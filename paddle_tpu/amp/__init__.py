@@ -1,0 +1,142 @@
+"""AMP: autocast + GradScaler.
+
+Ref parity: python/paddle/amp/auto_cast.py + grad_scaler.py, C++ lists at
+paddle/fluid/imperative/amp_auto_cast.h. TPU-native default low-precision
+dtype is bfloat16 (no loss scaling needed); float16 kept for compat with
+scripts that ask for it, with the dynamic loss-scaling state machine of
+check_finite_and_unscale/update_loss_scaling implemented on jnp.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax
+import jax.numpy as jnp
+
+from ..core import config
+from ..core.tensor import Tensor
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16"):
+    st = config._state
+    prev = (st.amp_level, st.amp_dtype, st.custom_white_list,
+            st.custom_black_list)
+    if enable:
+        st.amp_level = level
+        st.amp_dtype = dtype
+        st.custom_white_list = custom_white_list
+        st.custom_black_list = custom_black_list
+    try:
+        yield
+    finally:
+        (st.amp_level, st.amp_dtype, st.custom_white_list,
+         st.custom_black_list) = prev
+
+
+amp_guard = auto_cast  # legacy fluid name
+
+
+def decorate(models, optimizers=None, level="O1", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """O2: cast model params to the low-precision dtype (keeping fp32
+    master weights inside the optimizer state, which stores f32 moments)."""
+    if level == "O2":
+        for m in models if isinstance(models, (list, tuple)) else [models]:
+            m.to(dtype=dtype)
+    if optimizers is None:
+        return models
+    return models, optimizers
+
+
+class GradScaler:
+    """Dynamic loss scaling (ref: python/paddle/amp/grad_scaler.py over
+    check_finite_and_unscale_op + update_loss_scaling_op). With bfloat16
+    scaling is a no-op (enable=False default path on TPU)."""
+
+    def __init__(self, enable=True, init_loss_scaling=2.0 ** 15,
+                 incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=1000,
+                 decr_every_n_nan_or_inf=1, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling)
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every_n_steps = incr_every_n_steps
+        self._decr_every_n = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+
+    def scale(self, loss):
+        if not self._enable:
+            return loss
+        return loss * self._scale
+
+    def unscale_(self, optimizer):
+        if not self._enable:
+            return
+        found_inf = False
+        for p in optimizer._parameter_list or []:
+            if p is None or p._grad is None:
+                continue
+            g = p._grad / self._scale
+            finite = bool(jnp.all(jnp.isfinite(g)))
+            if not finite:
+                found_inf = True
+            p._grad = g
+        self._found_inf = found_inf
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+        self.update()
+
+    def minimize(self, optimizer, scaled_loss):
+        self.step(optimizer)
+
+    def update(self):
+        if not (self._enable and self._dynamic):
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every_n:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every_n_steps:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+        self._found_inf = False
+
+    def is_enable(self):
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self):
+        return self._dynamic
+
+    def get_loss_scaling(self):
+        return Tensor(jnp.asarray(self._scale))
+
+    def set_init_loss_scaling(self, v):
+        self._scale = float(v)
+
+    def state_dict(self):
+        return {"scale": self._scale, "incr_ratio": self._incr_ratio,
+                "decr_ratio": self._decr_ratio,
+                "good_steps": self._good_steps,
+                "bad_steps": self._bad_steps}
+
+    def load_state_dict(self, sd):
+        self._scale = sd["scale"]
+        self._good_steps = sd.get("good_steps", 0)
+        self._bad_steps = sd.get("bad_steps", 0)

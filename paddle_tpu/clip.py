@@ -1,0 +1,103 @@
+"""Gradient clipping (ref: python/paddle/fluid/clip.py).
+
+Clippers operate on (param, grad) pairs eagerly and expose a pure
+`_clip_fn(grads_tree)` used by the functional engine so clipping compiles
+into the train step.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .core.tensor import Tensor
+
+
+class ClipGradBase:
+    def __call__(self, params_grads):
+        raise NotImplementedError
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -self.max
+
+    def __call__(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+                continue
+            out.append((p, Tensor(jnp.clip(g._value, self.min, self.max))))
+        return out
+
+    def _clip_fn(self, grads):
+        return jax.tree.map(lambda g: jnp.clip(g, self.min, self.max), grads)
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def __call__(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+                continue
+            gv = g._value
+            norm = jnp.sqrt(jnp.sum(jnp.square(gv)))
+            scale = jnp.minimum(self.clip_norm / jnp.maximum(norm, 1e-12),
+                                1.0)
+            out.append((p, Tensor(gv * scale)))
+        return out
+
+    def _clip_fn(self, grads):
+        def clip_one(g):
+            norm = jnp.sqrt(jnp.sum(jnp.square(g)))
+            scale = jnp.minimum(self.clip_norm / jnp.maximum(norm, 1e-12),
+                                1.0)
+            return g * scale
+
+        return jax.tree.map(clip_one, grads)
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    """ref: fluid/clip.py GradientClipByGlobalNorm. In hybrid-parallel runs
+    the global norm must reduce across model-parallel shards — handled by
+    HybridParallelClipGrad in paddle_tpu.distributed."""
+
+    def __init__(self, clip_norm, group_name="default_group"):
+        self.clip_norm = float(clip_norm)
+        self.group_name = group_name
+
+    def __call__(self, params_grads):
+        sq = 0.0
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                continue
+            gv = g._value.astype(jnp.float32)
+            sq = sq + jnp.sum(jnp.square(gv))
+        global_norm = jnp.sqrt(sq)
+        scale = self.clip_norm / jnp.maximum(global_norm, self.clip_norm)
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+                continue
+            out.append((p, Tensor(g._value * scale.astype(g._value.dtype))))
+        return out
+
+    def _clip_fn(self, grads):
+        leaves = jax.tree.leaves(grads)
+        sq = sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in leaves)
+        global_norm = jnp.sqrt(sq)
+        scale = self.clip_norm / jnp.maximum(global_norm, self.clip_norm)
+        return jax.tree.map(lambda g: g * scale.astype(g.dtype), grads)
+
+
+# legacy aliases (fluid names)
+GradientClipByValue = ClipGradByValue
+GradientClipByNorm = ClipGradByNorm
+GradientClipByGlobalNorm = ClipGradByGlobalNorm

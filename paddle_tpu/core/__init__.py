@@ -1,0 +1,14 @@
+"""Core runtime: Tensor, autograd tape, op registry/dispatch, dtypes.
+
+TPU-native reimagining of paddle/fluid/{framework,imperative} — the backing
+store is XLA/PJRT arrays managed by JAX; autograd tapes jax.vjp closures;
+ops are jax-traceable functions.
+"""
+
+from . import config  # noqa: F401
+from .autograd import grad  # noqa: F401
+from .config import enable_grad, no_grad, set_grad_enabled  # noqa: F401
+from .dispatch import apply  # noqa: F401
+from .dtype import DType  # noqa: F401
+from .op_registry import register_op, registered_ops  # noqa: F401
+from .tensor import Parameter, Tensor  # noqa: F401

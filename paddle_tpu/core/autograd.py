@@ -1,0 +1,210 @@
+"""Tape-based reverse-mode autograd for the eager (dygraph) API.
+
+Ref parity: paddle/fluid/imperative/basic_engine.cc (BasicEngine::Execute,
+PrepareDeps), gradient_accumulator.cc, partial_grad_engine.cc. TPU-native
+design: instead of per-op hand-written grad kernels (GradOpMaker), each
+dispatched op records the `vjp_fn` produced by `jax.vjp` over its pure-jax
+implementation; the backward pass is a topological walk calling those vjp
+closures. Inside `jit`/functional-engine tracing the same machinery runs on
+tracers, so the whole forward+backward collapses into one XLA computation.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+class Node:
+    """One taped op: holds the vjp closure and links to input tensors."""
+
+    __slots__ = ("vjp_fn", "inputs", "out_meta", "op_name", "__weakref__")
+
+    def __init__(self, vjp_fn, inputs, out_meta, op_name):
+        self.vjp_fn = vjp_fn
+        # tuple aligned with the primal arrays passed to jax.vjp;
+        # entries are Tensor or None (non-tensor primals).
+        self.inputs = inputs
+        # list of (shape, dtype) per differentiable output, for zero cotangents
+        self.out_meta = out_meta
+        self.op_name = op_name
+
+
+def _zero_cotangent(meta):
+    shape, dtype = meta
+    if jnp.issubdtype(dtype, jnp.floating) or jnp.issubdtype(dtype, jnp.complexfloating):
+        return jnp.zeros(shape, dtype)
+    # integer/bool outputs take float0 cotangents in jax
+    return np.zeros(shape, jax.dtypes.float0)
+
+
+def _is_float0(g):
+    return isinstance(g, np.ndarray) and g.dtype == jax.dtypes.float0
+
+
+def _topo_order(root_nodes):
+    """Post-order DFS over the node graph (iterative; graphs can be deep)."""
+    order, seen = [], set()
+    stack = [(n, False) for n in root_nodes]
+    while stack:
+        node, processed = stack.pop()
+        if processed:
+            order.append(node)
+            continue
+        if id(node) in seen:
+            continue
+        seen.add(id(node))
+        stack.append((node, True))
+        for t in node.inputs:
+            if t is not None and t._tape is not None and not t.stop_gradient:
+                parent = t._tape[0]
+                if id(parent) not in seen:
+                    stack.append((parent, False))
+    return order
+
+
+def _accumulate(store, node, idx, value):
+    slots = store.setdefault(id(node), {})
+    if idx in slots and not _is_float0(slots[idx]):
+        if not _is_float0(value):
+            slots[idx] = slots[idx] + value
+    else:
+        slots[idx] = value
+
+
+def _run_backward(tensors, grad_tensors, retain_graph, sinks=None):
+    """Core reverse walk.
+
+    sinks: optional dict id(tensor) -> tensor. When given, captured grads are
+    returned in a dict (keyed by id) and leaf `.grad` fields are NOT written.
+    When None, grads accumulate into `.grad` of reachable leaf tensors.
+    """
+    from .tensor import Tensor
+
+    captured = {}
+
+    def leaf_sink(t, g):
+        if sinks is None:
+            t._accumulate_grad(g)
+        elif id(t) in sinks:
+            captured[id(t)] = captured[id(t)] + g if id(t) in captured else g
+
+    cot = {}  # id(node) -> {out_idx: cotangent}
+    node_of = {}
+    roots = []
+    for t, g in zip(tensors, grad_tensors):
+        if t.stop_gradient:
+            continue
+        if g is None:
+            if t.size != 1:
+                raise RuntimeError(
+                    "backward() on a non-scalar tensor requires an explicit "
+                    "grad_tensor (paddle semantics)")
+            seed = jnp.ones_like(t._value)
+        else:
+            seed = g._value if isinstance(g, Tensor) else jnp.asarray(g)
+        if t._tape is None:
+            leaf_sink(t, seed)
+        else:
+            node, idx = t._tape
+            _accumulate(cot, node, idx, seed)
+            node_of[id(node)] = node
+            roots.append(node)
+
+    if roots:
+        # map from (node id, out idx) -> intermediate sink tensor, to capture
+        # cotangents of non-leaf inputs when requested
+        want = {}
+        if sinks:
+            for t in sinks.values():
+                if t._tape is not None:
+                    n, i = t._tape
+                    want[(id(n), i)] = t
+
+        for node in reversed(_topo_order(roots)):
+            slots = cot.pop(id(node), None)
+            if slots is None:
+                continue  # not reached by any cotangent
+            if want:
+                for i, v in slots.items():
+                    sink_t = want.get((id(node), i))
+                    if sink_t is not None and not _is_float0(v):
+                        captured[id(sink_t)] = (
+                            captured[id(sink_t)] + v
+                            if id(sink_t) in captured else v)
+            cots = tuple(
+                slots.get(i, _zero_cotangent(m))
+                for i, m in enumerate(node.out_meta))
+            if node.vjp_fn is None:
+                raise RuntimeError(
+                    "trying to backward through the graph a second time; set "
+                    "retain_graph=True if this is intended")
+            in_grads = node.vjp_fn(cots if len(node.out_meta) > 1 else cots[0])
+            if not retain_graph:
+                node.vjp_fn = None
+            for t, g in zip(node.inputs, in_grads):
+                if t is None or t.stop_gradient or _is_float0(g):
+                    continue
+                for hook in t._hooks:
+                    out = hook(Tensor(g, stop_gradient=True))
+                    if out is not None:
+                        g = out._value if isinstance(out, Tensor) else out
+                if t._tape is None:
+                    leaf_sink(t, g)
+                else:
+                    pnode, pidx = t._tape
+                    _accumulate(cot, pnode, pidx, g)
+    return captured
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    """Run reverse accumulation from `tensors`, writing `.grad` on leaves."""
+    from .tensor import Tensor
+
+    if isinstance(tensors, Tensor):
+        tensors = [tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    elif isinstance(grad_tensors, Tensor):
+        grad_tensors = [grad_tensors]
+    _run_backward(tensors, grad_tensors, retain_graph)
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, allow_unused=False, no_grad_vars=None):
+    """paddle.grad — partial backward returning grads for `inputs` only.
+
+    Ref parity: paddle/fluid/imperative/partial_grad_engine.cc. Double grad
+    (create_graph=True) is not supported yet.
+    """
+    from .tensor import Tensor
+
+    if create_graph:
+        raise NotImplementedError(
+            "create_graph=True (double grad) is not implemented yet")
+    if isinstance(outputs, Tensor):
+        outputs = [outputs]
+    if isinstance(inputs, Tensor):
+        inputs = [inputs]
+    if grad_outputs is None:
+        grad_outputs = [None] * len(outputs)
+    elif isinstance(grad_outputs, Tensor):
+        grad_outputs = [grad_outputs]
+
+    sinks = {id(t): t for t in inputs}
+    keep = bool(retain_graph) if retain_graph is not None else create_graph
+    captured = _run_backward(outputs, grad_outputs, keep, sinks=sinks)
+
+    results = []
+    for t in inputs:
+        if id(t) not in captured:
+            if not allow_unused:
+                raise RuntimeError(
+                    "one of the inputs was not used in the graph; pass "
+                    "allow_unused=True to return None for it")
+            results.append(None)
+        else:
+            results.append(Tensor(captured[id(t)], stop_gradient=True))
+    return results

@@ -1,0 +1,89 @@
+"""Global runtime state: grad mode, AMP mode, default dtype, device.
+
+TPU-native analogue of the reference's global tracer switches
+(ref: python/paddle/fluid/framework.py:185 `in_dygraph_mode`,
+paddle/fluid/imperative/tracer.h:50 `has_grad`, amp mode flags).
+Here there is no static/dygraph split: the framework is always
+imperative; compiled execution is obtained by `paddle_tpu.jit` /
+the functional engine, which trace the same op set.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+
+
+class _RuntimeState(threading.local):
+    def __init__(self):
+        super().__init__()
+        self.grad_enabled = True
+        # amp_level: None | 'O1' | 'O2'; amp_dtype: 'bfloat16' | 'float16'
+        self.amp_level = None
+        self.amp_dtype = "bfloat16"
+        self.custom_white_list = None
+        self.custom_black_list = None
+        self.default_dtype = "float32"
+        self.tracing = False  # True while inside jit capture
+
+
+_state = _RuntimeState()
+
+
+def is_grad_enabled() -> bool:
+    return _state.grad_enabled
+
+
+def set_grad_enabled(mode: bool):
+    """Context manager / function mirroring paddle.set_grad_enabled."""
+    return _GradMode(mode)
+
+
+class _GradMode(contextlib.AbstractContextManager):
+    def __init__(self, mode: bool):
+        self._mode = bool(mode)
+        self._prev = _state.grad_enabled
+        _state.grad_enabled = self._mode
+
+    def __exit__(self, *exc):
+        _state.grad_enabled = self._prev
+        return False
+
+
+class no_grad(contextlib.ContextDecorator):
+    """paddle.no_grad — usable as context manager and decorator."""
+
+    def __enter__(self):
+        self._prev = _state.grad_enabled
+        _state.grad_enabled = False
+        return self
+
+    def __exit__(self, *exc):
+        _state.grad_enabled = self._prev
+        return False
+
+
+class enable_grad(contextlib.ContextDecorator):
+    def __enter__(self):
+        self._prev = _state.grad_enabled
+        _state.grad_enabled = True
+        return self
+
+    def __exit__(self, *exc):
+        _state.grad_enabled = self._prev
+        return False
+
+
+def get_default_dtype() -> str:
+    return _state.default_dtype
+
+
+def set_default_dtype(d) -> None:
+    from .dtype import canonical_dtype_name
+
+    _state.default_dtype = canonical_dtype_name(d)
+
+
+def amp_state():
+    return (_state.amp_level, _state.amp_dtype,
+            _state.custom_white_list, _state.custom_black_list)

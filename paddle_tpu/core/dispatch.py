@@ -1,0 +1,133 @@
+"""Op dispatch: the single funnel every eager op call goes through.
+
+Ref parity: paddle/fluid/imperative/tracer.cc:150 (TraceOp) — create op,
+AMP autocast rewrite, run kernel, tape the backward. Here the "kernel" is a
+pure jax function (XLA compiles + fuses it), autocast is an input-dtype
+rewrite, and taping captures `jax.vjp` closures (see autograd.py).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from . import config
+from .autograd import Node
+from .op_registry import lookup
+
+# ---------------------------------------------------------------------------
+# AMP policy (ref: paddle/fluid/imperative/amp_auto_cast.h AmpOperators and
+# python/paddle/fluid/dygraph/amp/auto_cast.py white/black lists). On TPU the
+# low-precision dtype is bfloat16; float16 is kept for compatibility.
+# ---------------------------------------------------------------------------
+
+AMP_WHITE_LIST = {
+    "matmul_v2", "matmul", "mul", "conv2d", "conv2d_transpose", "conv1d",
+    "conv3d", "depthwise_conv2d", "einsum", "fused_attention",
+    "flash_attention", "bmm", "addmm",
+}
+
+AMP_BLACK_LIST = {
+    "softmax_with_cross_entropy", "cross_entropy", "log_softmax", "exp",
+    "log", "log2", "log10", "log1p", "mean", "sum", "reduce_sum",
+    "reduce_mean", "softmax", "layer_norm", "batch_norm", "norm", "cumsum",
+    "pow", "rsqrt", "erf", "erfinv", "sigmoid_cross_entropy_with_logits",
+    "nll_loss", "kldiv_loss",
+}
+
+
+def _amp_rewrite(op_name, arrs):
+    level, amp_dtype, white, black = config.amp_state()
+    if level is None:
+        return arrs
+    white_list = AMP_WHITE_LIST if white is None else (AMP_WHITE_LIST | set(white))
+    black_list = AMP_BLACK_LIST if black is None else (AMP_BLACK_LIST | set(black))
+    low = jnp.bfloat16 if amp_dtype == "bfloat16" else jnp.float16
+
+    def cast_to(a, dt):
+        if hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.floating) \
+                and a.dtype != dt and a.dtype != jnp.float64:
+            return a.astype(dt)
+        return a
+
+    if op_name in black_list:
+        return [cast_to(a, jnp.float32) for a in arrs]
+    if op_name in white_list or level == "O2":
+        return [cast_to(a, low) for a in arrs]
+    return arrs
+
+
+# ---------------------------------------------------------------------------
+# dispatch
+# ---------------------------------------------------------------------------
+
+def _as_primal(x):
+    """Tensor -> backing array; arrays/scalars pass through."""
+    from .tensor import Tensor
+
+    if isinstance(x, Tensor):
+        return x._value
+    return x
+
+
+def apply(op_name, *inputs, **attrs):
+    """Run op `op_name` on `inputs` (Tensors / arrays / scalars).
+
+    Returns Tensor or tuple of Tensors. For `has_aux` ops the aux outputs are
+    appended as stop-gradient Tensors.
+    """
+    from .tensor import Tensor
+
+    opdef = lookup(op_name)
+    tensor_inputs = tuple(x if isinstance(x, Tensor) else None for x in inputs)
+    arrs = [_as_primal(x) for x in inputs]
+    arrs = _amp_rewrite(op_name, arrs)
+
+    requires_grad = (
+        config.is_grad_enabled()
+        and not opdef.no_grad
+        and any(t is not None and not t.stop_gradient for t in tensor_inputs)
+    )
+
+    def f(*primals):
+        return opdef.fn(*primals, **attrs)
+
+    if not requires_grad:
+        out = f(*arrs)
+        aux = None
+        if opdef.has_aux:
+            out, aux = out
+        return _wrap_outputs(opdef, out, aux, node=None)
+
+    if opdef.has_aux:
+        out, vjp_fn, aux = jax.vjp(f, *arrs, has_aux=True)
+    else:
+        out, vjp_fn = jax.vjp(f, *arrs)
+        aux = None
+
+    outs_flat = out if isinstance(out, tuple) else (out,)
+    out_meta = [(o.shape, o.dtype) for o in outs_flat]
+    node = Node(vjp_fn, tensor_inputs, out_meta, op_name)
+    return _wrap_outputs(opdef, out, aux, node=node)
+
+
+def _wrap_outputs(opdef, out, aux, node):
+    from .tensor import Tensor
+
+    def wrap_diff(o, idx):
+        t = Tensor(o, stop_gradient=node is None)
+        if node is not None:
+            t._tape = (node, idx)
+        return t
+
+    if isinstance(out, tuple):
+        outs = tuple(wrap_diff(o, i) for i, o in enumerate(out))
+    else:
+        outs = wrap_diff(out, 0)
+
+    if aux is None:
+        return outs
+    aux_t = jax.tree.map(lambda a: Tensor(a, stop_gradient=True), aux)
+    if isinstance(outs, tuple):
+        return outs + (aux_t if isinstance(aux_t, tuple) else (aux_t,))
+    return (outs,) + (aux_t if isinstance(aux_t, tuple) else (aux_t,))

@@ -1,0 +1,110 @@
+"""Dtype system: paddle-style names mapped onto jax/numpy dtypes.
+
+Ref parity: paddle/fluid/framework/framework.proto VarType.Type dtype enum;
+python/paddle/fluid/data_feeder.py convert_dtype. TPU-native default compute
+dtype is float32 with bfloat16 as the AMP dtype (fp16 kept for compat).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+# canonical name -> jnp dtype
+_NAME_TO_DTYPE = {
+    "bool": jnp.bool_,
+    "uint8": jnp.uint8,
+    "int8": jnp.int8,
+    "int16": jnp.int16,
+    "int32": jnp.int32,
+    "int64": jnp.int64,
+    "float16": jnp.float16,
+    "bfloat16": jnp.bfloat16,
+    "float32": jnp.float32,
+    "float64": jnp.float64,
+    "complex64": jnp.complex64,
+    "complex128": jnp.complex128,
+}
+
+_ALIASES = {
+    "float": "float32",
+    "double": "float64",
+    "half": "float16",
+    "int": "int32",
+    "long": "int64",
+    "bfloat": "bfloat16",
+}
+
+
+class DType:
+    """Lightweight dtype handle so `paddle_tpu.float32` etc. exist and
+    compare equal to their string names and numpy dtypes."""
+
+    __slots__ = ("name", "np_dtype")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.np_dtype = np.dtype(_NAME_TO_DTYPE[name])
+
+    def __repr__(self):
+        return f"paddle_tpu.{self.name}"
+
+    def __eq__(self, other):
+        try:
+            return canonical_dtype_name(other) == self.name
+        except (TypeError, ValueError):
+            return NotImplemented
+
+    def __hash__(self):
+        return hash(self.name)
+
+
+_DTYPE_SINGLETONS = {name: DType(name) for name in _NAME_TO_DTYPE}
+
+
+def canonical_dtype_name(d) -> str:
+    """Normalise any dtype-ish (str, DType, np.dtype, jnp type) to a name."""
+    if isinstance(d, DType):
+        return d.name
+    if isinstance(d, str):
+        d = _ALIASES.get(d, d)
+        if d in _NAME_TO_DTYPE:
+            return d
+        # fall through to np parsing for things like '<f4'
+    try:
+        name = np.dtype(d).name
+    except TypeError as e:  # e.g. bfloat16 class
+        name = getattr(d, "__name__", None) or getattr(d, "name", None)
+        if name is None:
+            raise ValueError(f"unsupported dtype: {d!r}") from e
+    if name == "float64" or name == "int64":
+        return name
+    if name not in _NAME_TO_DTYPE:
+        raise ValueError(f"unsupported dtype: {d!r}")
+    return name
+
+
+def to_jax_dtype(d):
+    name = canonical_dtype_name(d)
+    # TPU-native narrowing: without jax x64 mode, 64-bit requests become
+    # their 32-bit counterparts (XLA:TPU emulates int64/f64 anyway).
+    # Doing it here keeps jnp from warning on every creation.
+    import jax
+
+    if not jax.config.jax_enable_x64 and name in ("int64", "float64",
+                                                  "complex128"):
+        name = {"int64": "int32", "float64": "float32",
+                "complex128": "complex64"}[name]
+    return _NAME_TO_DTYPE[name]
+
+
+def dtype_handle(d) -> DType:
+    return _DTYPE_SINGLETONS[canonical_dtype_name(d)]
+
+
+def is_floating(d) -> bool:
+    return jnp.issubdtype(to_jax_dtype(d), jnp.floating)
+
+
+def is_integer(d) -> bool:
+    return jnp.issubdtype(to_jax_dtype(d), jnp.integer)

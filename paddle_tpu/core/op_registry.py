@@ -1,0 +1,61 @@
+"""Operator registry.
+
+Ref parity: paddle/fluid/framework/op_registry.h — the reference keys kernels
+by OpKernelType{place,dtype,layout,library}; on TPU every op is a pure
+jax-traceable function, so the registry maps op_type -> OpDef. Dispatch,
+AMP policy, and autograd live in `dispatch.py`; XLA does kernel selection,
+layout, and fusion.
+
+An OpDef's `fn` signature is `fn(*arrays, **attrs) -> array | tuple`.
+If `has_aux`, `fn` returns `(differentiable_outputs, aux_outputs)` and only
+the first element participates in autograd (indices, masks, ... go in aux).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import typing as _t
+
+
+@dataclasses.dataclass(frozen=True)
+class OpDef:
+    name: str
+    fn: _t.Callable
+    has_aux: bool = False
+    # multi_out: fn returns a tuple of differentiable outputs
+    multi_out: bool = False
+    # ops that must never be differentiated (comparison, logical, ...)
+    no_grad: bool = False
+
+
+_REGISTRY: dict[str, OpDef] = {}
+
+
+def register_op(name: str, *, has_aux: bool = False, multi_out: bool = False,
+                no_grad: bool = False):
+    """Decorator: @register_op('matmul_v2')."""
+
+    def deco(fn):
+        if name in _REGISTRY:
+            raise KeyError(f"op '{name}' already registered")
+        _REGISTRY[name] = OpDef(name, fn, has_aux=has_aux,
+                                multi_out=multi_out, no_grad=no_grad)
+        return fn
+
+    return deco
+
+
+def lookup(name: str) -> OpDef:
+    try:
+        return _REGISTRY[name]
+    except KeyError:
+        raise NotImplementedError(
+            f"op '{name}' is not registered in paddle_tpu") from None
+
+
+def registered_ops() -> list[str]:
+    return sorted(_REGISTRY)
+
+
+def has_op(name: str) -> bool:
+    return name in _REGISTRY

@@ -1,0 +1,378 @@
+"""Eager Tensor: a jax.Array handle with paddle semantics.
+
+Ref parity: paddle/fluid/imperative/layer.h:66 (VarBase) +
+python/paddle/fluid/dygraph/varbase_patch_methods.py. Differences by design:
+the backing store is an immutable `jax.Array` (XLA-managed device buffer;
+PJRT handles allocation/donation), "in-place" mutation rebinds the handle,
+and autograd state is a (Node, output-index) tape link instead of grad-op
+descriptors. LoDTensor has no analogue — variable-length data is expressed
+with padding + masks (static shapes for XLA).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from . import config
+from .autograd import backward as _backward
+from .dispatch import apply
+from .dtype import canonical_dtype_name, dtype_handle, to_jax_dtype
+
+
+def _coerce(data, dtype=None):
+    """Build a jax array from arbitrary input data."""
+    if isinstance(data, Tensor):
+        data = data._value
+    if isinstance(data, (jax.Array, jax.core.Tracer)):
+        # already device data (or a tracer inside jit) — never via numpy
+        if dtype is not None:
+            return data.astype(to_jax_dtype(dtype))
+        return data
+    if isinstance(data, (bool, int, float, complex, list, tuple, np.ndarray,
+                         np.generic)) or hasattr(data, "__array__"):
+        arr = np.asarray(data)
+        if dtype is None and arr.dtype == np.float64:
+            # paddle default: python floats / float64 numpy -> default dtype
+            dtype = config.get_default_dtype()
+        if dtype is None and arr.dtype == np.int64 and not isinstance(
+                data, np.ndarray):
+            dtype = "int64"  # keep python int64 semantics like paddle
+        data = arr
+    out = jnp.asarray(data, dtype=to_jax_dtype(dtype) if dtype is not None else None)
+    return out
+
+
+class Tensor:
+    __slots__ = ("_value", "stop_gradient", "_grad", "_tape", "name",
+                 "persistable", "_hooks", "__weakref__")
+
+    def __init__(self, value, dtype=None, stop_gradient=True, name=None):
+        self._value = _coerce(value, dtype)
+        self.stop_gradient = stop_gradient
+        self._grad = None
+        self._tape = None
+        self.name = name
+        self.persistable = False
+        self._hooks = []
+
+    # -- basic properties ---------------------------------------------------
+    @property
+    def shape(self):
+        return list(self._value.shape)
+
+    @property
+    def ndim(self):
+        return self._value.ndim
+
+    dim = ndim
+
+    @property
+    def size(self):
+        return int(self._value.size)
+
+    @property
+    def dtype(self):
+        return dtype_handle(self._value.dtype.name)
+
+    @property
+    def place(self):
+        devs = getattr(self._value, "devices", None)
+        if devs is None:
+            return "unknown"
+        return str(next(iter(self._value.devices())))
+
+    @property
+    def T(self):
+        return apply("transpose",
+                     self, perm=list(range(self.ndim))[::-1])
+
+    def is_leaf(self):
+        return self._tape is None
+
+    # -- value access -------------------------------------------------------
+    def numpy(self):
+        return np.asarray(self._value)
+
+    def item(self, *args):
+        return self._value.item(*args)
+
+    def tolist(self):
+        return np.asarray(self._value).tolist()
+
+    def __float__(self):
+        return float(self._value)
+
+    def __int__(self):
+        return int(self._value)
+
+    def __bool__(self):
+        return bool(self._value)
+
+    def __index__(self):
+        return int(self._value)
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self._value.shape[0]
+
+    def __repr__(self):
+        grad_info = "" if self.stop_gradient else ", stop_gradient=False"
+        return (f"Tensor(shape={self.shape}, dtype={self.dtype.name}"
+                f"{grad_info},\n       {np.asarray(self._value)!r})")
+
+    def __hash__(self):
+        return id(self)
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    # numpy interop
+    def __array__(self, dtype=None):
+        arr = np.asarray(self._value)
+        return arr.astype(dtype) if dtype is not None else arr
+
+    # -- autograd -----------------------------------------------------------
+    @property
+    def grad(self):
+        if self._grad is None:
+            return None
+        return Tensor(self._grad, stop_gradient=True)
+
+    @grad.setter
+    def grad(self, value):
+        self._grad = None if value is None else _coerce(value)
+
+    def backward(self, grad_tensor=None, retain_graph=False):
+        _backward([self], [grad_tensor], retain_graph=retain_graph)
+
+    def clear_grad(self):
+        self._grad = None
+
+    clear_gradient = clear_grad
+
+    def _accumulate_grad(self, g):
+        if g.dtype != self._value.dtype:
+            g = g.astype(self._value.dtype)
+        self._grad = g if self._grad is None else self._grad + g
+
+    def register_hook(self, hook):
+        self._hooks.append(hook)
+
+        class _Removable:
+            def remove(inner):
+                if hook in self._hooks:
+                    self._hooks.remove(hook)
+        return _Removable()
+
+    def detach(self):
+        # lax.stop_gradient so detach also cuts jax AD when this runs under
+        # a functional trace (engine/jit); identity on concrete arrays
+        t = Tensor(jax.lax.stop_gradient(self._value), stop_gradient=True)
+        t.name = self.name
+        return t
+
+    def detach_(self):
+        self._tape = None
+        self.stop_gradient = True
+        return self
+
+    def clone(self):
+        return apply("assign", self)
+
+    # -- mutation (rebinds the immutable buffer) ----------------------------
+    def _check_inplace(self):
+        # mutating a taped (non-leaf) tensor would leave backward walking
+        # the pre-mutation graph — paddle rejects this via the inplace
+        # version counter (framework/tensor.h inplace_version_counter_)
+        if self._tape is not None:
+            raise RuntimeError(
+                "in-place mutation of a tensor produced by a taped op is "
+                "not allowed (its gradient graph would become stale); "
+                "use out-of-place ops or .detach() first")
+
+    def set_value(self, value):
+        self._check_inplace()
+        new = _coerce(value, None)
+        if tuple(new.shape) != tuple(self._value.shape):
+            raise ValueError(
+                f"set_value shape mismatch: {new.shape} vs {self._value.shape}")
+        self._value = new.astype(self._value.dtype)
+
+    def copy_(self, other):
+        self.set_value(other)
+        return self
+
+    def fill_(self, value):
+        self._check_inplace()
+        self._value = jnp.full_like(self._value, value)
+        return self
+
+    def zero_(self):
+        self._check_inplace()
+        self._value = jnp.zeros_like(self._value)
+        return self
+
+    # -- dtype / shape ------------------------------------------------------
+    def astype(self, dtype):
+        return apply("cast", self, dtype=canonical_dtype_name(dtype))
+
+    cast = astype
+
+    def cpu(self):
+        return self
+
+    def pin_memory(self):
+        return self
+
+    def cuda(self, *a, **k):
+        return self
+
+    def to(self, *args, **kwargs):
+        for a in args:
+            try:
+                return self.astype(a)
+            except (ValueError, TypeError):
+                continue
+        if "dtype" in kwargs:
+            return self.astype(kwargs["dtype"])
+        return self
+
+    # -- indexing -----------------------------------------------------------
+    def __getitem__(self, idx):
+        idx = _unwrap_index(idx)
+        return apply("getitem", self, idx=idx)
+
+    def __setitem__(self, idx, value):
+        self._check_inplace()
+        idx = _unwrap_index(idx)
+        if isinstance(value, Tensor):
+            value = value._value
+        self._value = self._value.at[idx].set(value)
+
+    # -- operators (implementations registered in paddle_tpu.ops) -----------
+    def __add__(self, o):
+        return apply("elementwise_add", self, o)
+
+    def __radd__(self, o):
+        return apply("elementwise_add", o, self)
+
+    def __sub__(self, o):
+        return apply("elementwise_sub", self, o)
+
+    def __rsub__(self, o):
+        return apply("elementwise_sub", o, self)
+
+    def __mul__(self, o):
+        return apply("elementwise_mul", self, o)
+
+    def __rmul__(self, o):
+        return apply("elementwise_mul", o, self)
+
+    def __truediv__(self, o):
+        return apply("elementwise_div", self, o)
+
+    def __rtruediv__(self, o):
+        return apply("elementwise_div", o, self)
+
+    def __floordiv__(self, o):
+        return apply("elementwise_floordiv", self, o)
+
+    def __rfloordiv__(self, o):
+        return apply("elementwise_floordiv", o, self)
+
+    def __mod__(self, o):
+        return apply("elementwise_mod", self, o)
+
+    def __rmod__(self, o):
+        return apply("elementwise_mod", o, self)
+
+    def __pow__(self, o):
+        return apply("elementwise_pow", self, o)
+
+    def __rpow__(self, o):
+        return apply("elementwise_pow", o, self)
+
+    def __matmul__(self, o):
+        return apply("matmul_v2", self, o)
+
+    def __rmatmul__(self, o):
+        return apply("matmul_v2", o, self)
+
+    def __neg__(self):
+        return apply("scale", self, scale=-1.0)
+
+    def __abs__(self):
+        return apply("abs", self)
+
+    def __invert__(self):
+        return apply("logical_not", self)
+
+    # in-place arithmetic rebinds (autograd-safe only outside taped regions)
+    def __iadd__(self, o):
+        return self.__add__(o)
+
+    def __isub__(self, o):
+        return self.__sub__(o)
+
+    def __imul__(self, o):
+        return self.__mul__(o)
+
+    def __itruediv__(self, o):
+        return self.__truediv__(o)
+
+    # comparisons (no-grad ops)
+    def __eq__(self, o):
+        return apply("equal", self, o)
+
+    def __ne__(self, o):
+        return apply("not_equal", self, o)
+
+    def __lt__(self, o):
+        return apply("less_than", self, o)
+
+    def __le__(self, o):
+        return apply("less_equal", self, o)
+
+    def __gt__(self, o):
+        return apply("greater_than", self, o)
+
+    def __ge__(self, o):
+        return apply("greater_equal", self, o)
+
+
+def _unwrap_index(idx):
+    def unwrap(i):
+        if isinstance(i, Tensor):
+            return i._value
+        if isinstance(i, slice):
+            return slice(unwrap(i.start), unwrap(i.stop), unwrap(i.step))
+        return i
+
+    if isinstance(idx, tuple):
+        return tuple(unwrap(i) for i in idx)
+    return unwrap(idx)
+
+
+class Parameter(Tensor):
+    """Trainable tensor (ref: python/paddle/fluid/framework.py Parameter)."""
+
+    __slots__ = ("trainable", "optimize_attr", "regularizer", "need_clip",
+                 "param_spec", "is_distributed")
+
+    def __init__(self, value, dtype=None, name=None, trainable=True):
+        super().__init__(value, dtype=dtype, stop_gradient=not trainable,
+                         name=name)
+        self.trainable = trainable
+        self.persistable = True
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.regularizer = None
+        self.need_clip = True
+        # jax.sharding.PartitionSpec for GSPMD parallelism (set by parallel
+        # layers; consumed by the functional engine when building shardings)
+        self.param_spec = None
+        self.is_distributed = False

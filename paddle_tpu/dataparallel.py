@@ -1,0 +1,47 @@
+"""paddle.DataParallel (ref: python/paddle/fluid/dygraph/parallel.py:382 +
+paddle/fluid/imperative/reducer.cc).
+
+TPU-native semantics: in compiled (engine/pjit) execution, data parallelism
+is a sharding of the batch axis over the mesh's 'dp' axis — gradient
+synchronisation falls out of GSPMD as XLA all-reduces (no bucketing Reducer
+needed; XLA's latency-hiding scheduler overlaps them with the backward).
+This wrapper exists for API compatibility: it marks the model as
+data-parallel and, when a multi-device mesh is active, lets the engine pick
+batch sharding up automatically. Eager single-process behaviour is
+identity.
+"""
+
+from __future__ import annotations
+
+from .nn.layer.layers import Layer
+
+
+class DataParallel(Layer):
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        super().__init__()
+        self._layers = layers
+        self.find_unused_parameters = find_unused_parameters
+        self.group = group
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def scale_loss(self, loss):
+        # kept for API parity; grads are averaged by the compiled allreduce
+        return loss
+
+    def apply_collective_grads(self):
+        # eager single-process: nothing to reduce; multi-device runs use the
+        # compiled engine where XLA emits the reductions
+        pass
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self._layers.set_state_dict(state_dict, *args, **kwargs)
+
+    load_dict = set_state_dict
+    set_dict = set_state_dict

@@ -1,0 +1,72 @@
+"""Device management (ref: python/paddle/device.py).
+
+On TPU there is no per-op device placement: JAX owns the local devices
+(PJRT) and `jit` computations are placed by sharding. `set_device` selects
+the default jax platform when called before first use.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def get_device() -> str:
+    d = jax.devices()[0]
+    plat = d.platform
+    if plat in ("tpu", "axon"):
+        return f"tpu:{d.id}"
+    return f"{plat}:{d.id}"
+
+
+def set_device(device: str):
+    dev = device.split(":")[0]
+    if dev in ("gpu", "cuda"):
+        raise ValueError(
+            "paddle_tpu targets TPU (and CPU for testing); GPU is not a "
+            "supported backend")
+    try:
+        jax.config.update("jax_platforms", "cpu" if dev == "cpu" else None)
+    except RuntimeError:
+        pass  # backend already initialised; placement is sharding-driven
+    return get_device()
+
+
+def device_count() -> int:
+    return jax.device_count()
+
+
+def is_compiled_with_cuda() -> bool:
+    return False
+
+
+def is_compiled_with_rocm() -> bool:
+    return False
+
+
+def is_compiled_with_xpu() -> bool:
+    return False
+
+
+def is_compiled_with_npu() -> bool:
+    return False
+
+
+def is_compiled_with_tpu() -> bool:
+    return True
+
+
+class CPUPlace:
+    def __repr__(self):
+        return "Place(cpu)"
+
+
+class TPUPlace:
+    def __init__(self, device_id=0):
+        self.device_id = device_id
+
+    def __repr__(self):
+        return f"Place(tpu:{self.device_id})"
+
+
+# alias kept for scripts written against CUDAPlace
+CUDAPlace = TPUPlace

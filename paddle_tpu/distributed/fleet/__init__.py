@@ -1,0 +1,36 @@
+"""paddle_tpu.distributed.fleet — the distributed-training facade.
+
+Ref parity: python/paddle/distributed/fleet/__init__.py. Module-level
+functions delegate to a singleton Fleet instance, exactly like the
+reference.
+"""
+
+from .base.distributed_strategy import DistributedStrategy  # noqa: F401
+from .base.fleet_base import Fleet
+from . import meta_parallel  # noqa: F401
+from . import utils  # noqa: F401
+from .utils.recompute import recompute  # noqa: F401
+
+fleet = Fleet()
+
+init = fleet.init
+distributed_model = fleet.distributed_model
+distributed_optimizer = fleet.distributed_optimizer
+distributed_scaler = fleet.distributed_scaler
+get_hybrid_communicate_group = fleet.get_hybrid_communicate_group
+worker_index = fleet.worker_index
+worker_num = fleet.worker_num
+is_first_worker = fleet.is_first_worker
+worker_endpoints = fleet.worker_endpoints
+barrier_worker = fleet.barrier_worker
+minimize = fleet.minimize
+
+
+class UserDefinedRoleMaker:
+    def __init__(self, *a, **k):
+        pass
+
+
+class PaddleCloudRoleMaker:
+    def __init__(self, is_collective=False, **kwargs):
+        self._is_collective = is_collective

@@ -1,0 +1,132 @@
+"""Fleet facade (ref: python/paddle/distributed/fleet/base/fleet_base.py:
+139 init, 783 distributed_optimizer, 1288 minimize).
+
+TPU-native: `init` builds the HybridCommunicateGroup (and thus the jax
+Mesh) from strategy.hybrid_configs; `distributed_model` wraps by
+ParallelMode; `distributed_optimizer` returns a HybridParallelOptimizer
+that carries the strategy into the compiled engine. There are no program
+rewrites — the meta-optimizer composition collapses into sharding specs +
+engine options (GSPMD/ZeRO/pipeline/recompute flags).
+"""
+
+from __future__ import annotations
+
+import jax
+
+from ....dataparallel import DataParallel
+from ...parallel import ParallelEnv, get_rank, get_world_size, \
+    init_parallel_env
+from ...topology import (
+    HybridCommunicateGroup, ParallelMode, set_hybrid_communicate_group,
+)
+from .distributed_strategy import DistributedStrategy
+
+
+class _RoleMaker:
+    def __init__(self, is_collective=True):
+        self._is_collective = is_collective
+
+    def _is_non_distributed(self):
+        return get_world_size() <= 1 and jax.device_count() <= 1
+
+
+class Fleet:
+    def __init__(self):
+        self._role_maker = None
+        self._hcg = None
+        self._user_defined_strategy = None
+        self._is_initialized = False
+
+    # -- init ----------------------------------------------------------------
+    def init(self, role_maker=None, is_collective=True, strategy=None):
+        if strategy is None:
+            strategy = DistributedStrategy()
+        self._user_defined_strategy = strategy
+        self._role_maker = role_maker or _RoleMaker(is_collective)
+        init_parallel_env()
+
+        hc = strategy.hybrid_configs
+        ndev = jax.device_count()
+        mp = max(int(hc.get("mp_degree", 1)), 1)
+        pp = max(int(hc.get("pp_degree", 1)), 1)
+        sh = max(int(hc.get("sharding_degree", 1)), 1)
+        dp = int(hc.get("dp_degree", -1))
+        if dp <= 0:
+            dp = max(ndev // (mp * pp * sh), 1)
+        self._hcg = HybridCommunicateGroup(
+            dp_degree=dp, mp_degree=mp, pp_degree=pp, sharding_degree=sh)
+        set_hybrid_communicate_group(self._hcg)
+        self._is_initialized = True
+        return self
+
+    # -- info ----------------------------------------------------------------
+    def get_hybrid_communicate_group(self):
+        return self._hcg
+
+    def worker_index(self):
+        return get_rank()
+
+    def worker_num(self):
+        return get_world_size()
+
+    def is_first_worker(self):
+        return get_rank() == 0
+
+    def worker_endpoints(self, to_string=False):
+        eps = ParallelEnv().trainer_endpoints
+        return ",".join(eps) if to_string else eps
+
+    def barrier_worker(self):
+        from ...collective import barrier
+
+        barrier()
+
+    @property
+    def world_size(self):
+        return get_world_size()
+
+    # -- model / optimizer wrapping -----------------------------------------
+    def distributed_model(self, model):
+        from ..meta_parallel.pipeline_parallel import PipelineParallel
+        from ..meta_parallel.pp_layers import PipelineLayer
+
+        if self._hcg is None:
+            self.init()
+        mode = self._hcg.get_parallel_mode()
+        if mode == ParallelMode.PIPELINE_PARALLEL and isinstance(
+                model, PipelineLayer):
+            return PipelineParallel(model, self._hcg,
+                                    self._user_defined_strategy)
+        if mode == ParallelMode.DATA_PARALLEL and \
+                self._hcg.get_data_parallel_world_size() > 1:
+            return DataParallel(model)
+        # tensor/sharding parallel: parameters already carry GSPMD specs
+        return model
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        from ..meta_optimizers.dygraph_optimizer import \
+            HybridParallelOptimizer
+
+        if strategy is not None:
+            self._user_defined_strategy = strategy
+        return HybridParallelOptimizer(
+            optimizer, self._hcg, self._user_defined_strategy)
+
+    def distributed_scaler(self, scaler):
+        return scaler
+
+    # -- static-graph style minimize (compat shim) ---------------------------
+    def minimize(self, optimizer, loss, startup_program=None,
+                 parameter_list=None, no_grad_set=None):
+        optimizer.step()
+        return None, []
+
+    # -- checkpoint ----------------------------------------------------------
+    def save_persistables(self, executor=None, dirname=None,
+                          main_program=None, mode=0):
+        raise NotImplementedError(
+            "use paddle_tpu.save / distributed.checkpoint for state saving")
+
+    @property
+    def hcg(self):
+        return self._hcg

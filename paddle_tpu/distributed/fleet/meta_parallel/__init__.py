@@ -1,0 +1,9 @@
+"""Meta-parallel layers & engines (ref: python/paddle/distributed/fleet/
+meta_parallel/)."""
+
+from .mp_layers import (  # noqa: F401
+    ColumnParallelLinear, ParallelCrossEntropy, RowParallelLinear,
+    VocabParallelEmbedding, parallel_linear_split, shard_hint,
+)
+from .pp_layers import LayerDesc, PipelineLayer, SharedLayerDesc  # noqa: F401
+from .pipeline_parallel import PipelineParallel, pipeline_spmd  # noqa: F401

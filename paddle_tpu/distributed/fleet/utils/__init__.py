@@ -1,0 +1,3 @@
+"""Fleet utils (ref: python/paddle/distributed/fleet/utils/)."""
+
+from .recompute import recompute  # noqa: F401

@@ -1,0 +1,67 @@
+"""Activation recomputation (ref: fleet/utils/recompute.py:63
+RecomputeFunction — a PyLayer that re-runs forward under saved RNG state
+during backward).
+
+TPU-native: `jax.checkpoint` (rematerialisation) IS this feature, applied
+at trace time — XLA recomputes the segment in the backward pass, and the
+threaded-PRNG design makes dropout reproducibility automatic (the same key
+is folded in on replay; no RNG state tracker needed). Eagerly (no jit)
+recompute is a no-op: the tape already stores residuals.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from ....core.tensor import Tensor
+
+
+def recompute(function, *args, **kwargs):
+    preserve_rng_state = kwargs.pop("preserve_rng_state", True)  # noqa: F841
+    use_reentrant = kwargs.pop("use_reentrant", True)  # noqa: F841
+
+    sample = None
+    for a in args:
+        if isinstance(a, Tensor):
+            sample = a
+            break
+    tracing = sample is not None and isinstance(sample._value,
+                                                jax.core.Tracer)
+    if not tracing:
+        return function(*args, **kwargs)
+
+    def fn_arrays(*arrs):
+        wrapped = [Tensor(a) if not isinstance(a, Tensor) else a
+                   for a in arrs]
+        out = function(*wrapped, **kwargs)
+        return jax.tree.map(
+            lambda t: t._value if isinstance(t, Tensor) else t, out,
+            is_leaf=lambda t: isinstance(t, Tensor))
+
+    arrs = [a._value if isinstance(a, Tensor) else a for a in args]
+    out = jax.checkpoint(fn_arrays)(*arrs)
+    return jax.tree.map(Tensor, out)
+
+
+class RecomputeSequential:
+    """Helper: wrap sublayer calls of a Sequential in recompute segments."""
+
+    def __init__(self, layers, segments=1):
+        self.layers = layers
+        self.segments = segments
+
+    def __call__(self, x):
+        n = len(self.layers)
+        seg = max(n // self.segments, 1)
+        i = 0
+        while i < n:
+            chunk = self.layers[i:i + seg]
+
+            def run_chunk(inp, chunk=chunk):
+                for l in chunk:
+                    inp = l(inp)
+                return inp
+
+            x = recompute(run_chunk, x)
+            i += seg
+        return x

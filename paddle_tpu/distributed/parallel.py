@@ -1,0 +1,88 @@
+"""Process/parallel environment.
+
+Ref parity: python/paddle/distributed/parallel.py:58 init_parallel_env +
+the PADDLE_TRAINER_* env contract (fleet/launch_utils.py). TPU-native: one
+process per *host* (not per chip); `jax.distributed.initialize` plays the
+role of the NCCL-id TCP bootstrap (gen_comm_id_helper.cc), and the
+"world" is the set of jax processes × local devices.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+_parallel_env_initialized = False
+
+
+class ParallelEnv:
+    """ref: fluid/dygraph/parallel.py ParallelEnv."""
+
+    def __init__(self):
+        self._rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+        self._world_size = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+        self._device_id = 0
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        self._trainer_endpoints = eps.split(",") if eps else []
+        self._current_endpoint = os.environ.get("PADDLE_CURRENT_ENDPOINT",
+                                                "")
+
+    @property
+    def rank(self):
+        return self._rank
+
+    @property
+    def world_size(self):
+        return self._world_size
+
+    @property
+    def device_id(self):
+        return self._device_id
+
+    @property
+    def current_endpoint(self):
+        return self._current_endpoint
+
+    @property
+    def trainer_endpoints(self):
+        return self._trainer_endpoints
+
+    # legacy names
+    local_rank = rank
+    nranks = world_size
+
+
+def init_parallel_env():
+    """Bootstrap multi-host jax (DCN). Single-host is a no-op: all local
+    TPU chips already belong to this process (unlike the reference's
+    process-per-GPU model)."""
+    global _parallel_env_initialized
+    env = ParallelEnv()
+    if env.world_size > 1 and not _parallel_env_initialized:
+        coordinator = env.trainer_endpoints[0] if env.trainer_endpoints \
+            else None
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=env.world_size,
+            process_id=env.rank)
+    _parallel_env_initialized = True
+    return env
+
+
+def get_rank(group=None):
+    if group is not None:
+        return group.rank
+    try:
+        return jax.process_index()
+    except RuntimeError:
+        return int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+
+
+def get_world_size(group=None):
+    if group is not None:
+        return group.nranks
+    try:
+        return jax.process_count()
+    except RuntimeError:
+        return int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))

@@ -1,0 +1,248 @@
+"""4-D process topology -> jax device Mesh.
+
+Ref parity: python/paddle/distributed/fleet/base/topology.py:29-344
+(CommunicateTopology, HybridCommunicateGroup, ParallelMode). The reference
+builds one NCCL ring per axis of the data x model x pipe x sharding grid;
+here the grid *is* a jax.sharding.Mesh whose axis names are consumed by
+GSPMD specs and shard_map collectives — comm groups collapse into axis
+names.
+"""
+
+from __future__ import annotations
+
+import itertools
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+from .collective import Group, new_group
+from .parallel import get_rank
+
+
+class ParallelMode:
+    DATA_PARALLEL = 0
+    TENSOR_PARALLEL = 1
+    PIPELINE_PARALLEL = 2
+    SHARDING_PARALLEL = 3
+
+
+# canonical mesh axis names
+DP_AXIS = "dp"
+SHARDING_AXIS = "sharding"
+PP_AXIS = "pp"
+MP_AXIS = "mp"
+SEP_AXIS = "sep"  # sequence/context parallel (net-new vs reference)
+
+
+class CommunicateTopology:
+    """ref: topology.py:29 CommunicateTopology — a named hypercube of
+    ranks with per-axis comm groups."""
+
+    def __init__(self, hybrid_group_names=("data", "pipe", "sharding",
+                                           "model"),
+                 dims=(1, 1, 1, 1)):
+        self._parallel_names = list(hybrid_group_names)
+        self._dims = list(dims)
+        self.coordinate = list(itertools.product(
+            *(range(d) for d in self._dims)))
+        self._world_size = int(np.prod(self._dims))
+        self._rank2coord = dict(zip(range(self._world_size), self.coordinate))
+        self._coord2rank = {c: r for r, c in self._rank2coord.items()}
+
+    def get_hybrid_group_names(self):
+        return self._parallel_names
+
+    def get_dim(self, axis_name):
+        return self._dims[self._parallel_names.index(axis_name)]
+
+    get_dim_size = get_dim
+
+    def world_size(self):
+        return self._world_size
+
+    def get_rank(self, **kwargs):
+        coord = tuple(kwargs[name] for name in self._parallel_names)
+        return self._coord2rank[coord]
+
+    def get_coord(self, rank):
+        return self._rank2coord[rank]
+
+    def get_axis_list(self, axis_name, index):
+        axis = self._parallel_names.index(axis_name)
+        return [r for r, c in self._rank2coord.items() if c[axis] == index]
+
+    def get_comm_list(self, axis_name):
+        """All rank-lists that form comm groups along `axis_name`."""
+        axis = self._parallel_names.index(axis_name)
+        other_dims = [d for i, d in enumerate(self._dims) if i != axis]
+        comm_list = []
+        for other_coord in itertools.product(*(range(d)
+                                               for d in other_dims)):
+            ranks = []
+            for i in range(self._dims[axis]):
+                coord = list(other_coord)
+                coord.insert(axis, i)
+                ranks.append(self._coord2rank[tuple(coord)])
+            comm_list.append(ranks)
+        return comm_list
+
+
+class HybridCommunicateGroup:
+    """ref: topology.py:117 HybridCommunicateGroup.
+
+    Builds the dp x pp x sharding x mp grid over the *devices visible to
+    jax* (chips, not processes — the TPU-native twist) and exposes a
+    jax Mesh for the engine plus Group handles for API parity.
+    """
+
+    def __init__(self, topology=None, dp_degree=1, mp_degree=1, pp_degree=1,
+                 sharding_degree=1, order=None):
+        ndev = jax.device_count()
+        if topology is not None:
+            self._topo = topology
+            dp_degree = topology.get_dim("data")
+            pp_degree = topology.get_dim("pipe")
+            sharding_degree = topology.get_dim("sharding")
+            mp_degree = topology.get_dim("model")
+        else:
+            self._topo = CommunicateTopology(
+                ("data", "pipe", "sharding", "model"),
+                (dp_degree, pp_degree, sharding_degree, mp_degree))
+        self._dp_degree = dp_degree
+        self._mp_degree = mp_degree
+        self._pp_degree = pp_degree
+        self._sharding_degree = sharding_degree
+
+        total = dp_degree * mp_degree * pp_degree * sharding_degree
+        if total > ndev:
+            raise ValueError(
+                f"hybrid degrees product {total} exceeds visible device "
+                f"count {ndev}")
+        # unused devices stay out of the mesh (mirrors world_size checks)
+        devices = np.array(jax.devices()[:total]).reshape(
+            dp_degree, pp_degree, sharding_degree, mp_degree)
+        self._mesh = Mesh(devices, (DP_AXIS, PP_AXIS, SHARDING_AXIS,
+                                    MP_AXIS))
+
+        self.global_rank = get_rank()
+        coord = self._topo.get_coord(self.global_rank % total)
+        self._dp_rank = coord[0]
+        self._pp_rank = coord[1]
+        self._sharding_rank = coord[2]
+        self._mp_rank = coord[3]
+
+        axis_of = {"data": DP_AXIS, "pipe": PP_AXIS,
+                   "sharding": SHARDING_AXIS, "model": MP_AXIS}
+        self._dp_group = new_group(
+            self._topo.get_comm_list("data")[0], axis_name=DP_AXIS)
+        self._mp_group = new_group(
+            self._topo.get_comm_list("model")[0], axis_name=MP_AXIS)
+        self._pp_group = new_group(
+            self._topo.get_comm_list("pipe")[0], axis_name=PP_AXIS)
+        self._sharding_group = new_group(
+            self._topo.get_comm_list("sharding")[0],
+            axis_name=SHARDING_AXIS)
+        self._axis_of = axis_of
+
+    # -- mesh ----------------------------------------------------------------
+    def get_mesh(self) -> Mesh:
+        return self._mesh
+
+    # -- parallel mode -------------------------------------------------------
+    def _check_vpp(self):
+        return False
+
+    def get_parallel_mode(self):
+        if self._pp_degree > 1:
+            return ParallelMode.PIPELINE_PARALLEL
+        if self._mp_degree > 1:
+            return ParallelMode.TENSOR_PARALLEL
+        if self._sharding_degree > 1:
+            return ParallelMode.SHARDING_PARALLEL
+        return ParallelMode.DATA_PARALLEL
+
+    def topology(self):
+        return self._topo
+
+    def get_global_rank(self):
+        return self.global_rank
+
+    # data parallel
+    def get_data_parallel_rank(self):
+        return self._dp_rank
+
+    def get_data_parallel_world_size(self):
+        return self._dp_degree
+
+    def get_data_parallel_group(self):
+        return self._dp_group
+
+    def get_data_parallel_group_src_rank(self):
+        return self._dp_group.ranks[0]
+
+    # model (tensor) parallel
+    def get_model_parallel_rank(self):
+        return self._mp_rank
+
+    def get_model_parallel_world_size(self):
+        return self._mp_degree
+
+    def get_model_parallel_group(self):
+        return self._mp_group
+
+    def get_model_parallel_group_src_rank(self):
+        return self._mp_group.ranks[0]
+
+    # pipeline parallel
+    def get_stage_id(self):
+        return self._pp_rank
+
+    def get_pipe_parallel_rank(self):
+        return self._pp_rank
+
+    def get_pipe_parallel_world_size(self):
+        return self._pp_degree
+
+    def get_pipe_parallel_group(self):
+        return self._pp_group
+
+    def is_first_stage(self):
+        return self._pp_rank == 0
+
+    def is_last_stage(self):
+        return self._pp_rank == self._pp_degree - 1
+
+    # sharding
+    def get_sharding_parallel_rank(self):
+        return self._sharding_rank
+
+    def get_sharding_parallel_world_size(self):
+        return self._sharding_degree
+
+    def get_sharding_parallel_group(self):
+        return self._sharding_group
+
+    def get_sharding_parallel_group_src_rank(self):
+        return self._sharding_group.ranks[0]
+
+    def get_check_parallel_group(self, *a, **k):
+        return self._dp_group
+
+    def get_rank_from_stage(self, stage_id, **kwargs):
+        return self._topo.get_rank(
+            data=self._dp_rank, pipe=stage_id,
+            sharding=self._sharding_rank, model=self._mp_rank)
+
+
+_hcg = None
+
+
+def set_hybrid_communicate_group(hcg):
+    global _hcg
+    _hcg = hcg
+
+
+def get_hybrid_communicate_group():
+    return _hcg

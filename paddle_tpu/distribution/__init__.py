@@ -1,0 +1,140 @@
+"""Probability distributions (ref: python/paddle/distribution.py —
+Normal/Uniform/Categorical + kl_divergence)."""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import apply
+from ..core.tensor import Tensor
+from ..framework import random as _random
+
+
+def _val(x):
+    if isinstance(x, Tensor):
+        return x._value
+    return jnp.asarray(x, jnp.float32)
+
+
+class Distribution:
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def probs(self, value):
+        return Tensor(jnp.exp(self.log_prob(value)._value))
+
+    def kl_divergence(self, other):
+        raise NotImplementedError
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _val(loc)
+        self.scale = _val(scale)
+
+    @property
+    def mean(self):
+        return Tensor(jnp.broadcast_to(
+            self.loc, jnp.broadcast_shapes(self.loc.shape,
+                                           self.scale.shape)))
+
+    @property
+    def variance(self):
+        return Tensor(jnp.broadcast_to(
+            self.scale ** 2, jnp.broadcast_shapes(self.loc.shape,
+                                                  self.scale.shape)))
+
+    def sample(self, shape=(), seed=0):
+        shape = tuple(shape)
+        bshape = jnp.broadcast_shapes(self.loc.shape, self.scale.shape)
+        key = _random.next_key()
+        eps = jax.random.normal(key, shape + bshape)
+        return Tensor(self.loc + self.scale * eps)
+
+    def rsample(self, shape=()):
+        return self.sample(shape)
+
+    def entropy(self):
+        bshape = jnp.broadcast_shapes(self.loc.shape, self.scale.shape)
+        scale = jnp.broadcast_to(self.scale, bshape)
+        return Tensor(0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(scale))
+
+    def log_prob(self, value):
+        v = _val(value)
+        var = self.scale ** 2
+        return Tensor(-((v - self.loc) ** 2) / (2 * var) -
+                      jnp.log(self.scale) - 0.5 * math.log(2 * math.pi))
+
+    def kl_divergence(self, other):
+        var_ratio = (self.scale / other.scale) ** 2
+        t1 = ((self.loc - other.loc) / other.scale) ** 2
+        return Tensor(0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio)))
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _val(low)
+        self.high = _val(high)
+
+    def sample(self, shape=(), seed=0):
+        shape = tuple(shape)
+        bshape = jnp.broadcast_shapes(self.low.shape, self.high.shape)
+        key = _random.next_key()
+        u = jax.random.uniform(key, shape + bshape)
+        return Tensor(self.low + (self.high - self.low) * u)
+
+    def entropy(self):
+        return Tensor(jnp.log(self.high - self.low))
+
+    def log_prob(self, value):
+        v = _val(value)
+        inside = (v >= self.low) & (v < self.high)
+        lp = -jnp.log(self.high - self.low)
+        return Tensor(jnp.where(inside, lp, -jnp.inf))
+
+
+class Categorical(Distribution):
+    def __init__(self, logits, name=None):
+        self.logits = _val(logits)
+
+    def sample(self, shape=()):
+        key = _random.next_key()
+        return Tensor(jax.random.categorical(
+            key, jnp.log(jax.nn.softmax(self.logits)),
+            shape=tuple(shape) + self.logits.shape[:-1]))
+
+    def entropy(self):
+        p = jax.nn.softmax(self.logits)
+        logp = jax.nn.log_softmax(self.logits)
+        return Tensor(-jnp.sum(p * logp, axis=-1))
+
+    def log_prob(self, value):
+        logp = jax.nn.log_softmax(self.logits)
+        idx = _val(value).astype(jnp.int32)
+        return Tensor(jnp.take_along_axis(
+            logp, idx[..., None], axis=-1).squeeze(-1))
+
+    def probs(self, value):
+        p = jax.nn.softmax(self.logits)
+        idx = _val(value).astype(jnp.int32)
+        return Tensor(jnp.take_along_axis(p, idx[..., None],
+                                          axis=-1).squeeze(-1))
+
+    def kl_divergence(self, other):
+        p = jax.nn.softmax(self.logits)
+        logp = jax.nn.log_softmax(self.logits)
+        logq = jax.nn.log_softmax(other.logits)
+        return Tensor(jnp.sum(p * (logp - logq), axis=-1))
+
+
+def kl_divergence(p, q):
+    return p.kl_divergence(q)

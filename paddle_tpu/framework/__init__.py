@@ -1,0 +1,8 @@
+"""Framework-level utilities: RNG, device management, save/load.
+
+Ref parity: python/paddle/framework/ (random.py, io.py) and
+python/paddle/device.py.
+"""
+
+from . import random  # noqa: F401
+from .random import get_rng_state, seed, set_rng_state  # noqa: F401

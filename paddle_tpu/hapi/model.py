@@ -1,0 +1,241 @@
+"""paddle.Model — the high-level train/eval/predict API.
+
+Ref parity: python/paddle/hapi/model.py:878 (Model), 1523 (fit), with the
+dual Static/DynamicGraphAdapter collapsed: there is one execution path (the
+functional engine compiles the step; eager fallback for debugging).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..engine import Engine
+from ..io import DataLoader
+from .callbacks import CallbackList, ProgBarLogger
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._inputs = inputs
+        self._labels = labels
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+        self._engine = None
+        self.stop_training = False
+        self._compiled_mode = True  # compile steps via the engine
+
+    # -- prepare -------------------------------------------------------------
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None, jit_compile=True):
+        self._optimizer = optimizer
+        self._loss = loss
+        if metrics is not None:
+            self._metrics = metrics if isinstance(metrics, (list, tuple)) \
+                else [metrics]
+        self._compiled_mode = jit_compile
+        return self
+
+    # -- single-batch APIs ---------------------------------------------------
+    def _ensure_engine(self):
+        if self._engine is None:
+            self._engine = Engine(self.network, self._optimizer, self._loss)
+        return self._engine
+
+    def train_batch(self, inputs, labels=None, update=True):
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        labels = labels if labels is None or isinstance(
+            labels, (list, tuple)) else [labels]
+        if self._compiled_mode:
+            eng = self._ensure_engine()
+            loss = eng.train_batch(inputs, labels or ())
+            return [float(loss.item())]
+        # eager path
+        self.network.train()
+        outputs = self.network(*[_as_tensor(x) for x in inputs])
+        loss = self._loss(outputs, *[_as_tensor(l) for l in labels or []])
+        loss.backward()
+        if update:
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+        return [float(loss.item())]
+
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        if self._engine is not None:
+            self._engine.sync_to_layer()
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        outputs = self.network(*[_as_tensor(x) for x in inputs])
+        results = []
+        if self._loss is not None and labels:
+            loss = self._loss(outputs, *[_as_tensor(l) for l in labels])
+            results.append(float(loss.item()))
+        metric_results = []
+        for m in self._metrics:
+            pred = outputs[0] if isinstance(outputs, (list, tuple)) \
+                else outputs
+            corr = m.compute(pred, *[_as_tensor(l) for l in labels or []])
+            m.update(corr)
+            metric_results.append(m.accumulate())
+        self.network.train()
+        return results, metric_results
+
+    def predict_batch(self, inputs):
+        self.network.eval()
+        if self._engine is not None:
+            self._engine.sync_to_layer()
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        out = self.network(*[_as_tensor(x) for x in inputs])
+        self.network.train()
+        return [o.numpy() if isinstance(o, Tensor) else o
+                for o in (out if isinstance(out, (list, tuple)) else [out])]
+
+    # -- fit/evaluate/predict -----------------------------------------------
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1,
+            verbose=2, drop_last=False, shuffle=True, num_workers=0,
+            callbacks=None, accumulate_grad_batches=1, num_iters=None):
+        train_loader = _as_loader(train_data, batch_size, shuffle,
+                                  drop_last, num_workers)
+        eval_loader = _as_loader(eval_data, batch_size, False, False,
+                                 num_workers) if eval_data is not None \
+            else None
+        cbks = CallbackList([ProgBarLogger(log_freq, verbose=verbose)] +
+                            (callbacks or []))
+        cbks.set_model(self)
+        steps = _safe_len(train_loader)
+        cbks.set_params({"epochs": epochs, "steps": steps,
+                         "verbose": verbose})
+        cbks.on_train_begin()
+        self.stop_training = False
+        it = 0
+        for epoch in range(epochs):
+            if self.stop_training:
+                break
+            cbks.on_epoch_begin(epoch)
+            for m in self._metrics:
+                m.reset()
+            logs = {}
+            for step, batch in enumerate(train_loader):
+                cbks.on_train_batch_begin(step)
+                inputs, labels = _split_batch(batch)
+                losses = self.train_batch(inputs, labels)
+                logs = {"loss": losses[0]}
+                cbks.on_train_batch_end(step, logs)
+                it += 1
+                if num_iters is not None and it >= num_iters:
+                    self.stop_training = True
+                    break
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                eval_logs = self.evaluate(
+                    eval_loader, batch_size=batch_size, verbose=0,
+                    num_workers=num_workers)
+                logs.update(eval_logs)
+                cbks.on_eval_end(eval_logs)
+            cbks.on_epoch_end(epoch, logs)
+        cbks.on_train_end(logs if "logs" in dir() else None)
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_samples=None):
+        loader = _as_loader(eval_data, batch_size, False, False, num_workers)
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        for batch in loader:
+            inputs, labels = _split_batch(batch)
+            res, _ = self.eval_batch(inputs, labels)
+            if res:
+                losses.append(res[0])
+        logs = {}
+        if losses:
+            logs["eval_loss"] = float(np.mean(losses))
+        for m in self._metrics:
+            name = m.name()
+            acc = m.accumulate()
+            if isinstance(name, (list, tuple)):
+                for n, a in zip(name, acc):
+                    logs[f"eval_{n}"] = a
+            else:
+                logs[f"eval_{name}"] = acc
+        return logs
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, verbose=1, callbacks=None):
+        loader = _as_loader(test_data, batch_size, False, False, num_workers)
+        outputs = []
+        for batch in loader:
+            inputs, _ = _split_batch(batch)
+            outputs.append(self.predict_batch(inputs))
+        if stack_outputs:
+            n_out = len(outputs[0])
+            return [np.concatenate([o[i] for o in outputs])
+                    for i in range(n_out)]
+        return outputs
+
+    # -- persistence ---------------------------------------------------------
+    def save(self, path, training=True):
+        from ..framework.io import save as _save
+
+        if self._engine is not None:
+            self._engine.sync_to_layer()
+        _save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            _save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        from ..framework.io import load as _load
+
+        sd = _load(path + ".pdparams")
+        self.network.set_state_dict(sd)
+        import os
+
+        if not reset_optimizer and self._optimizer is not None and \
+                os.path.exists(path + ".pdopt"):
+            self._optimizer.set_state_dict(_load(path + ".pdopt"))
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters(*args, **kwargs)
+
+    def summary(self, input_size=None, dtype=None):
+        total = 0
+        trainable = 0
+        lines = ["-" * 60]
+        for name, p in self.network.named_parameters():
+            n = int(np.prod(p.shape)) if p.shape else 1
+            total += n
+            if not p.stop_gradient:
+                trainable += n
+            lines.append(f"{name:<40} {str(p.shape):<18} {n}")
+        lines.append("-" * 60)
+        lines.append(f"Total params: {total}")
+        lines.append(f"Trainable params: {trainable}")
+        print("\n".join(lines))
+        return {"total_params": total, "trainable_params": trainable}
+
+
+def _as_tensor(x):
+    return x if isinstance(x, Tensor) else Tensor(x)
+
+
+def _as_loader(data, batch_size, shuffle, drop_last, num_workers):
+    if data is None or isinstance(data, DataLoader):
+        return data
+    return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                      drop_last=drop_last, num_workers=num_workers)
+
+
+def _split_batch(batch):
+    if isinstance(batch, (list, tuple)):
+        if len(batch) >= 2:
+            return [batch[0]], list(batch[1:])
+        return [batch[0]], []
+    return [batch], []
+
+
+def _safe_len(loader):
+    try:
+        return len(loader)
+    except (RuntimeError, TypeError):
+        return None

@@ -1,0 +1,210 @@
+"""paddle_tpu.jit — compiled capture of eager code.
+
+Ref parity: python/paddle/fluid/dygraph/jit.py (@to_static / declarative,
+jit.save/load, TracedLayer). TPU-native: instead of AST-rewriting Python
+into a ProgramDesc (dygraph_to_static/), the eager code *is* traceable —
+`to_static` runs the same forward under `jax.jit` with parameters passed
+functionally, producing one cached XLA computation per input signature.
+`jit.save` serialises the lowered StableHLO via jax.export plus the
+state_dict; `jit.load` restores an executable TranslatedLayer.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..engine import functional_call, state_values
+from ..nn.layer.layers import Layer
+
+
+class InputSpec:
+    """ref: python/paddle/static/input.py InputSpec."""
+
+    def __init__(self, shape, dtype="float32", name=None):
+        self.shape = list(shape)
+        self.dtype = dtype
+        self.name = name
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype})"
+
+    def to_shape_dtype(self):
+        from ..core.dtype import to_jax_dtype
+
+        shape = [1 if s is None or s < 0 else s for s in self.shape]
+        return jax.ShapeDtypeStruct(tuple(shape), to_jax_dtype(self.dtype))
+
+
+class StaticFunction:
+    """A callable that runs its wrapped eager function as a compiled XLA
+    program (ref: dygraph_to_static/program_translator.py StaticFunction)."""
+
+    def __init__(self, function, input_spec=None, layer=None):
+        self._function = function
+        self._input_spec = input_spec
+        self._layer = layer
+        self._jitted = None
+
+    def _get_layer(self):
+        if self._layer is not None:
+            return self._layer
+        self_obj = getattr(self._function, "__self__", None)
+        if isinstance(self_obj, Layer):
+            return self_obj
+        return None
+
+    def _build(self):
+        layer = self._get_layer()
+
+        if layer is not None:
+            # call the original forward, not layer() — when to_static
+            # replaced layer.forward, going through Layer.__call__ would
+            # recurse into this StaticFunction
+            orig_forward = self._function
+            from ..engine import _swap_state, _unwrap
+
+            def run(values, *arrs):
+                wrapped = [Tensor(a) for a in arrs]
+                with _swap_state(layer, values):
+                    out = orig_forward(*wrapped)
+                return _unwrap(out)
+
+            self._jitted = jax.jit(run)
+        else:
+            fn = self._function
+
+            def run(*arrs):
+                wrapped = [Tensor(a) for a in arrs]
+                out = fn(*wrapped)
+                return jax.tree.map(
+                    lambda t: t._value if isinstance(t, Tensor) else t, out,
+                    is_leaf=lambda t: isinstance(t, Tensor))
+
+            self._jitted = jax.jit(run)
+
+    def __call__(self, *args, **kwargs):
+        if self._jitted is None:
+            self._build()
+        arrs = [a._value if isinstance(a, Tensor) else jnp.asarray(a)
+                for a in args]
+        layer = self._get_layer()
+        if layer is not None:
+            out = self._jitted(state_values(layer), *arrs)
+        else:
+            out = self._jitted(*arrs)
+        return jax.tree.map(Tensor, out)
+
+    @property
+    def code(self):
+        import inspect
+
+        return inspect.getsource(self._function)
+
+    def concrete_program(self, *args):
+        return self._jitted
+
+
+def to_static(function=None, input_spec=None, build_strategy=None,
+              backend=None):
+    """Decorator / wrapper. Accepts a function, bound method, or Layer."""
+
+    def decorate(fn):
+        if isinstance(fn, Layer):
+            layer = fn
+            sf = StaticFunction(layer.forward, input_spec, layer=layer)
+            layer.forward = sf
+            return layer
+        return StaticFunction(fn, input_spec)
+
+    if function is not None:
+        return decorate(function)
+    return decorate
+
+
+declarative = to_static
+
+
+def not_to_static(fn):
+    return fn
+
+
+# ---------------------------------------------------------------------------
+# save / load
+# ---------------------------------------------------------------------------
+
+
+def save(layer, path, input_spec=None, **configs):
+    """Serialise layer -> {path}.pdiparams (state dict) + {path}.pdmodel
+    (jax.export StableHLO bytes, when exportable).
+
+    ref: fluid/dygraph/jit.py:515 jit.save -> save_inference_model.
+    """
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    is_layer = isinstance(layer, Layer)
+    state = {}
+    if is_layer:
+        for k, v in layer.state_dict().items():
+            state[k] = np.asarray(v._value)
+    with open(path + ".pdiparams", "wb") as f:
+        pickle.dump(state, f, protocol=4)
+
+    exported_bytes = None
+    if input_spec is not None and is_layer:
+        try:
+            specs = [s.to_shape_dtype() if isinstance(s, InputSpec) else
+                     jax.ShapeDtypeStruct(tuple(s.shape),
+                                          s._value.dtype)
+                     for s in input_spec]
+            values = state_values(layer)
+
+            def run(values, *arrs):
+                return functional_call(layer, values, *arrs)
+
+            exp = jax.export.export(jax.jit(run))(
+                jax.tree.map(
+                    lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+                    values), *specs)
+            exported_bytes = exp.serialize()
+        except Exception as e:  # noqa: BLE001 — export is best-effort
+            import warnings
+
+            warnings.warn(f"jit.save: StableHLO export failed ({e}); "
+                          "saving params only")
+    if exported_bytes is not None:
+        with open(path + ".pdmodel", "wb") as f:
+            f.write(exported_bytes)
+
+
+class TranslatedLayer(Layer):
+    """Executable deserialised program (ref: fluid/dygraph/io.py
+    TranslatedLayer)."""
+
+    def __init__(self, exported, state):
+        super().__init__()
+        self._exported = exported
+        self._state = state
+
+    def forward(self, *args):
+        arrs = [a._value if isinstance(a, Tensor) else jnp.asarray(a)
+                for a in args]
+        values = {k: jnp.asarray(v) for k, v in self._state.items()}
+        out = self._exported.call(values, *arrs)
+        return jax.tree.map(Tensor, out)
+
+
+def load(path, **configs):
+    with open(path + ".pdiparams", "rb") as f:
+        state = pickle.load(f)
+    model_path = path + ".pdmodel"
+    if os.path.exists(model_path):
+        with open(model_path, "rb") as f:
+            exported = jax.export.deserialize(f.read())
+        return TranslatedLayer(exported, state)
+    return state

@@ -1,0 +1,173 @@
+"""Streaming metrics (ref: python/paddle/metric/metrics.py)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+
+def _np(x):
+    return np.asarray(x._value) if isinstance(x, Tensor) else np.asarray(x)
+
+
+class Metric:
+    def reset(self):
+        raise NotImplementedError
+
+    def update(self, *args):
+        raise NotImplementedError
+
+    def accumulate(self):
+        raise NotImplementedError
+
+    def name(self):
+        raise NotImplementedError
+
+    def compute(self, *args):
+        return args
+
+
+class Accuracy(Metric):
+    def __init__(self, topk=(1,), name=None):
+        self.topk = topk if isinstance(topk, (list, tuple)) else (topk,)
+        self.maxk = max(self.topk)
+        self._name = name or "acc"
+        self.reset()
+
+    def reset(self):
+        self.total = [0.0] * len(self.topk)
+        self.count = [0] * len(self.topk)
+
+    def compute(self, pred, label, *args):
+        pred_np = _np(pred)
+        label_np = _np(label)
+        if label_np.ndim == pred_np.ndim and label_np.shape[-1] == 1:
+            label_np = label_np.squeeze(-1)
+        order = np.argsort(-pred_np, axis=-1)[..., : self.maxk]
+        correct = (order == label_np[..., None])
+        return Tensor(correct.astype(np.float32))
+
+    def update(self, correct, *args):
+        correct_np = _np(correct)
+        accs = []
+        for i, k in enumerate(self.topk):
+            num_correct = correct_np[..., :k].sum()
+            num_samples = int(np.prod(correct_np.shape[:-1]))
+            self.total[i] += num_correct
+            self.count[i] += num_samples
+            accs.append(float(num_correct) / max(num_samples, 1))
+        return accs[0] if len(self.topk) == 1 else accs
+
+    def accumulate(self):
+        res = [t / max(c, 1) for t, c in zip(self.total, self.count)]
+        return res[0] if len(self.topk) == 1 else res
+
+    def name(self):
+        if len(self.topk) == 1:
+            return self._name
+        return [f"{self._name}_top{k}" for k in self.topk]
+
+
+class Precision(Metric):
+    def __init__(self, name="precision"):
+        self._name = name
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fp = 0
+
+    def update(self, preds, labels):
+        preds_np = (_np(preds) > 0.5).astype(np.int32).reshape(-1)
+        labels_np = _np(labels).astype(np.int32).reshape(-1)
+        self.tp += int(np.sum((preds_np == 1) & (labels_np == 1)))
+        self.fp += int(np.sum((preds_np == 1) & (labels_np == 0)))
+
+    def accumulate(self):
+        denom = self.tp + self.fp
+        return self.tp / denom if denom else 0.0
+
+    def name(self):
+        return self._name
+
+
+class Recall(Metric):
+    def __init__(self, name="recall"):
+        self._name = name
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fn = 0
+
+    def update(self, preds, labels):
+        preds_np = (_np(preds) > 0.5).astype(np.int32).reshape(-1)
+        labels_np = _np(labels).astype(np.int32).reshape(-1)
+        self.tp += int(np.sum((preds_np == 1) & (labels_np == 1)))
+        self.fn += int(np.sum((preds_np == 0) & (labels_np == 1)))
+
+    def accumulate(self):
+        denom = self.tp + self.fn
+        return self.tp / denom if denom else 0.0
+
+    def name(self):
+        return self._name
+
+
+class Auc(Metric):
+    """Streaming AUC via threshold bucketing (ref: metrics.py Auc)."""
+
+    def __init__(self, curve="ROC", num_thresholds=4095, name="auc"):
+        self._curve = curve
+        self._num_thresholds = num_thresholds
+        self._name = name
+        self.reset()
+
+    def reset(self):
+        n = self._num_thresholds + 1
+        self._stat_pos = np.zeros(n, dtype=np.int64)
+        self._stat_neg = np.zeros(n, dtype=np.int64)
+
+    def update(self, preds, labels):
+        preds_np = _np(preds)
+        labels_np = _np(labels).reshape(-1)
+        if preds_np.ndim == 2:
+            pos_prob = preds_np[:, 1]
+        else:
+            pos_prob = preds_np.reshape(-1)
+        bins = (pos_prob * self._num_thresholds).astype(np.int64)
+        bins = np.clip(bins, 0, self._num_thresholds)
+        for b, l in zip(bins, labels_np):
+            if l:
+                self._stat_pos[b] += 1
+            else:
+                self._stat_neg[b] += 1
+
+    def accumulate(self):
+        tot_pos = 0.0
+        tot_neg = 0.0
+        auc = 0.0
+        for i in range(self._num_thresholds, -1, -1):
+            p = self._stat_pos[i]
+            n = self._stat_neg[i]
+            auc += n * tot_pos + p * n / 2.0
+            tot_pos += p
+            tot_neg += n
+        if tot_pos == 0 or tot_neg == 0:
+            return 0.0
+        return auc / tot_pos / tot_neg
+
+    def name(self):
+        return self._name
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    """Functional accuracy (ref: python/paddle/metric/metrics.py:789)."""
+    pred_np = _np(input)
+    label_np = _np(label)
+    if label_np.ndim == pred_np.ndim and label_np.shape[-1] == 1:
+        label_np = label_np.squeeze(-1)
+    order = np.argsort(-pred_np, axis=-1)[..., :k]
+    correct_np = (order == label_np[..., None]).any(axis=-1)
+    return Tensor(np.asarray(correct_np.mean(), dtype=np.float32))

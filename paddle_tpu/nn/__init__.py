@@ -1,0 +1,37 @@
+"""paddle_tpu.nn (ref: python/paddle/nn/__init__.py)."""
+
+from . import functional  # noqa: F401
+from . import initializer  # noqa: F401
+from .layer.layers import Layer  # noqa: F401
+from .layer.container import (  # noqa: F401
+    LayerDict, LayerList, ParameterList, Sequential,
+)
+from .layer.common import (  # noqa: F401
+    Bilinear, CosineSimilarity, Dropout, Dropout2D, Embedding, Flatten,
+    Identity, Linear, Pad1D, Pad2D, Pad3D, PixelShuffle, Unfold, Upsample,
+    UpsamplingBilinear2D, UpsamplingNearest2D,
+)
+from .layer.conv import Conv1D, Conv2D, Conv2DTranspose, Conv3D  # noqa: F401
+from .layer.norm import (  # noqa: F401
+    BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D, GroupNorm,
+    InstanceNorm1D, InstanceNorm2D, InstanceNorm3D, LayerNorm,
+    LocalResponseNorm, RMSNorm, SyncBatchNorm,
+)
+from .layer.pooling import (  # noqa: F401
+    AdaptiveAvgPool2D, AdaptiveMaxPool2D, AvgPool1D, AvgPool2D, MaxPool1D,
+    MaxPool2D,
+)
+from .layer.activation import (  # noqa: F401
+    CELU, ELU, GELU, Hardshrink, Hardsigmoid, Hardswish, Hardtanh, LeakyReLU,
+    LogSigmoid, LogSoftmax, Mish, PReLU, ReLU, ReLU6, SELU, Sigmoid, Silu,
+    Softmax, Softplus, Softshrink, Softsign, Swish, Tanh, Tanhshrink,
+)
+from .layer.loss import (  # noqa: F401
+    BCELoss, BCEWithLogitsLoss, CrossEntropyLoss, KLDivLoss, L1Loss,
+    MarginRankingLoss, MSELoss, NLLLoss, SmoothL1Loss,
+)
+from .layer.transformer import (  # noqa: F401
+    MultiHeadAttention, Transformer, TransformerDecoder,
+    TransformerDecoderLayer, TransformerEncoder, TransformerEncoderLayer,
+)
+from ..clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue  # noqa: F401

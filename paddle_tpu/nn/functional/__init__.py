@@ -1,0 +1,523 @@
+"""nn.functional (ref: python/paddle/nn/functional/)."""
+
+from __future__ import annotations
+
+from ...core.dispatch import apply
+from ...core.tensor import Tensor
+from ...framework import random as _random
+
+# -- activations ------------------------------------------------------------
+
+
+def relu(x, name=None):
+    return apply("relu", x)
+
+
+def relu6(x, name=None):
+    return apply("relu6", x)
+
+
+def gelu(x, approximate=False, name=None):
+    return apply("gelu", x, approximate=approximate)
+
+
+def sigmoid(x, name=None):
+    return apply("sigmoid", x)
+
+
+def log_sigmoid(x, name=None):
+    return apply("logsigmoid", x)
+
+
+def tanh(x, name=None):
+    return apply("tanh", x)
+
+
+def silu(x, name=None):
+    return apply("silu", x)
+
+
+def swish(x, name=None):
+    return apply("swish", x)
+
+
+def mish(x, name=None):
+    return apply("mish", x)
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return apply("leaky_relu", x, negative_slope=negative_slope)
+
+
+def elu(x, alpha=1.0, name=None):
+    return apply("elu", x, alpha=alpha)
+
+
+def celu(x, alpha=1.0, name=None):
+    return apply("celu", x, alpha=alpha)
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return apply("selu", x, scale=scale, alpha=alpha)
+
+
+def prelu(x, weight, name=None):
+    return apply("prelu", x, weight)
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return apply("hardtanh", x, min=min, max=max)
+
+
+def hardsigmoid(x, slope=1.0 / 6.0, offset=0.5, name=None):
+    return apply("hardsigmoid", x, slope=slope, offset=offset)
+
+
+def hardswish(x, name=None):
+    return apply("hardswish", x)
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return apply("hardshrink", x, threshold=threshold)
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return apply("softshrink", x, threshold=threshold)
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return apply("softplus", x, beta=beta, threshold=threshold)
+
+
+def softsign(x, name=None):
+    return apply("softsign", x)
+
+
+def tanhshrink(x, name=None):
+    return apply("tanh_shrink", x)
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    out = apply("softmax", x, axis=axis)
+    return out.astype(dtype) if dtype is not None else out
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    out = apply("log_softmax", x, axis=axis)
+    return out.astype(dtype) if dtype is not None else out
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    import jax
+    import jax.numpy as jnp
+
+    u = jax.random.uniform(_random.next_key(), tuple(x.shape))
+    g = Tensor(-jnp.log(-jnp.log(jnp.maximum(1e-20, u))))
+    y = softmax((x + g) / temperature, axis=axis)
+    if hard:
+        # straight-through: one_hot(argmax) + y - stop_grad(y)
+        idx = apply("arg_max", y, axis=axis, keepdim=False)
+        oh = apply("one_hot", idx, num_classes=y.shape[axis])
+        if axis not in (-1, y.ndim - 1):
+            oh = oh.moveaxis(-1, axis)
+        return oh + y - y.detach()
+    return y
+
+
+# -- linear / conv ----------------------------------------------------------
+
+
+def linear(x, weight, bias=None, name=None):
+    out = apply("matmul_v2", x, weight)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    out = apply("conv2d", x, weight, stride=stride, padding=padding,
+                dilation=dilation, groups=groups, data_format=data_format)
+    if bias is not None:
+        shape = [1, -1, 1, 1] if data_format == "NCHW" else [1, 1, 1, -1]
+        out = out + bias.reshape(shape)
+    return out
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    out = apply("conv1d", x, weight, stride=stride, padding=padding,
+                dilation=dilation, groups=groups, data_format=data_format)
+    if bias is not None:
+        out = out + bias.reshape([1, -1, 1])
+    return out
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    out = apply("conv3d", x, weight, stride=stride, padding=padding,
+                dilation=dilation, groups=groups, data_format=data_format)
+    if bias is not None:
+        out = out + bias.reshape([1, -1, 1, 1, 1])
+    return out
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1,
+                     data_format="NCHW", name=None):
+    out = apply("conv2d_transpose", x, weight, stride=stride, padding=padding,
+                output_padding=output_padding, dilation=dilation,
+                groups=groups, data_format=data_format)
+    if bias is not None:
+        shape = [1, -1, 1, 1] if data_format == "NCHW" else [1, 1, 1, -1]
+        out = out + bias.reshape(shape)
+    return out
+
+
+# -- pooling ----------------------------------------------------------------
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, data_format="NCHW", name=None):
+    out = apply("pool2d", x, ksize=kernel_size, stride=stride,
+                padding=padding, ceil_mode=ceil_mode, pooling_type="max",
+                data_format=data_format)
+    if return_mask:
+        return out, None
+    return out
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW",
+               name=None):
+    return apply("pool2d", x, ksize=kernel_size, stride=stride,
+                 padding=padding, ceil_mode=ceil_mode, pooling_type="avg",
+                 exclusive=exclusive, data_format=data_format)
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return apply("pool2d", x, ksize=output_size, adaptive=True,
+                 pooling_type="avg", data_format=data_format)
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    out = apply("pool2d", x, ksize=output_size, adaptive=True,
+                pooling_type="max")
+    return (out, None) if return_mask else out
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, name=None):
+    x4 = x.unsqueeze(2)
+    k = kernel_size if isinstance(kernel_size, int) else kernel_size[0]
+    s = stride if stride is None or isinstance(stride, int) else stride[0]
+    p = padding if isinstance(padding, int) else padding[0]
+    out = apply("pool2d", x4, ksize=(1, k),
+                stride=(1, s if s is not None else k), padding=(0, p),
+                ceil_mode=ceil_mode, pooling_type="max")
+    return out.squeeze(2)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, name=None):
+    x4 = x.unsqueeze(2)
+    k = kernel_size if isinstance(kernel_size, int) else kernel_size[0]
+    s = stride if stride is None or isinstance(stride, int) else stride[0]
+    p = padding if isinstance(padding, int) else padding[0]
+    out = apply("pool2d", x4, ksize=(1, k),
+                stride=(1, s if s is not None else k), padding=(0, p),
+                ceil_mode=ceil_mode, pooling_type="avg", exclusive=exclusive)
+    return out.squeeze(2)
+
+
+# -- normalisation ----------------------------------------------------------
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5,
+               name=None):
+    if isinstance(normalized_shape, int):
+        normalized_shape = [normalized_shape]
+    begin = x.ndim - len(normalized_shape)
+    return apply("layer_norm", x, weight, bias, epsilon=epsilon,
+                 begin_norm_axis=begin) if weight is not None else \
+        apply("layer_norm", x, epsilon=epsilon, begin_norm_axis=begin)
+
+
+def batch_norm(x, running_mean, running_var, weight, bias, training=False,
+               momentum=0.9, epsilon=1e-5, data_format="NCHW",
+               use_global_stats=None, name=None):
+    if use_global_stats is None:
+        use_global_stats = not training
+    y, new_mean, new_var = apply(
+        "batch_norm", x, weight, bias, running_mean, running_var,
+        momentum=momentum, epsilon=epsilon, is_test=not training,
+        data_format=data_format, use_global_stats=use_global_stats and
+        not training)
+    if training and not use_global_stats:
+        running_mean.set_value(new_mean)
+        running_var.set_value(new_var)
+    return y
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None,
+                  bias=None, use_input_stats=True, momentum=0.9, eps=1e-5,
+                  data_format="NCHW", name=None):
+    if weight is not None:
+        return apply("instance_norm", x, weight, bias, epsilon=eps)
+    return apply("instance_norm", x, epsilon=eps)
+
+
+def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None,
+               data_format="NCHW", name=None):
+    if weight is not None:
+        return apply("group_norm", x, weight, bias, epsilon=epsilon,
+                     groups=num_groups, data_format=data_format)
+    return apply("group_norm", x, epsilon=epsilon, groups=num_groups,
+                 data_format=data_format)
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    import jax.numpy as jnp
+
+    if p == 2:
+        return apply("l2_normalize", x, axis=axis, epsilon=epsilon)
+    norm = apply("p_norm", x, porder=float(p), axis=axis, keepdim=True)
+    return x / norm.clip(min=epsilon)
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0, name=None):
+    return apply("local_response_norm", x, size=size, alpha=alpha,
+                 beta=beta, k=k)
+
+
+# -- dropout ----------------------------------------------------------------
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
+            name=None):
+    if not training or p == 0.0:
+        if mode == "downscale_in_infer" and not training:
+            return x * (1.0 - p)
+        return x
+    key = Tensor(_random.next_key())
+    return apply("dropout", x, key, p=float(p), training=training, mode=mode)
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    if not training or p == 0.0:
+        return x
+    import jax
+
+    key = _random.next_key()
+    shape = (x.shape[0], x.shape[1], 1, 1) if data_format == "NCHW" else \
+        (x.shape[0], 1, 1, x.shape[3])
+    mask = jax.random.bernoulli(key, 1.0 - p, shape)
+    return x * Tensor(mask.astype(x._value.dtype)) / (1.0 - p)
+
+
+# -- embedding --------------------------------------------------------------
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    return apply("lookup_table_v2", x, weight,
+                 padding_idx=-1 if padding_idx is None else padding_idx)
+
+
+def one_hot(x, num_classes, name=None):
+    return apply("one_hot", x, num_classes=num_classes)
+
+
+# -- losses -----------------------------------------------------------------
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100,
+                  reduction="mean", soft_label=False, axis=-1,
+                  use_softmax=True, name=None):
+    if weight is not None:
+        return apply("cross_entropy", input, label, weight._value,
+                     soft_label=soft_label, axis=axis,
+                     ignore_index=ignore_index, reduction=reduction,
+                     use_softmax=use_softmax)
+    return apply("cross_entropy", input, label, soft_label=soft_label,
+                 axis=axis, ignore_index=ignore_index, reduction=reduction,
+                 use_softmax=use_softmax)
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, numeric_stable_mode=True,
+                               return_softmax=False, axis=-1):
+    loss, sm = apply("softmax_with_cross_entropy", logits, label,
+                     soft_label=soft_label, axis=axis,
+                     ignore_index=ignore_index)
+    if return_softmax:
+        return loss, sm
+    return loss
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean",
+                         name=None):
+    loss = apply("bce_loss", input, label)
+    if weight is not None:
+        loss = loss * weight
+    if reduction == "none":
+        return loss
+    return loss.sum() if reduction == "sum" else loss.mean()
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None,
+                                     reduction="mean", pos_weight=None,
+                                     name=None):
+    loss = apply("sigmoid_cross_entropy_with_logits", logit, label)
+    if pos_weight is not None:
+        log_weight = (pos_weight - 1.0) * label + 1.0
+        loss = loss * log_weight
+    if weight is not None:
+        loss = loss * weight
+    if reduction == "none":
+        return loss
+    return loss.sum() if reduction == "sum" else loss.mean()
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    return apply("mse_loss", input, label, reduction=reduction)
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return apply("l1_loss", input, label, reduction=reduction)
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    return apply("smooth_l1_loss", input, label, delta=delta,
+                 reduction=reduction)
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean",
+             name=None):
+    if weight is not None:
+        return apply("nll_loss", input, label, weight,
+                     reduction=reduction, ignore_index=ignore_index)
+    return apply("nll_loss", input, label, reduction=reduction,
+                 ignore_index=ignore_index)
+
+
+def kl_div(input, label, reduction="mean", name=None):
+    return apply("kldiv_loss", input, label, reduction=reduction)
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean",
+                        name=None):
+    return apply("margin_ranking_loss", input, other, label, margin=margin,
+                 reduction=reduction)
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    p = sigmoid(logit)
+    ce = apply("sigmoid_cross_entropy_with_logits", logit, label)
+    p_t = p * label + (1 - p) * (1 - label)
+    alpha_t = alpha * label + (1 - alpha) * (1 - label)
+    loss = alpha_t * ((1 - p_t) ** gamma) * ce
+    if normalizer is not None:
+        loss = loss / normalizer
+    if reduction == "none":
+        return loss
+    return loss.sum() if reduction == "sum" else loss.mean()
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    return apply("cosine_similarity", x1, x2, axis=axis, eps=eps)
+
+
+# -- shape / misc -----------------------------------------------------------
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    if isinstance(pad, (list, tuple)) and len(pad) == 2 * x.ndim:
+        paddings = pad
+    else:
+        # paddle convention: pad is [left, right, top, bottom, ...] for the
+        # trailing spatial dims, in data_format order
+        spatial = len(pad) // 2
+        paddings = [0, 0] * (x.ndim - spatial)
+        if data_format.startswith("NC"):
+            for i in range(spatial):
+                paddings += [pad[2 * i], pad[2 * i + 1]]
+        else:
+            paddings = [0, 0]
+            for i in range(spatial):
+                paddings += [pad[2 * i], pad[2 * i + 1]]
+            paddings += [0, 0]
+    return apply("pad", x, paddings=list(map(int, paddings)), mode=mode,
+                 value=value, data_format=data_format)
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format="NCHW",
+                name=None):
+    if size is not None and not isinstance(size, (list, tuple)):
+        size = [size, size]
+    return apply("interpolate", x, size=size, scale_factor=scale_factor,
+                 mode=mode, align_corners=align_corners,
+                 data_format=data_format)
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest",
+             align_corners=False, align_mode=0, data_format="NCHW",
+             name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners,
+                       align_mode, data_format, name)
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    return apply("pixel_shuffle", x, upscale_factor=upscale_factor,
+                 data_format=data_format)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    return apply("unfold", x, kernel_sizes=kernel_sizes, strides=strides,
+                 paddings=paddings, dilations=dilations)
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, name=None,
+                   data_format="NCHW"):
+    return apply("temporal_shift", x, seg_num=seg_num,
+                 shift_ratio=shift_ratio)
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False,
+                                 training=True, name=None):
+    """query/key/value: [batch, seq, heads, head_dim] (paddle layout)."""
+    q = query.transpose([0, 2, 1, 3])
+    k = key.transpose([0, 2, 1, 3])
+    v = value.transpose([0, 2, 1, 3])
+    if attn_mask is not None:
+        out = apply("scaled_dot_product_attention", q, k, v, attn_mask,
+                    dropout_p=dropout_p, is_causal=is_causal)
+    else:
+        out = apply("flash_attention", q, k, v, is_causal=is_causal) \
+            if _has_flash() else apply(
+                "scaled_dot_product_attention", q, k, v,
+                dropout_p=dropout_p, is_causal=is_causal)
+    return out.transpose([0, 2, 1, 3])
+
+
+def _has_flash():
+    from ...core.op_registry import has_op
+
+    return has_op("flash_attention")
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    k = label.shape[-1]
+    smoothed = (1.0 - epsilon) * label + epsilon / k
+    return smoothed
+
+
+def diag_embed(input, offset=0, dim1=-2, dim2=-1):
+    return apply("diag_embed", input, offset=offset, dim1=dim1, dim2=dim2)
+
+
+def glu(x, axis=-1, name=None):
+    a, b = x.chunk(2, axis=axis)
+    return a * sigmoid(b)

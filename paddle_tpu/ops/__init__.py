@@ -1,0 +1,15 @@
+"""Registered op implementations (pure jax functions).
+
+Ref parity: paddle/fluid/operators/ (~520 registered ops). On TPU each op is
+an XLA-traceable function; XLA performs the fusion/layout/kernel-selection
+work the reference does with hand-written CUDA kernels and IR passes.
+Importing this package registers all ops into the registry.
+"""
+
+from . import math_ops  # noqa: F401
+from . import reduce_ops  # noqa: F401
+from . import manipulation_ops  # noqa: F401
+from . import nn_ops  # noqa: F401
+from . import search_ops  # noqa: F401
+from . import random_ops  # noqa: F401
+from . import linalg_ops  # noqa: F401

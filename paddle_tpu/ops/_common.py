@@ -1,0 +1,28 @@
+"""Shared helpers for op implementations."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def align_for_axis_broadcast(x, y, axis=-1):
+    """Paddle legacy elementwise `axis` attr: broadcast y starting at `axis`
+    of x (ref: paddle/fluid/operators/elementwise/elementwise_op.h)."""
+    x = jnp.asarray(x)
+    y = jnp.asarray(y)
+    if axis == -1 or y.ndim == 0 or x.ndim == y.ndim:
+        return x, y
+    if y.ndim > x.ndim:
+        return x, y
+    shape = [1] * axis + list(y.shape)
+    shape += [1] * (x.ndim - len(shape))
+    return x, y.reshape(shape)
+
+
+def normalize_axis(axis, ndim):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(normalize_axis(a, ndim) for a in axis)
+    axis = int(axis)
+    return axis + ndim if axis < 0 else axis

@@ -1,0 +1,131 @@
+"""Linear-algebra ops (ref: paddle/fluid/operators/ cholesky_op, svd_op,
+matrix_power_op, norm ops, inverse_op, p_norm_op)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.op_registry import register_op
+
+
+@register_op("p_norm")
+def p_norm(x, *, porder=2.0, axis=None, keepdim=False, epsilon=1e-12):
+    if porder == float("inf"):
+        return jnp.max(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if porder == float("-inf"):
+        return jnp.min(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if porder == 0:
+        return jnp.sum((x != 0).astype(x.dtype), axis=axis, keepdims=keepdim)
+    s = jnp.sum(jnp.power(jnp.abs(x), porder), axis=axis, keepdims=keepdim)
+    return jnp.power(s, 1.0 / porder)
+
+
+@register_op("frobenius_norm")
+def frobenius_norm(x, *, axis=None, keepdim=False):
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return jnp.sqrt(jnp.sum(jnp.square(x), axis=ax, keepdims=keepdim))
+
+
+@register_op("inverse")
+def inverse(x):
+    return jnp.linalg.inv(x)
+
+
+@register_op("cholesky")
+def cholesky(x, *, upper=False):
+    l = jnp.linalg.cholesky(x)
+    return jnp.swapaxes(l, -1, -2) if upper else l
+
+
+@register_op("matrix_power")
+def matrix_power(x, *, n):
+    return jnp.linalg.matrix_power(x, n)
+
+
+@register_op("matrix_rank", no_grad=True)
+def matrix_rank(x, *, tol=None, hermitian=False):
+    return jnp.linalg.matrix_rank(x, rtol=tol)
+
+
+@register_op("svd", multi_out=True)
+def svd(x, *, full_matrices=False):
+    u, s, vh = jnp.linalg.svd(x, full_matrices=full_matrices)
+    return u, s, jnp.swapaxes(vh, -1, -2)
+
+
+@register_op("qr", multi_out=True)
+def qr(x, *, mode="reduced"):
+    q, r = jnp.linalg.qr(x, mode=mode)
+    return q, r
+
+
+@register_op("eigh", multi_out=True)
+def eigh(x, *, UPLO="L"):
+    w, v = jnp.linalg.eigh(x, UPLO=UPLO)
+    return w, v
+
+
+@register_op("eigvalsh")
+def eigvalsh(x, *, UPLO="L"):
+    return jnp.linalg.eigvalsh(x, UPLO=UPLO)
+
+
+@register_op("det")
+def det(x):
+    return jnp.linalg.det(x)
+
+
+@register_op("slogdet", multi_out=True)
+def slogdet(x):
+    sign, logabs = jnp.linalg.slogdet(x)
+    return sign, logabs
+
+
+@register_op("pinv")
+def pinv(x, *, rcond=1e-15, hermitian=False):
+    return jnp.linalg.pinv(x, rtol=rcond, hermitian=hermitian)
+
+
+@register_op("solve")
+def solve(a, b):
+    return jnp.linalg.solve(a, b)
+
+
+@register_op("triangular_solve")
+def triangular_solve(a, b, *, upper=True, transpose=False, unitriangular=False):
+    import jax.scipy.linalg as jsl
+
+    return jsl.solve_triangular(a, b, lower=not upper, trans=1 if transpose
+                                else 0, unit_diagonal=unitriangular)
+
+
+@register_op("lstsq", multi_out=True)
+def lstsq(a, b, *, rcond=None):
+    sol, res, rank, sv = jnp.linalg.lstsq(a, b, rcond=rcond)
+    return sol, res
+
+
+@register_op("tensordot")
+def tensordot(a, b, *, axes):
+    return jnp.tensordot(a, b, axes=axes)
+
+
+@register_op("matrix_nms", no_grad=True)
+def matrix_nms(*args, **kwargs):
+    raise NotImplementedError("matrix_nms pending detection-op milestone")
+
+
+@register_op("histogram", no_grad=True)
+def histogram(x, *, bins=100, min=0, max=0):
+    if min == 0 and max == 0:
+        lo, hi = jnp.min(x), jnp.max(x)
+    else:
+        lo, hi = min, max
+    hist, _ = jnp.histogram(x, bins=bins, range=(lo, hi))
+    return hist
+
+
+@register_op("bincount", no_grad=True)
+def bincount(x, *, weights=None, minlength=0):
+    return jnp.bincount(jnp.asarray(x).reshape(-1), weights=weights,
+                        minlength=minlength)

@@ -1,0 +1,312 @@
+"""Shape/layout manipulation ops.
+
+Ref parity: paddle/fluid/operators/ reshape_op.cc, transpose_op.cc,
+concat_op.cc, split_op.cc, gather_op.cc, scatter_op.cc, pad_op, tile_op,
+expand_v2_op, flip, roll, cast_op. All static-shape (XLA requirement);
+LoD-style dynamic shapes are expressed with padding + masks instead.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.op_registry import register_op
+
+
+@register_op("cast")
+def cast(x, *, dtype):
+    from ..core.dtype import to_jax_dtype
+
+    return jnp.asarray(x).astype(to_jax_dtype(dtype))
+
+
+@register_op("assign")
+def assign(x):
+    return jnp.asarray(x)
+
+
+@register_op("getitem")
+def getitem(x, *, idx):
+    return x[idx]
+
+
+@register_op("reshape")
+def reshape(x, *, shape):
+    shape = [int(s) for s in shape]
+    return jnp.reshape(x, shape)
+
+
+@register_op("transpose")
+def transpose(x, *, perm):
+    return jnp.transpose(x, perm)
+
+
+@register_op("concat")
+def concat(*xs, axis=0):
+    return jnp.concatenate(xs, axis=int(axis))
+
+
+@register_op("stack")
+def stack(*xs, axis=0):
+    return jnp.stack(xs, axis=int(axis))
+
+
+@register_op("split", multi_out=True)
+def split(x, *, num_or_sections, axis=0):
+    axis = int(axis)
+    if isinstance(num_or_sections, int):
+        return tuple(jnp.split(x, num_or_sections, axis=axis))
+    # sections list; -1 means "the rest"
+    sections = list(num_or_sections)
+    total = x.shape[axis]
+    if -1 in sections:
+        known = sum(s for s in sections if s != -1)
+        sections[sections.index(-1)] = total - known
+    offsets = []
+    acc = 0
+    for s in sections[:-1]:
+        acc += s
+        offsets.append(acc)
+    return tuple(jnp.split(x, offsets, axis=axis))
+
+
+@register_op("unstack", multi_out=True)
+def unstack(x, *, axis=0, num=None):
+    n = num if num is not None else x.shape[axis]
+    parts = jnp.split(x, n, axis=axis)
+    return tuple(jnp.squeeze(p, axis=axis) for p in parts)
+
+
+@register_op("squeeze")
+def squeeze(x, *, axis=None):
+    if axis is None:
+        return jnp.squeeze(x)
+    if isinstance(axis, (list, tuple)):
+        axes = tuple(a for a in axis if x.shape[a] == 1)
+        return jnp.squeeze(x, axis=axes) if axes else x
+    if x.shape[axis] != 1:
+        return x
+    return jnp.squeeze(x, axis=axis)
+
+
+@register_op("unsqueeze")
+def unsqueeze(x, *, axis):
+    if isinstance(axis, (list, tuple)):
+        out = x
+        for a in sorted(axis):
+            out = jnp.expand_dims(out, a)
+        return out
+    return jnp.expand_dims(x, axis)
+
+
+@register_op("flatten")
+def flatten(x, *, start_axis=0, stop_axis=-1):
+    nd = x.ndim
+    if nd == 0:
+        return x.reshape(1)
+    start = start_axis + nd if start_axis < 0 else start_axis
+    stop = stop_axis + nd if stop_axis < 0 else stop_axis
+    shape = list(x.shape[:start]) + [-1] + list(x.shape[stop + 1:])
+    return x.reshape(shape)
+
+
+@register_op("expand_v2")
+def expand_v2(x, *, shape):
+    shape = list(shape)
+    # paddle: -1 keeps original dim size
+    x_shape = [1] * (len(shape) - x.ndim) + list(x.shape)
+    out_shape = [xs if s == -1 else int(s) for s, xs in zip(shape, x_shape)]
+    return jnp.broadcast_to(x.reshape(x_shape), out_shape)
+
+
+@register_op("tile")
+def tile(x, *, repeat_times):
+    return jnp.tile(x, tuple(int(r) for r in repeat_times))
+
+
+@register_op("broadcast_to")
+def broadcast_to(x, *, shape):
+    return jnp.broadcast_to(x, tuple(int(s) for s in shape))
+
+
+@register_op("gather")
+def gather(x, index, *, axis=0):
+    index = jnp.asarray(index)
+    if index.ndim == 0:
+        index = index[None]
+    return jnp.take(x, index, axis=int(axis))
+
+
+@register_op("gather_nd")
+def gather_nd(x, index):
+    index = jnp.asarray(index)
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x[idx]
+
+
+@register_op("index_select")
+def index_select(x, index, *, axis=0):
+    return jnp.take(x, jnp.asarray(index).reshape(-1), axis=int(axis))
+
+
+@register_op("index_sample")
+def index_sample(x, index):
+    return jnp.take_along_axis(x, jnp.asarray(index), axis=1)
+
+
+@register_op("take_along_axis")
+def take_along_axis(x, index, *, axis):
+    return jnp.take_along_axis(x, jnp.asarray(index), axis=int(axis))
+
+
+@register_op("put_along_axis")
+def put_along_axis(x, index, value, *, axis, reduce="assign"):
+    index = jnp.asarray(index)
+    value = jnp.broadcast_to(jnp.asarray(value), index.shape).astype(x.dtype)
+    dims = [
+        index if d == axis else jnp.arange(index.shape[d]).reshape(
+            [-1 if i == d else 1 for i in range(index.ndim)])
+        for d in range(x.ndim)
+    ]
+    at = x.at[tuple(dims)]
+    if reduce == "assign":
+        return at.set(value)
+    if reduce == "add":
+        return at.add(value)
+    if reduce == "multiply" or reduce == "mul":
+        return at.multiply(value)
+    raise ValueError(f"unsupported reduce mode {reduce!r}")
+
+
+@register_op("scatter")
+def scatter(x, index, updates, *, overwrite=True):
+    index = jnp.asarray(index).reshape(-1)
+    if overwrite:
+        return x.at[index].set(updates)
+    # paddle: overwrite=False means accumulate, zeroing the rows first
+    zeroed = x.at[index].set(jnp.zeros_like(updates))
+    return zeroed.at[index].add(updates)
+
+
+@register_op("scatter_nd_add")
+def scatter_nd_add(x, index, updates):
+    index = jnp.asarray(index)
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x.at[idx].add(updates)
+
+
+@register_op("pad")
+def pad(x, *, paddings, mode="constant", value=0.0, data_format="NCHW"):
+    if isinstance(paddings, (list, tuple)) and len(paddings) == 2 * x.ndim:
+        pads = [(int(paddings[2 * i]), int(paddings[2 * i + 1]))
+                for i in range(x.ndim)]
+    else:
+        pads = [tuple(p) for p in paddings]
+    jmode = {"constant": "constant", "reflect": "reflect",
+             "replicate": "edge", "circular": "wrap"}[mode]
+    if jmode == "constant":
+        return jnp.pad(x, pads, mode=jmode, constant_values=value)
+    return jnp.pad(x, pads, mode=jmode)
+
+
+@register_op("roll")
+def roll(x, *, shifts, axis=None):
+    return jnp.roll(x, shifts, axis=axis)
+
+
+@register_op("flip")
+def flip(x, *, axis):
+    return jnp.flip(x, axis=axis)
+
+
+@register_op("rot90")
+def rot90(x, *, k=1, axes=(0, 1)):
+    return jnp.rot90(x, k=k, axes=tuple(axes))
+
+
+@register_op("tril")
+def tril(x, *, diagonal=0):
+    return jnp.tril(x, k=diagonal)
+
+
+@register_op("triu")
+def triu(x, *, diagonal=0):
+    return jnp.triu(x, k=diagonal)
+
+
+@register_op("where")
+def where(cond, x, y):
+    return jnp.where(cond, x, y)
+
+
+@register_op("full_like")
+def full_like(x, *, fill_value, dtype=None):
+    from ..core.dtype import to_jax_dtype
+
+    dt = to_jax_dtype(dtype) if dtype is not None else None
+    return jnp.full_like(x, fill_value, dtype=dt)
+
+
+@register_op("strided_slice")
+def strided_slice(x, *, axes, starts, ends, strides):
+    idx = [slice(None)] * x.ndim
+    for ax, st, en, sd in zip(axes, starts, ends, strides):
+        idx[ax] = slice(int(st), int(en), int(sd))
+    return x[tuple(idx)]
+
+
+@register_op("slice_op")
+def slice_op(x, *, axes, starts, ends):
+    idx = [slice(None)] * x.ndim
+    for ax, st, en in zip(axes, starts, ends):
+        idx[ax] = slice(int(st), int(en))
+    return x[tuple(idx)]
+
+
+@register_op("repeat_interleave")
+def repeat_interleave(x, *, repeats, axis=None):
+    return jnp.repeat(x, repeats, axis=axis)
+
+
+@register_op("moveaxis")
+def moveaxis(x, *, source, destination):
+    return jnp.moveaxis(x, source, destination)
+
+
+@register_op("swapaxes")
+def swapaxes(x, *, axis0, axis1):
+    return jnp.swapaxes(x, axis0, axis1)
+
+
+@register_op("as_real")
+def as_real(x):
+    return jnp.stack([jnp.real(x), jnp.imag(x)], axis=-1)
+
+
+@register_op("as_complex")
+def as_complex(x):
+    return jax.lax.complex(x[..., 0], x[..., 1])
+
+
+@register_op("diag_embed")
+def diag_embed(x, *, offset=0, dim1=-2, dim2=-1):
+    out = jnp.zeros(x.shape + (x.shape[-1] + abs(offset),), x.dtype)
+    idx = jnp.arange(x.shape[-1])
+    rows = idx + max(-offset, 0)
+    cols = idx + max(offset, 0)
+    out = out[..., : x.shape[-1] + abs(offset), :]
+    out = out.at[..., rows, cols].set(x)
+    if dim1 != -2 or dim2 != -1:
+        out = jnp.moveaxis(out, (-2, -1), (dim1, dim2))
+    return out
+
+
+@register_op("meshgrid", multi_out=True)
+def meshgrid(*xs):
+    return tuple(jnp.meshgrid(*xs, indexing="ij"))
+
+
+@register_op("one_hot", no_grad=True)
+def one_hot(x, *, num_classes):
+    return jax.nn.one_hot(jnp.asarray(x).astype(jnp.int32), num_classes)

@@ -1,0 +1,355 @@
+"""Math ops: elementwise binary/unary, activations, matmul family.
+
+Ref parity: paddle/fluid/operators/elementwise/, activation_op.cc,
+matmul_v2_op.cc, scale_op.cc, clip_op.cc. Pure jnp — XLA fuses the
+elementwise chains into surrounding matmuls (what the reference needed
+fused CUDA kernels and IR passes for).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.op_registry import register_op
+from ._common import align_for_axis_broadcast
+
+# -- elementwise binary -----------------------------------------------------
+
+
+def _binary(name, fn):
+    def op(x, y, *, axis=-1):
+        x, y = align_for_axis_broadcast(x, y, axis)
+        return fn(x, y)
+
+    op.__name__ = name
+    register_op(name)(op)
+    return op
+
+
+_binary("elementwise_add", jnp.add)
+_binary("elementwise_sub", jnp.subtract)
+_binary("elementwise_mul", jnp.multiply)
+_binary("elementwise_div", jnp.divide)
+_binary("elementwise_min", jnp.minimum)
+_binary("elementwise_max", jnp.maximum)
+_binary("elementwise_pow", jnp.power)
+_binary("elementwise_mod", jnp.mod)
+_binary("elementwise_floordiv", jnp.floor_divide)
+_binary("elementwise_heaviside", jnp.heaviside)
+_binary("fmax", jnp.fmax)
+_binary("fmin", jnp.fmin)
+_binary("atan2", jnp.arctan2)
+_binary("nextafter", jnp.nextafter)
+_binary("logaddexp", jnp.logaddexp)
+
+
+@register_op("remainder")
+def remainder(x, y):
+    return jnp.remainder(x, y)
+
+
+# -- comparison / logical (no grad) ----------------------------------------
+
+for _name, _fn in [
+    ("equal", jnp.equal), ("not_equal", jnp.not_equal),
+    ("less_than", jnp.less), ("less_equal", jnp.less_equal),
+    ("greater_than", jnp.greater), ("greater_equal", jnp.greater_equal),
+    ("logical_and", jnp.logical_and), ("logical_or", jnp.logical_or),
+    ("logical_xor", jnp.logical_xor),
+]:
+    register_op(_name, no_grad=True)(
+        (lambda f: lambda x, y: f(x, y))(_fn))
+
+register_op("logical_not", no_grad=True)(lambda x: jnp.logical_not(x))
+register_op("isnan", no_grad=True)(lambda x: jnp.isnan(x))
+register_op("isinf", no_grad=True)(lambda x: jnp.isinf(x))
+register_op("isfinite", no_grad=True)(lambda x: jnp.isfinite(x))
+register_op("isclose", no_grad=True)(
+    lambda x, y, *, rtol=1e-5, atol=1e-8, equal_nan=False:
+    jnp.isclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan))
+register_op("sign", no_grad=True)(lambda x: jnp.sign(x))
+
+
+# -- unary ------------------------------------------------------------------
+
+def _unary(name, fn):
+    op = (lambda f: lambda x: f(x))(fn)
+    op.__name__ = name
+    register_op(name)(op)
+
+
+for _name, _fn in [
+    ("exp", jnp.exp), ("expm1", jnp.expm1), ("log", jnp.log),
+    ("log2", jnp.log2), ("log10", jnp.log10), ("log1p", jnp.log1p),
+    ("sqrt", jnp.sqrt), ("square", jnp.square),
+    ("sin", jnp.sin), ("cos", jnp.cos), ("tan", jnp.tan),
+    ("asin", jnp.arcsin), ("acos", jnp.arccos), ("atan", jnp.arctan),
+    ("sinh", jnp.sinh), ("cosh", jnp.cosh), ("tanh", jnp.tanh),
+    ("asinh", jnp.arcsinh), ("acosh", jnp.arccosh), ("atanh", jnp.arctanh),
+    ("abs", jnp.abs), ("ceil", jnp.ceil), ("floor", jnp.floor),
+    ("round", jnp.round), ("trunc", jnp.trunc), ("frac", lambda x: x - jnp.trunc(x)),
+    ("reciprocal", jnp.reciprocal), ("neg", jnp.negative),
+    ("erf", jax.scipy.special.erf), ("erfinv", jax.scipy.special.erfinv),
+    ("digamma", jax.scipy.special.digamma),
+    ("lgamma", jax.scipy.special.gammaln),
+    ("i0", lambda x: jax.scipy.special.i0(x)),
+    ("rsqrt", jax.lax.rsqrt),
+    ("sigmoid", jax.nn.sigmoid), ("logsigmoid", jax.nn.log_sigmoid),
+    ("relu", jax.nn.relu), ("relu6", jax.nn.relu6),
+    ("softplus_default", jax.nn.softplus),
+    ("silu", jax.nn.silu), ("mish", lambda x: x * jnp.tanh(jax.nn.softplus(x))),
+    ("tanh_shrink", lambda x: x - jnp.tanh(x)),
+]:
+    _unary(_name, _fn)
+
+
+@register_op("selu")
+def selu_op(x, *, scale=1.0507009873554805, alpha=1.6732632423543772):
+    return scale * jnp.where(x > 0, x, alpha * jnp.expm1(x))
+
+
+@register_op("scale")
+def scale(x, *, scale=1.0, bias=0.0, bias_after_scale=True):
+    if bias_after_scale:
+        return x * scale + bias
+    return (x + bias) * scale
+
+
+@register_op("pow")
+def pow_(x, *, factor=1.0):
+    return jnp.power(x, factor)
+
+
+@register_op("clip")
+def clip(x, *, min=None, max=None):
+    return jnp.clip(x, min, max)
+
+
+@register_op("gelu")
+def gelu(x, *, approximate=False):
+    return jax.nn.gelu(x, approximate=approximate)
+
+
+@register_op("leaky_relu")
+def leaky_relu(x, *, negative_slope=0.01):
+    return jax.nn.leaky_relu(x, negative_slope)
+
+
+@register_op("elu")
+def elu(x, *, alpha=1.0):
+    return jax.nn.elu(x, alpha)
+
+
+@register_op("celu")
+def celu(x, *, alpha=1.0):
+    return jax.nn.celu(x, alpha)
+
+
+
+
+@register_op("hardtanh")
+def hardtanh(x, *, min=-1.0, max=1.0):
+    return jnp.clip(x, min, max)
+
+
+@register_op("hardsigmoid")
+def hardsigmoid(x, *, slope=1.0 / 6.0, offset=0.5):
+    return jnp.clip(x * slope + offset, 0.0, 1.0)
+
+
+@register_op("hardswish")
+def hardswish(x):
+    return x * jnp.clip(x / 6.0 + 0.5, 0.0, 1.0)
+
+
+@register_op("hardshrink")
+def hardshrink(x, *, threshold=0.5):
+    return jnp.where(jnp.abs(x) > threshold, x, 0.0)
+
+
+@register_op("softshrink")
+def softshrink(x, *, threshold=0.5):
+    return jnp.where(x > threshold, x - threshold,
+                     jnp.where(x < -threshold, x + threshold, 0.0))
+
+
+@register_op("softplus")
+def softplus(x, *, beta=1.0, threshold=20.0):
+    scaled = x * beta
+    return jnp.where(scaled > threshold, x, jax.nn.softplus(scaled) / beta)
+
+
+@register_op("softsign")
+def softsign(x):
+    return jax.nn.soft_sign(x)
+
+
+@register_op("swish")
+def swish(x):
+    return jax.nn.silu(x)
+
+
+@register_op("prelu")
+def prelu(x, alpha):
+    return jnp.where(x >= 0, x, alpha * x)
+
+
+@register_op("maximum")
+def maximum(x, y):
+    return jnp.maximum(x, y)
+
+
+@register_op("minimum")
+def minimum(x, y):
+    return jnp.minimum(x, y)
+
+
+@register_op("stanh")
+def stanh(x, *, scale_a=0.67, scale_b=1.7159):
+    return scale_b * jnp.tanh(scale_a * x)
+
+
+# -- matmul family (MXU ops — keep large and let XLA tile) ------------------
+
+
+@register_op("matmul_v2")
+def matmul_v2(x, y, *, trans_x=False, trans_y=False):
+    if trans_x:
+        x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
+    if trans_y:
+        y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
+    return jnp.matmul(x, y)
+
+
+@register_op("matmul")
+def matmul_v1(x, y, *, transpose_X=False, transpose_Y=False, alpha=1.0):
+    xx = jnp.swapaxes(x, -1, -2) if transpose_X and x.ndim > 1 else x
+    yy = jnp.swapaxes(y, -1, -2) if transpose_Y and y.ndim > 1 else y
+    out = jnp.matmul(xx, yy)
+    return out * alpha if alpha != 1.0 else out
+
+
+@register_op("mul")
+def mul(x, y, *, x_num_col_dims=1, y_num_col_dims=1):
+    xm = x.reshape((int(jnp.prod(jnp.array(x.shape[:x_num_col_dims]))), -1)) \
+        if x.ndim > 2 else x
+    ym = y.reshape((y.shape[0], -1)) if y.ndim > 2 else y
+    return jnp.matmul(xm, ym)
+
+
+@register_op("bmm")
+def bmm(x, y):
+    return jnp.matmul(x, y)
+
+
+@register_op("addmm")
+def addmm(input, x, y, *, alpha=1.0, beta=1.0):
+    return beta * input + alpha * jnp.matmul(x, y)
+
+
+@register_op("dot")
+def dot(x, y):
+    return jnp.sum(x * y, axis=-1)
+
+
+@register_op("outer")
+def outer(x, y):
+    return jnp.outer(x, y)
+
+
+@register_op("cross")
+def cross(x, y, *, axis=None):
+    if axis is None:
+        axis = -1
+    return jnp.cross(x, y, axis=axis)
+
+
+@register_op("einsum")
+def einsum(*operands, equation):
+    return jnp.einsum(equation, *operands)
+
+
+@register_op("kron")
+def kron(x, y):
+    return jnp.kron(x, y)
+
+
+# -- cumulative -------------------------------------------------------------
+
+
+@register_op("cumsum")
+def cumsum(x, *, axis=None, reverse=False, exclusive=False):
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    if reverse:
+        x = jnp.flip(x, axis)
+    out = jnp.cumsum(x, axis=axis)
+    if exclusive:
+        out = out - x
+    if reverse:
+        out = jnp.flip(out, axis)
+    return out
+
+
+@register_op("cumprod")
+def cumprod(x, *, dim=None):
+    if dim is None:
+        x = x.reshape(-1)
+        dim = 0
+    return jnp.cumprod(x, axis=dim)
+
+
+@register_op("logcumsumexp")
+def logcumsumexp(x, *, axis=None):
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    return jax.lax.cumlogsumexp(x, axis=axis)
+
+
+@register_op("lerp")
+def lerp(x, y, weight):
+    return x + weight * (y - x)
+
+
+@register_op("angle")
+def angle(x):
+    return jnp.angle(x)
+
+
+@register_op("conj")
+def conj(x):
+    return jnp.conj(x)
+
+
+@register_op("real")
+def real(x):
+    return jnp.real(x)
+
+
+@register_op("imag")
+def imag(x):
+    return jnp.imag(x)
+
+
+@register_op("trace_op")
+def trace_op(x, *, offset=0, axis1=0, axis2=1):
+    return jnp.trace(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+@register_op("diag")
+def diag(x, *, offset=0, padding_value=0.0):
+    if x.ndim == 1:
+        out = jnp.diag(x, k=offset)
+        if padding_value != 0.0:
+            mask = jnp.diag(jnp.ones_like(x, dtype=bool), k=offset)
+            out = jnp.where(mask, out, padding_value)
+        return out
+    return jnp.diagonal(x, offset=offset)
+
+
+@register_op("diagonal")
+def diagonal(x, *, offset=0, axis1=0, axis2=1):
+    return jnp.diagonal(x, offset=offset, axis1=axis1, axis2=axis2)

@@ -1,0 +1,603 @@
+"""Neural-network ops: conv/pool/norm/softmax/losses/embedding/attention.
+
+Ref parity: paddle/fluid/operators/ conv_op.cc, pool_op.cc,
+batch_norm_op.cc, layer_norm_op.cc, softmax_with_cross_entropy_op.cc,
+dropout_op.cc, lookup_table_v2_op.cc, interpolate_v2. Convs/matmuls are the
+MXU ops — implemented with lax.conv_general_dilated / jnp.matmul so XLA
+tiles them onto the systolic array; elementwise epilogues fuse in.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.op_registry import register_op
+
+
+def _pair(v, n=2):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in v)
+    return (int(v),) * n
+
+
+def _conv_padding(padding, spatial, strides, dilations, ksizes):
+    """Normalise paddle padding spec to lax's [(lo, hi), ...] or string."""
+    if isinstance(padding, str):
+        return padding.upper()  # 'SAME' / 'VALID'
+    if isinstance(padding, int):
+        return [(padding, padding)] * spatial
+    pads = [int(p) for p in padding]
+    if len(pads) == spatial:
+        return [(p, p) for p in pads]
+    if len(pads) == 2 * spatial:
+        return [(pads[2 * i], pads[2 * i + 1]) for i in range(spatial)]
+    raise ValueError(f"bad padding {padding!r}")
+
+
+@register_op("conv2d")
+def conv2d(x, weight, *, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW"):
+    strides = _pair(stride)
+    dilations = _pair(dilation)
+    kh, kw = weight.shape[-2], weight.shape[-1]
+    pad = _conv_padding(padding, 2, strides, dilations, (kh, kw))
+    dn = lax.conv_dimension_numbers(
+        x.shape, weight.shape,
+        ("NCHW", "OIHW", "NCHW") if data_format == "NCHW"
+        else ("NHWC", "OIHW", "NHWC"))
+    return lax.conv_general_dilated(
+        x, weight, window_strides=strides, padding=pad,
+        rhs_dilation=dilations, dimension_numbers=dn,
+        feature_group_count=groups)
+
+
+@register_op("depthwise_conv2d")
+def depthwise_conv2d(x, weight, *, stride=1, padding=0, dilation=1, groups=1,
+                     data_format="NCHW"):
+    return conv2d(x, weight, stride=stride, padding=padding,
+                  dilation=dilation, groups=groups, data_format=data_format)
+
+
+@register_op("conv2d_transpose")
+def conv2d_transpose(x, weight, *, stride=1, padding=0, output_padding=0,
+                     dilation=1, groups=1, data_format="NCHW"):
+    if groups != 1:
+        raise NotImplementedError("grouped conv2d_transpose")
+    strides = _pair(stride)
+    dilations = _pair(dilation)
+    opad = _pair(output_padding)
+    kh, kw = weight.shape[-2], weight.shape[-1]
+    pad = _conv_padding(padding, 2, strides, dilations, (kh, kw))
+    if isinstance(pad, str):
+        lax_pad = pad
+    else:
+        # transpose conv: effective padding = k - 1 - p (+ output_padding hi)
+        lax_pad = [
+            (dilations[i] * (k - 1) - pad[i][0],
+             dilations[i] * (k - 1) - pad[i][1] + opad[i])
+            for i, k in enumerate((kh, kw))
+        ]
+    dn = lax.conv_dimension_numbers(
+        x.shape, (weight.shape[1], weight.shape[0]) + weight.shape[2:],
+        ("NCHW", "OIHW", "NCHW") if data_format == "NCHW"
+        else ("NHWC", "OIHW", "NHWC"))
+    # weight layout for paddle transpose conv is (in, out, kh, kw)
+    w = jnp.swapaxes(weight, 0, 1)
+    w = jnp.flip(w, axis=(-2, -1))
+    return lax.conv_general_dilated(
+        x, w, window_strides=(1, 1), padding=lax_pad,
+        lhs_dilation=strides, rhs_dilation=dilations, dimension_numbers=dn)
+
+
+@register_op("conv1d")
+def conv1d(x, weight, *, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL"):
+    x4 = x[:, :, None, :]
+    w4 = weight[:, :, None, :]
+    s = stride if isinstance(stride, int) else stride[0]
+    d = dilation if isinstance(dilation, int) else dilation[0]
+    if isinstance(padding, str):
+        p = padding
+    else:
+        pv = padding if isinstance(padding, int) else padding[0]
+        p = [(0, 0), (pv, pv)]
+    out = conv2d(x4, w4, stride=(1, s), padding=p, dilation=(1, d),
+                 groups=groups)
+    return out[:, :, 0, :]
+
+
+@register_op("conv3d")
+def conv3d(x, weight, *, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW"):
+    strides = _pair(stride, 3)
+    dilations = _pair(dilation, 3)
+    ks = weight.shape[2:]
+    pad = _conv_padding(padding, 3, strides, dilations, ks)
+    dn = lax.conv_dimension_numbers(x.shape, weight.shape,
+                                    ("NCDHW", "OIDHW", "NCDHW"))
+    return lax.conv_general_dilated(
+        x, weight, window_strides=strides, padding=pad,
+        rhs_dilation=dilations, dimension_numbers=dn,
+        feature_group_count=groups)
+
+
+# -- pooling ---------------------------------------------------------------
+
+
+def _pool2d(x, ksize, stride, padding, ceil_mode, mode, exclusive,
+            data_format):
+    if data_format != "NCHW":
+        x = jnp.transpose(x, (0, 3, 1, 2))
+    ks = _pair(ksize)
+    st = _pair(stride) if stride is not None else ks
+    if isinstance(padding, str):
+        if padding.upper() == "SAME":
+            pads = "SAME"
+        else:
+            pads = [(0, 0), (0, 0), (0, 0), (0, 0)]
+    else:
+        p = _conv_padding(padding, 2, st, (1, 1), ks)
+        pads = [(0, 0), (0, 0)] + list(p)
+    if ceil_mode and not isinstance(pads, str):
+        # add extra hi padding so ceil-division windows are produced
+        h, w = x.shape[2], x.shape[3]
+        extra = []
+        for dim, k, s, (lo, hi) in zip((h, w), ks, st, pads[2:]):
+            full = dim + lo + hi - k
+            rem = full % s
+            extra.append((lo, hi + (s - rem) % s if rem else hi))
+        pads = [(0, 0), (0, 0)] + extra
+    window = (1, 1) + ks
+    strides = (1, 1) + st
+    if mode == "max":
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else \
+            jnp.iinfo(x.dtype).min
+        out = lax.reduce_window(x, init, lax.max, window, strides, pads)
+    else:
+        summed = lax.reduce_window(x, 0.0, lax.add, window, strides, pads)
+        if exclusive and (isinstance(pads, str) or any(
+                p != (0, 0) for p in pads)):
+            ones = jnp.ones_like(x)
+            counts = lax.reduce_window(ones, 0.0, lax.add, window, strides,
+                                       pads)
+            out = summed / counts
+        else:
+            out = summed / (ks[0] * ks[1])
+    if data_format != "NCHW":
+        out = jnp.transpose(out, (0, 2, 3, 1))
+    return out
+
+
+@register_op("pool2d")
+def pool2d(x, *, ksize, stride=None, padding=0, ceil_mode=False,
+           pooling_type="max", exclusive=True, global_pooling=False,
+           adaptive=False, data_format="NCHW"):
+    if global_pooling:
+        axes = (2, 3) if data_format == "NCHW" else (1, 2)
+        if pooling_type == "max":
+            return jnp.max(x, axis=axes, keepdims=True)
+        return jnp.mean(x, axis=axes, keepdims=True)
+    if adaptive:
+        return _adaptive_pool2d(x, ksize, pooling_type, data_format)
+    return _pool2d(x, ksize, stride, padding, ceil_mode, pooling_type,
+                   exclusive, data_format)
+
+
+def _adaptive_pool2d(x, output_size, mode, data_format):
+    os = _pair(output_size)
+    axes = (2, 3) if data_format == "NCHW" else (1, 2)
+    h, w = x.shape[axes[0]], x.shape[axes[1]]
+    if h % os[0] == 0 and w % os[1] == 0:
+        ks = (h // os[0], w // os[1])
+        return _pool2d(x, ks, ks, 0, False, mode, True, data_format)
+    raise NotImplementedError(
+        "adaptive pool2d with non-divisible output size")
+
+
+@register_op("max_pool2d_with_index", has_aux=True)
+def max_pool2d_with_index(x, *, ksize, stride=None, padding=0):
+    out = _pool2d(x, ksize, stride, padding, False, "max", True, "NCHW")
+    # indices = per-window argmax as flat positions into the input H*W map
+    kh, kw = _pair(ksize)
+    st = _pair(stride) if stride is not None else (kh, kw)
+    ph, pw = _pair(padding)
+    n, c, h, w = x.shape
+    patches = lax.conv_general_dilated_patches(
+        x, (kh, kw), st, [(ph, ph), (pw, pw)],
+        dimension_numbers=lax.conv_dimension_numbers(
+            x.shape, (1, c, kh, kw), ("NCHW", "OIHW", "NCHW")))
+    oh, ow = patches.shape[2], patches.shape[3]
+    patches = patches.reshape(n, c, kh * kw, oh, ow)
+    rel = jnp.argmax(patches, axis=2)  # window-relative flat index
+    oy = jnp.arange(oh).reshape(1, 1, oh, 1)
+    ox = jnp.arange(ow).reshape(1, 1, 1, ow)
+    abs_y = oy * st[0] - ph + rel // kw
+    abs_x = ox * st[1] - pw + rel % kw
+    idx = (abs_y * w + abs_x).astype(jnp.int32)
+    return out, idx
+
+
+# -- normalisation ----------------------------------------------------------
+
+
+@register_op("layer_norm")
+def layer_norm(x, scale=None, bias=None, *, epsilon=1e-5, begin_norm_axis=1):
+    axes = tuple(range(begin_norm_axis, x.ndim))
+    x32 = x.astype(jnp.float32) if x.dtype in (jnp.bfloat16, jnp.float16) else x
+    mean = jnp.mean(x32, axis=axes, keepdims=True)
+    var = jnp.var(x32, axis=axes, keepdims=True)
+    y = (x32 - mean) * lax.rsqrt(var + epsilon)
+    y = y.astype(x.dtype)
+    if scale is not None:
+        norm_shape = x.shape[begin_norm_axis:]
+        y = y * scale.reshape(norm_shape)
+    if bias is not None:
+        norm_shape = x.shape[begin_norm_axis:]
+        y = y + bias.reshape(norm_shape)
+    return y
+
+
+@register_op("batch_norm", has_aux=True)
+def batch_norm(x, scale, bias, mean, variance, *, momentum=0.9, epsilon=1e-5,
+               is_test=False, data_format="NCHW", use_global_stats=False):
+    c_axis = 1 if data_format == "NCHW" else x.ndim - 1
+    reduce_axes = tuple(a for a in range(x.ndim) if a != c_axis)
+    bshape = [1] * x.ndim
+    bshape[c_axis] = x.shape[c_axis]
+
+    if is_test or use_global_stats:
+        use_mean, use_var = mean, variance
+        new_mean, new_var = mean, variance
+    else:
+        x32 = x.astype(jnp.float32) if x.dtype in (jnp.bfloat16, jnp.float16) else x
+        use_mean = jnp.mean(x32, axis=reduce_axes)
+        use_var = jnp.var(x32, axis=reduce_axes)
+        new_mean = momentum * mean + (1 - momentum) * use_mean
+        new_var = momentum * variance + (1 - momentum) * use_var
+    inv = lax.rsqrt(use_var + epsilon)
+    y = (x - use_mean.reshape(bshape).astype(x.dtype)) * \
+        inv.reshape(bshape).astype(x.dtype)
+    y = y * scale.reshape(bshape) + bias.reshape(bshape)
+    return y, (lax.stop_gradient(new_mean), lax.stop_gradient(new_var))
+
+
+@register_op("instance_norm")
+def instance_norm(x, scale=None, bias=None, *, epsilon=1e-5):
+    axes = tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    y = (x - mean) * lax.rsqrt(var + epsilon)
+    bshape = [1, x.shape[1]] + [1] * (x.ndim - 2)
+    if scale is not None:
+        y = y * scale.reshape(bshape)
+    if bias is not None:
+        y = y + bias.reshape(bshape)
+    return y
+
+
+@register_op("group_norm")
+def group_norm(x, scale=None, bias=None, *, epsilon=1e-5, groups=1,
+               data_format="NCHW"):
+    n, c = x.shape[0], x.shape[1]
+    g = groups
+    xg = x.reshape((n, g, c // g) + x.shape[2:])
+    axes = tuple(range(2, xg.ndim))
+    mean = jnp.mean(xg, axis=axes, keepdims=True)
+    var = jnp.var(xg, axis=axes, keepdims=True)
+    y = ((xg - mean) * lax.rsqrt(var + epsilon)).reshape(x.shape)
+    bshape = [1, c] + [1] * (x.ndim - 2)
+    if scale is not None:
+        y = y * scale.reshape(bshape)
+    if bias is not None:
+        y = y + bias.reshape(bshape)
+    return y
+
+
+@register_op("rms_norm")
+def rms_norm(x, scale=None, *, epsilon=1e-6):
+    x32 = x.astype(jnp.float32) if x.dtype in (jnp.bfloat16, jnp.float16) else x
+    ms = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+    y = (x32 * lax.rsqrt(ms + epsilon)).astype(x.dtype)
+    if scale is not None:
+        y = y * scale
+    return y
+
+
+@register_op("local_response_norm")
+def local_response_norm(x, *, size, alpha=1e-4, beta=0.75, k=1.0):
+    sq = x * x
+    half = size // 2
+    pads = [(0, 0), (half, size - half - 1)] + [(0, 0)] * (x.ndim - 2)
+    padded = jnp.pad(sq, pads)
+    acc = sum(padded[:, i:i + x.shape[1]] for i in range(size))
+    return x / (k + alpha * acc) ** beta
+
+
+@register_op("l2_normalize")
+def l2_normalize(x, *, axis=-1, epsilon=1e-12):
+    norm = jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=True))
+    return x / jnp.maximum(norm, epsilon)
+
+
+# -- softmax & losses -------------------------------------------------------
+
+
+@register_op("softmax")
+def softmax(x, *, axis=-1):
+    return jax.nn.softmax(x, axis=axis)
+
+
+@register_op("log_softmax")
+def log_softmax(x, *, axis=-1):
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+@register_op("softmax_with_cross_entropy", has_aux=True)
+def softmax_with_cross_entropy(logits, label, *, soft_label=False, axis=-1,
+                               ignore_index=-100):
+    logits32 = logits.astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits32, axis=axis)
+    if soft_label:
+        loss = -jnp.sum(label * logp, axis=axis, keepdims=True)
+    else:
+        lbl = jnp.asarray(label)
+        squeeze = lbl.ndim == logits.ndim and lbl.shape[axis] == 1
+        if squeeze:
+            lbl = jnp.squeeze(lbl, axis=axis)
+        lbl = lbl.astype(jnp.int32)
+        picked = jnp.take_along_axis(
+            logp, jnp.expand_dims(jnp.maximum(lbl, 0), axis), axis=axis)
+        loss = -picked
+        mask = (lbl != ignore_index)
+        loss = loss * jnp.expand_dims(mask, axis).astype(loss.dtype)
+    return loss, lax.stop_gradient(jnp.exp(logp))
+
+
+@register_op("cross_entropy")
+def cross_entropy(input, label, *, soft_label=False, axis=-1,
+                  ignore_index=-100, reduction="mean", use_softmax=True,
+                  weight=None):
+    logits32 = input.astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits32, axis=axis) if use_softmax \
+        else jnp.log(jnp.maximum(logits32, 1e-30))
+    if soft_label:
+        loss = -jnp.sum(jnp.asarray(label) * logp, axis=axis)
+        valid = jnp.ones_like(loss, dtype=bool)
+    else:
+        lbl = jnp.asarray(label)
+        if lbl.ndim == input.ndim and lbl.shape[axis] == 1:
+            lbl = jnp.squeeze(lbl, axis=axis)
+        lbl = lbl.astype(jnp.int32)
+        picked = jnp.take_along_axis(
+            logp, jnp.expand_dims(jnp.maximum(lbl, 0), axis), axis=axis)
+        loss = -jnp.squeeze(picked, axis)
+        valid = lbl != ignore_index
+        loss = loss * valid.astype(loss.dtype)
+        if weight is not None:
+            w = jnp.take(jnp.asarray(weight), jnp.maximum(lbl, 0))
+            loss = loss * w
+    if reduction == "none":
+        return loss
+    if reduction == "sum":
+        return jnp.sum(loss)
+    # mean: weighted CE divides by the sum of gathered weights (paddle
+    # semantics, ref python/paddle/nn/functional/loss.py cross_entropy)
+    if not soft_label and weight is not None:
+        denom = jnp.maximum(jnp.sum(w * valid.astype(loss.dtype)), 1e-12)
+    else:
+        denom = jnp.maximum(jnp.sum(valid.astype(loss.dtype)), 1.0)
+    return jnp.sum(loss) / denom
+
+
+@register_op("sigmoid_cross_entropy_with_logits")
+def sigmoid_ce_with_logits(x, label, *, ignore_index=-100, normalize=False):
+    loss = jnp.maximum(x, 0) - x * label + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    mask = (label != ignore_index)
+    loss = loss * mask.astype(loss.dtype)
+    if normalize:
+        loss = loss / jnp.maximum(jnp.sum(mask.astype(loss.dtype)), 1.0)
+    return loss
+
+
+@register_op("bce_loss")
+def bce_loss(input, label):
+    eps = 1e-12
+    return -(label * jnp.log(jnp.maximum(input, eps)) +
+             (1 - label) * jnp.log(jnp.maximum(1 - input, eps)))
+
+
+@register_op("kldiv_loss")
+def kldiv_loss(x, target, *, reduction="mean"):
+    loss = target * (jnp.log(jnp.maximum(target, 1e-12)) - x)
+    if reduction == "none":
+        return loss
+    if reduction == "sum":
+        return jnp.sum(loss)
+    if reduction == "batchmean":
+        return jnp.sum(loss) / x.shape[0]
+    return jnp.mean(loss)
+
+
+@register_op("l1_loss")
+def l1_loss(input, label, *, reduction="mean"):
+    loss = jnp.abs(input - label)
+    if reduction == "none":
+        return loss
+    return jnp.sum(loss) if reduction == "sum" else jnp.mean(loss)
+
+
+@register_op("mse_loss")
+def mse_loss(input, label, *, reduction="mean"):
+    loss = jnp.square(input - label)
+    if reduction == "none":
+        return loss
+    return jnp.sum(loss) if reduction == "sum" else jnp.mean(loss)
+
+
+@register_op("smooth_l1_loss")
+def smooth_l1_loss(input, label, *, delta=1.0, reduction="mean"):
+    diff = jnp.abs(input - label)
+    loss = jnp.where(diff < delta, 0.5 * diff * diff / delta,
+                     diff - 0.5 * delta)
+    if reduction == "none":
+        return loss
+    return jnp.sum(loss) if reduction == "sum" else jnp.mean(loss)
+
+
+@register_op("nll_loss")
+def nll_loss(input, label, weight=None, *, reduction="mean",
+             ignore_index=-100):
+    lbl = jnp.asarray(label).astype(jnp.int32)
+    picked = jnp.take_along_axis(input, jnp.expand_dims(
+        jnp.maximum(lbl, 0), 1), axis=1)
+    loss = -jnp.squeeze(picked, 1)
+    valid = lbl != ignore_index
+    loss = loss * valid.astype(loss.dtype)
+    if weight is not None:
+        w = jnp.take(jnp.asarray(weight), jnp.maximum(lbl, 0))
+        loss = loss * w
+    if reduction == "none":
+        return loss
+    if reduction == "sum":
+        return jnp.sum(loss)
+    if weight is not None:
+        denom = jnp.maximum(jnp.sum(w * valid.astype(loss.dtype)), 1e-12)
+    else:
+        denom = jnp.maximum(jnp.sum(valid.astype(loss.dtype)), 1.0)
+    return jnp.sum(loss) / denom
+
+
+@register_op("hinge_loss")
+def hinge_loss(logits, label):
+    return jnp.maximum(0.0, 1.0 - logits * (2.0 * label - 1.0))
+
+
+@register_op("margin_ranking_loss")
+def margin_ranking_loss(input, other, label, *, margin=0.0, reduction="mean"):
+    loss = jnp.maximum(0.0, -label * (input - other) + margin)
+    if reduction == "none":
+        return loss
+    return jnp.sum(loss) if reduction == "sum" else jnp.mean(loss)
+
+
+@register_op("cosine_similarity")
+def cosine_similarity(x1, x2, *, axis=1, eps=1e-8):
+    dot = jnp.sum(x1 * x2, axis=axis)
+    n1 = jnp.sqrt(jnp.sum(x1 * x1, axis=axis))
+    n2 = jnp.sqrt(jnp.sum(x2 * x2, axis=axis))
+    return dot / jnp.maximum(n1 * n2, eps)
+
+
+# -- embedding --------------------------------------------------------------
+
+
+@register_op("lookup_table_v2")
+def lookup_table_v2(ids, w, *, padding_idx=-1):
+    ids = jnp.asarray(ids).astype(jnp.int32)
+    out = jnp.take(w, ids, axis=0)
+    if padding_idx is not None and padding_idx >= 0:
+        mask = (ids != padding_idx)[..., None].astype(out.dtype)
+        out = out * mask
+    return out
+
+
+# -- dropout (key passed explicitly; see paddle_tpu.framework.random) -------
+
+
+@register_op("dropout")
+def dropout(x, key, *, p=0.5, training=True, mode="upscale_in_train"):
+    if not training or p == 0.0:
+        if mode == "downscale_in_infer" and not training:
+            return x * (1.0 - p)
+        return x
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(jnp.asarray(key), keep, x.shape)
+    mask = mask.astype(x.dtype)
+    if mode == "upscale_in_train":
+        return x * mask / keep
+    return x * mask
+
+
+# -- attention (jnp fallback; pallas flash attention overrides on TPU) ------
+
+
+@register_op("scaled_dot_product_attention")
+def sdpa(q, k, v, mask=None, *, dropout_p=0.0, is_causal=False, scale=None):
+    """q,k,v: [batch, heads, seq, head_dim] (already transposed)."""
+    d = q.shape[-1]
+    s = scale if scale is not None else 1.0 / math.sqrt(d)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) * s
+    logits = logits.astype(jnp.float32)
+    if is_causal:
+        ql, kl = logits.shape[-2], logits.shape[-1]
+        causal = jnp.tril(jnp.ones((ql, kl), bool), k=kl - ql)
+        logits = jnp.where(causal, logits, -1e30)
+    if mask is not None:
+        mask = jnp.asarray(mask)
+        if mask.dtype == jnp.bool_:
+            logits = jnp.where(mask, logits, -1e30)
+        else:
+            logits = logits + mask
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+
+
+# -- misc -------------------------------------------------------------------
+
+
+@register_op("interpolate")
+def interpolate(x, *, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, data_format="NCHW"):
+    spatial_axes = (2, 3) if data_format == "NCHW" else (1, 2)
+    in_sizes = [x.shape[a] for a in spatial_axes]
+    if size is None:
+        sf = scale_factor if isinstance(scale_factor, (list, tuple)) \
+            else [scale_factor] * 2
+        size = [int(s * f) for s, f in zip(in_sizes, sf)]
+    out_shape = list(x.shape)
+    for a, s in zip(spatial_axes, size):
+        out_shape[a] = int(s)
+    method = {"nearest": "nearest", "bilinear": "bilinear",
+              "bicubic": "bicubic", "area": "linear"}[mode]
+    return jax.image.resize(x, out_shape, method=method)
+
+
+@register_op("pixel_shuffle")
+def pixel_shuffle(x, *, upscale_factor, data_format="NCHW"):
+    r = upscale_factor
+    n, c, h, w = x.shape
+    x = x.reshape(n, c // (r * r), r, r, h, w)
+    x = jnp.transpose(x, (0, 1, 4, 2, 5, 3))
+    return x.reshape(n, c // (r * r), h * r, w * r)
+
+
+@register_op("unfold")
+def unfold(x, *, kernel_sizes, strides=1, paddings=0, dilations=1):
+    kh, kw = _pair(kernel_sizes)
+    sh, sw = _pair(strides)
+    ph, pw = _pair(paddings)
+    dh, dw = _pair(dilations)
+    n, c = x.shape[0], x.shape[1]
+    patches = lax.conv_general_dilated_patches(
+        x, (kh, kw), (sh, sw), [(ph, ph), (pw, pw)],
+        rhs_dilation=(dh, dw),
+        dimension_numbers=lax.conv_dimension_numbers(
+            x.shape, (1, c, kh, kw), ("NCHW", "OIHW", "NCHW")))
+    return patches.reshape(n, c * kh * kw, -1)
+
+
+@register_op("temporal_shift")
+def temporal_shift(x, *, seg_num, shift_ratio=0.25):
+    nt, c, h, w = x.shape
+    n = nt // seg_num
+    x5 = x.reshape(n, seg_num, c, h, w)
+    fold = int(c * shift_ratio)
+    left = jnp.concatenate([x5[:, 1:, :fold], jnp.zeros_like(x5[:, :1, :fold])], 1)
+    right = jnp.concatenate([jnp.zeros_like(x5[:, :1, fold:2 * fold]),
+                             x5[:, :-1, fold:2 * fold]], 1)
+    rest = x5[:, :, 2 * fold:]
+    return jnp.concatenate([left, right, rest], axis=2).reshape(nt, c, h, w)

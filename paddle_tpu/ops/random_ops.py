@@ -1,0 +1,81 @@
+"""Random ops. Keys come in as explicit primal inputs (threaded PRNG —
+the TPU-native replacement for the reference's stateful Philox Generator,
+paddle/fluid/framework/generator.h)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.op_registry import register_op
+from ..core.dtype import to_jax_dtype
+
+
+@register_op("gaussian_random", no_grad=True)
+def gaussian_random(key, *, shape, mean=0.0, std=1.0, dtype="float32"):
+    dt = to_jax_dtype(dtype)
+    return mean + std * jax.random.normal(jnp.asarray(key), tuple(shape), dt)
+
+
+@register_op("uniform_random", no_grad=True)
+def uniform_random(key, *, shape, min=-1.0, max=1.0, dtype="float32"):
+    dt = to_jax_dtype(dtype)
+    return jax.random.uniform(jnp.asarray(key), tuple(shape), dt, min, max)
+
+
+@register_op("randint", no_grad=True)
+def randint(key, *, low, high, shape, dtype="int64"):
+    dt = to_jax_dtype(dtype)
+    return jax.random.randint(jnp.asarray(key), tuple(shape), low, high, dt)
+
+
+@register_op("randperm", no_grad=True)
+def randperm(key, *, n, dtype="int64"):
+    return jax.random.permutation(jnp.asarray(key), n).astype(
+        to_jax_dtype(dtype))
+
+
+@register_op("bernoulli", no_grad=True)
+def bernoulli(x, key):
+    return jax.random.bernoulli(jnp.asarray(key), x).astype(x.dtype)
+
+
+@register_op("multinomial", no_grad=True)
+def multinomial(x, key, *, num_samples=1, replacement=False):
+    key = jnp.asarray(key)
+    logits = jnp.log(jnp.maximum(x, 1e-30))
+    if replacement:
+        return jax.random.categorical(
+            key, logits, axis=-1,
+            shape=(num_samples,) + x.shape[:-1]).T.astype(jnp.int64) \
+            if x.ndim > 1 else jax.random.categorical(
+                key, logits, shape=(num_samples,)).astype(jnp.int64)
+    # without replacement: Gumbel top-k trick
+    g = jax.random.gumbel(key, x.shape)
+    _, idx = jax.lax.top_k(logits + g, num_samples)
+    return idx.astype(jnp.int64)
+
+
+@register_op("poisson", no_grad=True)
+def poisson(x, key):
+    return jax.random.poisson(jnp.asarray(key), x).astype(x.dtype)
+
+
+@register_op("exponential", no_grad=True)
+def exponential(x, key, *, lam=1.0):
+    return jax.random.exponential(jnp.asarray(key), x.shape).astype(
+        x.dtype) / lam
+
+
+@register_op("normal_like", no_grad=True)
+def normal_like(x, key, *, mean=0.0, std=1.0):
+    return mean + std * jax.random.normal(jnp.asarray(key), x.shape, x.dtype)
+
+
+@register_op("truncated_gaussian_random", no_grad=True)
+def truncated_gaussian_random(key, *, shape, mean=0.0, std=1.0,
+                              dtype="float32"):
+    dt = to_jax_dtype(dtype)
+    out = jax.random.truncated_normal(
+        jnp.asarray(key), -2.0, 2.0, tuple(shape), dt)
+    return mean + std * out

@@ -1,0 +1,79 @@
+"""Reduction ops (ref: paddle/fluid/operators/reduce_ops/)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.op_registry import register_op
+
+
+def _axis_arg(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return None if len(axis) == 0 else tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def _reduce(name, fn, no_grad=False):
+    def op(x, *, axis=None, keepdim=False):
+        return fn(x, axis=_axis_arg(axis), keepdims=keepdim)
+
+    op.__name__ = name
+    register_op(name, no_grad=no_grad)(op)
+
+
+_reduce("reduce_sum", jnp.sum)
+_reduce("reduce_mean", jnp.mean)
+_reduce("reduce_max", jnp.max)
+_reduce("reduce_min", jnp.min)
+_reduce("reduce_prod", jnp.prod)
+_reduce("reduce_any", jnp.any, no_grad=True)
+_reduce("reduce_all", jnp.all, no_grad=True)
+_reduce("nansum", jnp.nansum)
+_reduce("nanmean", jnp.nanmean)
+
+
+@register_op("logsumexp")
+def logsumexp(x, *, axis=None, keepdim=False):
+    from jax.scipy.special import logsumexp as lse
+
+    return lse(x, axis=_axis_arg(axis), keepdims=keepdim)
+
+
+@register_op("amax")
+def amax(x, *, axis=None, keepdim=False):
+    return jnp.amax(x, axis=_axis_arg(axis), keepdims=keepdim)
+
+
+@register_op("amin")
+def amin(x, *, axis=None, keepdim=False):
+    return jnp.amin(x, axis=_axis_arg(axis), keepdims=keepdim)
+
+
+@register_op("var")
+def var(x, *, axis=None, unbiased=True, keepdim=False):
+    return jnp.var(x, axis=_axis_arg(axis), ddof=1 if unbiased else 0,
+                   keepdims=keepdim)
+
+
+@register_op("std")
+def std(x, *, axis=None, unbiased=True, keepdim=False):
+    return jnp.std(x, axis=_axis_arg(axis), ddof=1 if unbiased else 0,
+                   keepdims=keepdim)
+
+
+@register_op("median")
+def median(x, *, axis=None, keepdim=False):
+    return jnp.median(x, axis=_axis_arg(axis), keepdims=keepdim)
+
+
+@register_op("quantile")
+def quantile(x, *, q, axis=None, keepdim=False):
+    return jnp.quantile(x, jnp.asarray(q), axis=_axis_arg(axis),
+                        keepdims=keepdim)
+
+
+@register_op("count_nonzero", no_grad=True)
+def count_nonzero(x, *, axis=None, keepdim=False):
+    return jnp.count_nonzero(x, axis=_axis_arg(axis), keepdims=keepdim)

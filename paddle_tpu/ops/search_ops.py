@@ -1,0 +1,146 @@
+"""Search / sort / sampling-index ops.
+
+Ref parity: paddle/fluid/operators/ arg_max_op, top_k_v2_op, argsort_op,
+where_index_op, unique_op, masked_select_op. Ops with data-dependent output
+shapes (nonzero, masked_select, unique) are eager-only: they cannot appear
+inside a jit region (XLA static shapes) — same constraint the reference
+solves with LoD, we solve with padding/masks at the API layer.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.op_registry import register_op
+
+
+@register_op("arg_max", no_grad=True)
+def arg_max(x, *, axis=None, keepdim=False, dtype="int64"):
+    from ..core.dtype import to_jax_dtype
+
+    out = jnp.argmax(x, axis=axis, keepdims=keepdim if axis is not None else False)
+    return out.astype(to_jax_dtype(dtype))
+
+
+@register_op("arg_min", no_grad=True)
+def arg_min(x, *, axis=None, keepdim=False, dtype="int64"):
+    from ..core.dtype import to_jax_dtype
+
+    out = jnp.argmin(x, axis=axis, keepdims=keepdim if axis is not None else False)
+    return out.astype(to_jax_dtype(dtype))
+
+
+@register_op("top_k_v2", has_aux=True)
+def top_k_v2(x, *, k, axis=-1, largest=True, sorted=True):
+    import jax
+
+    axis = axis if axis >= 0 else x.ndim + axis
+    xs = jnp.moveaxis(x, axis, -1)
+    if largest:
+        vals, idx = jax.lax.top_k(xs, k)
+    else:
+        vals, idx = jax.lax.top_k(-xs, k)
+        vals = -vals
+    vals = jnp.moveaxis(vals, -1, axis)
+    idx = jnp.moveaxis(idx, -1, axis)
+    return vals, idx.astype(jnp.int64)
+
+
+@register_op("argsort", no_grad=True)
+def argsort(x, *, axis=-1, descending=False):
+    idx = jnp.argsort(x, axis=axis, descending=descending)
+    return idx.astype(jnp.int64)
+
+
+@register_op("sort_op", has_aux=True)
+def sort_op(x, *, axis=-1, descending=False):
+    idx = jnp.argsort(x, axis=axis, descending=descending)
+    vals = jnp.take_along_axis(x, idx, axis=axis)
+    return vals, idx.astype(jnp.int64)
+
+
+@register_op("searchsorted", no_grad=True)
+def searchsorted(sorted_sequence, values, *, out_int32=False, right=False):
+    side = "right" if right else "left"
+    out = jnp.searchsorted(sorted_sequence, values, side=side)
+    return out.astype(jnp.int32 if out_int32 else jnp.int64)
+
+
+@register_op("bucketize", no_grad=True)
+def bucketize(x, sorted_sequence, *, out_int32=False, right=False):
+    side = "right" if right else "left"
+    out = jnp.searchsorted(sorted_sequence, x, side=side)
+    return out.astype(jnp.int32 if out_int32 else jnp.int64)
+
+
+@register_op("nonzero", no_grad=True)
+def nonzero(x):
+    # eager-only: data-dependent shape
+    import numpy as np
+
+    arr = np.asarray(x)
+    return jnp.asarray(np.stack(np.nonzero(arr), axis=-1).astype(np.int64))
+
+
+@register_op("masked_select", no_grad=True)
+def masked_select(x, mask):
+    import numpy as np
+
+    arr, m = np.asarray(x), np.asarray(mask)
+    return jnp.asarray(arr[np.broadcast_to(m, arr.shape)])
+
+
+@register_op("unique", no_grad=True)
+def unique(x, *, return_index=False, return_inverse=False,
+           return_counts=False, axis=None):
+    import numpy as np
+
+    res = np.unique(np.asarray(x), return_index=return_index,
+                    return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    if isinstance(res, tuple):
+        return tuple(jnp.asarray(r) for r in res)
+    return jnp.asarray(res)
+
+
+@register_op("masked_fill")
+def masked_fill(x, mask, *, value):
+    return jnp.where(jnp.asarray(mask), jnp.asarray(value, x.dtype), x)
+
+
+@register_op("index_put")
+def index_put(x, indices, value):
+    import jax
+
+    idx = tuple(jnp.asarray(i) for i in indices) \
+        if isinstance(indices, (list, tuple)) else (jnp.asarray(indices),)
+    return x.at[idx].set(jnp.asarray(value))
+
+
+@register_op("kthvalue", has_aux=True)
+def kthvalue(x, *, k, axis=-1, keepdim=False):
+    vals = jnp.sort(x, axis=axis)
+    idxs = jnp.argsort(x, axis=axis)
+    taken = jnp.take(vals, k - 1, axis=axis)
+    tidx = jnp.take(idxs, k - 1, axis=axis)
+    if keepdim:
+        taken = jnp.expand_dims(taken, axis)
+        tidx = jnp.expand_dims(tidx, axis)
+    return taken, tidx.astype(jnp.int64)
+
+
+@register_op("mode_op", has_aux=True)
+def mode_op(x, *, axis=-1, keepdim=False):
+    # eager-only (uses host numpy); mode of each 1-d lane along `axis`
+    import numpy as np
+
+    arr = np.asarray(x)
+
+    def _mode_1d(a):
+        vals, counts = np.unique(a, return_counts=True)
+        return vals[np.argmax(counts)]
+
+    m = np.apply_along_axis(_mode_1d, axis, arr)
+    if keepdim:
+        m = np.expand_dims(m, axis)
+    return jnp.asarray(m), jnp.asarray(np.zeros(m.shape, dtype=np.int64))

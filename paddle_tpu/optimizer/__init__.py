@@ -1,0 +1,484 @@
+"""Optimizers (ref: python/paddle/optimizer/optimizer.py:49 base +
+adam/adamw/lamb/momentum/sgd/rmsprop; update rules from
+paddle/fluid/operators/optimizers/*.cc).
+
+TPU-native design: every optimizer is defined by a *pure* per-parameter
+update rule `_rule(param, grad, state, lr_and_hyper) -> (new_param,
+new_state)`. The eager `step()` runs the rule through one cached `jax.jit`
+per shape; the functional engine maps the same rule over the whole
+parameter pytree inside the compiled train step (so the reference's fused
+optimizer-op IR passes are unnecessary — XLA fuses the tree-wide update
+into a handful of kernels).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core import config
+from ..core.tensor import Parameter, Tensor
+from . import lr as lr  # noqa: PLC0414
+from .lr import LRScheduler
+
+__all__ = [
+    "Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adamax", "Adagrad",
+    "Adadelta", "RMSProp", "Lamb", "lr",
+]
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None,
+                 multi_precision=False):
+        if parameters is not None:
+            parameters = list(parameters)
+            if parameters and isinstance(parameters[0], dict):
+                # param groups: flatten (per-group lr handled via
+                # optimize_attr)
+                flat = []
+                for group in parameters:
+                    for p in group["params"]:
+                        if "learning_rate" in group:
+                            p.optimize_attr["learning_rate"] = \
+                                group["learning_rate"]
+                        if "weight_decay" in group:
+                            p.regularizer = _as_decay(group["weight_decay"])
+                        flat.append(p)
+                parameters = flat
+        self._parameter_list = parameters
+        self._learning_rate = learning_rate
+        self._weight_decay = _as_decay(weight_decay)
+        self._grad_clip = grad_clip
+        self._accumulators = {}  # id(param) -> state dict
+        self._global_step = 0
+        self._param_names = {}
+        self._jit_rules = {}
+
+    # -- lr ------------------------------------------------------------------
+    def get_lr(self):
+        if isinstance(self._learning_rate, LRScheduler):
+            return float(self._learning_rate())
+        return float(self._learning_rate)
+
+    def set_lr(self, value):
+        if isinstance(self._learning_rate, LRScheduler):
+            raise RuntimeError(
+                "can't set_lr when learning rate is an LRScheduler")
+        self._learning_rate = float(value)
+
+    # -- state ---------------------------------------------------------------
+    def _state_for(self, p):
+        st = self._accumulators.get(id(p))
+        if st is None:
+            st = self._init_state(p._value)
+            self._accumulators[id(p)] = st
+        return st
+
+    def _init_state(self, value):
+        return {}
+
+    # pure rule; override in subclasses
+    def _rule(self, param, grad, state, lr, **hyper):
+        raise NotImplementedError
+
+    # hyperparams passed to the rule each step (may include python floats
+    # that are stable across steps — they become compile-time constants)
+    def _hyper(self):
+        return {}
+
+    # -- the eager step ------------------------------------------------------
+    @config.no_grad()
+    def step(self):
+        self._global_step += 1
+        params_grads = []
+        for p in self._parameter_list:
+            if p is None or p.stop_gradient or p._grad is None:
+                continue
+            params_grads.append((p, Tensor(p._grad)))
+        params_grads = self._preprocess(params_grads)
+        lr = self.get_lr()
+        hyper = self._hyper()
+        for p, g in params_grads:
+            state = self._state_for(p)
+            plr = lr * p.optimize_attr.get("learning_rate", 1.0)
+            new_p, new_state = self._run_rule(
+                p._value, g._value, state, plr, hyper)
+            p._value = new_p
+            self._accumulators[id(p)] = new_state
+
+    def _run_rule(self, pv, gv, state, lr, hyper):
+        key = (pv.shape, str(pv.dtype))
+        fn = self._jit_rules.get(key)
+        if fn is None:
+            fn = jax.jit(lambda p, g, s, lr_: self._rule(
+                p, g, s, lr_, **hyper))
+            self._jit_rules[key] = fn
+        return fn(pv, gv, state, lr)
+
+    def _preprocess(self, params_grads):
+        # weight decay as L2 regularization on grads (per-param regularizer
+        # wins over the optimizer-level setting, paddle semantics)
+        out = []
+        for p, g in params_grads:
+            decay = p.regularizer if p.regularizer is not None \
+                else self._weight_decay
+            if decay is not None and not self._decoupled_weight_decay():
+                g = Tensor(g._value + decay.coeff * p._value)
+            out.append((p, g))
+        if self._grad_clip is not None:
+            out = self._grad_clip(out)
+        return out
+
+    def _decoupled_weight_decay(self):
+        return False
+
+    def clear_grad(self, set_to_zero=False):
+        for p in self._parameter_list or []:
+            if p is not None:
+                p.clear_grad()
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        self.step()
+        return None, [(p, p.grad) for p in self._parameter_list or []]
+
+    # -- persistence ---------------------------------------------------------
+    def state_dict(self):
+        import numpy as np
+
+        sd = {"global_step": self._global_step}
+        for i, p in enumerate(self._parameter_list or []):
+            st = self._accumulators.get(id(p))
+            if st is None:
+                continue
+            name = p.name or f"param_{i}"
+            for k, v in st.items():
+                sd[f"{name}.{k}"] = np.asarray(v)
+        if isinstance(self._learning_rate, LRScheduler):
+            sd["LR_Scheduler"] = self._learning_rate.state_dict()
+        return sd
+
+    def set_state_dict(self, state_dict):
+        self._global_step = int(state_dict.get("global_step", 0))
+        for i, p in enumerate(self._parameter_list or []):
+            name = p.name or f"param_{i}"
+            st = self._init_state(p._value)
+            found = False
+            for k in list(st):
+                kk = f"{name}.{k}"
+                if kk in state_dict:
+                    st[k] = jnp.asarray(state_dict[kk])
+                    found = True
+            if found:
+                self._accumulators[id(p)] = st
+        if "LR_Scheduler" in state_dict and isinstance(
+                self._learning_rate, LRScheduler):
+            self._learning_rate.set_state_dict(state_dict["LR_Scheduler"])
+
+    # -- functional access (used by the compiled engine) ---------------------
+    def init_state_tree(self, params):
+        return jax.tree.map(
+            lambda v: self._init_state(v), params,
+            is_leaf=lambda x: hasattr(x, "shape") and hasattr(x, "dtype"))
+
+    def apply_gradients_tree(self, params, grads, states, lr):
+        """Pure tree-wide update used inside the compiled train step.
+
+        `params`/`grads` share a structure whose leaves are arrays; `states`
+        has the same structure with a per-param state dict at each leaf.
+        """
+        hyper = self._hyper()
+        flat_p, tree = jax.tree.flatten(params)
+        flat_g = tree.flatten_up_to(grads)
+        flat_s = tree.flatten_up_to(states)
+        new_p, new_s = [], []
+        for p, g, s in zip(flat_p, flat_g, flat_s):
+            np_, ns_ = self._rule(p, g, s, lr, **hyper)
+            new_p.append(np_)
+            new_s.append(ns_)
+        return jax.tree.unflatten(tree, new_p), jax.tree.unflatten(
+            tree, new_s)
+
+
+class _Decay:
+    def __init__(self, coeff):
+        self.coeff = float(coeff)
+
+
+class L2Decay(_Decay):
+    pass
+
+
+class L1Decay(_Decay):
+    pass
+
+
+def _as_decay(wd):
+    if wd is None:
+        return None
+    if isinstance(wd, _Decay):
+        return wd
+    return L2Decay(float(wd))
+
+
+# ---------------------------------------------------------------------------
+# update rules (ref: paddle/fluid/operators/optimizers/)
+# ---------------------------------------------------------------------------
+
+
+class SGD(Optimizer):
+    def _rule(self, param, grad, state, lr):
+        return param - lr * grad.astype(param.dtype), state
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+
+    def _init_state(self, value):
+        return {"velocity": jnp.zeros_like(value)}
+
+    def _hyper(self):
+        return {"momentum": self._momentum, "nesterov": self._use_nesterov}
+
+    def _rule(self, param, grad, state, lr, *, momentum, nesterov):
+        g = grad.astype(param.dtype)
+        v = momentum * state["velocity"] + g
+        if nesterov:
+            new_p = param - lr * (g + momentum * v)
+        else:
+            new_p = param - lr * v
+        return new_p, {"velocity": v}
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+
+    def _init_state(self, value):
+        return {
+            "moment1": jnp.zeros_like(value),
+            "moment2": jnp.zeros_like(value),
+            "beta1_pow": jnp.ones((), jnp.float32),
+            "beta2_pow": jnp.ones((), jnp.float32),
+        }
+
+    def _hyper(self):
+        return {"beta1": self._beta1, "beta2": self._beta2,
+                "epsilon": self._epsilon}
+
+    def _rule(self, param, grad, state, lr, *, beta1, beta2, epsilon):
+        g = grad.astype(jnp.float32)
+        p32 = param.astype(jnp.float32)
+        m = beta1 * state["moment1"] + (1 - beta1) * g
+        v = beta2 * state["moment2"] + (1 - beta2) * g * g
+        b1p = state["beta1_pow"] * beta1
+        b2p = state["beta2_pow"] * beta2
+        mhat = m / (1 - b1p)
+        vhat = v / (1 - b2p)
+        new_p = p32 - lr * mhat / (jnp.sqrt(vhat) + epsilon)
+        return new_p.astype(param.dtype), {
+            "moment1": m, "moment2": v, "beta1_pow": b1p, "beta2_pow": b2p}
+
+
+class AdamW(Adam):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.01,
+                 lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=False, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         None, grad_clip)
+        self._coeff = float(weight_decay) if not isinstance(
+            weight_decay, _Decay) else weight_decay.coeff
+        self._apply_decay_param_fun = apply_decay_param_fun
+
+    def _decoupled_weight_decay(self):
+        return True
+
+    def _hyper(self):
+        h = super()._hyper()
+        h["coeff"] = self._coeff
+        return h
+
+    def _rule(self, param, grad, state, lr, *, beta1, beta2, epsilon, coeff):
+        # decoupled decay applied to the param before the adam update
+        p = param * (1.0 - lr * coeff)
+        return super()._rule(p, grad, state, lr, beta1=beta1, beta2=beta2,
+                             epsilon=epsilon)
+
+    @config.no_grad()
+    def step(self):
+        # honour apply_decay_param_fun by zeroing coeff per-param
+        if self._apply_decay_param_fun is None:
+            return super().step()
+        self._global_step += 1
+        params_grads = self._preprocess(
+            [(p, Tensor(p._grad)) for p in self._parameter_list
+             if p is not None and not p.stop_gradient and p._grad is not None])
+        lr = self.get_lr()
+        hyper = self._hyper()
+        for p, g in params_grads:
+            h = dict(hyper)
+            if not self._apply_decay_param_fun(p.name or ""):
+                h["coeff"] = 0.0
+            state = self._state_for(p)
+            plr = lr * p.optimize_attr.get("learning_rate", 1.0)
+            new_p, new_state = self._rule(p._value, g._value, state, plr, **h)
+            p._value = new_p
+            self._accumulators[id(p)] = new_state
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _init_state(self, value):
+        return {"moment": jnp.zeros_like(value),
+                "inf_norm": jnp.zeros_like(value),
+                "beta1_pow": jnp.ones((), jnp.float32)}
+
+    def _hyper(self):
+        return {"beta1": self._beta1, "beta2": self._beta2,
+                "epsilon": self._epsilon}
+
+    def _rule(self, param, grad, state, lr, *, beta1, beta2, epsilon):
+        g = grad.astype(param.dtype)
+        m = beta1 * state["moment"] + (1 - beta1) * g
+        u = jnp.maximum(beta2 * state["inf_norm"], jnp.abs(g))
+        b1p = state["beta1_pow"] * beta1
+        new_p = param - (lr / (1 - b1p)).astype(param.dtype) * m / \
+            (u + epsilon)
+        return new_p, {"moment": m, "inf_norm": u, "beta1_pow": b1p}
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None,
+                 initial_accumulator_value=0.0, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._epsilon = epsilon
+        self._initial = initial_accumulator_value
+
+    def _init_state(self, value):
+        return {"moment": jnp.full_like(value, self._initial)}
+
+    def _hyper(self):
+        return {"epsilon": self._epsilon}
+
+    def _rule(self, param, grad, state, lr, *, epsilon):
+        g = grad.astype(param.dtype)
+        mom = state["moment"] + g * g
+        new_p = param - lr * g / (jnp.sqrt(mom) + epsilon)
+        return new_p, {"moment": mom}
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._epsilon, self._rho = epsilon, rho
+
+    def _init_state(self, value):
+        return {"avg_squared_grad": jnp.zeros_like(value),
+                "avg_squared_update": jnp.zeros_like(value)}
+
+    def _hyper(self):
+        return {"epsilon": self._epsilon, "rho": self._rho}
+
+    def _rule(self, param, grad, state, lr, *, epsilon, rho):
+        g = grad.astype(param.dtype)
+        asg = rho * state["avg_squared_grad"] + (1 - rho) * g * g
+        update = g * jnp.sqrt(state["avg_squared_update"] + epsilon) / \
+            jnp.sqrt(asg + epsilon)
+        asu = rho * state["avg_squared_update"] + (1 - rho) * update * update
+        return param - lr * update, {
+            "avg_squared_grad": asg, "avg_squared_update": asu}
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._rho, self._epsilon = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+
+    def _init_state(self, value):
+        return {"mean_square": jnp.zeros_like(value),
+                "mean_grad": jnp.zeros_like(value),
+                "momentum": jnp.zeros_like(value)}
+
+    def _hyper(self):
+        return {"rho": self._rho, "epsilon": self._epsilon,
+                "momentum": self._momentum, "centered": self._centered}
+
+    def _rule(self, param, grad, state, lr, *, rho, epsilon, momentum,
+              centered):
+        g = grad.astype(param.dtype)
+        ms = rho * state["mean_square"] + (1 - rho) * g * g
+        if centered:
+            mg = rho * state["mean_grad"] + (1 - rho) * g
+            denom = jnp.sqrt(ms - mg * mg + epsilon)
+        else:
+            mg = state["mean_grad"]
+            denom = jnp.sqrt(ms + epsilon)
+        mom = momentum * state["momentum"] + lr * g / denom
+        return param - mom, {"mean_square": ms, "mean_grad": mg,
+                             "momentum": mom}
+
+
+class Lamb(Optimizer):
+    """ref: paddle/fluid/operators/optimizers/lamb_op.cc."""
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01,
+                 beta1=0.9, beta2=0.999, epsilon=1e-6, parameters=None,
+                 grad_clip=None, exclude_from_weight_decay_fn=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip)
+        self._coeff = lamb_weight_decay
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _init_state(self, value):
+        return {"moment1": jnp.zeros_like(value),
+                "moment2": jnp.zeros_like(value),
+                "beta1_pow": jnp.ones((), jnp.float32),
+                "beta2_pow": jnp.ones((), jnp.float32)}
+
+    def _hyper(self):
+        return {"beta1": self._beta1, "beta2": self._beta2,
+                "epsilon": self._epsilon, "coeff": self._coeff}
+
+    def _rule(self, param, grad, state, lr, *, beta1, beta2, epsilon, coeff):
+        g = grad.astype(jnp.float32)
+        p32 = param.astype(jnp.float32)
+        m = beta1 * state["moment1"] + (1 - beta1) * g
+        v = beta2 * state["moment2"] + (1 - beta2) * g * g
+        b1p = state["beta1_pow"] * beta1
+        b2p = state["beta2_pow"] * beta2
+        mhat = m / (1 - b1p)
+        vhat = v / (1 - b2p)
+        r = mhat / (jnp.sqrt(vhat) + epsilon) + coeff * p32
+        p_norm = jnp.sqrt(jnp.sum(p32 * p32))
+        r_norm = jnp.sqrt(jnp.sum(r * r))
+        trust = jnp.where((p_norm > 0) & (r_norm > 0), p_norm / r_norm, 1.0)
+        new_p = p32 - lr * trust * r
+        return new_p.astype(param.dtype), {
+            "moment1": m, "moment2": v, "beta1_pow": b1p, "beta2_pow": b2p}

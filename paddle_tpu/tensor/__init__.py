@@ -1,0 +1,38 @@
+"""paddle_tpu.tensor — functional tensor API + Tensor method attachment.
+
+Ref parity: python/paddle/tensor/__init__.py, which monkey-patches the
+generated method list onto the Tensor class.
+"""
+
+from . import creation, einsum, linalg, logic, manipulation, math, random, \
+    search, stat  # noqa: F401
+from ..core.tensor import Tensor
+
+# Functions that become Tensor methods, paddle-style (x is self).
+_METHOD_SOURCES = [math, manipulation, logic, search, stat, linalg]
+
+_SKIP = {"pow", "scale"}  # defined manually below / operator-backed
+
+
+def _attach_methods():
+    for mod in _METHOD_SOURCES:
+        for name in dir(mod):
+            if name.startswith("_"):
+                continue
+            fn = getattr(mod, name)
+            if not callable(fn):
+                continue
+            if hasattr(Tensor, name) and name not in ("where",):
+                continue
+            setattr(Tensor, name, fn)
+    # manual cases
+    Tensor.pow = lambda self, y, name=None: math.pow(self, y)
+    Tensor.scale = lambda self, scale=1.0, bias=0.0, bias_after_scale=True, \
+        act=None, name=None: math.scale(self, scale, bias, bias_after_scale,
+                                        act)
+    Tensor.norm = linalg.norm
+    Tensor.matmul = math.matmul
+    Tensor.mm = math.mm
+
+
+_attach_methods()

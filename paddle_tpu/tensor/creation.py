@@ -1,0 +1,145 @@
+"""Tensor creation API (ref: python/paddle/tensor/creation.py)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..core import config
+from ..core.dispatch import apply
+from ..core.dtype import to_jax_dtype
+from ..core.tensor import Tensor
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    return Tensor(data, dtype=dtype, stop_gradient=stop_gradient)
+
+
+def _default(dtype):
+    return dtype if dtype is not None else config.get_default_dtype()
+
+
+def zeros(shape, dtype=None, name=None):
+    return Tensor(jnp.zeros(tuple(int(s) for s in shape),
+                            to_jax_dtype(_default(dtype))))
+
+
+def ones(shape, dtype=None, name=None):
+    return Tensor(jnp.ones(tuple(int(s) for s in shape),
+                           to_jax_dtype(_default(dtype))))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    if dtype is None:
+        if isinstance(fill_value, bool):
+            dtype = "bool"
+        elif isinstance(fill_value, int):
+            dtype = "int64"
+        else:
+            dtype = config.get_default_dtype()
+    return Tensor(jnp.full(tuple(int(s) for s in shape), fill_value,
+                           to_jax_dtype(dtype)))
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype, name)
+
+
+def zeros_like(x, dtype=None, name=None):
+    return apply("full_like", x, fill_value=0, dtype=dtype)
+
+
+def ones_like(x, dtype=None, name=None):
+    return apply("full_like", x, fill_value=1, dtype=dtype)
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    return apply("full_like", x, fill_value=fill_value, dtype=dtype)
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype, name)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    def _v(v):
+        return v.item() if isinstance(v, Tensor) else v
+
+    start, end, step = _v(start), _v(end), _v(step)
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        dtype = "int64" if all(
+            isinstance(v, (int, np.integer)) for v in (start, end, step)) \
+            else config.get_default_dtype()
+    return Tensor(jnp.arange(start, end, step, dtype=to_jax_dtype(dtype)))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    def _v(v):
+        return v.item() if isinstance(v, Tensor) else v
+
+    dtype = _default(dtype)
+    return Tensor(jnp.linspace(_v(start), _v(stop), int(_v(num)),
+                               dtype=to_jax_dtype(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    dtype = _default(dtype)
+    return Tensor(jnp.logspace(start, stop, int(num), base=base,
+                               dtype=to_jax_dtype(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    dtype = _default(dtype)
+    return Tensor(jnp.eye(int(num_rows),
+                          int(num_columns) if num_columns else None,
+                          dtype=to_jax_dtype(dtype)))
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    return apply("diag", x, offset=offset, padding_value=padding_value)
+
+
+def diagflat(x, offset=0, name=None):
+    flat = x.numpy().reshape(-1) if isinstance(x, Tensor) else np.ravel(x)
+    return Tensor(jnp.diagflat(jnp.asarray(flat), k=offset))
+
+
+def assign(x, output=None):
+    out = apply("assign", x)
+    if output is not None:
+        output.set_value(out)
+        return output
+    return out
+
+
+def clone(x, name=None):
+    return x.clone()
+
+
+def tril(x, diagonal=0, name=None):
+    return apply("tril", x, diagonal=diagonal)
+
+
+def triu(x, diagonal=0, name=None):
+    return apply("triu", x, diagonal=diagonal)
+
+
+def meshgrid(*args, **kwargs):
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = tuple(args[0])
+    return list(apply("meshgrid", *args))
+
+
+def complex(real, imag, name=None):
+    from ..core.dispatch import apply as _apply
+
+    return Tensor(jnp.asarray(real.numpy() + 1j * imag.numpy()))
+
+
+def clone_detached(x):
+    return x.detach()

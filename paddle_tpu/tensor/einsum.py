@@ -1,0 +1,9 @@
+"""Einsum API (ref: python/paddle/tensor/einsum.py)."""
+
+from __future__ import annotations
+
+from ..core.dispatch import apply
+
+
+def einsum(equation, *operands):
+    return apply("einsum", *operands, equation=equation)

@@ -1,0 +1,88 @@
+"""Linalg API (ref: python/paddle/tensor/linalg.py + paddle.linalg)."""
+
+from __future__ import annotations
+
+from ..core.dispatch import apply
+
+
+def norm(x, p="fro", axis=None, keepdim=False, name=None):
+    if p == "fro":
+        return apply("frobenius_norm", x, axis=axis, keepdim=keepdim)
+    return apply("p_norm", x, porder=float(p), axis=axis, keepdim=keepdim)
+
+
+def dist(x, y, p=2, name=None):
+    return apply("p_norm", x - y, porder=float(p), axis=None, keepdim=False)
+
+
+def cond(x, p=None, name=None):
+    import jax.numpy as jnp
+
+    from ..core.tensor import Tensor
+
+    return Tensor(jnp.linalg.cond(x._value, p=p))
+
+
+def inv(x, name=None):
+    return apply("inverse", x)
+
+
+def cholesky(x, upper=False, name=None):
+    return apply("cholesky", x, upper=upper)
+
+
+def matrix_power(x, n, name=None):
+    return apply("matrix_power", x, n=n)
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return apply("matrix_rank", x, tol=tol, hermitian=hermitian)
+
+
+def svd(x, full_matrices=False, name=None):
+    return apply("svd", x, full_matrices=full_matrices)
+
+
+def qr(x, mode="reduced", name=None):
+    return apply("qr", x, mode=mode)
+
+
+def eigh(x, UPLO="L", name=None):
+    return apply("eigh", x, UPLO=UPLO)
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return apply("eigvalsh", x, UPLO=UPLO)
+
+
+def det(x, name=None):
+    return apply("det", x)
+
+
+def slogdet(x, name=None):
+    return apply("slogdet", x)
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return apply("pinv", x, rcond=rcond, hermitian=hermitian)
+
+
+def solve(x, y, name=None):
+    return apply("solve", x, y)
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False,
+                     name=None):
+    return apply("triangular_solve", x, y, upper=upper, transpose=transpose,
+                 unitriangular=unitriangular)
+
+
+def lstsq(x, y, rcond=None, name=None):
+    return apply("lstsq", x, y, rcond=rcond)
+
+
+def multi_dot(x, name=None):
+    out = x[0]
+    for m in x[1:]:
+        out = apply("matmul_v2", out, m)
+    return out

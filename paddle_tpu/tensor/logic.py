@@ -1,0 +1,57 @@
+"""Logic / comparison API (ref: python/paddle/tensor/logic.py)."""
+
+from __future__ import annotations
+
+from ..core.dispatch import apply
+
+
+def equal(x, y, name=None):
+    return apply("equal", x, y)
+
+
+def not_equal(x, y, name=None):
+    return apply("not_equal", x, y)
+
+
+def less_than(x, y, name=None):
+    return apply("less_than", x, y)
+
+
+def less_equal(x, y, name=None):
+    return apply("less_equal", x, y)
+
+
+def greater_than(x, y, name=None):
+    return apply("greater_than", x, y)
+
+
+def greater_equal(x, y, name=None):
+    return apply("greater_equal", x, y)
+
+
+def logical_and(x, y, out=None, name=None):
+    return apply("logical_and", x, y)
+
+
+def logical_or(x, y, out=None, name=None):
+    return apply("logical_or", x, y)
+
+
+def logical_xor(x, y, out=None, name=None):
+    return apply("logical_xor", x, y)
+
+
+def logical_not(x, out=None, name=None):
+    return apply("logical_not", x)
+
+
+def is_empty(x, name=None):
+    from .creation import to_tensor
+
+    return to_tensor(x.size == 0)
+
+
+def is_tensor(x):
+    from ..core.tensor import Tensor
+
+    return isinstance(x, Tensor)

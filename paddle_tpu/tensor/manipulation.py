@@ -1,0 +1,247 @@
+"""Manipulation API (ref: python/paddle/tensor/manipulation.py)."""
+
+from __future__ import annotations
+
+from ..core.dispatch import apply
+from ..core.tensor import Tensor
+
+
+def _shape_list(shape):
+    out = []
+    for s in shape:
+        out.append(int(s.item()) if isinstance(s, Tensor) else int(s))
+    return out
+
+
+def reshape(x, shape, name=None):
+    return apply("reshape", x, shape=_shape_list(shape))
+
+
+def reshape_(x, shape, name=None):
+    out = reshape(x, shape)
+    x._value = out._value
+    return x
+
+
+def transpose(x, perm, name=None):
+    return apply("transpose", x, perm=list(perm))
+
+
+def t(x, name=None):
+    if x.ndim < 2:
+        return x
+    return apply("transpose", x, perm=[1, 0])
+
+
+def moveaxis(x, source, destination, name=None):
+    return apply("moveaxis", x, source=source, destination=destination)
+
+
+def swapaxes(x, axis0, axis1, name=None):
+    return apply("swapaxes", x, axis0=axis0, axis1=axis1)
+
+
+def concat(x, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = axis.item()
+    return apply("concat", *x, axis=axis)
+
+
+def stack(x, axis=0, name=None):
+    return apply("stack", *x, axis=axis)
+
+
+def unstack(x, axis=0, num=None):
+    return list(apply("unstack", x, axis=axis, num=num))
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = axis.item()
+    return list(apply("split", x, num_or_sections=num_or_sections, axis=axis))
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def squeeze(x, axis=None, name=None):
+    return apply("squeeze", x, axis=axis)
+
+
+def unsqueeze(x, axis, name=None):
+    return apply("unsqueeze", x, axis=axis)
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    return apply("flatten", x, start_axis=start_axis, stop_axis=stop_axis)
+
+
+def expand(x, shape, name=None):
+    return apply("expand_v2", x, shape=_shape_list(shape))
+
+
+def expand_as(x, y, name=None):
+    return apply("expand_v2", x, shape=y.shape)
+
+
+def broadcast_to(x, shape, name=None):
+    return apply("broadcast_to", x, shape=_shape_list(shape))
+
+
+def broadcast_tensors(inputs, name=None):
+    import numpy as np
+
+    shapes = [t.shape for t in inputs]
+    out_shape = np.broadcast_shapes(*[tuple(s) for s in shapes])
+    return [broadcast_to(t, list(out_shape)) for t in inputs]
+
+
+def tile(x, repeat_times, name=None):
+    return apply("tile", x, repeat_times=_shape_list(repeat_times))
+
+
+def gather(x, index, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = axis.item()
+    return apply("gather", x, index, axis=axis)
+
+
+def gather_nd(x, index, name=None):
+    return apply("gather_nd", x, index)
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    return apply("scatter", x, index, updates, overwrite=overwrite)
+
+
+def scatter_(x, index, updates, overwrite=True, name=None):
+    out = scatter(x, index, updates, overwrite)
+    x._value = out._value
+    return x
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    return apply("scatter_nd_add", x, index, updates)
+
+
+def scatter_nd(index, updates, shape, name=None):
+    import jax.numpy as jnp
+
+    zeros = Tensor(jnp.zeros(_shape_list(shape),
+                             updates._value.dtype))
+    return apply("scatter_nd_add", zeros, index, updates)
+
+
+def index_select(x, index, axis=0, name=None):
+    return apply("index_select", x, index, axis=axis)
+
+
+def index_sample(x, index):
+    return apply("index_sample", x, index)
+
+
+def take_along_axis(arr, indices, axis, name=None):
+    return apply("take_along_axis", arr, indices, axis=axis)
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign", name=None):
+    return apply("put_along_axis", arr, indices, values, axis=axis,
+                 reduce=reduce)
+
+
+def roll(x, shifts, axis=None, name=None):
+    return apply("roll", x, shifts=shifts, axis=axis)
+
+
+def flip(x, axis, name=None):
+    return apply("flip", x, axis=axis)
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return apply("rot90", x, k=k, axes=axes)
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=False)
+    return apply("where", condition, x, y)
+
+
+def nonzero(x, as_tuple=False):
+    out = apply("nonzero", x)
+    if not as_tuple:
+        return out
+    return tuple(out[:, i] for i in range(out.shape[1]))
+
+
+def masked_select(x, mask, name=None):
+    return apply("masked_select", x, mask)
+
+
+def masked_fill(x, mask, value, name=None):
+    if isinstance(value, Tensor):
+        value = value.item()
+    return apply("masked_fill", x, mask, value=value)
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    res = apply("unique", x, return_index=return_index,
+                return_inverse=return_inverse, return_counts=return_counts,
+                axis=axis)
+    return res
+
+
+def flatten_(x, start_axis=0, stop_axis=-1, name=None):
+    out = flatten(x, start_axis, stop_axis)
+    x._value = out._value
+    return x
+
+
+def slice(input, axes, starts, ends):
+    return apply("slice_op", input, axes=list(axes),
+                 starts=_shape_list(starts), ends=_shape_list(ends))
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    return apply("strided_slice", x, axes=list(axes),
+                 starts=_shape_list(starts), ends=_shape_list(ends),
+                 strides=_shape_list(strides))
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    if isinstance(repeats, Tensor):
+        repeats = repeats._value
+    return apply("repeat_interleave", x, repeats=repeats, axis=axis)
+
+
+def as_complex(x, name=None):
+    return apply("as_complex", x)
+
+
+def as_real(x, name=None):
+    return apply("as_real", x)
+
+
+def cast(x, dtype):
+    return x.astype(dtype)
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    shape = _shape_list(shape)
+    offsets = _shape_list(offsets) if offsets is not None else [0] * x.ndim
+    axes = list(range(x.ndim))
+    ends = [o + s for o, s in zip(offsets, shape)]
+    return apply("slice_op", x, axes=axes, starts=offsets, ends=ends)
+
+
+def diag_embed(input, offset=0, dim1=-2, dim2=-1):
+    return apply("diag_embed", input, offset=offset, dim1=dim1, dim2=dim2)
+
+
+def tensordot(x, y, axes=2, name=None):
+    if isinstance(axes, (list, tuple)):
+        axes = tuple(tuple(a) if isinstance(a, (list, tuple)) else a
+                     for a in axes)
+    return apply("tensordot", x, y, axes=axes)

@@ -1,0 +1,246 @@
+"""Math API (ref: python/paddle/tensor/math.py). Thin wrappers over the op
+registry; autograd/AMP handled in core.dispatch."""
+
+from __future__ import annotations
+
+import sys
+
+from ..core.dispatch import apply
+
+_this = sys.modules[__name__]
+
+# simple unary: api name -> op name
+_UNARY = {
+    "exp": "exp", "expm1": "expm1", "log": "log", "log2": "log2",
+    "log10": "log10", "log1p": "log1p", "sqrt": "sqrt", "square": "square",
+    "rsqrt": "rsqrt", "abs": "abs", "ceil": "ceil", "floor": "floor",
+    "round": "round", "trunc": "trunc", "frac": "frac",
+    "reciprocal": "reciprocal", "neg": "neg", "sign": "sign",
+    "sin": "sin", "cos": "cos", "tan": "tan", "asin": "asin",
+    "acos": "acos", "atan": "atan", "sinh": "sinh", "cosh": "cosh",
+    "tanh": "tanh", "asinh": "asinh", "acosh": "acosh", "atanh": "atanh",
+    "erf": "erf", "erfinv": "erfinv", "digamma": "digamma",
+    "lgamma": "lgamma", "i0": "i0", "angle": "angle", "conj": "conj",
+    "real": "real", "imag": "imag",
+}
+
+for _api, _op in _UNARY.items():
+    def _make(op):
+        def f(x, name=None):
+            return apply(op, x)
+        return f
+    _f = _make(_op)
+    _f.__name__ = _api
+    setattr(_this, _api, _f)
+
+_BINARY = {
+    "add": "elementwise_add", "subtract": "elementwise_sub",
+    "multiply": "elementwise_mul", "divide": "elementwise_div",
+    "floor_divide": "elementwise_floordiv", "mod": "elementwise_mod",
+    "remainder": "remainder", "floor_mod": "elementwise_mod",
+    "maximum": "elementwise_max", "minimum": "elementwise_min",
+    "fmax": "fmax", "fmin": "fmin", "atan2": "atan2",
+    "nextafter": "nextafter", "logaddexp": "logaddexp",
+    "heaviside": "elementwise_heaviside",
+}
+
+for _api, _op in _BINARY.items():
+    def _make2(op):
+        def f(x, y, name=None):
+            return apply(op, x, y)
+        return f
+    _f = _make2(_op)
+    _f.__name__ = _api
+    setattr(_this, _api, _f)
+
+
+def pow(x, y, name=None):
+    if isinstance(y, (int, float)):
+        return apply("pow", x, factor=float(y))
+    return apply("elementwise_pow", x, y)
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    out = apply("scale", x, scale=float(scale), bias=float(bias),
+                bias_after_scale=bias_after_scale)
+    if act is not None:
+        out = apply(act, out)
+    return out
+
+
+def clip(x, min=None, max=None, name=None):
+    def _v(v):
+        from ..core.tensor import Tensor
+        return v.item() if isinstance(v, Tensor) else v
+    return apply("clip", x, min=_v(min), max=_v(max))
+
+
+def lerp(x, y, weight, name=None):
+    return apply("lerp", x, y, weight)
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return apply("stanh", x, scale_a=scale_a, scale_b=scale_b)
+
+
+# -- matmul family ---------------------------------------------------------
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    return apply("matmul_v2", x, y, trans_x=transpose_x, trans_y=transpose_y)
+
+
+def mm(x, y, name=None):
+    return apply("matmul_v2", x, y)
+
+
+def bmm(x, y, name=None):
+    return apply("bmm", x, y)
+
+
+def addmm(input, x, y, alpha=1.0, beta=1.0, name=None):
+    return apply("addmm", input, x, y, alpha=alpha, beta=beta)
+
+
+def dot(x, y, name=None):
+    return apply("dot", x, y)
+
+
+def outer(x, y, name=None):
+    return apply("outer", x, y)
+
+
+def cross(x, y, axis=None, name=None):
+    return apply("cross", x, y, axis=axis)
+
+
+def kron(x, y, name=None):
+    return apply("kron", x, y)
+
+
+def inner(x, y, name=None):
+    return apply("matmul_v2", x, y, trans_x=False, trans_y=True)
+
+
+def multiply_(x, y):
+    out = apply("elementwise_mul", x, y)
+    x._value = out._value
+    return x
+
+
+# -- reductions ------------------------------------------------------------
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    out = apply("reduce_sum", x, axis=axis, keepdim=keepdim)
+    if dtype is not None:
+        out = out.astype(dtype)
+    return out
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    return apply("reduce_mean", x, axis=axis, keepdim=keepdim)
+
+
+def max(x, axis=None, keepdim=False, name=None):
+    return apply("reduce_max", x, axis=axis, keepdim=keepdim)
+
+
+def min(x, axis=None, keepdim=False, name=None):
+    return apply("reduce_min", x, axis=axis, keepdim=keepdim)
+
+
+def prod(x, axis=None, keepdim=False, dtype=None, name=None):
+    out = apply("reduce_prod", x, axis=axis, keepdim=keepdim)
+    if dtype is not None:
+        out = out.astype(dtype)
+    return out
+
+
+def amax(x, axis=None, keepdim=False, name=None):
+    return apply("amax", x, axis=axis, keepdim=keepdim)
+
+
+def amin(x, axis=None, keepdim=False, name=None):
+    return apply("amin", x, axis=axis, keepdim=keepdim)
+
+
+def any(x, axis=None, keepdim=False, name=None):
+    return apply("reduce_any", x, axis=axis, keepdim=keepdim)
+
+
+def all(x, axis=None, keepdim=False, name=None):
+    return apply("reduce_all", x, axis=axis, keepdim=keepdim)
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    return apply("logsumexp", x, axis=axis, keepdim=keepdim)
+
+
+def nansum(x, axis=None, dtype=None, keepdim=False, name=None):
+    out = apply("nansum", x, axis=axis, keepdim=keepdim)
+    return out.astype(dtype) if dtype is not None else out
+
+
+def nanmean(x, axis=None, keepdim=False, name=None):
+    return apply("nanmean", x, axis=axis, keepdim=keepdim)
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    return apply("count_nonzero", x, axis=axis, keepdim=keepdim)
+
+
+# -- cumulative ------------------------------------------------------------
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    out = apply("cumsum", x, axis=axis)
+    return out.astype(dtype) if dtype is not None else out
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    out = apply("cumprod", x, dim=dim)
+    return out.astype(dtype) if dtype is not None else out
+
+
+def logcumsumexp(x, axis=None, name=None):
+    return apply("logcumsumexp", x, axis=axis)
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply("trace_op", x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply("diagonal", x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+def increment(x, value=1.0, name=None):
+    out = apply("scale", x, scale=1.0, bias=float(value))
+    x._value = out._value
+    return x
+
+
+def isnan(x, name=None):
+    return apply("isnan", x)
+
+
+def isinf(x, name=None):
+    return apply("isinf", x)
+
+
+def isfinite(x, name=None):
+    return apply("isfinite", x)
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return apply("isclose", x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return apply("reduce_all", apply("isclose", x, y, rtol=rtol, atol=atol,
+                                     equal_nan=equal_nan))
+
+
+def equal_all(x, y, name=None):
+    return apply("reduce_all", apply("equal", x, y))

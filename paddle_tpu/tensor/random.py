@@ -1,0 +1,88 @@
+"""Random tensor API (ref: python/paddle/tensor/random.py). Keys thread
+through paddle_tpu.framework.random (works both eagerly and under jit
+capture via rng_scope)."""
+
+from __future__ import annotations
+
+from ..core import config
+from ..core.dispatch import apply
+from ..core.tensor import Tensor
+from ..framework import random as _random
+
+
+def _key():
+    return Tensor(_random.next_key())
+
+
+def _shape_list(shape):
+    return [int(s.item()) if isinstance(s, Tensor) else int(s)
+            for s in shape]
+
+
+def randn(shape, dtype=None, name=None):
+    dtype = dtype or config.get_default_dtype()
+    return apply("gaussian_random", _key(), shape=_shape_list(shape),
+                 mean=0.0, std=1.0, dtype=dtype)
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        base = mean if isinstance(mean, Tensor) else std
+        noise = apply("normal_like", base, _key(), mean=0.0, std=1.0)
+        return mean + noise * std
+    shape = _shape_list(shape if shape is not None else [1])
+    return apply("gaussian_random", _key(), shape=shape, mean=float(mean),
+                 std=float(std), dtype=config.get_default_dtype())
+
+
+def rand(shape, dtype=None, name=None):
+    dtype = dtype or config.get_default_dtype()
+    return apply("uniform_random", _key(), shape=_shape_list(shape),
+                 min=0.0, max=1.0, dtype=dtype)
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    dtype = dtype or config.get_default_dtype()
+    return apply("uniform_random", _key(), shape=_shape_list(shape),
+                 min=float(min), max=float(max), dtype=dtype)
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
+    if high is None:
+        low, high = 0, low
+    return apply("randint", _key(), low=int(low), high=int(high),
+                 shape=_shape_list(shape), dtype=dtype)
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    if high is None:
+        low, high = 0, low
+    return apply("randint", _key(), low=int(low), high=int(high),
+                 shape=x.shape, dtype=dtype or x.dtype.name)
+
+
+def randperm(n, dtype="int64", name=None):
+    return apply("randperm", _key(), n=int(n), dtype=dtype)
+
+
+def bernoulli(x, name=None):
+    return apply("bernoulli", x, _key())
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    return apply("multinomial", x, _key(), num_samples=int(num_samples),
+                 replacement=replacement)
+
+
+def poisson(x, name=None):
+    return apply("poisson", x, _key())
+
+
+def standard_normal(shape, dtype=None, name=None):
+    return randn(shape, dtype, name)
+
+
+def exponential_(x, lam=1.0, name=None):
+    out = apply("exponential", x, _key(), lam=lam)
+    x._value = out._value
+    return x

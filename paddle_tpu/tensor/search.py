@@ -1,0 +1,55 @@
+"""Search/sort API (ref: python/paddle/tensor/search.py)."""
+
+from __future__ import annotations
+
+from ..core.dispatch import apply
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    return apply("arg_max", x, axis=axis, keepdim=keepdim, dtype=dtype)
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    return apply("arg_min", x, axis=axis, keepdim=keepdim, dtype=dtype)
+
+
+def argsort(x, axis=-1, descending=False, name=None):
+    return apply("argsort", x, axis=axis, descending=descending)
+
+
+def sort(x, axis=-1, descending=False, name=None):
+    vals, _ = apply("sort_op", x, axis=axis, descending=descending)
+    return vals
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True, name=None):
+    from ..core.tensor import Tensor
+
+    if isinstance(k, Tensor):
+        k = int(k.item())
+    vals, idx = apply("top_k_v2", x, k=k, axis=axis, largest=largest,
+                      sorted=sorted)
+    return vals, idx
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    return apply("kthvalue", x, k=k, axis=axis, keepdim=keepdim)
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    return apply("mode_op", x, axis=axis, keepdim=keepdim)
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False,
+                 name=None):
+    return apply("searchsorted", sorted_sequence, values,
+                 out_int32=out_int32, right=right)
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return apply("bucketize", x, sorted_sequence, out_int32=out_int32,
+                 right=right)
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    return apply("index_put", x, indices, value)

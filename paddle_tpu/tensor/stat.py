@@ -1,0 +1,37 @@
+"""Statistics API (ref: python/paddle/tensor/stat.py)."""
+
+from __future__ import annotations
+
+from ..core.dispatch import apply
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return apply("var", x, axis=axis, unbiased=unbiased, keepdim=keepdim)
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return apply("std", x, axis=axis, unbiased=unbiased, keepdim=keepdim)
+
+
+def median(x, axis=None, keepdim=False, name=None):
+    return apply("median", x, axis=axis, keepdim=keepdim)
+
+
+def quantile(x, q, axis=None, keepdim=False, name=None):
+    return apply("quantile", x, q=q, axis=axis, keepdim=keepdim)
+
+
+def numel(x, name=None):
+    from .creation import to_tensor
+
+    return to_tensor(x.size, dtype="int64")
+
+
+def histogram(input, bins=100, min=0, max=0, name=None):
+    return apply("histogram", input, bins=bins, min=min, max=max)
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    if weights is not None:
+        return apply("bincount", x, weights, minlength=minlength)
+    return apply("bincount", x, weights=None, minlength=minlength)

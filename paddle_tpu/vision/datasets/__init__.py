@@ -1,0 +1,150 @@
+"""Vision datasets (ref: python/paddle/vision/datasets/ — MNIST, CIFAR,
+FashionMNIST, Flowers).
+
+This environment has zero egress, so downloads are impossible: each
+dataset reads the standard file format when present under
+`~/.cache/paddle_tpu/<name>/` and otherwise falls back to a deterministic
+synthetic sample set with the right shapes/classes (`backend='synthetic'`),
+which is what the tests and smoke benchmarks use.
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+
+import numpy as np
+
+from ...io import Dataset
+
+_CACHE = os.path.expanduser("~/.cache/paddle_tpu")
+
+
+def _synthetic(n, image_shape, num_classes, seed):
+    rng = np.random.RandomState(seed)
+    images = rng.rand(n, *image_shape).astype(np.float32)
+    labels = rng.randint(0, num_classes, size=(n,)).astype(np.int64)
+    # inject a learnable signal: mean brightness correlates with the label
+    images += labels.reshape((-1,) + (1,) * len(image_shape)) / \
+        (2.0 * num_classes)
+    return images, labels
+
+
+class MNIST(Dataset):
+    """ref: python/paddle/vision/datasets/mnist.py."""
+
+    NUM_CLASSES = 10
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=True, backend=None):
+        self.mode = mode
+        self.transform = transform
+        base = os.path.join(_CACHE, "mnist")
+        prefix = "train" if mode == "train" else "t10k"
+        image_path = image_path or os.path.join(
+            base, f"{prefix}-images-idx3-ubyte.gz")
+        label_path = label_path or os.path.join(
+            base, f"{prefix}-labels-idx1-ubyte.gz")
+        if os.path.exists(image_path) and os.path.exists(label_path):
+            self.images, self.labels = self._load_idx(image_path, label_path)
+        else:
+            n = 2048 if mode == "train" else 512
+            self.images, self.labels = _synthetic(
+                n, (28, 28), self.NUM_CLASSES,
+                seed=42 if mode == "train" else 43)
+
+    @staticmethod
+    def _load_idx(image_path, label_path):
+        with gzip.open(image_path, "rb") as f:
+            magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+            images = np.frombuffer(f.read(), dtype=np.uint8).reshape(
+                n, rows, cols).astype(np.float32) / 255.0
+        with gzip.open(label_path, "rb") as f:
+            magic, n = struct.unpack(">II", f.read(8))
+            labels = np.frombuffer(f.read(), dtype=np.uint8).astype(np.int64)
+        return images, labels
+
+    def __getitem__(self, idx):
+        img = self.images[idx].reshape(1, 28, 28)
+        label = self.labels[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.asarray([label], dtype=np.int64)
+
+    def __len__(self):
+        return len(self.images)
+
+
+class FashionMNIST(MNIST):
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+
+
+class Cifar10(Dataset):
+    """ref: python/paddle/vision/datasets/cifar.py."""
+
+    NUM_CLASSES = 10
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        self.mode = mode
+        self.transform = transform
+        data_file = data_file or os.path.join(
+            _CACHE, "cifar", "cifar-10-python.tar.gz")
+        if os.path.exists(data_file):
+            self.images, self.labels = self._load_tar(data_file, mode)
+        else:
+            n = 2048 if mode == "train" else 512
+            self.images, self.labels = _synthetic(
+                n, (3, 32, 32), self.NUM_CLASSES,
+                seed=44 if mode == "train" else 45)
+
+    @staticmethod
+    def _load_tar(path, mode):
+        images, labels = [], []
+        want = "data_batch" if mode == "train" else "test_batch"
+        with tarfile.open(path) as tf:
+            for member in tf.getmembers():
+                if want in member.name:
+                    d = pickle.load(tf.extractfile(member),
+                                    encoding="bytes")
+                    images.append(d[b"data"].reshape(-1, 3, 32, 32))
+                    labels.extend(d[b"labels"])
+        images = np.concatenate(images).astype(np.float32) / 255.0
+        return images, np.asarray(labels, dtype=np.int64)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        label = self.labels[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.asarray([label], dtype=np.int64)
+
+    def __len__(self):
+        return len(self.images)
+
+
+class Cifar100(Cifar10):
+    NUM_CLASSES = 100
+
+
+class FakeData(Dataset):
+    """Deterministic synthetic dataset for tests/benchmarks."""
+
+    def __init__(self, size=1024, image_shape=(3, 224, 224),
+                 num_classes=1000, transform=None, seed=0):
+        self.images, self.labels = _synthetic(size, tuple(image_shape),
+                                              num_classes, seed)
+        self.transform = transform
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.asarray([self.labels[idx]], dtype=np.int64)
+
+    def __len__(self):
+        return len(self.images)

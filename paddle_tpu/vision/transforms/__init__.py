@@ -1,0 +1,153 @@
+"""Vision transforms (ref: python/paddle/vision/transforms/). Operate on
+numpy arrays (CHW float32) — the host-side preprocessing path."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = transforms
+
+    def __call__(self, img):
+        for t in self.transforms:
+            img = t(img)
+        return img
+
+
+class BaseTransform:
+    def __call__(self, img):
+        raise NotImplementedError
+
+
+class ToTensor(BaseTransform):
+    def __init__(self, data_format="CHW"):
+        self.data_format = data_format
+
+    def __call__(self, img):
+        arr = np.asarray(img, dtype=np.float32)
+        if arr.max() > 1.5:
+            arr = arr / 255.0
+        if arr.ndim == 2:
+            arr = arr[None]
+        elif arr.ndim == 3 and arr.shape[-1] in (1, 3, 4) and \
+                self.data_format == "CHW" and arr.shape[0] not in (1, 3, 4):
+            arr = arr.transpose(2, 0, 1)
+        return arr
+
+
+class Normalize(BaseTransform):
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False):
+        self.mean = np.asarray(mean, dtype=np.float32)
+        self.std = np.asarray(std, dtype=np.float32)
+        self.data_format = data_format
+
+    def __call__(self, img):
+        arr = np.asarray(img, dtype=np.float32)
+        mean = self.mean.reshape(-1, 1, 1) if self.data_format == "CHW" \
+            else self.mean
+        std = self.std.reshape(-1, 1, 1) if self.data_format == "CHW" \
+            else self.std
+        return (arr - mean) / std
+
+
+class Resize(BaseTransform):
+    def __init__(self, size, interpolation="bilinear"):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def __call__(self, img):
+        arr = np.asarray(img, dtype=np.float32)
+        chw = arr.ndim == 3 and arr.shape[0] in (1, 3, 4)
+        h_axis = 1 if chw else 0
+        in_h, in_w = arr.shape[h_axis], arr.shape[h_axis + 1]
+        out_h, out_w = self.size
+        ys = (np.arange(out_h) * (in_h / out_h)).astype(np.int64)
+        xs = (np.arange(out_w) * (in_w / out_w)).astype(np.int64)
+        if chw:
+            return arr[:, ys][:, :, xs]
+        return arr[ys][:, xs]
+
+
+class CenterCrop(BaseTransform):
+    def __init__(self, size):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def __call__(self, img):
+        arr = np.asarray(img)
+        chw = arr.ndim == 3 and arr.shape[0] in (1, 3, 4)
+        h_axis = 1 if chw else 0
+        h, w = arr.shape[h_axis], arr.shape[h_axis + 1]
+        th, tw = self.size
+        i = max((h - th) // 2, 0)
+        j = max((w - tw) // 2, 0)
+        if chw:
+            return arr[:, i:i + th, j:j + tw]
+        return arr[i:i + th, j:j + tw]
+
+
+class RandomCrop(BaseTransform):
+    def __init__(self, size, padding=None):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.padding = padding
+
+    def __call__(self, img):
+        arr = np.asarray(img)
+        chw = arr.ndim == 3 and arr.shape[0] in (1, 3, 4)
+        if self.padding:
+            p = self.padding
+            pads = [(0, 0), (p, p), (p, p)] if chw else [(p, p), (p, p)] + \
+                ([(0, 0)] if arr.ndim == 3 else [])
+            arr = np.pad(arr, pads)
+        h_axis = 1 if chw else 0
+        h, w = arr.shape[h_axis], arr.shape[h_axis + 1]
+        th, tw = self.size
+        i = np.random.randint(0, h - th + 1)
+        j = np.random.randint(0, w - tw + 1)
+        if chw:
+            return arr[:, i:i + th, j:j + tw]
+        return arr[i:i + th, j:j + tw]
+
+
+class RandomHorizontalFlip(BaseTransform):
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def __call__(self, img):
+        if np.random.rand() < self.prob:
+            arr = np.asarray(img)
+            return arr[..., ::-1].copy()
+        return img
+
+
+class RandomVerticalFlip(BaseTransform):
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def __call__(self, img):
+        if np.random.rand() < self.prob:
+            arr = np.asarray(img)
+            chw = arr.ndim == 3 and arr.shape[0] in (1, 3, 4)
+            axis = 1 if chw else 0
+            return np.flip(arr, axis=axis).copy()
+        return img
+
+
+class Transpose(BaseTransform):
+    def __init__(self, order=(2, 0, 1)):
+        self.order = order
+
+    def __call__(self, img):
+        return np.asarray(img).transpose(self.order)
+
+
+def to_tensor(img, data_format="CHW"):
+    return ToTensor(data_format)(img)
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    return Normalize(mean, std, data_format)(img)
+
+
+def resize(img, size, interpolation="bilinear"):
+    return Resize(size, interpolation)(img)

@@ -1,0 +1,101 @@
+"""OpTest harness — the conformance fixture.
+
+Ref parity: python/paddle/fluid/tests/unittests/op_test.py:270. Each op
+test declares op_type, inputs (numpy), attrs, and expected outputs
+(numpy-computed); `check_output` runs the registered op through dispatch
+on the CPU backend; `check_grad` compares the tape-autograd gradients with
+an independent `jax.grad` of the op's pure function AND (optionally)
+against centred finite differences.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.core.dispatch import apply
+from paddle_tpu.core.op_registry import lookup
+from paddle_tpu.core.tensor import Tensor
+
+
+class OpTest:
+    op_type: str = ""
+
+    def check_output(self, inputs, attrs, expected, rtol=1e-5, atol=1e-6):
+        tensors = [Tensor(v) for v in inputs]
+        out = apply(self.op_type, *tensors, **attrs)
+        outs = out if isinstance(out, tuple) else (out,)
+        expected = expected if isinstance(expected, (list, tuple)) \
+            else (expected,)
+        for got, exp in zip(outs, expected):
+            np.testing.assert_allclose(
+                np.asarray(got.numpy(), dtype=np.float64),
+                np.asarray(exp, dtype=np.float64), rtol=rtol, atol=atol,
+                err_msg=f"op {self.op_type} forward mismatch")
+        return outs
+
+    def check_grad(self, inputs, attrs, wrt=(0,), out_grad=None, rtol=1e-4,
+                   atol=1e-5, fd_check=False, fd_eps=1e-3, fd_rtol=5e-2):
+        opdef = lookup(self.op_type)
+
+        # 1) tape path
+        tensors = [Tensor(v, stop_gradient=(i not in wrt))
+                   for i, v in enumerate(inputs)]
+        out = apply(self.op_type, *tensors, **attrs)
+        first = out[0] if isinstance(out, tuple) else out
+        if out_grad is None:
+            seed = np.ones(first.shape, dtype=first.numpy().dtype)
+        else:
+            seed = np.asarray(out_grad)
+        first.backward(Tensor(seed))
+        tape_grads = [tensors[i].grad.numpy() for i in wrt]
+
+        # 2) reference: jax.grad of the pure function
+        def scalar_fn(*primals):
+            full = list(inputs)
+            for j, i in enumerate(wrt):
+                full[i] = primals[j]
+            o = opdef.fn(*[jnp.asarray(v) for v in full], **attrs)
+            if opdef.has_aux:
+                o = o[0]
+            if isinstance(o, tuple):
+                o = o[0]
+            return jnp.sum(o * jnp.asarray(seed))
+
+        ref_grads = jax.grad(scalar_fn, argnums=tuple(range(len(wrt))))(
+            *[jnp.asarray(inputs[i]) for i in wrt])
+        for tg, rg in zip(tape_grads, ref_grads):
+            np.testing.assert_allclose(
+                tg, np.asarray(rg), rtol=rtol, atol=atol,
+                err_msg=f"op {self.op_type} tape-vs-jax grad mismatch")
+
+        # 3) optional finite differences
+        if fd_check:
+            for j, i in enumerate(wrt):
+                x0 = np.asarray(inputs[i], dtype=np.float32)
+                fd = np.zeros_like(x0)
+                it = np.nditer(x0, flags=["multi_index"])
+                while not it.finished:
+                    idx = it.multi_index
+                    for sign in (+1, -1):
+                        xs = x0.copy()
+                        xs[idx] += sign * fd_eps
+                        full = list(inputs)
+                        full[i] = xs
+                        o = opdef.fn(*[jnp.asarray(v) for v in full],
+                                     **attrs)
+                        if opdef.has_aux:
+                            o = o[0]
+                        if isinstance(o, tuple):
+                            o = o[0]
+                        val = float(jnp.sum(o * jnp.asarray(seed)))
+                        fd[idx] += sign * val
+                    fd[idx] /= (2 * fd_eps)
+                    it.iternext()
+                np.testing.assert_allclose(
+                    tape_grads[j], fd, rtol=fd_rtol, atol=1e-2,
+                    err_msg=f"op {self.op_type} fd grad mismatch")
+        return tape_grads

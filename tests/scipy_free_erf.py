@@ -1,0 +1,12 @@
+"""numpy erf without scipy (Abramowitz-Stegun 7.1.26 is too inaccurate for
+tests; use the vectorised math.erf)."""
+
+import math
+
+import numpy as np
+
+_erf_vec = np.vectorize(math.erf)
+
+
+def erf_np(x):
+    return _erf_vec(np.asarray(x, dtype=np.float64))

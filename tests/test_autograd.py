@@ -1,0 +1,154 @@
+"""Autograd engine tests (ref: test_imperative_basic.py etc.)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_simple_chain():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = x * x + 3 * x
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [7.0])
+
+
+def test_grad_accumulation():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = (x * 2).sum() + (x * 3).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [5.0, 5.0])
+
+
+def test_backward_twice_accumulates():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = x * 2
+    y.backward(retain_graph=True)
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [4.0])
+
+
+def test_no_retain_raises():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = x * 2
+    y.backward()
+    with pytest.raises(RuntimeError):
+        y.backward()
+
+
+def test_stop_gradient_blocks():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = paddle.to_tensor([2.0])  # stop_gradient=True
+    z = x * y
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0])
+    assert y.grad is None
+
+
+def test_detach():
+    x = paddle.to_tensor([3.0], stop_gradient=False)
+    y = x * 2
+    d = y.detach()
+    z = d * x
+    z.backward()
+    # only the direct path x -> z counts (d is cut)
+    np.testing.assert_allclose(x.grad.numpy(), [6.0])
+
+
+def test_no_grad_context():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    with paddle.no_grad():
+        y = x * 5
+    assert y.stop_gradient
+    z = x * 2
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0])
+
+
+def test_paddle_grad_api():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = paddle.to_tensor([3.0], stop_gradient=False)
+    z = x * x * y
+    gx, gy = paddle.grad(z, [x, y])
+    np.testing.assert_allclose(gx.numpy(), [12.0])
+    np.testing.assert_allclose(gy.numpy(), [4.0])
+    # .grad must stay clean
+    assert x.grad is None and y.grad is None
+
+
+def test_grad_intermediate_tensor():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    h = x * 3
+    h.stop_gradient = False
+    z = h * h
+    (gh,) = paddle.grad(z, [h])
+    np.testing.assert_allclose(gh.numpy(), [12.0])
+
+
+def test_grad_unused_raises_and_allow():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    u = paddle.to_tensor([1.0], stop_gradient=False)
+    z = x * 2
+    with pytest.raises(RuntimeError):
+        paddle.grad(z, [u], retain_graph=True)
+    res = paddle.grad(z, [u], allow_unused=True)
+    assert res[0] is None
+
+
+def test_hook():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    seen = []
+
+    def hook(g):
+        seen.append(g.numpy().copy())
+        return g * 2
+
+    x.register_hook(hook)
+    (x * 3).backward()
+    np.testing.assert_allclose(seen[0], [3.0])
+    np.testing.assert_allclose(x.grad.numpy(), [6.0])
+
+
+def test_non_scalar_backward_needs_grad():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = x * 2
+    with pytest.raises(RuntimeError):
+        y.backward()
+    y.backward(paddle.to_tensor([1.0, 10.0]))
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 20.0])
+
+
+def test_multi_output_op_grad():
+    x = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3),
+                         stop_gradient=False)
+    parts = paddle.split(x, 3, axis=1)
+    loss = parts[0].sum() * 1 + parts[1].sum() * 2 + parts[2].sum() * 3
+    loss.backward()
+    np.testing.assert_allclose(x.grad.numpy(),
+                               [[1, 2, 3], [1, 2, 3]])
+
+
+def test_topk_aux_no_grad_crash():
+    x = paddle.to_tensor([[3.0, 1.0, 2.0]], stop_gradient=False)
+    vals, idx = paddle.topk(x, k=2)
+    vals.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [[1.0, 0.0, 1.0]])
+    np.testing.assert_array_equal(idx.numpy(), [[0, 2]])
+
+
+def test_getitem_grad():
+    x = paddle.to_tensor(np.ones((3, 3), np.float32), stop_gradient=False)
+    y = x[1]
+    y.sum().backward()
+    expected = np.zeros((3, 3))
+    expected[1] = 1
+    np.testing.assert_allclose(x.grad.numpy(), expected)
+
+
+def test_deep_chain_no_recursion():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = x
+    for _ in range(300):
+        y = y + 0.01
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [1.0])

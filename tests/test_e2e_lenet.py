@@ -1,0 +1,88 @@
+"""End-to-end ladder config 1: LeNet MNIST dygraph + compiled engine
+(ref test style: python/paddle/fluid/tests/book/test_recognize_digits.py —
+train to a loss threshold)."""
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.engine import Engine
+
+
+def _loader(n_batches=20, bs=64):
+    ds = paddle.vision.datasets.MNIST(mode="train")
+    return paddle.io.DataLoader(ds, batch_size=bs, shuffle=True,
+                                drop_last=True)
+
+
+def test_lenet_eager_loss_decreases():
+    paddle.seed(0)
+    model = paddle.vision.models.LeNet()
+    opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                parameters=model.parameters())
+    loss_fn = paddle.nn.CrossEntropyLoss()
+    losses = []
+    for i, (x, y) in enumerate(_loader()):
+        out = model(x)
+        loss = loss_fn(out, y.squeeze(-1))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.item()))
+        if i >= 15:
+            break
+    assert np.mean(losses[-3:]) < np.mean(losses[:3])
+
+
+def test_lenet_engine_matches_and_learns():
+    paddle.seed(0)
+    model = paddle.vision.models.LeNet()
+    opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                parameters=model.parameters())
+    loss_fn = paddle.nn.CrossEntropyLoss()
+    eng = Engine(model, opt, lambda out, y: loss_fn(out, y.squeeze(-1)))
+    losses = []
+    for i, (x, y) in enumerate(_loader()):
+        loss = eng.train_batch([x], [y])
+        losses.append(float(loss.item()))
+        if i >= 25:
+            break
+    assert np.mean(losses[-3:]) < np.mean(losses[:3])
+    # sync back and run eager eval
+    eng.sync_to_layer()
+    model.eval()
+    ds = paddle.vision.datasets.MNIST(mode="test")
+    x, y = paddle.io.default_collate_fn([ds[i] for i in range(128)])
+    pred = model(x).numpy().argmax(-1)
+    acc = (pred == y.numpy().squeeze(-1)).mean()
+    assert acc > 0.15  # synthetic data: above chance
+
+
+def test_hapi_model_fit():
+    paddle.seed(0)
+    model = paddle.Model(paddle.vision.models.LeNet())
+    opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                parameters=model.parameters())
+    loss_fn = paddle.nn.CrossEntropyLoss()
+    model.prepare(opt, lambda out, y: loss_fn(out, y.squeeze(-1)),
+                  paddle.metric.Accuracy())
+    train = paddle.vision.datasets.MNIST(mode="train")
+    model.fit(train, batch_size=64, epochs=1, num_iters=10, verbose=0)
+    res = model.evaluate(paddle.vision.datasets.MNIST(mode="test"),
+                         batch_size=64, verbose=0)
+    assert "eval_loss" in res and "eval_acc" in res
+
+
+def test_model_save_load(tmp_path):
+    model = paddle.Model(paddle.vision.models.LeNet())
+    opt = paddle.optimizer.Adam(parameters=model.parameters())
+    loss_fn = paddle.nn.CrossEntropyLoss()
+    model.prepare(opt, lambda out, y: loss_fn(out, y.squeeze(-1)))
+    path = str(tmp_path / "lenet")
+    model.save(path)
+    model2 = paddle.Model(paddle.vision.models.LeNet())
+    opt2 = paddle.optimizer.Adam(parameters=model2.parameters())
+    model2.prepare(opt2, lambda out, y: loss_fn(out, y.squeeze(-1)))
+    model2.load(path)
+    w1 = model.network.state_dict()["features.0.weight"].numpy()
+    w2 = model2.network.state_dict()["features.0.weight"].numpy()
+    np.testing.assert_allclose(w1, w2)

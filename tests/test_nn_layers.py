@@ -1,0 +1,183 @@
+"""nn.Layer system + layer forward tests (ref: test_layers.py)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+def test_linear_shapes_and_grad():
+    layer = nn.Linear(4, 3)
+    x = paddle.randn([2, 4])
+    out = layer(x)
+    assert out.shape == [2, 3]
+    out.sum().backward()
+    assert layer.weight.grad is not None
+    assert list(layer.weight.grad.shape) == [4, 3]
+    assert list(layer.bias.grad.shape) == [3]
+
+
+def test_parameters_traversal():
+    model = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    names = [n for n, _ in model.named_parameters()]
+    assert names == ["0.weight", "0.bias", "2.weight", "2.bias"]
+    assert len(model.parameters()) == 4
+
+
+def test_state_dict_roundtrip():
+    m1 = nn.Linear(3, 3)
+    m2 = nn.Linear(3, 3)
+    m2.set_state_dict(m1.state_dict())
+    np.testing.assert_allclose(m1.weight.numpy(), m2.weight.numpy())
+
+
+def test_train_eval_mode():
+    m = nn.Sequential(nn.Linear(2, 2), nn.Dropout(0.5))
+    assert m.training
+    m.eval()
+    assert not m[1].training
+    x = paddle.ones([4, 2])
+    np.testing.assert_allclose(m(x).numpy(), m(x).numpy())
+    m.train()
+    assert m[1].training
+
+
+def test_dropout_scales():
+    paddle.seed(7)
+    d = nn.Dropout(0.5)
+    x = paddle.ones([1000])
+    y = d(x)
+    kept = (y.numpy() != 0)
+    assert 0.35 < kept.mean() < 0.65
+    np.testing.assert_allclose(y.numpy()[kept], 2.0)
+
+
+def test_conv2d():
+    conv = nn.Conv2D(3, 8, 3, padding=1)
+    x = paddle.randn([2, 3, 16, 16])
+    out = conv(x)
+    assert out.shape == [2, 8, 16, 16]
+    out.mean().backward()
+    assert conv.weight.grad is not None
+
+
+def test_conv2d_vs_numpy():
+    conv = nn.Conv2D(1, 1, 3, padding=0, bias_attr=False)
+    w = np.arange(9, dtype=np.float32).reshape(1, 1, 3, 3)
+    conv.weight.set_value(w)
+    x = np.arange(25, dtype=np.float32).reshape(1, 1, 5, 5)
+    out = conv(paddle.to_tensor(x)).numpy()
+    # direct correlation
+    expected = np.zeros((1, 1, 3, 3), np.float32)
+    for i in range(3):
+        for j in range(3):
+            expected[0, 0, i, j] = (x[0, 0, i:i + 3, j:j + 3] *
+                                    w[0, 0]).sum()
+    np.testing.assert_allclose(out, expected, rtol=1e-5)
+
+
+def test_batchnorm_train_and_eval():
+    bn = nn.BatchNorm2D(4)
+    x = paddle.randn([8, 4, 5, 5]) * 3 + 1
+    out = bn(x)
+    got = out.numpy()
+    assert abs(got.mean()) < 1e-2
+    assert abs(got.std() - 1) < 1e-1
+    # running stats moved toward batch stats
+    assert not np.allclose(bn._mean.numpy(), 0)
+    bn.eval()
+    out2 = bn(x)
+    assert out2.shape == [8, 4, 5, 5]
+
+
+def test_layernorm_normalises():
+    ln = nn.LayerNorm(16)
+    x = paddle.randn([4, 16]) * 5 + 3
+    out = ln(x).numpy()
+    np.testing.assert_allclose(out.mean(axis=-1), 0, atol=1e-4)
+    np.testing.assert_allclose(out.std(axis=-1), 1, atol=1e-2)
+
+
+def test_embedding():
+    emb = nn.Embedding(10, 4)
+    ids = paddle.to_tensor(np.array([[1, 2], [3, 4]], np.int64))
+    out = emb(ids)
+    assert out.shape == [2, 2, 4]
+    out.sum().backward()
+    g = emb.weight.grad.numpy()
+    assert g[1].sum() != 0 and g[0].sum() == 0
+
+
+def test_maxpool_avgpool():
+    x = paddle.to_tensor(
+        np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+    mp = nn.MaxPool2D(2, 2)(x)
+    np.testing.assert_allclose(mp.numpy().squeeze(),
+                               [[5, 7], [13, 15]])
+    ap = nn.AvgPool2D(2, 2)(x)
+    np.testing.assert_allclose(ap.numpy().squeeze(),
+                               [[2.5, 4.5], [10.5, 12.5]])
+
+
+def test_sequential_and_layerlist():
+    seq = nn.Sequential(nn.Linear(2, 4), nn.ReLU(), nn.Linear(4, 2))
+    assert len(seq) == 3
+    ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+    ll.append(nn.Linear(2, 2))
+    assert len(ll) == 4
+    x = paddle.randn([1, 2])
+    for l in ll:
+        x = l(x)
+    assert x.shape == [1, 2]
+
+
+def test_hooks():
+    lin = nn.Linear(2, 2)
+    calls = []
+    h = lin.register_forward_post_hook(
+        lambda layer, inp, out: calls.append(1))
+    lin(paddle.randn([1, 2]))
+    assert calls == [1]
+    h.remove()
+    lin(paddle.randn([1, 2]))
+    assert calls == [1]
+
+
+def test_multihead_attention():
+    mha = nn.MultiHeadAttention(16, 4)
+    x = paddle.randn([2, 5, 16])
+    out = mha(x, x, x)
+    assert out.shape == [2, 5, 16]
+    out.mean().backward()
+    assert mha.q_proj.weight.grad is not None
+
+
+def test_transformer_encoder():
+    layer = nn.TransformerEncoderLayer(16, 4, 32, dropout=0.0)
+    enc = nn.TransformerEncoder(layer, 2)
+    x = paddle.randn([2, 6, 16])
+    out = enc(x)
+    assert out.shape == [2, 6, 16]
+
+
+def test_losses():
+    logits = paddle.randn([4, 10], dtype="float32")
+    labels = paddle.to_tensor(np.array([1, 2, 3, 4], np.int64))
+    ce = nn.CrossEntropyLoss()(logits, labels)
+    assert ce.shape == []
+    lp = paddle.nn.functional.log_softmax(logits, -1).numpy()
+    expected = -lp[np.arange(4), [1, 2, 3, 4]].mean()
+    np.testing.assert_allclose(float(ce.item()), expected, rtol=1e-5)
+
+    mse = nn.MSELoss()(paddle.ones([3]), paddle.zeros([3]))
+    np.testing.assert_allclose(float(mse.item()), 1.0)
+
+
+def test_initializers():
+    from paddle_tpu.nn import initializer as I
+
+    w = I.XavierNormal()([100, 100])
+    assert abs(float(np.asarray(w).std()) - float(np.sqrt(2 / 200))) < 0.01
+    c = I.Constant(3.0)([5])
+    np.testing.assert_allclose(np.asarray(c), 3.0)

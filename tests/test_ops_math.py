@@ -1,0 +1,233 @@
+"""Op conformance tests: math ops vs numpy (ref test style:
+python/paddle/fluid/tests/unittests/test_elementwise_add_op.py etc.)."""
+
+import numpy as np
+import pytest
+
+from op_test import OpTest
+
+
+def _rand(*shape, seed=0):
+    rng = np.random.RandomState(seed)
+    return rng.rand(*shape).astype(np.float32) + 0.1
+
+
+class TestElementwiseAdd(OpTest):
+    op_type = "elementwise_add"
+
+    def test_forward_backward(self):
+        x, y = _rand(3, 4), _rand(3, 4, seed=1)
+        self.check_output([x, y], {}, x + y)
+        self.check_grad([x, y], {}, wrt=(0, 1), fd_check=True)
+
+    def test_broadcast(self):
+        x, y = _rand(3, 4), _rand(4, seed=1)
+        self.check_output([x, y], {}, x + y)
+        self.check_grad([x, y], {}, wrt=(0, 1))
+
+    def test_axis_broadcast(self):
+        x, y = _rand(2, 3, 4), _rand(3, seed=1)
+        self.check_output([x, y], {"axis": 1}, x + y.reshape(1, 3, 1))
+
+
+class TestElementwiseMul(OpTest):
+    op_type = "elementwise_mul"
+
+    def test_forward_backward(self):
+        x, y = _rand(3, 4), _rand(3, 4, seed=1)
+        self.check_output([x, y], {}, x * y)
+        self.check_grad([x, y], {}, wrt=(0, 1), fd_check=True)
+
+
+class TestElementwiseDiv(OpTest):
+    op_type = "elementwise_div"
+
+    def test_forward_backward(self):
+        x, y = _rand(3, 4), _rand(3, 4, seed=1) + 0.5
+        self.check_output([x, y], {}, x / y)
+        self.check_grad([x, y], {}, wrt=(0, 1))
+
+
+class TestMatmulV2(OpTest):
+    op_type = "matmul_v2"
+
+    def test_2d(self):
+        x, y = _rand(3, 4), _rand(4, 5, seed=1)
+        self.check_output([x, y], {}, x @ y)
+        self.check_grad([x, y], {}, wrt=(0, 1), fd_check=True)
+
+    def test_transpose(self):
+        x, y = _rand(4, 3), _rand(4, 5, seed=1)
+        self.check_output([x, y], {"trans_x": True}, x.T @ y)
+        self.check_grad([x, y], {"trans_x": True}, wrt=(0, 1))
+
+    def test_batched(self):
+        x, y = _rand(2, 3, 4), _rand(2, 4, 5, seed=1)
+        self.check_output([x, y], {}, np.matmul(x, y))
+        self.check_grad([x, y], {}, wrt=(0, 1))
+
+
+class TestExp(OpTest):
+    op_type = "exp"
+
+    def test(self):
+        x = _rand(3, 4)
+        self.check_output([x], {}, np.exp(x))
+        self.check_grad([x], {}, fd_check=True)
+
+
+class TestTanh(OpTest):
+    op_type = "tanh"
+
+    def test(self):
+        x = _rand(3, 4)
+        self.check_output([x], {}, np.tanh(x))
+        self.check_grad([x], {})
+
+
+class TestSigmoid(OpTest):
+    op_type = "sigmoid"
+
+    def test(self):
+        x = _rand(3, 4)
+        self.check_output([x], {}, 1 / (1 + np.exp(-x)))
+        self.check_grad([x], {})
+
+
+class TestRelu(OpTest):
+    op_type = "relu"
+
+    def test(self):
+        x = _rand(3, 4) - 0.5
+        self.check_output([x], {}, np.maximum(x, 0))
+        self.check_grad([x], {})
+
+
+class TestGelu(OpTest):
+    op_type = "gelu"
+
+    def test(self):
+        from scipy_free_erf import erf_np
+
+        x = _rand(3, 4) - 0.5
+        expected = x * 0.5 * (1 + erf_np(x / np.sqrt(2)))
+        self.check_output([x], {}, expected, rtol=1e-4)
+        self.check_grad([x], {})
+
+
+class TestScale(OpTest):
+    op_type = "scale"
+
+    def test(self):
+        x = _rand(3, 4)
+        self.check_output([x], {"scale": 2.0, "bias": 1.0}, 2 * x + 1)
+        self.check_grad([x], {"scale": 2.0, "bias": 1.0}, fd_check=True)
+
+
+class TestReduceSum(OpTest):
+    op_type = "reduce_sum"
+
+    def test_all(self):
+        x = _rand(3, 4)
+        self.check_output([x], {}, x.sum())
+        self.check_grad([x], {}, fd_check=True)
+
+    def test_axis_keepdim(self):
+        x = _rand(3, 4, 5)
+        self.check_output([x], {"axis": [1], "keepdim": True},
+                          x.sum(axis=1, keepdims=True))
+        self.check_grad([x], {"axis": [1], "keepdim": True})
+
+
+class TestReduceMean(OpTest):
+    op_type = "reduce_mean"
+
+    def test(self):
+        x = _rand(3, 4)
+        self.check_output([x], {"axis": 0}, x.mean(axis=0))
+        self.check_grad([x], {"axis": 0})
+
+
+class TestReduceMax(OpTest):
+    op_type = "reduce_max"
+
+    def test(self):
+        x = _rand(3, 4)
+        self.check_output([x], {"axis": 1}, x.max(axis=1))
+        self.check_grad([x], {"axis": 1})
+
+
+class TestPow(OpTest):
+    op_type = "pow"
+
+    def test(self):
+        x = _rand(3, 4)
+        self.check_output([x], {"factor": 3.0}, x ** 3)
+        self.check_grad([x], {"factor": 3.0})
+
+
+class TestClip(OpTest):
+    op_type = "clip"
+
+    def test(self):
+        x = _rand(3, 4)
+        self.check_output([x], {"min": 0.3, "max": 0.7},
+                          np.clip(x, 0.3, 0.7))
+        self.check_grad([x], {"min": 0.3, "max": 0.7})
+
+
+class TestCumsum(OpTest):
+    op_type = "cumsum"
+
+    def test(self):
+        x = _rand(3, 4)
+        self.check_output([x], {"axis": 1}, np.cumsum(x, axis=1))
+        self.check_grad([x], {"axis": 1})
+
+    def test_flatten(self):
+        x = _rand(3, 4)
+        self.check_output([x], {}, np.cumsum(x))
+
+
+class TestLogsumexp(OpTest):
+    op_type = "logsumexp"
+
+    def test(self):
+        x = _rand(3, 4)
+        m = x.max(axis=1, keepdims=True)
+        expected = (np.log(np.exp(x - m).sum(axis=1, keepdims=True)) +
+                    m).squeeze(1)
+        self.check_output([x], {"axis": 1}, expected, rtol=1e-4)
+        self.check_grad([x], {"axis": 1})
+
+
+class TestEinsum(OpTest):
+    op_type = "einsum"
+
+    def test(self):
+        x, y = _rand(3, 4), _rand(4, 5, seed=1)
+        self.check_output([x, y], {"equation": "ij,jk->ik"}, x @ y)
+        self.check_grad([x, y], {"equation": "ij,jk->ik"}, wrt=(0, 1))
+
+
+class TestComparisons:
+    def test_comparisons(self):
+        import paddle_tpu as paddle
+
+        x = paddle.to_tensor([1.0, 2.0, 3.0])
+        y = paddle.to_tensor([2.0, 2.0, 2.0])
+        np.testing.assert_array_equal((x < y).numpy(), [True, False, False])
+        np.testing.assert_array_equal((x == y).numpy(),
+                                      [False, True, False])
+        np.testing.assert_array_equal((x >= y).numpy(),
+                                      [False, True, True])
+
+    def test_logical(self):
+        import paddle_tpu as paddle
+
+        a = paddle.to_tensor([True, False, True])
+        b = paddle.to_tensor([True, True, False])
+        np.testing.assert_array_equal(
+            paddle.logical_and(a, b).numpy(), [True, False, False])
+        np.testing.assert_array_equal(
+            paddle.logical_not(a).numpy(), [False, True, False])
