@@ -41,6 +41,14 @@ def shard_hint(x, *spec):
         return x
     from jax.sharding import NamedSharding
 
+    # inside shard_map (e.g. the pipeline's manual 'pp' region) the trace
+    # carries an abstract mesh; constraints must be built on it
+    try:
+        am = jax.sharding.get_abstract_mesh()
+        if am is not None and am.axis_names:
+            mesh = am
+    except (AttributeError, RuntimeError):
+        pass
     constrained = jax.lax.with_sharding_constraint(
         v, NamedSharding(mesh, P(*spec)))
     if isinstance(x, Tensor):

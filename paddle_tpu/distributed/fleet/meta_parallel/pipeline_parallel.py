@@ -80,10 +80,13 @@ def pipeline_spmd(stage_fn, mesh, *, num_stages, num_micro):
             jnp.where(stage == num_stages - 1, outputs, 0.0), PP_AXIS)
         return outputs
 
+    # manual only over 'pp': dp/mp/sharding stay GSPMD-auto inside the
+    # stage body, so TP sharding constraints and batch sharding compose
     return jax.shard_map(
         per_device, mesh=mesh,
         in_specs=(P(PP_AXIS), P()),
         out_specs=P(),
+        axis_names={PP_AXIS},
         check_vma=False)
 
 

@@ -1,0 +1,318 @@
+"""Hybrid-parallel (dp x mp x pp x sharding) compiled training for
+uniform-decoder transformers — the flagship path for the ladder's ERNIE
+sharding and GPT-3 hybrid configs.
+
+Ref parity: the composition the reference reaches with
+HybridCommunicateGroup + PipelineLayer + 1F1B SectionWorker + megatron TP
+layers + DygraphShardingOptimizer (python/paddle/distributed/fleet/
+meta_parallel/*, paddle/fluid/framework/section_worker.cc). Here the whole
+thing is ONE jitted XLA program:
+
+- dp: global batch sharded over 'dp' (GSPMD inserts grad all-reduce)
+- mp: megatron TP via Parameter.param_spec on qkv/mlp weights (GSPMD
+  inserts the per-block all-reduces), vocab-sharded embedding + loss
+- pp: transformer blocks stacked [L, ...] -> reshaped [S, L/S, ...],
+  leading axis sharded over 'pp'; a scan+ppermute collective-permute
+  pipeline (meta_parallel.pipeline_parallel.pipeline_spmd) runs the
+  micro-batch schedule; jax AD produces the reverse pipeline
+- sharding (ZeRO): optimizer moments sharded over the 'sharding' axis via
+  out_shardings on the optimizer state tree
+"""
+
+from __future__ import annotations
+
+import re
+from collections import OrderedDict
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..core.tensor import Tensor
+from ..engine import _swap_state, _unwrap, param_specs
+from ..framework import random as _random
+from .topology import DP_AXIS, MP_AXIS, PP_AXIS, SHARDING_AXIS
+from .fleet.meta_parallel.pipeline_parallel import pipeline_spmd
+
+
+def split_uniform_params(layer, block_prefix_re):
+    """Split state into (stacked block params, other params).
+
+    block_prefix_re: regex with one group for the layer index, e.g.
+    r"gpt\\.layers\\.(\\d+)\\.(.*)"  -> stacked under key group(2).
+    Returns (stacked: dict name -> [L, ...] array, rest: dict, num_layers).
+    """
+    pat = re.compile(block_prefix_re)
+    per_layer = {}
+    rest = {}
+    for name, t in layer.state_dict().items():
+        m = pat.match(name)
+        if m:
+            idx, sub = int(m.group(1)), m.group(2)
+            per_layer.setdefault(sub, {})[idx] = t._value
+        else:
+            rest[name] = t._value
+    num_layers = 0
+    stacked = {}
+    for sub, by_idx in per_layer.items():
+        num_layers = max(num_layers, max(by_idx) + 1)
+        stacked[sub] = jnp.stack([by_idx[i] for i in sorted(by_idx)])
+    return stacked, rest, num_layers
+
+
+def _block_spec_map(template_block):
+    """param name (relative to one block) -> PartitionSpec or None."""
+    return param_specs(template_block)
+
+
+class HybridParallelEngine:
+    """Compiled hybrid training for GPT/ERNIE-style models.
+
+    The model must expose: `embeddings_forward(values, ids, key)`,
+    uniform `layers` (indexable), and `head_forward(values, h, labels,
+    key)` -> scalar loss. Adapters below provide these for the nlp models.
+    """
+
+    def __init__(self, model, criterion, optimizer, hcg, *,
+                 block_regex, template_block, embed_fn, head_fn,
+                 accumulate_steps=1, zero_stage=0):
+        self.model = model
+        self.criterion = criterion
+        self.optimizer = optimizer
+        self.hcg = hcg
+        self.mesh = hcg.get_mesh()
+        self.accumulate_steps = accumulate_steps
+        self.zero_stage = zero_stage
+        self.block_regex = block_regex
+        self.template_block = template_block
+        self.embed_fn = embed_fn
+        self.head_fn = head_fn
+
+        stacked, rest, L = split_uniform_params(model, block_regex)
+        self.num_layers = L
+        S = hcg.get_pipe_parallel_world_size()
+        assert L % S == 0, f"num_layers {L} % pp {S} != 0"
+        self.pp = S
+        self.layers_per_stage = L // S
+        # [L, ...] -> [S, L/S, ...]
+        self.block_params = {
+            k: v.reshape((S, L // S) + v.shape[1:])
+            for k, v in stacked.items()}
+        # trainable vs frozen split of the rest
+        specs = param_specs(model)
+        self.rest_params = {
+            k: v for k, v in rest.items() if k in specs}
+        self.rest_buffers = {
+            k: v for k, v in rest.items() if k not in specs}
+        self.opt_state = {
+            "blocks": {k: self.optimizer._init_state(v)
+                       for k, v in self.block_params.items()},
+            "rest": {k: self.optimizer._init_state(v)
+                     for k, v in self.rest_params.items()},
+        }
+        self._step_fn = None
+        self._shardings = self._build_shardings(specs)
+
+    # -- sharding specs ------------------------------------------------------
+    def _block_leaf_spec(self, name, arr):
+        bspecs = _block_spec_map(self.template_block)
+        inner = bspecs.get(name)
+        if inner is None:
+            inner = P(*([None] * (arr.ndim - 2)))
+        return P(PP_AXIS, None, *tuple(inner))
+
+    def _opt_leaf_spec(self, pspec, arr, stacked):
+        # moments follow the param sharding; scalars replicate
+        if arr.ndim == 0:
+            return P()
+        if self.zero_stage >= 1 and self.mesh.shape.get(SHARDING_AXIS,
+                                                        1) > 1:
+            # shard the first non-pp dim over 'sharding' when divisible
+            spec = list(pspec) if pspec is not None else \
+                [None] * arr.ndim
+            spec += [None] * (arr.ndim - len(spec))
+            for i, s in enumerate(spec):
+                if s is None and arr.shape[i] % \
+                        self.mesh.shape[SHARDING_AXIS] == 0 and \
+                        arr.shape[i] > 1:
+                    spec[i] = SHARDING_AXIS
+                    break
+            return P(*spec)
+        if pspec is not None:
+            spec = list(pspec) + [None] * (arr.ndim - len(pspec))
+            return P(*spec)
+        return P(*([None] * arr.ndim))
+
+    def _build_shardings(self, specs):
+        mesh = self.mesh
+
+        def ns(spec):
+            return NamedSharding(mesh, spec)
+
+        block_sh = {k: ns(self._block_leaf_spec(k, v))
+                    for k, v in self.block_params.items()}
+        rest_sh = {}
+        for k, v in self.rest_params.items():
+            sp = specs.get(k)
+            rest_sh[k] = ns(sp if sp is not None else P())
+        buf_sh = {k: ns(P()) for k in self.rest_buffers}
+        opt_block_sh = {
+            k: jax.tree.map(
+                lambda a, kk=k: ns(self._opt_leaf_spec(
+                    tuple(self._block_leaf_spec(kk,
+                          self.block_params[kk])), a, True)), st)
+            for k, st in self.opt_state["blocks"].items()}
+        opt_rest_sh = {
+            k: jax.tree.map(
+                lambda a, kk=k: ns(self._opt_leaf_spec(
+                    specs.get(kk), a, False)), st)
+            for k, st in self.opt_state["rest"].items()}
+        data_sh = ns(P(DP_AXIS))  # tokens [B, s]: batch dim over dp
+        return dict(blocks=block_sh, rest=rest_sh, buffers=buf_sh,
+                    opt=dict(blocks=opt_block_sh, rest=opt_rest_sh),
+                    data=data_sh, repl=ns(P()))
+
+    # -- the compiled step ---------------------------------------------------
+    def _build(self):
+        M = self.accumulate_steps
+        S = self.pp
+        Lps = self.layers_per_stage
+        template = self.template_block
+        embed_fn, head_fn = self.embed_fn, self.head_fn
+        mesh = self.mesh
+        opt = self.optimizer
+
+        def stage_fn(stage_params, x):
+            # stage_params leaves: [Lps, ...]; scan the blocks
+            def body(h, inp):
+                layer_params, idx = inp
+                with _random.rng_scope(
+                        jax.random.fold_in(_random.next_key(), idx)):
+                    with _swap_state(template, layer_params):
+                        out = template(Tensor(h))
+                return out._value if isinstance(out, Tensor) else out, None
+
+            h, _ = jax.lax.scan(body, x,
+                                (stage_params, jnp.arange(Lps)))
+            return h
+
+        pipeline = pipeline_spmd(stage_fn, mesh, num_stages=S,
+                                 num_micro=M)
+
+        def loss_of(block_params, rest_params, buffers, batch, key):
+            tokens, labels = batch
+            with _random.rng_scope(key):
+                values = {**buffers, **rest_params}
+                x = embed_fn(self.model, values, tokens)  # [B, s, h]
+                b, s, h = x.shape
+                x = x.reshape((M, b // M, s, h))
+                x = pipeline(block_params, x)
+                x = x.reshape((b, s, h))
+                loss = head_fn(self.model, values, x, labels)
+                return loss.astype(jnp.float32)
+
+        def step_fn(block_params, rest_params, buffers, opt_state, batch,
+                    lr, key):
+            loss, (gb, gr) = jax.value_and_grad(
+                loss_of, argnums=(0, 1))(block_params, rest_params,
+                                         buffers, batch, key)
+            gc = getattr(opt, "_grad_clip", None)
+            if gc is not None:
+                gb, gr = gc._clip_fn((gb, gr))
+            nb, ob = opt.apply_gradients_tree(block_params, gb,
+                                              opt_state["blocks"], lr)
+            nr, orr = opt.apply_gradients_tree(rest_params, gr,
+                                               opt_state["rest"], lr)
+            return loss, nb, nr, {"blocks": ob, "rest": orr}
+
+        sh = self._shardings
+        self._step_fn = jax.jit(
+            step_fn,
+            in_shardings=(sh["blocks"], sh["rest"], sh["buffers"],
+                          sh["opt"], (sh["data"], sh["data"]),
+                          sh["repl"], sh["repl"]),
+            out_shardings=(sh["repl"], sh["blocks"], sh["rest"],
+                           sh["opt"]),
+            donate_argnums=(0, 1, 3))
+
+    def train_batch(self, tokens, labels):
+        if self._step_fn is None:
+            self._build()
+        t = tokens._value if isinstance(tokens, Tensor) else \
+            jnp.asarray(tokens)
+        l = labels._value if isinstance(labels, Tensor) else \
+            jnp.asarray(labels)
+        key = _random.default_generator.next_key()
+        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+        loss, self.block_params, self.rest_params, self.opt_state = \
+            self._step_fn(self.block_params, self.rest_params,
+                          self.rest_buffers, self.opt_state, (t, l), lr,
+                          key)
+        return Tensor(loss)
+
+
+# -- adapters for the nlp model family --------------------------------------
+
+
+def values_sub(values, prefix):
+    return {k[len(prefix):]: v for k, v in values.items()
+            if k.startswith(prefix)}
+
+
+def make_gpt_hybrid_engine(model, criterion, optimizer, hcg, *,
+                           accumulate_steps=1, zero_stage=0):
+    from ..engine import functional_call
+
+    def embed_fn(m, values, tokens):
+        return functional_call(m.gpt.embeddings,
+                               values_sub(values, "gpt.embeddings."),
+                               Tensor(tokens))
+
+    def head_fn(m, values, h, labels):
+        fn_values = values_sub(values, "gpt.final_norm.")
+        h = functional_call(m.gpt.final_norm, fn_values, Tensor(h))
+        # tied embedding logits: weight lives in the rest params
+        w = values["gpt.embeddings.word_embeddings.weight"]
+        logits = jnp.matmul(h, w.T)
+        loss = criterion(Tensor(logits), Tensor(labels))
+        return loss._value if isinstance(loss, Tensor) else loss
+
+    return HybridParallelEngine(
+        model, criterion, optimizer, hcg,
+        block_regex=r"gpt\.layers\.(\d+)\.(.*)",
+        template_block=model.gpt.layers[0],
+        embed_fn=embed_fn, head_fn=head_fn,
+        accumulate_steps=accumulate_steps, zero_stage=zero_stage)
+
+
+def make_ernie_hybrid_engine(model, criterion, optimizer, hcg, *,
+                             accumulate_steps=1, zero_stage=0):
+    """ERNIE pretraining (MLM-only in the hybrid path: NSP head needs the
+    pooler over the full sequence, kept in the head_fn)."""
+    from ..engine import functional_call
+
+    def embed_fn(m, values, tokens):
+        return functional_call(m.ernie.embeddings,
+                               values_sub(values, "ernie.embeddings."),
+                               Tensor(tokens))
+
+    def head_fn(m, values, h, labels):
+        pooled = functional_call(m.ernie.pooler,
+                                 values_sub(values, "ernie.pooler."),
+                                 Tensor(h))
+        cls_vals = values_sub(values, "cls.")
+        # the tied decoder weight dedups under the embedding's name in the
+        # model-level state dict; re-route it to cls's local registry name
+        cls_vals["_tied"] = values[
+            "ernie.embeddings.word_embeddings.weight"]
+        scores, rel = functional_call(
+            m.cls, cls_vals, Tensor(h), Tensor(pooled))
+        loss = criterion(Tensor(scores), Tensor(rel), Tensor(labels))
+        return loss._value if isinstance(loss, Tensor) else loss
+
+    return HybridParallelEngine(
+        model, criterion, optimizer, hcg,
+        block_regex=r"ernie\.encoder\.(\d+)\.(.*)",
+        template_block=model.ernie.encoder[0],
+        embed_fn=embed_fn, head_fn=head_fn,
+        accumulate_steps=accumulate_steps, zero_stage=zero_stage)

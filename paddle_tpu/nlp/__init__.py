@@ -1,0 +1,4 @@
+"""paddle_tpu.nlp — transformer model family (ERNIE/BERT/GPT) for the
+pretraining ladder configs (BASELINE.json)."""
+
+from . import transformers  # noqa: F401

@@ -1,0 +1,287 @@
+"""ERNIE / BERT encoder family (parity target: PaddleNLP ErnieModel /
+BertModel — the Baidu flagship pretraining config of BASELINE.json; the
+reference repo provides the primitives in python/paddle/nn/layer/
+transformer.py that PaddleNLP assembles the model from).
+
+Encoder-only transformer with MLM + NSP pretraining heads. Same TP/GSPMD
+options as the GPT family; blocks are structurally uniform for the
+pipeline scan.
+"""
+
+from __future__ import annotations
+
+from ... import nn
+from ...core.tensor import Tensor
+from ...distributed.fleet.meta_parallel.mp_layers import (
+    ColumnParallelLinear, ParallelCrossEntropy, RowParallelLinear,
+    VocabParallelEmbedding, shard_hint,
+)
+from ...distributed.topology import DP_AXIS, MP_AXIS
+from ...nn import functional as F
+
+
+class ErnieConfig:
+    def __init__(self, vocab_size=18000, hidden_size=768, num_layers=12,
+                 num_heads=12, ffn_hidden_size=3072, max_seq_len=512,
+                 type_vocab_size=4, dropout=0.1, attn_dropout=0.1,
+                 layer_norm_eps=1e-12, initializer_range=0.02,
+                 use_parallel=False, sequence_parallel=False):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.num_heads = num_heads
+        self.ffn_hidden_size = ffn_hidden_size
+        self.max_seq_len = max_seq_len
+        self.type_vocab_size = type_vocab_size
+        self.dropout = dropout
+        self.attn_dropout = attn_dropout
+        self.layer_norm_eps = layer_norm_eps
+        self.initializer_range = initializer_range
+        self.use_parallel = use_parallel
+        self.sequence_parallel = sequence_parallel
+
+
+_PRESETS = {
+    "ernie-1.0": dict(vocab_size=18000, hidden_size=768, num_layers=12,
+                      num_heads=12, ffn_hidden_size=3072),
+    "bert-base": dict(vocab_size=30522, hidden_size=768, num_layers=12,
+                      num_heads=12, ffn_hidden_size=3072,
+                      type_vocab_size=2),
+    "bert-large": dict(vocab_size=30522, hidden_size=1024, num_layers=24,
+                       num_heads=16, ffn_hidden_size=4096,
+                       type_vocab_size=2),
+}
+
+
+def ernie_config(name, **overrides):
+    cfg = dict(_PRESETS[name])
+    cfg.update(overrides)
+    return ErnieConfig(**cfg)
+
+
+class ErnieEmbeddings(nn.Layer):
+    def __init__(self, config: ErnieConfig):
+        super().__init__()
+        init = nn.initializer.Normal(std=config.initializer_range)
+        if config.use_parallel:
+            self.word_embeddings = VocabParallelEmbedding(
+                config.vocab_size, config.hidden_size, weight_attr=init)
+        else:
+            self.word_embeddings = nn.Embedding(
+                config.vocab_size, config.hidden_size, weight_attr=init)
+        self.position_embeddings = nn.Embedding(
+            config.max_seq_len, config.hidden_size, weight_attr=init)
+        self.token_type_embeddings = nn.Embedding(
+            config.type_vocab_size, config.hidden_size, weight_attr=init)
+        self.layer_norm = nn.LayerNorm(config.hidden_size,
+                                       epsilon=config.layer_norm_eps)
+        self.dropout = config.dropout
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None):
+        import jax.numpy as jnp
+
+        if position_ids is None:
+            s = input_ids.shape[-1]
+            position_ids = Tensor(jnp.arange(s, dtype=jnp.int32))
+        x = self.word_embeddings(input_ids) + \
+            self.position_embeddings(position_ids)
+        if token_type_ids is not None:
+            x = x + self.token_type_embeddings(token_type_ids)
+        x = self.layer_norm(x)
+        return F.dropout(x, self.dropout, training=self.training)
+
+
+class ErnieSelfAttention(nn.Layer):
+    def __init__(self, config: ErnieConfig):
+        super().__init__()
+        h, nh = config.hidden_size, config.num_heads
+        self.num_heads = nh
+        self.head_dim = h // nh
+        self.attn_dropout = config.attn_dropout
+        init = nn.initializer.Normal(std=config.initializer_range)
+        if config.use_parallel:
+            self.qkv_proj = ColumnParallelLinear(
+                h, 3 * h, weight_attr=init, gather_output=False)
+            self.out_proj = RowParallelLinear(
+                h, h, weight_attr=init, input_is_parallel=True)
+        else:
+            self.qkv_proj = nn.Linear(h, 3 * h, weight_attr=init)
+            self.out_proj = nn.Linear(h, h, weight_attr=init)
+
+    def forward(self, x, attn_mask=None):
+        b, s, h = x.shape
+        qkv = self.qkv_proj(x).reshape(
+            [b, s, 3, self.num_heads, self.head_dim])
+        q, k, v = qkv.unstack(axis=2)
+        out = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=attn_mask,
+            dropout_p=self.attn_dropout if self.training else 0.0)
+        return self.out_proj(out.reshape([b, s, h]))
+
+
+class ErnieEncoderLayer(nn.Layer):
+    """Post-LN encoder block (BERT convention), structurally uniform."""
+
+    def __init__(self, config: ErnieConfig):
+        super().__init__()
+        init = nn.initializer.Normal(std=config.initializer_range)
+        self.self_attn = ErnieSelfAttention(config)
+        self.norm1 = nn.LayerNorm(config.hidden_size,
+                                  epsilon=config.layer_norm_eps)
+        if config.use_parallel:
+            self.fc1 = ColumnParallelLinear(
+                config.hidden_size, config.ffn_hidden_size,
+                weight_attr=init, gather_output=False)
+            self.fc2 = RowParallelLinear(
+                config.ffn_hidden_size, config.hidden_size,
+                weight_attr=init, input_is_parallel=True)
+        else:
+            self.fc1 = nn.Linear(config.hidden_size,
+                                 config.ffn_hidden_size, weight_attr=init)
+            self.fc2 = nn.Linear(config.ffn_hidden_size,
+                                 config.hidden_size, weight_attr=init)
+        self.norm2 = nn.LayerNorm(config.hidden_size,
+                                  epsilon=config.layer_norm_eps)
+        self.dropout = config.dropout
+        self.sequence_parallel = config.sequence_parallel
+
+    def _sp(self, x):
+        if self.sequence_parallel:
+            return shard_hint(x, DP_AXIS, MP_AXIS, None)
+        return shard_hint(x, DP_AXIS, None, None)
+
+    def forward(self, x, attn_mask=None):
+        x = self._sp(x)
+        h = self.self_attn(x, attn_mask)
+        h = F.dropout(h, self.dropout, training=self.training)
+        x = self.norm1(x + h)
+        x = self._sp(x)
+        h = self.fc2(F.gelu(self.fc1(x), approximate=True))
+        h = F.dropout(h, self.dropout, training=self.training)
+        return self.norm2(x + h)
+
+
+class ErniePooler(nn.Layer):
+    def __init__(self, config: ErnieConfig):
+        super().__init__()
+        self.dense = nn.Linear(config.hidden_size, config.hidden_size)
+
+    def forward(self, hidden):
+        return F.tanh(self.dense(hidden[:, 0]))
+
+
+class ErnieModel(nn.Layer):
+    def __init__(self, config: ErnieConfig):
+        super().__init__()
+        self.config = config
+        self.embeddings = ErnieEmbeddings(config)
+        self.encoder = nn.LayerList(
+            [ErnieEncoderLayer(config) for _ in range(config.num_layers)])
+        self.pooler = ErniePooler(config)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None):
+        if attention_mask is not None and attention_mask.ndim == 2:
+            # [b, s] padding mask -> additive [b, 1, 1, s]
+            import jax.numpy as jnp
+
+            m = attention_mask._value.astype(jnp.float32)
+            attention_mask = Tensor((1.0 - m)[:, None, None, :] * -1e4)
+        x = self.embeddings(input_ids, token_type_ids, position_ids)
+        for layer in self.encoder:
+            x = layer(x, attention_mask)
+        pooled = self.pooler(x)
+        return x, pooled
+
+
+class ErniePretrainingHeads(nn.Layer):
+    def __init__(self, config: ErnieConfig, embedding_weights=None):
+        super().__init__()
+        self.transform = nn.Linear(config.hidden_size, config.hidden_size)
+        self.layer_norm = nn.LayerNorm(config.hidden_size,
+                                       epsilon=config.layer_norm_eps)
+        self._tied = embedding_weights
+        if embedding_weights is None:
+            self.decoder = nn.Linear(config.hidden_size, config.vocab_size)
+        self.seq_relationship = nn.Linear(config.hidden_size, 2)
+        self.config = config
+
+    def forward(self, sequence_output, pooled_output):
+        from ...core.dispatch import apply
+
+        h = self.layer_norm(F.gelu(self.transform(sequence_output)))
+        if self._tied is not None:
+            logits = apply("matmul_v2", h, self._tied, trans_y=True)
+            if self.config.use_parallel:
+                logits = shard_hint(logits, DP_AXIS, None, MP_AXIS)
+        else:
+            logits = self.decoder(h)
+        nsp = self.seq_relationship(pooled_output)
+        return logits, nsp
+
+
+class ErnieForPretraining(nn.Layer):
+    def __init__(self, config: ErnieConfig):
+        super().__init__()
+        self.config = config
+        self.ernie = ErnieModel(config)
+        self.cls = ErniePretrainingHeads(
+            config,
+            embedding_weights=self.ernie.embeddings.word_embeddings.weight)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None):
+        seq, pooled = self.ernie(input_ids, token_type_ids, position_ids,
+                                 attention_mask)
+        return self.cls(seq, pooled)
+
+
+class ErniePretrainingCriterion(nn.Layer):
+    """MLM + NSP loss (PaddleNLP ErniePretrainingCriterion parity)."""
+
+    def __init__(self, config: ErnieConfig = None, ignore_index=-100):
+        super().__init__()
+        self.ignore_index = ignore_index
+        use_parallel = config.use_parallel if config is not None else False
+        self.parallel_ce = ParallelCrossEntropy(ignore_index=ignore_index) \
+            if use_parallel else None
+
+    def forward(self, prediction_scores, seq_relationship_score,
+                masked_lm_labels, next_sentence_labels=None):
+        if self.parallel_ce is not None:
+            mlm = self.parallel_ce(prediction_scores, masked_lm_labels)
+            mlm = mlm.squeeze(-1)
+            mask = (masked_lm_labels != self.ignore_index)
+            mlm = (mlm * mask.astype("float32")).sum() / \
+                mask.astype("float32").sum().clip(min=1.0)
+        else:
+            mlm = F.cross_entropy(prediction_scores, masked_lm_labels,
+                                  ignore_index=self.ignore_index)
+        if next_sentence_labels is None:
+            return mlm
+        nsp = F.cross_entropy(seq_relationship_score,
+                              next_sentence_labels)
+        return mlm + nsp
+
+
+class ErnieForSequenceClassification(nn.Layer):
+    def __init__(self, config: ErnieConfig, num_classes=2, dropout=None):
+        super().__init__()
+        self.ernie = ErnieModel(config)
+        self.dropout = nn.Dropout(dropout if dropout is not None
+                                  else config.dropout)
+        self.classifier = nn.Linear(config.hidden_size, num_classes)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None):
+        _, pooled = self.ernie(input_ids, token_type_ids, position_ids,
+                               attention_mask)
+        return self.classifier(self.dropout(pooled))
+
+
+# Bert aliases (same architecture)
+BertConfig = ErnieConfig
+BertModel = ErnieModel
+BertForPretraining = ErnieForPretraining
+BertPretrainingCriterion = ErniePretrainingCriterion
+bert_config = ernie_config

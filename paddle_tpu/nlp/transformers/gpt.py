@@ -1,0 +1,239 @@
+"""GPT model family (parity target: FleetX / PaddleNLP GPT-2/3 used by the
+reference's hybrid-parallel ladder config; the reference repo itself ships
+the layer primitives — nn/layer/transformer.py — and the fleet TP/PP
+machinery these models plug into).
+
+TPU-native design:
+- decoder blocks use `F.scaled_dot_product_attention` (pallas flash
+  attention on TPU, jnp fallback elsewhere);
+- TP: q/k/v + mlp projections are Column/RowParallelLinear carrying GSPMD
+  specs over 'mp'; vocab embedding sharded over 'mp'; logits stay vocab-
+  sharded into ParallelCrossEntropy;
+- sequence parallel (megatron-style): optional sharding of the seq axis
+  over 'mp' outside the matmul regions (`sequence_parallel=True`);
+- PP: blocks are structurally identical -> their params stack into
+  [num_layers, ...] leaves, consumed by the scan/ppermute pipeline
+  (distributed/hybrid.py).
+"""
+
+from __future__ import annotations
+
+import math
+
+from ... import nn
+from ...core.tensor import Tensor
+from ...distributed.fleet.meta_parallel.mp_layers import (
+    ColumnParallelLinear, ParallelCrossEntropy, RowParallelLinear,
+    VocabParallelEmbedding, shard_hint,
+)
+from ...distributed.topology import DP_AXIS, MP_AXIS
+from ...nn import functional as F
+
+
+class GPTConfig:
+    def __init__(self, vocab_size=50304, hidden_size=768, num_layers=12,
+                 num_heads=12, ffn_hidden_size=None, max_seq_len=1024,
+                 dropout=0.1, attn_dropout=0.1, layer_norm_eps=1e-5,
+                 initializer_range=0.02, use_parallel=True,
+                 sequence_parallel=False, tie_word_embeddings=True):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.num_heads = num_heads
+        self.ffn_hidden_size = ffn_hidden_size or 4 * hidden_size
+        self.max_seq_len = max_seq_len
+        self.dropout = dropout
+        self.attn_dropout = attn_dropout
+        self.layer_norm_eps = layer_norm_eps
+        self.initializer_range = initializer_range
+        self.use_parallel = use_parallel
+        self.sequence_parallel = sequence_parallel
+        self.tie_word_embeddings = tie_word_embeddings
+
+
+_PRESETS = {
+    "gpt2-small": dict(hidden_size=768, num_layers=12, num_heads=12),
+    "gpt2-medium": dict(hidden_size=1024, num_layers=24, num_heads=16),
+    "gpt2-large": dict(hidden_size=1280, num_layers=36, num_heads=20),
+    "gpt3-1.3b": dict(hidden_size=2048, num_layers=24, num_heads=16,
+                      max_seq_len=2048),
+    "gpt3-6.7b": dict(hidden_size=4096, num_layers=32, num_heads=32,
+                      max_seq_len=2048),
+}
+
+
+def gpt_config(name, **overrides):
+    cfg = dict(_PRESETS[name])
+    cfg.update(overrides)
+    return GPTConfig(**cfg)
+
+
+class GPTAttention(nn.Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        h, nh = config.hidden_size, config.num_heads
+        self.num_heads = nh
+        self.head_dim = h // nh
+        self.attn_dropout = config.attn_dropout
+        init = nn.initializer.Normal(std=config.initializer_range)
+        if config.use_parallel:
+            self.qkv_proj = ColumnParallelLinear(
+                h, 3 * h, weight_attr=init, gather_output=False)
+            self.out_proj = RowParallelLinear(
+                h, h, weight_attr=init, input_is_parallel=True)
+        else:
+            self.qkv_proj = nn.Linear(h, 3 * h, weight_attr=init)
+            self.out_proj = nn.Linear(h, h, weight_attr=init)
+
+    def forward(self, x):
+        b, s, h = x.shape
+        qkv = self.qkv_proj(x)
+        qkv = qkv.reshape([b, s, 3, self.num_heads, self.head_dim])
+        q, k, v = qkv.unstack(axis=2)
+        out = F.scaled_dot_product_attention(
+            q, k, v, is_causal=True,
+            dropout_p=self.attn_dropout if self.training else 0.0)
+        out = out.reshape([b, s, h])
+        return self.out_proj(out)
+
+
+class GPTMLP(nn.Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        h, f = config.hidden_size, config.ffn_hidden_size
+        init = nn.initializer.Normal(std=config.initializer_range)
+        if config.use_parallel:
+            self.fc1 = ColumnParallelLinear(h, f, weight_attr=init,
+                                            gather_output=False)
+            self.fc2 = RowParallelLinear(f, h, weight_attr=init,
+                                         input_is_parallel=True)
+        else:
+            self.fc1 = nn.Linear(h, f, weight_attr=init)
+            self.fc2 = nn.Linear(f, h, weight_attr=init)
+
+    def forward(self, x):
+        return self.fc2(F.gelu(self.fc1(x), approximate=True))
+
+
+class GPTDecoderLayer(nn.Layer):
+    """Pre-LN decoder block. All blocks are structurally identical so
+    their params stack for the pipeline scan."""
+
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.norm1 = nn.LayerNorm(config.hidden_size,
+                                  epsilon=config.layer_norm_eps)
+        self.attn = GPTAttention(config)
+        self.norm2 = nn.LayerNorm(config.hidden_size,
+                                  epsilon=config.layer_norm_eps)
+        self.mlp = GPTMLP(config)
+        self.dropout = config.dropout
+        self.sequence_parallel = config.sequence_parallel
+
+    def _sp(self, x):
+        if self.sequence_parallel:
+            # megatron sequence parallelism: outside matmul regions the
+            # activations shard their seq axis over 'mp'
+            return shard_hint(x, DP_AXIS, MP_AXIS, None)
+        return shard_hint(x, DP_AXIS, None, None)
+
+    def forward(self, x):
+        x = self._sp(x)
+        h = self.attn(self.norm1(x))
+        h = F.dropout(h, self.dropout, training=self.training)
+        x = x + h
+        x = self._sp(x)
+        h = self.mlp(self.norm2(x))
+        h = F.dropout(h, self.dropout, training=self.training)
+        return x + h
+
+
+class GPTEmbeddings(nn.Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        init = nn.initializer.Normal(std=config.initializer_range)
+        if config.use_parallel:
+            self.word_embeddings = VocabParallelEmbedding(
+                config.vocab_size, config.hidden_size, weight_attr=init)
+        else:
+            self.word_embeddings = nn.Embedding(
+                config.vocab_size, config.hidden_size, weight_attr=init)
+        self.position_embeddings = nn.Embedding(
+            config.max_seq_len, config.hidden_size, weight_attr=init)
+        self.dropout = config.dropout
+
+    def forward(self, input_ids, position_ids=None):
+        import jax.numpy as jnp
+
+        if position_ids is None:
+            s = input_ids.shape[-1]
+            position_ids = Tensor(jnp.arange(s, dtype=jnp.int32))
+        x = self.word_embeddings(input_ids) + \
+            self.position_embeddings(position_ids)
+        return F.dropout(x, self.dropout, training=self.training)
+
+
+class GPTModel(nn.Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        self.embeddings = GPTEmbeddings(config)
+        self.layers = nn.LayerList(
+            [GPTDecoderLayer(config) for _ in range(config.num_layers)])
+        self.final_norm = nn.LayerNorm(config.hidden_size,
+                                       epsilon=config.layer_norm_eps)
+
+    def forward(self, input_ids, position_ids=None):
+        x = self.embeddings(input_ids, position_ids)
+        for layer in self.layers:
+            x = layer(x)
+        return self.final_norm(x)
+
+
+class GPTForPretraining(nn.Layer):
+    """LM-head model; logits = h @ E^T (tied) stay vocab-sharded over
+    'mp' and feed ParallelCrossEntropy."""
+
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        self.gpt = GPTModel(config)
+        if not config.tie_word_embeddings:
+            self.lm_head = nn.Linear(config.hidden_size, config.vocab_size,
+                                     bias_attr=False)
+
+    def forward(self, input_ids, position_ids=None):
+        h = self.gpt(input_ids, position_ids)
+        return self.logits(h)
+
+    def logits(self, h):
+        from ...core.dispatch import apply
+
+        if self.config.tie_word_embeddings:
+            w = self.gpt.embeddings.word_embeddings.weight
+            logits = apply("matmul_v2", h, w, trans_y=True)
+            if self.config.use_parallel:
+                logits = shard_hint(logits, DP_AXIS, None, MP_AXIS)
+            return logits
+        return self.lm_head(h)
+
+
+class GPTPretrainingCriterion(nn.Layer):
+    def __init__(self, config: GPTConfig = None, ignore_index=-100):
+        super().__init__()
+        use_parallel = config.use_parallel if config is not None else False
+        self.loss_fn = ParallelCrossEntropy(ignore_index=ignore_index) \
+            if use_parallel else None
+        self.ignore_index = ignore_index
+
+    def forward(self, logits, labels, loss_mask=None):
+        if self.loss_fn is not None:
+            loss = self.loss_fn(logits, labels)
+            loss = loss.squeeze(-1)
+        else:
+            loss = F.cross_entropy(logits, labels, reduction="none",
+                                   ignore_index=self.ignore_index)
+        if loss_mask is not None:
+            m = loss_mask.reshape(loss.shape).astype("float32")
+            return (loss * m).sum() / m.sum().clip(min=1.0)
+        return loss.mean()
