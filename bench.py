@@ -1,0 +1,170 @@
+"""Benchmark: ERNIE-base pretraining train step on one chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+
+Method (per VERDICT round-1 guidance): the full train step (fwd + bwd +
+AdamW update) is compiled once, then >=20 steps are timed with a REAL data
+dependency — step N+1 consumes step N's updated params/opt-state (the
+Engine threads state through every call), and the clock stops only after
+`jax.block_until_ready` on the final step's outputs.  MFU is derived from
+analytic FLOPs (6*P + 12*L*H*S per token for training) against the chip's
+peak bf16 FLOP/s — never from XLA cost models or wall-clock tricks.
+
+Reference analogue: tools/test_model_benchmark.sh:19-45 +
+paddle/fluid/operators/benchmark/op_tester.cc (harness shape only).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+# Peak dense bf16 FLOP/s per chip, by PJRT device_kind substring.
+_PEAK_FLOPS = [
+    ("v5 lite", 197e12),  # TPU v5e
+    ("v5e", 197e12),
+    ("v5p", 459e12),
+    ("v4", 275e12),
+    ("v6", 918e12),  # trillium
+    ("v3", 123e12),
+    ("v2", 45e12),
+]
+_CPU_NOMINAL = 0.5e12  # placeholder so the line still parses off-TPU
+
+
+def _peak_for(device) -> float:
+    kind = getattr(device, "device_kind", "cpu").lower()
+    for sub, peak in _PEAK_FLOPS:
+        if sub in kind:
+            return peak
+    return _CPU_NOMINAL
+
+
+def _tpu_usable(timeout_s: float = 120.0) -> bool:
+    """Probe the accelerator backend in a THROWAWAY subprocess.
+
+    Backend init hangs (not errors) when the terminal tunnel is down or
+    libtpu versions mismatch, and a hung PJRT C-API call cannot be
+    interrupted in-process — so the probe must be a subprocess we can
+    kill.  Returns True only if the child ran a real matmul on a TPU
+    within the timeout.
+    """
+    import subprocess
+    code = ("import jax, jax.numpy as jnp;"
+            "d = jax.devices()[0];"
+            "assert d.platform != 'cpu', d.platform;"
+            "x = jnp.ones((128, 128), jnp.bfloat16);"
+            "(x @ x).block_until_ready();"
+            "print('TPU_OK', d.device_kind)")
+    try:
+        r = subprocess.run([sys.executable, "-c", code], timeout=timeout_s,
+                           capture_output=True, text=True)
+        return r.returncode == 0 and "TPU_OK" in r.stdout
+    except (subprocess.TimeoutExpired, OSError):
+        return False
+
+
+def main():
+    if os.environ.get("BENCH_PLATFORM", "") == "cpu" or not _tpu_usable():
+        # Force host CPU *before* first backend touch; the axon site hook
+        # sets jax_platforms='axon,cpu', so the config update (not the env
+        # var) is what actually takes effect.
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    import jax
+    import paddle_tpu as paddle
+    from paddle_tpu import amp
+    from paddle_tpu.engine import Engine
+    from paddle_tpu.nlp.transformers import (
+        ErnieConfig, ErnieForPretraining, ErniePretrainingCriterion,
+    )
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+    peak = _peak_for(dev)
+
+    if on_tpu:
+        batch = int(os.environ.get("BENCH_BATCH", "32"))
+        seq = int(os.environ.get("BENCH_SEQ", "512"))
+        iters = int(os.environ.get("BENCH_ITERS", "20"))
+        cfg = ErnieConfig(vocab_size=18000, hidden_size=768, num_layers=12,
+                          num_heads=12, ffn_hidden_size=3072,
+                          max_seq_len=seq, dropout=0.1, use_parallel=False)
+    else:
+        # off-TPU smoke configuration: same code path, tiny shapes
+        batch, seq, iters = 4, 128, 5
+        cfg = ErnieConfig(vocab_size=1000, hidden_size=128, num_layers=2,
+                          num_heads=4, ffn_hidden_size=512,
+                          max_seq_len=seq, dropout=0.1, use_parallel=False)
+
+    paddle.seed(0)
+    model = ErnieForPretraining(cfg)
+    criterion = ErniePretrainingCriterion(cfg)
+    optimizer = paddle.optimizer.AdamW(
+        learning_rate=1e-4, parameters=model.parameters(), weight_decay=0.01)
+
+    def loss_fn(outputs, mlm_labels):
+        logits, nsp = outputs
+        return criterion(logits, nsp, mlm_labels)
+
+    engine = Engine(model, optimizer, loss_fn)
+
+    n_params = sum(int(np.prod(v.shape)) for v in engine.state.params.values())
+    # Training FLOPs per token: 6*P (fwd 2P + bwd 4P) plus the attention
+    # score/value matmuls 12*L*H*S (fwd+bwd) not counted in P.
+    flops_per_token = 6.0 * n_params + 12.0 * cfg.num_layers * \
+        cfg.hidden_size * seq
+    tokens_per_step = batch * seq
+
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32)
+    labels = ids.copy()
+    mask = rng.rand(batch, seq) > 0.15
+    labels[mask] = -100  # criterion ignore_index
+
+    def one_step():
+        # amp context is active during the first (tracing) call, baking
+        # bf16 autocast into the compiled program; later calls reuse it.
+        with amp.auto_cast(enable=True, dtype="bfloat16"):
+            return engine.train_batch(ids, labels)
+
+    # Warmup: compile + 2 executions.
+    loss = one_step()
+    for _ in range(2):
+        loss = one_step()
+    jax.block_until_ready((loss._value, engine.state.params))
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = one_step()
+    jax.block_until_ready((loss._value, engine.state.params))
+    dt = time.perf_counter() - t0
+
+    step_s = dt / iters
+    tokens_per_sec = tokens_per_step / step_s
+    achieved = flops_per_token * tokens_per_sec
+    mfu = achieved / peak
+    target_mfu = 0.35  # BASELINE.json north star: ERNIE-1.0 >=35% MFU
+
+    print(json.dumps({
+        "metric": "ernie_base_pretrain_mfu",
+        "value": round(mfu * 100.0, 2),
+        "unit": "percent_mfu",
+        "vs_baseline": round(mfu / target_mfu, 3),
+        "tokens_per_sec": round(tokens_per_sec, 1),
+        "step_ms": round(step_s * 1e3, 2),
+        "batch": batch, "seq": seq, "iters": iters,
+        "params": n_params,
+        "device": getattr(dev, "device_kind", dev.platform),
+        "loss": float(loss.item()),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -69,25 +69,45 @@ class GradScaler:
         self._good_steps = 0
         self._bad_steps = 0
         self._found_inf = False
+        # optimizers already unscaled since the last update() — step() must
+        # not divide by the scale a second time (reference OptimizerState)
+        self._unscaled = set()
 
     def scale(self, loss):
         if not self._enable:
             return loss
         return loss * self._scale
 
+    @staticmethod
+    @jax.jit
+    def _unscale_check(grads, inv_scale):
+        """One fused program: grads/scale + a single finite-ness bit
+        (in-graph analogue of check_finite_and_unscale_op; avoids one
+        host sync per parameter)."""
+        # keep each grad's own dtype (fp16 stays fp16; no f32 promotion)
+        new = jax.tree.map(lambda g: (g * inv_scale).astype(g.dtype), grads)
+        finite = jnp.stack([jnp.all(jnp.isfinite(g))
+                            for g in jax.tree.leaves(new)])
+        return new, jnp.all(finite)
+
     def unscale_(self, optimizer):
         if not self._enable:
             return
-        found_inf = False
-        for p in optimizer._parameter_list or []:
-            if p is None or p._grad is None:
-                continue
-            g = p._grad / self._scale
-            finite = bool(jnp.all(jnp.isfinite(g)))
-            if not finite:
-                found_inf = True
-            p._grad = g
-        self._found_inf = found_inf
+        if id(optimizer) in self._unscaled:
+            return
+        grads = [p._grad for p in optimizer._parameter_list or []
+                 if p is not None and p._grad is not None]
+        if grads:
+            inv = jnp.asarray(1.0 / self._scale, jnp.float32)
+            new_grads, all_finite = self._unscale_check(grads, inv)
+            i = 0
+            for p in optimizer._parameter_list or []:
+                if p is None or p._grad is None:
+                    continue
+                p._grad = new_grads[i]
+                i += 1
+            self._found_inf = not bool(all_finite)
+        self._unscaled.add(id(optimizer))
 
     def step(self, optimizer):
         if not self._enable:
@@ -102,7 +122,9 @@ class GradScaler:
         self.step(optimizer)
 
     def update(self):
+        self._unscaled.clear()
         if not (self._enable and self._dynamic):
+            self._found_inf = False
             return
         if self._found_inf:
             self._bad_steps += 1

@@ -199,6 +199,24 @@ class HybridParallelEngine:
         pipeline = pipeline_spmd(stage_fn, mesh, num_stages=S,
                                  num_micro=M)
 
+        # per-param decay/lr-mult constants (mirrors eager _preprocess);
+        # block params take their meta from the template block's Parameter
+        from ..core.tensor import Parameter
+        tsd = template.state_dict()
+        block_metas = opt.param_metas(
+            {k: tsd[k] for k in self.block_params
+             if k in tsd and isinstance(tsd[k], Parameter)}) or None
+        if block_metas is not None and len(block_metas) != \
+                len(self.block_params):
+            block_metas = None
+        msd = self.model.state_dict()
+        rest_metas = opt.param_metas(
+            {k: msd[k] for k in self.rest_params
+             if k in msd and isinstance(msd[k], Parameter)}) or None
+        if rest_metas is not None and len(rest_metas) != \
+                len(self.rest_params):
+            rest_metas = None
+
         def loss_of(block_params, rest_params, buffers, batch, key):
             tokens, labels = batch
             with _random.rng_scope(key):
@@ -216,13 +234,17 @@ class HybridParallelEngine:
             loss, (gb, gr) = jax.value_and_grad(
                 loss_of, argnums=(0, 1))(block_params, rest_params,
                                          buffers, batch, key)
+            gb = opt.decay_gradients_tree(block_params, gb, block_metas)
+            gr = opt.decay_gradients_tree(rest_params, gr, rest_metas)
             gc = getattr(opt, "_grad_clip", None)
             if gc is not None:
                 gb, gr = gc._clip_fn((gb, gr))
             nb, ob = opt.apply_gradients_tree(block_params, gb,
-                                              opt_state["blocks"], lr)
+                                              opt_state["blocks"], lr,
+                                              metas=block_metas)
             nr, orr = opt.apply_gradients_tree(rest_params, gr,
-                                               opt_state["rest"], lr)
+                                               opt_state["rest"], lr,
+                                               metas=rest_metas)
             return loss, nb, nr, {"blocks": ob, "rest": orr}
 
         sh = self._shardings

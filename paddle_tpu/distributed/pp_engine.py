@@ -59,6 +59,14 @@ class PipelineEngine:
 
         grad_fn = jax.value_and_grad(micro_loss)
 
+        from ..core.tensor import Parameter
+        sd = layer.state_dict()
+        metas = opt.param_metas(
+            {k: sd[k] for k in self.params
+             if k in sd and isinstance(sd[k], Parameter)})
+        if len(metas) != len(self.params):
+            metas = None
+
         def step_fn(params, opt_state, buffers, x, y, lr, key):
             # x, y: [M, micro_batch, ...]
             def accum(carry, mb):
@@ -73,11 +81,12 @@ class PipelineEngine:
             (gsum, lsum, _), _ = jax.lax.scan(
                 accum, (zero, jnp.zeros((), jnp.float32), 0), (x, y))
             grads = jax.tree.map(lambda g: g / M, gsum)
+            grads = opt.decay_gradients_tree(params, grads, metas)
             gc = getattr(opt, "_grad_clip", None)
             if gc is not None:
                 grads = gc._clip_fn(grads)
             new_params, new_opt = opt.apply_gradients_tree(
-                params, grads, opt_state, lr)
+                params, grads, opt_state, lr, metas=metas)
             return lsum / M, new_params, new_opt
 
         self._step_fn = jax.jit(step_fn, donate_argnums=(0, 1))

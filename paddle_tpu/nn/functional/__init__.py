@@ -248,11 +248,13 @@ def batch_norm(x, running_mean, running_var, weight, bias, training=False,
                use_global_stats=None, name=None):
     if use_global_stats is None:
         use_global_stats = not training
+    # use_global_stats=True always normalizes with the running stats, even
+    # in training (and then skips the running-stat update) — reference
+    # batch_norm_op.cc semantics (ADVICE r1 fix).
     y, new_mean, new_var = apply(
         "batch_norm", x, weight, bias, running_mean, running_var,
         momentum=momentum, epsilon=epsilon, is_test=not training,
-        data_format=data_format, use_global_stats=use_global_stats and
-        not training)
+        data_format=data_format, use_global_stats=use_global_stats)
     if training and not use_global_stats:
         running_mean.set_value(new_mean)
         running_var.set_value(new_var)
@@ -334,13 +336,10 @@ def cross_entropy(input, label, weight=None, ignore_index=-100,
                   reduction="mean", soft_label=False, axis=-1,
                   use_softmax=True, name=None):
     if weight is not None:
-        return apply("cross_entropy", input, label, weight._value,
-                     soft_label=soft_label, axis=axis,
-                     ignore_index=ignore_index, reduction=reduction,
-                     use_softmax=use_softmax)
+        weight = weight._value if hasattr(weight, "_value") else weight
     return apply("cross_entropy", input, label, soft_label=soft_label,
                  axis=axis, ignore_index=ignore_index, reduction=reduction,
-                 use_softmax=use_softmax)
+                 use_softmax=use_softmax, weight=weight)
 
 
 def softmax_with_cross_entropy(logits, label, soft_label=False,

@@ -291,11 +291,11 @@ def as_complex(x):
 
 @register_op("diag_embed")
 def diag_embed(x, *, offset=0, dim1=-2, dim2=-1):
-    out = jnp.zeros(x.shape + (x.shape[-1] + abs(offset),), x.dtype)
+    n = x.shape[-1] + abs(offset)  # output is square (n, n)
+    out = jnp.zeros(x.shape[:-1] + (n, n), x.dtype)
     idx = jnp.arange(x.shape[-1])
     rows = idx + max(-offset, 0)
     cols = idx + max(offset, 0)
-    out = out[..., : x.shape[-1] + abs(offset), :]
     out = out.at[..., rows, cols].set(x)
     if dim1 != -2 or dim2 != -1:
         out = jnp.moveaxis(out, (-2, -1), (dim1, dim2))

@@ -184,19 +184,71 @@ class Optimizer:
             lambda v: self._init_state(v), params,
             is_leaf=lambda x: hasattr(x, "shape") and hasattr(x, "dtype"))
 
-    def apply_gradients_tree(self, params, grads, states, lr):
+    def _leaf_meta(self, p):
+        """Per-parameter update metadata for the compiled path, mirroring
+        the eager `_preprocess`/`step` semantics: coupled L2/L1 decay
+        (per-param regularizer wins over the optimizer-level setting) and
+        per-param lr multipliers (`optimize_attr['learning_rate']`)."""
+        decay = p.regularizer if getattr(p, "regularizer", None) is not None \
+            else self._weight_decay
+        coeff, l1 = 0.0, False
+        if decay is not None and not self._decoupled_weight_decay():
+            coeff, l1 = decay.coeff, isinstance(decay, L1Decay)
+        return {"coeff": coeff, "l1": l1,
+                "lr_mult": float(p.optimize_attr.get("learning_rate", 1.0))}
+
+    def param_metas(self, named_params):
+        """dict name -> Parameter  =>  dict name -> leaf meta (static
+        floats; compiled into the train step as constants)."""
+        return {k: self._leaf_meta(p) for k, p in named_params.items()}
+
+    def decay_gradients_tree(self, params, grads, metas):
+        """Fold coupled L2/L1 decay into grads — called by the compiled
+        engines BEFORE grad clipping, matching the eager `_preprocess`
+        order (decay, then clip)."""
+        if metas is None:
+            return grads
+        flat_p, tree = jax.tree.flatten(params)
+        flat_g = tree.flatten_up_to(grads)
+        flat_m = tree.flatten_up_to(metas)
+        out = []
+        for p, g, m in zip(flat_p, flat_g, flat_m):
+            if m is not None and m.get("coeff"):
+                reg = jnp.sign(p) if m.get("l1") else p
+                g = g + jnp.asarray(m["coeff"], g.dtype) * \
+                    reg.astype(g.dtype)
+            out.append(g)
+        return jax.tree.unflatten(tree, out)
+
+    def apply_gradients_tree(self, params, grads, states, lr, metas=None):
         """Pure tree-wide update used inside the compiled train step.
 
         `params`/`grads` share a structure whose leaves are arrays; `states`
         has the same structure with a per-param state dict at each leaf.
+        `metas` (optional, same structure, leaf = `_leaf_meta` dict) carries
+        lr-multiplier / per-param decoupled-decay overrides. Coupled decay
+        is NOT applied here — engines fold it in pre-clip via
+        `decay_gradients_tree`.
         """
         hyper = self._hyper()
         flat_p, tree = jax.tree.flatten(params)
         flat_g = tree.flatten_up_to(grads)
         flat_s = tree.flatten_up_to(states)
+        if metas is not None:
+            flat_m = tree.flatten_up_to(metas)
+        else:
+            flat_m = [None] * len(flat_p)
         new_p, new_s = [], []
-        for p, g, s in zip(flat_p, flat_g, flat_s):
-            np_, ns_ = self._rule(p, g, s, lr, **hyper)
+        for p, g, s, m in zip(flat_p, flat_g, flat_s, flat_m):
+            h = hyper
+            leaf_lr = lr
+            if m is not None:
+                if m.get("lr_mult", 1.0) != 1.0:
+                    leaf_lr = lr * m["lr_mult"]
+                if "decoupled_coeff" in m:
+                    h = dict(hyper)
+                    h["coeff"] = m["decoupled_coeff"]
+            np_, ns_ = self._rule(p, g, s, leaf_lr, **h)
             new_p.append(np_)
             new_s.append(ns_)
         return jax.tree.unflatten(tree, new_p), jax.tree.unflatten(
@@ -312,6 +364,13 @@ class AdamW(Adam):
         h = super()._hyper()
         h["coeff"] = self._coeff
         return h
+
+    def _leaf_meta(self, p):
+        meta = super()._leaf_meta(p)
+        if self._apply_decay_param_fun is not None and \
+                not self._apply_decay_param_fun(p.name or ""):
+            meta["decoupled_coeff"] = 0.0
+        return meta
 
     def _rule(self, param, grad, state, lr, *, beta1, beta2, epsilon, coeff):
         # decoupled decay applied to the param before the adam update

@@ -1,0 +1,163 @@
+"""Regression tests for round-1 advisor findings (ADVICE.md)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.engine import Engine
+
+
+def test_compiled_sgd_weight_decay_matches_eager():
+    """#1: weight_decay must survive the compiled apply_gradients_tree."""
+    paddle.seed(7)
+    layer_e = nn.Linear(4, 3)
+    layer_c = nn.Linear(4, 3)
+    # identical weights
+    for (k, a), (_, b) in zip(layer_e.state_dict().items(),
+                              layer_c.state_dict().items()):
+        b.set_value(a.numpy())
+
+    x = np.random.RandomState(0).randn(8, 4).astype(np.float32)
+    y = np.random.RandomState(1).randn(8, 3).astype(np.float32)
+
+    opt_e = paddle.optimizer.SGD(learning_rate=0.1,
+                                 parameters=layer_e.parameters(),
+                                 weight_decay=0.5)
+    out = layer_e(paddle.to_tensor(x))
+    loss = F.mse_loss(out, paddle.to_tensor(y))
+    loss.backward()
+    opt_e.step()
+
+    opt_c = paddle.optimizer.SGD(learning_rate=0.1,
+                                 parameters=layer_c.parameters(),
+                                 weight_decay=0.5)
+    eng = Engine(layer_c, opt_c, lambda o, t: F.mse_loss(o, t))
+    eng.train_batch(x, y)
+    eng.sync_to_layer()
+
+    for (k, a), (_, b) in zip(layer_e.state_dict().items(),
+                              layer_c.state_dict().items()):
+        np.testing.assert_allclose(a.numpy(), b.numpy(), rtol=1e-5,
+                                   atol=1e-6, err_msg=k)
+
+
+def test_compiled_lr_multiplier_applied():
+    """#1: per-param optimize_attr learning_rate multiplier in compiled."""
+    paddle.seed(3)
+    layer = nn.Linear(2, 2)
+    layer.weight.optimize_attr["learning_rate"] = 0.0  # freeze via lr mult
+    w0 = layer.weight.numpy().copy()
+    opt = paddle.optimizer.SGD(learning_rate=1.0,
+                               parameters=layer.parameters())
+    eng = Engine(layer, opt, lambda o, t: F.mse_loss(o, t))
+    x = np.ones((4, 2), np.float32)
+    y = np.zeros((4, 2), np.float32)
+    eng.train_batch(x, y)
+    eng.sync_to_layer()
+    np.testing.assert_allclose(layer.weight.numpy(), w0)
+    # bias has lr_mult 1.0 and must have moved
+    assert np.abs(layer.bias.numpy()).sum() > 0
+
+
+def test_gradscaler_unscale_then_step_no_double_unscale():
+    """#2: scaler.unscale_ -> clip -> scaler.step must not divide twice."""
+    paddle.seed(0)
+    layer = nn.Linear(3, 1)
+    opt = paddle.optimizer.SGD(learning_rate=0.0,
+                               parameters=layer.parameters())
+    scaler = paddle.amp.GradScaler(enable=True, init_loss_scaling=128.0)
+    x = paddle.to_tensor(np.ones((2, 3), np.float32))
+    loss = layer(x).sum()
+    scaled = scaler.scale(loss)
+    scaled.backward()
+    scaler.unscale_(opt)
+    g_after_unscale = np.asarray(layer.weight._grad).copy()
+    scaler.step(opt)  # must NOT unscale again
+    np.testing.assert_allclose(np.asarray(layer.weight._grad),
+                               g_after_unscale)
+    # true grad of sum(layer(x)) wrt w for x=1: 2.0 each
+    np.testing.assert_allclose(g_after_unscale, 2.0, rtol=1e-6)
+    # after update(), the flag resets: next cycle unscales again
+    opt.clear_grad()
+    loss2 = layer(x).sum()
+    scaler.scale(loss2).backward()
+    scaler.unscale_(opt)
+    np.testing.assert_allclose(np.asarray(layer.weight._grad), 2.0,
+                               rtol=1e-6)
+
+
+def test_weighted_cross_entropy():
+    """#3: F.cross_entropy(weight=...) must work and match manual calc."""
+    logits = np.random.RandomState(0).randn(5, 3).astype(np.float32)
+    labels = np.array([0, 1, 2, 1, 0], np.int64)
+    w = np.array([1.0, 2.0, 0.5], np.float32)
+    out = F.cross_entropy(paddle.to_tensor(logits),
+                          paddle.to_tensor(labels),
+                          weight=paddle.to_tensor(w))
+    # manual reference
+    ex = np.exp(logits - logits.max(-1, keepdims=True))
+    logp = np.log(ex / ex.sum(-1, keepdims=True))
+    per = -logp[np.arange(5), labels] * w[labels]
+    expected = per.sum() / w[labels].sum()
+    np.testing.assert_allclose(out.numpy(), expected, rtol=1e-5)
+
+
+def test_diag_embed_offset_square():
+    """#4: diag_embed with offset returns a square matrix."""
+    x = paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32))
+    out = paddle.diag_embed(x, offset=1)
+    assert tuple(out.shape) == (4, 4)
+    np.testing.assert_allclose(
+        out.numpy(),
+        np.diag(np.array([1, 2, 3], np.float32), k=1))
+    out2 = paddle.diag_embed(x, offset=-2)
+    assert tuple(out2.shape) == (5, 5)
+    np.testing.assert_allclose(
+        out2.numpy(), np.diag(np.array([1, 2, 3], np.float32), k=-2))
+
+
+def test_batch_norm_use_global_stats_in_training():
+    """#5: use_global_stats=True during training uses running stats."""
+    rm = paddle.to_tensor(np.array([10.0, -10.0], np.float32))
+    rv = paddle.to_tensor(np.array([4.0, 4.0], np.float32))
+    w = paddle.to_tensor(np.ones(2, np.float32))
+    b = paddle.to_tensor(np.zeros(2, np.float32))
+    x = np.random.RandomState(0).randn(6, 2).astype(np.float32)
+    y = F.batch_norm(paddle.to_tensor(x), rm, rv, w, b, training=True,
+                     use_global_stats=True, epsilon=1e-5)
+    expected = (x - np.array([10.0, -10.0])) / np.sqrt(4.0 + 1e-5)
+    np.testing.assert_allclose(y.numpy(), expected, rtol=1e-5, atol=1e-5)
+    # running stats must NOT have been updated
+    np.testing.assert_allclose(rm.numpy(), [10.0, -10.0])
+    np.testing.assert_allclose(rv.numpy(), [4.0, 4.0])
+
+
+def test_adamw_decoupled_decay_compiled_vs_eager():
+    """#1 follow-on: AdamW decoupled decay identical eager vs compiled."""
+    paddle.seed(11)
+    le, lc = nn.Linear(3, 2), nn.Linear(3, 2)
+    for (k, a), (_, b) in zip(le.state_dict().items(),
+                              lc.state_dict().items()):
+        b.set_value(a.numpy())
+    x = np.random.RandomState(2).randn(4, 3).astype(np.float32)
+    y = np.random.RandomState(3).randn(4, 2).astype(np.float32)
+
+    oe = paddle.optimizer.AdamW(learning_rate=0.01,
+                                parameters=le.parameters(),
+                                weight_decay=0.1)
+    loss = F.mse_loss(le(paddle.to_tensor(x)), paddle.to_tensor(y))
+    loss.backward()
+    oe.step()
+
+    oc = paddle.optimizer.AdamW(learning_rate=0.01,
+                                parameters=lc.parameters(),
+                                weight_decay=0.1)
+    eng = Engine(lc, oc, lambda o, t: F.mse_loss(o, t))
+    eng.train_batch(x, y)
+    eng.sync_to_layer()
+    for (k, a), (_, b) in zip(le.state_dict().items(),
+                              lc.state_dict().items()):
+        np.testing.assert_allclose(a.numpy(), b.numpy(), rtol=1e-5,
+                                   atol=1e-6, err_msg=k)
