@@ -94,7 +94,13 @@ class GradScaler:
         if not self._enable:
             return
         if id(optimizer) in self._unscaled:
-            return
+            # Explicit double-unscale between updates is user error (the
+            # reference/AmpScaler and torch both refuse); silently
+            # no-opping would leave grads scaled on the NEXT iteration
+            # when the user steps the optimizer directly.
+            raise RuntimeError(
+                "unscale_() has already been called on this optimizer "
+                "since the last update()")
         grads = [p._grad for p in optimizer._parameter_list or []
                  if p is not None and p._grad is not None]
         if grads:
@@ -113,7 +119,8 @@ class GradScaler:
         if not self._enable:
             optimizer.step()
             return
-        self.unscale_(optimizer)
+        if id(optimizer) not in self._unscaled:
+            self.unscale_(optimizer)
         if not self._found_inf:
             optimizer.step()
         self.update()

@@ -201,21 +201,10 @@ class HybridParallelEngine:
 
         # per-param decay/lr-mult constants (mirrors eager _preprocess);
         # block params take their meta from the template block's Parameter
-        from ..core.tensor import Parameter
-        tsd = template.state_dict()
-        block_metas = opt.param_metas(
-            {k: tsd[k] for k in self.block_params
-             if k in tsd and isinstance(tsd[k], Parameter)}) or None
-        if block_metas is not None and len(block_metas) != \
-                len(self.block_params):
-            block_metas = None
-        msd = self.model.state_dict()
-        rest_metas = opt.param_metas(
-            {k: msd[k] for k in self.rest_params
-             if k in msd and isinstance(msd[k], Parameter)}) or None
-        if rest_metas is not None and len(rest_metas) != \
-                len(self.rest_params):
-            rest_metas = None
+        block_metas = opt.param_metas_for(self.block_params,
+                                          template.state_dict())
+        rest_metas = opt.param_metas_for(self.rest_params,
+                                         self.model.state_dict())
 
         def loss_of(block_params, rest_params, buffers, batch, key):
             tokens, labels = batch

@@ -59,13 +59,7 @@ class PipelineEngine:
 
         grad_fn = jax.value_and_grad(micro_loss)
 
-        from ..core.tensor import Parameter
-        sd = layer.state_dict()
-        metas = opt.param_metas(
-            {k: sd[k] for k in self.params
-             if k in sd and isinstance(sd[k], Parameter)})
-        if len(metas) != len(self.params):
-            metas = None
+        metas = opt.param_metas_for(self.params, layer.state_dict())
 
         def step_fn(params, opt_state, buffers, x, y, lr, key):
             # x, y: [M, micro_batch, ...]

@@ -181,9 +181,6 @@ def make_train_step(layer, loss_fn, optimizer, *, grad_clip=None,
     # per-param decay/lr-mult metadata baked in as compile-time constants
     # (mirrors eager Optimizer._preprocess; ADVICE r1 fix)
     _sd = layer.state_dict()
-    param_metas = optimizer.param_metas(
-        {k: v for k, v in _sd.items()
-         if isinstance(v, Parameter) and not v.stop_gradient})
 
     def loss_of(params, buffers, batch, key):
         with _random.rng_scope(key):
@@ -204,8 +201,7 @@ def make_train_step(layer, loss_fn, optimizer, *, grad_clip=None,
     def step_fn(params, buffers, opt_state, batch, lr, key):
         (loss, new_buffers), grads = jax.value_and_grad(
             loss_of, has_aux=True)(params, buffers, batch, key)
-        metas = {k: param_metas[k] for k in params} \
-            if all(k in param_metas for k in params) else None
+        metas = optimizer.param_metas_for(params, _sd)
         # eager _preprocess order: coupled decay first, then clip
         grads = optimizer.decay_gradients_tree(params, grads, metas)
         if grad_clip is not None:

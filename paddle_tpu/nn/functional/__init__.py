@@ -490,14 +490,14 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     q = query.transpose([0, 2, 1, 3])
     k = key.transpose([0, 2, 1, 3])
     v = value.transpose([0, 2, 1, 3])
-    if attn_mask is not None:
-        out = apply("scaled_dot_product_attention", q, k, v, attn_mask,
-                    dropout_p=dropout_p, is_causal=is_causal)
+    use_dropout = dropout_p > 0.0 and training
+    if attn_mask is None and not use_dropout and _has_flash():
+        out = apply("flash_attention", q, k, v, is_causal=is_causal)
     else:
-        out = apply("flash_attention", q, k, v, is_causal=is_causal) \
-            if _has_flash() else apply(
-                "scaled_dot_product_attention", q, k, v,
-                dropout_p=dropout_p, is_causal=is_causal)
+        key = Tensor(_random.next_key()) if use_dropout else None
+        out = apply("scaled_dot_product_attention", q, k, v, attn_mask,
+                    key, dropout_p=dropout_p if use_dropout else 0.0,
+                    is_causal=is_causal)
     return out.transpose([0, 2, 1, 3])
 
 

@@ -1,0 +1,389 @@
+"""Fused ops: Pallas TPU kernels for the hot paths.
+
+Ref parity: paddle/fluid/operators/fused/ (multihead_matmul_op.cu,
+fused_embedding_eltwise_layernorm_op.cu, ...) — the reference hand-writes
+CUDA kernels for attention and friends; here the TPU equivalents are
+Pallas/Mosaic kernels with custom-VJP backward passes.
+
+flash_attention: blockwise online-softmax attention (fwd) + the standard
+two-pass recompute backward (dq pass gridded over q blocks, dkv pass
+gridded over kv blocks).  Layout [batch, heads, seq, head_dim].  A jnp
+reference path with the identical log-sum-exp formulation runs on CPU so
+the same op (and its gradients) is testable without a TPU; set
+PADDLE_TPU_FLASH_FORCE=pallas to exercise the kernels in interpreter mode.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+import os
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+try:  # pallas TPU backend is absent on some CPU-only installs
+    from jax.experimental.pallas import tpu as pltpu
+    _HAS_PLTPU = True
+except ImportError:  # pragma: no cover
+    pltpu = None
+    _HAS_PLTPU = False
+
+from ..core.op_registry import register_op
+
+_NEG_INF = -1e30
+
+# Block sizes: MXU-aligned (128 lanes); q/kv tiles of 128 keep the f32
+# accumulators + one k/v stream well under the ~16MB VMEM budget.
+_BLOCK_Q = 128
+_BLOCK_K = 128
+
+
+def _cdiv(a: int, b: int) -> int:
+    return -(-a // b)
+
+
+def _round_up(a: int, b: int) -> int:
+    return _cdiv(a, b) * b
+
+
+def _use_pallas() -> bool:
+    force = os.environ.get("PADDLE_TPU_FLASH_FORCE", "")
+    if force == "pallas":
+        return True
+    if force == "jnp":
+        return False
+    return _HAS_PLTPU and jax.default_backend() == "tpu"
+
+
+def _interpret() -> bool:
+    return (os.environ.get("PADDLE_TPU_FLASH_FORCE", "") == "pallas"
+            and jax.default_backend() != "tpu")
+
+
+# ---------------------------------------------------------------------------
+# forward kernel
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
+                kv_len, block_k, causal_off):
+    # q_ref: (1, bq, d), k/v_ref: (1, sk, d), o_ref: (1, bq, d),
+    # lse_ref: (1, bq, 128) — lse broadcast along a lane dim because TPU
+    # blocks need the last two dims (8,128)-aligned (same layout as the
+    # jax.experimental.pallas.ops.tpu.flash_attention scratch).
+    bq, d = q_ref.shape[1], q_ref.shape[2]
+    sk = k_ref.shape[1]
+    nk = sk // block_k
+    q = q_ref[0].astype(jnp.float32) * scale
+    q_off = pl.program_id(1) * bq
+    q_idx = q_off + lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+
+    def body(t, carry):
+        acc, m_i, l_i = carry
+        k = k_ref[0, pl.dslice(t * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.dslice(t * block_k, block_k), :].astype(jnp.float32)
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+        k_idx = t * block_k + lax.broadcasted_iota(
+            jnp.int32, (bq, block_k), 1)
+        mask = k_idx < kv_len
+        if causal:
+            # bottom-right alignment (KV-cache convention): query i sees
+            # keys up to i + (kv_len - q_len), matching the sdpa fallback
+            mask = mask & (q_idx + causal_off >= k_idx)
+        s = jnp.where(mask, s, _NEG_INF)
+        m_new = jnp.maximum(m_i, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m_i - m_new)
+        l_new = l_i * alpha + jnp.sum(p, axis=-1)
+        acc = acc * alpha[:, None] + jnp.dot(
+            p, v, preferred_element_type=jnp.float32)
+        return acc, m_new, l_new
+
+    acc0 = jnp.zeros((bq, d), jnp.float32)
+    m0 = jnp.full((bq,), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq,), jnp.float32)
+    acc, m_i, l_i = lax.fori_loop(0, nk, body, (acc0, m0, l0))
+    l_safe = jnp.where(l_i == 0.0, 1.0, l_i)
+    o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+    lse_ref[0] = jnp.broadcast_to((m_i + jnp.log(l_safe))[:, None],
+                                  lse_ref.shape[1:])
+
+
+def _flash_fwd_pallas(q, k, v, scale, causal):
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    nq = _cdiv(sq, _BLOCK_Q)
+    grid = (bh, nq)
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal, kv_len=sk,
+        block_k=min(_BLOCK_K, _round_up(sk, _BLOCK_K)),
+        causal_off=sk - sq)
+    sk_pad = _round_up(sk, _BLOCK_K)
+    sq_pad = nq * _BLOCK_Q
+    q = jnp.pad(q, ((0, 0), (0, sq_pad - sq), (0, 0)))
+    k = jnp.pad(k, ((0, 0), (0, sk_pad - sk), (0, 0)))
+    v = jnp.pad(v, ((0, 0), (0, sk_pad - sk), (0, 0)))
+    vmem = pltpu.VMEM if _HAS_PLTPU else None
+    bspec = lambda shape, imap: pl.BlockSpec(  # noqa: E731
+        shape, imap, memory_space=vmem)
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            bspec((1, _BLOCK_Q, d), lambda i, j: (i, j, 0)),
+            bspec((1, sk_pad, d), lambda i, j: (i, 0, 0)),
+            bspec((1, sk_pad, d), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=[
+            bspec((1, _BLOCK_Q, d), lambda i, j: (i, j, 0)),
+            bspec((1, _BLOCK_Q, 128), lambda i, j: (i, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sq_pad, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, sq_pad, 128), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(q, k, v)
+    return o[:, :sq], lse[:, :sq, 0]
+
+
+# ---------------------------------------------------------------------------
+# backward kernels (two-pass recompute, FlashAttention-2 style)
+# ---------------------------------------------------------------------------
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   *, scale, causal, kv_len, block_k, causal_off):
+    # lse_ref/delta_ref: (1, bq, 128) lane-broadcast (see _fwd_kernel)
+    bq, d = q_ref.shape[1], q_ref.shape[2]
+    sk = k_ref.shape[1]
+    nk = sk // block_k
+    q = q_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0, :, 0]
+    delta = delta_ref[0, :, 0]
+    q_off = pl.program_id(1) * bq
+    q_idx = q_off + lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+
+    def body(t, dq):
+        k = k_ref[0, pl.dslice(t * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.dslice(t * block_k, block_k), :].astype(jnp.float32)
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        k_idx = t * block_k + lax.broadcasted_iota(
+            jnp.int32, (bq, block_k), 1)
+        mask = k_idx < kv_len
+        if causal:
+            mask = mask & (q_idx + causal_off >= k_idx)
+        p = jnp.where(mask, jnp.exp(s - lse[:, None]), 0.0)
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None])
+        return dq + jnp.dot(ds, k, preferred_element_type=jnp.float32)
+
+    dq = lax.fori_loop(0, nk, body, jnp.zeros((bq, d), jnp.float32))
+    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, *, scale, causal, q_len, block_q,
+                    causal_off):
+    bk, d = k_ref.shape[1], k_ref.shape[2]
+    sq = q_ref.shape[1]
+    nq = sq // block_q
+    k = k_ref[0].astype(jnp.float32)
+    v = v_ref[0].astype(jnp.float32)
+    k_off = pl.program_id(1) * bk
+    k_idx = k_off + lax.broadcasted_iota(jnp.int32, (block_q, bk), 1)
+
+    def body(t, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.dslice(t * block_q, block_q), :].astype(jnp.float32)
+        do = do_ref[0, pl.dslice(t * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.dslice(t * block_q, block_q), 0]
+        delta = delta_ref[0, pl.dslice(t * block_q, block_q), 0]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        q_idx = t * block_q + lax.broadcasted_iota(
+            jnp.int32, (block_q, bk), 0)
+        # padded q rows have lse=0 from the padded forward => exp(s) can
+        # explode; mask on q_len as well as causal structure.
+        mask = q_idx < q_len
+        if causal:
+            mask = mask & (q_idx + causal_off >= k_idx)
+        p = jnp.where(mask, jnp.exp(s - lse[:, None]), 0.0)
+        dv = dv + jnp.dot(p.T, do, preferred_element_type=jnp.float32)
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None])
+        dk = dk + jnp.dot(ds.T, q, preferred_element_type=jnp.float32)
+        return dk, dv
+
+    dk0 = jnp.zeros((bk, d), jnp.float32)
+    dv0 = jnp.zeros((bk, d), jnp.float32)
+    dk, dv = lax.fori_loop(0, nq, body, (dk0, dv0))
+    dk_ref[0] = (dk * scale).astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_bwd_pallas(q, k, v, o, lse, do, scale, causal):
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    nq = _cdiv(sq, _BLOCK_Q)
+    nk = _cdiv(sk, _BLOCK_K)
+    sq_pad, sk_pad = nq * _BLOCK_Q, nk * _BLOCK_K
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    qp = jnp.pad(q, ((0, 0), (0, sq_pad - sq), (0, 0)))
+    kp = jnp.pad(k, ((0, 0), (0, sk_pad - sk), (0, 0)))
+    vp = jnp.pad(v, ((0, 0), (0, sk_pad - sk), (0, 0)))
+    dop = jnp.pad(do, ((0, 0), (0, sq_pad - sq), (0, 0)))
+    lsep = jnp.broadcast_to(
+        jnp.pad(lse, ((0, 0), (0, sq_pad - sq)))[..., None],
+        (bh, sq_pad, 128))
+    deltap = jnp.broadcast_to(
+        jnp.pad(delta, ((0, 0), (0, sq_pad - sq)))[..., None],
+        (bh, sq_pad, 128))
+    vmem = pltpu.VMEM if _HAS_PLTPU else None
+    bspec = lambda shape, imap: pl.BlockSpec(  # noqa: E731
+        shape, imap, memory_space=vmem)
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          kv_len=sk, block_k=_BLOCK_K, causal_off=sk - sq),
+        grid=(bh, nq),
+        in_specs=[
+            bspec((1, _BLOCK_Q, d), lambda i, j: (i, j, 0)),
+            bspec((1, sk_pad, d), lambda i, j: (i, 0, 0)),
+            bspec((1, sk_pad, d), lambda i, j: (i, 0, 0)),
+            bspec((1, _BLOCK_Q, d), lambda i, j: (i, j, 0)),
+            bspec((1, _BLOCK_Q, 128), lambda i, j: (i, j, 0)),
+            bspec((1, _BLOCK_Q, 128), lambda i, j: (i, j, 0)),
+        ],
+        out_specs=bspec((1, _BLOCK_Q, d), lambda i, j: (i, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, sq_pad, d), q.dtype),
+        interpret=_interpret(),
+    )(qp, kp, vp, dop, lsep, deltap)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          q_len=sq, block_q=_BLOCK_Q, causal_off=sk - sq),
+        grid=(bh, nk),
+        in_specs=[
+            bspec((1, sq_pad, d), lambda i, j: (i, 0, 0)),
+            bspec((1, _BLOCK_K, d), lambda i, j: (i, j, 0)),
+            bspec((1, _BLOCK_K, d), lambda i, j: (i, j, 0)),
+            bspec((1, sq_pad, d), lambda i, j: (i, 0, 0)),
+            bspec((1, sq_pad, 128), lambda i, j: (i, 0, 0)),
+            bspec((1, sq_pad, 128), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=[
+            bspec((1, _BLOCK_K, d), lambda i, j: (i, j, 0)),
+            bspec((1, _BLOCK_K, d), lambda i, j: (i, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sk_pad, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, sk_pad, d), v.dtype),
+        ],
+        interpret=_interpret(),
+    )(qp, kp, vp, dop, lsep, deltap)
+    return dq[:, :sq], dk[:, :sk], dv[:, :sk]
+
+
+# ---------------------------------------------------------------------------
+# jnp reference path (identical lse formulation; runs anywhere)
+# ---------------------------------------------------------------------------
+
+
+def _flash_fwd_jnp(q, k, v, scale, causal):
+    qf = q.astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    s = jnp.einsum("bqd,bkd->bqk", qf, kf) * scale
+    if causal:
+        sq, sk = s.shape[-2], s.shape[-1]
+        q_idx = lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
+        k_idx = lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+        s = jnp.where(q_idx + (sk - sq) >= k_idx, s, _NEG_INF)
+    m = jnp.max(s, axis=-1)
+    p = jnp.exp(s - m[..., None])
+    l = jnp.sum(p, axis=-1)
+    o = jnp.einsum("bqk,bkd->bqd", p / l[..., None],
+                   v.astype(jnp.float32))
+    return o.astype(q.dtype), m + jnp.log(l)
+
+
+def _flash_bwd_jnp(q, k, v, o, lse, do, scale, causal):
+    qf, kf, vf = (t.astype(jnp.float32) for t in (q, k, v))
+    dof = do.astype(jnp.float32)
+    s = jnp.einsum("bqd,bkd->bqk", qf, kf) * scale
+    if causal:
+        sq, sk = s.shape[-2], s.shape[-1]
+        q_idx = lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
+        k_idx = lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+        s = jnp.where(q_idx + (sk - sq) >= k_idx, s, _NEG_INF)
+    p = jnp.exp(s - lse[..., None])
+    delta = jnp.sum(dof * o.astype(jnp.float32), axis=-1)
+    dv = jnp.einsum("bqk,bqd->bkd", p, dof)
+    dp = jnp.einsum("bqd,bkd->bqk", dof, vf)
+    ds = p * (dp - delta[..., None])
+    dq = jnp.einsum("bqk,bkd->bqd", ds, kf) * scale
+    dk = jnp.einsum("bqk,bqd->bkd", ds, qf) * scale
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+# ---------------------------------------------------------------------------
+# public op
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash_attention(q, k, v, causal, scale):
+    o, _ = _flash_fwd(q, k, v, causal, scale)
+    return o
+
+
+def _flash_fwd(q, k, v, causal, scale):
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    q3 = q.reshape(b * h, sq, d)
+    k3 = k.reshape(b * h, sk, d)
+    v3 = v.reshape(b * h, sk, d)
+    if _use_pallas():
+        o3, lse3 = _flash_fwd_pallas(q3, k3, v3, scale, causal)
+    else:
+        o3, lse3 = _flash_fwd_jnp(q3, k3, v3, scale, causal)
+    return o3.reshape(b, h, sq, d), lse3.reshape(b, h, sq)
+
+
+def _flash_fwd_rule(q, k, v, causal, scale):
+    o, lse = _flash_fwd(q, k, v, causal, scale)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_bwd_rule(causal, scale, res, g):
+    q, k, v, o, lse = res
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    args = (q.reshape(b * h, sq, d), k.reshape(b * h, sk, d),
+            v.reshape(b * h, sk, d), o.reshape(b * h, sq, d),
+            lse.reshape(b * h, sq), g.reshape(b * h, sq, d))
+    if _use_pallas():
+        dq, dk, dv = _flash_bwd_pallas(*args, scale, causal)
+    else:
+        dq, dk, dv = _flash_bwd_jnp(*args, scale, causal)
+    return (dq.reshape(b, h, sq, d), dk.reshape(b, h, sk, d),
+            dv.reshape(b, h, sk, d))
+
+
+_flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+@register_op("flash_attention")
+def flash_attention(q, k, v, *, is_causal=False, scale=None):
+    """Flash attention. q,k,v: [batch, heads, seq, head_dim].
+
+    Ref parity: paddle/fluid/operators/fused/multihead_matmul_op.cu — the
+    reference fuses QK^T + softmax + PV in one CUDA kernel; here it is a
+    Pallas online-softmax kernel with custom-VJP backward.
+    """
+    q, k, v = jnp.asarray(q), jnp.asarray(k), jnp.asarray(v)
+    s = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    return _flash_attention(q, k, v, bool(is_causal), float(s))

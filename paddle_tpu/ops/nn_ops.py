@@ -526,8 +526,12 @@ def dropout(x, key, *, p=0.5, training=True, mode="upscale_in_train"):
 
 
 @register_op("scaled_dot_product_attention")
-def sdpa(q, k, v, mask=None, *, dropout_p=0.0, is_causal=False, scale=None):
-    """q,k,v: [batch, heads, seq, head_dim] (already transposed)."""
+def sdpa(q, k, v, mask=None, key=None, *, dropout_p=0.0, is_causal=False,
+         scale=None):
+    """q,k,v: [batch, heads, seq, head_dim] (already transposed).
+
+    `key` (PRNG key) enables attention-probability dropout; without a key
+    dropout_p is inert (inference / dropout disabled)."""
     d = q.shape[-1]
     s = scale if scale is not None else 1.0 / math.sqrt(d)
     logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) * s
@@ -543,6 +547,10 @@ def sdpa(q, k, v, mask=None, *, dropout_p=0.0, is_causal=False, scale=None):
         else:
             logits = logits + mask
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    if key is not None and dropout_p > 0.0:
+        keep = jax.random.bernoulli(jnp.asarray(key), 1.0 - dropout_p,
+                                    probs.shape).astype(probs.dtype)
+        probs = probs * keep / (1.0 - dropout_p)
     return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
 
 

@@ -202,6 +202,19 @@ class Optimizer:
         floats; compiled into the train step as constants)."""
         return {k: self._leaf_meta(p) for k, p in named_params.items()}
 
+    def param_metas_for(self, param_names, state_dict):
+        """Metas for `param_names` resolved from a layer `state_dict`, or
+        None when any name is missing / not a Parameter (engines then run
+        without per-param decay/lr metadata). Single point of truth for
+        the compiled engines (engine/pp_engine/hybrid)."""
+        from ..core.tensor import Parameter
+
+        sel = {k: state_dict.get(k) for k in param_names}
+        if not sel or any(not isinstance(v, Parameter)
+                          for v in sel.values()):
+            return None
+        return self.param_metas(sel)
+
     def decay_gradients_tree(self, params, grads, metas):
         """Fold coupled L2/L1 decay into grads — called by the compiled
         engines BEFORE grad clipping, matching the eager `_preprocess`
