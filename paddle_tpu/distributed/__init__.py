@@ -17,6 +17,7 @@ from .topology import (  # noqa: F401
     CommunicateTopology, HybridCommunicateGroup, ParallelMode,
 )
 from . import fleet  # noqa: F401
+from . import checkpoint  # noqa: F401
 
 
 def spawn(func, args=(), nprocs=-1, **kwargs):

@@ -124,8 +124,14 @@ class Fleet:
     # -- checkpoint ----------------------------------------------------------
     def save_persistables(self, executor=None, dirname=None,
                           main_program=None, mode=0):
-        raise NotImplementedError(
-            "use paddle_tpu.save / distributed.checkpoint for state saving")
+        """ref: fleet_base.py save_persistables -> the_one_ps runtime.
+        `executor` is the Engine or Layer holding the state (the TPU path
+        has no Executor/Program split); `dirname` the checkpoint dir."""
+        from ...checkpoint import save_persistables as _save
+
+        if executor is None or dirname is None:
+            raise ValueError("save_persistables(engine_or_layer, dirname)")
+        _save(executor, dirname)
 
     @property
     def hcg(self):
