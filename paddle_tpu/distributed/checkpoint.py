@@ -57,8 +57,14 @@ def save_state(path, state, *, metadata=None):
     ckpt.save(path, state, force=True)
     ckpt.wait_until_finished()
     if metadata is not None:
-        with open(os.path.join(path, "paddle_meta.json"), "w") as f:
+        # atomic: a crash mid-write must not leave a valid-looking orbax
+        # dir with truncated/absent metadata that would silently reset
+        # step/RNG on resume
+        meta_path = os.path.join(path, "paddle_meta.json")
+        tmp = meta_path + ".tmp"
+        with open(tmp, "w") as f:
             json.dump(metadata, f)
+        os.replace(tmp, meta_path)
 
 
 def load_state(path, template, *, shardings=None):
@@ -104,11 +110,16 @@ def _restore_rng(meta):
 
 def save_train_state(path, engine):
     """Checkpoint an engine.Engine: params, optimizer moments, buffers,
-    step count, and the host RNG stream position."""
+    step count, LR-scheduler position, and the host RNG stream."""
+    from ..optimizer.lr import LRScheduler
+
     st = engine.state
+    meta = {"step": int(st.step), **_rng_metadata()}
+    lr = getattr(engine.optimizer, "_learning_rate", None)
+    if isinstance(lr, LRScheduler):
+        meta["lr_scheduler"] = lr.state_dict()
     save_state(path, {"params": st.params, "opt_state": st.opt_state,
-                      "buffers": st.buffers},
-               metadata={"step": int(st.step), **_rng_metadata()})
+                      "buffers": st.buffers}, metadata=meta)
 
 
 def _engine_shardings(engine):
@@ -142,9 +153,18 @@ def load_train_state(path, engine):
     restored = load_state(path, tpl, shardings=_engine_shardings(engine))
     st.params, st.opt_state, st.buffers = (
         restored["params"], restored["opt_state"], restored["buffers"])
-    meta = load_metadata(path) or {}
+    meta = load_metadata(path)
+    if meta is None:
+        raise FileNotFoundError(
+            f"checkpoint {path} has no paddle_meta.json — it was written "
+            "by an interrupted save and cannot be resumed exactly")
     st.step = int(meta.get("step", 0))
     _restore_rng(meta)
+    from ..optimizer.lr import LRScheduler
+
+    lr = getattr(engine.optimizer, "_learning_rate", None)
+    if isinstance(lr, LRScheduler) and "lr_scheduler" in meta:
+        lr.set_state_dict(meta["lr_scheduler"])
     engine.sync_to_layer()
     return engine
 

@@ -24,12 +24,22 @@ def _pair(v, n=2):
     return (int(v),) * n
 
 
-def _conv_padding(padding, spatial, strides, dilations, ksizes):
+def _conv_padding(padding, spatial, strides, dilations, ksizes,
+                  channel_last=False):
     """Normalise paddle padding spec to lax's [(lo, hi), ...] or string."""
     if isinstance(padding, str):
         return padding.upper()  # 'SAME' / 'VALID'
     if isinstance(padding, int):
         return [(padding, padding)] * spatial
+    if len(padding) > 0 and all(isinstance(p, (list, tuple)) for p in padding):
+        pairs = [(int(lo), int(hi)) for lo, hi in padding]
+        if len(pairs) == spatial:
+            return pairs
+        if len(pairs) == spatial + 2:
+            # paddle's full-rank form includes batch/channel pairs: NCHW
+            # keeps them in front, NHWC wraps the spatial dims
+            return pairs[1:-1] if channel_last else pairs[2:]
+        raise ValueError(f"bad padding {padding!r}")
     pads = [int(p) for p in padding]
     if len(pads) == spatial:
         return [(p, p) for p in pads]
@@ -44,7 +54,8 @@ def conv2d(x, weight, *, stride=1, padding=0, dilation=1, groups=1,
     strides = _pair(stride)
     dilations = _pair(dilation)
     kh, kw = weight.shape[-2], weight.shape[-1]
-    pad = _conv_padding(padding, 2, strides, dilations, (kh, kw))
+    pad = _conv_padding(padding, 2, strides, dilations, (kh, kw),
+                        channel_last=(data_format != "NCHW"))
     dn = lax.conv_dimension_numbers(
         x.shape, weight.shape,
         ("NCHW", "OIHW", "NCHW") if data_format == "NCHW"

@@ -131,16 +131,23 @@ def kthvalue(x, *, k, axis=-1, keepdim=False):
 
 @register_op("mode_op", has_aux=True)
 def mode_op(x, *, axis=-1, keepdim=False):
-    # eager-only (uses host numpy); mode of each 1-d lane along `axis`
-    import numpy as np
-
-    arr = np.asarray(x)
-
-    def _mode_1d(a):
-        vals, counts = np.unique(a, return_counts=True)
-        return vals[np.argmax(counts)]
-
-    m = np.apply_along_axis(_mode_1d, axis, arr)
+    """Mode along `axis`: most frequent value (ties -> smallest value),
+    index of its last occurrence. O(n^2) equality-matrix counting keeps it
+    jit-able with static shapes (lanes are short in practice)."""
+    orig_dtype = x.dtype
+    if x.dtype == jnp.bool_:
+        x = x.astype(jnp.int32)
+    xm = jnp.moveaxis(x, axis, -1)
+    eq = xm[..., :, None] == xm[..., None, :]
+    counts = eq.sum(-1)
+    is_max = counts == counts.max(-1, keepdims=True)
+    big = jnp.asarray(jnp.inf, xm.dtype) if jnp.issubdtype(
+        xm.dtype, jnp.floating) else jnp.iinfo(xm.dtype).max
+    mode_val = jnp.where(is_max, xm, big).min(-1)
+    match = xm == mode_val[..., None]
+    n = xm.shape[-1]
+    idx = (n - 1) - jnp.argmax(jnp.flip(match, -1), -1)
     if keepdim:
-        m = np.expand_dims(m, axis)
-    return jnp.asarray(m), jnp.asarray(np.zeros(m.shape, dtype=np.int64))
+        mode_val = jnp.expand_dims(mode_val, axis)
+        idx = jnp.expand_dims(idx, axis)
+    return mode_val.astype(orig_dtype), idx.astype(jnp.int64)

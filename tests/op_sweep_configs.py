@@ -1,0 +1,1180 @@
+"""Per-op config table driving the full-registry OpTest sweep.
+
+Ref parity: python/paddle/fluid/tests/unittests/op_test.py:270 declares
+numpy inputs + expected outputs per op; white_list/ files govern exemptions.
+Here every registered op gets >=1 case; `ref` is a numpy reference where the
+output is deterministic, `prop` is a property validator where it is not
+(decompositions with sign freedom, random samplers). test_op_sweep.py
+enforces that the table covers the whole registry.
+
+Case fields:
+  inputs   list of np arrays (or KEY sentinel -> jax PRNG key)
+  attrs    dict passed as op attrs
+  ref      callable(*inputs, **attrs) -> expected array(s), or None
+  prop     callable(outs, inputs, attrs) -> None (asserts), or None
+  grad     tuple of input indices to grad-check via tape-vs-jax.grad
+  bf16     run a bfloat16 forward and require finite outputs of same shape
+  mode     'dispatch' (through apply) | 'fn' (call opdef.fn directly)
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+KEY = "__prng_key__"  # replaced with jax.random.PRNGKey(0) at run time
+
+CASES: dict[str, list[dict]] = {}
+# ops expected to raise NotImplementedError (tracked, not silently skipped)
+UNIMPLEMENTED: set[str] = set()
+
+
+def case(name, inputs, attrs=None, *, ref=None, prop=None, grad=(0,),
+         bf16=True, mode="dispatch", rtol=1e-5, atol=1e-6,
+         grad_rtol=1e-4, grad_atol=1e-5):
+    CASES.setdefault(name, []).append(dict(
+        inputs=list(inputs), attrs=dict(attrs or {}), ref=ref, prop=prop,
+        grad=grad, bf16=bf16, mode=mode, rtol=rtol, atol=atol,
+        grad_rtol=grad_rtol, grad_atol=grad_atol))
+
+
+def rs(seed=0):
+    return np.random.RandomState(seed)
+
+
+def f32(shape, lo=-1.0, hi=1.0, seed=0):
+    return rs(seed).uniform(lo, hi, shape).astype(np.float32)
+
+
+def pos(shape, lo=0.2, hi=2.0, seed=0):
+    return f32(shape, lo, hi, seed)
+
+
+def ints(shape, lo=0, hi=10, seed=0, dtype=np.int32):
+    return rs(seed).randint(lo, hi, shape).astype(dtype)
+
+
+def spd(n, seed=0):
+    a = rs(seed).randn(n, n).astype(np.float32)
+    return (a @ a.T + n * np.eye(n)).astype(np.float32)
+
+
+def np_sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+def np_softplus(x):
+    return np.log1p(np.exp(-np.abs(x))) + np.maximum(x, 0)
+
+
+def np_softmax(x, axis=-1):
+    e = np.exp(x - x.max(axis=axis, keepdims=True))
+    return e / e.sum(axis=axis, keepdims=True)
+
+
+def np_erf(x):
+    return np.vectorize(math.erf)(np.asarray(x, np.float64)).astype(np.float64)
+
+
+def np_conv2d(x, w, stride=1, padding=0, dilation=1, groups=1):
+    """Direct-loop NCHW conv reference (tiny shapes only)."""
+    st = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    pd = (padding, padding) if isinstance(padding, int) else tuple(padding)
+    dl = (dilation, dilation) if isinstance(dilation, int) else tuple(dilation)
+    n, cin, h, wid = x.shape
+    cout, cing, kh, kw = w.shape
+    xp = np.pad(x, ((0, 0), (0, 0), (pd[0], pd[0]), (pd[1], pd[1])))
+    oh = (h + 2 * pd[0] - dl[0] * (kh - 1) - 1) // st[0] + 1
+    ow = (wid + 2 * pd[1] - dl[1] * (kw - 1) - 1) // st[1] + 1
+    out = np.zeros((n, cout, oh, ow), np.float64)
+    cpg = cin // groups  # in-channels per group
+    opg = cout // groups
+    for b in range(n):
+        for o in range(cout):
+            g = o // opg
+            for i in range(oh):
+                for j in range(ow):
+                    acc = 0.0
+                    for c in range(cpg):
+                        for p in range(kh):
+                            for q in range(kw):
+                                acc += (
+                                    xp[b, g * cpg + c,
+                                       i * st[0] + p * dl[0],
+                                       j * st[1] + q * dl[1]]
+                                    * w[o, c, p, q])
+                    out[b, o, i, j] = acc
+    return out.astype(np.float32)
+
+
+def np_pool2d(x, ksize, stride=None, padding=0, pooling_type="max",
+              exclusive=True):
+    ks = (ksize, ksize) if isinstance(ksize, int) else tuple(ksize)
+    st = ks if stride is None else (
+        (stride, stride) if isinstance(stride, int) else tuple(stride))
+    pd = (padding, padding) if isinstance(padding, int) else tuple(padding)
+    n, c, h, w = x.shape
+    oh = (h + 2 * pd[0] - ks[0]) // st[0] + 1
+    ow = (w + 2 * pd[1] - ks[1]) // st[1] + 1
+    out = np.zeros((n, c, oh, ow), np.float64)
+    for i in range(oh):
+        for j in range(ow):
+            y0, x0 = i * st[0] - pd[0], j * st[1] - pd[1]
+            y1, x1 = y0 + ks[0], x0 + ks[1]
+            yy0, xx0 = max(y0, 0), max(x0, 0)
+            yy1, xx1 = min(y1, h), min(x1, w)
+            win = x[:, :, yy0:yy1, xx0:xx1]
+            if pooling_type == "max":
+                out[:, :, i, j] = win.max(axis=(2, 3))
+            else:
+                denom = (yy1 - yy0) * (xx1 - xx0) if exclusive \
+                    else ks[0] * ks[1]
+                out[:, :, i, j] = win.sum(axis=(2, 3)) / denom
+    return out.astype(np.float32)
+
+
+def finite(outs, inputs, attrs):
+    for o in outs:
+        a = np.asarray(o, np.float64) if np.issubdtype(
+            np.asarray(o).dtype, np.floating) else None
+        if a is not None:
+            assert np.isfinite(a).all(), "non-finite output"
+
+
+# ===========================================================================
+# unary math (np-ref'd)
+# ===========================================================================
+
+_X = f32((3, 4), -0.9, 0.9, seed=1)
+_XP = pos((3, 4), seed=2)
+_XW = f32((3, 4), -3.0, 3.0, seed=3)
+
+for name, ref, inp in [
+    ("abs", np.abs, _XW), ("neg", np.negative, _XW),
+    ("ceil", np.ceil, _XW), ("floor", np.floor, _XW),
+    ("round", np.round, _XW), ("trunc", np.trunc, _XW),
+    ("square", np.square, _XW), ("exp", np.exp, _XW),
+    ("expm1", np.expm1, _XW),
+    ("frac", lambda x: x - np.trunc(x), _XW),
+    ("sqrt", np.sqrt, _XP), ("rsqrt", lambda x: 1 / np.sqrt(x), _XP),
+    ("reciprocal", lambda x: 1 / x, _XP),
+    ("log", np.log, _XP), ("log2", np.log2, _XP),
+    ("log10", np.log10, _XP), ("log1p", np.log1p, _XP),
+    ("sin", np.sin, _XW), ("cos", np.cos, _XW), ("tan", np.tan, _X),
+    ("asin", np.arcsin, _X), ("acos", np.arccos, _X),
+    ("atan", np.arctan, _XW),
+    ("sinh", np.sinh, _XW), ("cosh", np.cosh, _XW), ("tanh", np.tanh, _XW),
+    ("asinh", np.arcsinh, _XW),
+    ("acosh", np.arccosh, pos((3, 4), 1.1, 3.0, seed=4)),
+    ("atanh", np.arctanh, _X),
+    ("erf", np_erf, _XW),
+    ("i0", np.i0, _XW),
+    ("lgamma", lambda x: np.vectorize(math.lgamma)(
+        np.asarray(x, np.float64)), _XP),
+    ("sigmoid", np_sigmoid, _XW),
+    ("logsigmoid", lambda x: np.log(np_sigmoid(x)), _XW),
+    ("softsign", lambda x: x / (1 + np.abs(x)), _XW),
+    ("tanh_shrink", lambda x: x - np.tanh(x), _XW),
+]:
+    case(name, [inp], ref=ref, rtol=2e-5, atol=2e-5)
+
+# domain-sensitive / no clean numpy reference: consistency + grad only
+case("digamma", [_XP], ref=None, prop=finite)
+case("erfinv", [_X], ref=None, prop=lambda outs, inputs, attrs:
+     np.testing.assert_allclose(np_erf(np.asarray(outs[0], np.float64)),
+                                inputs[0], rtol=1e-4, atol=1e-5))
+
+# no-grad predicates
+_NAN = np.array([[0.0, np.nan], [np.inf, -np.inf]], np.float32)
+case("isnan", [_NAN], ref=np.isnan, grad=None, bf16=False)
+case("isinf", [_NAN], ref=np.isinf, grad=None, bf16=False)
+case("isfinite", [_NAN], ref=np.isfinite, grad=None, bf16=False)
+case("sign", [_XW], ref=np.sign, grad=None)
+case("logical_not", [ints((3, 4), 0, 2).astype(bool)],
+     ref=np.logical_not, grad=None, bf16=False)
+
+# ===========================================================================
+# activations
+# ===========================================================================
+
+case("relu", [_XW], ref=lambda x: np.maximum(x, 0))
+case("relu6", [f32((3, 4), -2, 8, seed=5)],
+     ref=lambda x: np.clip(x, 0, 6))
+case("leaky_relu", [_XW], {"negative_slope": 0.1},
+     ref=lambda x, negative_slope: np.where(x >= 0, x, negative_slope * x))
+case("elu", [_XW], {"alpha": 0.8},
+     ref=lambda x, alpha: np.where(x > 0, x, alpha * np.expm1(x)))
+case("celu", [_XW], {"alpha": 0.8},
+     ref=lambda x, alpha: np.maximum(x, 0) +
+     np.minimum(0, alpha * np.expm1(x / alpha)))
+case("selu", [_XW],
+     ref=lambda x: 1.0507009873554805 * np.where(
+         x > 0, x, 1.6732632423543772 * np.expm1(x)))
+case("gelu", [_XW],
+     ref=lambda x: 0.5 * x * (1 + np_erf(x / math.sqrt(2))),
+     rtol=1e-4, atol=1e-5)
+case("gelu", [_XW], {"approximate": True},
+     ref=lambda x, approximate: 0.5 * x * (1 + np.tanh(
+         math.sqrt(2 / math.pi) * (x + 0.044715 * x ** 3))),
+     rtol=1e-4, atol=1e-5)
+case("silu", [_XW], ref=lambda x: x * np_sigmoid(x))
+case("swish", [_XW], ref=lambda x: x * np_sigmoid(x))
+case("mish", [_XW], ref=lambda x: x * np.tanh(np_softplus(x)))
+case("hardshrink", [_XW], {"threshold": 0.5},
+     ref=lambda x, threshold: np.where(np.abs(x) > threshold, x, 0.0))
+case("hardsigmoid", [_XW],
+     ref=lambda x: np.clip(x / 6.0 + 0.5, 0, 1))
+case("hardswish", [_XW],
+     ref=lambda x: x * np.clip(x / 6.0 + 0.5, 0, 1))
+case("hardtanh", [_XW], {"min": -0.7, "max": 0.7},
+     ref=lambda x, min, max: np.clip(x, min, max))
+case("softplus", [_XW], {"beta": 2.0, "threshold": 20.0},
+     ref=lambda x, beta, threshold: np_softplus(x * beta) / beta)
+case("softplus_default", [_XW], ref=np_softplus)
+case("softshrink", [_XW], {"threshold": 0.3},
+     ref=lambda x, threshold: np.where(
+         x > threshold, x - threshold,
+         np.where(x < -threshold, x + threshold, 0.0)))
+case("stanh", [_XW], {"scale_a": 0.67, "scale_b": 1.7159},
+     ref=lambda x, scale_a, scale_b: scale_b * np.tanh(scale_a * x))
+case("prelu", [_XW, pos((4,), seed=6)], grad=(0, 1),
+     ref=lambda x, a: np.where(x >= 0, x, a * x))
+
+# ===========================================================================
+# binary elementwise + comparison
+# ===========================================================================
+
+_A = f32((3, 4), -2, 2, seed=7)
+_B = f32((3, 4), 0.5, 2.5, seed=8)
+
+for name, ref in [
+    ("elementwise_add", np.add), ("elementwise_sub", np.subtract),
+    ("elementwise_mul", np.multiply), ("elementwise_div", np.divide),
+    ("elementwise_max", np.maximum), ("elementwise_min", np.minimum),
+    ("elementwise_mod", np.mod), ("elementwise_floordiv", np.floor_divide),
+    ("elementwise_heaviside", np.heaviside),
+    ("fmax", np.fmax), ("fmin", np.fmin),
+    ("atan2", np.arctan2), ("logaddexp", np.logaddexp),
+    ("nextafter", np.nextafter),
+]:
+    g = None if name in ("elementwise_floordiv", "elementwise_heaviside",
+                         "nextafter") else (0, 1)
+    case(name, [_A, _B], ref=ref, grad=g,
+         bf16=(name != "nextafter"))
+case("elementwise_pow", [_B, _A], ref=np.power, grad=(0, 1))
+# paddle axis-broadcast: y's dims align to x starting at `axis`
+case("elementwise_add", [f32((2, 3, 4), seed=9), f32((3,), seed=10)],
+     {"axis": 1},
+     ref=lambda x, y, axis: x + y.reshape(1, 3, 1), grad=(0, 1))
+case("maximum", [_A, _B], ref=np.maximum, grad=(0, 1))
+case("minimum", [_A, _B], ref=np.minimum, grad=(0, 1))
+case("remainder", [_A, _B], ref=np.remainder, grad=None)
+case("lerp", [_A, _B, np.full((), 0.3, np.float32)], grad=(0, 1),
+     ref=lambda x, y, w: x + w * (y - x))
+
+for name, ref in [
+    ("equal", np.equal), ("not_equal", np.not_equal),
+    ("less_than", np.less), ("less_equal", np.less_equal),
+    ("greater_than", np.greater), ("greater_equal", np.greater_equal),
+]:
+    case(name, [ints((3, 4), 0, 3, seed=1), ints((3, 4), 0, 3, seed=2)],
+         ref=ref, grad=None, bf16=False)
+_BA = ints((3, 4), 0, 2, seed=3).astype(bool)
+_BB = ints((3, 4), 0, 2, seed=4).astype(bool)
+for name, ref in [("logical_and", np.logical_and),
+                  ("logical_or", np.logical_or),
+                  ("logical_xor", np.logical_xor)]:
+    case(name, [_BA, _BB], ref=ref, grad=None, bf16=False)
+case("isclose", [_A, _A + 1e-7], ref=np.isclose, grad=None, bf16=False)
+
+# ===========================================================================
+# reductions / stats
+# ===========================================================================
+
+_R = f32((2, 3, 4), -2, 2, seed=11)
+
+for name, ref in [
+    ("reduce_sum", np.sum), ("reduce_mean", np.mean),
+    ("reduce_max", np.max), ("reduce_min", np.min),
+    ("reduce_prod", np.prod), ("amax", np.max), ("amin", np.min),
+]:
+    case(name, [_R], ref=lambda x, _f=ref: _f(x))
+    case(name, [_R], {"axis": 1, "keepdim": True},
+         ref=lambda x, axis, keepdim, _f=ref:
+         _f(x, axis=axis, keepdims=keepdim))
+case("logsumexp", [_R], {"axis": 2},
+     ref=lambda x, axis: np.log(np.sum(np.exp(x), axis=axis)),
+     rtol=1e-5, atol=1e-5)
+_RN = _R.copy()
+_RN[0, 0, 0] = np.nan
+case("nansum", [_RN], {"axis": 1}, grad=None,
+     ref=lambda x, axis: np.nansum(x, axis=axis), bf16=False)
+case("nanmean", [_RN], {"axis": 1}, grad=None,
+     ref=lambda x, axis: np.nanmean(x, axis=axis), bf16=False)
+case("count_nonzero", [ints((3, 4), 0, 2, seed=5)], {"axis": 1},
+     ref=lambda x, axis: np.count_nonzero(x, axis=axis),
+     grad=None, bf16=False)
+case("reduce_all", [_BA], {"axis": 1},
+     ref=lambda x, axis: np.all(x, axis=axis), grad=None, bf16=False)
+case("reduce_any", [_BA], {"axis": 1},
+     ref=lambda x, axis: np.any(x, axis=axis), grad=None, bf16=False)
+case("std", [_R], {"axis": 1, "unbiased": True},
+     ref=lambda x, axis, unbiased: np.std(x, axis=axis, ddof=1))
+case("var", [_R], {"axis": 1, "unbiased": False},
+     ref=lambda x, axis, unbiased: np.var(x, axis=axis, ddof=0))
+case("median", [f32((3, 5), seed=12)], {"axis": 1},
+     ref=lambda x, axis: np.median(x, axis=axis), grad=None)
+case("quantile", [f32((3, 5), seed=13)], {"q": 0.5, "axis": 1},
+     ref=lambda x, q, axis: np.quantile(x, q, axis=axis), grad=None)
+case("frobenius_norm", [_R], {"axis": (1, 2)},
+     ref=lambda x, axis: np.sqrt(np.sum(x * x, axis=axis)))
+case("p_norm", [_R], {"porder": 2.0, "axis": 1},
+     ref=lambda x, porder, axis:
+     np.linalg.norm(x, ord=porder, axis=axis))
+case("p_norm", [pos((3, 4), seed=14)], {"porder": 3.0, "axis": -1},
+     ref=lambda x, porder, axis:
+     np.sum(np.abs(x) ** porder, axis=axis) ** (1.0 / porder))
+
+# ===========================================================================
+# matmul family
+# ===========================================================================
+
+_M1 = f32((3, 4), seed=15)
+_M2 = f32((4, 5), seed=16)
+
+case("matmul_v2", [_M1, _M2], ref=lambda x, y: x @ y, grad=(0, 1))
+case("matmul_v2", [f32((2, 3, 4), seed=17), f32((2, 5, 4), seed=18)],
+     {"trans_y": True},
+     ref=lambda x, y, trans_y: x @ np.swapaxes(y, -1, -2), grad=(0, 1))
+case("matmul", [_M1, _M2], {"alpha": 2.0},
+     ref=lambda x, y, alpha: alpha * (x @ y), grad=(0, 1))
+case("matmul", [f32((4, 3), seed=19), _M2],
+     {"transpose_X": True},
+     ref=lambda x, y, transpose_X: x.T @ y, grad=(0, 1))
+case("mul", [_M1, _M2], ref=lambda x, y: x @ y, grad=(0, 1))
+case("bmm", [f32((2, 3, 4), seed=20), f32((2, 4, 5), seed=21)],
+     ref=np.matmul, grad=(0, 1))
+case("addmm", [f32((3, 5), seed=22), _M1, _M2],
+     {"alpha": 0.5, "beta": 2.0},
+     ref=lambda i, x, y, alpha, beta: beta * i + alpha * (x @ y),
+     grad=(0, 1, 2))
+case("dot", [_A, _B], ref=lambda x, y: np.sum(x * y, -1), grad=(0, 1))
+case("outer", [f32((3,), seed=23), f32((4,), seed=24)],
+     ref=np.outer, grad=(0, 1))
+case("cross", [f32((2, 3), seed=25), f32((2, 3), seed=26)],
+     ref=lambda x, y: np.cross(x, y), grad=(0, 1))
+case("einsum", [f32((3, 4), seed=27), f32((4, 5), seed=28)],
+     {"equation": "ij,jk->ik"},
+     ref=lambda x, y, equation: np.einsum(equation, x, y), grad=(0, 1))
+case("kron", [f32((2, 2), seed=29), f32((2, 3), seed=30)],
+     ref=np.kron, grad=(0, 1))
+case("tensordot", [f32((2, 3, 4), seed=31), f32((3, 4, 5), seed=32)],
+     {"axes": 2},
+     ref=lambda a, b, axes: np.tensordot(a, b, axes=axes), grad=(0, 1))
+
+# ===========================================================================
+# cumulative
+# ===========================================================================
+
+case("cumsum", [_R], {"axis": 1}, ref=lambda x, axis: np.cumsum(x, axis))
+case("cumsum", [_R], {"axis": 1, "reverse": True},
+     ref=lambda x, axis, reverse: np.flip(
+         np.cumsum(np.flip(x, axis), axis), axis))
+case("cumsum", [_R], {"axis": 1, "exclusive": True},
+     ref=lambda x, axis, exclusive: np.cumsum(x, axis) - x)
+case("cumprod", [pos((3, 4), seed=33)], {"dim": 1},
+     ref=lambda x, dim: np.cumprod(x, dim))
+case("logcumsumexp", [_R], {"axis": 1},
+     ref=lambda x, axis: np.log(np.cumsum(np.exp(x), axis)),
+     rtol=1e-5, atol=1e-5)
+
+# ===========================================================================
+# complex / misc unary
+# ===========================================================================
+
+_C = (f32((3, 4), seed=34) + 1j * f32((3, 4), seed=35)).astype(np.complex64)
+case("angle", [_C], ref=np.angle, grad=None, bf16=False)
+case("conj", [_C], ref=np.conj, grad=None, bf16=False)
+case("real", [_C], ref=np.real, grad=None, bf16=False)
+case("imag", [_C], ref=np.imag, grad=None, bf16=False)
+case("as_complex", [f32((3, 4, 2), seed=36)], grad=None, bf16=False,
+     ref=lambda x: x[..., 0] + 1j * x[..., 1])
+case("as_real", [_C], grad=None, bf16=False,
+     ref=lambda x: np.stack([x.real, x.imag], -1))
+case("assign", [_A], ref=lambda x: x)
+case("cast", [_A], {"dtype": "float64"}, grad=None,
+     ref=lambda x, dtype: x.astype(np.float64))
+case("full_like", [_A], {"fill_value": 3.5},
+     ref=lambda x, fill_value: np.full_like(x, fill_value), grad=None)
+case("scale", [_A], {"scale": 2.0, "bias": 1.0},
+     ref=lambda x, scale, bias: x * scale + bias)
+case("scale", [_A], {"scale": 2.0, "bias": 1.0, "bias_after_scale": False},
+     ref=lambda x, scale, bias, bias_after_scale: (x + bias) * scale)
+case("pow", [pos((3, 4), seed=37)], {"factor": 2.5},
+     ref=lambda x, factor: x ** factor)
+case("clip", [_XW], {"min": -0.5, "max": 0.8},
+     ref=lambda x, min, max: np.clip(x, min, max))
+case("where", [_BA, _A, _B], grad=(1, 2),
+     ref=lambda c, x, y: np.where(c, x, y), bf16=False)
+case("trace_op", [f32((4, 4), seed=38)], {"offset": 1},
+     ref=lambda x, offset: np.trace(x, offset=offset))
+case("diag", [f32((4,), seed=39)], {"offset": 1},
+     ref=lambda x, offset: np.diag(x, k=offset))
+case("diag", [f32((3, 4), seed=40)], {"offset": 0},
+     ref=lambda x, offset: np.diagonal(x, offset=offset))
+case("diagonal", [f32((3, 4), seed=41)], {"offset": -1},
+     ref=lambda x, offset: np.diagonal(x, offset=offset))
+case("diag_embed", [f32((3,), seed=42)], {"offset": 1},
+     ref=lambda x, offset: np.diag(x, k=offset))
+
+# ===========================================================================
+# manipulation
+# ===========================================================================
+
+case("concat", [f32((2, 3), seed=43), f32((2, 2), seed=44)], {"axis": 1},
+     ref=lambda a, b, axis: np.concatenate([a, b], axis), grad=(0, 1))
+case("stack", [f32((2, 3), seed=45), f32((2, 3), seed=46)], {"axis": 1},
+     ref=lambda a, b, axis: np.stack([a, b], axis), grad=(0, 1))
+case("split", [f32((2, 6), seed=47)], {"num_or_sections": 3, "axis": 1},
+     ref=lambda x, num_or_sections, axis:
+     tuple(np.split(x, num_or_sections, axis)))
+case("split", [f32((2, 6), seed=48)],
+     {"num_or_sections": [1, 2, 3], "axis": 1},
+     ref=lambda x, num_or_sections, axis:
+     tuple(np.split(x, np.cumsum(num_or_sections)[:-1], axis)))
+case("unstack", [f32((3, 2, 4), seed=49)], {"axis": 0},
+     ref=lambda x, axis: tuple(x[i] for i in range(x.shape[0])))
+case("reshape", [_R], {"shape": (4, 6)},
+     ref=lambda x, shape: x.reshape(shape))
+case("reshape", [_R], {"shape": (-1, 3)},
+     ref=lambda x, shape: x.reshape(-1, 3))
+case("squeeze", [f32((2, 1, 3, 1), seed=50)], {"axis": 1},
+     ref=lambda x, axis: np.squeeze(x, axis))
+case("squeeze", [f32((2, 1, 3, 1), seed=50)], {},
+     ref=lambda x: np.squeeze(x))
+case("unsqueeze", [_A], {"axis": 1},
+     ref=lambda x, axis: np.expand_dims(x, axis))
+case("flatten", [f32((2, 3, 4), seed=51)],
+     {"start_axis": 1, "stop_axis": 2},
+     ref=lambda x, start_axis, stop_axis: x.reshape(2, 12))
+case("transpose", [_R], {"perm": (2, 0, 1)},
+     ref=lambda x, perm: np.transpose(x, perm))
+case("swapaxes", [_R], {"axis0": 0, "axis1": 2},
+     ref=lambda x, axis0, axis1: np.swapaxes(x, axis0, axis1))
+case("moveaxis", [_R], {"source": 0, "destination": 2},
+     ref=lambda x, source, destination:
+     np.moveaxis(x, source, destination))
+case("tile", [_A], {"repeat_times": (2, 3)},
+     ref=lambda x, repeat_times: np.tile(x, repeat_times))
+case("expand_v2", [f32((1, 4), seed=52)], {"shape": (3, 4)},
+     ref=lambda x, shape: np.broadcast_to(x, shape))
+case("broadcast_to", [f32((1, 4), seed=53)], {"shape": (3, 4)},
+     ref=lambda x, shape: np.broadcast_to(x, shape))
+case("flip", [_R], {"axis": (0, 2)},
+     ref=lambda x, axis: np.flip(x, axis))
+case("roll", [_A], {"shifts": 2, "axis": 1},
+     ref=lambda x, shifts, axis: np.roll(x, shifts, axis))
+case("roll", [_A], {"shifts": 3},
+     ref=lambda x, shifts: np.roll(x, shifts))
+case("rot90", [_A], {"k": 1, "axes": (0, 1)},
+     ref=lambda x, k, axes: np.rot90(x, k, axes))
+case("pad", [_A], {"paddings": (1, 2, 0, 1), "mode": "constant",
+                   "value": 0.5, "data_format": "NCHW"},
+     ref=lambda x, paddings, mode, value, data_format:
+     np.pad(x, ((1, 2), (0, 1)), constant_values=value))
+case("tril", [f32((4, 4), seed=54)], {"diagonal": 1},
+     ref=lambda x, diagonal: np.tril(x, diagonal))
+case("triu", [f32((4, 4), seed=55)], {"diagonal": -1},
+     ref=lambda x, diagonal: np.triu(x, diagonal))
+case("repeat_interleave", [_A], {"repeats": 2, "axis": 1},
+     ref=lambda x, repeats, axis: np.repeat(x, repeats, axis))
+case("meshgrid", [f32((3,), seed=56), f32((4,), seed=57)],
+     ref=lambda a, b: tuple(np.meshgrid(a, b, indexing="ij")))
+case("slice_op", [_R], {"axes": (0, 2), "starts": (0, 1), "ends": (2, 3)},
+     ref=lambda x, axes, starts, ends: x[0:2, :, 1:3])
+case("strided_slice", [_R],
+     {"axes": (2,), "starts": (0,), "ends": (4,), "strides": (2,)},
+     ref=lambda x, axes, starts, ends, strides: x[:, :, 0:4:2])
+case("getitem", [_R], {"idx": (slice(0, 1), Ellipsis)},
+     ref=lambda x, idx: x[idx])
+
+_IDX = ints((3,), 0, 3, seed=58, dtype=np.int64)
+case("gather", [f32((4, 5), seed=59), _IDX], {"axis": 0},
+     ref=lambda x, i, axis: np.take(x, i, axis))
+case("gather_nd", [f32((3, 4), seed=60),
+                   np.array([[0, 1], [2, 2]], np.int64)],
+     ref=lambda x, i: x[i[:, 0], i[:, 1]])
+case("index_select", [f32((4, 5), seed=61), _IDX], {"axis": 1},
+     ref=lambda x, i, axis: np.take(x, i, axis))
+case("index_sample", [f32((3, 5), seed=62), ints((3, 2), 0, 5, seed=63)],
+     ref=lambda x, i: np.take_along_axis(x, i.astype(np.int64), 1))
+case("take_along_axis", [f32((3, 5), seed=64),
+                         ints((3, 2), 0, 5, seed=65, dtype=np.int64)],
+     {"axis": 1},
+     ref=lambda x, i, axis: np.take_along_axis(x, i, axis))
+
+
+def _scatter_ref(x, index, updates, overwrite=True):
+    out = x.copy()
+    if overwrite:
+        out[index] = updates
+    else:
+        out[index] = 0
+        np.add.at(out, index, updates)
+    return out
+
+
+case("scatter", [f32((5, 3), seed=66), np.array([1, 3], np.int64),
+                 f32((2, 3), seed=67)],
+     ref=lambda x, i, u: _scatter_ref(x, i, u), grad=(0, 2))
+
+
+def _scatter_nd_add_ref(x, index, updates):
+    out = x.copy()
+    np.add.at(out, tuple(index.T), updates)
+    return out
+
+
+case("scatter_nd_add", [f32((4, 3), seed=68),
+                        np.array([[0], [2]], np.int64),
+                        f32((2, 3), seed=69)],
+     ref=_scatter_nd_add_ref, grad=(0, 2))
+
+
+def _put_along_axis_ref(x, index, value, axis, reduce="assign"):
+    out = x.copy()
+    np.put_along_axis(out, index, value, axis)
+    return out
+
+
+case("put_along_axis", [f32((3, 5), seed=70),
+                        ints((3, 1), 0, 5, seed=71, dtype=np.int64),
+                        f32((3, 1), seed=72)],
+     {"axis": 1}, ref=_put_along_axis_ref, grad=None)
+
+
+def _index_put_ref(x, indices, value):
+    out = x.copy()
+    out[tuple(np.asarray(i) for i in indices)] = value
+    return out
+
+
+case("index_put", [f32((4, 3), seed=73),
+                   (np.array([0, 2], np.int64),),
+                   f32((2, 3), seed=74)],
+     ref=_index_put_ref, grad=None, bf16=False)
+case("masked_fill", [_A, _BA], {"value": -2.0},
+     ref=lambda x, m, value: np.where(m, value, x))
+case("masked_select", [_A, _BA],
+     ref=lambda x, m: x[m], grad=None, bf16=False)
+case("one_hot", [ints((4,), 0, 5, seed=75, dtype=np.int64)],
+     {"num_classes": 5},
+     ref=lambda x, num_classes: np.eye(num_classes, dtype=np.float32)[x],
+     grad=None, bf16=False)
+case("lookup_table_v2", [ints((2, 3), 0, 6, seed=76, dtype=np.int64),
+                         f32((6, 4), seed=77)],
+     {"padding_idx": 2}, grad=(1,),
+     ref=lambda ids, w, padding_idx:
+     w[ids] * (ids != padding_idx)[..., None])
+
+# ===========================================================================
+# search / sort
+# ===========================================================================
+
+_S = f32((3, 5), seed=78)
+case("arg_max", [_S], {"axis": 1}, ref=lambda x, axis: np.argmax(x, axis),
+     grad=None, bf16=False)
+case("arg_min", [_S], {"axis": 1}, ref=lambda x, axis: np.argmin(x, axis),
+     grad=None, bf16=False)
+case("argsort", [_S], {"axis": 1},
+     ref=lambda x, axis: np.argsort(x, axis, kind="stable"),
+     grad=None, bf16=False)
+case("argsort", [_S], {"axis": 1, "descending": True},
+     ref=lambda x, axis, descending:
+     np.argsort(-x, axis, kind="stable"), grad=None, bf16=False)
+case("sort_op", [_S], {"axis": 1},
+     ref=lambda x, axis: (np.sort(x, axis),
+                          np.argsort(x, axis, kind="stable")))
+case("top_k_v2", [_S], {"k": 2, "axis": 1},
+     ref=lambda x, k, axis: (
+         np.sort(x, axis)[:, ::-1][:, :k],
+         np.argsort(-x, axis, kind="stable")[:, :k]))
+case("kthvalue", [_S], {"k": 2, "axis": 1},
+     ref=lambda x, k, axis: (np.sort(x, axis)[:, k - 1],
+                             np.argsort(x, axis, kind="stable")[:, k - 1]))
+
+
+def _mode_ref(x, axis=-1, keepdim=False):
+    # most frequent value (ties -> smallest), last-occurrence index
+    vals = []
+    idxs = []
+    for row in x:
+        uniq, counts = np.unique(row, return_counts=True)
+        best = uniq[counts == counts.max()].min()
+        where = np.where(row == best)[0][-1]
+        vals.append(best)
+        idxs.append(where)
+    return np.asarray(vals), np.asarray(idxs)
+
+
+case("mode_op", [np.array([[1., 2., 2., 3.], [4., 4., 5., 4.]],
+                          np.float32)],
+     {"axis": -1}, ref=_mode_ref, grad=None, bf16=False)
+case("nonzero", [np.array([[1, 0], [0, 2]], np.int32)],
+     ref=lambda x: np.stack(np.nonzero(x), -1), grad=None, bf16=False)
+case("unique", [np.array([3, 1, 2, 1, 3], np.int64)],
+     ref=lambda x: np.unique(x), grad=None, bf16=False)
+case("masked_select", [_S, _S > 0.0],
+     ref=lambda x, m: x[m], grad=None, bf16=False)
+_SORTED = np.sort(f32((6,), seed=79))
+case("searchsorted", [_SORTED, f32((4,), seed=80)],
+     ref=lambda s, v: np.searchsorted(s, v), grad=None, bf16=False)
+case("bucketize", [f32((4,), seed=81), _SORTED],
+     ref=lambda x, s: np.searchsorted(s, x), grad=None, bf16=False)
+case("bincount", [ints((10,), 0, 5, seed=82, dtype=np.int64)],
+     {"minlength": 7},
+     ref=lambda x, minlength: np.bincount(x, minlength=minlength),
+     grad=None, bf16=False)
+case("histogram", [f32((20,), seed=83)], {"bins": 5, "min": -1, "max": 1},
+     ref=lambda x, bins, min, max:
+     np.histogram(x, bins=bins, range=(min, max))[0],
+     grad=None, bf16=False)
+
+# ===========================================================================
+# linalg
+# ===========================================================================
+
+_SPD = spd(4, seed=84)
+_SQ = f32((4, 4), seed=85) + 4 * np.eye(4, dtype=np.float32)
+
+case("cholesky", [_SPD], ref=lambda x: np.linalg.cholesky(x),
+     bf16=False, grad_rtol=1e-3, grad_atol=1e-4)
+case("det", [_SQ], ref=np.linalg.det, bf16=False, rtol=1e-4)
+case("slogdet", [_SQ], bf16=False, rtol=1e-4,
+     ref=lambda x: tuple(np.linalg.slogdet(x)))
+case("inverse", [_SQ], ref=np.linalg.inv, bf16=False, rtol=1e-4,
+     atol=1e-5)
+case("matrix_power", [_SQ], {"n": 3}, bf16=False, rtol=1e-4, atol=1e-4,
+     ref=lambda x, n: np.linalg.matrix_power(x, n))
+case("matrix_rank", [_SPD], ref=lambda x: np.linalg.matrix_rank(x),
+     grad=None, bf16=False)
+case("solve", [_SQ, f32((4, 2), seed=86)],
+     ref=np.linalg.solve, grad=(0, 1), bf16=False, rtol=1e-4, atol=1e-5)
+case("triangular_solve",
+     [np.tril(_SQ), f32((4, 2), seed=87)], {"upper": False},
+     ref=lambda a, b, upper:
+     np.linalg.solve(np.tril(a), b), grad=None, bf16=False,
+     rtol=1e-4, atol=1e-5)
+case("eigvalsh", [_SPD], ref=np.linalg.eigvalsh, bf16=False,
+     rtol=1e-4, atol=1e-4, grad=None)
+
+
+def _eigh_prop(outs, inputs, attrs):
+    w, v = np.asarray(outs[0], np.float64), np.asarray(outs[1], np.float64)
+    a = np.asarray(inputs[0], np.float64)
+    np.testing.assert_allclose(a @ v, v @ np.diag(w), rtol=1e-4, atol=1e-4)
+
+
+case("eigh", [_SPD], prop=_eigh_prop, grad=None, bf16=False)
+
+
+def _svd_prop(outs, inputs, attrs):
+    # repo convention: returns (U, S, V) with x = U @ diag(S) @ V.T
+    u, s, v = (np.asarray(o, np.float64) for o in outs[:3])
+    a = np.asarray(inputs[0], np.float64)
+    np.testing.assert_allclose(
+        u @ np.diag(s) @ v.T, a, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(
+        s, np.linalg.svd(a, compute_uv=False), rtol=1e-5, atol=1e-6)
+
+
+case("svd", [f32((4, 3), seed=88)], prop=_svd_prop, grad=None, bf16=False)
+
+
+def _qr_prop(outs, inputs, attrs):
+    q, r = np.asarray(outs[0], np.float64), np.asarray(outs[1], np.float64)
+    a = np.asarray(inputs[0], np.float64)
+    np.testing.assert_allclose(q @ r, a, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(q.T @ q, np.eye(q.shape[1]),
+                               rtol=1e-4, atol=1e-4)
+
+
+case("qr", [f32((4, 3), seed=89)], prop=_qr_prop, grad=None, bf16=False)
+
+
+def _lstsq_prop(outs, inputs, attrs):
+    sol = np.asarray(outs[0], np.float64)
+    a, b = (np.asarray(v, np.float64) for v in inputs)
+    expect = np.linalg.lstsq(a, b, rcond=None)[0]
+    np.testing.assert_allclose(sol, expect, rtol=1e-4, atol=1e-4)
+
+
+case("lstsq", [f32((5, 3), seed=90), f32((5, 2), seed=91)],
+     prop=_lstsq_prop, grad=None, bf16=False)
+case("pinv", [f32((4, 3), seed=92)],
+     ref=lambda x: np.linalg.pinv(x), grad=None, bf16=False,
+     rtol=1e-4, atol=1e-4)
+case("matrix_power", [_SQ], {"n": -1}, bf16=False, rtol=1e-3, atol=1e-3,
+     ref=lambda x, n: np.linalg.inv(x), grad=None)
+case("l2_normalize", [_A], {"axis": 1},
+     ref=lambda x, axis: x / np.maximum(
+         np.sqrt(np.sum(x * x, axis, keepdims=True)), 1e-12))
+case("cosine_similarity", [_A, _B], {"axis": 1}, grad=(0, 1),
+     ref=lambda a, b, axis:
+     np.sum(a * b, axis) / np.maximum(
+         np.sqrt(np.sum(a * a, axis)) * np.sqrt(np.sum(b * b, axis)),
+         1e-8))
+
+# ===========================================================================
+# nn: conv / pool / norm
+# ===========================================================================
+
+_CX = f32((1, 2, 5, 5), seed=93)
+_CW = f32((3, 2, 3, 3), seed=94)
+
+case("conv2d", [_CX, _CW], {"stride": 1, "padding": 1},
+     ref=lambda x, w, stride, padding:
+     np_conv2d(x, w, stride, padding), grad=(0, 1),
+     rtol=1e-4, atol=1e-5)
+case("conv2d", [_CX, _CW], {"stride": 2, "padding": 0, "dilation": 2},
+     ref=lambda x, w, stride, padding, dilation:
+     np_conv2d(x, w, stride, padding, dilation), grad=(0, 1),
+     rtol=1e-4, atol=1e-5)
+case("conv2d", [f32((1, 4, 5, 5), seed=95), f32((4, 2, 3, 3), seed=96)],
+     {"groups": 2},
+     ref=lambda x, w, groups: np_conv2d(x, w, groups=groups),
+     grad=(0, 1), rtol=1e-4, atol=1e-5)
+case("depthwise_conv2d",
+     [f32((1, 3, 5, 5), seed=97), f32((3, 1, 3, 3), seed=98)],
+     {"groups": 3},
+     ref=lambda x, w, groups: np_conv2d(x, w, groups=groups),
+     grad=(0, 1), rtol=1e-4, atol=1e-5)
+case("conv1d", [f32((1, 2, 6), seed=99), f32((3, 2, 3), seed=100)],
+     {"padding": 1},
+     ref=lambda x, w, padding: np_conv2d(
+         x[:, :, None, :], w[:, :, None, :], padding=(0, padding))[:, :, 0],
+     grad=(0, 1), rtol=1e-4, atol=1e-5)
+
+
+def _np_conv3d(x, w):
+    n, cin, d, h, wid = x.shape
+    cout, _, kd, kh, kw = w.shape
+    od, oh, ow = d - kd + 1, h - kh + 1, wid - kw + 1
+    out = np.zeros((n, cout, od, oh, ow), np.float64)
+    for o in range(cout):
+        for i in range(od):
+            for j in range(oh):
+                for l in range(ow):
+                    out[:, o, i, j, l] = np.sum(
+                        x[:, :, i:i + kd, j:j + kh, l:l + kw] * w[o],
+                        axis=(1, 2, 3, 4))
+    return out.astype(np.float32)
+
+
+case("conv3d", [f32((1, 2, 4, 4, 4), seed=101),
+                f32((2, 2, 2, 2, 2), seed=102)],
+     ref=_np_conv3d, grad=(0, 1), rtol=1e-4, atol=1e-5)
+
+
+def _np_conv2d_transpose(x, w, stride=1, padding=0):
+    # w layout (in, out, kh, kw)
+    n, cin, h, wid = x.shape
+    _, cout, kh, kw = w.shape
+    st = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    pd = (padding, padding) if isinstance(padding, int) else tuple(padding)
+    oh = (h - 1) * st[0] + kh - 2 * pd[0]
+    ow = (wid - 1) * st[1] + kw - 2 * pd[1]
+    full = np.zeros((n, cout, oh + 2 * pd[0], ow + 2 * pd[1]), np.float64)
+    for b in range(n):
+        for c in range(cin):
+            for i in range(h):
+                for j in range(wid):
+                    full[b, :, i * st[0]:i * st[0] + kh,
+                         j * st[1]:j * st[1] + kw] += x[b, c, i, j] * w[c]
+    out = full[:, :, pd[0]:pd[0] + oh, pd[1]:pd[1] + ow]
+    return out.astype(np.float32)
+
+
+case("conv2d_transpose", [f32((1, 2, 3, 3), seed=103),
+                          f32((2, 3, 3, 3), seed=104)],
+     {"stride": 2, "padding": 1},
+     ref=lambda x, w, stride, padding:
+     _np_conv2d_transpose(x, w, stride, padding),
+     grad=(0, 1), rtol=1e-4, atol=1e-5)
+
+_PX = f32((1, 2, 6, 6), seed=105)
+case("pool2d", [_PX], {"ksize": 2, "stride": 2, "pooling_type": "max"},
+     ref=lambda x, ksize, stride, pooling_type:
+     np_pool2d(x, ksize, stride, pooling_type=pooling_type))
+case("pool2d", [_PX],
+     {"ksize": 3, "stride": 2, "padding": 1, "pooling_type": "avg",
+      "exclusive": True},
+     ref=lambda x, ksize, stride, padding, pooling_type, exclusive:
+     np_pool2d(x, ksize, stride, padding, pooling_type, exclusive))
+case("pool2d", [_PX], {"ksize": 1, "global_pooling": True,
+                       "pooling_type": "avg"},
+     ref=lambda x, ksize, global_pooling, pooling_type:
+     x.mean(axis=(2, 3), keepdims=True))
+
+
+def _maxpool_index_prop(outs, inputs, attrs):
+    out, idx = np.asarray(outs[0]), np.asarray(outs[1])
+    x = inputs[0]
+    n, c, h, w = x.shape
+    flat = x.reshape(n, c, h * w)
+    got = np.take_along_axis(flat, idx.reshape(n, c, -1), axis=2)
+    np.testing.assert_allclose(got.reshape(out.shape), out, rtol=1e-6)
+
+
+case("max_pool2d_with_index", [_PX], {"ksize": 2, "stride": 2},
+     ref=lambda x, ksize, stride: np_pool2d(x, ksize, stride),
+     prop=_maxpool_index_prop)
+
+
+def _np_layer_norm(x, scale=None, bias=None, epsilon=1e-5,
+                   begin_norm_axis=1):
+    axes = tuple(range(begin_norm_axis, x.ndim))
+    mean = x.mean(axes, keepdims=True)
+    var = x.var(axes, keepdims=True)
+    y = (x - mean) / np.sqrt(var + epsilon)
+    if scale is not None:
+        y = y * scale.reshape(x.shape[begin_norm_axis:])
+    if bias is not None:
+        y = y + bias.reshape(x.shape[begin_norm_axis:])
+    return y
+
+
+case("layer_norm", [f32((2, 3, 4), seed=106), pos((12,), seed=107),
+                    f32((12,), seed=108)],
+     {"begin_norm_axis": 1},
+     ref=_np_layer_norm, grad=(0, 1, 2), rtol=1e-4, atol=1e-5)
+case("rms_norm", [f32((2, 3, 4), seed=109), pos((4,), seed=110)],
+     ref=lambda x, s: x / np.sqrt(
+         (x * x).mean(-1, keepdims=True) + 1e-6) * s,
+     grad=(0, 1), rtol=1e-4, atol=1e-5)
+
+
+def _np_batch_norm(x, scale, bias, mean, variance, momentum=0.9,
+                   epsilon=1e-5, is_test=False, use_global_stats=False):
+    if is_test or use_global_stats:
+        um, uv = mean, variance
+    else:
+        um = x.mean(axis=(0, 2, 3))
+        uv = x.var(axis=(0, 2, 3))
+    b = (1, -1, 1, 1)
+    y = (x - um.reshape(b)) / np.sqrt(uv.reshape(b) + epsilon)
+    return y * scale.reshape(b) + bias.reshape(b)
+
+
+_BNX = f32((2, 3, 4, 4), seed=111)
+_BNS, _BNB = pos((3,), seed=112), f32((3,), seed=113)
+_BNM, _BNV = f32((3,), seed=114), pos((3,), seed=115)
+case("batch_norm", [_BNX, _BNS, _BNB, _BNM, _BNV], {"is_test": False},
+     ref=lambda x, s, b, m, v, is_test:
+     _np_batch_norm(x, s, b, m, v, is_test=is_test),
+     grad=(0, 1, 2), rtol=1e-4, atol=1e-5)
+case("batch_norm", [_BNX, _BNS, _BNB, _BNM, _BNV],
+     {"is_test": False, "use_global_stats": True},
+     ref=lambda x, s, b, m, v, is_test, use_global_stats:
+     _np_batch_norm(x, s, b, m, v, is_test=is_test,
+                    use_global_stats=use_global_stats),
+     grad=(0,), rtol=1e-4, atol=1e-5)
+
+
+def _np_instance_norm(x, scale=None, bias=None, epsilon=1e-5):
+    axes = tuple(range(2, x.ndim))
+    mean = x.mean(axes, keepdims=True)
+    var = x.var(axes, keepdims=True)
+    y = (x - mean) / np.sqrt(var + epsilon)
+    b = [1, x.shape[1]] + [1] * (x.ndim - 2)
+    if scale is not None:
+        y = y * scale.reshape(b)
+    if bias is not None:
+        y = y + bias.reshape(b)
+    return y
+
+
+case("instance_norm", [_BNX, _BNS, _BNB],
+     ref=_np_instance_norm, grad=(0, 1, 2), rtol=1e-4, atol=1e-5)
+
+
+def _np_group_norm(x, scale=None, bias=None, epsilon=1e-5, groups=1):
+    n, c = x.shape[:2]
+    xg = x.reshape((n, groups, c // groups) + x.shape[2:])
+    axes = tuple(range(2, xg.ndim))
+    mean = xg.mean(axes, keepdims=True)
+    var = xg.var(axes, keepdims=True)
+    y = ((xg - mean) / np.sqrt(var + epsilon)).reshape(x.shape)
+    b = [1, c] + [1] * (x.ndim - 2)
+    if scale is not None:
+        y = y * scale.reshape(b)
+    if bias is not None:
+        y = y + bias.reshape(b)
+    return y
+
+
+case("group_norm", [f32((2, 4, 3, 3), seed=116), pos((4,), seed=117),
+                    f32((4,), seed=118)],
+     {"groups": 2}, ref=_np_group_norm, grad=(0, 1, 2),
+     rtol=1e-4, atol=1e-5)
+
+
+def _np_lrn(x, size, alpha=1e-4, beta=0.75, k=1.0):
+    sq = x * x
+    c = x.shape[1]
+    half = size // 2
+    acc = np.zeros_like(x)
+    for i in range(c):
+        lo, hi = max(0, i - half), min(c, i - half + size)
+        acc[:, i] = sq[:, lo:hi].sum(axis=1)
+    return x / (k + alpha * acc) ** beta
+
+
+case("local_response_norm", [f32((2, 5, 3, 3), seed=119)],
+     {"size": 3, "alpha": 1e-3, "beta": 0.75, "k": 1.0},
+     ref=_np_lrn, rtol=1e-4, atol=1e-5)
+
+# ===========================================================================
+# nn: softmax / losses / attention / misc
+# ===========================================================================
+
+_L = f32((4, 6), -3, 3, seed=120)
+_LBL = ints((4,), 0, 6, seed=121, dtype=np.int64)
+
+case("softmax", [_L], {"axis": -1}, ref=np_softmax, rtol=1e-5, atol=1e-6)
+case("log_softmax", [_L], {"axis": 1},
+     ref=lambda x, axis: np.log(np_softmax(x, axis)))
+case("softmax_with_cross_entropy", [_L, _LBL.reshape(4, 1)],
+     ref=lambda lg, lb: (
+         -np.take_along_axis(np.log(np_softmax(lg)), lb, 1),
+         np_softmax(lg)),
+     grad=(0,), rtol=1e-4, atol=1e-5)
+case("cross_entropy", [_L, _LBL],
+     ref=lambda lg, lb:
+     -np.log(np_softmax(lg))[np.arange(4), lb].mean(),
+     grad=(0,), rtol=1e-4, atol=1e-5)
+_CW6 = pos((6,), seed=122)
+case("cross_entropy", [_L, _LBL], {"weight": _CW6, "reduction": "mean"},
+     ref=lambda lg, lb, weight, reduction:
+     (-np.log(np_softmax(lg))[np.arange(4), lb] * weight[lb]).sum()
+     / weight[lb].sum(),
+     grad=(0,), rtol=1e-4, atol=1e-5)
+case("sigmoid_cross_entropy_with_logits",
+     [_L, rs(123).randint(0, 2, (4, 6)).astype(np.float32)],
+     ref=lambda x, l: np.maximum(x, 0) - x * l + np.log1p(
+         np.exp(-np.abs(x))),
+     grad=(0,), rtol=1e-4, atol=1e-5)
+case("bce_loss", [pos((4, 3), 0.05, 0.95, seed=124),
+                  rs(125).randint(0, 2, (4, 3)).astype(np.float32)],
+     ref=lambda p, l: -(l * np.log(p) + (1 - l) * np.log(1 - p)),
+     grad=(0,), rtol=1e-4, atol=1e-5)
+case("kldiv_loss", [np.log(pos((4, 3), 0.1, 0.9, seed=126)),
+                    pos((4, 3), 0.1, 0.9, seed=127)],
+     {"reduction": "batchmean"},
+     ref=lambda x, t, reduction: (t * (np.log(t) - x)).sum() / 4,
+     grad=(0,), rtol=1e-4, atol=1e-5)
+case("l1_loss", [_A, _B], ref=lambda a, b: np.abs(a - b).mean())
+case("mse_loss", [_A, _B], ref=lambda a, b: ((a - b) ** 2).mean())
+case("smooth_l1_loss", [_A, _B], {"delta": 1.0},
+     ref=lambda a, b, delta: np.where(
+         np.abs(a - b) < delta, 0.5 * (a - b) ** 2 / delta,
+         np.abs(a - b) - 0.5 * delta).mean())
+case("hinge_loss", [_A, rs(128).randint(0, 2, (3, 4)).astype(np.float32)],
+     ref=lambda lg, lb: np.maximum(0, 1 - lg * (2 * lb - 1)))
+case("margin_ranking_loss",
+     [_A, _B, np.sign(f32((3, 4), seed=129)).astype(np.float32)],
+     {"margin": 0.1},
+     ref=lambda a, b, l, margin:
+     np.maximum(0, -l * (a - b) + margin).mean(), grad=(0, 1))
+case("nll_loss", [np.log(np_softmax(_L)), _LBL],
+     ref=lambda x, l: -x[np.arange(4), l].mean(),
+     grad=(0,), rtol=1e-4, atol=1e-5)
+
+
+def _np_sdpa(q, k, v, is_causal=False, scale=None):
+    d = q.shape[-1]
+    s = scale if scale is not None else 1.0 / math.sqrt(d)
+    logits = np.einsum("bhqd,bhkd->bhqk", q, k) * s
+    if is_causal:
+        ql, kl = logits.shape[-2], logits.shape[-1]
+        mask = np.tril(np.ones((ql, kl), bool), k=kl - ql)
+        logits = np.where(mask, logits, -1e30)
+    p = np_softmax(logits, -1)
+    return np.einsum("bhqk,bhkd->bhqd", p, v)
+
+
+_Q = f32((2, 2, 4, 8), seed=130)
+_K = f32((2, 2, 4, 8), seed=131)
+_V = f32((2, 2, 4, 8), seed=132)
+case("scaled_dot_product_attention", [_Q, _K, _V],
+     ref=_np_sdpa, grad=(0, 1, 2), rtol=1e-4, atol=1e-5)
+case("scaled_dot_product_attention", [_Q, _K, _V], {"is_causal": True},
+     ref=lambda q, k, v, is_causal: _np_sdpa(q, k, v, is_causal),
+     grad=(0, 1, 2), rtol=1e-4, atol=1e-5)
+case("flash_attention", [_Q, _K, _V], {"is_causal": True},
+     ref=lambda q, k, v, is_causal: _np_sdpa(q, k, v, is_causal),
+     grad=(0, 1, 2), rtol=1e-4, atol=1e-4)
+case("dropout", [_A, KEY], {"p": 0.0, "training": True},
+     ref=None, prop=lambda outs, inputs, attrs:
+     np.testing.assert_allclose(np.asarray(outs[0]), inputs[0]),
+     grad=None, mode="fn")
+
+
+def _dropout_prop(outs, inputs, attrs):
+    out = np.asarray(outs[0])
+    x = inputs[0]
+    keep = 1.0 - attrs["p"]
+    mask = out != 0
+    np.testing.assert_allclose(out[mask], (x / keep)[mask], rtol=1e-6)
+    assert 0.1 < mask.mean() < 0.9
+
+
+case("dropout", [f32((32, 32), 0.5, 1.5, seed=133), KEY], {"p": 0.5},
+     prop=_dropout_prop, grad=None, mode="fn")
+
+case("interpolate", [f32((1, 2, 3, 3), seed=134)],
+     {"size": (6, 6), "mode": "nearest"},
+     ref=lambda x, size, mode: x.repeat(2, 2).repeat(2, 3))
+
+
+def _np_pixel_shuffle(x, r):
+    n, c, h, w = x.shape
+    y = x.reshape(n, c // (r * r), r, r, h, w)
+    y = y.transpose(0, 1, 4, 2, 5, 3)
+    return y.reshape(n, c // (r * r), h * r, w * r)
+
+
+case("pixel_shuffle", [f32((1, 4, 3, 3), seed=135)],
+     {"upscale_factor": 2},
+     ref=lambda x, upscale_factor: _np_pixel_shuffle(x, upscale_factor))
+
+
+def _np_unfold(x, k):
+    n, c, h, w = x.shape
+    oh, ow = h - k + 1, w - k + 1
+    cols = np.zeros((n, c * k * k, oh * ow), np.float32)
+    idx = 0
+    for i in range(oh):
+        for j in range(ow):
+            cols[:, :, idx] = x[:, :, i:i + k, j:j + k].reshape(n, -1)
+            idx += 1
+    return cols
+
+
+case("unfold", [f32((1, 2, 4, 4), seed=136)], {"kernel_sizes": 2},
+     ref=lambda x, kernel_sizes: _np_unfold(x, kernel_sizes))
+case("temporal_shift", [f32((4, 4, 2, 2), seed=137)],
+     {"seg_num": 2, "shift_ratio": 0.25},
+     prop=finite)
+
+# ===========================================================================
+# random ops (property checks, mode='fn' with PRNG key)
+# ===========================================================================
+
+
+def _shape_dtype_prop(shape, dtype=None, lo=None, hi=None):
+    def prop(outs, inputs, attrs):
+        o = np.asarray(outs[0])
+        assert o.shape == tuple(shape), (o.shape, shape)
+        if dtype is not None:
+            assert o.dtype == np.dtype(dtype), o.dtype
+        if lo is not None:
+            assert (o >= lo).all()
+        if hi is not None:
+            assert (o <= hi).all()
+    return prop
+
+
+case("uniform_random", [KEY],
+     {"shape": (200,), "min": -2.0, "max": 3.0},
+     prop=_shape_dtype_prop((200,), np.float32, -2.0, 3.0),
+     grad=None, bf16=False, mode="fn")
+
+
+def _gauss_prop(outs, inputs, attrs):
+    o = np.asarray(outs[0])
+    assert o.shape == (2000,)
+    assert abs(o.mean() - 1.0) < 0.2 and abs(o.std() - 2.0) < 0.3
+
+
+case("gaussian_random", [KEY],
+     {"shape": (2000,), "mean": 1.0, "std": 2.0},
+     prop=_gauss_prop, grad=None, bf16=False, mode="fn")
+
+
+def _trunc_gauss_prop(outs, inputs, attrs):
+    o = np.asarray(outs[0])
+    assert o.shape == (2000,)
+    assert (np.abs(o) <= 2.0 + 1e-6).all()  # truncated at 2 std
+
+
+case("truncated_gaussian_random", [KEY], {"shape": (2000,)},
+     prop=_trunc_gauss_prop, grad=None, bf16=False, mode="fn")
+def _randint_prop(outs, inputs, attrs):
+    o = np.asarray(outs[0])
+    assert o.shape == (100,)
+    assert np.issubdtype(o.dtype, np.integer)
+    assert (o >= 2).all() and (o <= 8).all()
+
+
+case("randint", [KEY], {"low": 2, "high": 9, "shape": (100,)},
+     prop=_randint_prop, grad=None, bf16=False, mode="fn")
+
+
+def _randperm_prop(outs, inputs, attrs):
+    o = np.sort(np.asarray(outs[0]))
+    np.testing.assert_array_equal(o, np.arange(10))
+
+
+case("randperm", [KEY], {"n": 10}, prop=_randperm_prop,
+     grad=None, bf16=False, mode="fn")
+
+
+def _bernoulli_prop(outs, inputs, attrs):
+    o = np.asarray(outs[0])
+    assert set(np.unique(o)).issubset({0.0, 1.0})
+    assert 0.5 < o.mean() < 0.9
+
+
+case("bernoulli", [np.full((1000,), 0.7, np.float32), KEY],
+     prop=_bernoulli_prop, grad=None, bf16=False, mode="fn")
+
+
+def _multinomial_prop(outs, inputs, attrs):
+    o = np.asarray(outs[0])
+    assert ((o >= 0) & (o < 4)).all()
+
+
+case("multinomial", [np.array([[0.1, 0.2, 0.3, 0.4]], np.float32), KEY],
+     {"num_samples": 16, "replacement": True},
+     prop=_multinomial_prop, grad=None, bf16=False, mode="fn")
+case("normal_like", [f32((500,), seed=138), KEY],
+     {"mean": 0.0, "std": 1.0},
+     prop=lambda outs, inputs, attrs:
+     finite(outs, inputs, attrs) or None,
+     grad=None, bf16=False, mode="fn")
+
+
+def _exponential_prop(outs, inputs, attrs):
+    o = np.asarray(outs[0])
+    assert (o >= 0).all() and abs(o.mean() - 0.5) < 0.15
+
+
+case("exponential", [f32((2000,), seed=139), KEY], {"lam": 2.0},
+     prop=_exponential_prop, grad=None, bf16=False, mode="fn")
+
+
+def _poisson_prop(outs, inputs, attrs):
+    o = np.asarray(outs[0])
+    assert (o >= 0).all() and abs(o.mean() - 3.0) < 0.5
+
+
+case("poisson", [np.full((2000,), 3.0, np.float32), KEY],
+     prop=_poisson_prop, grad=None, bf16=False, mode="fn")
+
+# ===========================================================================
+# known-unimplemented ops (tracked; implementing removes from this set)
+# ===========================================================================
+
+UNIMPLEMENTED.add("matrix_nms")
