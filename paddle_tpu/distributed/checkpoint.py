@@ -147,17 +147,19 @@ def _engine_shardings(engine):
 def load_train_state(path, engine):
     """Restore in place; arrays come back with the engine's target
     shardings (rebuilt from the engine's mesh when present)."""
+    # validate metadata BEFORE mutating the engine so a failed load leaves
+    # the caller free to fall back to fresh training
+    meta = load_metadata(path)
+    if meta is None:
+        raise FileNotFoundError(
+            f"checkpoint {path} has no paddle_meta.json — it was written "
+            "by an interrupted save and cannot be resumed exactly")
     st = engine.state
     tpl = {"params": st.params, "opt_state": st.opt_state,
            "buffers": st.buffers}
     restored = load_state(path, tpl, shardings=_engine_shardings(engine))
     st.params, st.opt_state, st.buffers = (
         restored["params"], restored["opt_state"], restored["buffers"])
-    meta = load_metadata(path)
-    if meta is None:
-        raise FileNotFoundError(
-            f"checkpoint {path} has no paddle_meta.json — it was written "
-            "by an interrupted save and cannot be resumed exactly")
     st.step = int(meta.get("step", 0))
     _restore_rng(meta)
     from ..optimizer.lr import LRScheduler

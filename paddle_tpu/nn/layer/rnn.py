@@ -1,0 +1,348 @@
+"""Recurrent layers: cells, RNN/BiRNN wrappers, SimpleRNN/LSTM/GRU.
+
+Ref parity: python/paddle/nn/layer/rnn.py (RNNCellBase:95, SimpleRNNCell
+:258, LSTMCell:390, GRUCell:543, RNN:694, BiRNN:776, SimpleRNN/LSTM/GRU).
+Same cell equations and parameter naming; the multi-layer classes dispatch
+to the fused `rnn` op (ops/rnn_ops.py) whose time loop is a lax.scan —
+the TPU replacement for the reference's cudnn rnn_op.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from ...core.dispatch import apply
+from ...core.tensor import Tensor
+from ...framework import random as _random
+from ...tensor.manipulation import concat, split, stack, t
+from .. import functional as F
+from .. import initializer as I
+from .layers import Layer
+
+__all__ = [
+    "RNNCellBase", "SimpleRNNCell", "LSTMCell", "GRUCell",
+    "RNN", "BiRNN", "SimpleRNN", "LSTM", "GRU",
+]
+
+
+def _split(x, n):
+    return split(x, num_or_sections=n, axis=-1)
+
+
+class RNNCellBase(Layer):
+    """Base for single-step recurrent cells (ref rnn.py:95)."""
+
+    def get_initial_states(self, batch_ref, shape=None, dtype=None,
+                           init_value=0.0):
+        batch = batch_ref.shape[0]
+        shapes = shape if shape is not None else self.state_shape
+        if isinstance(shapes, tuple) and isinstance(shapes[0], (tuple, list)):
+            return tuple(
+                Tensor(np.full((batch,) + tuple(s), init_value, np.float32))
+                for s in shapes)
+        return Tensor(np.full((batch,) + tuple(shapes), init_value,
+                              np.float32))
+
+
+class SimpleRNNCell(RNNCellBase):
+    r"""h' = act(x W_ih^T + b_ih + h W_hh^T + b_hh) (ref rnn.py:258)."""
+
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        if activation not in ("tanh", "relu"):
+            raise ValueError("activation must be 'tanh' or 'relu'")
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.activation = activation
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter(
+            [hidden_size, input_size], weight_ih_attr,
+            default_initializer=u)
+        self.weight_hh = self.create_parameter(
+            [hidden_size, hidden_size], weight_hh_attr,
+            default_initializer=u)
+        self.bias_ih = self.create_parameter(
+            [hidden_size], bias_ih_attr, is_bias=True,
+            default_initializer=u)
+        self.bias_hh = self.create_parameter(
+            [hidden_size], bias_hh_attr, is_bias=True,
+            default_initializer=u)
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        pre_h = states
+        gates = F.linear(inputs, t(self.weight_ih))
+        if self.bias_ih is not None:
+            gates = gates + self.bias_ih
+        gates = gates + F.linear(pre_h, t(self.weight_hh))
+        if self.bias_hh is not None:
+            gates = gates + self.bias_hh
+        h = F.tanh(gates) if self.activation == "tanh" else F.relu(gates)
+        return h, h
+
+
+class LSTMCell(RNNCellBase):
+    r"""Gates i,f,g,o; c' = f*c + i*tanh(g); h' = o*tanh(c')
+    (ref rnn.py:390)."""
+
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter(
+            [4 * hidden_size, input_size], weight_ih_attr,
+            default_initializer=u)
+        self.weight_hh = self.create_parameter(
+            [4 * hidden_size, hidden_size], weight_hh_attr,
+            default_initializer=u)
+        self.bias_ih = self.create_parameter(
+            [4 * hidden_size], bias_ih_attr, is_bias=True,
+            default_initializer=u)
+        self.bias_hh = self.create_parameter(
+            [4 * hidden_size], bias_hh_attr, is_bias=True,
+            default_initializer=u)
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,), (self.hidden_size,))
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        pre_h, pre_c = states
+        gates = F.linear(inputs, t(self.weight_ih))
+        if self.bias_ih is not None:
+            gates = gates + self.bias_ih
+        gates = gates + F.linear(pre_h, t(self.weight_hh))
+        if self.bias_hh is not None:
+            gates = gates + self.bias_hh
+        i, f, g, o = _split(gates, 4)
+        i, f, o = F.sigmoid(i), F.sigmoid(f), F.sigmoid(o)
+        c = f * pre_c + i * F.tanh(g)
+        h = o * F.tanh(c)
+        return h, (h, c)
+
+
+class GRUCell(RNNCellBase):
+    r"""Gates r,z,c; h' = z*h + (1-z)*tanh(xc + r*(hc)) (ref rnn.py:543)."""
+
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter(
+            [3 * hidden_size, input_size], weight_ih_attr,
+            default_initializer=u)
+        self.weight_hh = self.create_parameter(
+            [3 * hidden_size, hidden_size], weight_hh_attr,
+            default_initializer=u)
+        self.bias_ih = self.create_parameter(
+            [3 * hidden_size], bias_ih_attr, is_bias=True,
+            default_initializer=u)
+        self.bias_hh = self.create_parameter(
+            [3 * hidden_size], bias_hh_attr, is_bias=True,
+            default_initializer=u)
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        pre_h = states
+        x_gates = F.linear(inputs, t(self.weight_ih))
+        if self.bias_ih is not None:
+            x_gates = x_gates + self.bias_ih
+        h_gates = F.linear(pre_h, t(self.weight_hh))
+        if self.bias_hh is not None:
+            h_gates = h_gates + self.bias_hh
+        xr, xz, xc = _split(x_gates, 3)
+        hr, hz, hc = _split(h_gates, 3)
+        r = F.sigmoid(xr + hr)
+        z = F.sigmoid(xz + hz)
+        cand = F.tanh(xc + r * hc)
+        h = z * pre_h + (1.0 - z) * cand
+        return h, h
+
+
+class RNN(Layer):
+    """Run a cell over a sequence (ref rnn.py:694). Python time loop —
+    generic over user cells; the fused classes below are the fast path."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, **kwargs):
+        time_axis = 0 if self.time_major else 1
+        steps = inputs.shape[time_axis]
+        states = initial_states
+        if states is None:
+            batch_ref = inputs[0] if self.time_major else inputs
+            states = self.cell.get_initial_states(batch_ref)
+        outputs = []
+        order = range(steps - 1, -1, -1) if self.is_reverse else range(steps)
+        for t in order:
+            xt = inputs[t] if self.time_major else inputs[:, t]
+            out, states = self.cell(xt, states, **kwargs)
+            outputs.append(out)
+        if self.is_reverse:
+            outputs = outputs[::-1]
+        stacked = stack(outputs, axis=time_axis)
+        return stacked, states
+
+
+class BiRNN(Layer):
+    """Forward + backward cells, outputs concatenated (ref rnn.py:776)."""
+
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.cell_fw = cell_fw
+        self.cell_bw = cell_bw
+        self.rnn_fw = RNN(cell_fw, is_reverse=False, time_major=time_major)
+        self.rnn_bw = RNN(cell_bw, is_reverse=True, time_major=time_major)
+
+    def forward(self, inputs, initial_states=None, **kwargs):
+        fw_states = bw_states = None
+        if initial_states is not None:
+            fw_states, bw_states = initial_states
+        out_fw, st_fw = self.rnn_fw(inputs, fw_states, **kwargs)
+        out_bw, st_bw = self.rnn_bw(inputs, bw_states, **kwargs)
+        out = concat([out_fw, out_bw], axis=-1)
+        return out, (st_fw, st_bw)
+
+
+class _RNNBase(Layer):
+    """Stacked (bi)directional recurrence over the fused `rnn` op.
+
+    Parameter naming follows the reference: weight_ih_l{k}[_reverse], ...
+    """
+
+    def __init__(self, mode, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        if direction not in ("forward", "bidirect", "bidirectional"):
+            raise ValueError(f"unknown direction {direction!r}")
+        self.mode = mode
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = float(dropout)
+        self.num_directions = 2 if direction.startswith("bidirect") else 1
+        from ...ops.rnn_ops import _GATE_MULT
+
+        gm = _GATE_MULT[mode]
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self._weight_names = []
+        for layer in range(num_layers):
+            in_size = input_size if layer == 0 \
+                else hidden_size * self.num_directions
+            for d in range(self.num_directions):
+                suffix = "_reverse" if d == 1 else ""
+                names = [f"weight_ih_l{layer}{suffix}",
+                         f"weight_hh_l{layer}{suffix}",
+                         f"bias_ih_l{layer}{suffix}",
+                         f"bias_hh_l{layer}{suffix}"]
+                shapes = [[gm * hidden_size, in_size],
+                          [gm * hidden_size, hidden_size],
+                          [gm * hidden_size], [gm * hidden_size]]
+                attrs = [weight_ih_attr, weight_hh_attr,
+                         bias_ih_attr, bias_hh_attr]
+                for n, s, a in zip(names, shapes, attrs):
+                    p = self.create_parameter(
+                        s, a, is_bias=(len(s) == 1), default_initializer=u)
+                    setattr(self, n, p)
+                self._weight_names.append(names)
+
+    @property
+    def state_shape(self):
+        layers = self.num_layers * self.num_directions
+        return (layers, -1, self.hidden_size)
+
+    def _flat_weights(self):
+        out = []
+        for names in self._weight_names:
+            out.extend(getattr(self, n) for n in names)
+        return out
+
+    def forward(self, inputs, initial_states=None):
+        batch = inputs.shape[0 if not self.time_major else 1]
+        layers = self.num_layers * self.num_directions
+        zeros = np.zeros((layers, batch, self.hidden_size), np.float32)
+        if self.mode == "LSTM":
+            if initial_states is None:
+                init_h, init_c = Tensor(zeros), Tensor(zeros)
+            else:
+                init_h, init_c = initial_states
+        else:
+            init_h = initial_states if initial_states is not None \
+                else Tensor(zeros)
+            init_c = Tensor(zeros)
+        dropout = self.dropout if self.training else 0.0
+        # only consume the RNG stream when a mask will actually be drawn —
+        # eval passes must not perturb exact-resume RNG positions
+        key = _random.next_key() if dropout > 0.0 \
+            else np.zeros(2, np.uint32)
+        outputs, final_h, final_c = apply(
+            "rnn", inputs, init_h, init_c, key, *self._flat_weights(),
+            mode=self.mode, num_layers=self.num_layers,
+            hidden_size=self.hidden_size,
+            is_bidirec=(self.num_directions == 2),
+            time_major=self.time_major, dropout=dropout)
+        if self.mode == "LSTM":
+            return outputs, (final_h, final_c)
+        return outputs, final_h
+
+    def extra_repr(self):
+        return (f"{self.input_size}, {self.hidden_size}, "
+                f"num_layers={self.num_layers}")
+
+
+class SimpleRNN(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", **kwargs):
+        mode = "RNN_TANH" if activation == "tanh" else "RNN_RELU"
+        super().__init__(mode, input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, **kwargs)
+
+
+class LSTM(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 **kwargs):
+        super().__init__("LSTM", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, **kwargs)
+
+
+class GRU(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 **kwargs):
+        super().__init__("GRU", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, **kwargs)

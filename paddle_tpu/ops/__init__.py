@@ -14,3 +14,4 @@ from . import search_ops  # noqa: F401
 from . import random_ops  # noqa: F401
 from . import linalg_ops  # noqa: F401
 from . import fused_ops  # noqa: F401
+from . import rnn_ops  # noqa: F401

@@ -82,7 +82,8 @@ def conv2d_transpose(x, weight, *, stride=1, padding=0, output_padding=0,
     dilations = _pair(dilation)
     opad = _pair(output_padding)
     kh, kw = weight.shape[-2], weight.shape[-1]
-    pad = _conv_padding(padding, 2, strides, dilations, (kh, kw))
+    pad = _conv_padding(padding, 2, strides, dilations, (kh, kw),
+                        channel_last=(data_format != "NCHW"))
     if isinstance(pad, str):
         lax_pad = pad
     else:

@@ -1,0 +1,105 @@
+"""Fused multi-layer RNN op (SimpleRNN / LSTM / GRU).
+
+Ref parity: paddle/fluid/operators/rnn_op.h (the cudnn-style fused RNN the
+reference dispatches nn.LSTM/GRU/SimpleRNN to) and the cell equations of
+python/paddle/nn/layer/rnn.py:258,390,543. TPU-native design: the whole
+stacked, optionally bidirectional recurrence is ONE op whose time loop is a
+`lax.scan` — XLA compiles it to a fused while-loop keeping the [B, 4H]
+gate matmuls on the MXU, and `jax.vjp` of the scan gives the backward pass
+(the reference needed a hand-written rnn_grad kernel).
+
+Weight layout per (layer, direction): weight_ih [G*H, in], weight_hh
+[G*H, H], bias_ih [G*H], bias_hh [G*H] with G = 1 (simple), 4 (lstm,
+gates i,f,g,o), 3 (gru, gates r,z,c).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.op_registry import register_op
+
+_GATE_MULT = {"RNN_TANH": 1, "RNN_RELU": 1, "LSTM": 4, "GRU": 3}
+
+
+def _cell_step(mode, xt, h, c, w_ih, w_hh, b_ih, b_hh):
+    """One time step. xt: [B, in], h/c: [B, H]. Returns (h', c')."""
+    if mode == "GRU":
+        # paddle applies bias_hh inside the candidate's reset product, so
+        # the hidden contribution stays separate for the c gate
+        hidden = h @ w_hh.T + (b_hh if b_hh is not None else 0.0)
+        x_part = xt @ w_ih.T + (b_ih if b_ih is not None else 0.0)
+        xr, xz, xc = jnp.split(x_part, 3, axis=-1)
+        hr, hz, hc = jnp.split(hidden, 3, axis=-1)
+        r = jax.nn.sigmoid(xr + hr)
+        z = jax.nn.sigmoid(xz + hz)
+        cand = jnp.tanh(xc + r * hc)
+        h_new = z * h + (1.0 - z) * cand
+        return h_new, c
+    gates = xt @ w_ih.T + h @ w_hh.T
+    if b_ih is not None:
+        gates = gates + b_ih
+    if b_hh is not None:
+        gates = gates + b_hh
+    if mode == "LSTM":
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+        c_new = f * c + i * jnp.tanh(g)
+        h_new = o * jnp.tanh(c_new)
+        return h_new, c_new
+    act = jnp.tanh if mode == "RNN_TANH" else jax.nn.relu
+    return act(gates), c
+
+
+def _scan_direction(mode, xs, h0, c0, w_ih, w_hh, b_ih, b_hh, reverse):
+    """xs: [T, B, in] time-major. Returns (ys [T, B, H], hT, cT)."""
+
+    def step(carry, xt):
+        h, c = carry
+        h, c = _cell_step(mode, xt, h, c, w_ih, w_hh, b_ih, b_hh)
+        return (h, c), h
+
+    (hT, cT), ys = lax.scan(step, (h0, c0), xs, reverse=reverse)
+    return ys, hT, cT
+
+
+@register_op("rnn", multi_out=True)
+def rnn(x, init_h, init_c, key, *weights, mode, num_layers=1,
+        hidden_size=None, is_bidirec=False, time_major=False, dropout=0.0,
+        has_bias=True):
+    """Stacked RNN. x: [B, T, in] (or [T, B, in] when time_major).
+    init_h/init_c: [num_layers*num_dirs, B, H] (init_c ignored unless LSTM).
+    `key` (PRNG key) drives inter-layer dropout; pass dropout=0.0 to
+    disable. Returns (outputs, final_h, final_c)."""
+    num_dirs = 2 if is_bidirec else 1
+    per = 4 if has_bias else 2
+    assert len(weights) == num_layers * num_dirs * per, \
+        f"expected {num_layers * num_dirs * per} weights, got {len(weights)}"
+
+    xs = x if time_major else jnp.swapaxes(x, 0, 1)  # [T, B, in]
+    final_h, final_c = [], []
+    for layer in range(num_layers):
+        outs = []
+        for d in range(num_dirs):
+            li = layer * num_dirs + d
+            ws = weights[li * per:(li + 1) * per]
+            w_ih, w_hh = ws[0], ws[1]
+            b_ih = ws[2] if has_bias else None
+            b_hh = ws[3] if has_bias else None
+            h0 = init_h[li]
+            c0 = init_c[li]
+            ys, hT, cT = _scan_direction(
+                mode, xs, h0, c0, w_ih, w_hh, b_ih, b_hh, reverse=(d == 1))
+            outs.append(ys)
+            final_h.append(hT)
+            final_c.append(cT)
+        xs = outs[0] if num_dirs == 1 else jnp.concatenate(outs, axis=-1)
+        if dropout > 0.0 and layer < num_layers - 1:
+            lkey = jax.random.fold_in(jnp.asarray(key), layer)
+            keep = jax.random.bernoulli(lkey, 1.0 - dropout, xs.shape)
+            xs = xs * keep.astype(xs.dtype) / (1.0 - dropout)
+
+    outputs = xs if time_major else jnp.swapaxes(xs, 0, 1)
+    return outputs, jnp.stack(final_h), jnp.stack(final_c)

@@ -1173,6 +1173,44 @@ def _poisson_prop(outs, inputs, attrs):
 case("poisson", [np.full((2000,), 3.0, np.float32), KEY],
      prop=_poisson_prop, grad=None, bf16=False, mode="fn")
 
+# fused rnn op: single-layer LSTM vs explicit numpy recurrence
+_RNN_X = f32((2, 4, 3), seed=140)
+_RNN_H0 = np.zeros((1, 2, 5), np.float32)
+_RNN_WIH = f32((20, 3), seed=141)
+_RNN_WHH = f32((20, 5), seed=142)
+_RNN_BIH = f32((20,), seed=143)
+_RNN_BHH = f32((20,), seed=144)
+
+
+def _np_lstm_ref(outs, inputs, attrs):
+    x, h0 = inputs[0], inputs[1]
+    w_ih, w_hh, b_ih, b_hh = inputs[3], inputs[4], inputs[5], inputs[6]
+    h = h0[0].copy()
+    c = h0[0].copy()
+    ys = []
+    for step in range(x.shape[1]):
+        g = x[:, step] @ w_ih.T + b_ih + h @ w_hh.T + b_hh
+        H = h.shape[-1]
+        i = np_sigmoid(g[:, :H])
+        f = np_sigmoid(g[:, H:2 * H])
+        gg = np.tanh(g[:, 2 * H:3 * H])
+        o = np_sigmoid(g[:, 3 * H:])
+        c = f * c + i * gg
+        h = o * np.tanh(c)
+        ys.append(h)
+    np.testing.assert_allclose(np.asarray(outs[0]), np.stack(ys, 1),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(outs[1])[0], h,
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(outs[2])[0], c,
+                               rtol=1e-5, atol=1e-5)
+
+
+case("rnn", [_RNN_X, _RNN_H0, _RNN_H0, KEY,
+             _RNN_WIH, _RNN_WHH, _RNN_BIH, _RNN_BHH],
+     {"mode": "LSTM", "num_layers": 1, "hidden_size": 5},
+     prop=_np_lstm_ref, grad=None, bf16=False, mode="fn")
+
 # ===========================================================================
 # known-unimplemented ops (tracked; implementing removes from this set)
 # ===========================================================================

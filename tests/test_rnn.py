@@ -1,0 +1,213 @@
+"""RNN family tests: cell equations vs numpy, fused-op vs cell-loop
+equivalence, bidirectional/multi-layer shapes, gradients.
+
+Ref parity: python/paddle/fluid/tests/unittests/rnn/ (test_rnn_nets.py
+compares against a numpy RNN implementation the same way).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.core.tensor import Tensor
+
+
+def sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+def np_lstm_step(x, h, c, w_ih, w_hh, b_ih, b_hh):
+    g = x @ w_ih.T + b_ih + h @ w_hh.T + b_hh
+    H = h.shape[-1]
+    i, f, gg, o = (g[:, :H], g[:, H:2 * H], g[:, 2 * H:3 * H], g[:, 3 * H:])
+    i, f, o = sigmoid(i), sigmoid(f), sigmoid(o)
+    c2 = f * c + i * np.tanh(gg)
+    return o * np.tanh(c2), c2
+
+
+def np_gru_step(x, h, w_ih, w_hh, b_ih, b_hh):
+    H = h.shape[-1]
+    xg = x @ w_ih.T + b_ih
+    hg = h @ w_hh.T + b_hh
+    r = sigmoid(xg[:, :H] + hg[:, :H])
+    z = sigmoid(xg[:, H:2 * H] + hg[:, H:2 * H])
+    cand = np.tanh(xg[:, 2 * H:] + r * hg[:, 2 * H:])
+    return z * h + (1 - z) * cand
+
+
+def np_rnn_step(x, h, w_ih, w_hh, b_ih, b_hh):
+    return np.tanh(x @ w_ih.T + b_ih + h @ w_hh.T + b_hh)
+
+
+def _weights(layer):
+    return {k: np.asarray(v.numpy())
+            for k, v in layer.state_dict().items()}
+
+
+B, T, I, H = 2, 5, 3, 4
+
+
+def _x(seed=0):
+    return np.random.RandomState(seed).randn(B, T, I).astype(np.float32)
+
+
+def test_lstm_forward_matches_numpy():
+    paddle.seed(7)
+    m = nn.LSTM(I, H)
+    m.eval()
+    x = _x(1)
+    out, (hT, cT) = m(Tensor(x))
+    w = _weights(m)
+    h = np.zeros((B, H), np.float32)
+    c = np.zeros((B, H), np.float32)
+    outs = []
+    for step in range(T):
+        h, c = np_lstm_step(x[:, step], h, c, w["weight_ih_l0"],
+                            w["weight_hh_l0"], w["bias_ih_l0"],
+                            w["bias_hh_l0"])
+        outs.append(h)
+    ref = np.stack(outs, 1)
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(hT.numpy()[0], h, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(cT.numpy()[0], c, rtol=1e-5, atol=1e-5)
+
+
+def test_gru_forward_matches_numpy():
+    paddle.seed(8)
+    m = nn.GRU(I, H)
+    m.eval()
+    x = _x(2)
+    out, hT = m(Tensor(x))
+    w = _weights(m)
+    h = np.zeros((B, H), np.float32)
+    for step in range(T):
+        h = np_gru_step(x[:, step], h, w["weight_ih_l0"],
+                        w["weight_hh_l0"], w["bias_ih_l0"],
+                        w["bias_hh_l0"])
+    np.testing.assert_allclose(out.numpy()[:, -1], h, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(hT.numpy()[0], h, rtol=1e-5, atol=1e-5)
+
+
+def test_simple_rnn_forward_matches_numpy():
+    paddle.seed(9)
+    m = nn.SimpleRNN(I, H)
+    m.eval()
+    x = _x(3)
+    out, hT = m(Tensor(x))
+    w = _weights(m)
+    h = np.zeros((B, H), np.float32)
+    for step in range(T):
+        h = np_rnn_step(x[:, step], h, w["weight_ih_l0"],
+                        w["weight_hh_l0"], w["bias_ih_l0"],
+                        w["bias_hh_l0"])
+    np.testing.assert_allclose(out.numpy()[:, -1], h, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(hT.numpy()[0], h, rtol=1e-5, atol=1e-5)
+
+
+def test_fused_lstm_equals_cell_loop():
+    paddle.seed(10)
+    fused = nn.LSTM(I, H)
+    fused.eval()
+    cell = nn.LSTMCell(I, H)
+    # copy fused weights into the cell
+    sd = fused.state_dict()
+    cell.weight_ih._value = sd["weight_ih_l0"]._value
+    cell.weight_hh._value = sd["weight_hh_l0"]._value
+    cell.bias_ih._value = sd["bias_ih_l0"]._value
+    cell.bias_hh._value = sd["bias_hh_l0"]._value
+    looped = nn.RNN(cell)
+    x = _x(4)
+    out_f, (h_f, c_f) = fused(Tensor(x))
+    out_l, (h_l, c_l) = looped(Tensor(x))
+    np.testing.assert_allclose(out_f.numpy(), out_l.numpy(),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(h_f.numpy()[0], h_l.numpy(),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_bidirectional_shapes_and_backward_pass():
+    paddle.seed(11)
+    m = nn.LSTM(I, H, num_layers=2, direction="bidirect")
+    x = _x(5)
+    out, (hT, cT) = m(Tensor(x))
+    assert tuple(out.shape) == (B, T, 2 * H)
+    assert tuple(hT.shape) == (4, B, H)  # num_layers * num_dirs
+    loss = (out * out).sum()
+    loss.backward()
+    g = m.weight_ih_l0.grad
+    assert g is not None and np.isfinite(g.numpy()).all()
+    assert np.abs(g.numpy()).sum() > 0
+
+
+def test_birnn_wrapper():
+    paddle.seed(12)
+    fw = nn.GRUCell(I, H)
+    bw = nn.GRUCell(I, H)
+    m = nn.BiRNN(fw, bw)
+    x = _x(6)
+    out, (st_f, st_b) = m(Tensor(x))
+    assert tuple(out.shape) == (B, T, 2 * H)
+    # backward half must be the reverse-run of bw over x
+    rev, _ = nn.RNN(bw, is_reverse=True)(Tensor(x))
+    np.testing.assert_allclose(out.numpy()[..., H:], rev.numpy(),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_time_major_matches_batch_major():
+    paddle.seed(13)
+    m = nn.GRU(I, H)
+    m.eval()
+    x = _x(7)
+    out_b, _ = m(Tensor(x))
+    m_t = nn.GRU(I, H, time_major=True)
+    m_t.eval()
+    for k, v in m.state_dict().items():
+        m_t.state_dict()[k]._value = v._value
+    out_t, _ = m_t(Tensor(np.swapaxes(x, 0, 1)))
+    np.testing.assert_allclose(np.swapaxes(out_t.numpy(), 0, 1),
+                               out_b.numpy(), rtol=1e-5, atol=1e-5)
+
+
+def test_lstm_grad_matches_jax():
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.core.op_registry import lookup
+
+    paddle.seed(14)
+    m = nn.LSTM(I, H)
+    x = _x(8)
+    xt = Tensor(x, stop_gradient=False)
+    out, _ = m(xt)
+    out.backward(Tensor(np.ones(out.shape, np.float32)))
+    got = xt.grad.numpy()
+
+    w = _weights(m)
+    names = ["weight_ih_l0", "weight_hh_l0", "bias_ih_l0", "bias_hh_l0"]
+    zeros = jnp.zeros((1, B, H))
+    key = jax.random.PRNGKey(0)
+
+    def f(xv):
+        o = lookup("rnn").fn(
+            xv, zeros, zeros, key, *[jnp.asarray(w[n]) for n in names],
+            mode="LSTM", num_layers=1, hidden_size=H)
+        return jnp.sum(o[0])
+
+    ref = jax.grad(f)(jnp.asarray(x))
+    np.testing.assert_allclose(got, np.asarray(ref), rtol=1e-4, atol=1e-5)
+
+
+def test_dropout_between_layers_active_in_train_only():
+    paddle.seed(15)
+    m = nn.LSTM(I, H, num_layers=2, dropout=0.5)
+    x = _x(9)
+    m.eval()
+    a, _ = m(Tensor(x))
+    b, _ = m(Tensor(x))
+    np.testing.assert_allclose(a.numpy(), b.numpy())  # eval: deterministic
+    m.train()
+    c, _ = m(Tensor(x))
+    d, _ = m(Tensor(x))
+    assert np.abs(c.numpy() - d.numpy()).max() > 1e-6  # differing masks
