@@ -146,6 +146,24 @@ def main():
     jax.block_until_ready((loss._value, engine.state.params))
     dt = time.perf_counter() - t0
 
+    profile_dir = os.environ.get("BENCH_PROFILE", "")
+    if profile_dir:
+        # optional deep-dive: XProf device trace of 3 steps (per-op device
+        # timings live in the xplane capture — the compiled step dispatches
+        # no eager ops, so a host-side op table would be empty) + host
+        # chrome-trace of the step spans; stdout stays one JSON line
+        from paddle_tpu import profiler
+
+        profiler.start_trace(profile_dir)
+        with profiler.profile(op_detail=False):
+            with profiler.RecordEvent("bench_step"):
+                for _ in range(3):
+                    loss = one_step()
+                jax.block_until_ready(loss._value)
+        profiler.stop_trace()
+        profiler.export_chrome_tracing(
+            os.path.join(profile_dir, "host_trace.json"))
+
     step_s = dt / iters
     tokens_per_sec = tokens_per_step / step_s
     achieved = flops_per_token * tokens_per_sec

@@ -106,6 +106,7 @@ from . import jit  # noqa: F401,E402
 from . import metric  # noqa: F401,E402
 from . import vision  # noqa: F401,E402
 from . import distribution  # noqa: F401,E402
+from . import profiler  # noqa: F401,E402
 from .framework.io import load, save  # noqa: F401,E402
 from .hapi.model import Model  # noqa: F401,E402
 from .nn.layer.layers import Layer  # noqa: F401,E402

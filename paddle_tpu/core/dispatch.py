@@ -76,6 +76,15 @@ def apply(op_name, *inputs, **attrs):
     Returns Tensor or tuple of Tensors. For `has_aux` ops the aux outputs are
     appended as stop-gradient Tensors.
     """
+    from .. import profiler
+
+    if profiler.is_op_profiling_enabled():
+        with profiler.RecordEvent(op_name, cat="op"):
+            return _apply_impl(op_name, inputs, attrs)
+    return _apply_impl(op_name, inputs, attrs)
+
+
+def _apply_impl(op_name, inputs, attrs):
     from .tensor import Tensor
 
     opdef = lookup(op_name)

@@ -1,0 +1,179 @@
+"""Multi-process launcher: `python -m paddle_tpu.distributed.launch`.
+
+Ref parity: python/paddle/distributed/fleet/launch.py:396 (launch_collective)
+and fleet/launch_utils.py:453 (start_local_trainers) / :565
+(watch_local_trainers). TPU-native differences: one process per HOST (a jax
+process owns all its local chips), so `--nproc_per_node` defaults to 1 and
+is only raised for CPU-simulated multi-host tests; the NCCL-id TCP
+broadcast is replaced by `jax.distributed.initialize` against a coordinator
+address every rank derives from the same env contract.
+
+Env contract written for each child (read by parallel.init_parallel_env):
+  PADDLE_TRAINER_ID         global rank of the process
+  PADDLE_TRAINERS_NUM       world size (total processes)
+  PADDLE_CURRENT_ENDPOINT   this process's endpoint host:port
+  PADDLE_TRAINER_ENDPOINTS  comma list of all endpoints (rank order)
+  PADDLE_MASTER             coordinator address (= endpoint of rank 0)
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+
+def _free_ports(n, host="127.0.0.1"):
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        s.bind((host, 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def parse_args(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.distributed.launch",
+        description="Launch a distributed paddle_tpu job "
+                    "(one process per host; jax.distributed bootstrap).")
+    parser.add_argument("--nnodes", type=int, default=1,
+                        help="number of hosts in the job")
+    parser.add_argument("--node_rank", type=int,
+                        default=int(os.environ.get("PADDLE_NODE_RANK", 0)),
+                        help="rank of this host")
+    parser.add_argument("--master", type=str, default=None,
+                        help="coordinator host:port (rank-0 host); "
+                             "required when nnodes > 1")
+    parser.add_argument("--ips", type=str, default=None,
+                        help="comma list of host IPs, rank order (ref "
+                             "fleet.launch --ips); defaults to the master "
+                             "host for every node")
+    parser.add_argument("--nproc_per_node", type=int, default=1,
+                        help="processes per host (1 on TPU: a process owns "
+                             "all local chips; >1 only for CPU-mesh tests)")
+    parser.add_argument("--log_dir", type=str, default=None,
+                        help="write per-rank workerlog.N files here")
+    parser.add_argument("--poll_interval", type=float, default=0.5)
+    parser.add_argument("training_script", type=str)
+    parser.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return parser.parse_args(argv)
+
+
+def _build_endpoints(args):
+    """Endpoint per global rank. Single-node: loopback + free ports."""
+    world = args.nnodes * args.nproc_per_node
+    if args.nnodes == 1:
+        ports = _free_ports(args.nproc_per_node)
+        return ["127.0.0.1:%d" % p for p in ports], world
+    if not args.master:
+        raise SystemExit("--master host:port is required when nnodes > 1")
+    base = int(args.master.split(":")[1])
+    if args.ips:
+        hosts = [h.strip() for h in args.ips.split(",")]
+        if len(hosts) != args.nnodes:
+            raise SystemExit(
+                f"--ips lists {len(hosts)} hosts but nnodes={args.nnodes}")
+    else:
+        hosts = [args.master.split(":")[0]] * args.nnodes
+    eps = []
+    for node in range(args.nnodes):
+        for i in range(args.nproc_per_node):
+            eps.append(f"{hosts[node]}:{base + i}")
+    return eps, world
+
+
+def start_local_trainers(args, endpoints, world):
+    """ref launch_utils.py:453 — one Popen per local rank with the env
+    contract; stdout/stderr tee'd to workerlog.N when --log_dir given."""
+    procs = []
+    logs = []
+    master = args.master or endpoints[0]
+    if args.log_dir:
+        os.makedirs(args.log_dir, exist_ok=True)
+    for local in range(args.nproc_per_node):
+        rank = args.node_rank * args.nproc_per_node + local
+        env = dict(os.environ)
+        env.update({
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": str(world),
+            "PADDLE_CURRENT_ENDPOINT": endpoints[rank],
+            "PADDLE_TRAINER_ENDPOINTS": ",".join(endpoints),
+            "PADDLE_MASTER": master,
+            "PADDLE_LOCAL_RANK": str(local),
+        })
+        cmd = [sys.executable, "-u", args.training_script] + \
+            args.training_script_args
+        if args.log_dir:
+            f = open(os.path.join(args.log_dir, f"workerlog.{rank}"), "w")
+            logs.append(f)
+            p = subprocess.Popen(cmd, env=env, stdout=f,
+                                 stderr=subprocess.STDOUT)
+        else:
+            p = subprocess.Popen(cmd, env=env)
+        procs.append(p)
+    return procs, logs
+
+
+def _terminate_all(procs, grace=10.0):
+    for p in procs:
+        if p.poll() is None:
+            p.terminate()
+    deadline = time.time() + grace
+    for p in procs:
+        if p.poll() is None:
+            try:
+                p.wait(timeout=max(0.1, deadline - time.time()))
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+def watch_local_trainers(procs, poll_interval=0.5):
+    """ref launch_utils.py:565 — poll children; any non-zero exit kills
+    the whole local pod and propagates the code."""
+    try:
+        while True:
+            alive = False
+            for rank, p in enumerate(procs):
+                ret = p.poll()
+                if ret is None:
+                    alive = True
+                elif ret != 0:
+                    sys.stderr.write(
+                        f"[launch] rank {rank} (pid {p.pid}) exited with "
+                        f"code {ret}; terminating the pod\n")
+                    _terminate_all(procs)
+                    return ret
+            if not alive:
+                return 0
+            time.sleep(poll_interval)
+    except KeyboardInterrupt:
+        _terminate_all(procs)
+        return 130
+
+
+def launch(argv=None):
+    args = parse_args(argv)
+    endpoints, world = _build_endpoints(args)
+    procs, logs = start_local_trainers(args, endpoints, world)
+
+    def _sig(signum, frame):
+        _terminate_all(procs)
+        sys.exit(128 + signum)
+
+    signal.signal(signal.SIGTERM, _sig)
+    code = watch_local_trainers(procs, args.poll_interval)
+    for f in logs:
+        f.close()
+    return code
+
+
+if __name__ == "__main__":
+    sys.exit(launch())

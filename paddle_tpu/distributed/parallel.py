@@ -60,8 +60,18 @@ def init_parallel_env():
     global _parallel_env_initialized
     env = ParallelEnv()
     if env.world_size > 1 and not _parallel_env_initialized:
-        coordinator = env.trainer_endpoints[0] if env.trainer_endpoints \
-            else None
+        coordinator = os.environ.get("PADDLE_MASTER") or (
+            env.trainer_endpoints[0] if env.trainer_endpoints else None)
+        configured = os.environ.get("JAX_PLATFORMS", "") or str(
+            getattr(jax.config, "jax_platforms", None) or "")
+        if "cpu" in configured:
+            # multi-process CPU (the 'no real cluster' test backend) needs
+            # an explicit cross-process collectives implementation
+            try:
+                jax.config.update(
+                    "jax_cpu_collectives_implementation", "gloo")
+            except (ValueError, RuntimeError):
+                pass
         jax.distributed.initialize(
             coordinator_address=coordinator,
             num_processes=env.world_size,

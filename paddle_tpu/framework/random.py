@@ -20,13 +20,26 @@ class Generator:
     """Counter-based key stream (split-free: fold_in on a monotone counter)."""
 
     def __init__(self, seed=0):
+        # lazy: building a PRNGKey initialises the XLA backend, which must
+        # not happen at import time (jax.distributed.initialize comes first
+        # in multi-process jobs)
         self._seed = seed
-        self._base = jax.random.PRNGKey(seed)
+        self._base_cache = None
         self._counter = 0
+
+    @property
+    def _base(self):
+        if self._base_cache is None:
+            self._base_cache = jax.random.PRNGKey(self._seed)
+        return self._base_cache
+
+    @_base.setter
+    def _base(self, value):
+        self._base_cache = value
 
     def manual_seed(self, seed):
         self._seed = int(seed)
-        self._base = jax.random.PRNGKey(self._seed)
+        self._base_cache = None
         self._counter = 0
         return self
 

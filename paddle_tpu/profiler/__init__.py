@@ -1,0 +1,192 @@
+"""Profiler: host-side event recording + device trace capture.
+
+Ref parity: paddle/fluid/platform/profiler.h (RecordEvent RAII, event
+aggregation), platform/device_tracer.cc (CUPTI device tracing),
+python/paddle/fluid/profiler.py:190 (profiler context + summary table),
+tools/timeline.py (chrome-trace export). TPU-native mapping:
+
+- RecordEvent           -> host wall-clock spans (thread-aware), doubling
+                           as jax.profiler.TraceAnnotation so annotations
+                           show up inside XProf device traces
+- DeviceTracer/CUPTI    -> jax.profiler.start_trace/stop_trace (XProf
+                           xplane capture; the PJRT runtime records device
+                           ops — no CUPTI analogue needed)
+- profiler.profiler ctx -> profiler.profile(...)
+- tools/timeline.py     -> export_chrome_tracing(path) from host events
+- op-time table         -> summary() — per-op totals/avg/max/min, fed by
+                           dispatch instrumentation (enable_op_profiling)
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+
+__all__ = [
+    "RecordEvent", "enable_op_profiling", "disable_op_profiling",
+    "is_op_profiling_enabled", "reset", "events", "summary",
+    "export_chrome_tracing", "profile", "start_trace", "stop_trace",
+]
+
+_lock = threading.Lock()
+_events: list[dict] = []  # {name, cat, ts, dur, tid}
+_op_profiling = False
+_tls = threading.local()
+
+
+def _now_us():
+    return time.perf_counter_ns() / 1000.0
+
+
+class RecordEvent:
+    """Named host-side span (ref platform/profiler.h RecordEvent).
+
+    Context manager; nests. Also emits a jax TraceAnnotation so the name
+    appears in XProf device timelines captured via start_trace."""
+
+    def __init__(self, name, cat="host"):
+        self.name = name
+        self.cat = cat
+        self._t0 = None
+        self._jax_ann = None
+
+    def __enter__(self):
+        depth = getattr(_tls, "depth", 0)
+        _tls.depth = depth + 1
+        self._t0 = _now_us()
+        try:
+            import jax.profiler as jp
+
+            self._jax_ann = jp.TraceAnnotation(self.name)
+            self._jax_ann.__enter__()
+        except Exception:
+            self._jax_ann = None
+        return self
+
+    def __exit__(self, *exc):
+        if self._jax_ann is not None:
+            self._jax_ann.__exit__(*exc)
+        dur = _now_us() - self._t0
+        _tls.depth -= 1
+        with _lock:
+            _events.append({
+                "name": self.name, "cat": self.cat, "ts": self._t0,
+                "dur": dur, "tid": threading.get_ident(),
+                "depth": _tls.depth,
+            })
+        return False
+
+
+def reset():
+    with _lock:
+        _events.clear()
+
+
+def events():
+    with _lock:
+        return list(_events)
+
+
+def enable_op_profiling():
+    """Record a span per dispatched op (ref imperative/profiler.cc)."""
+    global _op_profiling
+    _op_profiling = True
+
+
+def disable_op_profiling():
+    global _op_profiling
+    _op_profiling = False
+
+
+def is_op_profiling_enabled():
+    return _op_profiling
+
+
+@contextlib.contextmanager
+def profile(*, op_detail=True, trace_dir=None):
+    """Profiler scope (ref fluid/profiler.py:257 profiler ctx).
+
+    op_detail: record per-op dispatch spans for summary().
+    trace_dir: also capture an XProf device trace there."""
+    reset()
+    if op_detail:
+        enable_op_profiling()
+    if trace_dir:
+        start_trace(trace_dir)
+    try:
+        yield
+    finally:
+        if trace_dir:
+            stop_trace()
+        if op_detail:
+            disable_op_profiling()
+
+
+def summary(sorted_by="total", limit=None):
+    """Aggregate events by name into the reference's op-time table
+    (fluid/profiler.py:190 print_profiler). Returns the table string."""
+    agg: dict[str, list[float]] = {}
+    for e in events():
+        agg.setdefault(e["name"], []).append(e["dur"])
+    rows = []
+    for name, durs in agg.items():
+        rows.append({
+            "name": name, "calls": len(durs), "total": sum(durs),
+            "avg": sum(durs) / len(durs), "max": max(durs),
+            "min": min(durs),
+        })
+    key = {"total": "total", "calls": "calls", "avg": "avg",
+           "max": "max", "min": "min"}.get(sorted_by, "total")
+    rows.sort(key=lambda r: r[key], reverse=True)
+    if limit:
+        rows = rows[:limit]
+    lines = [
+        f"{'Event':<40}{'Calls':>8}{'Total(us)':>14}{'Avg(us)':>12}"
+        f"{'Max(us)':>12}{'Min(us)':>12}"
+    ]
+    lines.append("-" * len(lines[0]))
+    for r in rows:
+        lines.append(
+            f"{r['name'][:39]:<40}{r['calls']:>8}{r['total']:>14.1f}"
+            f"{r['avg']:>12.1f}{r['max']:>12.1f}{r['min']:>12.1f}")
+    return "\n".join(lines)
+
+
+def export_chrome_tracing(path):
+    """Write host events as a chrome://tracing JSON file
+    (ref tools/timeline.py)."""
+    trace = {
+        "traceEvents": [
+            {
+                "name": e["name"], "cat": e["cat"], "ph": "X",
+                "ts": e["ts"], "dur": e["dur"], "pid": os.getpid(),
+                "tid": e["tid"],
+            }
+            for e in events()
+        ],
+        "displayTimeUnit": "ms",
+    }
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(trace, f)
+    return path
+
+
+# -- device (XProf) trace ----------------------------------------------------
+
+
+def start_trace(logdir):
+    """Capture an XProf/xplane device trace (ref device_tracer.cc — here
+    the PJRT runtime does the recording)."""
+    import jax.profiler as jp
+
+    jp.start_trace(logdir)
+
+
+def stop_trace():
+    import jax.profiler as jp
+
+    jp.stop_trace()

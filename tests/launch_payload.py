@@ -1,0 +1,39 @@
+"""Payload for launcher tests (ref: the collective_*.py scripts driven by
+test_collective_api_base.py). Runs a real 2-process gloo collective on the
+CPU backend, or crashes a designated rank to exercise the watchdog."""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(__file__)))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 2)
+
+from paddle_tpu.distributed.parallel import init_parallel_env  # noqa: E402
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # makes init_parallel_env pick gloo
+env = init_parallel_env()
+
+if "--crash-rank" in sys.argv:
+    victim = int(sys.argv[sys.argv.index("--crash-rank") + 1])
+    if env.rank == victim:
+        # hard exit: a graceful sys.exit would block in jax.distributed's
+        # atexit shutdown barrier until the peer finishes — precisely the
+        # hang the watchdog exists to break
+        os._exit(3)
+    time.sleep(120)  # the watchdog must kill us well before this
+    sys.exit(0)
+
+assert jax.process_count() == 2, jax.process_count()
+
+import numpy as np  # noqa: E402
+from jax.experimental import multihost_utils  # noqa: E402
+
+gathered = multihost_utils.process_allgather(
+    np.array([jax.process_index()]))
+assert sorted(gathered.ravel().tolist()) == [0, 1], gathered
+print(f"RANK {env.rank} COLLECTIVE OK", flush=True)

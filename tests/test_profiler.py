@@ -1,0 +1,70 @@
+"""Profiler tests: RecordEvent spans, op instrumentation, summary table,
+chrome-trace export. Ref parity: fluid/profiler.py + tools/timeline.py."""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import profiler
+
+
+def test_record_event_spans():
+    profiler.reset()
+    with profiler.RecordEvent("outer"):
+        time.sleep(0.01)
+        with profiler.RecordEvent("inner"):
+            time.sleep(0.005)
+    evs = profiler.events()
+    names = {e["name"] for e in evs}
+    assert names == {"outer", "inner"}
+    outer = next(e for e in evs if e["name"] == "outer")
+    inner = next(e for e in evs if e["name"] == "inner")
+    assert outer["dur"] >= inner["dur"]
+    assert outer["dur"] >= 10_000  # >= 10ms in us
+
+
+def test_op_profiling_and_summary():
+    x = paddle.to_tensor(np.ones((4, 4), np.float32))
+    with profiler.profile(op_detail=True):
+        y = paddle.matmul(x, x)
+        z = y + x
+        _ = z.numpy()
+    table = profiler.summary()
+    assert "matmul" in table
+    assert "elementwise_add" in table
+    assert "Calls" in table and "Total(us)" in table
+    # off outside the scope: no new events recorded
+    before = len(profiler.events())
+    _ = paddle.matmul(x, x)
+    assert len(profiler.events()) == before
+
+
+def test_chrome_trace_export(tmp_path):
+    profiler.reset()
+    with profiler.RecordEvent("step"):
+        pass
+    p = profiler.export_chrome_tracing(str(tmp_path / "trace.json"))
+    with open(p) as f:
+        trace = json.load(f)
+    assert trace["traceEvents"], "empty trace"
+    ev = trace["traceEvents"][0]
+    assert ev["name"] == "step" and ev["ph"] == "X"
+    assert "ts" in ev and "dur" in ev
+
+
+def test_xprof_device_trace(tmp_path):
+    logdir = str(tmp_path / "xprof")
+    x = paddle.to_tensor(np.ones((8, 8), np.float32))
+    profiler.start_trace(logdir)
+    _ = paddle.matmul(x, x).numpy()
+    profiler.stop_trace()
+    import os
+
+    found = []
+    for root, _dirs, files in os.walk(logdir):
+        found.extend(files)
+    assert any(f.endswith(".xplane.pb") for f in found), found
