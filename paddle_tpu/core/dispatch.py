@@ -70,16 +70,22 @@ def _as_primal(x):
     return x
 
 
+_profiler_mod = None
+
+
 def apply(op_name, *inputs, **attrs):
     """Run op `op_name` on `inputs` (Tensors / arrays / scalars).
 
     Returns Tensor or tuple of Tensors. For `has_aux` ops the aux outputs are
     appended as stop-gradient Tensors.
     """
-    from .. import profiler
+    global _profiler_mod
+    if _profiler_mod is None:
+        from .. import profiler as _p
 
-    if profiler.is_op_profiling_enabled():
-        with profiler.RecordEvent(op_name, cat="op"):
+        _profiler_mod = _p
+    if _profiler_mod._op_profiling:
+        with _profiler_mod.RecordEvent(op_name, cat="op"):
             return _apply_impl(op_name, inputs, attrs)
     return _apply_impl(op_name, inputs, attrs)
 

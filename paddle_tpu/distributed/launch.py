@@ -81,12 +81,17 @@ def _build_endpoints(args):
         if len(hosts) != args.nnodes:
             raise SystemExit(
                 f"--ips lists {len(hosts)} hosts but nnodes={args.nnodes}")
+        # distinct hosts: each node reuses the same port block
+        eps = [f"{hosts[node]}:{base + i}"
+               for node in range(args.nnodes)
+               for i in range(args.nproc_per_node)]
     else:
-        hosts = [args.master.split(":")[0]] * args.nnodes
-    eps = []
-    for node in range(args.nnodes):
-        for i in range(args.nproc_per_node):
-            eps.append(f"{hosts[node]}:{base + i}")
+        # no --ips: all endpoints fabricated on the master host (same-host
+        # testing); ports must then be globally unique to stay addressable
+        host = args.master.split(":")[0]
+        eps = [f"{host}:{base + node * args.nproc_per_node + i}"
+               for node in range(args.nnodes)
+               for i in range(args.nproc_per_node)]
     return eps, world
 
 

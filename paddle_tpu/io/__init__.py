@@ -1,19 +1,25 @@
 """paddle_tpu.io — datasets and DataLoader.
 
 Ref parity: python/paddle/fluid/dataloader/ (Dataset/BatchSampler/
-DistributedBatchSampler/worker machinery) + fluid/reader.py DataLoader.
-Single-process iteration is the default; `num_workers>0` uses a
-thread-based prefetcher (the heavy per-sample decode work on TPU hosts is
-numpy-bound and the C++ datafeed (paddle_tpu/native) covers the hot path;
-a full shm+fork worker pool mirrors the reference but is deferred).
+DistributedBatchSampler) + fluid/reader.py DataLoader +
+fluid/dataloader/dataloader_iter.py:97,248 (single-/multi-process
+iterators) + dataloader/worker.py (worker loop). `num_workers>0` forks a
+real worker pool: samples are collated to numpy inside the workers
+(GIL-free of the parent), returned through an mp queue in batch order, and
+converted to Tensors in the parent. `use_buffer_reader` double-buffers the
+next batch onto the device (jax.device_put is async) while the previous
+one computes. TensorDataset batches take the C++ datafeed fast path
+(paddle_tpu.native.gather_rows).
 """
 
 from __future__ import annotations
 
 import itertools
 import math
+import multiprocessing as mp
 import queue
 import threading
+import traceback
 
 import numpy as np
 
@@ -42,7 +48,10 @@ class IterableDataset(Dataset):
 
 class TensorDataset(Dataset):
     def __init__(self, tensors):
-        self.tensors = tensors
+        # store host numpy copies: samples must be fork-safe (loader
+        # workers) and free of device-array references
+        self.tensors = [np.asarray(t.numpy()) if isinstance(t, Tensor)
+                        else np.asarray(t) for t in tensors]
 
     def __getitem__(self, idx):
         return tuple(t[idx] for t in self.tensors)
@@ -232,20 +241,225 @@ class DistributedBatchSampler(BatchSampler):
         self.epoch = epoch
 
 
-def default_collate_fn(batch):
+def _numpy_collate(batch):
+    """Worker-side collate: numpy only (Tensors would drag a jax backend
+    into every worker process)."""
     sample = batch[0]
-    if isinstance(sample, (Tensor,)):
-        return Tensor(np.stack([np.asarray(s._value) for s in batch]))
+    if isinstance(sample, Tensor):
+        return np.stack([np.asarray(s._value) for s in batch])
     if isinstance(sample, np.ndarray):
-        return Tensor(np.stack(batch))
+        return np.stack(batch)
     if isinstance(sample, (int, float, np.number)):
-        return Tensor(np.asarray(batch))
+        return np.asarray(batch)
     if isinstance(sample, (list, tuple)):
         transposed = list(zip(*batch))
-        return [default_collate_fn(list(fields)) for fields in transposed]
+        return [_numpy_collate(list(fields)) for fields in transposed]
     if isinstance(sample, dict):
-        return {k: default_collate_fn([d[k] for d in batch]) for k in sample}
+        return {k: _numpy_collate([d[k] for d in batch]) for k in sample}
     return batch
+
+
+def _to_tensor_tree(item):
+    if isinstance(item, np.ndarray):
+        return Tensor(item)
+    if isinstance(item, (list, tuple)):
+        return [_to_tensor_tree(v) for v in item]
+    if isinstance(item, dict):
+        return {k: _to_tensor_tree(v) for k, v in item.items()}
+    return item
+
+
+def default_collate_fn(batch):
+    return _to_tensor_tree(_numpy_collate(batch))
+
+
+class WorkerInfo:
+    def __init__(self, id, num_workers, dataset, seed):
+        self.id = id
+        self.num_workers = num_workers
+        self.dataset = dataset
+        self.seed = seed
+
+
+_worker_info: WorkerInfo | None = None
+
+
+def get_worker_info():
+    """Inside a loader worker: (id, num_workers, dataset); None in the
+    main process (ref fluid/dataloader/worker.py get_worker_info)."""
+    return _worker_info
+
+
+class _ExcInfo:
+    def __init__(self, exc):
+        self.type_name = type(exc).__name__
+        self.tb = traceback.format_exc()
+
+
+def _worker_loop(dataset, index_queue, result_queue, collate_fn, init_fn,
+                 worker_id, num_workers, base_seed):
+    """ref fluid/dataloader/worker.py:_worker_loop — pull index lists,
+    collate to numpy, push (batch_id, data)."""
+    global _worker_info
+    _worker_info = WorkerInfo(worker_id, num_workers, dataset,
+                              base_seed + worker_id)
+    np.random.seed(base_seed + worker_id)
+    if init_fn is not None:
+        init_fn(worker_id)
+    while True:
+        job = index_queue.get()
+        if job is None:
+            return
+        batch_id, idxs = job
+        try:
+            samples = [dataset[i] for i in idxs]
+            for s in samples:
+                items = s if isinstance(s, (list, tuple)) else (s,)
+                if any(isinstance(v, Tensor) for v in items):
+                    raise RuntimeError(
+                        "dataset __getitem__ returned a paddle Tensor "
+                        "inside a loader worker; return numpy when "
+                        "num_workers > 0 — touching device arrays in a "
+                        "forked child of an initialised XLA runtime is "
+                        "unsafe")
+            data = collate_fn(samples)
+            result_queue.put((batch_id, ("ok", data)))
+        except Exception as e:  # noqa: BLE001 — forwarded to parent
+            result_queue.put((batch_id, ("err", _ExcInfo(e))))
+
+
+class _MultiprocessIter:
+    """Fork-based worker pool with ordered batch reassembly
+    (ref fluid/dataloader/dataloader_iter.py:248
+    _DataLoaderIterMultiProcess)."""
+
+    def __init__(self, loader):
+        self.loader = loader
+        self.num_workers = loader.num_workers
+        self.timeout = loader.timeout or None  # 0/None => wait, watch pool
+        ctx = mp.get_context("fork")
+        self.result_queue = ctx.Queue()
+        self.index_queues = []
+        self.workers = []
+        # fresh base seed per iterator/epoch: identical reseeding every
+        # epoch would repeat augmentations byte-for-byte
+        epoch = loader._epoch_count
+        loader._epoch_count += 1
+        base_seed = (int(_random.default_generator.initial_seed())
+                     * 1000003 + epoch * 7919) & 0x7FFFFFFF
+        collate = loader._worker_collate_fn
+        for w in range(self.num_workers):
+            iq = ctx.Queue()
+            p = ctx.Process(
+                target=_worker_loop,
+                args=(loader.dataset, iq, self.result_queue, collate,
+                      loader.worker_init_fn, w, self.num_workers,
+                      base_seed),
+                daemon=True)
+            p.start()
+            self.index_queues.append(iq)
+            self.workers.append(p)
+        self._next_send = 0
+        self._next_recv = 0
+        self._reorder: dict[int, object] = {}
+        self._batches = iter(loader._index_batches())
+        self._exhausted = False
+        self._window = max(2, loader.prefetch_factor * self.num_workers)
+        self._shutdown_done = False
+        for _ in range(self._window):
+            self._dispatch_one()
+
+    def _dispatch_one(self):
+        if self._exhausted:
+            return
+        try:
+            idxs = next(self._batches)
+        except StopIteration:
+            self._exhausted = True
+            return
+        wid = self._next_send % self.num_workers
+        self.index_queues[wid].put((self._next_send, idxs))
+        self._next_send += 1
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._next_recv >= self._next_send and self._exhausted:
+            self._shutdown()
+            raise StopIteration
+        waited = 0.0
+        while self._next_recv not in self._reorder:
+            try:
+                batch_id, payload = self.result_queue.get(timeout=5.0)
+            except queue.Empty:
+                waited += 5.0
+                dead = [i for i, p in enumerate(self.workers)
+                        if not p.is_alive()]
+                if dead:
+                    self._shutdown()
+                    raise RuntimeError(
+                        f"DataLoader workers died: ranks {dead}")
+                if self.timeout and waited >= self.timeout:
+                    self._shutdown()
+                    raise RuntimeError(
+                        f"DataLoader timed out after {self.timeout}s")
+                continue  # timeout unset (block indefinitely) or not yet
+            self._reorder[batch_id] = payload
+        status, data = self._reorder.pop(self._next_recv)
+        self._next_recv += 1
+        self._dispatch_one()
+        if status == "err":
+            self._shutdown()
+            raise RuntimeError(
+                f"DataLoader worker raised {data.type_name}:\n{data.tb}")
+        return _to_tensor_tree(data)
+
+    def _shutdown(self):
+        if self._shutdown_done:
+            return
+        self._shutdown_done = True
+        for iq in self.index_queues:
+            try:
+                iq.put(None)
+            except (OSError, ValueError):
+                pass
+        for p in self.workers:
+            p.join(timeout=5)
+            if p.is_alive():
+                p.terminate()
+        self.result_queue.close()
+
+    def __del__(self):
+        try:
+            self._shutdown()
+        except Exception:  # noqa: BLE001 — interpreter teardown
+            pass
+
+
+def _device_prefetch(iterator):
+    """Double-buffered prefetch-to-device: the transfer of batch N+1 is
+    dispatched (device_put is async) while batch N computes
+    (ref reader.py use_buffer_reader / double-buffer queues)."""
+    import jax
+
+    def put(batch):
+        if isinstance(batch, Tensor):
+            return Tensor(jax.device_put(batch._value))
+        if isinstance(batch, (list, tuple)):
+            return [put(b) for b in batch]
+        if isinstance(batch, dict):
+            return {k: put(v) for k, v in batch.items()}
+        return batch
+
+    prev = None
+    for batch in iterator:
+        cur = put(batch)
+        if prev is not None:
+            yield prev
+        prev = cur
+    if prev is not None:
+        yield prev
 
 
 class DataLoader:
@@ -260,8 +474,15 @@ class DataLoader:
         self.dataset = dataset
         self.return_list = return_list
         self.collate_fn = collate_fn or default_collate_fn
+        # worker-side collate must stay numpy; a user collate_fn runs
+        # verbatim in the worker and np leaves become Tensors in the parent
+        self._worker_collate_fn = collate_fn or _numpy_collate
         self.num_workers = num_workers
         self.prefetch_factor = prefetch_factor
+        self.use_buffer_reader = use_buffer_reader
+        self.timeout = timeout
+        self.worker_init_fn = worker_init_fn
+        self._epoch_count = 0
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if batch_sampler is not None:
             self.batch_sampler = batch_sampler
@@ -283,6 +504,36 @@ class DataLoader:
             return len(self.dataset)
         return len(self.batch_sampler)
 
+    def _index_batches(self):
+        """Index lists consumed by the worker pool (map-style, batched)."""
+        yield from self.batch_sampler
+
+    def _native_tensor_batch(self, idxs):
+        """C++ datafeed fast path: one parallel gather per component
+        instead of per-sample indexing + stack."""
+        from .. import native
+
+        return [Tensor(native.gather_rows(a, idxs))
+                for a in self._native_arrays]
+
+    def _can_use_native(self):
+        from .. import native
+
+        cached = getattr(self, "_native_ok", None)
+        if cached is not None:
+            return cached
+        ok = (isinstance(self.dataset, TensorDataset)
+              and self.collate_fn is default_collate_fn
+              and native.available())
+        if ok:
+            arrays = []
+            for t in self.dataset.tensors:
+                a = t.numpy() if isinstance(t, Tensor) else np.asarray(t)
+                arrays.append(np.ascontiguousarray(a))
+            self._native_arrays = arrays
+        self._native_ok = ok
+        return ok
+
     def _iter_batches(self):
         if self._iterable_mode:
             it = iter(self.dataset)
@@ -296,14 +547,29 @@ class DataLoader:
         elif self.batch_sampler is None:
             for i in range(len(self.dataset)):
                 yield self.dataset[i]
+        elif self._can_use_native():
+            for idxs in self.batch_sampler:
+                yield self._native_tensor_batch(idxs)
         else:
             for idxs in self.batch_sampler:
                 yield self.collate_fn([self.dataset[i] for i in idxs])
 
     def __iter__(self):
-        if self.num_workers and self.num_workers > 0:
-            return self._prefetch_iter()
-        return self._iter_batches()
+        workers = bool(self.num_workers and self.num_workers > 0)
+        if workers and self._iterable_mode:
+            # iterable datasets keep the thread prefetcher (each fork would
+            # otherwise re-iterate the same stream)
+            it = self._prefetch_iter()
+        elif workers and self.batch_sampler is not None \
+                and not self._can_use_native():
+            # batch_size=None (raw-sample mode) and pre-loaded
+            # TensorDatasets gain nothing from forking
+            it = iter(_MultiprocessIter(self))
+        else:
+            it = self._iter_batches()
+        if self.use_buffer_reader:
+            return _device_prefetch(it)
+        return it
 
     def _prefetch_iter(self):
         """Thread-based prefetch pipeline (keeps the accelerator fed while
@@ -334,5 +600,3 @@ class DataLoader:
             yield item
 
 
-def get_worker_info():
-    return None
