@@ -41,53 +41,105 @@ def pipeline_spmd(stage_fn, mesh, *, num_stages, num_micro):
     stacked_params: pytree whose leaves have leading dim [num_stages]
     microbatches:   [num_micro, micro_batch, ...]
     outputs:        [num_micro, micro_batch, ...] (from the last stage)
-    """
-    perm = [(i, (i + 1) % num_stages) for i in range(num_stages)]
 
-    def per_device(params, x_mb):
+    Memory schedule (the 1F1B working-set analogue,
+    ref section_worker.cc:134-180): the micro-batch stream is SHARDED over
+    'pp' (device s holds micro-batches {j*S+s}, L = M/S each) instead of
+    replicated, and per-tick traffic is three [micro]-sized ppermutes:
+
+    - an input ring rotating toward stage 0: every S ticks each device
+      injects its next local micro-batch; after k shifts the batch due at
+      tick t arrives at stage 0 exactly at tick t;
+    - the activation carry (stage s -> s+1), as before;
+    - an output ring rotating away from the last stage: finished
+      micro-batches travel back to their owner device, which captures
+      them at tick j*S + 2*s + S (last stage captures its own directly).
+
+    Per-device stream memory drops from 2*M to 2*M/S micro-batches and the
+    old O(M x batch) psum broadcast of outputs disappears entirely.
+    """
+    S, M = num_stages, num_micro
+    # pad the stream to a multiple of S: the ring schedule needs equal
+    # local shares; padded micro-batches compute garbage that is sliced
+    # off the outputs (and therefore carries no gradient)
+    L = -(-M // S)
+    M_pad = L * S
+    fwd = [(i, (i + 1) % S) for i in range(S)]
+    back = [(i, (i - 1) % S) for i in range(S)]
+
+    def per_device(params, x_local):
         # inside shard_map over 'pp': params leaves are [1, ...] (this
-        # stage's slice), x_mb is the full micro-batch stream (replicated
-        # along pp)
+        # stage's slice), x_local is [L, micro, ...] (this device's strided
+        # share of the stream: micro-batches j*S + stage)
         stage = jax.lax.axis_index(PP_AXIS)
         local = jax.tree.map(lambda p: p[0], params)
-        mbs = x_mb.shape[0]
-        total = num_micro + num_stages - 1
+        total = M_pad + 2 * S - 2 if S > 1 else M_pad
 
-        carry_buf = jnp.zeros_like(x_mb[0])
-        outputs = jnp.zeros_like(x_mb)
+        zero_mb = jnp.zeros_like(x_local[0])
+        outs0 = jnp.zeros_like(x_local)
 
-        def tick(carry, t):
-            state, outs = carry
-            # stage 0 consumes micro-batch t (clamped; masked later)
-            idx = jnp.clip(t, 0, num_micro - 1)
-            inp = jnp.where(stage == 0, x_mb[idx], state)
-            out = stage_fn(local, inp)
-            # last stage emits micro-batch t-(S-1)
-            emit_t = t - (num_stages - 1)
-            valid = (emit_t >= 0) & (emit_t <= num_micro - 1)
-            eidx = jnp.clip(emit_t, 0, num_micro - 1)
+        def tick(carry, u):
+            act, iring, oring, outs = carry
+            # 1) input injection: at ticks u = j*S every device loads its
+            # j-th local micro-batch into the input ring
+            jj = u // S
+            inject = (u % S == 0) & (jj < L)
+            iring = jnp.where(inject, x_local[jnp.clip(jj, 0, L - 1)],
+                              iring)
+            # 2) owner capture from the output ring (stages < S-1): the
+            # batch finished at tick t = j*S+s+S-1 arrives after s+1
+            # shifts, i.e. at tick j*S + 2s + S
+            num = u - 2 * stage - S
+            jcap = num // S
+            cap = (stage < S - 1) & (num >= 0) & (num % S == 0) \
+                & (jcap < L)
             outs = jnp.where(
-                valid & (stage == num_stages - 1),
-                outs.at[eidx].set(out), outs)
-            nxt = jax.lax.ppermute(out, PP_AXIS, perm)
-            return (nxt, outs), None
+                cap, outs.at[jnp.clip(jcap, 0, L - 1)].set(oring), outs)
+            # 3) stage compute (stage 0 eats the input ring)
+            inp = jnp.where(stage == 0, iring, act)
+            out = stage_fn(local, inp)
+            # 4) last stage: emit into the output ring; micro-batches it
+            # owns itself (t % S == S-1) are stored directly
+            t = u - (S - 1)
+            emitting = (stage == S - 1) & (t >= 0) & (t < M_pad)
+            own = emitting & (t % S == S - 1)
+            outs = jnp.where(
+                own, outs.at[jnp.clip(t // S, 0, L - 1)].set(out), outs)
+            oring = jnp.where(emitting, out, oring)
+            # 5) ring shifts
+            act = jax.lax.ppermute(out, PP_AXIS, fwd)
+            iring = jax.lax.ppermute(iring, PP_AXIS, back)
+            oring = jax.lax.ppermute(oring, PP_AXIS, fwd)
+            return (act, iring, oring, outs), None
 
-        (_, outputs), _ = jax.lax.scan(
-            tick, (carry_buf, outputs), jnp.arange(total))
-        # bring the last stage's outputs to every pp slice (grads flow back
-        # through the psum's transpose)
-        outputs = jax.lax.psum(
-            jnp.where(stage == num_stages - 1, outputs, 0.0), PP_AXIS)
-        return outputs
+        (_, _, _, outs), _ = jax.lax.scan(
+            tick, (zero_mb, zero_mb, zero_mb, outs0), jnp.arange(total))
+        return outs
 
     # manual only over 'pp': dp/mp/sharding stay GSPMD-auto inside the
     # stage body, so TP sharding constraints and batch sharding compose
-    return jax.shard_map(
+    sm = jax.shard_map(
         per_device, mesh=mesh,
-        in_specs=(P(PP_AXIS), P()),
-        out_specs=P(),
+        in_specs=(P(PP_AXIS), P(PP_AXIS)),
+        out_specs=P(PP_AXIS),
         axis_names={PP_AXIS},
         check_vma=False)
+
+    def run(params, x):
+        # strided re-layout so device s's contiguous block holds
+        # micro-batches {j*S+s}; inverse applied to the outputs
+        tail = x.shape[1:]
+        if M_pad != M:
+            pad = jnp.zeros((M_pad - M,) + tail, x.dtype)
+            x = jnp.concatenate([x, pad], axis=0)
+        xs = x.reshape((L, S) + tail).swapaxes(0, 1).reshape(
+            (M_pad,) + tail)
+        y = sm(params, xs)
+        y = y.reshape((S, L) + tail).swapaxes(0, 1).reshape(
+            (M_pad,) + tail)
+        return y[:M]
+
+    return run
 
 
 class PipelineParallel:

@@ -296,6 +296,22 @@ class _ExcInfo:
         self.tb = traceback.format_exc()
 
 
+def _reject_tensors(obj, where):
+    """Recursive: device arrays must never be touched inside a forked
+    worker (forking an initialised XLA runtime is unsafe)."""
+    if isinstance(obj, Tensor):
+        raise RuntimeError(
+            f"{where} produced a paddle Tensor inside a loader worker; "
+            "return numpy when num_workers > 0 — touching device arrays "
+            "in a forked child of an initialised XLA runtime is unsafe")
+    if isinstance(obj, (list, tuple)):
+        for v in obj:
+            _reject_tensors(v, where)
+    elif isinstance(obj, dict):
+        for v in obj.values():
+            _reject_tensors(v, where)
+
+
 def _worker_loop(dataset, index_queue, result_queue, collate_fn, init_fn,
                  worker_id, num_workers, base_seed):
     """ref fluid/dataloader/worker.py:_worker_loop — pull index lists,
@@ -314,15 +330,9 @@ def _worker_loop(dataset, index_queue, result_queue, collate_fn, init_fn,
         try:
             samples = [dataset[i] for i in idxs]
             for s in samples:
-                items = s if isinstance(s, (list, tuple)) else (s,)
-                if any(isinstance(v, Tensor) for v in items):
-                    raise RuntimeError(
-                        "dataset __getitem__ returned a paddle Tensor "
-                        "inside a loader worker; return numpy when "
-                        "num_workers > 0 — touching device arrays in a "
-                        "forked child of an initialised XLA runtime is "
-                        "unsafe")
+                _reject_tensors(s, "dataset __getitem__")
             data = collate_fn(samples)
+            _reject_tensors(data, "collate_fn")
             result_queue.put((batch_id, ("ok", data)))
         except Exception as e:  # noqa: BLE001 — forwarded to parent
             result_queue.put((batch_id, ("err", _ExcInfo(e))))

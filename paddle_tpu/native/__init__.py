@@ -40,8 +40,22 @@ def _cache_dir():
 
 
 def _build():
+    import platform
+
+    h = hashlib.sha256()
     with open(_SRC, "rb") as f:
-        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+        h.update(f.read())
+    # -march=native binaries are host-specific: key the cache on the
+    # machine/compiler too so a shared cache dir never serves a binary
+    # with illegal instructions to a different CPU generation
+    h.update(platform.machine().encode())
+    h.update(platform.processor().encode())
+    try:
+        h.update(subprocess.run(["g++", "--version"], capture_output=True,
+                                text=True).stdout.encode())
+    except OSError:
+        pass
+    digest = h.hexdigest()[:16]
     so = os.path.join(_cache_dir(), f"libptfeed-{digest}.so")
     if not os.path.exists(so):
         tmp = so + f".tmp{os.getpid()}"
@@ -93,6 +107,21 @@ _GATHER = {
 }
 
 
+def _check_indices(idx, n):
+    """Numpy fancy-index semantics before the C++ kernel: wrap negatives,
+    raise IndexError out of range (instead of reading OOB memory)."""
+    if idx.size == 0:
+        return idx
+    lo, hi = int(idx.min()), int(idx.max())
+    if lo < -n or hi >= n:
+        bad = lo if lo < -n else hi
+        raise IndexError(
+            f"index {bad} is out of bounds for axis 0 with size {n}")
+    if lo < 0:
+        idx = np.where(idx < 0, idx + n, idx)
+    return np.ascontiguousarray(idx)
+
+
 def _nthreads(default=None):
     if default is not None:
         return default
@@ -107,6 +136,7 @@ def gather_rows(src: np.ndarray, indices, nthreads=None) -> np.ndarray:
     idx = np.ascontiguousarray(np.asarray(indices, dtype=np.int64))
     if lib is None or src.dtype not in _GATHER or src.ndim < 1:
         return src[idx]
+    idx = _check_indices(idx, src.shape[0])
     name, ctype = _GATHER[src.dtype]
     row = int(np.prod(src.shape[1:], dtype=np.int64)) if src.ndim > 1 else 1
     out = np.empty((idx.shape[0],) + src.shape[1:], dtype=src.dtype)
@@ -128,6 +158,7 @@ def gather_images_u8_chw(src: np.ndarray, indices, scale=1.0 / 255.0,
         batch = src[idx].astype(np.float32) * scale + shift
         return np.transpose(batch, (0, 3, 1, 2))
     src = np.ascontiguousarray(src)
+    idx = _check_indices(idx, src.shape[0])
     n = idx.shape[0]
     _, h, w, c = src.shape
     out = np.empty((n, c, h, w), dtype=np.float32)
