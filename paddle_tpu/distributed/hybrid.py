@@ -131,12 +131,24 @@ class HybridParallelEngine:
             spec = list(pspec) if pspec is not None else \
                 [None] * arr.ndim
             spec += [None] * (arr.ndim - len(spec))
+            placed = False
             for i, s in enumerate(spec):
                 if s is None and arr.shape[i] % \
                         self.mesh.shape[SHARDING_AXIS] == 0 and \
                         arr.shape[i] > 1:
                     spec[i] = SHARDING_AXIS
+                    placed = True
                     break
+            if not placed and all(s is None for s in spec):
+                # only a truly replicated state warrants the warning —
+                # pp/mp-sharded leaves just have no free dim left
+                import warnings
+
+                warnings.warn(
+                    f"ZeRO: optimizer state of shape {arr.shape} has no "
+                    f"dim divisible by sharding degree "
+                    f"{self.mesh.shape[SHARDING_AXIS]}; replicating",
+                    stacklevel=3)
             return P(*spec)
         if pspec is not None:
             spec = list(pspec) + [None] * (arr.ndim - len(pspec))
@@ -218,11 +230,30 @@ class HybridParallelEngine:
                 loss = head_fn(self.model, values, x, labels)
                 return loss.astype(jnp.float32)
 
+        # ZeRO-2: gradients constrained to the moment shardings — GSPMD
+        # lowers the grad reductions into reduce-scatter over 'sharding'
+        grad_constraint = None
+        if self.zero_stage >= 2 and mesh.shape.get(SHARDING_AXIS, 1) > 1:
+            specs_all = param_specs(self.model)
+
+            def grad_constraint(gb, gr):
+                gb = {k: jax.lax.with_sharding_constraint(
+                    g, NamedSharding(mesh, self._opt_leaf_spec(
+                        tuple(self._block_leaf_spec(k, g)), g, True)))
+                    for k, g in gb.items()}
+                gr = {k: jax.lax.with_sharding_constraint(
+                    g, NamedSharding(mesh, self._opt_leaf_spec(
+                        specs_all.get(k), g, False)))
+                    for k, g in gr.items()}
+                return gb, gr
+
         def step_fn(block_params, rest_params, buffers, opt_state, batch,
                     lr, key):
             loss, (gb, gr) = jax.value_and_grad(
                 loss_of, argnums=(0, 1))(block_params, rest_params,
                                          buffers, batch, key)
+            if grad_constraint is not None:
+                gb, gr = grad_constraint(gb, gr)
             gb = opt.decay_gradients_tree(block_params, gb, block_metas)
             gr = opt.decay_gradients_tree(rest_params, gr, rest_metas)
             gc = getattr(opt, "_grad_clip", None)

@@ -161,6 +161,13 @@ def build_shardings(layer, optimizer, mesh, *, dp_axis="dp",
             if arr.shape[0] % axis_size == 0 and arr.shape[0] >= axis_size:
                 return NamedSharding(
                     mesh, P(sharding_axis, *([None] * (arr.ndim - 1))))
+            import warnings
+
+            warnings.warn(
+                f"ZeRO: optimizer state for '{name}' (shape {arr.shape}) "
+                f"is not divisible by sharding degree {axis_size} on dim "
+                "0; falling back to replication for this parameter",
+                stacklevel=3)
         return NamedSharding(mesh, P())
 
     return param_sharding, opt_leaf_sharding
@@ -198,9 +205,28 @@ def make_train_step(layer, loss_fn, optimizer, *, grad_clip=None,
             new_buffers = {k: post[k] for k in buffers}
             return loss_v.astype(jnp.float32), new_buffers
 
+    # single build of the sharding rules, shared by the ZeRO-2 gradient
+    # constraint and the jit in/out shardings below
+    param_sh = opt_sh = None
+    if mesh is not None:
+        param_sh, opt_sh = build_shardings(
+            layer, optimizer, mesh, zero_stage=zero_stage,
+            sharding_axis=sharding_axis)
+
+    # ZeRO-2: constrain gradients to the moment sharding so GSPMD lowers
+    # the dp grad sum into reduce-scatter feeding sharded updates
+    # (ref fleet/meta_optimizers/sharding_optimizer.py grad sharding)
+    grad_constraint = None
+    if zero_stage >= 2 and mesh is not None and sharding_axis is not None:
+        def grad_constraint(grads):
+            return {k: jax.lax.with_sharding_constraint(
+                g, opt_sh(k, g)) for k, g in grads.items()}
+
     def step_fn(params, buffers, opt_state, batch, lr, key):
         (loss, new_buffers), grads = jax.value_and_grad(
             loss_of, has_aux=True)(params, buffers, batch, key)
+        if grad_constraint is not None:
+            grads = grad_constraint(grads)
         metas = optimizer.param_metas_for(params, _sd)
         # eager _preprocess order: coupled decay first, then clip
         grads = optimizer.decay_gradients_tree(params, grads, metas)
@@ -213,9 +239,6 @@ def make_train_step(layer, loss_fn, optimizer, *, grad_clip=None,
     in_shardings = None
     out_shardings = None
     if mesh is not None:
-        param_sh, opt_sh = build_shardings(
-            layer, optimizer, mesh, zero_stage=zero_stage,
-            sharding_axis=sharding_axis)
         params0 = param_values(layer)
         p_sh = {k: param_sh(k, v) for k, v in params0.items()}
         buf_sh = {k: NamedSharding(mesh, P())

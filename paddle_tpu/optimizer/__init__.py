@@ -23,7 +23,7 @@ from .lr import LRScheduler
 
 __all__ = [
     "Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adamax", "Adagrad",
-    "Adadelta", "RMSProp", "Lamb", "lr",
+    "Adadelta", "RMSProp", "Lamb", "LarsMomentum", "Lars", "lr",
 ]
 
 
@@ -98,17 +98,22 @@ class Optimizer:
             params_grads.append((p, Tensor(p._grad)))
         params_grads = self._preprocess(params_grads)
         lr = self.get_lr()
-        hyper = self._hyper()
         for p, g in params_grads:
             state = self._state_for(p)
             plr = lr * p.optimize_attr.get("learning_rate", 1.0)
             new_p, new_state = self._run_rule(
-                p._value, g._value, state, plr, hyper)
+                p._value, g._value, state, plr, self._hyper_for(p))
             p._value = new_p
             self._accumulators[id(p)] = new_state
 
+    def _hyper_for(self, p):
+        """Per-parameter hyperparameters (overridden by optimizers with
+        name-based exclusions, e.g. LARS weight-decay skip lists)."""
+        return self._hyper()
+
     def _run_rule(self, pv, gv, state, lr, hyper):
-        key = (pv.shape, str(pv.dtype))
+        key = (pv.shape, str(pv.dtype),
+               tuple(sorted((k, v) for k, v in hyper.items())))
         fn = self._jit_rules.get(key)
         if fn is None:
             fn = jax.jit(lambda p, g, s, lr_: self._rule(
@@ -261,6 +266,8 @@ class Optimizer:
                 if "decoupled_coeff" in m:
                     h = dict(hyper)
                     h["coeff"] = m["decoupled_coeff"]
+                if "hyper_overrides" in m:
+                    h = {**h, **m["hyper_overrides"]}
             np_, ns_ = self._rule(p, g, s, leaf_lr, **h)
             new_p.append(np_)
             new_s.append(ns_)
@@ -514,6 +521,70 @@ class RMSProp(Optimizer):
         mom = momentum * state["momentum"] + lr * g / denom
         return param - mom, {"mean_square": ms, "mean_grad": mg,
                              "momentum": mom}
+
+
+class LarsMomentum(Optimizer):
+    """Layer-wise adaptive rate scaling with momentum.
+
+    ref: paddle/fluid/operators/optimizers/lars_momentum_op.cc and
+    fleet/meta_optimizers/lars_optimizer.py —
+      local_lr = lr * lars_coeff * ||p|| / (||g|| + decay * ||p|| + eps)
+      v' = mu * v + local_lr * (g + decay * p);  p' = p - v'
+    """
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, lars_coeff=0.001,
+                 lars_weight_decay=0.0005, parameters=None, grad_clip=None,
+                 epsilon=0.0, exclude_from_weight_decay=None, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip)
+        self._momentum = momentum
+        self._lars_coeff = lars_coeff
+        self._lars_decay = lars_weight_decay
+        self._epsilon = epsilon
+        self._exclude = tuple(exclude_from_weight_decay or ())
+
+    def _init_state(self, value):
+        return {"velocity": jnp.zeros_like(value)}
+
+    def _hyper(self):
+        return {"momentum": self._momentum, "coeff": self._lars_coeff,
+                "decay": self._lars_decay, "epsilon": self._epsilon}
+
+    def _rule(self, param, grad, state, lr, *, momentum, coeff, decay,
+              epsilon):
+        g = grad.astype(jnp.float32)
+        p32 = param.astype(jnp.float32)
+        p_norm = jnp.sqrt(jnp.sum(p32 * p32))
+        g_norm = jnp.sqrt(jnp.sum(g * g))
+        # reference kernel form: local_lr = lr*coeff*||p||/(||g|| +
+        # decay*||p|| + eps); an all-zero denominator yields 0 (not NaN)
+        denom = g_norm + decay * p_norm + epsilon
+        local_lr = jnp.where(
+            denom > 0, lr * coeff * p_norm / jnp.maximum(denom, 1e-30),
+            0.0)
+        v = momentum * state["velocity"] + local_lr * (g + decay * p32)
+        new_p = p32 - v
+        return new_p.astype(param.dtype), {"velocity": v}
+
+    def _excluded(self, name):
+        return bool(name) and any(sub in name for sub in self._exclude)
+
+    def _hyper_for(self, p):
+        h = self._hyper()
+        if self._excluded(getattr(p, "name", None)):
+            h = {**h, "decay": 0.0}
+        return h
+
+    def param_metas(self, named_params):
+        metas = super().param_metas(named_params)
+        for k in list(metas):
+            if self._excluded(k):
+                meta = dict(metas[k] or {})
+                meta["hyper_overrides"] = {"decay": 0.0}
+                metas[k] = meta
+        return metas
+
+
+Lars = LarsMomentum
 
 
 class Lamb(Optimizer):
