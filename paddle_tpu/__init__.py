@@ -107,6 +107,8 @@ from . import metric  # noqa: F401,E402
 from . import vision  # noqa: F401,E402
 from . import distribution  # noqa: F401,E402
 from . import profiler  # noqa: F401,E402
+from . import autograd  # noqa: F401,E402
+from . import static  # noqa: F401,E402
 from .framework.io import load, save  # noqa: F401,E402
 from .hapi.model import Model  # noqa: F401,E402
 from .nn.layer.layers import Layer  # noqa: F401,E402
@@ -133,3 +135,9 @@ def backward(tensors, grad_tensors=None, retain_graph=False):
     from .core.autograd import backward as _b
 
     return _b(tensors, grad_tensors, retain_graph)
+
+
+# importing the clip/device submodules above rebound the package
+# attributes to the modules; the paddle API names are the functions
+from .tensor.math import clip as clip  # noqa: F401,E402
+

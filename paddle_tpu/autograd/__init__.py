@@ -1,0 +1,172 @@
+"""paddle.autograd — user-facing autograd utilities + PyLayer.
+
+Ref parity: python/paddle/autograd/ (PyLayer at
+python/paddle/autograd/py_layer.py, C++ side
+paddle/fluid/imperative/py_layer_fwd.h). A PyLayer is a user-defined
+differentiable function: `forward` runs under no-grad and its taped
+boundary is a single Node whose vjp calls the user's `backward`.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core import config as _config
+from ..core.autograd import Node, backward, grad  # noqa: F401
+from ..core.tensor import Tensor
+
+__all__ = ["PyLayer", "PyLayerContext", "backward", "grad"]
+
+
+class PyLayerContext:
+    """Passed as `ctx` to forward/backward
+    (ref py_layer.py PyLayerContext)."""
+
+    def __init__(self):
+        self._saved = ()
+        self._materialize_grads = True
+
+    def save_for_backward(self, *tensors):
+        self._saved = tuple(tensors)
+
+    def saved_tensor(self):
+        return self._saved
+
+    saved_tensors = property(lambda self: self._saved)
+
+
+def _make_replay(cls, args, kwargs, tensor_args):
+    """Pure-jax re-execution of a PyLayer for create_graph (double grad):
+    a jax.custom_vjp whose forward re-runs cls.forward and whose backward
+    calls the user's cls.backward — so higher-order grads respect the
+    custom rule."""
+    import jax
+
+    tensor_slots = [i for i, t in enumerate(tensor_args) if t is not None]
+
+    def _run(xs):
+        ctx = PyLayerContext()
+        full = list(args)
+        for slot, x in zip(tensor_slots, xs):
+            full[slot] = Tensor(x, stop_gradient=True)
+        with _config.no_grad():
+            out = cls.forward(ctx, *full, **kwargs)
+        outs = (out,) if not isinstance(out, (tuple, list)) else tuple(out)
+        out_arrays = tuple(o._value for o in outs)
+        saved = tuple(
+            t._value if isinstance(t, Tensor) else jnp.asarray(t)
+            for t in ctx._saved)
+        return out_arrays, saved
+
+    # static grad shapes/dtypes (residuals may only carry jax arrays)
+    shapes = [tensor_args[i]._value.shape for i in tensor_slots]
+    dtypes = [tensor_args[i]._value.dtype for i in tensor_slots]
+
+    def primal(*xs):
+        return _run(xs)[0]
+
+    def fwd(*xs):
+        out_arrays, saved = _run(xs)
+        return out_arrays, saved
+
+    def bwd(saved, cots):
+        ctx = PyLayerContext()
+        ctx._saved = tuple(Tensor(a, stop_gradient=True) for a in saved)
+        gin = cls.backward(
+            ctx, *[Tensor(c, stop_gradient=True) for c in cots])
+        gin = (gin,) if isinstance(gin, Tensor) or gin is None \
+            else tuple(gin)
+        if len(gin) != len(shapes):
+            raise RuntimeError(
+                f"{cls.__name__}.backward returned {len(gin)} grads "
+                f"for {len(shapes)} Tensor inputs")
+        out = []
+        for g, shape, dtype in zip(gin, shapes, dtypes):
+            if g is None:
+                out.append(jnp.zeros(shape, dtype))
+            else:
+                out.append(g._value if isinstance(g, Tensor)
+                           else jnp.asarray(g))
+        return tuple(out)
+
+    f = jax.custom_vjp(primal)
+    f.defvjp(fwd, bwd)
+    return f
+
+
+class PyLayer:
+    """Subclass with static `forward(ctx, *args)` and
+    `backward(ctx, *grads)`; call via `MyFn.apply(*args)`.
+
+    forward runs with gradients disabled (its internals are opaque to the
+    tape); backward receives one grad per forward output and must return
+    one grad (Tensor or None) per Tensor argument of forward, in order.
+    """
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        with _config.no_grad():
+            out = cls.forward(ctx, *args, **kwargs)
+        single = not isinstance(out, (tuple, list))
+        outs = (out,) if single else tuple(out)
+        for o in outs:
+            if not isinstance(o, Tensor):
+                raise TypeError(
+                    "PyLayer.forward must return Tensor(s), got "
+                    f"{type(o).__name__}")
+
+        tensor_args = tuple(a if isinstance(a, Tensor) else None
+                            for a in args)
+        needs_grad = _config.is_grad_enabled() and any(
+            t is not None and not t.stop_gradient for t in tensor_args)
+        if not needs_grad:
+            return out
+
+        out_meta = [(o._value.shape, o._value.dtype) for o in outs]
+        n_inputs = len(tensor_args)
+
+        def vjp_fn(cots):
+            cots = cots if isinstance(cots, tuple) else (cots,)
+            gin = cls.backward(
+                ctx, *[Tensor(c, stop_gradient=True) for c in cots])
+            gin = (gin,) if isinstance(gin, Tensor) or gin is None \
+                else tuple(gin)
+            n_tensor_args = sum(1 for t in tensor_args if t is not None)
+            if len(gin) != n_tensor_args:
+                raise RuntimeError(
+                    f"{cls.__name__}.backward returned {len(gin)} grads "
+                    f"for {n_tensor_args} Tensor inputs")
+            # align with node.inputs: one slot per forward arg
+            it = iter(gin)
+            full = []
+            for t in tensor_args:
+                if t is None:
+                    full.append(jnp.zeros(()))  # ignored (input is None)
+                else:
+                    g = next(it)
+                    full.append(
+                        jnp.zeros(t._value.shape, t._value.dtype)
+                        if g is None else
+                        (g._value if isinstance(g, Tensor)
+                         else jnp.asarray(g)))
+            return tuple(full)
+
+        replay_fn = _make_replay(cls, args, kwargs, tensor_args)
+        node = Node(vjp_fn, tensor_args, out_meta,
+                    f"pylayer:{cls.__name__}", attrs=None,
+                    replay_fn=replay_fn)
+        wrapped = []
+        for i, o in enumerate(outs):
+            t = Tensor(o._value, stop_gradient=False)
+            t._tape = (node, i)
+            wrapped.append(t)
+        return wrapped[0] if single else tuple(wrapped)

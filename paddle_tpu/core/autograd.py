@@ -20,9 +20,12 @@ import jax.numpy as jnp
 class Node:
     """One taped op: holds the vjp closure and links to input tensors."""
 
-    __slots__ = ("vjp_fn", "inputs", "out_meta", "op_name", "__weakref__")
+    __slots__ = ("vjp_fn", "inputs", "out_meta", "op_name", "attrs",
+                 "const_primals", "replay_fn", "primal_dtypes",
+                 "__weakref__")
 
-    def __init__(self, vjp_fn, inputs, out_meta, op_name):
+    def __init__(self, vjp_fn, inputs, out_meta, op_name, attrs=None,
+                 const_primals=None, replay_fn=None, primal_dtypes=None):
         self.vjp_fn = vjp_fn
         # tuple aligned with the primal arrays passed to jax.vjp;
         # entries are Tensor or None (non-tensor primals).
@@ -30,6 +33,16 @@ class Node:
         # list of (shape, dtype) per differentiable output, for zero cotangents
         self.out_meta = out_meta
         self.op_name = op_name
+        # attrs + values of non-Tensor primals: enough to re-execute the
+        # op's pure function for create_graph (double-grad) replay
+        self.attrs = attrs
+        self.const_primals = const_primals
+        # alternative replay path for non-registry nodes (PyLayer): a pure
+        # function over this node's Tensor-slot arrays -> outputs tuple
+        self.replay_fn = replay_fn
+        # dtypes the vjp actually saw (post-AMP-rewrite); replay casts to
+        # these so double grad matches first-order numerics under autocast
+        self.primal_dtypes = primal_dtypes
 
 
 def _zero_cotangent(meta):
@@ -44,8 +57,12 @@ def _is_float0(g):
     return isinstance(g, np.ndarray) and g.dtype == jax.dtypes.float0
 
 
-def _topo_order(root_nodes):
-    """Post-order DFS over the node graph (iterative; graphs can be deep)."""
+def _topo_order(root_nodes, cut_ids=None):
+    """Post-order DFS over the node graph (iterative; graphs can be deep).
+
+    cut_ids: tensor ids acting as graph cuts — the walk does not descend
+    past them (used by create_graph replay to skip everything above the
+    requested inputs)."""
     order, seen = [], set()
     stack = [(n, False) for n in root_nodes]
     while stack:
@@ -58,10 +75,13 @@ def _topo_order(root_nodes):
         seen.add(id(node))
         stack.append((node, True))
         for t in node.inputs:
-            if t is not None and t._tape is not None and not t.stop_gradient:
-                parent = t._tape[0]
-                if id(parent) not in seen:
-                    stack.append((parent, False))
+            if t is None or t.stop_gradient or t._tape is None:
+                continue
+            if cut_ids is not None and id(t) in cut_ids:
+                continue
+            parent = t._tape[0]
+            if id(parent) not in seen:
+                stack.append((parent, False))
     return order
 
 
@@ -176,14 +196,14 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
          create_graph=False, allow_unused=False, no_grad_vars=None):
     """paddle.grad — partial backward returning grads for `inputs` only.
 
-    Ref parity: paddle/fluid/imperative/partial_grad_engine.cc. Double grad
-    (create_graph=True) is not supported yet.
+    Ref parity: paddle/fluid/imperative/partial_grad_engine.cc.
+    create_graph=True (double grad) re-executes the taped subgraph as a
+    pure jax function and differentiates it with jax.vjp, so the returned
+    grads are themselves taped (w.r.t. `inputs` AND every other leaf the
+    subgraph touches, e.g. parameters — gradient-penalty training works).
     """
     from .tensor import Tensor
 
-    if create_graph:
-        raise NotImplementedError(
-            "create_graph=True (double grad) is not implemented yet")
     if isinstance(outputs, Tensor):
         outputs = [outputs]
     if isinstance(inputs, Tensor):
@@ -192,6 +212,10 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
         grad_outputs = [None] * len(outputs)
     elif isinstance(grad_outputs, Tensor):
         grad_outputs = [grad_outputs]
+
+    if create_graph:
+        return _grad_with_graph(outputs, inputs, grad_outputs,
+                                allow_unused)
 
     sinks = {id(t): t for t in inputs}
     keep = bool(retain_graph) if retain_graph is not None else create_graph
@@ -207,4 +231,169 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
             results.append(None)
         else:
             results.append(Tensor(captured[id(t)], stop_gradient=True))
+    return results
+
+
+# ---------------------------------------------------------------------------
+# create_graph: replay the taped subgraph as a pure function + jax.vjp
+# ---------------------------------------------------------------------------
+
+
+def _replay_forward(order, var_tensors, outputs):
+    """Pure function xs -> output arrays re-executing `order` (deps-first)
+    with the tensors in `var_tensors` replaced by the traced xs (cut
+    semantics for non-leaf vars: the subgraph above them is bypassed)."""
+    from .op_registry import lookup
+
+    def forward(*xs):
+        env = {id(t): x for t, x in zip(var_tensors, xs)}
+        produced = {}
+
+        def val_of(t, node, i):
+            if t is not None and id(t) in env:
+                v = env[id(t)]
+            elif t is not None and t._tape is not None and \
+                    id(t._tape[0]) in produced:
+                pn, pi = t._tape
+                v = produced[id(pn)][pi]
+            elif t is not None:
+                v = t._value
+            else:
+                return node.const_primals[i]
+            dts = node.primal_dtypes
+            if dts is not None and dts[i] is not None \
+                    and hasattr(v, "dtype") and v.dtype != dts[i] \
+                    and jnp.issubdtype(v.dtype, jnp.floating) \
+                    and jnp.issubdtype(dts[i], jnp.floating):
+                v = v.astype(dts[i])
+            return v
+
+        for node in order:
+            if node.replay_fn is not None:
+                args = [val_of(t, node, i)
+                        for i, t in enumerate(node.inputs)
+                        if t is not None]
+                out = node.replay_fn(*args)
+            elif node.attrs is not None:
+                opdef = lookup(node.op_name)
+                args = [val_of(t, node, i)
+                        for i, t in enumerate(node.inputs)]
+                out = opdef.fn(*args, **node.attrs)
+                if opdef.has_aux:
+                    out = out[0]
+            else:
+                raise NotImplementedError(
+                    f"create_graph through op '{node.op_name}' is not "
+                    "supported (no replay record)")
+            produced[id(node)] = out if isinstance(out, tuple) else (out,)
+
+        outs = []
+        for t in outputs:
+            if id(t) in env:
+                outs.append(env[id(t)])
+            elif t._tape is not None and id(t._tape[0]) in produced:
+                outs.append(produced[id(t._tape[0])][t._tape[1]])
+            else:
+                outs.append(t._value)
+        return tuple(outs)
+
+    return forward
+
+
+def _grad_with_graph(outputs, inputs, grad_outputs, allow_unused):
+    from .tensor import Tensor
+
+    # first-order semantics carry over: a stop_gradient input gets no grad
+    for t in inputs:
+        if t.stop_gradient:
+            if allow_unused:
+                continue
+            raise RuntimeError(
+                "grad() requested for a stop_gradient tensor; pass "
+                "allow_unused=True to receive None for it")
+
+    roots = [t._tape[0] for t in outputs if t._tape is not None]
+    # cut at the requested inputs: nodes strictly above them need no
+    # replay (their outputs are bypassed by the env cut anyway)
+    order = _topo_order(
+        roots, cut_ids={id(t) for t in inputs if not t.stop_gradient})
+
+    for node in order:
+        for t in node.inputs:
+            if t is not None and t._hooks:
+                raise NotImplementedError(
+                    "create_graph=True does not support tensors with "
+                    "registered hooks in the subgraph (the replay would "
+                    "silently skip them)")
+
+    # variables = requested (differentiable) inputs first, then every
+    # other differentiable leaf in the subgraph (so second-order backward
+    # reaches parameters)
+    active = [t for t in inputs if not t.stop_gradient]
+    input_ids = {id(t) for t in active}
+    extra_leaves = []
+    seen = set()
+    for node in order:
+        for t in node.inputs:
+            if t is None or t.stop_gradient or id(t) in input_ids \
+                    or id(t) in seen:
+                continue
+            if t._tape is None:
+                seen.add(id(t))
+                extra_leaves.append(t)
+    var_tensors = active + extra_leaves
+
+    seeds = []
+    for t, g in zip(outputs, grad_outputs):
+        if g is None:
+            if t.size != 1:
+                raise RuntimeError(
+                    "grad() on a non-scalar output requires grad_outputs")
+            seeds.append(jnp.ones_like(t._value))
+        else:
+            seeds.append(g._value if isinstance(g, Tensor)
+                         else jnp.asarray(g))
+    seeds = tuple(seeds)
+
+    forward = _replay_forward(order, var_tensors, outputs)
+
+    def grads_of(*xs):
+        _, vjp = jax.vjp(forward, *xs)
+        gs = vjp(seeds)
+        # single-output shape must match how _run_backward feeds
+        # cotangents back (bare array when out_meta has one entry)
+        return gs if len(gs) > 1 else gs[0]
+
+    primals = [t._value for t in var_tensors]
+    gvals, vjp2 = jax.vjp(grads_of, *primals)
+    if not isinstance(gvals, tuple):
+        gvals = (gvals,)
+    out_meta = [(g.shape, g.dtype) for g in gvals]
+    node = Node(vjp2, tuple(var_tensors), out_meta, "partial_grad",
+                attrs=None)
+
+    # usage check: an unused input has an identically-zero grad function;
+    # cheap structural check — the input is used iff some node consumes it
+    used = set()
+    for n in order:
+        for t in n.inputs:
+            if t is not None:
+                used.add(id(t))
+    for t in outputs:
+        used.add(id(t))
+
+    active_index = {id(t): i for i, t in enumerate(active)}
+    results = []
+    for t in inputs:
+        if t.stop_gradient or id(t) not in used:
+            if not t.stop_gradient and not allow_unused:
+                raise RuntimeError(
+                    "one of the inputs was not used in the graph; pass "
+                    "allow_unused=True to return None for it")
+            results.append(None)
+            continue
+        i = active_index[id(t)]
+        g = Tensor(gvals[i], stop_gradient=False)
+        g._tape = (node, i)
+        results.append(g)
     return results

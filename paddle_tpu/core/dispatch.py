@@ -122,7 +122,11 @@ def _apply_impl(op_name, inputs, attrs):
 
     outs_flat = out if isinstance(out, tuple) else (out,)
     out_meta = [(o.shape, o.dtype) for o in outs_flat]
-    node = Node(vjp_fn, tensor_inputs, out_meta, op_name)
+    const_primals = {i: a for i, (t, a) in
+                     enumerate(zip(tensor_inputs, arrs)) if t is None}
+    primal_dtypes = tuple(getattr(a, "dtype", None) for a in arrs)
+    node = Node(vjp_fn, tensor_inputs, out_meta, op_name, attrs=attrs,
+                const_primals=const_primals, primal_dtypes=primal_dtypes)
     return _wrap_outputs(opdef, out, aux, node=node)
 
 

@@ -312,6 +312,24 @@ class Tensor:
     def __invert__(self):
         return apply("logical_not", self)
 
+    def __and__(self, o):
+        return apply("bitwise_and", self, o)
+
+    def __rand__(self, o):
+        return apply("bitwise_and", o, self)
+
+    def __or__(self, o):
+        return apply("bitwise_or", self, o)
+
+    def __ror__(self, o):
+        return apply("bitwise_or", o, self)
+
+    def __xor__(self, o):
+        return apply("bitwise_xor", self, o)
+
+    def __rxor__(self, o):
+        return apply("bitwise_xor", o, self)
+
     # in-place arithmetic rebinds (autograd-safe only outside taped regions)
     def __iadd__(self, o):
         return self.__add__(o)

@@ -104,6 +104,7 @@ class HybridParallelEngine:
             k: v for k, v in rest.items() if k in specs}
         self.rest_buffers = {
             k: v for k, v in rest.items() if k not in specs}
+        self._zero_warned = set()
         self.opt_state = {
             "blocks": {k: self.optimizer._init_state(v)
                        for k, v in self.block_params.items()},
@@ -121,7 +122,7 @@ class HybridParallelEngine:
             inner = P(*([None] * (arr.ndim - 2)))
         return P(PP_AXIS, None, *tuple(inner))
 
-    def _opt_leaf_spec(self, pspec, arr, stacked):
+    def _opt_leaf_spec(self, pspec, arr, name=""):
         # moments follow the param sharding; scalars replicate
         if arr.ndim == 0:
             return P()
@@ -139,16 +140,20 @@ class HybridParallelEngine:
                     spec[i] = SHARDING_AXIS
                     placed = True
                     break
-            if not placed and all(s is None for s in spec):
+            if not placed and all(s is None for s in spec) \
+                    and arr.size >= self.mesh.shape[SHARDING_AXIS] \
+                    and name not in self._zero_warned:
                 # only a truly replicated state warrants the warning —
-                # pp/mp-sharded leaves just have no free dim left
+                # pp/mp-sharded leaves just have no free dim left; once
+                # per param, across state leaves and grad retraces
+                self._zero_warned.add(name)
                 import warnings
 
                 warnings.warn(
-                    f"ZeRO: optimizer state of shape {arr.shape} has no "
-                    f"dim divisible by sharding degree "
-                    f"{self.mesh.shape[SHARDING_AXIS]}; replicating",
-                    stacklevel=3)
+                    f"ZeRO: state/gradient for '{name}' (shape "
+                    f"{arr.shape}) has no dim divisible by sharding "
+                    f"degree {self.mesh.shape[SHARDING_AXIS]}; "
+                    "replicating", stacklevel=3)
             return P(*spec)
         if pspec is not None:
             spec = list(pspec) + [None] * (arr.ndim - len(pspec))
@@ -172,12 +177,12 @@ class HybridParallelEngine:
             k: jax.tree.map(
                 lambda a, kk=k: ns(self._opt_leaf_spec(
                     tuple(self._block_leaf_spec(kk,
-                          self.block_params[kk])), a, True)), st)
+                          self.block_params[kk])), a, name=kk)), st)
             for k, st in self.opt_state["blocks"].items()}
         opt_rest_sh = {
             k: jax.tree.map(
                 lambda a, kk=k: ns(self._opt_leaf_spec(
-                    specs.get(kk), a, False)), st)
+                    specs.get(kk), a, name=kk)), st)
             for k, st in self.opt_state["rest"].items()}
         data_sh = ns(P(DP_AXIS))  # tokens [B, s]: batch dim over dp
         return dict(blocks=block_sh, rest=rest_sh, buffers=buf_sh,
@@ -239,11 +244,11 @@ class HybridParallelEngine:
             def grad_constraint(gb, gr):
                 gb = {k: jax.lax.with_sharding_constraint(
                     g, NamedSharding(mesh, self._opt_leaf_spec(
-                        tuple(self._block_leaf_spec(k, g)), g, True)))
+                        tuple(self._block_leaf_spec(k, g)), g, name=k)))
                     for k, g in gb.items()}
                 gr = {k: jax.lax.with_sharding_constraint(
                     g, NamedSharding(mesh, self._opt_leaf_spec(
-                        specs_all.get(k), g, False)))
+                        specs_all.get(k), g, name=k)))
                     for k, g in gr.items()}
                 return gb, gr
 

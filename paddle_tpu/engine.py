@@ -151,6 +151,8 @@ def build_shardings(layer, optimizer, mesh, *, dp_axis="dp",
         spec = specs.get(name)
         return NamedSharding(mesh, spec if spec is not None else P())
 
+    warned = set()  # once per param name across state leaves AND grads
+
     def opt_leaf_sharding(name, arr):
         spec = specs.get(name)
         if spec is not None and any(s is not None for s in spec):
@@ -161,13 +163,15 @@ def build_shardings(layer, optimizer, mesh, *, dp_axis="dp",
             if arr.shape[0] % axis_size == 0 and arr.shape[0] >= axis_size:
                 return NamedSharding(
                     mesh, P(sharding_axis, *([None] * (arr.ndim - 1))))
-            import warnings
+            if arr.size >= axis_size and name not in warned:
+                warned.add(name)
+                import warnings
 
-            warnings.warn(
-                f"ZeRO: optimizer state for '{name}' (shape {arr.shape}) "
-                f"is not divisible by sharding degree {axis_size} on dim "
-                "0; falling back to replication for this parameter",
-                stacklevel=3)
+                warnings.warn(
+                    f"ZeRO: state/gradient for '{name}' (shape "
+                    f"{arr.shape}) is not divisible by sharding degree "
+                    f"{axis_size} on dim 0; replicating this parameter",
+                    stacklevel=3)
         return NamedSharding(mesh, P())
 
     return param_sharding, opt_leaf_sharding

@@ -30,6 +30,22 @@ class HookRemoveHelper:
         self._hooks.pop(self._hook_id, None)
 
 
+_auto_name_counters: dict = {}
+
+
+def _auto_prefix(layer):
+    """Stable per-instance prefix like 'linear_0' (ref fluid unique_name
+    generator). Cached on the instance itself (no global id map)."""
+    cached = layer.__dict__.get("_auto_prefix_name")
+    if cached is None:
+        cls = type(layer).__name__.lower()
+        n = _auto_name_counters.get(cls, 0)
+        _auto_name_counters[cls] = n + 1
+        cached = f"{cls}_{n}"
+        layer.__dict__["_auto_prefix_name"] = cached
+    return cached
+
+
 class Layer:
     def __init__(self, name_scope=None, dtype="float32"):
         self.training = True
@@ -98,6 +114,11 @@ class Layer:
             for d in (layers, buffers):
                 if d is not None:
                     d.pop(name, None)
+            if value.name is None:
+                # auto name (ref fluid unique_name): '<class>_<n>.<attr>'
+                # — name-based matching (e.g. LARS exclude lists) works
+                # without explicit ParamAttr names
+                value.name = f"{_auto_prefix(self)}.{name}"
             params[name] = value
         elif isinstance(value, Layer):
             if layers is None:

@@ -62,6 +62,14 @@ for _name, _fn in [
         (lambda f: lambda x, y: f(x, y))(_fn))
 
 register_op("logical_not", no_grad=True)(lambda x: jnp.logical_not(x))
+# bitwise family (paddle maps the &,|,^,~ operators here; for bool inputs
+# bitwise == logical)
+register_op("bitwise_and", no_grad=True)(
+    lambda x, y: jnp.bitwise_and(x, y))
+register_op("bitwise_or", no_grad=True)(lambda x, y: jnp.bitwise_or(x, y))
+register_op("bitwise_xor", no_grad=True)(
+    lambda x, y: jnp.bitwise_xor(x, y))
+register_op("bitwise_not", no_grad=True)(lambda x: jnp.bitwise_not(x))
 register_op("isnan", no_grad=True)(lambda x: jnp.isnan(x))
 register_op("isinf", no_grad=True)(lambda x: jnp.isinf(x))
 register_op("isfinite", no_grad=True)(lambda x: jnp.isfinite(x))

@@ -168,17 +168,32 @@ class Optimizer:
 
     def set_state_dict(self, state_dict):
         self._global_step = int(state_dict.get("global_step", 0))
+        missing = []
         for i, p in enumerate(self._parameter_list or []):
             name = p.name or f"param_{i}"
             st = self._init_state(p._value)
             found = False
             for k in list(st):
                 kk = f"{name}.{k}"
+                if kk not in state_dict:
+                    # legacy checkpoints keyed by position before params
+                    # had auto names
+                    kk = f"param_{i}.{k}"
                 if kk in state_dict:
                     st[k] = jnp.asarray(state_dict[kk])
                     found = True
             if found:
                 self._accumulators[id(p)] = st
+            elif st:
+                missing.append(name)
+        if missing:
+            import warnings
+
+            warnings.warn(
+                "optimizer.set_state_dict found no saved state for "
+                f"parameters {missing[:5]}{'...' if len(missing) > 5 else ''}"
+                " — their accumulators stay at fresh initialisation",
+                stacklevel=2)
         if "LR_Scheduler" in state_dict and isinstance(
                 self._learning_rate, LRScheduler):
             self._learning_rate.set_state_dict(state_dict["LR_Scheduler"])
@@ -574,14 +589,14 @@ class LarsMomentum(Optimizer):
             h = {**h, "decay": 0.0}
         return h
 
-    def param_metas(self, named_params):
-        metas = super().param_metas(named_params)
-        for k in list(metas):
-            if self._excluded(k):
-                meta = dict(metas[k] or {})
-                meta["hyper_overrides"] = {"decay": 0.0}
-                metas[k] = meta
-        return metas
+    def _leaf_meta(self, p):
+        # exclusion keyed on p.name in BOTH paths (eager _hyper_for above,
+        # compiled via metas) — state-dict keys are a different namespace
+        meta = super()._leaf_meta(p)
+        if self._excluded(getattr(p, "name", None)):
+            meta = dict(meta or {})
+            meta["hyper_overrides"] = {"decay": 0.0}
+        return meta
 
 
 Lars = LarsMomentum

@@ -1,0 +1,15 @@
+"""paddle.static — static-graph-style surface.
+
+Ref parity: python/paddle/static/__init__.py. On TPU there is no separate
+Program/Executor runtime — `paddle.jit.to_static` capture plays that role
+— but the static namespace keeps API compatibility: control-flow ops
+(`nn.cond`, `nn.while_loop`, ...) lower to XLA control flow, and InputSpec
+re-exports from paddle.jit.
+"""
+
+from __future__ import annotations
+
+from ..jit import InputSpec  # noqa: F401
+from . import nn  # noqa: F401
+
+__all__ = ["InputSpec", "nn"]

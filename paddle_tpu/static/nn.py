@@ -1,0 +1,176 @@
+"""Control-flow ops: cond / while_loop / switch_case / case.
+
+Ref parity: paddle/fluid/operators/controlflow/conditional_block_op.cc,
+while_op.cc and python/paddle/fluid/layers/control_flow.py. TPU-native:
+in eager mode predicates are concrete, so the chosen branch simply runs
+(fully taped — autograd works through it); under jit tracing the ops
+lower to `lax.cond` / `lax.while_loop` / `lax.switch` — compiled XLA
+control flow with no Python unrolling.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["cond", "while_loop", "switch_case", "case"]
+
+
+def _raw(x):
+    return x._value if isinstance(x, Tensor) else x
+
+
+def _is_traced(v):
+    return isinstance(v, jax.core.Tracer)
+
+
+def _unwrap_tree(tree):
+    return jax.tree.map(
+        lambda t: t._value if isinstance(t, Tensor) else jnp.asarray(t),
+        tree, is_leaf=lambda t: isinstance(t, Tensor))
+
+
+def _wrap_tree(tree):
+    return jax.tree.map(Tensor, tree)
+
+
+def cond(pred, true_fn=None, false_fn=None, name=None):
+    """Run true_fn() or false_fn() by `pred`
+    (ref control_flow.py cond / conditional_block_op.cc).
+
+    Eager (concrete pred): executes the chosen branch — differentiable
+    through the tape. Traced: lowers to lax.cond (both branches traced
+    once; output structures must match)."""
+    pv = _raw(pred)
+    if not _is_traced(pv):
+        fn = true_fn if bool(pv) else false_fn
+        return fn() if fn is not None else None
+
+    def t_branch(_):
+        return _unwrap_tree(true_fn())
+
+    def f_branch(_):
+        return _unwrap_tree(false_fn())
+
+    out = jax.lax.cond(jnp.asarray(pv, bool), t_branch, f_branch,
+                       operand=None)
+    return _wrap_tree(out)
+
+
+def while_loop(cond_fn, body_fn, loop_vars, is_test=False, name=None):
+    """Repeat body while cond holds (ref while_op.cc).
+
+    Eager: Python loop over Tensors (taped — backward works, trip count
+    becomes part of the tape). Traced: lax.while_loop (forward-only, like
+    XLA; use lax.scan-style bounded loops for differentiable recurrences).
+    """
+    if not isinstance(loop_vars, (list, tuple)):
+        raise TypeError("loop_vars must be a list/tuple")
+    loop_vars = list(loop_vars)
+
+    probe = cond_fn(*loop_vars)
+    pv = _raw(probe)
+    if not _is_traced(pv) and not any(
+            _is_traced(_raw(v)) for v in loop_vars
+            if isinstance(v, Tensor)):
+        keep_going = bool(pv)
+        while keep_going:
+            out = body_fn(*loop_vars)
+            loop_vars = list(out) if isinstance(out, (list, tuple)) \
+                else [out]
+            keep_going = bool(_raw(cond_fn(*loop_vars)))
+        return loop_vars
+
+    def c(vs):
+        return jnp.asarray(_raw(cond_fn(*_wrap_tree(vs))), bool)
+
+    def b(vs):
+        out = body_fn(*_wrap_tree(vs))
+        out = list(out) if isinstance(out, (list, tuple)) else [out]
+        return _unwrap_tree(out)
+
+    final = jax.lax.while_loop(c, b, _unwrap_tree(loop_vars))
+    return [_wrap_tree(v) for v in final]
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    """Select one of branch_fns by integer index
+    (ref control_flow.py switch_case)."""
+    if isinstance(branch_fns, dict):
+        keys = sorted(branch_fns)
+        fns = [branch_fns[k] for k in keys]
+        index_map = {k: i for i, k in enumerate(keys)}
+    else:
+        pairs = list(branch_fns)
+        if pairs and isinstance(pairs[0], (tuple, list)):
+            keys = [k for k, _ in pairs]
+            fns = [f for _, f in pairs]
+            index_map = {k: i for i, k in enumerate(keys)}
+        else:
+            fns = pairs
+            index_map = None
+
+    iv = _raw(branch_index)
+    if not _is_traced(iv):
+        key = int(iv)
+        if index_map is not None:
+            if key in index_map:
+                return fns[index_map[key]]()
+        elif 0 <= key < len(fns):
+            return fns[key]()
+        if default is None:
+            raise ValueError(f"branch_index {key} out of range and no "
+                             "default branch given")
+        return default()
+
+    all_fns = list(fns) + ([default] if default is not None else [])
+    iv_arr = jnp.asarray(iv)
+    if index_map is not None:
+        # map arbitrary keys to dense positions; unknown -> default slot
+        lut_keys = jnp.asarray(list(index_map.keys()))
+        pos = jnp.argmax(lut_keys == iv_arr)
+        hit = jnp.any(lut_keys == iv_arr)
+        dense = jnp.where(hit, pos, len(fns))
+    else:
+        in_range = (iv_arr >= 0) & (iv_arr < len(fns))
+        # out-of-range goes to the default slot when one exists; without a
+        # default XLA cannot raise, so it clamps to the last branch
+        fallback = len(fns) if default is not None else len(fns) - 1
+        dense = jnp.where(in_range, jnp.clip(iv_arr, 0, len(fns) - 1),
+                          fallback)
+    branches = [lambda _, f=f: _unwrap_tree(f()) for f in all_fns]
+    out = jax.lax.switch(dense, branches, None)
+    return _wrap_tree(out)
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    """First-match conditional chain (ref control_flow.py case)."""
+    pairs = list(pred_fn_pairs)
+    if not pairs:
+        raise ValueError("pred_fn_pairs must not be empty")
+
+    if all(not _is_traced(_raw(p)) for p, _ in pairs):
+        for p, fn in pairs:
+            if bool(_raw(p)):
+                return fn()
+        if default is None:
+            _, last_fn = pairs[-1]
+            return last_fn()
+        return default()
+
+    # traced: nested lax.cond chain
+    def build(i):
+        if i == len(pairs):
+            if default is not None:
+                return lambda: _unwrap_tree(default())
+            return lambda: _unwrap_tree(pairs[-1][1]())
+        p, fn = pairs[i]
+        rest = build(i + 1)
+        return lambda: jax.lax.cond(
+            jnp.asarray(_raw(p), bool),
+            lambda _: _unwrap_tree(fn()),
+            lambda _: rest(), operand=None)
+
+    return _wrap_tree(build(0)())

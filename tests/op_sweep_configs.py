@@ -286,6 +286,14 @@ for name, ref in [("logical_and", np.logical_and),
                   ("logical_or", np.logical_or),
                   ("logical_xor", np.logical_xor)]:
     case(name, [_BA, _BB], ref=ref, grad=None, bf16=False)
+_IA = ints((3, 4), 0, 16, seed=150)
+_IB = ints((3, 4), 0, 16, seed=151)
+for name, ref in [("bitwise_and", np.bitwise_and),
+                  ("bitwise_or", np.bitwise_or),
+                  ("bitwise_xor", np.bitwise_xor)]:
+    case(name, [_IA, _IB], ref=ref, grad=None, bf16=False)
+    case(name, [_BA, _BB], ref=ref, grad=None, bf16=False)
+case("bitwise_not", [_IA], ref=np.bitwise_not, grad=None, bf16=False)
 case("isclose", [_A, _A + 1e-7], ref=np.isclose, grad=None, bf16=False)
 
 # ===========================================================================

@@ -1,0 +1,297 @@
+"""Control-flow ops, PyLayer, and double grad.
+
+Ref parity: operators/controlflow/ (cond/while), autograd/py_layer.py,
+imperative/partial_grad_engine.cc (create_graph).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.autograd import PyLayer
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.static import nn as snn
+
+
+def T(v, sg=True):
+    return Tensor(np.asarray(v, np.float32), stop_gradient=sg)
+
+
+# -- cond -------------------------------------------------------------------
+
+
+def test_cond_eager_branches_and_grad():
+    x = T([2.0], sg=False)
+    out = snn.cond(T(1.0) > T(0.0), lambda: x * 3.0, lambda: x * 5.0)
+    np.testing.assert_allclose(out.numpy(), [6.0])
+    out.backward(T([1.0]))
+    np.testing.assert_allclose(x.grad.numpy(), [3.0])
+
+    y = T([2.0], sg=False)
+    out2 = snn.cond(T(-1.0) > T(0.0), lambda: y * 3.0, lambda: y * 5.0)
+    np.testing.assert_allclose(out2.numpy(), [10.0])
+
+
+def test_cond_traced_lowers_to_lax_cond():
+    def fn(flag, x):
+        t = Tensor(x)
+        out = snn.cond(Tensor(flag) > Tensor(0.0),
+                       lambda: t * 2.0, lambda: t + 100.0)
+        return out._value
+
+    jitted = jax.jit(fn)
+    np.testing.assert_allclose(jitted(1.0, jnp.asarray([3.0])), [6.0])
+    np.testing.assert_allclose(jitted(-1.0, jnp.asarray([3.0])), [103.0])
+
+
+# -- while_loop -------------------------------------------------------------
+
+
+def test_while_loop_eager_with_grad():
+    # double x until its (detached) magnitude exceeds 20; starts at 3 ->
+    # 3 doublings; d out / d x = 8
+    x = T([3.0], sg=False)
+    i = T([0.0])
+
+    def cond_fn(i, v):
+        return float(np.asarray(v.numpy())[0]) < 20.0
+
+    def body_fn(i, v):
+        return i + 1.0, v * 2.0
+
+    i_out, v_out = snn.while_loop(cond_fn, body_fn, [i, x])
+    np.testing.assert_allclose(v_out.numpy(), [24.0])
+    np.testing.assert_allclose(i_out.numpy(), [3.0])
+    v_out.backward(T([1.0]))
+    np.testing.assert_allclose(x.grad.numpy(), [8.0])
+
+
+def test_while_loop_traced_lowers_to_lax():
+    def fn(n, x):
+        vs = snn.while_loop(
+            lambda i, v: (i < n)._value,
+            lambda i, v: (i + 1, v * 2.0),
+            [Tensor(jnp.asarray(0)), Tensor(x)])
+        return vs[1]._value
+
+    out = jax.jit(fn)(4, jnp.asarray([1.5]))
+    np.testing.assert_allclose(out, [24.0])
+
+
+# -- switch_case / case -----------------------------------------------------
+
+
+def test_switch_case_eager():
+    x = T([1.0])
+    out = snn.switch_case(
+        T(1), branch_fns=[lambda: x * 10.0, lambda: x * 20.0,
+                          lambda: x * 30.0])
+    np.testing.assert_allclose(out.numpy(), [20.0])
+    out = snn.switch_case(
+        T(7), branch_fns={3: lambda: x * 1.0, 7: lambda: x * 2.0})
+    np.testing.assert_allclose(out.numpy(), [2.0])
+    out = snn.switch_case(T(99), branch_fns=[lambda: x],
+                          default=lambda: x * -1.0)
+    np.testing.assert_allclose(out.numpy(), [-1.0])
+
+
+def test_switch_case_traced():
+    def fn(i, x):
+        out = snn.switch_case(
+            Tensor(i), branch_fns=[lambda: Tensor(x) * 10.0,
+                                   lambda: Tensor(x) * 20.0])
+        return out._value
+
+    np.testing.assert_allclose(jax.jit(fn)(0, jnp.asarray([2.0])), [20.0])
+    np.testing.assert_allclose(jax.jit(fn)(1, jnp.asarray([2.0])), [40.0])
+
+
+def test_case_eager_and_traced():
+    x = T([2.0])
+    out = snn.case([(T(0.0) > T(1.0), lambda: x * 1.0),
+                    (T(2.0) > T(1.0), lambda: x * 5.0)],
+                   default=lambda: x * 9.0)
+    np.testing.assert_allclose(out.numpy(), [10.0])
+
+    def fn(a, x):
+        out = snn.case(
+            [(Tensor(a) > Tensor(1.0), lambda: Tensor(x) * 5.0)],
+            default=lambda: Tensor(x) * 9.0)
+        return out._value
+
+    np.testing.assert_allclose(jax.jit(fn)(2.0, jnp.asarray([2.0])),
+                               [10.0])
+    np.testing.assert_allclose(jax.jit(fn)(0.0, jnp.asarray([2.0])),
+                               [18.0])
+
+
+# -- PyLayer ----------------------------------------------------------------
+
+
+class ScaledTanh(PyLayer):
+    @staticmethod
+    def forward(ctx, x, scale):
+        y = paddle.tanh(x) * scale
+        ctx.save_for_backward(x, Tensor(np.asarray(scale, np.float32)))
+        return y
+
+    @staticmethod
+    def backward(ctx, dy):
+        x, scale = ctx.saved_tensor()
+        return dy * scale * (1.0 - paddle.tanh(x) * paddle.tanh(x))
+
+
+def test_pylayer_forward_backward():
+    x = T([0.3, -0.7], sg=False)
+    y = ScaledTanh.apply(x, 2.0)
+    np.testing.assert_allclose(y.numpy(), 2.0 * np.tanh([0.3, -0.7]),
+                               rtol=1e-6)
+    (y * y).sum().backward()
+    t = np.tanh([0.3, -0.7])
+    expect = 2 * (2 * t) * 2.0 * (1 - t * t)
+    np.testing.assert_allclose(x.grad.numpy(), expect, rtol=1e-5)
+
+
+class TwoOut(PyLayer):
+    @staticmethod
+    def forward(ctx, x):
+        return x * 2.0, x * 3.0
+
+    @staticmethod
+    def backward(ctx, da, db):
+        return da * 2.0 + db * 3.0
+
+
+def test_pylayer_multiple_outputs():
+    x = T([1.0], sg=False)
+    a, b = TwoOut.apply(x)
+    (a + b).backward(T([1.0]))
+    np.testing.assert_allclose(x.grad.numpy(), [5.0])  # da*2 + db*3
+
+
+def test_pylayer_wrong_grad_count_raises():
+    class Bad(PyLayer):
+        @staticmethod
+        def forward(ctx, x, y):
+            return x + y
+
+        @staticmethod
+        def backward(ctx, dz):
+            return dz  # one grad for two tensor inputs
+
+    x, y = T([1.0], sg=False), T([2.0], sg=False)
+    out = Bad.apply(x, y)
+    with pytest.raises(RuntimeError, match="grads"):
+        out.backward(T([1.0]))
+
+
+# -- double grad ------------------------------------------------------------
+
+
+def test_double_grad_scalar():
+    x = T([2.0], sg=False)
+    y = x * x * x  # y = x^3
+    (gx,) = paddle.autograd.grad(y.sum(), x, create_graph=True)
+    np.testing.assert_allclose(gx.numpy(), [12.0])  # 3x^2
+    gx.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [12.0])  # d(3x^2)/dx = 6x
+
+
+def test_double_grad_reaches_parameters():
+    """Gradient-penalty pattern: grad w.r.t. input, then backward to
+    weights."""
+    import paddle_tpu.nn as nn
+
+    paddle.seed(31)
+    lin = nn.Linear(3, 1)
+    x = T(np.ones((2, 3)), sg=False)
+    out = lin(x).sum()
+    (gx,) = paddle.autograd.grad(out, x, create_graph=True)
+    # gx == W broadcast; penalty = sum(gx^2); d penalty / d W = 2*2*W rows
+    penalty = (gx * gx).sum()
+    penalty.backward()
+    w = np.asarray(lin.weight.numpy())  # [3, 1]
+    expect = (2 * w * 2).reshape(3, 1)  # two rows in x
+    np.testing.assert_allclose(lin.weight.grad.numpy(), expect, rtol=1e-5)
+
+
+def test_double_grad_through_pylayer():
+    """create_graph replays a PyLayer via custom_vjp honouring the user's
+    backward rule."""
+
+    class SquareGradIsX(PyLayer):
+        # forward x^2 but backward deliberately returns dy * x (NOT the
+        # true 2x) so we can tell the custom rule is used in the replay
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x * x
+
+        @staticmethod
+        def backward(ctx, dy):
+            (x,) = ctx.saved_tensor()
+            return dy * x
+
+    x = T([3.0], sg=False)
+    y = SquareGradIsX.apply(x)
+    (gx,) = paddle.autograd.grad(y.sum(), x, create_graph=True)
+    np.testing.assert_allclose(gx.numpy(), [3.0])  # custom rule: 1 * x
+    gx.sum().backward()
+    # d(custom grad)/dx: the custom bwd of the replay is dy*x; vjp of that
+    # w.r.t. x with dy=1 gives 1
+    np.testing.assert_allclose(x.grad.numpy(), [1.0])
+
+
+def test_tensor_logical_operators():
+    a = Tensor(np.array([True, False]))
+    b = Tensor(np.array([True, True]))
+    np.testing.assert_array_equal((a & b).numpy(), [True, False])
+    np.testing.assert_array_equal((a | b).numpy(), [True, True])
+    np.testing.assert_array_equal((a ^ b).numpy(), [False, True])
+    np.testing.assert_array_equal((~a).numpy(), [False, True])
+    # integer operands use paddle's bitwise semantics, not truthiness
+    ia = Tensor(np.array([3, 12], np.int32))
+    ib = Tensor(np.array([6, 10], np.int32))
+    np.testing.assert_array_equal((ia & ib).numpy(), [2, 8])
+    np.testing.assert_array_equal((ia | ib).numpy(), [7, 14])
+    np.testing.assert_array_equal((ia ^ ib).numpy(), [5, 6])
+
+
+def test_switch_case_traced_out_of_range_uses_default():
+    def fn(i, x):
+        out = snn.switch_case(
+            Tensor(i), branch_fns=[lambda: Tensor(x) * 10.0],
+            default=lambda: Tensor(x) * -1.0)
+        return out._value
+
+    np.testing.assert_allclose(jax.jit(fn)(0, jnp.asarray([2.0])), [20.0])
+    np.testing.assert_allclose(jax.jit(fn)(-1, jnp.asarray([2.0])),
+                               [-2.0])
+    np.testing.assert_allclose(jax.jit(fn)(5, jnp.asarray([2.0])), [-2.0])
+
+
+def test_double_grad_stop_gradient_input_returns_none():
+    x = T([2.0], sg=False)
+    f = T([3.0], sg=True)
+    y = (x * f).sum()
+    gs = paddle.autograd.grad(y, [x, f], create_graph=True,
+                              allow_unused=True)
+    np.testing.assert_allclose(gs[0].numpy(), [3.0])
+    assert gs[1] is None
+    with pytest.raises(RuntimeError, match="stop_gradient"):
+        paddle.autograd.grad(y, [f], create_graph=True)
+
+
+def test_double_grad_allow_unused():
+    x = T([1.0], sg=False)
+    z = T([1.0], sg=False)
+    y = x * 2.0
+    gs = paddle.autograd.grad(y.sum(), [x, z], create_graph=True,
+                              allow_unused=True)
+    np.testing.assert_allclose(gs[0].numpy(), [2.0])
+    assert gs[1] is None
