@@ -109,6 +109,12 @@ from . import distribution  # noqa: F401,E402
 from . import profiler  # noqa: F401,E402
 from . import autograd  # noqa: F401,E402
 from . import static  # noqa: F401,E402
+from . import text  # noqa: F401,E402
+from . import inference  # noqa: F401,E402
+from . import utils  # noqa: F401,E402
+from .framework.flags import get_flags, set_flags  # noqa: F401,E402
+from .framework import monitor  # noqa: F401,E402
+from .framework import errors  # noqa: F401,E402
 from .framework.io import load, save  # noqa: F401,E402
 from .hapi.model import Model  # noqa: F401,E402
 from .nn.layer.layers import Layer  # noqa: F401,E402

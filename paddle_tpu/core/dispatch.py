@@ -61,6 +61,37 @@ def _amp_rewrite(op_name, arrs):
 # dispatch
 # ---------------------------------------------------------------------------
 
+_flags_mod = None
+
+
+def _maybe_check_nan_inf(op_name, out):
+    """FLAGS_check_nan_inf: post-op scan of every output (ref
+    framework/details/nan_inf_utils_detail.cu; flag at
+    platform/flags.cc:44). Eager-only — under tracing the values are
+    abstract and the check is skipped."""
+    global _flags_mod
+    if _flags_mod is None:
+        from ..framework import flags as _f
+
+        _flags_mod = _f
+    outs = out if isinstance(out, tuple) else (out,)
+    if _flags_mod.flag("FLAGS_benchmark") and not any(
+            isinstance(o, jax.core.Tracer) for o in outs):
+        # stable op timing: block on every output (ref FLAGS_benchmark)
+        jax.block_until_ready(out)
+    if not _flags_mod.flag("FLAGS_check_nan_inf"):
+        return
+    for i, o in enumerate(outs):
+        if isinstance(o, jax.core.Tracer) or not hasattr(o, "dtype"):
+            continue
+        if jnp.issubdtype(o.dtype, jnp.floating):
+            if not bool(jnp.isfinite(o).all()):
+                from ..framework.errors import PreconditionNotMetError
+
+                raise PreconditionNotMetError(
+                    f"op '{op_name}' output #{i} contains NaN/Inf "
+                    "(FLAGS_check_nan_inf is enabled)")
+
 def _as_primal(x):
     """Tensor -> backing array; arrays/scalars pass through."""
     from .tensor import Tensor
@@ -112,6 +143,7 @@ def _apply_impl(op_name, inputs, attrs):
         aux = None
         if opdef.has_aux:
             out, aux = out
+        _maybe_check_nan_inf(op_name, out)
         return _wrap_outputs(opdef, out, aux, node=None)
 
     if opdef.has_aux:
@@ -120,6 +152,7 @@ def _apply_impl(op_name, inputs, attrs):
         out, vjp_fn = jax.vjp(f, *arrs)
         aux = None
 
+    _maybe_check_nan_inf(op_name, out)
     outs_flat = out if isinstance(out, tuple) else (out,)
     out_meta = [(o.shape, o.dtype) for o in outs_flat]
     const_primals = {i: a for i, (t, a) in

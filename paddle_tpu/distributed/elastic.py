@@ -1,0 +1,111 @@
+"""Elastic training: node registry, liveness watch, restart signalling.
+
+Ref parity: python/paddle/distributed/fleet/elastic.py:99 (ElasticManager
+registers nodes in etcd, watches peer liveness, signals RESTART/HOLD) and
+distributed/elastic.py (the `python -m paddle.distributed.elastic` entry).
+TPU-native mapping: the registry is a shared directory (NFS/GCS-fuse on a
+pod; tmpdir in tests) of per-node heartbeat files — the etcd analogue
+with no extra service; fault RECOVERY is checkpoint-based
+(distributed.checkpoint.CheckpointManager), the manager only detects and
+signals, exactly like the reference.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+__all__ = ["ElasticStatus", "ElasticManager"]
+
+
+class ElasticStatus:
+    COMPLETED = "completed"
+    ERROR = "error"
+    HOLD = "hold"
+    RESTART = "restart"
+    EXIT = "exit"
+
+
+class ElasticManager:
+    """File-registry elastic manager.
+
+    np can float between min_np and max_np (PADDLE_ELASTIC_NP semantics):
+    - fewer live nodes than min_np        -> HOLD (wait for peers)
+    - membership changed but >= min_np    -> RESTART (re-form the job)
+    - stable membership                   -> HOLD steady state
+    """
+
+    def __init__(self, registry_dir, node_id=None, min_np=1, max_np=None,
+                 heartbeat_interval=1.0, timeout=10.0):
+        self.registry = os.path.abspath(registry_dir)
+        os.makedirs(self.registry, exist_ok=True)
+        self.node_id = node_id or f"node-{os.getpid()}"
+        self.min_np = int(min_np)
+        self.max_np = int(max_np) if max_np else None
+        self.heartbeat_interval = heartbeat_interval
+        self.timeout = timeout
+        self._known = None
+
+    def _path(self, node_id):
+        return os.path.join(self.registry, f"{node_id}.beat")
+
+    # -- registration / heartbeat (ref elastic.py:142-190) -------------------
+    def register(self):
+        self.beat()
+        return self
+
+    def beat(self):
+        tmp = self._path(self.node_id) + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"node": self.node_id, "ts": time.time()}, f)
+        os.replace(tmp, self._path(self.node_id))
+
+    def deregister(self):
+        try:
+            os.remove(self._path(self.node_id))
+        except FileNotFoundError:
+            pass
+
+    # -- liveness ------------------------------------------------------------
+    def live_nodes(self):
+        now = time.time()
+        live = []
+        for name in os.listdir(self.registry):
+            if not name.endswith(".beat"):
+                continue
+            p = os.path.join(self.registry, name)
+            try:
+                with open(p) as f:
+                    rec = json.load(f)
+            except (OSError, ValueError):
+                continue
+            if now - rec.get("ts", 0) <= self.timeout:
+                live.append(rec["node"])
+        return sorted(live)
+
+    def watch(self):
+        """One poll step -> ElasticStatus (ref watch loop elastic.py)."""
+        live = self.live_nodes()
+        if len(live) < self.min_np:
+            self._known = live
+            return ElasticStatus.HOLD
+        if self.max_np and len(live) > self.max_np:
+            live = live[: self.max_np]
+        if self._known is None:
+            self._known = live
+            return ElasticStatus.HOLD
+        if live != self._known:
+            self._known = live
+            return ElasticStatus.RESTART
+        return ElasticStatus.HOLD
+
+    def world(self):
+        """(rank, world_size) from the current stable membership (same
+        max_np truncation the watcher applies; nodes beyond the cutoff
+        get rank -1)."""
+        live = self.live_nodes()
+        if self.max_np:
+            live = live[: self.max_np]
+        rank = live.index(self.node_id) if self.node_id in live else -1
+        return rank, len(live)

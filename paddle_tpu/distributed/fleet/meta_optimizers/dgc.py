@@ -1,0 +1,96 @@
+"""DGC — deep gradient compression (top-k sparsification + momentum
+correction + local accumulation).
+
+Ref parity: fleet/meta_optimizers/dgc_optimizer.py +
+paddle/fluid/operators/optimizers/dgc_momentum_op.* and dgc_op.*. Same
+update semantics: momentum correction accumulates velocity locally, only
+the top-k% magnitude entries are applied (and, in multi-process mode,
+would be exchanged — sparse comm compression), the rest stay in the local
+error accumulator until they grow large enough.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+
+class DGCMomentumOptimizer:
+    """Momentum with gradient compression.
+
+    rampup_begin_step: steps of plain dense momentum before compression
+    starts (ref dgc_optimizer.py). sparsity: fraction of entries DROPPED
+    (reference default schedule ends at 0.999 -> keep 0.1%)."""
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 rampup_begin_step=0, rampup_step=1, sparsity=(0.999,),
+                 grad_clip=None, name=None):
+        from ....optimizer import Momentum
+
+        self.inner = Momentum(learning_rate=learning_rate,
+                              momentum=momentum, parameters=parameters,
+                              grad_clip=grad_clip)
+        self._momentum = momentum
+        self.rampup_begin_step = int(rampup_begin_step)
+        self.rampup_step = max(1, int(rampup_step))
+        self.sparsity = list(sparsity)
+        self._step_count = 0
+        self._u: dict = {}  # id(p) -> velocity accumulator
+        self._v: dict = {}  # id(p) -> error (unsent) accumulator
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+    def _current_sparsity(self):
+        if self._step_count < self.rampup_begin_step:
+            return 0.0
+        k = min(len(self.sparsity) - 1,
+                (self._step_count - self.rampup_begin_step)
+                * len(self.sparsity) // self.rampup_step)
+        return float(self.sparsity[k])
+
+    def step(self):
+        sparsity = self._current_sparsity()
+        self._step_count += 1
+        if sparsity <= 0.0:
+            self.inner.step()
+            return
+        lr = self.inner.get_lr()
+        # grad clip applies before compression, same as inner.step()
+        params_grads = []
+        for p in self.inner._parameter_list:
+            if p is None or p.stop_gradient or p._grad is None:
+                continue
+            from ....core.tensor import Tensor
+
+            params_grads.append((p, Tensor(p._grad)))
+        gc = getattr(self.inner, "_grad_clip", None)
+        if gc is not None:
+            params_grads = gc(params_grads)
+        for p, g_t in params_grads:
+            g = np.asarray(g_t._value, np.float32)
+            u = self._u.get(id(p))
+            v = self._v.get(id(p))
+            if u is None:
+                u = np.zeros_like(g)
+                v = np.zeros_like(g)
+            # momentum correction (dgc paper eq. 4-5)
+            u = self._momentum * u + g
+            v = v + u
+            flat = np.abs(v).ravel()
+            keep = max(1, int(round(flat.size * (1.0 - sparsity))))
+            thresh = np.partition(flat, -keep)[-keep]
+            mask = np.abs(v) >= thresh
+            sparse_update = np.where(mask, v, 0.0)
+            # applied entries leave the accumulators
+            v = np.where(mask, 0.0, v)
+            u = np.where(mask, 0.0, u)
+            self._u[id(p)], self._v[id(p)] = u, v
+            p._value = p._value - jnp.asarray(
+                lr * sparse_update, p._value.dtype)
+        # keep schedulers/global step consistent
+        self.inner._global_step += 1
+
+    def clear_grad(self):
+        self.inner.clear_grad()

@@ -61,6 +61,10 @@ def parse_args(argv=None):
                              "all local chips; >1 only for CPU-mesh tests)")
     parser.add_argument("--log_dir", type=str, default=None,
                         help="write per-rank workerlog.N files here")
+    parser.add_argument("--elastic_retries", type=int, default=0,
+                        help="restart the whole local pod up to N times "
+                             "after a failure (ref fleet/elastic.py; "
+                             "state recovery is checkpoint-based)")
     parser.add_argument("--poll_interval", type=float, default=0.5)
     parser.add_argument("training_script", type=str)
     parser.add_argument("training_script_args", nargs=argparse.REMAINDER)
@@ -95,9 +99,11 @@ def _build_endpoints(args):
     return eps, world
 
 
-def start_local_trainers(args, endpoints, world):
+def start_local_trainers(args, endpoints, world, append_logs=False):
     """ref launch_utils.py:453 — one Popen per local rank with the env
-    contract; stdout/stderr tee'd to workerlog.N when --log_dir given."""
+    contract; stdout/stderr tee'd to workerlog.N when --log_dir given.
+    append_logs: elastic retries must not truncate the failed attempt's
+    traceback."""
     procs = []
     logs = []
     master = args.master or endpoints[0]
@@ -117,7 +123,8 @@ def start_local_trainers(args, endpoints, world):
         cmd = [sys.executable, "-u", args.training_script] + \
             args.training_script_args
         if args.log_dir:
-            f = open(os.path.join(args.log_dir, f"workerlog.{rank}"), "w")
+            f = open(os.path.join(args.log_dir, f"workerlog.{rank}"),
+                     "a" if append_logs else "w")
             logs.append(f)
             p = subprocess.Popen(cmd, env=env, stdout=f,
                                  stderr=subprocess.STDOUT)
@@ -166,18 +173,26 @@ def watch_local_trainers(procs, poll_interval=0.5):
 
 def launch(argv=None):
     args = parse_args(argv)
-    endpoints, world = _build_endpoints(args)
-    procs, logs = start_local_trainers(args, endpoints, world)
+    attempts = 0
+    while True:
+        endpoints, world = _build_endpoints(args)
+        procs, logs = start_local_trainers(args, endpoints, world,
+                                           append_logs=(attempts > 0))
 
-    def _sig(signum, frame):
-        _terminate_all(procs)
-        sys.exit(128 + signum)
+        def _sig(signum, frame, procs=procs):
+            _terminate_all(procs)
+            sys.exit(128 + signum)
 
-    signal.signal(signal.SIGTERM, _sig)
-    code = watch_local_trainers(procs, args.poll_interval)
-    for f in logs:
-        f.close()
-    return code
+        signal.signal(signal.SIGTERM, _sig)
+        code = watch_local_trainers(procs, args.poll_interval)
+        for f in logs:
+            f.close()
+        if code == 0 or attempts >= args.elastic_retries or code == 130:
+            return code
+        attempts += 1
+        sys.stderr.write(
+            f"[launch] elastic restart {attempts}/"
+            f"{args.elastic_retries} after exit code {code}\n")
 
 
 if __name__ == "__main__":

@@ -1,0 +1,93 @@
+"""Typed error taxonomy + enforce helpers.
+
+Ref parity: paddle/fluid/platform/errors.h + error_codes.proto (the
+PADDLE_ENFORCE_* macros of platform/enforce.h). User-facing errors carry
+the op/context in the message instead of a raw XLA traceback.
+"""
+
+from __future__ import annotations
+
+__all__ = [
+    "PaddleError", "InvalidArgumentError", "NotFoundError",
+    "OutOfRangeError", "AlreadyExistsError", "ResourceExhaustedError",
+    "PreconditionNotMetError", "PermissionDeniedError", "UnavailableError",
+    "FatalError", "UnimplementedError", "ExecutionTimeoutError",
+    "enforce", "enforce_eq", "enforce_gt", "enforce_shape",
+]
+
+
+class PaddleError(Exception):
+    """Base of the taxonomy (error_codes.proto)."""
+
+
+class InvalidArgumentError(PaddleError, ValueError):
+    pass
+
+
+class NotFoundError(PaddleError, KeyError):
+    pass
+
+
+class OutOfRangeError(PaddleError, IndexError):
+    pass
+
+
+class AlreadyExistsError(PaddleError):
+    pass
+
+
+class ResourceExhaustedError(PaddleError, MemoryError):
+    pass
+
+
+class PreconditionNotMetError(PaddleError, RuntimeError):
+    pass
+
+
+class PermissionDeniedError(PaddleError):
+    pass
+
+
+class UnavailableError(PaddleError, RuntimeError):
+    pass
+
+
+class FatalError(PaddleError, RuntimeError):
+    pass
+
+
+class UnimplementedError(PaddleError, NotImplementedError):
+    pass
+
+
+class ExecutionTimeoutError(PaddleError, TimeoutError):
+    pass
+
+
+def enforce(cond, message, error_cls=InvalidArgumentError):
+    """PADDLE_ENFORCE analogue (platform/enforce.h)."""
+    if not cond:
+        raise error_cls(message)
+
+
+def enforce_eq(a, b, message="", error_cls=InvalidArgumentError):
+    if a != b:
+        raise error_cls(f"expected {a!r} == {b!r}"
+                        + (f": {message}" if message else ""))
+
+
+def enforce_gt(a, b, message="", error_cls=InvalidArgumentError):
+    if not a > b:
+        raise error_cls(f"expected {a!r} > {b!r}"
+                        + (f": {message}" if message else ""))
+
+
+def enforce_shape(tensor, expected, message=""):
+    got = tuple(tensor.shape)
+    exp = tuple(expected)
+    ok = len(got) == len(exp) and all(
+        e in (-1, None) or g == e for g, e in zip(got, exp))
+    if not ok:
+        raise InvalidArgumentError(
+            f"shape mismatch: got {got}, expected {exp}"
+            + (f": {message}" if message else ""))

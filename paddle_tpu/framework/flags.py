@@ -1,0 +1,91 @@
+"""Runtime flags registry: paddle.set_flags / get_flags + FLAGS_* env.
+
+Ref parity: paddle/fluid/platform/flags.cc (gflags DEFINEs) +
+pybind/global_value_getter_setter.cc (the Python surface). TPU-native
+differences: flags that configured CUDA allocators/streams have no
+meaning; the registry keeps the reference's user-visible debugging knobs
+and adds XLA-relevant ones. Unknown flags raise (same as the reference's
+enforce on unknown gflag).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+_lock = threading.Lock()
+
+# name -> (default, type, doc)
+_DEFS = {
+    "FLAGS_check_nan_inf": (
+        False, bool,
+        "scan every op output for NaN/Inf and raise (ref "
+        "platform/flags.cc:44 + details/nan_inf_utils_detail.cu)"),
+    "FLAGS_benchmark": (
+        False, bool, "block after each op for stable timing"),
+    "FLAGS_paddle_num_threads": (
+        1, int, "host threads for the native datafeed"),
+    "FLAGS_use_pallas": (
+        True, bool, "use Pallas kernels on TPU where available"),
+    "FLAGS_eager_delete_tensor_gb": (
+        0.0, float, "accepted for compatibility; PJRT manages memory"),
+    "FLAGS_cudnn_deterministic": (
+        False, bool, "accepted for compatibility; XLA is deterministic "
+        "modulo collectives"),
+    "FLAGS_max_inplace_grad_add": (
+        0, int, "accepted for compatibility"),
+}
+
+_values: dict = {}
+
+
+def _coerce(name, value, typ):
+    if typ is bool:
+        if isinstance(value, str):
+            return value.lower() in ("1", "true", "yes", "on")
+        return bool(value)
+    return typ(value)
+
+
+def _init():
+    if _values:  # lock-free fast path (dict fill is atomic under the GIL)
+        return
+    with _lock:
+        if _values:
+            return
+        staged = {}
+        for name, (default, typ, _doc) in _DEFS.items():
+            env = os.environ.get(name)
+            staged[name] = _coerce(name, env, typ) if env is not None \
+                else default
+        _values.update(staged)
+
+
+def set_flags(flags: dict):
+    """paddle.set_flags({'FLAGS_check_nan_inf': True})."""
+    _init()
+    for name, value in flags.items():
+        if name not in _DEFS:
+            raise ValueError(
+                f"unknown flag {name!r}; known flags: "
+                f"{sorted(_DEFS)}")
+        _values[name] = _coerce(name, value, _DEFS[name][1])
+
+
+def get_flags(flags):
+    """paddle.get_flags('FLAGS_x') / ['FLAGS_x', ...] -> dict."""
+    _init()
+    if isinstance(flags, str):
+        flags = [flags]
+    out = {}
+    for name in flags:
+        if name not in _DEFS:
+            raise ValueError(f"unknown flag {name!r}")
+        out[name] = _values[name]
+    return out
+
+
+def flag(name):
+    """Fast internal read."""
+    _init()
+    return _values[name]

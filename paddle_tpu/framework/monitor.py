@@ -1,0 +1,42 @@
+"""Named stat registry (ref paddle/fluid/platform/monitor.h:77 — the
+STAT_ADD int64 counters, e.g. GPU mem high-watermarks). Host-side,
+thread-safe; exported for user/runtime instrumentation."""
+
+from __future__ import annotations
+
+import threading
+
+_lock = threading.Lock()
+_stats: dict = {}
+
+
+def stat_add(name: str, value: int = 1):
+    """STAT_ADD analogue (monitor.h:130)."""
+    with _lock:
+        _stats[name] = _stats.get(name, 0) + int(value)
+
+
+def stat_set(name: str, value: int):
+    with _lock:
+        _stats[name] = int(value)
+
+
+def stat_get(name: str) -> int:
+    with _lock:
+        return _stats.get(name, 0)
+
+
+def stat_max(name: str, value: int):
+    """Record a high-watermark."""
+    with _lock:
+        _stats[name] = max(_stats.get(name, 0), int(value))
+
+
+def stats() -> dict:
+    with _lock:
+        return dict(_stats)
+
+
+def reset():
+    with _lock:
+        _stats.clear()

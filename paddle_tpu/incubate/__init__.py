@@ -1,0 +1,3 @@
+"""paddle.incubate (ref python/paddle/fluid/incubate + paddle/incubate)."""
+
+from . import asp  # noqa: F401

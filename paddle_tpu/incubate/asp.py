@@ -1,0 +1,110 @@
+"""ASP — automatic 2:4 structured sparsity.
+
+Ref parity: python/paddle/fluid/contrib/sparsity/ (utils.py mask
+generation, asp.py prune_model/decorate) + fleet/meta_optimizers/
+asp_optimizer.py. Same workflow: compute n:m masks for eligible weights,
+prune in place, and decorate the optimizer so masks are re-applied after
+every step (keeping pruned weights at zero through training).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+__all__ = [
+    "calculate_density", "check_sparsity", "create_mask", "prune_model",
+    "decorate", "set_excluded_layers", "reset_excluded_layers",
+]
+
+_excluded_names: set = set()
+_masks: dict = {}  # id(param) -> jnp mask
+
+
+def calculate_density(mat) -> float:
+    mat = np.asarray(mat)
+    return float(np.count_nonzero(mat)) / mat.size
+
+
+def create_mask(mat, n=2, m=4):
+    """n:m mask along the last axis: keep the n largest |values| in every
+    group of m (ref sparsity/utils.py get_mask_1d)."""
+    arr = np.asarray(mat)
+    if arr.shape[-1] % m != 0:
+        raise ValueError(
+            f"last dim {arr.shape[-1]} not divisible by m={m}")
+    groups = np.abs(arr).reshape(-1, m)
+    order = np.argsort(-groups, axis=1, kind="stable")
+    mask = np.zeros_like(groups, dtype=bool)
+    np.put_along_axis(mask, order[:, :n], True, axis=1)
+    return mask.reshape(arr.shape)
+
+
+def check_sparsity(mat, n=2, m=4) -> bool:
+    """True iff every m-group along the last axis has <= n non-zeros
+    (ref sparsity/utils.py check_mask_1d)."""
+    arr = np.asarray(mat)
+    if arr.shape[-1] % m != 0:
+        return False
+    nz = (arr.reshape(-1, m) != 0).sum(axis=1)
+    return bool((nz <= n).all())
+
+
+def set_excluded_layers(param_names):
+    """Exclude parameters by name substring (ref asp.py
+    set_excluded_layers)."""
+    _excluded_names.update(param_names)
+
+
+def reset_excluded_layers():
+    _excluded_names.clear()
+
+
+def _eligible(name, param):
+    if param.ndim < 2:
+        return False
+    if param._value.shape[-1] % 4 != 0:
+        return False
+    return not any(sub in (name or "") for sub in _excluded_names)
+
+
+def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
+    """Compute + apply n:m masks to every eligible weight of `model`
+    (ref asp.py prune_model). Returns {param_name: mask}."""
+    out = {}
+    for name, p in model.state_dict().items():
+        from ..core.tensor import Parameter
+
+        if not isinstance(p, Parameter) or not _eligible(name, p):
+            continue
+        mask = create_mask(p.numpy(), n=n, m=m)
+        jmask = jnp.asarray(mask, p._value.dtype)
+        p._value = p._value * jmask
+        if with_mask:
+            _masks[id(p)] = jmask
+        out[name] = mask
+    return out
+
+
+class ASPOptimizerWrapper:
+    """Re-applies masks after each step so pruned weights stay zero
+    (ref asp_optimizer.py ASPOptimizer)."""
+
+    def __init__(self, inner):
+        self.inner = inner
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+    def step(self):
+        self.inner.step()
+        for p in self.inner._parameter_list:
+            mask = _masks.get(id(p))
+            if mask is not None:
+                p._value = p._value * mask
+
+
+def decorate(optimizer):
+    """ref asp.py decorate(optimizer)."""
+    return ASPOptimizerWrapper(optimizer)

@@ -192,9 +192,14 @@ class TranslatedLayer(Layer):
         self._state = state
 
     def forward(self, *args):
+        from collections import OrderedDict
+
         arrs = [a._value if isinstance(a, Tensor) else jnp.asarray(a)
                 for a in args]
-        values = {k: jnp.asarray(v) for k, v in self._state.items()}
+        # the export traced an OrderedDict of values — the call-time
+        # pytree must match its type and key order exactly
+        values = OrderedDict(
+            (k, jnp.asarray(v)) for k, v in self._state.items())
         out = self._exported.call(values, *arrs)
         return jax.tree.map(Tensor, out)
 

@@ -125,6 +125,14 @@ def _check_indices(idx, n):
 def _nthreads(default=None):
     if default is not None:
         return default
+    try:
+        from ..framework.flags import flag
+
+        n = int(flag("FLAGS_paddle_num_threads"))
+        if n > 1:
+            return n
+    except Exception:  # noqa: BLE001 — flags optional here
+        pass
     return min(8, os.cpu_count() or 1)
 
 

@@ -55,6 +55,10 @@ def _use_pallas() -> bool:
         return True
     if force == "jnp":
         return False
+    from ..framework.flags import flag
+
+    if not flag("FLAGS_use_pallas"):
+        return False
     return _HAS_PLTPU and jax.default_backend() == "tpu"
 
 

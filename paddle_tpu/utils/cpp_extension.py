@@ -1,0 +1,145 @@
+"""Custom-op plugin toolchain: build user C++ into loadable ops.
+
+Ref parity: python/paddle/utils/cpp_extension/ (JIT build of user .cc into
+a .so) + paddle/fluid/framework/custom_operator.cc:511 (runtime op
+registration). TPU-native differences: no pybind11 — the user exposes
+`extern "C"` functions loaded via ctypes; `register_custom_op` wires a
+host function into the op registry through `jax.pure_callback`, so custom
+ops work in eager mode AND inside jit-traced programs (XLA calls back to
+the host), with an optional custom gradient.
+
+    lib = load(name="my_ops", sources=["my_ops.cc"])
+    # extern "C" void my_relu(const float* x, float* y, int64_t n);
+
+    def my_relu(x):
+        out = np.empty_like(x)
+        lib.my_relu(c_ptr(x), c_ptr(out), x.size)
+        return out
+
+    register_custom_op("my_relu", my_relu,
+                       infer_shape=lambda x: (x.shape, x.dtype))
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.op_registry import register_op
+
+__all__ = ["load", "register_custom_op", "c_ptr", "CppExtension"]
+
+
+def _cache_dir():
+    root = os.environ.get("PADDLE_TPU_CACHE",
+                          os.path.join(os.path.expanduser("~"), ".cache",
+                                       "paddle_tpu"))
+    d = os.path.join(root, "extensions")
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def load(name, sources, extra_cflags=None, extra_ldflags=None,
+         verbose=False):
+    """Compile `sources` (C++ files) into a shared library and return the
+    ctypes.CDLL (ref cpp_extension.load). Rebuilds only when sources or
+    flags change (content-hash cache)."""
+    h = hashlib.sha256(name.encode())
+    for src in sources:
+        with open(src, "rb") as f:
+            h.update(f.read())
+    flags = ["-O3", "-shared", "-fPIC", "-std=c++17"] + \
+        list(extra_cflags or [])
+    h.update(" ".join(flags).encode())
+    h.update(" ".join(extra_ldflags or []).encode())
+    so = os.path.join(_cache_dir(), f"{name}-{h.hexdigest()[:16]}.so")
+    if not os.path.exists(so):
+        tmp = so + f".tmp{os.getpid()}"
+        cmd = ["g++"] + flags + list(sources) + ["-o", tmp] + \
+            list(extra_ldflags or [])
+        if verbose:
+            print("[cpp_extension]", " ".join(cmd))
+        r = subprocess.run(cmd, capture_output=True, text=True)
+        if r.returncode != 0:
+            raise RuntimeError(
+                f"cpp_extension build failed:\n{r.stderr}")
+        os.replace(tmp, so)
+    return ctypes.CDLL(so)
+
+
+# torch/paddle-style spec object for setup() workflows
+class CppExtension:
+    def __init__(self, sources, extra_compile_args=None):
+        self.sources = list(sources)
+        self.extra_compile_args = list(extra_compile_args or [])
+
+
+_CTYPES = {
+    np.dtype(np.float32): ctypes.POINTER(ctypes.c_float),
+    np.dtype(np.float64): ctypes.POINTER(ctypes.c_double),
+    np.dtype(np.int32): ctypes.POINTER(ctypes.c_int32),
+    np.dtype(np.int64): ctypes.POINTER(ctypes.c_int64),
+    np.dtype(np.uint8): ctypes.POINTER(ctypes.c_uint8),
+}
+
+
+def c_ptr(array):
+    """Typed ctypes pointer for a contiguous numpy array."""
+    array = np.ascontiguousarray(array)
+    return array.ctypes.data_as(_CTYPES[array.dtype])
+
+
+def register_custom_op(name, host_fn, *, infer_shape=None, grad_fn=None,
+                       no_grad=False):
+    """Register a host-side function as op `name`
+    (ref custom_operator.cc:511 RegisterOperatorWithMetaInfo).
+
+    host_fn(*np_arrays, **attrs) -> np array (or tuple). Under jit the op
+    becomes a jax.pure_callback using `infer_shape(*abstract) ->
+    (shape, dtype) | list` for the output spec. grad_fn(*np_arrays,
+    grad) -> tuple of input grads enables backward via custom_vjp."""
+
+    def spec_of(*arrs, **attrs):
+        if infer_shape is not None:
+            out = infer_shape(*arrs, **attrs)
+        else:
+            out = (arrs[0].shape, arrs[0].dtype)
+        if isinstance(out, list):
+            return [jax.ShapeDtypeStruct(tuple(s), d) for s, d in out]
+        return jax.ShapeDtypeStruct(tuple(out[0]), out[1])
+
+    def call_host(*arrs, **attrs):
+        return jax.pure_callback(
+            lambda *xs: host_fn(*[np.asarray(x) for x in xs], **attrs),
+            spec_of(*arrs, **attrs), *arrs, vmap_method="sequential")
+
+    if grad_fn is None:
+        register_op(name, no_grad=True)(call_host)
+        return
+
+    @jax.custom_vjp
+    def op(*arrs):
+        return call_host(*arrs)
+
+    def fwd(*arrs):
+        return call_host(*arrs), arrs
+
+    def bwd(res, g):
+        specs = tuple(jax.ShapeDtypeStruct(a.shape, a.dtype) for a in res)
+        out = jax.pure_callback(
+            lambda *xs: tuple(
+                np.asarray(r) for r in grad_fn(
+                    *[np.asarray(x) for x in xs[:-1]],
+                    np.asarray(xs[-1]))),
+            specs, *res, g, vmap_method="sequential")
+        return tuple(out)
+
+    op.defvjp(fwd, bwd)
+    register_op(name)(lambda *arrs, **attrs: op(*arrs))

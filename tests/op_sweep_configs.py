@@ -1219,8 +1219,33 @@ case("rnn", [_RNN_X, _RNN_H0, _RNN_H0, KEY,
      {"mode": "LSTM", "num_layers": 1, "hidden_size": 5},
      prop=_np_lstm_ref, grad=None, bf16=False, mode="fn")
 
+# matrix_nms: two boxes of class 1 overlapping heavily -> second decays
+_NMS_BOXES = np.array([[[0, 0, 10, 10], [0, 0, 9, 9], [20, 20, 30, 30]]],
+                      np.float32)
+_NMS_SCORES = np.array([[[0.0, 0.0, 0.0],      # background
+                         [0.9, 0.8, 0.7]]], np.float32)
+
+
+def _nms_prop(outs, inputs, attrs):
+    out, index, rois = (np.asarray(o) for o in outs)
+    assert rois.tolist() == [3]
+    assert out.shape == (3, 6)
+    # sorted by decayed score: the overlapped 0.8 box decays below 0.7
+    np.testing.assert_allclose(out[0, 1], 0.9, rtol=1e-6)
+    assert out[0, 0] == 1.0  # class label
+    assert (out[:, 1][:-1] >= out[:, 1][1:]).all()
+    assert out[-1, 1] < 0.3  # heavily suppressed
+
+
+case("matrix_nms", [_NMS_BOXES, _NMS_SCORES],
+     {"score_threshold": 0.05, "post_threshold": 0.0},
+     prop=_nms_prop, grad=None, bf16=False)
+
+case("sequence_mask", [np.array([1, 3, 2], np.int64)], {"maxlen": 4},
+     ref=lambda lengths, maxlen:
+     np.arange(4)[None, :] < lengths[:, None],
+     grad=None, bf16=False)
+
 # ===========================================================================
 # known-unimplemented ops (tracked; implementing removes from this set)
 # ===========================================================================
-
-UNIMPLEMENTED.add("matrix_nms")

@@ -1,0 +1,252 @@
+"""Aux-subsystem parity: flags, monitor, NaN/Inf debug, errors, text
+datasets, inference predictor, cpp_extension custom ops, elastic manager,
+LocalSGD wrapper.
+
+Ref parity: platform/flags.cc, platform/monitor.h, nan_inf_utils,
+platform/errors.h, python/paddle/text/datasets/, inference/api/,
+framework/custom_operator.cc, fleet/elastic.py,
+fleet/meta_optimizers/localsgd_optimizer.py.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor
+
+
+# -- flags ------------------------------------------------------------------
+
+
+def test_set_get_flags():
+    paddle.set_flags({"FLAGS_benchmark": True})
+    assert paddle.get_flags("FLAGS_benchmark")["FLAGS_benchmark"] is True
+    paddle.set_flags({"FLAGS_benchmark": False})
+    with pytest.raises(ValueError, match="unknown flag"):
+        paddle.set_flags({"FLAGS_nope": 1})
+
+
+def test_check_nan_inf_flag():
+    x = Tensor(np.array([1.0, 0.0], np.float32))
+    paddle.set_flags({"FLAGS_check_nan_inf": True})
+    try:
+        with pytest.raises(paddle.errors.PreconditionNotMetError,
+                           match="NaN/Inf"):
+            _ = x / Tensor(np.array([1.0, 0.0], np.float32))
+        # clean computation passes
+        _ = x + x
+    finally:
+        paddle.set_flags({"FLAGS_check_nan_inf": False})
+
+
+# -- monitor / errors -------------------------------------------------------
+
+
+def test_monitor_stats():
+    paddle.monitor.reset()
+    paddle.monitor.stat_add("steps", 2)
+    paddle.monitor.stat_add("steps", 3)
+    paddle.monitor.stat_max("peak", 7)
+    paddle.monitor.stat_max("peak", 5)
+    assert paddle.monitor.stat_get("steps") == 5
+    assert paddle.monitor.stats()["peak"] == 7
+
+
+def test_error_taxonomy():
+    with pytest.raises(paddle.errors.InvalidArgumentError):
+        paddle.errors.enforce(False, "bad arg")
+    with pytest.raises(ValueError):  # taxonomy doubles as builtin types
+        paddle.errors.enforce(False, "bad arg")
+    paddle.errors.enforce_shape(
+        Tensor(np.zeros((2, 3), np.float32)), (2, -1))
+    with pytest.raises(paddle.errors.InvalidArgumentError):
+        paddle.errors.enforce_shape(
+            Tensor(np.zeros((2, 3), np.float32)), (3, 3))
+
+
+# -- text datasets ----------------------------------------------------------
+
+
+def test_text_datasets_shapes():
+    imdb = paddle.text.Imdb(mode="train", max_len=64, vocab_size=100)
+    x, y = imdb[0]
+    assert x.shape == (64,) and y in (0, 1)
+    assert len(imdb) > 0
+
+    uci = paddle.text.UCIHousing(mode="train")
+    x, y = uci[0]
+    assert x.shape == (13,) and y.shape == (1,)
+
+    conll = paddle.text.Conll05st(mode="test", max_len=32)
+    w, t = conll[0]
+    assert w.shape == (32,) and t.shape == (32,)
+
+    ml = paddle.text.Movielens()
+    u, m, r = ml[0]
+    assert 1.0 <= float(r) <= 5.0
+
+    wmt = paddle.text.WMT14(mode="test", max_len=16)
+    s, t, nxt = wmt[0]
+    assert s.shape == (16,) and t.shape == (16,) and nxt.shape == (16,)
+
+
+def test_imdb_trains():
+    import paddle_tpu.nn as nn
+
+    paddle.seed(77)
+    ds = paddle.text.Imdb(mode="train", max_len=32, vocab_size=50)
+    emb = nn.Embedding(50, 16)
+    head = nn.Linear(16, 2)
+    opt = paddle.optimizer.Adam(
+        learning_rate=5e-3,
+        parameters=list(emb.parameters()) + list(head.parameters()))
+    lossf = nn.CrossEntropyLoss()
+    loader = paddle.io.DataLoader(
+        paddle.io.TensorDataset([ds.docs[:256], ds.labels[:256]]),
+        batch_size=64, shuffle=True)
+    losses = []
+    for _ in range(3):
+        for x, y in loader:
+            h = emb(x).mean(axis=1)
+            loss = lossf(head(h), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0]
+
+
+# -- inference predictor ----------------------------------------------------
+
+
+def test_inference_predictor_roundtrip(tmp_path):
+    import paddle_tpu.nn as nn
+    from paddle_tpu.jit import InputSpec
+
+    paddle.seed(5)
+    model = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    model.eval()
+    prefix = str(tmp_path / "served")
+    paddle.jit.save(model, prefix,
+                    input_spec=[InputSpec([2, 4], "float32")])
+
+    config = paddle.inference.Config(prefix)
+    predictor = paddle.inference.create_predictor(config)
+    x = np.random.RandomState(0).randn(2, 4).astype(np.float32)
+    h = predictor.get_input_handle(predictor.get_input_names()[0])
+    h.copy_from_cpu(x)
+    assert predictor.run()
+    out = predictor.get_output_handle(
+        predictor.get_output_names()[0]).copy_to_cpu()
+    expect = model(Tensor(x)).numpy()
+    np.testing.assert_allclose(out, expect, rtol=1e-5, atol=1e-6)
+
+    clone = predictor.clone()
+    h2 = clone.get_input_handle(clone.get_input_names()[0])
+    h2.copy_from_cpu(x)
+    clone.run()
+    np.testing.assert_allclose(
+        clone.get_output_handle(
+            clone.get_output_names()[0]).copy_to_cpu(),
+        expect, rtol=1e-5, atol=1e-6)
+
+
+# -- cpp_extension custom ops ----------------------------------------------
+
+
+CPP_SRC = r"""
+#include <cstdint>
+extern "C" void double_it(const float* x, float* y, int64_t n) {
+  for (int64_t i = 0; i < n; ++i) y[i] = 2.0f * x[i];
+}
+"""
+
+
+def test_cpp_extension_load_and_custom_op(tmp_path):
+    from paddle_tpu.core.dispatch import apply
+    from paddle_tpu.core.op_registry import has_op
+    from paddle_tpu.utils import cpp_extension as cpp
+
+    src = tmp_path / "double_it.cc"
+    src.write_text(CPP_SRC)
+    lib = cpp.load("double_it_ext", [str(src)])
+
+    def host_double(x):
+        out = np.empty_like(x)
+        lib.double_it(cpp.c_ptr(x), cpp.c_ptr(out), x.size)
+        return out
+
+    def grad_double(x, g):
+        return (2.0 * g,)
+
+    if not has_op("custom_double"):
+        cpp.register_custom_op("custom_double", host_double,
+                               grad_fn=grad_double)
+
+    x = Tensor(np.array([1.0, -2.5], np.float32), stop_gradient=False)
+    y = apply("custom_double", x)
+    np.testing.assert_allclose(y.numpy(), [2.0, -5.0])
+    y.backward(Tensor(np.ones(2, np.float32)))
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 2.0])
+
+    # works inside jit (pure_callback)
+    import jax
+
+    out = jax.jit(lambda a: apply("custom_double", Tensor(a))._value)(
+        np.array([3.0], np.float32))
+    np.testing.assert_allclose(np.asarray(out), [6.0])
+
+
+# -- elastic ---------------------------------------------------------------
+
+
+def test_elastic_manager_membership(tmp_path):
+    from paddle_tpu.distributed.elastic import ElasticManager, \
+        ElasticStatus
+
+    reg = str(tmp_path / "reg")
+    a = ElasticManager(reg, node_id="a", min_np=2, timeout=5).register()
+    watcher = ElasticManager(reg, node_id="a", min_np=2, timeout=5)
+    assert watcher.watch() == ElasticStatus.HOLD  # below min_np
+
+    b = ElasticManager(reg, node_id="b", min_np=2, timeout=5).register()
+    assert watcher.watch() in (ElasticStatus.HOLD, ElasticStatus.RESTART)
+    watcher.watch()  # stabilise
+    assert watcher.watch() == ElasticStatus.HOLD
+
+    b.deregister()
+    a.beat()
+    st = watcher.watch()
+    assert st == ElasticStatus.HOLD  # back under min_np -> hold
+    rank, world = a.world()
+    assert rank == 0 and world == 1
+
+
+# -- LocalSGD ---------------------------------------------------------------
+
+
+def test_localsgd_single_process_is_plain_sgd():
+    import paddle_tpu.nn as nn
+    from paddle_tpu.distributed.fleet.meta_optimizers.localsgd import (
+        LocalSGDOptimizer,
+    )
+
+    paddle.seed(6)
+    lin = nn.Linear(4, 2)
+    inner = paddle.optimizer.SGD(learning_rate=0.1,
+                                 parameters=lin.parameters())
+    opt = LocalSGDOptimizer(inner, k_steps=2)
+    x = Tensor(np.random.RandomState(0).randn(4, 4).astype(np.float32))
+    y = Tensor(np.random.RandomState(1).randn(4, 2).astype(np.float32))
+    for _ in range(4):
+        loss = ((lin(x) - y) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    assert opt._local_steps == 4
+    assert np.isfinite(lin.weight.numpy()).all()
