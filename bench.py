@@ -78,6 +78,9 @@ def main():
         import jax
         jax.config.update("jax_platforms", "cpu")
     import jax
+    # hardware RNG for dropout masks: threefry is a long scalar program on
+    # TPU, rbg lowers to the on-chip PRNG
+    jax.config.update("jax_default_prng_impl", "rbg")
     import paddle_tpu as paddle
     from paddle_tpu import amp
     from paddle_tpu.engine import Engine
@@ -93,9 +96,13 @@ def main():
         batch = int(os.environ.get("BENCH_BATCH", "32"))
         seq = int(os.environ.get("BENCH_SEQ", "512"))
         iters = int(os.environ.get("BENCH_ITERS", "20"))
+        dropout = float(os.environ.get("BENCH_DROPOUT", "0.1"))
+        remat = os.environ.get("BENCH_REMAT", "") == "1"
         cfg = ErnieConfig(vocab_size=18000, hidden_size=768, num_layers=12,
                           num_heads=12, ffn_hidden_size=3072,
-                          max_seq_len=seq, dropout=0.1, use_parallel=False)
+                          max_seq_len=seq, dropout=dropout,
+                          attn_dropout=dropout,
+                          use_parallel=False, recompute=remat)
     else:
         # off-TPU smoke configuration: same code path, tiny shapes
         batch, seq, iters = 4, 128, 5
@@ -134,17 +141,60 @@ def main():
         with amp.auto_cast(enable=True, dtype="bfloat16"):
             return engine.train_batch(ids, labels)
 
-    # Warmup: compile + 2 executions.
+    # Warmup: compile + 2 executions (also builds engine._step_fn).
     loss = one_step()
     for _ in range(2):
         loss = one_step()
-    jax.block_until_ready((loss._value, engine.state.params))
+    _ = float(np.asarray(loss._value))  # real sync (see timing note)
 
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        loss = one_step()
-    jax.block_until_ready((loss._value, engine.state.params))
-    dt = time.perf_counter() - t0
+    # Timing. Two axon-terminal hazards (VERDICT r1): block_until_ready
+    # over the tunnel returns before compute finishes (measured "6500
+    # TFLOP/s"), and every dispatch pays ~50ms RTT. So: (a) scan N steps
+    # INSIDE one jitted program (one dispatch, true step-to-step data
+    # dependency through params/opt-state), (b) end timing on a HOST READ
+    # of the final loss, (c) run two different N and use the difference,
+    # cancelling the fixed dispatch+transfer overhead.
+    import jax.numpy as jnp
+    from jax import lax
+    from paddle_tpu.framework import random as _random
+
+    raw_step = engine._step_fn._raw_step_fn
+    xj, yj = jnp.asarray(ids), jnp.asarray(labels)
+    lr = jnp.asarray(1e-4, jnp.float32)
+    base_key = _random.default_generator.next_key()
+
+    def make_run_n(n):
+        @jax.jit
+        def run_n(params, buffers, opt_state):
+            def body(carry, i):
+                params, buffers, opt_state = carry
+                with amp.auto_cast(enable=True, dtype="bfloat16"):
+                    loss, p, b, o = raw_step(
+                        params, buffers, opt_state,
+                        {"inputs": (xj,), "labels": (yj,)}, lr,
+                        jax.random.fold_in(base_key, i))
+                return (p, b, o), loss
+            (p, b, o), losses = lax.scan(
+                body, (params, buffers, opt_state), jnp.arange(n))
+            return losses[-1], p, b, o
+        return run_n
+
+    n1, n2 = iters, 3 * iters
+    st = engine.state
+    run1, run2 = make_run_n(n1), make_run_n(n2)
+
+    def timed(run):
+        l, p, b, o = run(st.params, st.buffers, st.opt_state)
+        _ = float(np.asarray(l))  # warmup incl. compile
+        t0 = time.perf_counter()
+        l, p, b, o = run(st.params, st.buffers, st.opt_state)
+        lv = float(np.asarray(l))
+        return time.perf_counter() - t0, lv
+
+    dt1, _ = timed(run1)
+    dt2, loss_v = timed(run2)
+    dt = dt2 - dt1          # fixed overhead cancels
+    iters = n2 - n1
 
     profile_dir = os.environ.get("BENCH_PROFILE", "")
     if profile_dir:
@@ -180,7 +230,7 @@ def main():
         "batch": batch, "seq": seq, "iters": iters,
         "params": n_params,
         "device": getattr(dev, "device_kind", dev.platform),
-        "loss": float(loss.item()),
+        "loss": loss_v,
     }))
 
 

@@ -63,6 +63,28 @@ class no_grad(contextlib.ContextDecorator):
         return False
 
 
+def is_tape_enabled() -> bool:
+    return getattr(_state, "tape_enabled", True)
+
+
+class no_tape(contextlib.ContextDecorator):
+    """Disable eager tape recording (dispatch skips its per-op jax.vjp).
+
+    Used by the functional engines: they differentiate the whole step with
+    jax AD, so the tape's inner vjp closures are pure overhead — and a
+    nested inner-vjp-under-outer-grad would require second-order rules
+    from custom kernels (Pallas flash attention has first-order only)."""
+
+    def __enter__(self):
+        self._prev = getattr(_state, "tape_enabled", True)
+        _state.tape_enabled = False
+        return self
+
+    def __exit__(self, *exc):
+        _state.tape_enabled = self._prev
+        return False
+
+
 class enable_grad(contextlib.ContextDecorator):
     def __enter__(self):
         self._prev = _state.grad_enabled

@@ -131,6 +131,7 @@ def _apply_impl(op_name, inputs, attrs):
 
     requires_grad = (
         config.is_grad_enabled()
+        and config.is_tape_enabled()
         and not opdef.no_grad
         and any(t is not None and not t.stop_gradient for t in tensor_inputs)
     )

@@ -30,16 +30,21 @@ def recompute(function, *args, **kwargs):
     if not tracing:
         return function(*args, **kwargs)
 
+    # only Tensor args flow through the checkpoint boundary; None/static
+    # args stay closed over (jax.checkpoint args must be arrays)
+    tensor_idx = [i for i, a in enumerate(args) if isinstance(a, Tensor)]
+
     def fn_arrays(*arrs):
-        wrapped = [Tensor(a) if not isinstance(a, Tensor) else a
-                   for a in arrs]
-        out = function(*wrapped, **kwargs)
+        full = list(args)
+        for j, i in enumerate(tensor_idx):
+            full[i] = Tensor(arrs[j])
+        out = function(*full, **kwargs)
         return jax.tree.map(
             lambda t: t._value if isinstance(t, Tensor) else t, out,
             is_leaf=lambda t: isinstance(t, Tensor))
 
-    arrs = [a._value if isinstance(a, Tensor) else a for a in args]
-    out = jax.checkpoint(fn_arrays)(*arrs)
+    out = jax.checkpoint(fn_arrays)(
+        *[args[i]._value for i in tensor_idx])
     return jax.tree.map(Tensor, out)
 
 

@@ -199,13 +199,15 @@ class HybridParallelEngine:
         mesh = self.mesh
         opt = self.optimizer
 
+        from ..core.config import no_tape
+
         def stage_fn(stage_params, x):
             # stage_params leaves: [Lps, ...]; scan the blocks
             def body(h, inp):
                 layer_params, idx = inp
                 with _random.rng_scope(
                         jax.random.fold_in(_random.next_key(), idx)):
-                    with _swap_state(template, layer_params):
+                    with no_tape(), _swap_state(template, layer_params):
                         out = template(Tensor(h))
                 return out._value if isinstance(out, Tensor) else out, None
 

@@ -92,8 +92,10 @@ def _unwrap(out):
 def functional_call(layer, values, *args, capture_buffers=False, **kwargs):
     """Run `layer(*args)` with parameters/buffers taken from `values`
     (dict name->array). Differentiable wrt `values` under jax traces."""
+    from .core.config import no_tape
+
     wrapped = [Tensor(a) if not isinstance(a, Tensor) else a for a in args]
-    with _swap_state(layer, values) as sd:
+    with no_tape(), _swap_state(layer, values) as sd:
         out = layer(*wrapped, **kwargs)
         if capture_buffers:
             post = OrderedDict(
@@ -256,10 +258,15 @@ def make_train_step(layer, loss_fn, optimizer, *, grad_clip=None,
         out_shardings = (repl, p_sh, buf_sh, o_sh)
     donate_argnums = (0, 1, 2) if donate else ()
     if mesh is not None:
-        return jax.jit(step_fn, donate_argnums=donate_argnums,
-                       in_shardings=in_shardings,
-                       out_shardings=out_shardings)
-    return jax.jit(step_fn, donate_argnums=donate_argnums)
+        jitted = jax.jit(step_fn, donate_argnums=donate_argnums,
+                         in_shardings=in_shardings,
+                         out_shardings=out_shardings)
+    else:
+        jitted = jax.jit(step_fn, donate_argnums=donate_argnums)
+    # the un-jitted step is re-usable inside larger traced loops (bench
+    # scans N steps in one program to amortise dispatch latency)
+    jitted._raw_step_fn = step_fn
+    return jitted
 
 
 def make_eval_step(layer, mesh=None):

@@ -71,8 +71,10 @@ class StaticFunction:
             from ..engine import _swap_state, _unwrap
 
             def run(values, *arrs):
+                from ..core.config import no_tape
+
                 wrapped = [Tensor(a) for a in arrs]
-                with _swap_state(layer, values):
+                with no_tape(), _swap_state(layer, values):
                     out = orig_forward(*wrapped)
                 return _unwrap(out)
 

@@ -25,7 +25,8 @@ class ErnieConfig:
                  num_heads=12, ffn_hidden_size=3072, max_seq_len=512,
                  type_vocab_size=4, dropout=0.1, attn_dropout=0.1,
                  layer_norm_eps=1e-12, initializer_range=0.02,
-                 use_parallel=False, sequence_parallel=False):
+                 use_parallel=False, sequence_parallel=False,
+                 recompute=False):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_layers = num_layers
@@ -39,6 +40,7 @@ class ErnieConfig:
         self.initializer_range = initializer_range
         self.use_parallel = use_parallel
         self.sequence_parallel = sequence_parallel
+        self.recompute = recompute
 
 
 _PRESETS = {
@@ -188,8 +190,16 @@ class ErnieModel(nn.Layer):
             m = attention_mask._value.astype(jnp.float32)
             attention_mask = Tensor((1.0 - m)[:, None, None, :] * -1e4)
         x = self.embeddings(input_ids, token_type_ids, position_ids)
-        for layer in self.encoder:
-            x = layer(x, attention_mask)
+        if self.config.recompute:
+            # rematerialise each block in backward (jax.checkpoint) —
+            # trades ~1/3 more FLOPs for O(layers) activation memory
+            from ...distributed.fleet.utils.recompute import recompute
+
+            for layer in self.encoder:
+                x = recompute(layer, x, attention_mask)
+        else:
+            for layer in self.encoder:
+                x = layer(x, attention_mask)
         pooled = self.pooler(x)
         return x, pooled
 

@@ -491,8 +491,18 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     k = key.transpose([0, 2, 1, 3])
     v = value.transpose([0, 2, 1, 3])
     use_dropout = dropout_p > 0.0 and training
-    if attn_mask is None and not use_dropout and _has_flash():
-        out = apply("flash_attention", q, k, v, is_causal=is_causal)
+    if attn_mask is None and _has_flash():
+        # flash handles attention dropout in-kernel (mask regenerated in
+        # the backward from the seed, never materialised)
+        seed = None
+        if use_dropout:
+            import jax.numpy as _jnp
+
+            seed = Tensor(
+                _random.next_key()[0].astype(_jnp.int32))
+        out = apply("flash_attention", q, k, v, seed,
+                    is_causal=is_causal,
+                    dropout_p=dropout_p if use_dropout else 0.0)
     else:
         key = Tensor(_random.next_key()) if use_dropout else None
         out = apply("scaled_dot_product_attention", q, k, v, attn_mask,

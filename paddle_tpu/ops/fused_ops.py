@@ -72,8 +72,25 @@ def _interpret() -> bool:
 # ---------------------------------------------------------------------------
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
-                kv_len, block_k, causal_off):
+def _drop_mask(seed, bh_idx, q_off, k_off, shape, dropout_p):
+    """Deterministic keep-mask/(1-p) tile: seeded by (seed, bh, q_off,
+    k_off) so the backward kernels regenerate the identical mask from the
+    same global tile coordinates."""
+    # mosaic accepts at most two 32-bit seed words: mix (seed, bh) into
+    # one and pack the tile coordinates (seq < 2^16) into the other
+    s1 = seed + bh_idx * jnp.int32(-1640531527)  # 2654435761 mod 2^32
+    s2 = q_off * jnp.int32(65536) + k_off
+    pltpu.prng_seed(s1, s2)
+    bits = pltpu.prng_random_bits(shape)
+    keep_prob = 1.0 - dropout_p
+    thresh = jnp.uint32(int(keep_prob * float(2**32 - 1)))
+    keep = bits.astype(jnp.uint32) < thresh
+    return jnp.where(keep, 1.0 / keep_prob, 0.0).astype(jnp.float32)
+
+
+def _fwd_kernel(qpos_ref, bhpos_ref, seed_ref, q_ref, k_ref, v_ref,
+                o_ref, lse_ref, *, scale, causal, kv_len, block_k,
+                causal_off, dropout_p):
     # q_ref: (1, bq, d), k/v_ref: (1, sk, d), o_ref: (1, bq, d),
     # lse_ref: (1, bq, 128) — lse broadcast along a lane dim because TPU
     # blocks need the last two dims (8,128)-aligned (same layout as the
@@ -82,7 +99,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
     sk = k_ref.shape[1]
     nk = sk // block_k
     q = q_ref[0].astype(jnp.float32) * scale
-    q_off = pl.program_id(1) * bq
+    # block offset arrives via an SMEM input: pl.program_id fails to
+    # re-trace under nested AD (jax 0.9), positions-as-data does not
+    q_off = qpos_ref[0, 0, 0]
+    bh_idx = bhpos_ref[0, 0, 0]
+    seed = seed_ref[0, 0, 0]
     q_idx = q_off + lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
 
     def body(t, carry):
@@ -101,9 +122,16 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
         m_new = jnp.maximum(m_i, jnp.max(s, axis=-1))
         p = jnp.exp(s - m_new[:, None])
         alpha = jnp.exp(m_i - m_new)
+        # the softmax denominator uses UNDROPPED p (dropout applies to
+        # normalised probabilities); the value accumulation uses the
+        # dropped+rescaled p
         l_new = l_i * alpha + jnp.sum(p, axis=-1)
+        pv = p
+        if dropout_p > 0.0:
+            pv = p * _drop_mask(seed, bh_idx, q_off, t * block_k,
+                                (bq, block_k), dropout_p)
         acc = acc * alpha[:, None] + jnp.dot(
-            p, v, preferred_element_type=jnp.float32)
+            pv, v, preferred_element_type=jnp.float32)
         return acc, m_new, l_new
 
     acc0 = jnp.zeros((bq, d), jnp.float32)
@@ -116,7 +144,34 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
                                   lse_ref.shape[1:])
 
 
-def _flash_fwd_pallas(q, k, v, scale, causal):
+
+def _pos_inputs(bh, n_blocks, block_size):
+    """Position/seed inputs shared by the fwd and bwd pallas calls.
+
+    The backward kernels REGENERATE the dropout mask from these tile
+    coordinates, so fwd and bwd must build them identically — single
+    construction point. Returns (pos, bhpos, specs) where specs maps
+    kwargs for pallas in_specs."""
+    vmem = pltpu.VMEM if _HAS_PLTPU else None
+    pos = jnp.broadcast_to(
+        (jnp.arange(n_blocks, dtype=jnp.int32) * block_size)[
+            :, None, None], (n_blocks, 8, 128))
+    bhpos = jnp.broadcast_to(
+        jnp.arange(bh, dtype=jnp.int32)[:, None, None], (bh, 8, 128))
+    pos_spec = pl.BlockSpec((1, 8, 128), lambda i, j: (j, 0, 0),
+                            memory_space=vmem)
+    bh_spec = pl.BlockSpec((1, 8, 128), lambda i, j: (i, 0, 0),
+                           memory_space=vmem)
+    seed_spec = pl.BlockSpec((1, 8, 128), lambda i, j: (0, 0, 0),
+                             memory_space=vmem)
+    return pos, bhpos, pos_spec, bh_spec, seed_spec
+
+
+def _seed_input(seed):
+    return jnp.broadcast_to(
+        seed.astype(jnp.int32)[None, None, None], (1, 8, 128))
+
+def _flash_fwd_pallas(q, k, v, seed, scale, causal, dropout_p):
     bh, sq, d = q.shape
     sk = k.shape[1]
     nq = _cdiv(sq, _BLOCK_Q)
@@ -124,7 +179,7 @@ def _flash_fwd_pallas(q, k, v, scale, causal):
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal, kv_len=sk,
         block_k=min(_BLOCK_K, _round_up(sk, _BLOCK_K)),
-        causal_off=sk - sq)
+        causal_off=sk - sq, dropout_p=dropout_p)
     sk_pad = _round_up(sk, _BLOCK_K)
     sq_pad = nq * _BLOCK_Q
     q = jnp.pad(q, ((0, 0), (0, sq_pad - sq), (0, 0)))
@@ -133,10 +188,16 @@ def _flash_fwd_pallas(q, k, v, scale, causal):
     vmem = pltpu.VMEM if _HAS_PLTPU else None
     bspec = lambda shape, imap: pl.BlockSpec(  # noqa: E731
         shape, imap, memory_space=vmem)
+    qpos, bhpos, pos_spec, bh_spec, seed_spec = _pos_inputs(
+        bh, nq, _BLOCK_Q)
+    seed_arr = _seed_input(seed)
     o, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
+            pos_spec,
+            bh_spec,
+            seed_spec,
             bspec((1, _BLOCK_Q, d), lambda i, j: (i, j, 0)),
             bspec((1, sk_pad, d), lambda i, j: (i, 0, 0)),
             bspec((1, sk_pad, d), lambda i, j: (i, 0, 0)),
@@ -150,7 +211,7 @@ def _flash_fwd_pallas(q, k, v, scale, causal):
             jax.ShapeDtypeStruct((bh, sq_pad, 128), jnp.float32),
         ],
         interpret=_interpret(),
-    )(q, k, v)
+    )(qpos, bhpos, seed_arr, q, k, v)
     return o[:, :sq], lse[:, :sq, 0]
 
 
@@ -159,8 +220,9 @@ def _flash_fwd_pallas(q, k, v, scale, causal):
 # ---------------------------------------------------------------------------
 
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   *, scale, causal, kv_len, block_k, causal_off):
+def _bwd_dq_kernel(qpos_ref, bhpos_ref, seed_ref, q_ref, k_ref, v_ref,
+                   do_ref, lse_ref, delta_ref, dq_ref, *, scale, causal,
+                   kv_len, block_k, causal_off, dropout_p):
     # lse_ref/delta_ref: (1, bq, 128) lane-broadcast (see _fwd_kernel)
     bq, d = q_ref.shape[1], q_ref.shape[2]
     sk = k_ref.shape[1]
@@ -169,7 +231,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     do = do_ref[0].astype(jnp.float32)
     lse = lse_ref[0, :, 0]
     delta = delta_ref[0, :, 0]
-    q_off = pl.program_id(1) * bq
+    q_off = qpos_ref[0, 0, 0]
+    bh_idx = bhpos_ref[0, 0, 0]
+    seed = seed_ref[0, 0, 0]
     q_idx = q_off + lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
 
     def body(t, dq):
@@ -183,6 +247,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             mask = mask & (q_idx + causal_off >= k_idx)
         p = jnp.where(mask, jnp.exp(s - lse[:, None]), 0.0)
         dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        if dropout_p > 0.0:
+            dp = dp * _drop_mask(seed, bh_idx, q_off, t * block_k,
+                                 (bq, block_k), dropout_p)
         ds = p * (dp - delta[:, None])
         return dq + jnp.dot(ds, k, preferred_element_type=jnp.float32)
 
@@ -190,15 +257,17 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, *, scale, causal, q_len, block_q,
-                    causal_off):
+def _bwd_dkv_kernel(kpos_ref, bhpos_ref, seed_ref, q_ref, k_ref, v_ref,
+                    do_ref, lse_ref, delta_ref, dk_ref, dv_ref, *, scale,
+                    causal, q_len, block_q, causal_off, dropout_p):
     bk, d = k_ref.shape[1], k_ref.shape[2]
     sq = q_ref.shape[1]
     nq = sq // block_q
     k = k_ref[0].astype(jnp.float32)
     v = v_ref[0].astype(jnp.float32)
-    k_off = pl.program_id(1) * bk
+    k_off = kpos_ref[0, 0, 0]
+    bh_idx = bhpos_ref[0, 0, 0]
+    seed = seed_ref[0, 0, 0]
     k_idx = k_off + lax.broadcasted_iota(jnp.int32, (block_q, bk), 1)
 
     def body(t, carry):
@@ -216,8 +285,18 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         if causal:
             mask = mask & (q_idx + causal_off >= k_idx)
         p = jnp.where(mask, jnp.exp(s - lse[:, None]), 0.0)
-        dv = dv + jnp.dot(p.T, do, preferred_element_type=jnp.float32)
+        if dropout_p > 0.0:
+            # same (q_off, k_off) tile coordinates as the forward
+            dmask = _drop_mask(seed, bh_idx, t * block_q, k_off,
+                               (block_q, bk), dropout_p)
+            pd = p * dmask
+        else:
+            dmask = None
+            pd = p
+        dv = dv + jnp.dot(pd.T, do, preferred_element_type=jnp.float32)
         dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        if dmask is not None:
+            dp = dp * dmask
         ds = p * (dp - delta[:, None])
         dk = dk + jnp.dot(ds.T, q, preferred_element_type=jnp.float32)
         return dk, dv
@@ -229,7 +308,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
-def _flash_bwd_pallas(q, k, v, o, lse, do, scale, causal):
+def _flash_bwd_pallas(q, k, v, o, lse, do, seed, scale, causal,
+                      dropout_p):
     bh, sq, d = q.shape
     sk = k.shape[1]
     nq = _cdiv(sq, _BLOCK_Q)
@@ -249,12 +329,20 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, scale, causal):
     vmem = pltpu.VMEM if _HAS_PLTPU else None
     bspec = lambda shape, imap: pl.BlockSpec(  # noqa: E731
         shape, imap, memory_space=vmem)
+    qpos, bhpos, pos_spec_q, bh_spec, seed_spec = _pos_inputs(
+        bh, nq, _BLOCK_Q)
+    kpos, _, pos_spec_k, _, _ = _pos_inputs(bh, nk, _BLOCK_K)
+    seed_arr = _seed_input(seed)
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                          kv_len=sk, block_k=_BLOCK_K, causal_off=sk - sq),
+                          kv_len=sk, block_k=_BLOCK_K, causal_off=sk - sq,
+                          dropout_p=dropout_p),
         grid=(bh, nq),
         in_specs=[
+            pos_spec_q,
+            bh_spec,
+            seed_spec,
             bspec((1, _BLOCK_Q, d), lambda i, j: (i, j, 0)),
             bspec((1, sk_pad, d), lambda i, j: (i, 0, 0)),
             bspec((1, sk_pad, d), lambda i, j: (i, 0, 0)),
@@ -265,13 +353,17 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, scale, causal):
         out_specs=bspec((1, _BLOCK_Q, d), lambda i, j: (i, j, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, sq_pad, d), q.dtype),
         interpret=_interpret(),
-    )(qp, kp, vp, dop, lsep, deltap)
+    )(qpos, bhpos, seed_arr, qp, kp, vp, dop, lsep, deltap)
 
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
-                          q_len=sq, block_q=_BLOCK_Q, causal_off=sk - sq),
+                          q_len=sq, block_q=_BLOCK_Q, causal_off=sk - sq,
+                          dropout_p=dropout_p),
         grid=(bh, nk),
         in_specs=[
+            pos_spec_k,
+            bh_spec,
+            seed_spec,
             bspec((1, sq_pad, d), lambda i, j: (i, 0, 0)),
             bspec((1, _BLOCK_K, d), lambda i, j: (i, j, 0)),
             bspec((1, _BLOCK_K, d), lambda i, j: (i, j, 0)),
@@ -288,7 +380,7 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, scale, causal):
             jax.ShapeDtypeStruct((bh, sk_pad, d), v.dtype),
         ],
         interpret=_interpret(),
-    )(qp, kp, vp, dop, lsep, deltap)
+    )(kpos, bhpos, seed_arr, qp, kp, vp, dop, lsep, deltap)
     return dq[:, :sq], dk[:, :sk], dv[:, :sk]
 
 
@@ -297,7 +389,13 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, scale, causal):
 # ---------------------------------------------------------------------------
 
 
-def _flash_fwd_jnp(q, k, v, scale, causal):
+def _jnp_drop_mask(seed, shape, dropout_p):
+    key = jax.random.PRNGKey(seed.astype(jnp.uint32))
+    keep = jax.random.bernoulli(key, 1.0 - dropout_p, shape)
+    return jnp.where(keep, 1.0 / (1.0 - dropout_p), 0.0)
+
+
+def _flash_fwd_jnp(q, k, v, seed, scale, causal, dropout_p):
     qf = q.astype(jnp.float32)
     kf = k.astype(jnp.float32)
     s = jnp.einsum("bqd,bkd->bqk", qf, kf) * scale
@@ -309,12 +407,15 @@ def _flash_fwd_jnp(q, k, v, scale, causal):
     m = jnp.max(s, axis=-1)
     p = jnp.exp(s - m[..., None])
     l = jnp.sum(p, axis=-1)
-    o = jnp.einsum("bqk,bkd->bqd", p / l[..., None],
+    pv = p
+    if dropout_p > 0.0:
+        pv = p * _jnp_drop_mask(seed, p.shape, dropout_p)
+    o = jnp.einsum("bqk,bkd->bqd", pv / l[..., None],
                    v.astype(jnp.float32))
     return o.astype(q.dtype), m + jnp.log(l)
 
 
-def _flash_bwd_jnp(q, k, v, o, lse, do, scale, causal):
+def _flash_bwd_jnp(q, k, v, o, lse, do, seed, scale, causal, dropout_p):
     qf, kf, vf = (t.astype(jnp.float32) for t in (q, k, v))
     dof = do.astype(jnp.float32)
     s = jnp.einsum("bqd,bkd->bqk", qf, kf) * scale
@@ -325,8 +426,16 @@ def _flash_bwd_jnp(q, k, v, o, lse, do, scale, causal):
         s = jnp.where(q_idx + (sk - sq) >= k_idx, s, _NEG_INF)
     p = jnp.exp(s - lse[..., None])
     delta = jnp.sum(dof * o.astype(jnp.float32), axis=-1)
-    dv = jnp.einsum("bqk,bqd->bkd", p, dof)
+    if dropout_p > 0.0:
+        dmask = _jnp_drop_mask(seed, p.shape, dropout_p)
+        pd = p * dmask
+    else:
+        dmask = None
+        pd = p
+    dv = jnp.einsum("bqk,bqd->bkd", pd, dof)
     dp = jnp.einsum("bqd,bkd->bqk", dof, vf)
+    if dmask is not None:
+        dp = dp * dmask
     ds = p * (dp - delta[..., None])
     dq = jnp.einsum("bqk,bkd->bqd", ds, kf) * scale
     dk = jnp.einsum("bqk,bqd->bkd", ds, qf) * scale
@@ -338,56 +447,69 @@ def _flash_bwd_jnp(q, k, v, o, lse, do, scale, causal):
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def _flash_attention(q, k, v, causal, scale):
-    o, _ = _flash_fwd(q, k, v, causal, scale)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _flash_attention(q, k, v, seed, causal, scale, dropout_p):
+    o, _ = _flash_fwd(q, k, v, seed, causal, scale, dropout_p)
     return o
 
 
-def _flash_fwd(q, k, v, causal, scale):
+def _flash_fwd(q, k, v, seed, causal, scale, dropout_p):
     b, h, sq, d = q.shape
     sk = k.shape[2]
     q3 = q.reshape(b * h, sq, d)
     k3 = k.reshape(b * h, sk, d)
     v3 = v.reshape(b * h, sk, d)
     if _use_pallas():
-        o3, lse3 = _flash_fwd_pallas(q3, k3, v3, scale, causal)
+        o3, lse3 = _flash_fwd_pallas(q3, k3, v3, seed, scale, causal,
+                                     dropout_p)
     else:
-        o3, lse3 = _flash_fwd_jnp(q3, k3, v3, scale, causal)
+        o3, lse3 = _flash_fwd_jnp(q3, k3, v3, seed, scale, causal,
+                                  dropout_p)
     return o3.reshape(b, h, sq, d), lse3.reshape(b, h, sq)
 
 
-def _flash_fwd_rule(q, k, v, causal, scale):
-    o, lse = _flash_fwd(q, k, v, causal, scale)
-    return o, (q, k, v, o, lse)
+def _flash_fwd_rule(q, k, v, seed, causal, scale, dropout_p):
+    o, lse = _flash_fwd(q, k, v, seed, causal, scale, dropout_p)
+    return o, (q, k, v, seed, o, lse)
 
 
-def _flash_bwd_rule(causal, scale, res, g):
-    q, k, v, o, lse = res
+def _flash_bwd_rule(causal, scale, dropout_p, res, g):
+    q, k, v, seed, o, lse = res
     b, h, sq, d = q.shape
     sk = k.shape[2]
     args = (q.reshape(b * h, sq, d), k.reshape(b * h, sk, d),
             v.reshape(b * h, sk, d), o.reshape(b * h, sq, d),
             lse.reshape(b * h, sq), g.reshape(b * h, sq, d))
     if _use_pallas():
-        dq, dk, dv = _flash_bwd_pallas(*args, scale, causal)
+        dq, dk, dv = _flash_bwd_pallas(*args, seed, scale, causal,
+                                       dropout_p)
     else:
-        dq, dk, dv = _flash_bwd_jnp(*args, scale, causal)
+        dq, dk, dv = _flash_bwd_jnp(*args, seed, scale, causal, dropout_p)
     return (dq.reshape(b, h, sq, d), dk.reshape(b, h, sk, d),
-            dv.reshape(b, h, sk, d))
+            dv.reshape(b, h, sk, d), jnp.zeros_like(seed))
 
 
 _flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
 @register_op("flash_attention")
-def flash_attention(q, k, v, *, is_causal=False, scale=None):
+def flash_attention(q, k, v, seed=None, *, is_causal=False, scale=None,
+                    dropout_p=0.0):
     """Flash attention. q,k,v: [batch, heads, seq, head_dim].
 
-    Ref parity: paddle/fluid/operators/fused/multihead_matmul_op.cu — the
-    reference fuses QK^T + softmax + PV in one CUDA kernel; here it is a
-    Pallas online-softmax kernel with custom-VJP backward.
+    Ref parity: paddle/fluid/operators/fused/multihead_matmul_op.cu and
+    fused attention dropout — here a Pallas online-softmax kernel with
+    custom-VJP backward; attention-probability dropout runs IN-kernel
+    (pltpu PRNG seeded by global tile coordinates, so the backward
+    regenerates the identical mask instead of storing an s*s buffer).
+    `seed`: int32 scalar array driving the dropout PRNG (ignored when
+    dropout_p == 0).
     """
     q, k, v = jnp.asarray(q), jnp.asarray(k), jnp.asarray(v)
     s = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
-    return _flash_attention(q, k, v, bool(is_causal), float(s))
+    if seed is None:
+        seed = jnp.zeros((), jnp.int32)
+    else:
+        seed = jnp.asarray(seed).astype(jnp.int32).reshape(())
+    return _flash_attention(q, k, v, seed, bool(is_causal), float(s),
+                            float(dropout_p))
