@@ -193,8 +193,8 @@ def main():
 
     dt1, _ = timed(run1)
     dt2, loss_v = timed(run2)
-    dt = dt2 - dt1          # fixed overhead cancels
-    iters = n2 - n1
+    dt = dt2 - dt1            # fixed overhead cancels
+    timed_iters = n2 - n1     # steps covered by the differenced window
 
     profile_dir = os.environ.get("BENCH_PROFILE", "")
     if profile_dir:
@@ -214,7 +214,7 @@ def main():
         profiler.export_chrome_tracing(
             os.path.join(profile_dir, "host_trace.json"))
 
-    step_s = dt / iters
+    step_s = dt / timed_iters
     tokens_per_sec = tokens_per_step / step_s
     achieved = flops_per_token * tokens_per_sec
     mfu = achieved / peak
@@ -228,6 +228,7 @@ def main():
         "tokens_per_sec": round(tokens_per_sec, 1),
         "step_ms": round(step_s * 1e3, 2),
         "batch": batch, "seq": seq, "iters": iters,
+        "timed_iters": timed_iters,
         "params": n_params,
         "device": getattr(dev, "device_kind", dev.platform),
         "loss": loss_v,

@@ -112,12 +112,17 @@ class ErnieSelfAttention(nn.Layer):
 
     def forward(self, x, attn_mask=None):
         b, s, h = x.shape
+        # one packed [b,s,3,nh,d] -> [3,b,nh,s,d] transpose instead of
+        # three per-tensor ones: the pallas flash custom-call is opaque to
+        # XLA transpose fusion, so physical transposes are minimised
         qkv = self.qkv_proj(x).reshape(
-            [b, s, 3, self.num_heads, self.head_dim])
-        q, k, v = qkv.unstack(axis=2)
+            [b, s, 3, self.num_heads, self.head_dim]).transpose(
+            [2, 0, 3, 1, 4])
+        q, k, v = qkv.unstack(axis=0)
         out = F.scaled_dot_product_attention(
             q, k, v, attn_mask=attn_mask,
-            dropout_p=self.attn_dropout if self.training else 0.0)
+            dropout_p=self.attn_dropout if self.training else 0.0,
+            qkv_layout="bhsd")
         return self.out_proj(out.reshape([b, s, h]))
 
 

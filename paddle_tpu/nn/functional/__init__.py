@@ -485,11 +485,21 @@ def temporal_shift(x, seg_num, shift_ratio=0.25, name=None,
 
 def scaled_dot_product_attention(query, key, value, attn_mask=None,
                                  dropout_p=0.0, is_causal=False,
-                                 training=True, name=None):
-    """query/key/value: [batch, seq, heads, head_dim] (paddle layout)."""
-    q = query.transpose([0, 2, 1, 3])
-    k = key.transpose([0, 2, 1, 3])
-    v = value.transpose([0, 2, 1, 3])
+                                 training=True, name=None,
+                                 qkv_layout="bshd"):
+    """query/key/value: [batch, seq, heads, head_dim] (paddle layout).
+
+    qkv_layout='bhsd' accepts pre-transposed [batch, heads, seq, head_dim]
+    inputs and returns [batch, seq, heads*...] — callers that already hold
+    head-major tensors (packed-QKV attention blocks) skip the per-tensor
+    transposes, which are physical copies around the opaque pallas call.
+    """
+    if qkv_layout == "bhsd":
+        q, k, v = query, key, value
+    else:
+        q = query.transpose([0, 2, 1, 3])
+        k = key.transpose([0, 2, 1, 3])
+        v = value.transpose([0, 2, 1, 3])
     use_dropout = dropout_p > 0.0 and training
     if attn_mask is None and _has_flash():
         # flash handles attention dropout in-kernel (mask regenerated in
@@ -508,7 +518,7 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
         out = apply("scaled_dot_product_attention", q, k, v, attn_mask,
                     key, dropout_p=dropout_p if use_dropout else 0.0,
                     is_causal=is_causal)
-    return out.transpose([0, 2, 1, 3])
+    return out.transpose([0, 2, 1, 3])  # back to [b, s, h, d]
 
 
 def _has_flash():

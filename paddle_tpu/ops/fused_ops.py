@@ -507,6 +507,12 @@ def flash_attention(q, k, v, seed=None, *, is_causal=False, scale=None,
     """
     q, k, v = jnp.asarray(q), jnp.asarray(k), jnp.asarray(v)
     s = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    if dropout_p > 0.0 and (q.shape[2] >= 65536 or k.shape[2] >= 65536):
+        # the dropout PRNG packs (q_off, k_off) into one 32-bit word
+        # (_drop_mask); beyond 2^16 tiles would reuse streams silently
+        raise ValueError(
+            "flash_attention dropout supports seq < 65536; disable "
+            "dropout_p or use ring attention for longer sequences")
     if seed is None:
         seed = jnp.zeros((), jnp.int32)
     else:

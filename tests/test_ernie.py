@@ -1,0 +1,107 @@
+"""ERNIE model family: packed-QKV attention equals a manual reference,
+recompute matches the dense path exactly, pretraining step runs.
+
+Covers the attention-layout fast path (qkv_layout='bhsd' in
+F.scaled_dot_product_attention) and config.recompute.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.nlp.transformers import (
+    ErnieConfig, ErnieForPretraining, ErnieModel,
+    ErniePretrainingCriterion,
+)
+
+
+def _cfg(**kw):
+    base = dict(vocab_size=500, hidden_size=64, num_layers=2, num_heads=4,
+                ffn_hidden_size=128, max_seq_len=32, dropout=0.0,
+                attn_dropout=0.0, use_parallel=False)
+    base.update(kw)
+    return ErnieConfig(**base)
+
+
+def _ids(b=2, s=32, seed=0):
+    return np.random.RandomState(seed).randint(
+        0, 500, (b, s)).astype(np.int32)
+
+
+def test_packed_qkv_attention_matches_manual_reference():
+    paddle.seed(0)
+    m = ErnieModel(_cfg())
+    m.eval()
+    ids = _ids()
+    x = m.embeddings(paddle.to_tensor(ids))
+    attn = m.encoder[0].self_attn
+    got = attn(x).numpy()
+
+    # manual: unpack qkv weights, standard softmax attention
+    qkv = attn.qkv_proj(x).numpy().reshape(2, 32, 3, 4, 16)
+    q, k, v = [np.transpose(qkv[:, :, i], (0, 2, 1, 3)) for i in range(3)]
+    s = np.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(16)
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    ref = np.einsum("bhqk,bhkd->bhqd", p, v)
+    ref = np.transpose(ref, (0, 2, 1, 3)).reshape(2, 32, 64)
+    expect = ref @ attn.out_proj.weight.numpy() + \
+        attn.out_proj.bias.numpy()
+    np.testing.assert_allclose(got, expect, rtol=1e-4, atol=1e-5)
+
+
+def test_recompute_matches_dense_exactly():
+    paddle.seed(1)
+    dense = ErnieModel(_cfg(recompute=False))
+    paddle.seed(1)
+    remat = ErnieModel(_cfg(recompute=True))
+    for k, t in dense.state_dict().items():
+        np.testing.assert_array_equal(t.numpy(),
+                                      remat.state_dict()[k].numpy())
+    dense.eval()
+    remat.eval()
+    ids = _ids(seed=3)
+
+    # compiled path (recompute only applies under tracing)
+    import jax
+
+    from paddle_tpu.engine import functional_call, state_values
+
+    def loss_of(model):
+        values = dict(state_values(model))
+
+        def f(values):
+            seq, _ = functional_call(model, values,
+                                     paddle.to_tensor(ids))
+            return (seq if not isinstance(seq, Tensor)
+                    else seq._value).astype("float32").sum()
+
+        l, g = jax.value_and_grad(f)(values)
+        return float(l), g
+
+    l_dense, g_dense = loss_of(dense)
+    l_remat, g_remat = loss_of(remat)
+    assert abs(l_dense - l_remat) < 1e-4 * max(1.0, abs(l_dense))
+    for k in g_dense:
+        np.testing.assert_allclose(
+            np.asarray(g_dense[k]), np.asarray(g_remat[k]),
+            rtol=1e-4, atol=1e-5, err_msg=f"grad mismatch for {k}")
+
+
+def test_pretraining_step_trains():
+    paddle.seed(2)
+    cfg = _cfg(dropout=0.1, attn_dropout=0.1)
+    model = ErnieForPretraining(cfg)
+    crit = ErniePretrainingCriterion(cfg)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=model.parameters())
+    from paddle_tpu.engine import Engine
+
+    eng = Engine(model, opt, lambda o, l: crit(o[0], o[1], l))
+    ids = _ids(seed=5)
+    losses = [float(np.asarray(eng.train_batch(ids, ids.copy())._value))
+              for _ in range(3)]
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
