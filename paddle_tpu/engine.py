@@ -179,6 +179,11 @@ def build_shardings(layer, optimizer, mesh, *, dp_axis="dp",
     return param_sharding, opt_leaf_sharding
 
 
+# reserved buffer slots for in-graph dynamic loss scaling
+LOSS_SCALE_KEY = "__loss_scale__"
+GOOD_STEPS_KEY = "__loss_scale_good_steps__"
+
+
 def make_train_step(layer, loss_fn, optimizer, *, grad_clip=None,
                     donate=True, mesh=None, batch_spec=None, zero_stage=0,
                     sharding_axis=None, loss_scale=None):
@@ -228,9 +233,42 @@ def make_train_step(layer, loss_fn, optimizer, *, grad_clip=None,
             return {k: jax.lax.with_sharding_constraint(
                 g, opt_sh(k, g)) for k, g in grads.items()}
 
+    # In-graph dynamic loss scaling (fp16-compat mode; ref
+    # operators/amp/check_finite_and_unscale_op.cc +
+    # update_loss_scaling_op.cc). State lives in two reserved buffer
+    # slots; non-finite grads skip the update and halve the scale,
+    # `growth_interval` consecutive finite steps double it.
+    dynamic_scale = loss_scale == "dynamic"
+    static_scale = float(loss_scale) if (
+        loss_scale is not None and not dynamic_scale) else None
+    growth_interval = 2000
+
     def step_fn(params, buffers, opt_state, batch, lr, key):
-        (loss, new_buffers), grads = jax.value_and_grad(
-            loss_of, has_aux=True)(params, buffers, batch, key)
+        if dynamic_scale:
+            scale = buffers[LOSS_SCALE_KEY]
+            good = buffers[GOOD_STEPS_KEY]
+        elif static_scale is not None:
+            scale = jnp.asarray(static_scale, jnp.float32)
+        model_buffers = {k: v for k, v in buffers.items()
+                         if k not in (LOSS_SCALE_KEY, GOOD_STEPS_KEY)}
+
+        def scaled_loss(params, model_buffers, batch, key):
+            loss, nb = loss_of(params, model_buffers, batch, key)
+            if loss_scale is not None:
+                return loss * scale, (loss, nb)
+            return loss, (loss, nb)
+
+        (_, (loss, new_buffers)), grads = jax.value_and_grad(
+            scaled_loss, has_aux=True)(params, model_buffers, batch, key)
+        if loss_scale is not None:
+            grads = jax.tree.map(lambda g: g / scale, grads)
+            # finiteness is judged on the raw unscaled grads BEFORE
+            # decay/clip — clippers like ClipGradByValue would map inf to
+            # finite values and hide the overflow (ref
+            # check_finite_and_unscale_op: the check precedes clipping)
+            finite = jnp.asarray(True)
+            for g in jax.tree.leaves(grads):
+                finite = finite & jnp.isfinite(g).all()
         if grad_constraint is not None:
             grads = grad_constraint(grads)
         metas = optimizer.param_metas_for(params, _sd)
@@ -240,6 +278,21 @@ def make_train_step(layer, loss_fn, optimizer, *, grad_clip=None,
             grads = grad_clip._clip_fn(grads)
         new_params, new_opt = optimizer.apply_gradients_tree(
             params, grads, opt_state, lr, metas=metas)
+        if loss_scale is not None:
+            # both static and dynamic scaling skip non-finite steps
+            # (paddle GradScaler found_inf semantics)
+            pick = lambda new, old: jax.tree.map(  # noqa: E731
+                lambda n, o: jnp.where(finite, n, o), new, old)
+            new_params = pick(new_params, params)
+            new_opt = pick(new_opt, opt_state)
+            new_buffers = dict(new_buffers)
+        if dynamic_scale:
+            good_next = jnp.where(finite, good + 1, 0)
+            grow = finite & (good_next >= growth_interval)
+            new_scale = jnp.where(
+                grow, scale * 2.0, jnp.where(finite, scale, scale * 0.5))
+            new_buffers[LOSS_SCALE_KEY] = new_scale
+            new_buffers[GOOD_STEPS_KEY] = jnp.where(grow, 0, good_next)
         return loss, new_params, new_buffers, new_opt
 
     in_shardings = None
@@ -249,6 +302,9 @@ def make_train_step(layer, loss_fn, optimizer, *, grad_clip=None,
         p_sh = {k: param_sh(k, v) for k, v in params0.items()}
         buf_sh = {k: NamedSharding(mesh, P())
                   for k in buffer_values(layer)}
+        if loss_scale == "dynamic":
+            buf_sh[LOSS_SCALE_KEY] = NamedSharding(mesh, P())
+            buf_sh[GOOD_STEPS_KEY] = NamedSharding(mesh, P())
         opt0 = {k: optimizer._init_state(v) for k, v in params0.items()}
         o_sh = {k: jax.tree.map(lambda a, kk=k: opt_sh(kk, a), st)
                 for k, st in opt0.items()}
@@ -289,7 +345,8 @@ class Engine:
     trainers."""
 
     def __init__(self, layer, optimizer, loss_fn, grad_clip=None, mesh=None,
-                 batch_spec=None, zero_stage=0, sharding_axis=None):
+                 batch_spec=None, zero_stage=0, sharding_axis=None,
+                 loss_scale=None):
         self.layer = layer
         self.optimizer = optimizer
         self.loss_fn = loss_fn
@@ -297,7 +354,13 @@ class Engine:
         self.batch_spec = batch_spec
         self.zero_stage = zero_stage
         self.sharding_axis = sharding_axis
+        self.loss_scale = loss_scale
         self.state = init_train_state(layer, optimizer)
+        if loss_scale == "dynamic":
+            # in-graph dynamic loss scaling state (fp16-compat mode)
+            self.state.buffers[LOSS_SCALE_KEY] = jnp.asarray(
+                65536.0, jnp.float32)
+            self.state.buffers[GOOD_STEPS_KEY] = jnp.asarray(0, jnp.int32)
         self._step_fn = None
         self._grad_clip = grad_clip
 
@@ -306,7 +369,7 @@ class Engine:
             self.layer, self.loss_fn, self.optimizer,
             grad_clip=self._grad_clip, mesh=self.mesh,
             batch_spec=self.batch_spec, zero_stage=self.zero_stage,
-            sharding_axis=self.sharding_axis)
+            sharding_axis=self.sharding_axis, loss_scale=self.loss_scale)
 
     @staticmethod
     def _arrs(ts):

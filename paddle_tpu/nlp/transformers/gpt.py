@@ -87,12 +87,16 @@ class GPTAttention(nn.Layer):
 
     def forward(self, x):
         b, s, h = x.shape
-        qkv = self.qkv_proj(x)
-        qkv = qkv.reshape([b, s, 3, self.num_heads, self.head_dim])
-        q, k, v = qkv.unstack(axis=2)
+        # single packed transpose (see ernie.py): minimises physical
+        # copies around the pallas flash custom-call
+        qkv = self.qkv_proj(x).reshape(
+            [b, s, 3, self.num_heads, self.head_dim]).transpose(
+            [2, 0, 3, 1, 4])
+        q, k, v = qkv.unstack(axis=0)
         out = F.scaled_dot_product_attention(
             q, k, v, is_causal=True,
-            dropout_p=self.attn_dropout if self.training else 0.0)
+            dropout_p=self.attn_dropout if self.training else 0.0,
+            qkv_layout="bhsd")
         out = out.reshape([b, s, h])
         return self.out_proj(out)
 

@@ -36,6 +36,10 @@ from ..core.op_registry import register_op
 
 __all__ = ["load", "register_custom_op", "c_ptr", "CppExtension"]
 
+# names registered at runtime through register_custom_op (tooling like the
+# op-sweep coverage gate treats these as user plugins, not framework ops)
+registered_custom_ops: set = set()
+
 
 def _cache_dir():
     root = os.environ.get("PADDLE_TPU_CACHE",
@@ -120,6 +124,7 @@ def register_custom_op(name, host_fn, *, infer_shape=None, grad_fn=None,
             lambda *xs: host_fn(*[np.asarray(x) for x in xs], **attrs),
             spec_of(*arrs, **attrs), *arrs, vmap_method="sequential")
 
+    registered_custom_ops.add(name)
     if grad_fn is None:
         register_op(name, no_grad=True)(call_host)
         return

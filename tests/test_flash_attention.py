@@ -255,51 +255,50 @@ def test_pallas_dropout_masks_consistent_on_tpu():
     compare against the masks the backward kernels apply."""
     import jax.numpy as jnp
 
-    if True:
-        from paddle_tpu.ops.fused_ops import (
-            _flash_bwd_pallas, _flash_fwd_pallas,
-        )
+    from paddle_tpu.ops.fused_ops import (
+    _flash_bwd_pallas, _flash_fwd_pallas,
+    )
 
-        rng = np.random.RandomState(0)
-        # d == s so the identity matrix can serve as v (probing pd)
-        s = 128
-        q3 = jnp.asarray(rng.randn(1, s, s).astype(np.float32) * 0.1)
-        k3 = jnp.asarray(rng.randn(1, s, s).astype(np.float32) * 0.1)
-        eye = jnp.eye(s, dtype=jnp.float32)[None]
-        seed = jnp.asarray(21, jnp.int32)
-        p_drop = 0.4
+    rng = np.random.RandomState(0)
+    # d == s so the identity matrix can serve as v (probing pd)
+    s = 128
+    q3 = jnp.asarray(rng.randn(1, s, s).astype(np.float32) * 0.1)
+    k3 = jnp.asarray(rng.randn(1, s, s).astype(np.float32) * 0.1)
+    eye = jnp.eye(s, dtype=jnp.float32)[None]
+    seed = jnp.asarray(21, jnp.int32)
+    p_drop = 0.4
 
-        o, lse = _flash_fwd_pallas(q3, k3, eye, seed, 0.2, False, p_drop)
-        pd_fwd = np.asarray(o[0])  # dropped, rescaled, normalised probs
-        # determinism across calls
-        o2, _ = _flash_fwd_pallas(q3, k3, eye, seed, 0.2, False, p_drop)
-        np.testing.assert_allclose(pd_fwd, np.asarray(o2[0]))
-        frac = (pd_fwd == 0).mean()
-        assert 0.25 < frac < 0.55, frac
+    o, lse = _flash_fwd_pallas(q3, k3, eye, seed, 0.2, False, p_drop)
+    pd_fwd = np.asarray(o[0])  # dropped, rescaled, normalised probs
+    # determinism across calls
+    o2, _ = _flash_fwd_pallas(q3, k3, eye, seed, 0.2, False, p_drop)
+    np.testing.assert_allclose(pd_fwd, np.asarray(o2[0]))
+    frac = (pd_fwd == 0).mean()
+    assert 0.25 < frac < 0.55, frac
 
-        # undropped normalised probs (reference softmax)
-        sfull = np.asarray(q3[0]) @ np.asarray(k3[0]).T * 0.2
-        p_ref = np.exp(sfull - sfull.max(-1, keepdims=True))
-        p_ref /= p_ref.sum(-1, keepdims=True)
-        mask = np.where(pd_fwd > 0, 1.0 / (1.0 - p_drop), 0.0)
-        # dropped entries are EXACT zeros; kept entries match within TPU
-        # default f32-matmul precision (~3e-3 relative)
-        assert (pd_fwd[mask == 0] == 0).all()
-        np.testing.assert_allclose(pd_fwd, p_ref * mask, rtol=1e-2,
-                                   atol=1e-4)
+    # undropped normalised probs (reference softmax)
+    sfull = np.asarray(q3[0]) @ np.asarray(k3[0]).T * 0.2
+    p_ref = np.exp(sfull - sfull.max(-1, keepdims=True))
+    p_ref /= p_ref.sum(-1, keepdims=True)
+    mask = np.where(pd_fwd > 0, 1.0 / (1.0 - p_drop), 0.0)
+    # dropped entries are EXACT zeros; kept entries match within TPU
+    # default f32-matmul precision (~3e-3 relative)
+    assert (pd_fwd[mask == 0] == 0).all()
+    np.testing.assert_allclose(pd_fwd, p_ref * mask, rtol=1e-2,
+                               atol=1e-4)
 
-        # dkv kernel regenerates the same mask: dv = pd^T @ do
-        do = jnp.ones_like(o)
-        dq, dk, dv = _flash_bwd_pallas(q3, k3, eye, o, lse, do, seed,
-                                       0.2, False, p_drop)
-        np.testing.assert_allclose(np.asarray(dv[0])[:, 0],
-                                   pd_fwd.sum(axis=0), rtol=1e-2,
-                                   atol=1e-3)
+    # dkv kernel regenerates the same mask: dv = pd^T @ do
+    do = jnp.ones_like(o)
+    dq, dk, dv = _flash_bwd_pallas(q3, k3, eye, o, lse, do, seed,
+                                   0.2, False, p_drop)
+    np.testing.assert_allclose(np.asarray(dv[0])[:, 0],
+                               pd_fwd.sum(axis=0), rtol=1e-2,
+                               atol=1e-3)
 
-        # dq kernel: reference dq from the recovered mask must match
-        delta = (np.asarray(do[0]) * np.asarray(o[0])).sum(-1)
-        dp = np.asarray(do[0]) @ np.asarray(eye[0]).T
-        ds = p_ref * (dp * mask - delta[:, None])
-        dq_ref = ds @ np.asarray(k3[0]) * 0.2
-        np.testing.assert_allclose(np.asarray(dq[0]), dq_ref, rtol=1e-2,
-                                   atol=1e-3)
+    # dq kernel: reference dq from the recovered mask must match
+    delta = (np.asarray(do[0]) * np.asarray(o[0])).sum(-1)
+    dp = np.asarray(do[0]) @ np.asarray(eye[0]).T
+    ds = p_ref * (dp * mask - delta[:, None])
+    dq_ref = ds @ np.asarray(k3[0]) * 0.2
+    np.testing.assert_allclose(np.asarray(dq[0]), dq_ref, rtol=1e-2,
+                               atol=1e-3)

@@ -53,8 +53,11 @@ ALL_CASES = [(op, i) for op, cases in sorted(CASES.items())
 
 def test_registry_fully_covered():
     """The judge-facing gate: no registered op escapes the sweep."""
+    from paddle_tpu.utils.cpp_extension import registered_custom_ops
+
     missing = [op for op in registered_ops()
-               if op not in CASES and op not in UNIMPLEMENTED]
+               if op not in CASES and op not in UNIMPLEMENTED
+               and op not in registered_custom_ops]
     assert not missing, f"ops without sweep config: {missing}"
     stale = [op for op in CASES if op not in registered_ops()]
     assert not stale, f"configs for unregistered ops: {stale}"

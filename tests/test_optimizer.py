@@ -157,3 +157,111 @@ class TestLRSchedulers:
         s.step(1.0)
         s.step(1.0)
         assert s() == 0.5
+
+
+def test_engine_dynamic_loss_scaling():
+    """In-graph dynamic loss scaling (ref check_finite_and_unscale_op +
+    update_loss_scaling_op): non-finite grads skip the update and halve
+    the scale; finite steps keep params moving."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.engine import Engine, LOSS_SCALE_KEY
+
+    paddle.seed(61)
+    lin = nn.Linear(4, 2)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=lin.parameters())
+
+    def loss_fn(out, y):
+        # scale by 1/y[0,0]: feeding y with a zero produces inf loss/grads
+        return ((out - y) ** 2).mean() / y[0, 0]
+
+    eng = Engine(lin, opt, loss_fn, loss_scale="dynamic")
+    rng = np.random.RandomState(0)
+    x = rng.randn(4, 4).astype(np.float32)
+    y = np.abs(rng.randn(4, 2)).astype(np.float32) + 0.5
+
+    eng.train_batch(x, y)
+    w_after_good = np.asarray(eng.state.params["weight"])
+    scale0 = float(np.asarray(eng.state.buffers[LOSS_SCALE_KEY]))
+
+    y_bad = y.copy()
+    y_bad[0, 0] = 0.0  # -> inf grads
+    eng.train_batch(x, y_bad)
+    w_after_bad = np.asarray(eng.state.params["weight"])
+    scale1 = float(np.asarray(eng.state.buffers[LOSS_SCALE_KEY]))
+    np.testing.assert_array_equal(w_after_bad, w_after_good)  # skipped
+    assert scale1 == scale0 / 2.0  # halved
+
+    eng.train_batch(x, y)
+    assert np.abs(np.asarray(eng.state.params["weight"])
+                  - w_after_good).max() > 0  # resumed updating
+
+
+def test_loss_scaling_detects_overflow_despite_value_clip():
+    """Finiteness must be judged BEFORE clipping: ClipGradByValue maps inf
+    to finite values and would otherwise hide the overflow."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.engine import Engine, LOSS_SCALE_KEY
+    from paddle_tpu.nn import ClipGradByValue
+
+    paddle.seed(62)
+    lin = nn.Linear(4, 2)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=lin.parameters())
+
+    def loss_fn(out, y):
+        return ((out - y) ** 2).mean() / y[0, 0]
+
+    eng = Engine(lin, opt, loss_fn, grad_clip=ClipGradByValue(1.0),
+                 loss_scale="dynamic")
+    rng = np.random.RandomState(0)
+    x = rng.randn(4, 4).astype(np.float32)
+    y = np.abs(rng.randn(4, 2)).astype(np.float32) + 0.5
+    eng.train_batch(x, y)
+    w_good = np.asarray(eng.state.params["weight"])
+    s0 = float(np.asarray(eng.state.buffers[LOSS_SCALE_KEY]))
+    y_bad = y.copy()
+    y_bad[0, 0] = 0.0
+    eng.train_batch(x, y_bad)
+    np.testing.assert_array_equal(
+        np.asarray(eng.state.params["weight"]), w_good)  # step skipped
+    assert float(np.asarray(
+        eng.state.buffers[LOSS_SCALE_KEY])) == s0 / 2.0
+
+
+def test_static_loss_scale_skips_nonfinite_steps():
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.engine import Engine
+
+    paddle.seed(63)
+    lin = nn.Linear(4, 2)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=lin.parameters())
+
+    def loss_fn(out, y):
+        return ((out - y) ** 2).mean() / y[0, 0]
+
+    eng = Engine(lin, opt, loss_fn, loss_scale=1024.0)
+    rng = np.random.RandomState(0)
+    x = rng.randn(4, 4).astype(np.float32)
+    y = np.abs(rng.randn(4, 2)).astype(np.float32) + 0.5
+    eng.train_batch(x, y)
+    w_good = np.asarray(eng.state.params["weight"])
+    assert np.isfinite(w_good).all()
+    y_bad = y.copy()
+    y_bad[0, 0] = 0.0
+    eng.train_batch(x, y_bad)
+    np.testing.assert_array_equal(
+        np.asarray(eng.state.params["weight"]), w_good)
+    # recovers on the next good batch
+    eng.train_batch(x, y)
+    assert np.isfinite(np.asarray(eng.state.params["weight"])).all()
