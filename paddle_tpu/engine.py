@@ -182,6 +182,12 @@ def build_shardings(layer, optimizer, mesh, *, dp_axis="dp",
 # reserved buffer slots for in-graph dynamic loss scaling
 LOSS_SCALE_KEY = "__loss_scale__"
 GOOD_STEPS_KEY = "__loss_scale_good_steps__"
+BAD_STEPS_KEY = "__loss_scale_bad_steps__"
+
+# paddle GradScaler defaults (ref python/paddle/amp/grad_scaler.py)
+DEFAULT_SCALE_CONFIG = dict(
+    init_loss_scaling=2.0 ** 15, incr_ratio=2.0, decr_ratio=0.5,
+    incr_every_n_steps=1000, decr_every_n_nan_or_inf=2)
 
 
 def make_train_step(layer, loss_fn, optimizer, *, grad_clip=None,
@@ -235,22 +241,32 @@ def make_train_step(layer, loss_fn, optimizer, *, grad_clip=None,
 
     # In-graph dynamic loss scaling (fp16-compat mode; ref
     # operators/amp/check_finite_and_unscale_op.cc +
-    # update_loss_scaling_op.cc). State lives in two reserved buffer
-    # slots; non-finite grads skip the update and halve the scale,
-    # `growth_interval` consecutive finite steps double it.
-    dynamic_scale = loss_scale == "dynamic"
+    # update_loss_scaling_op.cc, python/paddle/amp/grad_scaler.py
+    # defaults). State lives in reserved buffer slots; the scale decays
+    # after `decr_every_n_nan_or_inf` CONSECUTIVE non-finite steps and
+    # grows after `incr_every_n_steps` consecutive finite ones.
+    # `loss_scale` may be: None | float (static) | "dynamic" | dict of
+    # GradScaler knobs.
+    scale_cfg = dict(DEFAULT_SCALE_CONFIG)
+    if isinstance(loss_scale, dict):
+        scale_cfg.update(loss_scale)
+        dynamic_scale = True
+    else:
+        dynamic_scale = loss_scale == "dynamic"
     static_scale = float(loss_scale) if (
-        loss_scale is not None and not dynamic_scale) else None
-    growth_interval = 2000
+        loss_scale is not None and not dynamic_scale
+        and not isinstance(loss_scale, dict)) else None
 
     def step_fn(params, buffers, opt_state, batch, lr, key):
         if dynamic_scale:
             scale = buffers[LOSS_SCALE_KEY]
             good = buffers[GOOD_STEPS_KEY]
+            bad = buffers[BAD_STEPS_KEY]
         elif static_scale is not None:
             scale = jnp.asarray(static_scale, jnp.float32)
         model_buffers = {k: v for k, v in buffers.items()
-                         if k not in (LOSS_SCALE_KEY, GOOD_STEPS_KEY)}
+                         if k not in (LOSS_SCALE_KEY, GOOD_STEPS_KEY,
+                                      BAD_STEPS_KEY)}
 
         def scaled_loss(params, model_buffers, batch, key):
             loss, nb = loss_of(params, model_buffers, batch, key)
@@ -288,11 +304,16 @@ def make_train_step(layer, loss_fn, optimizer, *, grad_clip=None,
             new_buffers = dict(new_buffers)
         if dynamic_scale:
             good_next = jnp.where(finite, good + 1, 0)
-            grow = finite & (good_next >= growth_interval)
+            bad_next = jnp.where(finite, 0, bad + 1)
+            grow = finite & (good_next >= scale_cfg["incr_every_n_steps"])
+            shrink = (~finite) & (
+                bad_next >= scale_cfg["decr_every_n_nan_or_inf"])
             new_scale = jnp.where(
-                grow, scale * 2.0, jnp.where(finite, scale, scale * 0.5))
+                grow, scale * scale_cfg["incr_ratio"],
+                jnp.where(shrink, scale * scale_cfg["decr_ratio"], scale))
             new_buffers[LOSS_SCALE_KEY] = new_scale
             new_buffers[GOOD_STEPS_KEY] = jnp.where(grow, 0, good_next)
+            new_buffers[BAD_STEPS_KEY] = jnp.where(shrink, 0, bad_next)
         return loss, new_params, new_buffers, new_opt
 
     in_shardings = None
@@ -302,9 +323,10 @@ def make_train_step(layer, loss_fn, optimizer, *, grad_clip=None,
         p_sh = {k: param_sh(k, v) for k, v in params0.items()}
         buf_sh = {k: NamedSharding(mesh, P())
                   for k in buffer_values(layer)}
-        if loss_scale == "dynamic":
+        if loss_scale == "dynamic" or isinstance(loss_scale, dict):
             buf_sh[LOSS_SCALE_KEY] = NamedSharding(mesh, P())
             buf_sh[GOOD_STEPS_KEY] = NamedSharding(mesh, P())
+            buf_sh[BAD_STEPS_KEY] = NamedSharding(mesh, P())
         opt0 = {k: optimizer._init_state(v) for k, v in params0.items()}
         o_sh = {k: jax.tree.map(lambda a, kk=k: opt_sh(kk, a), st)
                 for k, st in opt0.items()}
@@ -356,11 +378,15 @@ class Engine:
         self.sharding_axis = sharding_axis
         self.loss_scale = loss_scale
         self.state = init_train_state(layer, optimizer)
-        if loss_scale == "dynamic":
+        if loss_scale == "dynamic" or isinstance(loss_scale, dict):
             # in-graph dynamic loss scaling state (fp16-compat mode)
+            cfg = dict(DEFAULT_SCALE_CONFIG)
+            if isinstance(loss_scale, dict):
+                cfg.update(loss_scale)
             self.state.buffers[LOSS_SCALE_KEY] = jnp.asarray(
-                65536.0, jnp.float32)
+                float(cfg["init_loss_scaling"]), jnp.float32)
             self.state.buffers[GOOD_STEPS_KEY] = jnp.asarray(0, jnp.int32)
+            self.state.buffers[BAD_STEPS_KEY] = jnp.asarray(0, jnp.int32)
         self._step_fn = None
         self._grad_clip = grad_clip
 

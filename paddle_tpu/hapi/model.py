@@ -26,6 +26,9 @@ class Model:
         self._engine = None
         self.stop_training = False
         self._compiled_mode = True  # compile steps via the engine
+        self._amp_level = None
+        self._amp_dtype = "bfloat16"
+        self._loss_scale = None
 
     # -- prepare -------------------------------------------------------------
     def prepare(self, optimizer=None, loss=None, metrics=None,
@@ -36,13 +39,52 @@ class Model:
             self._metrics = metrics if isinstance(metrics, (list, tuple)) \
                 else [metrics]
         self._compiled_mode = jit_compile
+        # amp_configs (ref hapi/model.py prepare + amp/grad_scaler.py):
+        # 'O1'/'O2' level enables autocast around the compiled step;
+        # loss-scaling knobs flow to the engine's in-graph scaler
+        self._amp_level = None
+        self._amp_dtype = "bfloat16"
+        self._loss_scale = None
+        if amp_configs is not None:
+            if isinstance(amp_configs, str):
+                amp_configs = {"level": amp_configs}
+            cfg = dict(amp_configs)
+            self._amp_level = cfg.pop("level", "O1")
+            self._amp_dtype = cfg.pop("dtype", "bfloat16")
+            if self._amp_level in ("O0", None):
+                self._amp_level = None
+            if cfg.pop("use_dynamic_loss_scaling", True):
+                knobs = {k: v for k, v in cfg.items()
+                         if k in ("init_loss_scaling", "incr_ratio",
+                                  "decr_ratio", "incr_every_n_steps",
+                                  "decr_every_n_nan_or_inf")}
+                self._loss_scale = knobs if knobs else "dynamic"
+            else:
+                self._loss_scale = float(
+                    cfg.get("init_loss_scaling", 2.0 ** 15))
+            if self._amp_dtype == "bfloat16" and \
+                    "init_loss_scaling" not in (amp_configs or {}):
+                # bf16 has fp32's exponent range: scaling is unnecessary
+                # unless explicitly configured (paddle bf16 semantics)
+                self._loss_scale = None
         return self
 
     # -- single-batch APIs ---------------------------------------------------
     def _ensure_engine(self):
         if self._engine is None:
-            self._engine = Engine(self.network, self._optimizer, self._loss)
+            self._engine = Engine(self.network, self._optimizer, self._loss,
+                                  loss_scale=self._loss_scale)
         return self._engine
+
+    def _amp_scope(self):
+        import contextlib
+
+        if self._amp_level is None:
+            return contextlib.nullcontext()
+        from .. import amp
+
+        return amp.auto_cast(enable=True, dtype=self._amp_dtype,
+                             level=self._amp_level)
 
     def train_batch(self, inputs, labels=None, update=True):
         inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
@@ -50,7 +92,8 @@ class Model:
             labels, (list, tuple)) else [labels]
         if self._compiled_mode:
             eng = self._ensure_engine()
-            loss = eng.train_batch(inputs, labels or ())
+            with self._amp_scope():
+                loss = eng.train_batch(inputs, labels or ())
             return [float(loss.item())]
         # eager path
         self.network.train()
@@ -213,6 +256,42 @@ class Model:
         lines.append(f"Trainable params: {trainable}")
         print("\n".join(lines))
         return {"total_params": total, "trainable_params": trainable}
+
+    def flops(self, input_spec=None):
+        """Analytic forward FLOPs for one input (ref hapi flops/paddle.flops).
+
+        Counted from XLA's own cost analysis of the traced forward —
+        exact for whatever the model actually computes, no per-layer
+        bookkeeping. `input_spec`: list of InputSpec/arrays; falls back
+        to self._inputs from prepare()."""
+        import jax
+
+        from ..engine import functional_call, state_values
+        from ..jit import InputSpec
+
+        spec = input_spec if input_spec is not None else self._inputs
+        if spec is None:
+            raise ValueError(
+                "flops() needs input_spec (or Model(..., inputs=...))")
+        shapes = []
+        for s in spec:
+            if isinstance(s, InputSpec):
+                shapes.append(s.to_shape_dtype())
+            else:
+                arr = np.asarray(s)
+                shapes.append(jax.ShapeDtypeStruct(arr.shape, arr.dtype))
+        values = dict(state_values(self.network))
+
+        def run(values, *args):
+            return functional_call(self.network, values, *args)
+
+        lowered = jax.jit(run).lower(
+            jax.tree.map(lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+                         values), *shapes)
+        # HLO cost analysis without compiling (compilation would take
+        # seconds-to-minutes on large models just to read a count)
+        cost = lowered.cost_analysis()
+        return int(cost.get("flops", 0)) if cost else 0
 
 
 def _as_tensor(x):

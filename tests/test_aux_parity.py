@@ -250,3 +250,19 @@ def test_localsgd_single_process_is_plain_sgd():
         opt.clear_grad()
     assert opt._local_steps == 4
     assert np.isfinite(lin.weight.numpy()).all()
+
+
+def test_hapi_flops_and_summary():
+    """Model.flops (XLA cost analysis of the traced forward) + summary
+    (ref hapi/model.py summary/flops)."""
+    import paddle_tpu.nn as nn
+    from paddle_tpu.jit import InputSpec
+
+    paddle.seed(7)
+    m = paddle.Model(nn.Sequential(nn.Linear(8, 16), nn.ReLU(),
+                                   nn.Linear(16, 4)))
+    f = m.flops(input_spec=[InputSpec([2, 8], "float32")])
+    # two matmuls: 2*(2*8*16) + 2*(2*16*4) = 768, plus bias adds
+    assert 700 <= f <= 1200, f
+    s = m.summary()
+    assert s["total_params"] == 8 * 16 + 16 + 16 * 4 + 4

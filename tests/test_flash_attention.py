@@ -256,7 +256,7 @@ def test_pallas_dropout_masks_consistent_on_tpu():
     import jax.numpy as jnp
 
     from paddle_tpu.ops.fused_ops import (
-    _flash_bwd_pallas, _flash_fwd_pallas,
+        _flash_bwd_pallas, _flash_fwd_pallas,
     )
 
     rng = np.random.RandomState(0)

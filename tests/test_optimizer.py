@@ -178,7 +178,8 @@ def test_engine_dynamic_loss_scaling():
         # scale by 1/y[0,0]: feeding y with a zero produces inf loss/grads
         return ((out - y) ** 2).mean() / y[0, 0]
 
-    eng = Engine(lin, opt, loss_fn, loss_scale="dynamic")
+    eng = Engine(lin, opt, loss_fn,
+                 loss_scale={"decr_every_n_nan_or_inf": 1})
     rng = np.random.RandomState(0)
     x = rng.randn(4, 4).astype(np.float32)
     y = np.abs(rng.randn(4, 2)).astype(np.float32) + 0.5
@@ -219,7 +220,7 @@ def test_loss_scaling_detects_overflow_despite_value_clip():
         return ((out - y) ** 2).mean() / y[0, 0]
 
     eng = Engine(lin, opt, loss_fn, grad_clip=ClipGradByValue(1.0),
-                 loss_scale="dynamic")
+                 loss_scale={"decr_every_n_nan_or_inf": 1})
     rng = np.random.RandomState(0)
     x = rng.randn(4, 4).astype(np.float32)
     y = np.abs(rng.randn(4, 2)).astype(np.float32) + 0.5
@@ -265,3 +266,37 @@ def test_static_loss_scale_skips_nonfinite_steps():
     # recovers on the next good batch
     eng.train_batch(x, y)
     assert np.isfinite(np.asarray(eng.state.params["weight"])).all()
+
+
+def test_dynamic_scale_decays_after_consecutive_bad_steps_only():
+    """paddle GradScaler semantics: isolated overflow steps keep the
+    scale; decr_every_n_nan_or_inf consecutive ones halve it."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.engine import Engine, LOSS_SCALE_KEY
+
+    paddle.seed(64)
+    lin = nn.Linear(4, 2)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=lin.parameters())
+
+    def loss_fn(out, y):
+        return ((out - y) ** 2).mean() / y[0, 0]
+
+    eng = Engine(lin, opt, loss_fn, loss_scale="dynamic")  # default: 2
+    rng = np.random.RandomState(0)
+    x = rng.randn(4, 4).astype(np.float32)
+    y = np.abs(rng.randn(4, 2)).astype(np.float32) + 0.5
+    y_bad = y.copy()
+    y_bad[0, 0] = 0.0
+    s0 = 2.0 ** 15
+    eng.train_batch(x, y_bad)  # 1 bad -> hold
+    assert float(np.asarray(eng.state.buffers[LOSS_SCALE_KEY])) == s0
+    eng.train_batch(x, y)      # finite resets the streak
+    eng.train_batch(x, y_bad)  # 1 bad -> hold
+    assert float(np.asarray(eng.state.buffers[LOSS_SCALE_KEY])) == s0
+    eng.train_batch(x, y_bad)  # 2 consecutive -> halve
+    assert float(np.asarray(
+        eng.state.buffers[LOSS_SCALE_KEY])) == s0 / 2.0
