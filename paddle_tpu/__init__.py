@@ -124,17 +124,25 @@ __version__ = "0.1.0"
 
 
 def disable_static(place=None):
-    """No-op: this framework is always imperative (compiled via jit)."""
+    """Leave static-graph (op capture) mode; eager execution resumes."""
+    from .static.program import _disable_static
+
+    _disable_static()
 
 
 def enable_static():
-    raise NotImplementedError(
-        "paddle_tpu has no separate static-graph mode; use paddle_tpu.jit "
-        "/ the functional engine for compiled execution")
+    """Enter static-graph mode: paddle ops called on `static.data`
+    Variables record into the default main Program instead of executing
+    (ref fluid/framework.py enable_static). Run with static.Executor."""
+    from .static.program import _enable_static
+
+    _enable_static()
 
 
 def in_dynamic_mode():
-    return True
+    from .static.program import in_static_mode
+
+    return not in_static_mode()
 
 
 def backward(tensors, grad_tensors=None, retain_graph=False):

@@ -103,6 +103,13 @@ def _as_primal(x):
 
 _profiler_mod = None
 
+# static-graph capture hook — set by paddle_tpu.static.program when
+# enable_static() is active; returns NotImplemented to fall through to
+# eager execution (ref: the reference routes the same op calls to either
+# the dygraph tracer or ProgramDesc building, fluid/framework.py:185
+# in_dygraph_mode switch)
+_capture_fn = None
+
 
 def apply(op_name, *inputs, **attrs):
     """Run op `op_name` on `inputs` (Tensors / arrays / scalars).
@@ -123,6 +130,11 @@ def apply(op_name, *inputs, **attrs):
 
 def _apply_impl(op_name, inputs, attrs):
     from .tensor import Tensor
+
+    if _capture_fn is not None:
+        captured = _capture_fn(op_name, inputs, attrs)
+        if captured is not NotImplemented:
+            return captured
 
     opdef = lookup(op_name)
     tensor_inputs = tuple(x if isinstance(x, Tensor) else None for x in inputs)

@@ -62,10 +62,14 @@ class Model:
             else:
                 self._loss_scale = float(
                     cfg.get("init_loss_scaling", 2.0 ** 15))
-            if self._amp_dtype == "bfloat16" and \
-                    "init_loss_scaling" not in (amp_configs or {}):
+            scaler_knobs = ("init_loss_scaling", "incr_ratio", "decr_ratio",
+                            "incr_every_n_steps", "decr_every_n_nan_or_inf",
+                            "use_dynamic_loss_scaling")
+            if self._amp_dtype == "bfloat16" and not any(
+                    k in amp_configs for k in scaler_knobs):
                 # bf16 has fp32's exponent range: scaling is unnecessary
-                # unless explicitly configured (paddle bf16 semantics)
+                # unless any scaler knob was explicitly configured
+                # (paddle bf16 semantics)
                 self._loss_scale = None
         return self
 

@@ -381,6 +381,12 @@ def mse_loss(input, label, reduction="mean", name=None):
     return apply("mse_loss", input, label, reduction=reduction)
 
 
+def square_error_cost(input, label):
+    """Elementwise (input - label)^2, unreduced
+    (ref python/paddle/nn/functional/loss.py square_error_cost)."""
+    return apply("mse_loss", input, label, reduction="none")
+
+
 def l1_loss(input, label, reduction="mean", name=None):
     return apply("l1_loss", input, label, reduction=reduction)
 

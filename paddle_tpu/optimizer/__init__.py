@@ -147,8 +147,63 @@ class Optimizer:
 
     def minimize(self, loss, startup_program=None, parameters=None,
                  no_grad_set=None):
+        from ..static.program import Variable as _StaticVar
+
+        if isinstance(loss, _StaticVar):
+            return self._minimize_static(loss, parameters, no_grad_set)
         self.step()
         return None, [(p, p.grad) for p in self._parameter_list or []]
+
+    def _minimize_static(self, loss, parameters=None, no_grad_set=None):
+        """Static-graph path: record @backward + @update ops into the
+        default main program (ref fleet/static optimizer.minimize —
+        program rewriting instead of eager stepping)."""
+        from ..clip import (
+            ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue,
+        )
+        from ..static import program as sp
+
+        plist = parameters if parameters is not None \
+            else self._parameter_list
+        pairs = sp.append_backward(loss, plist, no_grad_set)
+        per_grad_clip = None
+        if isinstance(self._grad_clip, ClipGradByGlobalNorm):
+            sp.append_global_norm_clip(pairs, self._grad_clip.clip_norm)
+        elif isinstance(self._grad_clip, ClipGradByNorm):
+            per_grad_clip = ("norm", self._grad_clip.clip_norm)
+        elif isinstance(self._grad_clip, ClipGradByValue):
+            per_grad_clip = ("value", self._grad_clip.min,
+                             self._grad_clip.max)
+        elif self._grad_clip is not None:
+            raise NotImplementedError(
+                f"grad_clip {type(self._grad_clip).__name__} is not "
+                "supported in the static path")
+
+        # map grad vars back to the eager Parameters (for per-param lr /
+        # regularizer attrs) via the program's intern table
+        prog = sp.default_main_program()
+        var_to_eager = {}
+        for t in (plist or []):
+            if isinstance(t, Tensor):
+                hit = prog._interned.get(id(t))
+                if hit is not None:
+                    var_to_eager[id(hit[1])] = t
+        for pvar, gvar in pairs:
+            eager = var_to_eager.get(id(pvar))
+            lr_scale = 1.0
+            coeff = 0.0
+            if eager is not None:
+                lr_scale = getattr(eager, "optimize_attr",
+                                   {}).get("learning_rate", 1.0)
+                decay = getattr(eager, "regularizer", None) \
+                    or self._weight_decay
+            else:
+                decay = self._weight_decay
+            if decay is not None and not self._decoupled_weight_decay():
+                coeff = decay.coeff
+            sp.append_optimizer_update(self, pvar, gvar, lr_scale, coeff,
+                                       clip=per_grad_clip)
+        return None, pairs
 
     # -- persistence ---------------------------------------------------------
     def state_dict(self):

@@ -1,15 +1,35 @@
-"""paddle.static — static-graph-style surface.
+"""paddle.static — static-graph surface.
 
-Ref parity: python/paddle/static/__init__.py. On TPU there is no separate
-Program/Executor runtime — `paddle.jit.to_static` capture plays that role
-— but the static namespace keeps API compatibility: control-flow ops
-(`nn.cond`, `nn.while_loop`, ...) lower to XLA control flow, and InputSpec
-re-exports from paddle.jit.
+Ref parity: python/paddle/static/__init__.py + fluid/framework.py +
+fluid/executor.py. TPU-native: Program building is an op-capture mode in
+the eager dispatch funnel (see program.py); Executor.run compiles the
+recorded block into ONE XLA computation, with persistable state in a
+Scope across runs. Control-flow ops (`nn.cond`, `nn.while_loop`, ...)
+lower to XLA control flow.
 """
 
 from __future__ import annotations
 
 from ..jit import InputSpec  # noqa: F401
 from . import nn  # noqa: F401
+from .program import (  # noqa: F401
+    Block, CompiledProgram, Executor, OpDesc, Program, Scope, Variable,
+    append_backward, data, default_main_program, default_startup_program,
+    global_scope, load, load_inference_model, program_guard, save,
+    save_inference_model, scope_guard,
+)
 
-__all__ = ["InputSpec", "nn"]
+# re-export the control-flow ops at the paddle.static.nn level they live
+# at in the reference
+cond = nn.cond
+while_loop = nn.while_loop
+case = nn.case
+switch_case = nn.switch_case
+
+__all__ = [
+    "InputSpec", "nn", "Program", "Block", "OpDesc", "Variable", "Scope",
+    "Executor", "CompiledProgram", "program_guard", "scope_guard",
+    "default_main_program", "default_startup_program", "global_scope",
+    "data", "append_backward", "save", "load", "save_inference_model",
+    "load_inference_model", "cond", "while_loop", "case", "switch_case",
+]

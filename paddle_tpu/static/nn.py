@@ -15,7 +15,86 @@ import jax.numpy as jnp
 
 from ..core.tensor import Tensor
 
-__all__ = ["cond", "while_loop", "switch_case", "case"]
+__all__ = ["cond", "while_loop", "switch_case", "case", "fc", "embedding",
+           "conv2d", "batch_norm"]
+
+
+# ---------------------------------------------------------------------------
+# layer builders (ref python/paddle/static/nn/common.py fc, conv2d, ...).
+# Each call creates fresh eager parameters (the "startup program") and runs
+# the forward — under enable_static the ops record into the main program
+# and the parameters are interned as persistable vars.
+# ---------------------------------------------------------------------------
+
+
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+       activation=None, name=None):
+    import numpy as _np
+
+    from ..nn import Linear
+    from ..nn import functional as F
+
+    in_features = int(_np.prod(x.shape[num_flatten_dims:]))
+    layer = Linear(in_features, size, weight_attr=weight_attr,
+                   bias_attr=bias_attr)
+    h = x
+    if x.ndim > num_flatten_dims + 1:
+        from ..tensor.manipulation import reshape
+
+        # -1 for the leading dims: capture-time shapes may carry
+        # placeholder batch dims (None -> 1), so never bake them into the
+        # recorded reshape attr
+        h = reshape(h, [-1] * num_flatten_dims + [in_features]) \
+            if num_flatten_dims == 1 else \
+            reshape(h, list(x.shape[:num_flatten_dims]) + [in_features])
+    out = layer(h)
+    if activation is not None:
+        out = getattr(F, activation)(out)
+    return out
+
+
+def embedding(input, size, is_sparse=False, padding_idx=None,
+              weight_attr=None, name=None):
+    from ..nn import Embedding
+
+    layer = Embedding(size[0], size[1], padding_idx=padding_idx,
+                      weight_attr=weight_attr, sparse=is_sparse)
+    return layer(input)
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, act=None, name=None,
+           data_format="NCHW"):
+    from ..nn import Conv2D
+    from ..nn import functional as F
+
+    in_channels = input.shape[1 if data_format == "NCHW" else -1]
+    layer = Conv2D(in_channels, num_filters, filter_size, stride=stride,
+                   padding=padding, dilation=dilation, groups=groups,
+                   weight_attr=param_attr, bias_attr=bias_attr,
+                   data_format=data_format)
+    out = layer(input)
+    if act is not None:
+        out = getattr(F, act)(out)
+    return out
+
+
+def batch_norm(input, act=None, momentum=0.9, epsilon=1e-05,
+               param_attr=None, bias_attr=None, data_layout="NCHW",
+               is_test=False, name=None):
+    from ..nn import BatchNorm2D
+    from ..nn import functional as F
+
+    layer = BatchNorm2D(input.shape[1 if data_layout == "NCHW" else -1],
+                        momentum=momentum, epsilon=epsilon,
+                        weight_attr=param_attr, bias_attr=bias_attr,
+                        data_format=data_layout)
+    if is_test:
+        layer.eval()
+    out = layer(input)
+    if act is not None:
+        out = getattr(F, act)(out)
+    return out
 
 
 def _raw(x):

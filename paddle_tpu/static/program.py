@@ -1,0 +1,876 @@
+"""Static-graph surface: Program / Block / Variable / Executor / Scope.
+
+Ref parity: python/paddle/fluid/framework.py (Program/Block/Operator/
+Variable, program_guard, default_main_program), python/paddle/fluid/
+executor.py (Executor.run feed/fetch), python/paddle/static/__init__.py.
+
+TPU-native design — *not* an op-by-op interpreter: building code runs
+under a capture hook in the eager dispatch funnel, so every paddle op
+called on a symbolic `Variable` records an `OpDesc` into the current
+`Program` instead of executing.  `Executor.run` then compiles the whole
+recorded block into ONE jitted XLA computation (replaying the op list
+with real arrays inside `jax.jit`), caches it by (program version, feed
+signature, fetch names), and keeps persistable state in a `Scope` across
+runs — the reference's Program/Scope/Executor contract, with XLA playing
+the role of `framework/executor.cc` and every IR fusion pass.
+
+Autograd: `append_backward` (ref fluid/backward.py:1377) records a single
+`@backward` op; at replay it becomes `jax.vjp` over the forward section —
+the reference generates per-op grad ops from GradOpMakers, XLA's AD
+transform generates the whole backward program at once.
+
+Randomness: ops that consume an explicit PRNG-key input (dropout, random
+ops — see ops/nn_ops.py) get the key re-derived per run from a fresh
+executor key, `fold_in`-ed with the op index, so a captured dropout does
+not bake one mask into the graph.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import pickle
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core import config
+from ..core.dtype import to_jax_dtype
+from ..core.op_registry import lookup
+from ..core.tensor import Tensor
+
+__all__ = [
+    "Variable", "OpDesc", "Block", "Program", "Scope", "Executor",
+    "CompiledProgram", "program_guard", "default_main_program",
+    "default_startup_program", "global_scope", "scope_guard", "data",
+    "append_backward", "save", "load", "save_inference_model",
+    "load_inference_model", "InputSpec",
+]
+
+from ..jit import InputSpec  # noqa: E402  (re-export, paddle.static.InputSpec)
+
+
+# ---------------------------------------------------------------------------
+# symbolic Variable
+# ---------------------------------------------------------------------------
+
+
+class Variable(Tensor):
+    """Symbolic tensor inside a Program (ref framework.py:805 Variable).
+
+    `_value` holds a `jax.ShapeDtypeStruct` — shape/dtype flow through the
+    whole eager Tensor API, but any attempt to read data raises, as in the
+    reference ("variable has no data in static mode").
+    """
+
+    def __init__(self, name, shape, dtype, *, persistable=False,
+                 stop_gradient=True, is_data=False, block=None):
+        # bypass Tensor._coerce: no concrete array exists
+        self._value = jax.ShapeDtypeStruct(
+            tuple(int(s) if s is not None and s >= 0 else 1 for s in shape),
+            to_jax_dtype(dtype))
+        self.stop_gradient = stop_gradient
+        self._grad = None
+        self._tape = None
+        self.name = name
+        self.persistable = persistable
+        self._hooks = []
+        self.is_data = is_data
+        self.block = block
+
+    def numpy(self):
+        raise RuntimeError(
+            f"Variable '{self.name}' has no data in static mode; run it "
+            "through Executor.run(fetch_list=[...])")
+
+    __array__ = numpy
+
+    def __float__(self):
+        raise RuntimeError(f"Variable '{self.name}' is symbolic")
+
+    __int__ = __bool__ = __index__ = __float__
+
+    def item(self, *a):
+        raise RuntimeError(f"Variable '{self.name}' is symbolic")
+
+    def __repr__(self):
+        return (f"Variable(name={self.name!r}, shape={self.shape}, "
+                f"dtype={self._value.dtype.name}, "
+                f"persistable={self.persistable})")
+
+
+class OpDesc:
+    """One recorded op (ref framework.py:1921 Operator / proto OpDesc).
+
+    inputs: list of slots — ("var", name) | ("const", value) |
+    ("rngkey", salt).  attrs are the op's keyword attributes verbatim.
+    """
+
+    __slots__ = ("type", "inputs", "outputs", "attrs", "extra")
+
+    def __init__(self, type, inputs, outputs, attrs, extra=None):
+        self.type = type
+        self.inputs = inputs
+        self.outputs = outputs
+        self.attrs = attrs
+        self.extra = extra or {}
+
+    def input_names(self):
+        return [s[1] for s in self.inputs if s[0] == "var"]
+
+    def __repr__(self):
+        ins = ", ".join(s[1] if s[0] == "var" else f"<{s[0]}>"
+                        for s in self.inputs)
+        outs = ", ".join(self.outputs)
+        return f"{{{self.type}: ({ins}) -> ({outs})}}"
+
+
+class Block:
+    """Op list + var map (ref framework.py BlockDesc)."""
+
+    def __init__(self, program, idx=0):
+        self.program = program
+        self.idx = idx
+        self.vars: dict[str, Variable] = {}
+        self.ops: list[OpDesc] = []
+
+    def var(self, name):
+        v = self.vars.get(name)
+        if v is None:
+            raise ValueError(f"var '{name}' not in block {self.idx}")
+        return v
+
+    def has_var(self, name):
+        return name in self.vars
+
+    def create_var(self, name=None, shape=(), dtype="float32", **kw):
+        name = name or self.program._unique_name("tmp")
+        v = Variable(name, shape, dtype, block=self, **kw)
+        self.vars[name] = v
+        return v
+
+    def append_op(self, op):
+        self.ops.append(op)
+        self.program._version += 1
+        return op
+
+    def all_parameters(self):
+        return [v for v in self.vars.values()
+                if v.persistable and not v.stop_gradient]
+
+
+class Program:
+    """Recorded graph (ref framework.py:185 Program)."""
+
+    def __init__(self):
+        self.blocks = [Block(self, 0)]
+        self._version = 0
+        self._name_counter = 0
+        self.backward_index = None  # op index of the @backward op
+        self._is_test = False
+        self._lr_getter = None
+        # Tensors interned as persistable vars: id(tensor) -> (tensor, var).
+        # The Tensor is kept alive so a recycled CPython id can never alias
+        # a new tensor onto a stale Variable.
+        self._interned: dict[int, tuple] = {}
+
+    def global_block(self):
+        return self.blocks[0]
+
+    @property
+    def num_blocks(self):
+        return len(self.blocks)
+
+    def current_block(self):
+        return self.blocks[0]
+
+    def list_vars(self):
+        return list(self.global_block().vars.values())
+
+    def all_parameters(self):
+        return self.global_block().all_parameters()
+
+    def _unique_name(self, stem):
+        self._name_counter += 1
+        return f"{stem}_{self._name_counter}"
+
+    def clone(self, for_test=False):
+        """Copy the program; for_test drops backward + optimizer ops
+        (everything from the @backward op on), ref Program.clone."""
+        p = Program()
+        b = p.global_block()
+        src = self.global_block()
+        ops = src.ops
+        if for_test and self.backward_index is not None:
+            ops = ops[: self.backward_index]
+        b.vars = dict(src.vars)
+        b.ops = list(ops)
+        p._name_counter = self._name_counter
+        p._version = self._version
+        p._is_test = for_test
+        p._interned = dict(self._interned)
+        if not for_test:
+            p.backward_index = self.backward_index
+            p._lr_getter = self._lr_getter
+        return p
+
+    def __str__(self):
+        lines = [f"Program(version={self._version})"]
+        for b in self.blocks:
+            lines.append(f" block {b.idx}:")
+            for v in b.vars.values():
+                tag = ("data" if getattr(v, "is_data", False) else
+                       "persist" if v.persistable else "tmp")
+                lines.append(
+                    f"  var {v.name} : {list(v._value.shape)} "
+                    f"{v._value.dtype.name} [{tag}]")
+            for i, op in enumerate(b.ops):
+                lines.append(f"  op {i}: {op!r}")
+        return "\n".join(lines)
+
+    to_string = __str__
+
+
+class Scope:
+    """name -> concrete value store (ref framework/scope.h:52)."""
+
+    def __init__(self):
+        self._values: dict[str, jax.Array] = {}
+
+    def var(self, name):
+        return self._values.setdefault(name, None)
+
+    def find_var(self, name):
+        return self._values.get(name)
+
+    def set(self, name, value):
+        self._values[name] = value
+
+    def keys(self):
+        return self._values.keys()
+
+
+# ---------------------------------------------------------------------------
+# capture state
+# ---------------------------------------------------------------------------
+
+_main_program = Program()
+_startup_program = Program()
+_scope = Scope()
+_static_mode = False
+
+
+def default_main_program():
+    return _main_program
+
+
+def default_startup_program():
+    return _startup_program
+
+
+def global_scope():
+    return _scope
+
+
+@contextlib.contextmanager
+def scope_guard(scope):
+    global _scope
+    prev, _scope = _scope, scope
+    try:
+        yield
+    finally:
+        _scope = prev
+
+
+@contextlib.contextmanager
+def program_guard(main_program, startup_program=None):
+    global _main_program, _startup_program
+    prev = (_main_program, _startup_program)
+    _main_program = main_program
+    if startup_program is not None:
+        _startup_program = startup_program
+    _install_capture()
+    try:
+        yield
+    finally:
+        _main_program, _startup_program = prev
+
+
+def _enable_static():
+    global _static_mode
+    _static_mode = True
+    _install_capture()
+
+
+def _disable_static():
+    global _static_mode
+    _static_mode = False
+    from ..core import dispatch
+
+    dispatch._capture_fn = None
+
+
+def in_static_mode():
+    return _static_mode
+
+
+def _install_capture():
+    from ..core import dispatch
+
+    if _static_mode:
+        dispatch._capture_fn = capture_op
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    """Feed placeholder (ref python/paddle/static/input.py data)."""
+    blk = _main_program.global_block()
+    v = Variable(name, shape, dtype, is_data=True, block=blk)
+    blk.vars[name] = v
+    return v
+
+
+# ---------------------------------------------------------------------------
+# op capture (called from core.dispatch when static mode is on)
+# ---------------------------------------------------------------------------
+
+
+def _is_prng_key(a):
+    if isinstance(a, (jax.Array, np.ndarray)):
+        if jnp.issubdtype(a.dtype, jax.dtypes.prng_key):
+            return True
+        return a.dtype == jnp.uint32 and a.shape == (2,)
+    return False
+
+
+def _intern(t: Tensor):
+    """Concrete Tensor flowing into a captured op -> persistable var whose
+    value lives in the global scope (parameters, buffers)."""
+    blk = _main_program.global_block()
+    hit = _main_program._interned.get(id(t))
+    if hit is not None:
+        return hit[1]
+    name = t.name or _main_program._unique_name("persist")
+    if blk.has_var(name):
+        name = _main_program._unique_name(name)
+    v = Variable(name, t._value.shape, t._value.dtype,
+                 persistable=True, stop_gradient=t.stop_gradient, block=blk)
+    blk.vars[name] = v
+    _main_program._interned[id(t)] = (t, v)
+    _scope.set(name, t._value)
+    return v
+
+
+def capture_op(op_name, inputs, attrs):
+    """Record `op_name` into the default main program.
+
+    Returns NotImplemented when no input is symbolic — the dispatch funnel
+    then executes eagerly (parameter initialisation etc. stays concrete).
+    """
+    if not any(isinstance(x, Variable) for x in inputs):
+        return NotImplemented
+
+    opdef = lookup(op_name)
+    blk = _main_program.global_block()
+    op_idx = len(blk.ops)
+
+    slots = []
+    abstract = []
+    for x in inputs:
+        raw = x._value if isinstance(x, Tensor) else x
+        if isinstance(x, Variable):
+            slots.append(("var", x.name))
+            abstract.append(x._value)
+        elif _is_prng_key(raw):
+            # PRNG-key inputs (dropout, random ops) are re-derived per run
+            # from a fresh executor key — never baked into the graph
+            slots.append(("rngkey", op_idx))
+            abstract.append(raw)
+        elif isinstance(x, Tensor):
+            v = _intern(x)
+            slots.append(("var", v.name))
+            abstract.append(v._value)
+        else:
+            slots.append(("const", x))
+            abstract.append(x)
+
+    out_shapes = jax.eval_shape(
+        lambda *a: opdef.fn(*a, **attrs), *abstract)
+
+    # flatten outputs exactly like dispatch._wrap_outputs does
+    if opdef.has_aux:
+        diff_out, aux = out_shapes
+    else:
+        diff_out, aux = out_shapes, None
+
+    any_grad_in = any(
+        isinstance(x, Variable) and not x.stop_gradient for x in inputs)
+    requires_grad = (config.is_grad_enabled() and not opdef.no_grad
+                     and any_grad_in)
+
+    def mk_out(sds, stop_grad):
+        v = blk.create_var(
+            name=_main_program._unique_name(f"{op_name}.tmp"),
+            shape=sds.shape, dtype=sds.dtype, stop_gradient=stop_grad)
+        return v
+
+    out_names = []
+    if isinstance(diff_out, tuple):
+        outs = tuple(mk_out(o, not requires_grad) for o in diff_out)
+        out_names += [o.name for o in outs]
+    else:
+        outs = mk_out(diff_out, not requires_grad)
+        out_names.append(outs.name)
+
+    aux_struct = None
+    if aux is not None:
+        aux_leaves, aux_struct = jax.tree.flatten(aux)
+        aux_vars = [mk_out(a, True) for a in aux_leaves]
+        out_names += [a.name for a in aux_vars]
+        aux_t = jax.tree.unflatten(aux_struct, aux_vars)
+        if isinstance(outs, tuple):
+            result = outs + (aux_t if isinstance(aux_t, tuple) else (aux_t,))
+        else:
+            result = (outs,) + (aux_t if isinstance(aux_t, tuple)
+                                else (aux_t,))
+    else:
+        result = outs
+
+    blk.append_op(OpDesc(op_name, slots, out_names, dict(attrs),
+                         extra={"has_aux": opdef.has_aux,
+                                "aux_struct": aux_struct}))
+    return result
+
+
+# ---------------------------------------------------------------------------
+# backward + optimizer recording
+# ---------------------------------------------------------------------------
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None):
+    """Record the AD transform over the forward section
+    (ref fluid/backward.py:1377).  Returns [(param_var, grad_var)]."""
+    prog = _main_program
+    blk = prog.global_block()
+    if prog.backward_index is not None:
+        raise RuntimeError("append_backward already called on this program")
+    if not isinstance(loss, Variable):
+        raise TypeError("append_backward needs a symbolic loss Variable")
+
+    if parameter_list is None:
+        params = [v for v in blk.vars.values()
+                  if v.persistable and not v.stop_gradient]
+    else:
+        params = []
+        for p in parameter_list:
+            if isinstance(p, Variable):
+                params.append(p)
+            elif isinstance(p, Tensor):
+                hit = prog._interned.get(id(p))
+                if hit is None:
+                    raise ValueError(
+                        f"parameter {p.name!r} was never used in this "
+                        "program")
+                params.append(hit[1])
+            else:
+                params.append(blk.var(p))
+    if no_grad_set:
+        drop = {v.name if isinstance(v, Variable) else str(v)
+                for v in no_grad_set}
+        params = [p for p in params if p.name not in drop]
+
+    pairs = []
+    grad_names = []
+    for p in params:
+        g = blk.create_var(name=p.name + "@GRAD", shape=p._value.shape,
+                           dtype=p._value.dtype)
+        pairs.append((p, g))
+        grad_names.append(g.name)
+
+    prog.backward_index = len(blk.ops)
+    blk.append_op(OpDesc(
+        "@backward",
+        [("var", loss.name)] + [("var", p.name) for p in params],
+        grad_names,
+        {"loss": loss.name, "params": [p.name for p in params]}))
+    return pairs
+
+
+def append_global_norm_clip(params_grads, clip_norm):
+    """Record a global-norm clip over all grads (ref fluid/clip.py
+    ClipGradByGlobalNorm) — rebinds each grad var to its clipped value."""
+    blk = _main_program.global_block()
+    out_names = []
+    slots = []
+    for _, g in params_grads:
+        slots.append(("var", g.name))
+        out_names.append(g.name)  # rebind in place
+    blk.append_op(OpDesc("@global_norm_clip", slots, out_names,
+                         {"clip_norm": float(clip_norm)}))
+    return params_grads
+
+
+def append_optimizer_update(optimizer, param_var, grad_var, lr_scale=1.0,
+                            decay_coeff=0.0, clip=None):
+    """Record one parameter update as an op whose replay calls the
+    optimizer's pure `_rule` (the reference registers sgd/adam/... as ops;
+    here the rule itself is the kernel)."""
+    prog = _main_program
+    blk = prog.global_block()
+    pname = param_var.name
+
+    # moment state as persistable vars, initialised in the scope
+    pval_abstract = param_var._value
+    init_state = optimizer._init_state(
+        jnp.zeros(pval_abstract.shape, pval_abstract.dtype))
+    state_names = []
+    for k, v in init_state.items():
+        sname = f"{pname}@{optimizer.__class__.__name__}.{k}"
+        if not blk.has_var(sname):
+            blk.create_var(name=sname, shape=v.shape, dtype=v.dtype,
+                           persistable=True)
+            _scope.set(sname, v)
+        state_names.append((k, sname))
+
+    slots = ([("var", pname), ("var", grad_var.name), ("const", lr_scale)]
+             + [("var", s) for _, s in state_names])
+    out_names = [pname] + [s for _, s in state_names]
+    prog._lr_getter = optimizer.get_lr
+    blk.append_op(OpDesc(
+        "@update", slots, out_names,
+        {"rule": optimizer._rule, "hyper": optimizer._hyper(),
+         "state_keys": [k for k, _ in state_names],
+         "optimizer": optimizer.__class__.__name__,
+         "decay_coeff": float(decay_coeff), "clip": clip}))
+
+
+# ---------------------------------------------------------------------------
+# Executor: compile the recorded block into one XLA computation
+# ---------------------------------------------------------------------------
+
+
+def _run_ops(ops, env, rng_key, start=0, stop=None):
+    """Replay a slice of the op list over concrete/traced arrays."""
+    stop = len(ops) if stop is None else stop
+    for i in range(start, stop):
+        op = ops[i]
+        if op.type.startswith("@"):
+            raise RuntimeError(
+                f"internal: pseudo-op {op.type} inside plain replay")
+        opdef = lookup(op.type)
+        args = []
+        for kind, val in op.inputs:
+            if kind == "var":
+                args.append(env[val])
+            elif kind == "rngkey":
+                args.append(jax.random.fold_in(rng_key, val))
+            else:
+                args.append(val)
+        out = opdef.fn(*args, **op.attrs)
+        if op.extra.get("has_aux"):
+            diff, aux = out
+            leaves = (list(diff) if isinstance(diff, tuple) else [diff])
+            leaves += jax.tree.leaves(aux)
+        else:
+            leaves = list(out) if isinstance(out, tuple) else [out]
+        for name, val in zip(op.outputs, leaves):
+            env[name] = val
+    return env
+
+
+def _split_sections(ops, backward_index):
+    """fwd ops | @backward | tail (clip + updates)."""
+    if backward_index is None:
+        return ops, None, []
+    return ops[:backward_index], ops[backward_index], ops[backward_index + 1:]
+
+
+def _run_tail(ops, env, rng_key):
+    """Replay the post-backward section: grad clip + optimizer updates +
+    any further plain ops."""
+    for i, op in enumerate(ops):
+        if op.type == "@global_norm_clip":
+            grads = [env[s[1]] for s in op.inputs]
+            sq = sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                     for g in grads)
+            gnorm = jnp.sqrt(sq)
+            clip = op.attrs["clip_norm"]
+            scale = jnp.minimum(1.0, clip / jnp.maximum(gnorm, 1e-12))
+            for (kind, name), g in zip(op.inputs, grads):
+                env[name] = (g.astype(jnp.float32) * scale).astype(g.dtype)
+        elif op.type == "@update":
+            pname = op.inputs[0][1]
+            gname = op.inputs[1][1]
+            lr_scale = op.inputs[2][1]
+            state = {k: env[s[1]] for k, s in
+                     zip(op.attrs["state_keys"], op.inputs[3:])}
+            p, g = env[pname], env[gname]
+            if op.attrs.get("decay_coeff"):
+                g = g + op.attrs["decay_coeff"] * p
+            clip_spec = op.attrs.get("clip")
+            if clip_spec is not None:
+                if clip_spec[0] == "value":
+                    g = jnp.clip(g, clip_spec[1], clip_spec[2])
+                elif clip_spec[0] == "norm":
+                    norm = jnp.sqrt(jnp.sum(
+                        jnp.square(g.astype(jnp.float32))))
+                    scale = jnp.minimum(
+                        1.0, clip_spec[1] / jnp.maximum(norm, 1e-12))
+                    g = (g.astype(jnp.float32) * scale).astype(g.dtype)
+            # lr arrives as a traced scalar ("@lr" in env), fed fresh each
+            # run — LR schedulers step without recompiling
+            lr = env["@lr"] * lr_scale
+            new_p, new_state = op.attrs["rule"](
+                p, g, state, lr, **op.attrs["hyper"])
+            env[pname] = new_p
+            for k, s in zip(op.attrs["state_keys"], op.inputs[3:]):
+                env[s[1]] = new_state[k]
+        else:
+            _run_ops(ops, env, rng_key, start=i, stop=i + 1)
+    return env
+
+
+class Executor:
+    """Compiles + runs Programs (ref fluid/executor.py:475 Executor)."""
+
+    def __init__(self, place=None):
+        self.place = place
+        self._cache = {}
+
+    def run(self, program=None, feed=None, fetch_list=None, scope=None,
+            return_numpy=True, use_prune=False):
+        if program is None:
+            program = _main_program
+        if isinstance(program, CompiledProgram):
+            program = program._program
+        scope = scope or _scope
+        feed = feed or {}
+        fetch_list = fetch_list or []
+        if program is _startup_program and not fetch_list:
+            # startup: parameter values are already materialised in the
+            # scope at intern time (eager init = the startup program)
+            return []
+
+        fetch_names = []
+        for f in fetch_list:
+            fetch_names.append(f.name if isinstance(f, Variable) else str(f))
+
+        blk = program.global_block()
+        persist = sorted(
+            n for n, v in blk.vars.items()
+            if v.persistable and scope.find_var(n) is not None)
+        feed_names = sorted(feed.keys())
+
+        feed_vals = {}
+        for n in feed_names:
+            a = feed[n]
+            a = a._value if isinstance(a, Tensor) else jnp.asarray(a)
+            feed_vals[n] = a
+
+        # the Program object itself is part of the key (identity hash) —
+        # keeping it referenced in the cache means a GC'd program's id can
+        # never be recycled into a stale cache hit
+        sig = (program, program._version, tuple(fetch_names),
+               tuple(feed_names),
+               tuple((n,) + tuple(feed_vals[n].shape) for n in feed_names))
+        fn = self._cache.get(sig)
+        if fn is None:
+            fn = self._build(program, persist, feed_names, fetch_names)
+            self._cache[sig] = fn
+
+        pvals = {n: scope.find_var(n) for n in persist}
+        from ..framework import random as fr
+
+        rng = fr.next_key()
+        lr = getattr(program, "_lr_getter", None)
+        lr_val = jnp.asarray(lr() if lr is not None else 0.0, jnp.float32)
+        fetched, new_pvals = fn(pvals, feed_vals, rng, lr_val)
+        for n, v in new_pvals.items():
+            scope.set(n, v)
+        if return_numpy:
+            return [np.asarray(v) for v in fetched]
+        return [Tensor(v) for v in fetched]
+
+    def close(self):
+        self._cache.clear()
+
+    def _build(self, program, persist, feed_names, fetch_names):
+        blk = program.global_block()
+        fwd_ops, bwd_op, tail_ops = _split_sections(
+            blk.ops, program.backward_index)
+
+        if bwd_op is None:
+            # dead-code elimination: an inference program only runs the
+            # ops its fetches need (XLA would DCE anyway; pruning first
+            # means un-fed data vars that feed only pruned ops are fine)
+            fwd_ops = _backward_slice(fwd_ops, fetch_names)
+
+        def compiled(pvals, feed_vals, rng_key, lr):
+            env = dict(pvals)
+            env.update(feed_vals)
+            env["@lr"] = lr
+
+            if bwd_op is None:
+                env = _run_ops(fwd_ops, env, rng_key)
+            else:
+                loss_name = bwd_op.attrs["loss"]
+                param_names = bwd_op.attrs["params"]
+                base_env = dict(env)
+
+                def fwd(trainable):
+                    e = dict(base_env)
+                    e.update(trainable)
+                    e = _run_ops(fwd_ops, e, rng_key)
+                    return e[loss_name], e
+
+                trainable = {n: env[n] for n in param_names}
+                loss, vjp_fn, env = jax.vjp(fwd, trainable, has_aux=True)
+                (grads,) = vjp_fn(jnp.ones_like(loss))
+                env = dict(env)
+                env["@lr"] = lr
+                for pname, gname in zip(param_names, bwd_op.outputs):
+                    env[gname] = grads[pname]
+                _run_tail(tail_ops, env, rng_key)
+
+            fetched = [env[n] for n in fetch_names]
+            new_pvals = {n: env[n] for n in persist if n in env}
+            return fetched, new_pvals
+
+        return jax.jit(compiled)
+
+
+class CompiledProgram:
+    """Thin wrapper kept for API parity (ref fluid/compiler.py
+    CompiledProgram) — XLA compilation happens inside Executor.run."""
+
+    def __init__(self, program, build_strategy=None):
+        self._program = program
+        self._build_strategy = build_strategy
+
+    def with_data_parallel(self, loss_name=None, places=None, **kw):
+        # multi-device execution goes through the GSPMD engine
+        # (paddle_tpu.engine / distributed.hybrid); single-program replay
+        # stays single-device here
+        return self
+
+
+# ---------------------------------------------------------------------------
+# persistence (ref fluid/io.py:286-1042 save/load_persistables,
+# save_inference_model:1246)
+# ---------------------------------------------------------------------------
+
+
+def save(program, model_path, protocol=4):
+    """Save all persistable var values of `program` -> {path}.pdparams."""
+    blk = program.global_block()
+    state = {}
+    for n, v in blk.vars.items():
+        if v.persistable and _scope.find_var(n) is not None:
+            state[n] = np.asarray(_scope.find_var(n))
+    with open(model_path + ".pdparams", "wb") as f:
+        pickle.dump(state, f, protocol=protocol)
+
+
+def load(program, model_path, executor=None, var_list=None):
+    """Restore persistable var values saved by `save` into the scope."""
+    with open(model_path + ".pdparams", "rb") as f:
+        state = pickle.load(f)
+    keep = None if var_list is None else {
+        v.name if isinstance(v, Variable) else str(v) for v in var_list}
+    for n, val in state.items():
+        if keep is None or n in keep:
+            _scope.set(n, jnp.asarray(val))
+
+
+def _backward_slice(ops, fetch_names):
+    """Keep only the ops a backward walk from `fetch_names` reaches."""
+    needed = set(fetch_names)
+    kept = []
+    for op in reversed(ops):
+        if any(o in needed for o in op.outputs):
+            kept.append(op)
+            needed.update(op.input_names())
+    kept.reverse()
+    return kept
+
+
+def _prune_for_fetch(program, feed_names, fetch_names):
+    """Backward slice: keep only ops needed to compute the fetches from
+    feeds + persistables (ref Program._prune)."""
+    blk = program.global_block()
+    fwd_ops = blk.ops
+    if program.backward_index is not None:
+        fwd_ops = fwd_ops[: program.backward_index]
+    kept = _backward_slice(fwd_ops, fetch_names)
+    var_names = set(feed_names) | set(fetch_names)
+    for op in kept:
+        var_names.update(op.input_names())
+        var_names.update(op.outputs)
+    return kept, var_names
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         program=None, **configs):
+    """Serialize the pruned inference graph + params
+    (ref fluid/io.py:1246).  Produces {path}.pdmodel (op list + var metas,
+    pickled) and {path}.pdiparams (persistable values)."""
+    import os
+
+    program = program or _main_program
+    feed_names = [v.name if isinstance(v, Variable) else str(v)
+                  for v in feed_vars]
+    fetch_names = [v.name if isinstance(v, Variable) else str(v)
+                   for v in fetch_vars]
+    ops, var_names = _prune_for_fetch(program, feed_names, fetch_names)
+    blk = program.global_block()
+
+    op_records = []
+    for op in ops:
+        if op.type.startswith("@"):
+            raise ValueError(
+                f"inference graph contains training pseudo-op {op.type}; "
+                "prune with clone(for_test=True) first")
+        # only literal attrs survive serialization
+        attrs = {k: v for k, v in op.attrs.items() if not callable(v)}
+        op_records.append((op.type, op.inputs, op.outputs, attrs,
+                           op.extra.get("has_aux", False)))
+
+    var_metas = {}
+    params = {}
+    for n in sorted(var_names):
+        v = blk.vars.get(n)
+        if v is None:
+            continue
+        var_metas[n] = (list(v._value.shape), v._value.dtype.name,
+                        v.persistable, getattr(v, "is_data", False))
+        if v.persistable and _scope.find_var(n) is not None:
+            params[n] = np.asarray(_scope.find_var(n))
+
+    os.makedirs(os.path.dirname(path_prefix) or ".", exist_ok=True)
+    with open(path_prefix + ".pdmodel", "wb") as f:
+        pickle.dump({"ops": op_records, "vars": var_metas,
+                     "feed": feed_names, "fetch": fetch_names}, f, protocol=4)
+    with open(path_prefix + ".pdiparams", "wb") as f:
+        pickle.dump(params, f, protocol=4)
+
+
+def load_inference_model(path_prefix, executor=None, **configs):
+    """Returns (program, feed_names, fetch_names); the program's
+    persistables are loaded into the global scope."""
+    with open(path_prefix + ".pdmodel", "rb") as f:
+        meta = pickle.load(f)
+    with open(path_prefix + ".pdiparams", "rb") as f:
+        params = pickle.load(f)
+
+    prog = Program()
+    blk = prog.global_block()
+    for n, (shape, dtype, persistable, is_data) in meta["vars"].items():
+        blk.create_var(name=n, shape=shape, dtype=dtype,
+                       persistable=persistable, is_data=is_data)
+    for type_, inputs, outputs, attrs, has_aux in meta["ops"]:
+        blk.append_op(OpDesc(type_, [tuple(s) for s in inputs],
+                             list(outputs), attrs,
+                             extra={"has_aux": has_aux}))
+    for n, val in params.items():
+        _scope.set(n, jnp.asarray(val))
+    return prog, meta["feed"], meta["fetch"]

@@ -1,0 +1,212 @@
+"""paddle.static Program/Executor surface.
+
+Ref intent: python/paddle/fluid/tests/unittests/test_program.py,
+test_executor_and_use_program_cache.py, book/test_fit_a_line.py — build a
+program with static.data + layers, train it with optimizer.minimize via
+Executor.run(feed/fetch), clone for test, and round-trip
+save/load_inference_model.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import static
+
+
+@pytest.fixture()
+def static_mode():
+    main = static.Program()
+    startup = static.Program()
+    paddle.enable_static()
+    with static.program_guard(main, startup):
+        yield main, startup
+    paddle.disable_static()
+
+
+def test_capture_records_ops(static_mode):
+    main, _ = static_mode
+    x = static.data("x", [4, 3], "float32")
+    y = paddle.matmul(x, paddle.transpose(x, perm=[1, 0]))
+    z = y + 1.0
+
+    ops = [op.type for op in main.global_block().ops]
+    assert "matmul_v2" in ops or "matmul" in ops
+    assert isinstance(z, static.Variable)
+    assert z.shape == [4, 4]
+    # symbolic vars refuse data access
+    with pytest.raises(RuntimeError):
+        z.numpy()
+    # program prints an inspectable IR
+    s = str(main)
+    assert "op 0" in s and "var x" in s
+
+
+def test_executor_run_forward(static_mode):
+    main, startup = static_mode
+    x = static.data("x", [2, 3], "float32")
+    y = paddle.tanh(x) * 2.0
+
+    exe = static.Executor()
+    exe.run(startup)
+    xv = np.random.RandomState(0).randn(2, 3).astype(np.float32)
+    (out,) = exe.run(main, feed={"x": xv}, fetch_list=[y])
+    np.testing.assert_allclose(out, np.tanh(xv) * 2.0, rtol=1e-6)
+
+
+def test_fc_fit_a_line(static_mode):
+    """book/test_fit_a_line.py: linear regression trains to low loss."""
+    main, startup = static_mode
+    x = static.data("x", [16, 13], "float32")
+    label = static.data("label", [16, 1], "float32")
+    pred = static.nn.fc(x, 1)
+    loss = paddle.mean(paddle.nn.functional.square_error_cost(pred, label))
+
+    sgd = paddle.optimizer.SGD(learning_rate=0.05)
+    sgd.minimize(loss)
+    assert main.backward_index is not None
+
+    exe = static.Executor()
+    exe.run(startup)
+    rng = np.random.RandomState(1)
+    w = rng.randn(13, 1).astype(np.float32)
+    first = last = None
+    for i in range(60):
+        xv = rng.randn(16, 13).astype(np.float32)
+        yv = xv @ w + 0.1
+        (lv,) = exe.run(main, feed={"x": xv, "label": yv},
+                        fetch_list=[loss])
+        if first is None:
+            first = float(lv)
+        last = float(lv)
+    assert last < first * 0.1, (first, last)
+
+
+def test_clone_for_test_drops_updates(static_mode):
+    main, startup = static_mode
+    x = static.data("x", [4, 2], "float32")
+    label = static.data("label", [4, 1], "float32")
+    pred = static.nn.fc(x, 1)
+    loss = paddle.mean(paddle.nn.functional.square_error_cost(pred, label))
+    test_prog = main.clone(for_test=True)
+
+    paddle.optimizer.SGD(learning_rate=0.1).minimize(loss)
+
+    assert test_prog.backward_index is None
+    assert all(not op.type.startswith("@")
+               for op in test_prog.global_block().ops)
+
+    exe = static.Executor()
+    exe.run(startup)
+    xv = np.ones((4, 2), np.float32)
+    (before,) = exe.run(test_prog, feed={"x": xv}, fetch_list=[pred])
+    # a train step changes params; the test prog sees the new values
+    exe.run(main, feed={"x": xv, "label": np.zeros((4, 1), np.float32)},
+            fetch_list=[loss])
+    (after,) = exe.run(test_prog, feed={"x": xv}, fetch_list=[pred])
+    assert not np.allclose(before, after)
+
+
+def test_lr_scheduler_no_recompile(static_mode):
+    main, startup = static_mode
+    x = static.data("x", [4, 2], "float32")
+    label = static.data("label", [4, 1], "float32")
+    pred = static.nn.fc(x, 1)
+    loss = paddle.mean(paddle.nn.functional.square_error_cost(pred, label))
+    sched = paddle.optimizer.lr.StepDecay(learning_rate=0.5, step_size=1,
+                                          gamma=0.1)
+    sgd = paddle.optimizer.SGD(learning_rate=sched)
+    sgd.minimize(loss)
+
+    exe = static.Executor()
+    exe.run(startup)
+    xv = np.ones((4, 2), np.float32)
+    yv = np.zeros((4, 1), np.float32)
+    exe.run(main, feed={"x": xv, "label": yv}, fetch_list=[loss])
+    sched.step()  # lr 0.5 -> 0.05; same compiled program must honour it
+    n_compiled = len(exe._cache)
+    exe.run(main, feed={"x": xv, "label": yv}, fetch_list=[loss])
+    assert len(exe._cache) == n_compiled
+
+
+def test_save_load_inference_model(tmp_path, static_mode):
+    main, startup = static_mode
+    x = static.data("x", [4, 3], "float32")
+    out = static.nn.fc(x, 2, activation="relu")
+
+    exe = static.Executor()
+    exe.run(startup)
+    xv = np.random.RandomState(2).randn(4, 3).astype(np.float32)
+    (expect,) = exe.run(main, feed={"x": xv}, fetch_list=[out])
+
+    path = str(tmp_path / "infer_model")
+    static.save_inference_model(path, [x], [out], exe)
+
+    prog, feeds, fetches = static.load_inference_model(path, exe)
+    (got,) = exe.run(prog, feed={feeds[0]: xv}, fetch_list=fetches)
+    np.testing.assert_allclose(got, expect, rtol=1e-6)
+
+
+def test_static_save_load_params(tmp_path, static_mode):
+    main, startup = static_mode
+    x = static.data("x", [2, 3], "float32")
+    out = static.nn.fc(x, 2)
+
+    exe = static.Executor()
+    exe.run(startup)
+    xv = np.ones((2, 3), np.float32)
+    (before,) = exe.run(main, feed={"x": xv}, fetch_list=[out])
+
+    path = str(tmp_path / "ckpt")
+    static.save(main, path)
+    # clobber the params, restore, expect identical output
+    scope = static.global_scope()
+    for p in main.all_parameters():
+        scope.set(p.name, np.zeros_like(scope.find_var(p.name)))
+    (zeroed,) = exe.run(main, feed={"x": xv}, fetch_list=[out])
+    assert not np.allclose(zeroed, before)
+    static.load(main, path, exe)
+    (after,) = exe.run(main, feed={"x": xv}, fetch_list=[out])
+    np.testing.assert_allclose(after, before, rtol=1e-6)
+
+
+def test_dropout_fresh_mask_per_run(static_mode):
+    main, startup = static_mode
+    x = static.data("x", [64, 64], "float32")
+    y = paddle.nn.functional.dropout(x, p=0.5, training=True)
+
+    exe = static.Executor()
+    xv = np.ones((64, 64), np.float32)
+    (a,) = exe.run(main, feed={"x": xv}, fetch_list=[y])
+    (b,) = exe.run(main, feed={"x": xv}, fetch_list=[y])
+    # a captured dropout must not bake one mask into the graph
+    assert not np.allclose(a, b)
+
+
+def test_per_grad_clip_static(static_mode):
+    """ClipGradByValue must apply in the static path (not just eager)."""
+    main, startup = static_mode
+    x = static.data("x", [4, 2], "float32")
+    label = static.data("label", [4, 1], "float32")
+    pred = static.nn.fc(x, 1)
+    loss = paddle.mean(paddle.nn.functional.square_error_cost(pred, label))
+    clip = paddle.nn.ClipGradByValue(1e-4) if hasattr(
+        paddle.nn, "ClipGradByValue") else None
+    from paddle_tpu.clip import ClipGradByValue
+
+    sgd = paddle.optimizer.SGD(learning_rate=1.0,
+                               grad_clip=ClipGradByValue(1e-4))
+    sgd.minimize(loss)
+
+    exe = static.Executor()
+    exe.run(startup)
+    scope = static.global_scope()
+    params = main.all_parameters()
+    before = {p.name: np.asarray(scope.find_var(p.name)) for p in params}
+    xv = np.full((4, 2), 100.0, np.float32)
+    yv = np.full((4, 1), -100.0, np.float32)
+    exe.run(main, feed={"x": xv, "label": yv}, fetch_list=[loss])
+    # lr=1.0 with huge grads would explode; value-clip bounds the step
+    for p in params:
+        delta = np.abs(np.asarray(scope.find_var(p.name)) - before[p.name])
+        assert delta.max() <= 1e-4 + 1e-7, (p.name, delta.max())
