@@ -167,8 +167,15 @@ def _run_backward(tensors, grad_tensors, retain_graph, sinks=None):
             for t, g in zip(node.inputs, in_grads):
                 if t is None or t.stop_gradient or _is_float0(g):
                     continue
+                from .selected_rows import SelectedRows
+
                 for hook in t._hooks:
-                    out = hook(Tensor(g, stop_gradient=True))
+                    if isinstance(g, SelectedRows):
+                        # hooks see the densified grad; a hook that edits
+                        # it falls back to the dense representation
+                        out = hook(Tensor(g.to_dense(), stop_gradient=True))
+                    else:
+                        out = hook(Tensor(g, stop_gradient=True))
                     if out is not None:
                         g = out._value if isinstance(out, Tensor) else out
                 if t._tape is None:

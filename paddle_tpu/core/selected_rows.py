@@ -1,0 +1,87 @@
+"""SelectedRows: sparse row-index gradients for embeddings.
+
+Ref parity: paddle/fluid/framework/selected_rows.h — the reference stores
+embedding gradients as {rows, value} so the optimizer touches only the
+looked-up rows. TPU-native: `rows`/`values` are device arrays with STATIC
+shapes (k = number of lookups, known at trace time), duplicates are
+allowed (scatter-add semantics), and densification is one XLA
+scatter-add. Optimizers apply them with `at[rows].add` (SGD) or a
+static-size `jnp.unique` merge + row-wise moment update (Adam lazy_mode),
+so a large vocab table never materialises a dense gradient.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+class SelectedRows:
+    """A sparse gradient: `values[i]` is the gradient of row `rows[i]` of
+    a dense tensor with leading dimension `height`."""
+
+    __slots__ = ("rows", "values", "height")
+
+    def __init__(self, rows, values, height):
+        self.rows = jnp.asarray(rows, jnp.int32).reshape(-1)
+        values = jnp.asarray(values)
+        k = self.rows.shape[0]
+        if values.ndim >= 2 and values.shape[0] == k:
+            self.values = values
+        else:
+            self.values = values.reshape(k, -1)
+        self.height = int(height)
+
+    # -- tensor-protocol shims (so autograd plumbing can pass it around) --
+    @property
+    def dtype(self):
+        return self.values.dtype
+
+    @property
+    def shape(self):
+        return (self.height,) + tuple(self.values.shape[1:])
+
+    def astype(self, dt):
+        return SelectedRows(self.rows, self.values.astype(dt), self.height)
+
+    def to_dense(self):
+        dense = jnp.zeros(self.shape, self.values.dtype)
+        return dense.at[self.rows].add(self.values, mode="drop")
+
+    def merge(self, other):
+        """Accumulate another gradient (sparse or dense)."""
+        if isinstance(other, SelectedRows):
+            return SelectedRows(
+                jnp.concatenate([self.rows, other.rows]),
+                jnp.concatenate([self.values, other.values]), self.height)
+        return self.to_dense() + other
+
+    def coalesced(self):
+        """Merge duplicate rows with a static-size unique (XLA-friendly:
+        out-of-range fill rows are dropped by scatter mode='drop')."""
+        k = self.rows.shape[0]
+        uniq, inv = jnp.unique(self.rows, return_inverse=True, size=k,
+                               fill_value=self.height)
+        merged = jax.ops.segment_sum(self.values, inv.reshape(-1),
+                                     num_segments=k)
+        return SelectedRows(uniq, merged, self.height)
+
+    def __repr__(self):
+        return (f"SelectedRows(height={self.height}, "
+                f"nnz_rows={self.rows.shape[0]}, "
+                f"row_shape={tuple(self.values.shape[1:])})")
+
+
+def is_selected_rows(x):
+    return isinstance(x, SelectedRows)
+
+
+def accumulate(a, b):
+    """Grad accumulation where either side may be sparse."""
+    if a is None:
+        return b
+    if isinstance(a, SelectedRows):
+        return a.merge(b)
+    if isinstance(b, SelectedRows):
+        return b.merge(a)
+    return a + b

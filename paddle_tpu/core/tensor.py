@@ -26,6 +26,13 @@ def _coerce(data, dtype=None):
     """Build a jax array from arbitrary input data."""
     if isinstance(data, Tensor):
         data = data._value
+    from .selected_rows import SelectedRows
+
+    if isinstance(data, SelectedRows):
+        # wrapping a sparse grad in a Tensor densifies it; the sparse fast
+        # path lives in Optimizer.step/_apply_sparse which checks the type
+        # before wrapping
+        data = data.to_dense()
     if isinstance(data, (jax.Array, jax.core.Tracer)):
         # already device data (or a tracer inside jit) — never via numpy
         if dtype is not None:
@@ -158,7 +165,10 @@ class Tensor:
     def _accumulate_grad(self, g):
         if g.dtype != self._value.dtype:
             g = g.astype(self._value.dtype)
-        self._grad = g if self._grad is None else self._grad + g
+        from .selected_rows import accumulate
+
+        # handles dense+dense, and SelectedRows sparse grads on either side
+        self._grad = accumulate(self._grad, g)
 
     def register_hook(self, hook):
         self._hooks.append(hook)

@@ -321,8 +321,66 @@ def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
 
 
 def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    if sparse:
+        return _sparse_embedding(x, weight, padding_idx)
     return apply("lookup_table_v2", x, weight,
                  padding_idx=-1 if padding_idx is None else padding_idx)
+
+
+def _sparse_embedding(x, weight, padding_idx=None):
+    """sparse=True lookup: the weight gradient is a SelectedRows
+    {rows=looked-up ids, values=output cotangents} instead of a dense
+    vocab-sized scatter (ref framework/selected_rows.h +
+    lookup_table_v2_op.cc is_sparse path). TPU-native: static shapes
+    (k = number of lookups), optimizers apply it with scatter-add /
+    row-wise moment updates."""
+    import numpy as _np
+
+    import jax as _jax
+    import jax.numpy as jnp
+
+    from ...core import dispatch as _dispatch
+    from ...core.autograd import Node
+    from ...core.op_registry import lookup as _op_lookup
+    from ...core.selected_rows import SelectedRows
+    from ...core.tensor import Tensor as _T
+    from ...core import config as _config
+
+    pad = -1 if padding_idx is None else int(padding_idx)
+    if _dispatch._capture_fn is not None:
+        # static-graph capture replays ops from the registry; SelectedRows
+        # has no static representation, so is_sparse degrades to the dense
+        # captured lookup (the reference's static sparse path is PS-mode
+        # only — distributed_lookup_table_op)
+        return apply("lookup_table_v2", x, weight, padding_idx=pad)
+
+    ids_t = x if isinstance(x, _T) else _T(x)
+    ids = jnp.asarray(ids_t._value).astype(jnp.int32)
+    w = weight._value
+
+    # same kernel as the dense path — only the backward differs
+    out = _op_lookup("lookup_table_v2").fn(ids, w, padding_idx=pad)
+
+    requires_grad = (_config.is_grad_enabled() and _config.is_tape_enabled()
+                     and not weight.stop_gradient)
+    result = _T(out, stop_gradient=not requires_grad)
+    if not requires_grad:
+        return result
+
+    height = w.shape[0]
+
+    def vjp_fn(dy):
+        rows = ids.reshape(-1)
+        values = jnp.asarray(dy).reshape(-1, w.shape[1])
+        if pad >= 0:
+            values = values * (rows != pad)[:, None].astype(values.dtype)
+        ids_zero = _np.zeros(ids.shape, _jax.dtypes.float0)
+        return (ids_zero, SelectedRows(rows, values, height))
+
+    node = Node(vjp_fn, (ids_t, weight), [(out.shape, out.dtype)],
+                "lookup_table_v2_sparse", attrs={"padding_idx": pad})
+    result._tape = (node, 0)
+    return result
 
 
 def one_hot(x, num_classes, name=None):

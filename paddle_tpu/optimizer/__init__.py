@@ -27,6 +27,9 @@ __all__ = [
 ]
 
 
+_warned_sparse_densify = False
+
+
 class Optimizer:
     def __init__(self, learning_rate=0.001, parameters=None,
                  weight_decay=None, grad_clip=None, name=None,
@@ -90,12 +93,37 @@ class Optimizer:
     # -- the eager step ------------------------------------------------------
     @config.no_grad()
     def step(self):
+        from ..core.selected_rows import SelectedRows
+
         self._global_step += 1
         params_grads = []
+        sparse_pg = []
         for p in self._parameter_list:
             if p is None or p.stop_gradient or p._grad is None:
                 continue
-            params_grads.append((p, Tensor(p._grad)))
+            if isinstance(p._grad, SelectedRows):
+                decay = p.regularizer if p.regularizer is not None \
+                    else self._weight_decay
+                if self._grad_clip is not None or (
+                        decay is not None
+                        and not self._decoupled_weight_decay()):
+                    # clip/coupled-decay need the whole gradient: densify
+                    # so the configured semantics hold exactly (the
+                    # reference merges SelectedRows before clipping too)
+                    global _warned_sparse_densify
+                    if not _warned_sparse_densify:
+                        import warnings
+
+                        warnings.warn(
+                            "sparse gradient densified because grad_clip/"
+                            "weight_decay is configured; drop them to keep "
+                            "the sparse fast path")
+                        _warned_sparse_densify = True
+                    params_grads.append((p, Tensor(p._grad)))
+                else:
+                    sparse_pg.append((p, p._grad))
+            else:
+                params_grads.append((p, Tensor(p._grad)))
         params_grads = self._preprocess(params_grads)
         lr = self.get_lr()
         for p, g in params_grads:
@@ -105,6 +133,20 @@ class Optimizer:
                 p._value, g._value, state, plr, self._hyper_for(p))
             p._value = new_p
             self._accumulators[id(p)] = new_state
+        for p, sr in sparse_pg:
+            state = self._state_for(p)
+            plr = lr * p.optimize_attr.get("learning_rate", 1.0)
+            new_p, new_state = self._apply_sparse(
+                p._value, sr, state, plr, self._hyper_for(p))
+            p._value = new_p
+            self._accumulators[id(p)] = new_state
+
+    def _apply_sparse(self, pv, sr, state, lr, hyper):
+        """Apply a SelectedRows gradient (ref
+        operators/optimizers/*_op.cc SelectedRows kernels). Default:
+        densify and run the dense rule; SGD/Adam override with row-wise
+        updates that never materialise a vocab-sized gradient."""
+        return self._rule(pv, sr.to_dense(), state, lr, **hyper)
 
     def _hyper_for(self, p):
         """Per-parameter hyperparameters (overridden by optimizers with
@@ -166,18 +208,6 @@ class Optimizer:
         plist = parameters if parameters is not None \
             else self._parameter_list
         pairs = sp.append_backward(loss, plist, no_grad_set)
-        per_grad_clip = None
-        if isinstance(self._grad_clip, ClipGradByGlobalNorm):
-            sp.append_global_norm_clip(pairs, self._grad_clip.clip_norm)
-        elif isinstance(self._grad_clip, ClipGradByNorm):
-            per_grad_clip = ("norm", self._grad_clip.clip_norm)
-        elif isinstance(self._grad_clip, ClipGradByValue):
-            per_grad_clip = ("value", self._grad_clip.min,
-                             self._grad_clip.max)
-        elif self._grad_clip is not None:
-            raise NotImplementedError(
-                f"grad_clip {type(self._grad_clip).__name__} is not "
-                "supported in the static path")
 
         # map grad vars back to the eager Parameters (for per-param lr /
         # regularizer attrs) via the program's intern table
@@ -188,19 +218,41 @@ class Optimizer:
                 hit = prog._interned.get(id(t))
                 if hit is not None:
                     var_to_eager[id(hit[1])] = t
+
+        def _coeff_for(pvar):
+            eager = var_to_eager.get(id(pvar))
+            decay = (getattr(eager, "regularizer", None)
+                     if eager is not None else None) or self._weight_decay
+            if decay is not None and not self._decoupled_weight_decay():
+                return decay.coeff
+            return 0.0
+
+        per_grad_clip = None
+        global_clip = isinstance(self._grad_clip, ClipGradByGlobalNorm)
+        if global_clip:
+            # decay folds into the grads INSIDE the clip op, before the
+            # norm — matching the eager _preprocess order (decay, then
+            # clip sees decay-included grads)
+            sp.append_global_norm_clip(
+                pairs, self._grad_clip.clip_norm,
+                decay_coeffs=[_coeff_for(p) for p, _ in pairs])
+        elif isinstance(self._grad_clip, ClipGradByNorm):
+            per_grad_clip = ("norm", self._grad_clip.clip_norm)
+        elif isinstance(self._grad_clip, ClipGradByValue):
+            per_grad_clip = ("value", self._grad_clip.min,
+                             self._grad_clip.max)
+        elif self._grad_clip is not None:
+            raise NotImplementedError(
+                f"grad_clip {type(self._grad_clip).__name__} is not "
+                "supported in the static path")
+
         for pvar, gvar in pairs:
             eager = var_to_eager.get(id(pvar))
             lr_scale = 1.0
-            coeff = 0.0
             if eager is not None:
                 lr_scale = getattr(eager, "optimize_attr",
                                    {}).get("learning_rate", 1.0)
-                decay = getattr(eager, "regularizer", None) \
-                    or self._weight_decay
-            else:
-                decay = self._weight_decay
-            if decay is not None and not self._decoupled_weight_decay():
-                coeff = decay.coeff
+            coeff = 0.0 if global_clip else _coeff_for(pvar)
             sp.append_optimizer_update(self, pvar, gvar, lr_scale, coeff,
                                        clip=per_grad_clip)
         return None, pairs
@@ -375,6 +427,12 @@ class SGD(Optimizer):
     def _rule(self, param, grad, state, lr):
         return param - lr * grad.astype(param.dtype), state
 
+    def _apply_sparse(self, pv, sr, state, lr, hyper):
+        # scatter-add handles duplicate rows; mode='drop' ignores the
+        # static-size unique's fill rows
+        upd = (-lr * sr.values).astype(pv.dtype)
+        return pv.at[sr.rows].add(upd, mode="drop"), state
+
 
 class Momentum(Optimizer):
     def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
@@ -409,6 +467,7 @@ class Adam(Optimizer):
         self._beta1 = beta1
         self._beta2 = beta2
         self._epsilon = epsilon
+        self._lazy_mode = lazy_mode
 
     def _init_state(self, value):
         return {
@@ -417,6 +476,41 @@ class Adam(Optimizer):
             "beta1_pow": jnp.ones((), jnp.float32),
             "beta2_pow": jnp.ones((), jnp.float32),
         }
+
+    def _apply_sparse(self, pv, sr, state, lr, hyper):
+        """lazy_mode row-wise Adam (ref adam_op.h SelectedRows kernel +
+        lazy_mode): moments update only on the looked-up rows. Without
+        lazy_mode paddle still decays ALL moments — that needs the dense
+        path, so fall back."""
+        if not self._lazy_mode:
+            return super()._apply_sparse(pv, sr, state, lr, hyper)
+        beta1 = hyper["beta1"]
+        beta2 = hyper["beta2"]
+        epsilon = hyper["epsilon"]
+        sr = sr.coalesced()
+        rows, g = sr.rows, sr.values.astype(jnp.float32)
+        m_r = state["moment1"][rows].astype(jnp.float32)
+        v_r = state["moment2"][rows].astype(jnp.float32)
+        m_r = beta1 * m_r + (1 - beta1) * g
+        v_r = beta2 * v_r + (1 - beta2) * g * g
+        b1p = state["beta1_pow"] * beta1
+        b2p = state["beta2_pow"] * beta2
+        mhat = m_r / (1 - b1p)
+        vhat = v_r / (1 - b2p)
+        p_r = pv[rows].astype(jnp.float32)
+        coeff = hyper.get("coeff", 0.0)  # AdamW decoupled decay, row-wise
+        if coeff:
+            p_r = p_r * (1.0 - lr * coeff)
+        new_rows = p_r - lr * mhat / (jnp.sqrt(vhat) + epsilon)
+        new_p = pv.at[rows].set(new_rows.astype(pv.dtype), mode="drop")
+        new_state = {
+            "moment1": state["moment1"].at[rows].set(
+                m_r.astype(state["moment1"].dtype), mode="drop"),
+            "moment2": state["moment2"].at[rows].set(
+                v_r.astype(state["moment2"].dtype), mode="drop"),
+            "beta1_pow": b1p, "beta2_pow": b2p,
+        }
+        return new_p, new_state
 
     def _hyper(self):
         return {"beta1": self._beta1, "beta2": self._beta2,
@@ -442,7 +536,7 @@ class AdamW(Adam):
                  lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
                  lazy_mode=False, multi_precision=False, name=None):
         super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
-                         None, grad_clip)
+                         None, grad_clip, lazy_mode)
         self._coeff = float(weight_decay) if not isinstance(
             weight_decay, _Decay) else weight_decay.coeff
         self._apply_decay_param_fun = apply_decay_param_fun

@@ -41,12 +41,11 @@ def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
     if x.ndim > num_flatten_dims + 1:
         from ..tensor.manipulation import reshape
 
-        # -1 for the leading dims: capture-time shapes may carry
-        # placeholder batch dims (None -> 1), so never bake them into the
-        # recorded reshape attr
-        h = reshape(h, [-1] * num_flatten_dims + [in_features]) \
-            if num_flatten_dims == 1 else \
-            reshape(h, list(x.shape[:num_flatten_dims]) + [in_features])
+        # dim0 is -1: capture-time shapes may carry a placeholder batch
+        # dim (None -> 1), which must never be baked into the recorded
+        # reshape attr; the remaining leading dims are user-declared
+        h = reshape(h, [-1] + list(x.shape[1:num_flatten_dims])
+                    + [in_features])
     out = layer(h)
     if activation is not None:
         out = getattr(F, activation)(out)

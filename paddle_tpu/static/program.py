@@ -496,17 +496,23 @@ def append_backward(loss, parameter_list=None, no_grad_set=None):
     return pairs
 
 
-def append_global_norm_clip(params_grads, clip_norm):
+def append_global_norm_clip(params_grads, clip_norm, decay_coeffs=None):
     """Record a global-norm clip over all grads (ref fluid/clip.py
-    ClipGradByGlobalNorm) — rebinds each grad var to its clipped value."""
+    ClipGradByGlobalNorm) — rebinds each grad var to its clipped value.
+
+    decay_coeffs (optional, aligned with params_grads): coupled L2 decay
+    folded into each grad BEFORE the norm, matching the eager
+    _preprocess order (decay first, clip sees decay-included grads)."""
     blk = _main_program.global_block()
     out_names = []
     slots = []
-    for _, g in params_grads:
+    for p, g in params_grads:
         slots.append(("var", g.name))
+        slots.append(("var", p.name))
         out_names.append(g.name)  # rebind in place
     blk.append_op(OpDesc("@global_norm_clip", slots, out_names,
-                         {"clip_norm": float(clip_norm)}))
+                         {"clip_norm": float(clip_norm),
+                          "decay_coeffs": list(decay_coeffs or [])}))
     return params_grads
 
 
@@ -590,13 +596,21 @@ def _run_tail(ops, env, rng_key):
     any further plain ops."""
     for i, op in enumerate(ops):
         if op.type == "@global_norm_clip":
-            grads = [env[s[1]] for s in op.inputs]
+            gnames = [s[1] for s in op.inputs[0::2]]
+            pnames = [s[1] for s in op.inputs[1::2]]
+            coeffs = op.attrs.get("decay_coeffs") or [0.0] * len(gnames)
+            grads = []
+            for gn, pn, c in zip(gnames, pnames, coeffs):
+                g = env[gn]
+                if c:
+                    g = g + c * env[pn].astype(g.dtype)
+                grads.append(g)
             sq = sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
                      for g in grads)
             gnorm = jnp.sqrt(sq)
             clip = op.attrs["clip_norm"]
             scale = jnp.minimum(1.0, clip / jnp.maximum(gnorm, 1e-12))
-            for (kind, name), g in zip(op.inputs, grads):
+            for name, g in zip(gnames, grads):
                 env[name] = (g.astype(jnp.float32) * scale).astype(g.dtype)
         elif op.type == "@update":
             pname = op.inputs[0][1]

@@ -1,0 +1,118 @@
+"""SelectedRows sparse embedding gradients.
+
+Ref intent: paddle/fluid/framework/selected_rows.h + the SelectedRows
+kernels of lookup_table_v2_op / sgd_op / adam_op (lazy_mode), and
+unittests/test_adam_op.py lazy-mode cases: the sparse path must agree
+with the dense path numerically.
+"""
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.core.selected_rows import SelectedRows
+
+
+def _make(vocab=50, dim=8, sparse=False, seed=0):
+    paddle.seed(seed)
+    return nn.Embedding(vocab, dim, sparse=sparse)
+
+
+def test_sparse_backward_produces_selected_rows():
+    emb = _make(sparse=True)
+    ids = paddle.to_tensor(np.array([[1, 3], [3, 7]], np.int64))
+    out = emb(ids)
+    out.sum().backward()
+    g = emb.weight._grad
+    assert isinstance(g, SelectedRows)
+    assert g.height == 50
+    assert sorted(np.asarray(g.rows).tolist()) == [1, 3, 3, 7]
+    # densified sparse grad == dense-path grad
+    emb_d = _make(sparse=False)
+    emb_d.weight._value = emb.weight._value
+    out_d = emb_d(ids)
+    out_d.sum().backward()
+    np.testing.assert_allclose(np.asarray(g.to_dense()),
+                               np.asarray(emb_d.weight._grad), rtol=1e-6)
+
+
+def test_sparse_grad_accumulates_across_backwards():
+    emb = _make(sparse=True)
+    ids1 = paddle.to_tensor(np.array([1, 2], np.int64))
+    ids2 = paddle.to_tensor(np.array([2, 4], np.int64))
+    emb(ids1).sum().backward()
+    emb(ids2).sum().backward()
+    g = emb.weight._grad
+    assert isinstance(g, SelectedRows)
+    dense = np.asarray(g.to_dense())
+    assert dense[2].sum() == 2 * emb.weight.shape[1]  # hit twice
+    assert dense[1].sum() == emb.weight.shape[1]
+
+
+def test_padding_idx_rows_zero():
+    emb = nn.Embedding(20, 4, padding_idx=0, sparse=True)
+    ids = paddle.to_tensor(np.array([0, 5], np.int64))
+    emb(ids).sum().backward()
+    dense = np.asarray(emb.weight._grad.to_dense())
+    assert np.all(dense[0] == 0)
+    assert np.all(dense[5] == 1)
+
+
+def test_sgd_sparse_matches_dense():
+    ids = np.array([[3, 9, 3]], np.int64)
+    emb_s = _make(sparse=True, seed=7)
+    emb_d = _make(sparse=False, seed=7)
+    np.testing.assert_allclose(np.asarray(emb_s.weight._value),
+                               np.asarray(emb_d.weight._value))
+    for emb in (emb_s, emb_d):
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=emb.parameters())
+        loss = (emb(paddle.to_tensor(ids)) ** 2).sum()
+        loss.backward()
+        opt.step()
+    np.testing.assert_allclose(np.asarray(emb_s.weight._value),
+                               np.asarray(emb_d.weight._value), rtol=1e-5)
+
+
+def test_adam_lazy_sparse_first_step_matches_dense():
+    ids = np.array([[2, 5]], np.int64)
+    emb_s = _make(sparse=True, seed=3)
+    emb_d = _make(sparse=False, seed=3)
+    opt_s = paddle.optimizer.Adam(learning_rate=0.01, lazy_mode=True,
+                                  parameters=emb_s.parameters())
+    opt_d = paddle.optimizer.Adam(learning_rate=0.01,
+                                  parameters=emb_d.parameters())
+    for emb, opt in ((emb_s, opt_s), (emb_d, opt_d)):
+        (emb(paddle.to_tensor(ids)) ** 2).sum().backward()
+        opt.step()
+        opt.clear_grad()
+    # with zero-init moments the first lazy step equals the dense step
+    np.testing.assert_allclose(np.asarray(emb_s.weight._value),
+                               np.asarray(emb_d.weight._value),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_adam_lazy_trains():
+    emb = _make(vocab=30, dim=4, sparse=True, seed=1)
+    opt = paddle.optimizer.Adam(learning_rate=0.05, lazy_mode=True,
+                                parameters=emb.parameters())
+    ids = paddle.to_tensor(np.array([1, 4, 4, 9], np.int64))
+    losses = []
+    for _ in range(25):
+        loss = (emb(ids) ** 2).sum()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.1
+
+
+def test_adam_nonlazy_sparse_falls_back_dense():
+    emb = _make(sparse=True, seed=2)
+    opt = paddle.optimizer.Adam(learning_rate=0.01,
+                                parameters=emb.parameters())
+    ids = paddle.to_tensor(np.array([6], np.int64))
+    (emb(ids) ** 2).sum().backward()
+    opt.step()  # densify fallback must not crash
+    st = opt._accumulators[id(emb.weight)]
+    assert st["moment1"].shape == tuple(emb.weight.shape)
