@@ -101,8 +101,15 @@ class GradScaler:
             raise RuntimeError(
                 "unscale_() has already been called on this optimizer "
                 "since the last update()")
-        grads = [p._grad for p in optimizer._parameter_list or []
-                 if p is not None and p._grad is not None]
+        from ..core.selected_rows import SelectedRows
+
+        grads = []
+        for p in optimizer._parameter_list or []:
+            if p is None or p._grad is None:
+                continue
+            # sparse grads unscale their values array; rows are untouched
+            grads.append(p._grad.values
+                         if isinstance(p._grad, SelectedRows) else p._grad)
         if grads:
             inv = jnp.asarray(1.0 / self._scale, jnp.float32)
             new_grads, all_finite = self._unscale_check(grads, inv)
@@ -110,7 +117,11 @@ class GradScaler:
             for p in optimizer._parameter_list or []:
                 if p is None or p._grad is None:
                     continue
-                p._grad = new_grads[i]
+                if isinstance(p._grad, SelectedRows):
+                    p._grad = SelectedRows(p._grad.rows, new_grads[i],
+                                           p._grad.height)
+                else:
+                    p._grad = new_grads[i]
                 i += 1
             self._found_inf = not bool(all_finite)
         self._unscaled.add(id(optimizer))

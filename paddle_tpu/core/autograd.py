@@ -106,10 +106,12 @@ def _run_backward(tensors, grad_tensors, retain_graph, sinks=None):
     captured = {}
 
     def leaf_sink(t, g):
+        from .selected_rows import accumulate
+
         if sinks is None:
             t._accumulate_grad(g)
         elif id(t) in sinks:
-            captured[id(t)] = captured[id(t)] + g if id(t) in captured else g
+            captured[id(t)] = accumulate(captured.get(id(t)), g)
 
     cot = {}  # id(node) -> {out_idx: cotangent}
     node_of = {}
@@ -169,18 +171,21 @@ def _run_backward(tensors, grad_tensors, retain_graph, sinks=None):
                     continue
                 from .selected_rows import SelectedRows
 
+                if t._hooks and isinstance(g, SelectedRows):
+                    # hooks see the densified grad (once, not per hook); a
+                    # hook that edits it keeps the dense representation
+                    g = g.to_dense()
                 for hook in t._hooks:
-                    if isinstance(g, SelectedRows):
-                        # hooks see the densified grad; a hook that edits
-                        # it falls back to the dense representation
-                        out = hook(Tensor(g.to_dense(), stop_gradient=True))
-                    else:
-                        out = hook(Tensor(g, stop_gradient=True))
+                    out = hook(Tensor(g, stop_gradient=True))
                     if out is not None:
                         g = out._value if isinstance(out, Tensor) else out
                 if t._tape is None:
                     leaf_sink(t, g)
                 else:
+                    # a sparse cotangent flowing into an upstream vjp
+                    # closure must densify — jax vjp_fns take arrays only
+                    if isinstance(g, SelectedRows):
+                        g = g.to_dense()
                     pnode, pidx = t._tape
                     _accumulate(cot, pnode, pidx, g)
     return captured

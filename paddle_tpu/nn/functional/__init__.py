@@ -355,11 +355,15 @@ def _sparse_embedding(x, weight, padding_idx=None):
         return apply("lookup_table_v2", x, weight, padding_idx=pad)
 
     ids_t = x if isinstance(x, _T) else _T(x)
-    ids = jnp.asarray(ids_t._value).astype(jnp.int32)
-    w = weight._value
+    # same AMP autocast rewrite the dispatch funnel applies to the dense
+    # path, so sparse=True does not silently change dtype behaviour
+    ids, w = _dispatch._amp_rewrite(
+        "lookup_table_v2", [jnp.asarray(ids_t._value).astype(jnp.int32),
+                            weight._value])
 
     # same kernel as the dense path — only the backward differs
     out = _op_lookup("lookup_table_v2").fn(ids, w, padding_idx=pad)
+    _dispatch._maybe_check_nan_inf("lookup_table_v2", out)
 
     requires_grad = (_config.is_grad_enabled() and _config.is_tape_enabled()
                      and not weight.stop_gradient)

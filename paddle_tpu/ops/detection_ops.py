@@ -1,0 +1,318 @@
+"""Detection ops: boxes, anchors, NMS, RoI pooling.
+
+Ref parity: paddle/fluid/operators/detection/ (iou_similarity_op.cc,
+box_coder_op.cc, prior_box_op.cc, yolo_box_op.cu, roi_align_op.cu,
+multiclass_nms_op.cc). TPU-native: everything up to NMS is pure
+jax/XLA-traceable with static shapes (boxes stay fixed-size, scores
+carry the ranking); NMS itself emits a fixed `keep_top_k` result with a
+validity mask instead of the reference's dynamic-length LoD output —
+host-side postprocessing slices by the returned count.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.op_registry import register_op
+
+
+def _box_area(b, off):
+    return (jnp.maximum(b[..., 2] - b[..., 0] + off, 0)
+            * jnp.maximum(b[..., 3] - b[..., 1] + off, 0))
+
+
+def _iou(a, b, off=0.0):
+    """Pairwise IoU: a [..., N, 4], b [..., M, 4] -> [..., N, M]."""
+    ix1 = jnp.maximum(a[..., :, None, 0], b[..., None, :, 0])
+    iy1 = jnp.maximum(a[..., :, None, 1], b[..., None, :, 1])
+    ix2 = jnp.minimum(a[..., :, None, 2], b[..., None, :, 2])
+    iy2 = jnp.minimum(a[..., :, None, 3], b[..., None, :, 3])
+    inter = (jnp.maximum(ix2 - ix1 + off, 0)
+             * jnp.maximum(iy2 - iy1 + off, 0))
+    union = (_box_area(a, off)[..., :, None]
+             + _box_area(b, off)[..., None, :] - inter)
+    return jnp.where(union > 0, inter / jnp.maximum(union, 1e-10), 0.0)
+
+
+@register_op("iou_similarity", no_grad=True)
+def iou_similarity(x, y, *, box_normalized=True):
+    """ref detection/iou_similarity_op.cc: pairwise IoU [N,4]x[M,4]."""
+    off = 0.0 if box_normalized else 1.0
+    return _iou(jnp.asarray(x), jnp.asarray(y), off)
+
+
+@register_op("box_coder", no_grad=True)
+def box_coder(prior_box, prior_box_var, target_box, *,
+              code_type="encode_center_size", box_normalized=True,
+              axis=0):
+    """ref detection/box_coder_op.cc: encode corner boxes against priors
+    into (dx, dy, dw, dh) offsets, or decode offsets back to corners."""
+    pb = jnp.asarray(prior_box, jnp.float32)
+    tb = jnp.asarray(target_box, jnp.float32)
+    var = None if prior_box_var is None else jnp.asarray(
+        prior_box_var, jnp.float32)
+    norm = 0.0 if box_normalized else 1.0
+
+    pw = pb[:, 2] - pb[:, 0] + norm
+    ph = pb[:, 3] - pb[:, 1] + norm
+    pcx = pb[:, 0] + pw * 0.5
+    pcy = pb[:, 1] + ph * 0.5
+
+    if code_type == "encode_center_size":
+        tw = tb[:, 2] - tb[:, 0] + norm
+        th = tb[:, 3] - tb[:, 1] + norm
+        tcx = tb[:, 0] + tw * 0.5
+        tcy = tb[:, 1] + th * 0.5
+        # every target against every prior: [T, P, 4]
+        dx = (tcx[:, None] - pcx[None, :]) / pw[None, :]
+        dy = (tcy[:, None] - pcy[None, :]) / ph[None, :]
+        dw = jnp.log(jnp.maximum(tw[:, None] / pw[None, :], 1e-10))
+        dh = jnp.log(jnp.maximum(th[:, None] / ph[None, :], 1e-10))
+        out = jnp.stack([dx, dy, dw, dh], axis=-1)
+        if var is not None:
+            out = out / var[None, :, :]
+        return out
+
+    if code_type == "decode_center_size":
+        # tb: [N, P, 4] offsets (or broadcastable); axis selects which dim
+        # aligns with the priors
+        if tb.ndim == 2:
+            tb = tb[:, None, :]
+        if axis == 0:
+            pw_, ph_, pcx_, pcy_ = (pw[None, :], ph[None, :],
+                                    pcx[None, :], pcy[None, :])
+            v = var[None, :, :] if var is not None else None
+        else:
+            pw_, ph_, pcx_, pcy_ = (pw[:, None], ph[:, None],
+                                    pcx[:, None], pcy[:, None])
+            v = var[:, None, :] if var is not None else None
+        t = tb * v if v is not None else tb
+        cx = t[..., 0] * pw_ + pcx_
+        cy = t[..., 1] * ph_ + pcy_
+        w = jnp.exp(t[..., 2]) * pw_
+        h = jnp.exp(t[..., 3]) * ph_
+        return jnp.stack([cx - w * 0.5, cy - h * 0.5,
+                          cx + w * 0.5 - norm, cy + h * 0.5 - norm],
+                         axis=-1)
+    raise ValueError(f"unknown code_type {code_type!r}")
+
+
+@register_op("prior_box", no_grad=True)
+def prior_box(input, image, *, min_sizes, max_sizes=None,
+              aspect_ratios=(1.0,), variances=(0.1, 0.1, 0.2, 0.2),
+              flip=False, clip=False, step=(0.0, 0.0), offset=0.5,
+              min_max_aspect_ratios_order=False):
+    """ref detection/prior_box_op.cc (SSD anchors): one prior per
+    (cell, size/ratio combination) over the feature map grid.
+
+    input: [N, C, H, W] feature map; image: [N, C, IH, IW].
+    Returns (boxes [H, W, P, 4], variances [H, W, P, 4])."""
+    h, w = input.shape[2], input.shape[3]
+    ih, iw = image.shape[2], image.shape[3]
+
+    ratios = [1.0] if 1.0 not in aspect_ratios else []
+    ratios += list(aspect_ratios)
+    if flip:
+        ratios += [1.0 / r for r in aspect_ratios if r != 1.0]
+    # de-dup preserving order
+    seen, ars = set(), []
+    for r in ratios:
+        if round(r, 6) not in seen:
+            seen.add(round(r, 6))
+            ars.append(r)
+
+    step_w = step[0] or iw / w
+    step_h = step[1] or ih / h
+
+    whs = []
+    for ms in min_sizes:
+        for ar in ars:
+            whs.append((ms * (ar ** 0.5), ms / (ar ** 0.5)))
+        if max_sizes:
+            for mx in max_sizes:
+                s = (ms * mx) ** 0.5
+                whs.append((s, s))
+    whs = jnp.asarray(whs, jnp.float32)  # [P, 2]
+    p = whs.shape[0]
+
+    cx = (jnp.arange(w, dtype=jnp.float32) + offset) * step_w
+    cy = (jnp.arange(h, dtype=jnp.float32) + offset) * step_h
+    cxg, cyg = jnp.meshgrid(cx, cy)  # [H, W]
+    cxg = cxg[..., None]
+    cyg = cyg[..., None]
+    bw = whs[None, None, :, 0] * 0.5
+    bh = whs[None, None, :, 1] * 0.5
+    boxes = jnp.stack([(cxg - bw) / iw, (cyg - bh) / ih,
+                       (cxg + bw) / iw, (cyg + bh) / ih], axis=-1)
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variances, jnp.float32),
+                           (h, w, p, 4))
+    return boxes, var
+
+
+@register_op("yolo_box", no_grad=True)
+def yolo_box(x, img_size, *, anchors, class_num, conf_thresh=0.01,
+             downsample_ratio=32, clip_bbox=True, scale_x_y=1.0):
+    """ref detection/yolo_box_op.cu: decode one YOLOv3 head.
+
+    x: [N, A*(5+C), H, W]; img_size: [N, 2] (h, w).
+    Returns (boxes [N, A*H*W, 4], scores [N, A*H*W, C]); boxes whose
+    objectness < conf_thresh are zeroed like the reference."""
+    n, _, h, w = x.shape
+    a = len(anchors) // 2
+    c = class_num
+    anc = jnp.asarray(anchors, jnp.float32).reshape(a, 2)
+    x = x.reshape(n, a, 5 + c, h, w)
+
+    gx = jnp.arange(w, dtype=jnp.float32)[None, None, None, :]
+    gy = jnp.arange(h, dtype=jnp.float32)[None, None, :, None]
+    input_h = jnp.asarray(downsample_ratio * h, jnp.float32)
+    input_w = jnp.asarray(downsample_ratio * w, jnp.float32)
+
+    sig = jax.nn.sigmoid
+    bias = -0.5 * (scale_x_y - 1.0)
+    bx = (sig(x[:, :, 0]) * scale_x_y + bias + gx) / w
+    by = (sig(x[:, :, 1]) * scale_x_y + bias + gy) / h
+    bw = jnp.exp(x[:, :, 2]) * anc[None, :, 0, None, None] / input_w
+    bh = jnp.exp(x[:, :, 3]) * anc[None, :, 1, None, None] / input_h
+    obj = sig(x[:, :, 4])
+    cls = sig(x[:, :, 5:])  # [N, A, C, H, W]
+
+    img_h = img_size[:, 0].astype(jnp.float32)[:, None, None, None]
+    img_w = img_size[:, 1].astype(jnp.float32)[:, None, None, None]
+    x1 = (bx - bw * 0.5) * img_w
+    y1 = (by - bh * 0.5) * img_h
+    x2 = (bx + bw * 0.5) * img_w
+    y2 = (by + bh * 0.5) * img_h
+    if clip_bbox:
+        x1 = jnp.clip(x1, 0, img_w - 1)
+        y1 = jnp.clip(y1, 0, img_h - 1)
+        x2 = jnp.clip(x2, 0, img_w - 1)
+        y2 = jnp.clip(y2, 0, img_h - 1)
+    keep = (obj > conf_thresh).astype(x1.dtype)
+    boxes = jnp.stack([x1, y1, x2, y2], axis=-1) * keep[..., None]
+    scores = cls * (obj[:, :, None] * (obj > conf_thresh)[:, :, None])
+    boxes = boxes.reshape(n, a * h * w, 4)
+    scores = jnp.moveaxis(scores, 2, -1).reshape(n, a * h * w, c)
+    return boxes, scores
+
+
+@register_op("roi_align")
+def roi_align(x, boxes, boxes_num, *, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True):
+    """ref roi_align_op.cu: bilinear average pooling inside each RoI.
+
+    x: [N, C, H, W]; boxes: [R, 4] (x1, y1, x2, y2 in image coords);
+    boxes_num: [N] rois per image. Differentiable w.r.t. x."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    n, c, h, w = x.shape
+    r = boxes.shape[0]
+    boxes = jnp.asarray(boxes, jnp.float32)
+    bn = jnp.asarray(boxes_num, jnp.int32)
+    # image index per roi from boxes_num (cumulative)
+    img_of_roi = jnp.searchsorted(jnp.cumsum(bn), jnp.arange(r),
+                                  side="right").astype(jnp.int32)
+
+    off = 0.5 if aligned else 0.0
+    x1 = boxes[:, 0] * spatial_scale - off
+    y1 = boxes[:, 1] * spatial_scale - off
+    x2 = boxes[:, 2] * spatial_scale - off
+    y2 = boxes[:, 3] * spatial_scale - off
+    rw = x2 - x1
+    rh = y2 - y1
+    if not aligned:
+        rw = jnp.maximum(rw, 1.0)
+        rh = jnp.maximum(rh, 1.0)
+    bin_w = rw / pw
+    bin_h = rh / ph
+    s = sampling_ratio if sampling_ratio > 0 else 2
+    # sample grid: [R, ph*s] x [R, pw*s]
+    iy = (jnp.arange(ph * s, dtype=jnp.float32) + 0.5) / s
+    ix = (jnp.arange(pw * s, dtype=jnp.float32) + 0.5) / s
+    sy = y1[:, None] + iy[None, :] * bin_h[:, None]  # [R, ph*s]
+    sx = x1[:, None] + ix[None, :] * bin_w[:, None]  # [R, pw*s]
+
+    def bilinear(img, yy, xx):
+        """img [C,H,W], yy [ph*s], xx [pw*s] -> [C, ph*s, pw*s]"""
+        y0 = jnp.floor(yy)
+        x0 = jnp.floor(xx)
+        wy1 = yy - y0
+        wx1 = xx - x0
+        y0i = jnp.clip(y0.astype(jnp.int32), 0, h - 1)
+        y1i = jnp.clip(y0i + 1, 0, h - 1)
+        x0i = jnp.clip(x0.astype(jnp.int32), 0, w - 1)
+        x1i = jnp.clip(x0i + 1, 0, w - 1)
+        inside_y = ((yy >= -1.0) & (yy <= h)).astype(img.dtype)
+        inside_x = ((xx >= -1.0) & (xx <= w)).astype(img.dtype)
+        g = lambda yi, xi: img[:, yi][:, :, xi]  # noqa: E731
+        v = (g(y0i, x0i) * ((1 - wy1)[:, None] * (1 - wx1)[None, :])
+             + g(y0i, x1i) * ((1 - wy1)[:, None] * wx1[None, :])
+             + g(y1i, x0i) * (wy1[:, None] * (1 - wx1)[None, :])
+             + g(y1i, x1i) * (wy1[:, None] * wx1[None, :]))
+        return v * inside_y[None, :, None] * inside_x[None, None, :]
+
+    def per_roi(roi_i):
+        img = x[img_of_roi[roi_i]]
+        v = bilinear(img, sy[roi_i], sx[roi_i])  # [C, ph*s, pw*s]
+        v = v.reshape(c, ph, s, pw, s)
+        return v.mean(axis=(2, 4))
+
+    return jax.vmap(per_roi)(jnp.arange(r))
+
+
+@register_op("multiclass_nms3", no_grad=True, has_aux=False)
+def multiclass_nms3(bboxes, scores, *, score_threshold=0.05, nms_top_k=400,
+                    keep_top_k=100, nms_threshold=0.3, normalized=True,
+                    nms_eta=1.0, background_label=-1):
+    """ref detection/multiclass_nms_op.cc (v3). TPU-native: fixed-size
+    output — greedy per-class NMS over the top nms_top_k candidates,
+    returning exactly keep_top_k rows [label, score, x1, y1, x2, y2]
+    (invalid rows have label -1) plus the valid count. Static shapes =
+    jit/batch friendly; the reference's LoD output is the host-side
+    slice out[:count]."""
+    bboxes = jnp.asarray(bboxes)  # [M, 4] single image
+    scores = jnp.asarray(scores)  # [C, M]
+    c, m = scores.shape
+    off = 0.0 if normalized else 1.0
+    iou = _iou(bboxes, bboxes, off)  # [M, M]
+
+    top_k = min(nms_top_k, m)
+
+    def one_class(cls_scores):
+        s, idx = jax.lax.top_k(cls_scores, top_k)
+        valid = s > score_threshold
+        sub_iou = iou[idx][:, idx]
+
+        def body(i, keep):
+            # suppressed if it overlaps any higher-scoring kept box
+            sup = jnp.any(jnp.where(jnp.arange(top_k) < i,
+                                    (sub_iou[i] > nms_threshold) & keep,
+                                    False))
+            return keep.at[i].set(valid[i] & ~sup)
+
+        keep = jax.lax.fori_loop(0, top_k,
+                                 body, jnp.zeros(top_k, bool))
+        return s, idx, keep
+
+    s_all, idx_all, keep_all = jax.vmap(one_class)(scores)
+    labels = jnp.broadcast_to(jnp.arange(c)[:, None], (c, top_k))
+    if background_label >= 0:
+        keep_all = keep_all & (labels != background_label)
+
+    flat_scores = jnp.where(keep_all, s_all, -jnp.inf).reshape(-1)
+    k = min(keep_top_k, flat_scores.shape[0])
+    best, flat_pos = jax.lax.top_k(flat_scores, k)
+    flat_labels = labels.reshape(-1)[flat_pos]
+    flat_box_idx = idx_all.reshape(-1)[flat_pos]
+    valid_out = jnp.isfinite(best)
+    out = jnp.concatenate([
+        jnp.where(valid_out, flat_labels, -1)[:, None].astype(jnp.float32),
+        jnp.where(valid_out, best, 0.0)[:, None],
+        bboxes[flat_box_idx] * valid_out[:, None].astype(bboxes.dtype),
+    ], axis=1)
+    count = valid_out.sum().astype(jnp.int32)
+    return out, count

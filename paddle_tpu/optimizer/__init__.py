@@ -102,7 +102,7 @@ class Optimizer:
             if p is None or p.stop_gradient or p._grad is None:
                 continue
             if isinstance(p._grad, SelectedRows):
-                decay = p.regularizer if p.regularizer is not None \
+                decay = p.regularizer if getattr(p, "regularizer", None) is not None \
                     else self._weight_decay
                 if self._grad_clip is not None or (
                         decay is not None
@@ -128,14 +128,14 @@ class Optimizer:
         lr = self.get_lr()
         for p, g in params_grads:
             state = self._state_for(p)
-            plr = lr * p.optimize_attr.get("learning_rate", 1.0)
+            plr = lr * getattr(p, "optimize_attr", {}).get("learning_rate", 1.0)
             new_p, new_state = self._run_rule(
                 p._value, g._value, state, plr, self._hyper_for(p))
             p._value = new_p
             self._accumulators[id(p)] = new_state
         for p, sr in sparse_pg:
             state = self._state_for(p)
-            plr = lr * p.optimize_attr.get("learning_rate", 1.0)
+            plr = lr * getattr(p, "optimize_attr", {}).get("learning_rate", 1.0)
             new_p, new_state = self._apply_sparse(
                 p._value, sr, state, plr, self._hyper_for(p))
             p._value = new_p
@@ -168,7 +168,7 @@ class Optimizer:
         # wins over the optimizer-level setting, paddle semantics)
         out = []
         for p, g in params_grads:
-            decay = p.regularizer if p.regularizer is not None \
+            decay = p.regularizer if getattr(p, "regularizer", None) is not None \
                 else self._weight_decay
             if decay is not None and not self._decoupled_weight_decay():
                 g = Tensor(g._value + decay.coeff * p._value)
@@ -562,26 +562,15 @@ class AdamW(Adam):
         return super()._rule(p, grad, state, lr, beta1=beta1, beta2=beta2,
                              epsilon=epsilon)
 
-    @config.no_grad()
-    def step(self):
-        # honour apply_decay_param_fun by zeroing coeff per-param
-        if self._apply_decay_param_fun is None:
-            return super().step()
-        self._global_step += 1
-        params_grads = self._preprocess(
-            [(p, Tensor(p._grad)) for p in self._parameter_list
-             if p is not None and not p.stop_gradient and p._grad is not None])
-        lr = self.get_lr()
-        hyper = self._hyper()
-        for p, g in params_grads:
-            h = dict(hyper)
-            if not self._apply_decay_param_fun(p.name or ""):
-                h["coeff"] = 0.0
-            state = self._state_for(p)
-            plr = lr * p.optimize_attr.get("learning_rate", 1.0)
-            new_p, new_state = self._rule(p._value, g._value, state, plr, **h)
-            p._value = new_p
-            self._accumulators[id(p)] = new_state
+    def _hyper_for(self, p):
+        # honour apply_decay_param_fun by zeroing coeff per-param; the
+        # base step() (dense AND sparse paths) consults this per leaf
+        h = self._hyper()
+        if self._apply_decay_param_fun is not None and \
+                not self._apply_decay_param_fun(p.name or ""):
+            h = dict(h)
+            h["coeff"] = 0.0
+        return h
 
 
 class Adamax(Optimizer):

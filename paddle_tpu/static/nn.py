@@ -16,7 +16,39 @@ import jax.numpy as jnp
 from ..core.tensor import Tensor
 
 __all__ = ["cond", "while_loop", "switch_case", "case", "fc", "embedding",
-           "conv2d", "batch_norm"]
+           "conv2d", "batch_norm", "sequence_pad", "sequence_unpad",
+           "sequence_pool", "sequence_softmax", "sequence_reverse",
+           "sequence_expand", "sequence_first_step", "sequence_last_step",
+           "sequence_conv"]
+
+
+# ---------------------------------------------------------------------------
+# sequence ops (ref python/paddle/fluid/layers/sequence_lod.py; kernels in
+# ops/sequence_ops.py — padded+mask replaces LoD, SURVEY §7 hard part #4)
+# ---------------------------------------------------------------------------
+
+
+def _seq(name):
+    from ..core.dispatch import apply
+
+    def wrapper(*args, **kwargs):
+        return apply(name, *args, **kwargs)
+
+    wrapper.__name__ = name
+    wrapper.__doc__ = (f"{name}(data, lengths, ...) — see "
+                       "paddle_tpu/ops/sequence_ops.py")
+    return wrapper
+
+
+sequence_pad = _seq("sequence_pad")
+sequence_unpad = _seq("sequence_unpad")
+sequence_pool = _seq("sequence_pool")
+sequence_softmax = _seq("sequence_softmax")
+sequence_reverse = _seq("sequence_reverse")
+sequence_expand = _seq("sequence_expand")
+sequence_first_step = _seq("sequence_first_step")
+sequence_last_step = _seq("sequence_last_step")
+sequence_conv = _seq("sequence_conv")
 
 
 # ---------------------------------------------------------------------------

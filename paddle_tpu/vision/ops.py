@@ -1,0 +1,78 @@
+"""paddle.vision.ops — detection operators.
+
+Ref parity: python/paddle/vision/ops.py (yolo_box, roi_align, ...) and
+python/paddle/fluid/layers/detection.py (prior_box, box_coder,
+iou_similarity, multiclass_nms). Kernels live in
+paddle_tpu/ops/detection_ops.py (XLA-traceable, static shapes).
+"""
+
+from __future__ import annotations
+
+from ..core.dispatch import apply
+
+__all__ = ["yolo_box", "prior_box", "box_coder", "iou_similarity",
+           "roi_align", "multiclass_nms", "matrix_nms"]
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh=0.01,
+             downsample_ratio=32, clip_bbox=True, name=None,
+             scale_x_y=1.0):
+    return apply("yolo_box", x, img_size, anchors=list(anchors),
+                 class_num=class_num, conf_thresh=conf_thresh,
+                 downsample_ratio=downsample_ratio, clip_bbox=clip_bbox,
+                 scale_x_y=scale_x_y)
+
+
+def prior_box(input, image, min_sizes, max_sizes=None,
+              aspect_ratios=(1.0,), variance=(0.1, 0.1, 0.2, 0.2),
+              flip=False, clip=False, steps=(0.0, 0.0), offset=0.5,
+              name=None, min_max_aspect_ratios_order=False):
+    return apply("prior_box", input, image, min_sizes=list(min_sizes),
+                 max_sizes=list(max_sizes) if max_sizes else None,
+                 aspect_ratios=tuple(aspect_ratios),
+                 variances=tuple(variance), flip=flip, clip=clip,
+                 step=tuple(steps), offset=offset,
+                 min_max_aspect_ratios_order=min_max_aspect_ratios_order)
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True,
+              axis=0, name=None):
+    return apply("box_coder", prior_box, prior_box_var, target_box,
+                 code_type=code_type, box_normalized=box_normalized,
+                 axis=axis)
+
+
+def iou_similarity(x, y, box_normalized=True, name=None):
+    return apply("iou_similarity", x, y, box_normalized=box_normalized)
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    return apply("roi_align", x, boxes, boxes_num,
+                 output_size=output_size, spatial_scale=spatial_scale,
+                 sampling_ratio=sampling_ratio, aligned=aligned)
+
+
+def multiclass_nms(bboxes, scores, score_threshold=0.05, nms_top_k=400,
+                   keep_top_k=100, nms_threshold=0.3, normalized=True,
+                   nms_eta=1.0, background_label=-1, name=None):
+    """Fixed-size NMS: returns (out [keep_top_k, 6], valid_count). Slice
+    `out[:valid_count]` host-side for the reference's ragged output."""
+    return apply("multiclass_nms3", bboxes, scores,
+                 score_threshold=score_threshold, nms_top_k=nms_top_k,
+                 keep_top_k=keep_top_k, nms_threshold=nms_threshold,
+                 normalized=normalized, nms_eta=nms_eta,
+                 background_label=background_label)
+
+
+def matrix_nms(bboxes, scores, score_threshold=0.05, post_threshold=0.0,
+               nms_top_k=400, keep_top_k=100, use_gaussian=False,
+               gaussian_sigma=2.0, background_label=0, normalized=True,
+               name=None):
+    return apply("matrix_nms", bboxes, scores,
+                 score_threshold=score_threshold,
+                 post_threshold=post_threshold, nms_top_k=nms_top_k,
+                 keep_top_k=keep_top_k, use_gaussian=use_gaussian,
+                 gaussian_sigma=gaussian_sigma,
+                 background_label=background_label, normalized=normalized)

@@ -1247,5 +1247,204 @@ case("sequence_mask", [np.array([1, 3, 2], np.int64)], {"maxlen": 4},
      grad=None, bf16=False)
 
 # ===========================================================================
+# sequence ops (padded+mask; ops/sequence_ops.py)
+# ===========================================================================
+
+_SEQ_ROWS = np.arange(12, dtype=np.float32).reshape(6, 2)
+_SEQ_LEN = np.array([2, 1, 3], np.int32)
+
+
+def _np_seq_pad(x, lengths, pad_value=0.0, maxlen=None):
+    t = int(lengths.max()) if maxlen is None else maxlen
+    out = np.full((len(lengths), t) + x.shape[1:], pad_value, x.dtype)
+    s = 0
+    for b, n in enumerate(lengths):
+        out[b, :n] = x[s:s + n]
+        s += n
+    return out
+
+
+case("sequence_pad", [_SEQ_ROWS, _SEQ_LEN], {"pad_value": -1.0},
+     ref=_np_seq_pad, grad=(0,), bf16=True)
+
+_SEQ_PADDED = _np_seq_pad(_SEQ_ROWS, _SEQ_LEN)
+
+case("sequence_unpad", [_SEQ_PADDED, _SEQ_LEN], {"total": 6},
+     ref=lambda x, lengths, total: _SEQ_ROWS, grad=(0,), bf16=True)
+
+
+def _np_seq_pool(x, lengths, pool_type="sum"):
+    outs = []
+    for b, n in enumerate(lengths):
+        v = x[b, :n]
+        if pool_type == "sum":
+            outs.append(v.sum(0))
+        elif pool_type == "mean":
+            outs.append(v.mean(0))
+        elif pool_type == "max":
+            outs.append(v.max(0))
+    return np.stack(outs)
+
+
+case("sequence_pool", [_SEQ_PADDED, _SEQ_LEN], {"pool_type": "sum"},
+     ref=_np_seq_pool, grad=(0,), bf16=True)
+case("sequence_pool", [_SEQ_PADDED, _SEQ_LEN], {"pool_type": "mean"},
+     ref=_np_seq_pool, grad=(0,), bf16=True)
+case("sequence_pool", [_SEQ_PADDED, _SEQ_LEN], {"pool_type": "max"},
+     ref=_np_seq_pool, grad=(0,), bf16=True)
+
+
+def _np_seq_softmax(x, lengths):
+    out = np.zeros_like(x)
+    for b, n in enumerate(lengths):
+        z = x[b, :n] - x[b, :n].max(0, keepdims=True)
+        e = np.exp(z)
+        out[b, :n] = e / e.sum(0, keepdims=True)
+    return out
+
+
+case("sequence_softmax", [f32((2, 4, 1), seed=3),
+                          np.array([2, 4], np.int32)], {},
+     ref=_np_seq_softmax, grad=(0,), bf16=True)
+
+
+def _np_seq_reverse(x, lengths):
+    out = x.copy()
+    for b, n in enumerate(lengths):
+        out[b, :n] = x[b, :n][::-1]
+    return out
+
+
+case("sequence_reverse", [f32((2, 4, 3), seed=4),
+                          np.array([3, 4], np.int32)], {},
+     ref=_np_seq_reverse, grad=(0,), bf16=True)
+
+
+def _np_seq_expand(x, repeats):
+    r = int(repeats.max())
+    out = np.zeros((x.shape[0], r) + x.shape[1:], x.dtype)
+    for b, n in enumerate(repeats):
+        out[b, :n] = x[b]
+    return out
+
+
+case("sequence_expand", [f32((3, 2), seed=5), np.array([2, 1, 3], np.int32)],
+     {}, ref=_np_seq_expand, grad=(0,), bf16=True)
+
+case("sequence_first_step", [_SEQ_PADDED, _SEQ_LEN], {},
+     ref=lambda x, lengths: x[:, 0], grad=(0,), bf16=True)
+case("sequence_last_step", [_SEQ_PADDED, _SEQ_LEN], {},
+     ref=lambda x, lengths: np.stack(
+         [x[b, n - 1] for b, n in enumerate(lengths)]),
+     grad=(0,), bf16=True)
+
+
+def _np_seq_conv(x, w, context_length=3, context_start=None, lengths=None):
+    b, t, d = x.shape
+    start = -((context_length - 1) // 2) if context_start is None \
+        else context_start
+    cols = []
+    for k in range(context_length):
+        off = start + k
+        s = np.zeros_like(x)
+        for ti in range(t):
+            src = ti + off
+            if 0 <= src < t:
+                s[:, ti] = x[:, src]
+        cols.append(s)
+    return np.concatenate(cols, -1) @ w
+
+
+case("sequence_conv", [f32((2, 5, 3), seed=6), f32((9, 2), seed=7)],
+     {"context_length": 3}, ref=_np_seq_conv, grad=(0, 1), bf16=True)
+
+# ===========================================================================
+# detection ops (ops/detection_ops.py)
+# ===========================================================================
+
+_DET_A = np.array([[0, 0, 2, 2], [1, 1, 3, 3]], np.float32)
+_DET_B = np.array([[0, 0, 2, 2], [5, 5, 6, 6]], np.float32)
+
+
+def _np_iou(x, y, box_normalized=True):
+    off = 0.0 if box_normalized else 1.0
+    out = np.zeros((len(x), len(y)), np.float32)
+    for i, a in enumerate(x):
+        for j, b in enumerate(y):
+            iw = max(min(a[2], b[2]) - max(a[0], b[0]) + off, 0)
+            ih = max(min(a[3], b[3]) - max(a[1], b[1]) + off, 0)
+            inter = iw * ih
+            ua = ((a[2] - a[0] + off) * (a[3] - a[1] + off)
+                  + (b[2] - b[0] + off) * (b[3] - b[1] + off) - inter)
+            out[i, j] = inter / ua if ua > 0 else 0.0
+    return out
+
+
+case("iou_similarity", [_DET_A, _DET_B], {}, ref=_np_iou, grad=None,
+     bf16=False)
+
+_BC_PRIORS = np.array([[0., 0., 2., 2.], [1., 1., 4., 5.]], np.float32)
+_BC_VAR = np.full((2, 4), 0.1, np.float32)
+_BC_TARGETS = np.array([[0.5, 0.5, 2.5, 2.5]], np.float32)
+
+
+def _bc_prop(outs, inputs, attrs):
+    enc = np.asarray(outs[0])
+    assert enc.shape == (1, 2, 4)
+    # target center (1.5,1.5) vs prior0 center (1,1), size 2 -> dx=dy=0.25
+    np.testing.assert_allclose(enc[0, 0, :2], [2.5, 2.5], rtol=1e-5)
+
+
+case("box_coder", [_BC_PRIORS, _BC_VAR, _BC_TARGETS],
+     {"code_type": "encode_center_size"}, prop=_bc_prop, grad=None,
+     bf16=False)
+
+
+def _pb_prop(outs, inputs, attrs):
+    boxes, var = (np.asarray(o) for o in outs)
+    assert boxes.shape == (2, 2, 2, 4) and var.shape == boxes.shape
+    assert (boxes[..., 2] >= boxes[..., 0]).all()
+    np.testing.assert_allclose(var[..., 0], 0.1, rtol=1e-6)
+
+
+case("prior_box", [np.zeros((1, 4, 2, 2), np.float32),
+                   np.zeros((1, 3, 32, 32), np.float32)],
+     {"min_sizes": [8.0], "aspect_ratios": (1.0, 2.0), "clip": True},
+     prop=_pb_prop, grad=None, bf16=False)
+
+
+def _yb_prop(outs, inputs, attrs):
+    boxes, scores = (np.asarray(o) for o in outs)
+    assert boxes.shape == (1, 8, 4) and scores.shape == (1, 8, 3)
+    np.testing.assert_allclose(scores, 0.25, rtol=1e-5)
+
+
+case("yolo_box", [np.zeros((1, 16, 2, 2), np.float32),
+                  np.array([[64, 64]], np.int32)],
+     {"anchors": [10, 13, 16, 30], "class_num": 3, "conf_thresh": 0.4},
+     prop=_yb_prop, grad=None, bf16=False)
+
+case("roi_align", [np.full((1, 1, 8, 8), 3.0, np.float32),
+                   np.array([[0, 0, 4, 4]], np.float32),
+                   np.array([1], np.int32)],
+     {"output_size": 2},
+     ref=lambda x, boxes, bn, **kw: np.full((1, 1, 2, 2), 3.0, np.float32),
+     grad=(0,), bf16=True)
+
+
+def _mc_nms_prop(outs, inputs, attrs):
+    out, count = np.asarray(outs[0]), int(np.asarray(outs[1]))
+    assert count == 2
+    np.testing.assert_allclose(out[:2, 1], [0.9, 0.7], rtol=1e-6)
+
+
+case("multiclass_nms3",
+     [np.array([[0, 0, 10, 10], [1, 1, 11, 11], [50, 50, 60, 60]],
+               np.float32),
+      np.array([[0.9, 0.8, 0.7]], np.float32)],
+     {"score_threshold": 0.1, "nms_threshold": 0.5, "keep_top_k": 10},
+     prop=_mc_nms_prop, grad=None, bf16=False)
+
+# ===========================================================================
 # known-unimplemented ops (tracked; implementing removes from this set)
 # ===========================================================================

@@ -1,0 +1,184 @@
+"""Sequence (padded+mask LoD replacement) and detection op families.
+
+Ref intent: unittests/sequence/test_sequence_pad_op.py,
+test_sequence_pool.py, test_sequence_softmax_op.py, and
+unittests/test_iou_similarity_op.py, test_box_coder_op.py,
+test_yolo_box_op.py, test_roi_align_op.py, test_multiclass_nms_op.py —
+numpy-referenced checks per op.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core.dispatch import apply
+from paddle_tpu.vision import ops as vops
+
+
+# -- sequence ---------------------------------------------------------------
+
+
+def test_sequence_pad_unpad_roundtrip():
+    rows = np.arange(12, dtype=np.float32).reshape(6, 2)
+    lengths = np.array([2, 1, 3])
+    padded = apply("sequence_pad", rows, lengths, pad_value=-1.0)
+    assert padded.shape == [3, 3, 2]
+    got = np.asarray(padded)
+    np.testing.assert_allclose(got[0, :2], rows[:2])
+    assert np.all(got[0, 2] == -1)
+    np.testing.assert_allclose(got[1, 0], rows[2])
+    np.testing.assert_allclose(got[2], rows[3:6])
+
+    flat = apply("sequence_unpad", padded, lengths, total=6)
+    np.testing.assert_allclose(np.asarray(flat), rows)
+
+
+@pytest.mark.parametrize("pool,expect", [
+    ("sum", [[3.0], [3.0]]),
+    ("mean", [[1.5], [3.0]]),
+    ("max", [[2.0], [3.0]]),
+    ("first", [[1.0], [3.0]]),
+    ("last", [[2.0], [3.0]]),
+])
+def test_sequence_pool(pool, expect):
+    x = np.array([[[1.0], [2.0], [99.0]],
+                  [[3.0], [98.0], [97.0]]], np.float32)
+    lengths = np.array([2, 1])
+    out = apply("sequence_pool", x, lengths, pool_type=pool)
+    np.testing.assert_allclose(np.asarray(out), expect)
+
+
+def test_sequence_softmax_masks_padding():
+    x = np.zeros((2, 4, 1), np.float32)
+    lengths = np.array([2, 4])
+    out = np.asarray(apply("sequence_softmax", x, lengths))
+    np.testing.assert_allclose(out[0, :2, 0], [0.5, 0.5], rtol=1e-6)
+    np.testing.assert_allclose(out[0, 2:, 0], [0.0, 0.0])
+    np.testing.assert_allclose(out[1, :, 0], [0.25] * 4, rtol=1e-6)
+
+
+def test_sequence_reverse():
+    x = np.arange(8, dtype=np.float32).reshape(2, 4, 1)
+    lengths = np.array([3, 4])
+    out = np.asarray(apply("sequence_reverse", x, lengths))
+    np.testing.assert_allclose(out[0, :, 0], [2, 1, 0, 3])
+    np.testing.assert_allclose(out[1, :, 0], [7, 6, 5, 4])
+
+
+def test_sequence_pool_grad_flows():
+    x = paddle.to_tensor(np.random.randn(2, 3, 4).astype(np.float32))
+    x.stop_gradient = False
+    out = apply("sequence_pool", x, np.array([2, 3]), pool_type="mean")
+    out.sum().backward()
+    g = np.asarray(x.grad)
+    np.testing.assert_allclose(g[0, :2], np.full((2, 4), 0.5), rtol=1e-6)
+    np.testing.assert_allclose(g[0, 2], np.zeros(4))
+
+
+def test_sequence_conv_matches_manual():
+    rng = np.random.RandomState(0)
+    x = rng.randn(1, 5, 3).astype(np.float32)
+    w = rng.randn(9, 2).astype(np.float32)
+    out = np.asarray(apply("sequence_conv", x, w, context_length=3))
+    # manual: window [-1, 0, 1] with zero padding
+    padded = np.concatenate([np.zeros((1, 1, 3)), x, np.zeros((1, 1, 3))],
+                            axis=1)
+    ctx = np.concatenate([padded[:, 0:5], padded[:, 1:6], padded[:, 2:7]],
+                         axis=-1)
+    np.testing.assert_allclose(out, ctx @ w, rtol=1e-5)
+
+
+# -- detection --------------------------------------------------------------
+
+
+def test_iou_similarity():
+    a = np.array([[0, 0, 2, 2]], np.float32)
+    b = np.array([[0, 0, 2, 2], [1, 1, 3, 3], [5, 5, 6, 6]], np.float32)
+    iou = np.asarray(vops.iou_similarity(paddle.to_tensor(a),
+                                         paddle.to_tensor(b)))
+    np.testing.assert_allclose(iou[0], [1.0, 1.0 / 7.0, 0.0], rtol=1e-5)
+
+
+def test_box_coder_roundtrip():
+    rng = np.random.RandomState(1)
+    priors = np.abs(rng.randn(4, 4).astype(np.float32))
+    priors[:, 2:] = priors[:, :2] + 1.0 + np.abs(rng.randn(4, 2)).astype(
+        np.float32)
+    var = np.full((4, 4), 0.1, np.float32)
+    targets = priors + 0.1
+
+    enc = vops.box_coder(paddle.to_tensor(priors), paddle.to_tensor(var),
+                         paddle.to_tensor(targets),
+                         code_type="encode_center_size")
+    # decode the diagonal (each target against its own prior)
+    codes = np.asarray(enc)[np.arange(4), np.arange(4)][None]  # [1, 4, 4]
+    dec = vops.box_coder(paddle.to_tensor(priors), paddle.to_tensor(var),
+                         paddle.to_tensor(
+                             np.transpose(codes, (1, 0, 2))),
+                         code_type="decode_center_size", axis=1)
+    got = np.asarray(dec)[:, 0, :]
+    np.testing.assert_allclose(got, targets, rtol=1e-4, atol=1e-4)
+
+
+def test_prior_box_shapes_and_range():
+    feat = paddle.zeros([1, 8, 4, 4])
+    img = paddle.zeros([1, 3, 64, 64])
+    boxes, var = vops.prior_box(feat, img, min_sizes=[16.0],
+                                aspect_ratios=(1.0, 2.0), clip=True)
+    # num_priors = len(expanded aspect_ratios) * len(min_sizes) = 2
+    assert boxes.shape == [4, 4, 2, 4]
+    assert var.shape == [4, 4, 2, 4]
+    b = np.asarray(boxes)
+    assert b.min() >= 0.0 and b.max() <= 1.0
+
+
+def test_yolo_box_decodes():
+    n, a, c, h, w = 1, 2, 3, 2, 2
+    x = np.zeros((n, a * (5 + c), h, w), np.float32)
+    img_size = np.array([[64, 64]], np.int32)
+    boxes, scores = vops.yolo_box(paddle.to_tensor(x),
+                                  paddle.to_tensor(img_size),
+                                  anchors=[10, 13, 16, 30], class_num=c,
+                                  conf_thresh=0.4, downsample_ratio=32)
+    assert boxes.shape == [1, a * h * w, 4]
+    assert scores.shape == [1, a * h * w, c]
+    # sigmoid(0)=0.5 objectness > 0.4 -> boxes kept, score = 0.25
+    np.testing.assert_allclose(np.asarray(scores), 0.25, rtol=1e-5)
+
+
+def test_roi_align_constant_map():
+    x = np.full((1, 1, 8, 8), 3.0, np.float32)
+    boxes = np.array([[0, 0, 4, 4]], np.float32)
+    out = vops.roi_align(paddle.to_tensor(x), paddle.to_tensor(boxes),
+                         paddle.to_tensor(np.array([1], np.int32)),
+                         output_size=2)
+    assert out.shape == [1, 1, 2, 2]
+    np.testing.assert_allclose(np.asarray(out), 3.0, rtol=1e-5)
+
+
+def test_roi_align_grad_flows():
+    x = paddle.to_tensor(np.random.randn(1, 2, 8, 8).astype(np.float32))
+    x.stop_gradient = False
+    boxes = paddle.to_tensor(np.array([[1, 1, 6, 6]], np.float32))
+    out = vops.roi_align(x, boxes,
+                         paddle.to_tensor(np.array([1], np.int32)),
+                         output_size=2)
+    out.sum().backward()
+    assert x.grad is not None
+    assert float(np.abs(np.asarray(x.grad)).sum()) > 0
+
+
+def test_multiclass_nms_suppresses():
+    # two overlapping boxes + one far box, one class
+    bboxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11], [50, 50, 60, 60]],
+                      np.float32)
+    scores = np.array([[0.9, 0.8, 0.7]], np.float32)
+    out, count = vops.multiclass_nms(paddle.to_tensor(bboxes),
+                                     paddle.to_tensor(scores),
+                                     score_threshold=0.1,
+                                     nms_threshold=0.5, keep_top_k=10)
+    assert int(count) == 2  # the 0.8 box is suppressed by the 0.9 box
+    rows = np.asarray(out)[: int(count)]
+    np.testing.assert_allclose(rows[:, 1], [0.9, 0.7], rtol=1e-6)
+    np.testing.assert_allclose(rows[0, 2:], [0, 0, 10, 10])
+    np.testing.assert_allclose(rows[1, 2:], [50, 50, 60, 60])
