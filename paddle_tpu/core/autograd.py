@@ -171,14 +171,21 @@ def _run_backward(tensors, grad_tensors, retain_graph, sinks=None):
                     continue
                 from .selected_rows import SelectedRows
 
-                if t._hooks and isinstance(g, SelectedRows):
-                    # hooks see the densified grad (once, not per hook); a
-                    # hook that edits it keeps the dense representation
-                    g = g.to_dense()
-                for hook in t._hooks:
-                    out = hook(Tensor(g, stop_gradient=True))
-                    if out is not None:
-                        g = out._value if isinstance(out, Tensor) else out
+                if t._hooks:
+                    # hooks see a densified view (computed once); observer
+                    # hooks (returning None) keep the sparse grad — only a
+                    # hook that REPLACES the grad commits the dense form
+                    view = g.to_dense() if isinstance(g, SelectedRows) \
+                        else g
+                    replaced = False
+                    for hook in t._hooks:
+                        out = hook(Tensor(view, stop_gradient=True))
+                        if out is not None:
+                            view = out._value if isinstance(out, Tensor) \
+                                else out
+                            replaced = True
+                    if replaced or not isinstance(g, SelectedRows):
+                        g = view
                 if t._tape is None:
                     leaf_sink(t, g)
                 else:

@@ -24,6 +24,13 @@ is_first_worker = fleet.is_first_worker
 worker_endpoints = fleet.worker_endpoints
 barrier_worker = fleet.barrier_worker
 minimize = fleet.minimize
+# parameter-server mode (ref fleet/__init__.py PS surface)
+is_server = fleet.is_server
+is_worker = fleet.is_worker
+init_server = fleet.init_server
+run_server = fleet.run_server
+init_worker = fleet.init_worker
+stop_worker = fleet.stop_worker
 
 
 class UserDefinedRoleMaker:

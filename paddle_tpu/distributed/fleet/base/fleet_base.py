@@ -36,6 +36,7 @@ class Fleet:
         self._hcg = None
         self._user_defined_strategy = None
         self._is_initialized = False
+        self._ps_runtime = None
 
     # -- init ----------------------------------------------------------------
     def init(self, role_maker=None, is_collective=True, strategy=None):
@@ -43,6 +44,22 @@ class Fleet:
             strategy = DistributedStrategy()
         self._user_defined_strategy = strategy
         self._role_maker = role_maker or _RoleMaker(is_collective)
+
+        from ...ps.runtime import PSRoleMaker, init_runtime
+
+        if isinstance(self._role_maker, PSRoleMaker):
+            # parameter-server mode (ref fleet_base.py PS branch +
+            # the_one_ps.py runtime): no collective mesh is built
+            a_sync = getattr(strategy, "a_sync", False)
+            cfg = getattr(strategy, "a_sync_configs", {}) or {}
+            k_steps = int(cfg.get("k_steps", -1))
+            # paddle semantics: a_sync + k_steps>0 = GeoSGD; a_sync = async
+            mode = "geo" if (a_sync and k_steps > 0) else \
+                ("async" if a_sync else "sync")
+            self._ps_runtime = init_runtime(
+                self._role_maker, mode=mode, geo_step=max(k_steps, 1))
+            self._is_initialized = True
+            return self
         init_parallel_env()
 
         hc = strategy.hybrid_configs
@@ -58,6 +75,37 @@ class Fleet:
         set_hybrid_communicate_group(self._hcg)
         self._is_initialized = True
         return self
+
+    # -- parameter-server mode (ref fleet_base.py:
+    # is_server/init_server/run_server/init_worker/stop_worker) -------------
+    def is_server(self):
+        from ...ps.runtime import PSRoleMaker
+
+        return isinstance(self._role_maker, PSRoleMaker) and \
+            self._role_maker.is_server()
+
+    def is_worker(self):
+        from ...ps.runtime import PSRoleMaker
+
+        if isinstance(self._role_maker, PSRoleMaker):
+            return self._role_maker.is_worker()
+        return True
+
+    def init_server(self, *args, **kwargs):
+        return self._ps_runtime.init_server()
+
+    def run_server(self):
+        return self._ps_runtime.run_server()
+
+    def init_worker(self):
+        return self._ps_runtime.init_worker()
+
+    def stop_worker(self):
+        return self._ps_runtime.stop_worker()
+
+    @property
+    def ps_runtime(self):
+        return self._ps_runtime
 
     # -- info ----------------------------------------------------------------
     def get_hybrid_communicate_group(self):

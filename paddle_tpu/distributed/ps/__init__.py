@@ -1,0 +1,30 @@
+"""Parameter-server mode (ref paddle/fluid/distributed/ §2.5 + fleet PS
+runtime the_one_ps.py).
+
+TPU-native redesign: dense compute stays on the accelerator; huge sparse
+tables live on CPU parameter servers (native C++ hash tables,
+paddle_tpu/native/ps_table.cc) behind a TCP RPC service. Trainers pull
+only the touched rows, push SelectedRows-style gradients through a
+sync/async/geo Communicator, and the server applies the optimizer —
+the reference's brpc PS split, minus brpc.
+
+Quick start:
+    # server process:  TRAINING_ROLE=PSERVER PADDLE_PORT=9000
+    fleet.init(ps.PSRoleMaker());  fleet.init_server();  fleet.run_server()
+    # trainer process: TRAINING_ROLE=TRAINER
+    fleet.init(ps.PSRoleMaker());  fleet.init_worker()
+    emb = ps.DistributedEmbedding("emb0", 64, lr=0.1)
+"""
+
+from .runtime import (  # noqa: F401
+    DistributedEmbedding, PSOptimizer, PSRoleMaker, PSRuntime, get_runtime,
+    init_runtime,
+)
+from .service import Communicator, PSClient, PSServer  # noqa: F401
+from .tables import DenseTable, SparseTable  # noqa: F401
+
+__all__ = [
+    "PSRoleMaker", "PSRuntime", "PSServer", "PSClient", "Communicator",
+    "DenseTable", "SparseTable", "DistributedEmbedding", "PSOptimizer",
+    "get_runtime", "init_runtime",
+]

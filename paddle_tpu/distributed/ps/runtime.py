@@ -1,0 +1,238 @@
+"""Parameter-server runtime: role making, fleet wiring, trainer-side
+layers/optimizer.
+
+Ref parity: python/paddle/distributed/fleet/runtime/the_one_ps.py
+(TheOnePSRuntime: init_server/run_server/init_worker/stop_worker),
+PaddleCloudRoleMaker's PS env contract, and
+operators/pscore/distributed_lookup_table_op.cc (the trainer-side sparse
+pull) — rebuilt over the TCP service of §service.py.
+
+Env contract (same variable names as the reference):
+  TRAINING_ROLE                PSERVER | TRAINER
+  PADDLE_PSERVERS_IP_PORT_LIST comma-separated host:port list
+  PADDLE_PORT + POD_IP         this server's bind endpoint (server role)
+  PADDLE_TRAINERS_NUM          number of trainers
+  PADDLE_TRAINER_ID            this trainer's rank
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from .service import Communicator, PSClient, PSServer
+
+__all__ = ["PSRoleMaker", "PSRuntime", "DistributedEmbedding",
+           "PSOptimizer", "get_runtime", "init_runtime"]
+
+
+class PSRoleMaker:
+    """ref PaddleCloudRoleMaker (PS mode)."""
+
+    def __init__(self, server_endpoints=None, role=None, trainer_id=None,
+                 n_trainers=None):
+        env = os.environ
+        eps = server_endpoints or env.get(
+            "PADDLE_PSERVERS_IP_PORT_LIST", "127.0.0.1:0")
+        self.server_endpoints = (eps.split(",")
+                                 if isinstance(eps, str) else list(eps))
+        self.role = (role or env.get("TRAINING_ROLE", "TRAINER")).upper()
+        self.trainer_id = int(trainer_id if trainer_id is not None
+                              else env.get("PADDLE_TRAINER_ID", "0"))
+        self.n_trainers = int(n_trainers if n_trainers is not None
+                              else env.get("PADDLE_TRAINERS_NUM", "1"))
+
+    def is_server(self):
+        return self.role == "PSERVER"
+
+    def is_worker(self):
+        return not self.is_server()
+
+    def my_server_endpoint(self):
+        port = os.environ.get("PADDLE_PORT")
+        ip = os.environ.get("POD_IP", "127.0.0.1")
+        if port is not None:
+            return f"{ip}:{port}"
+        return self.server_endpoints[0]
+
+
+class PSRuntime:
+    """ref the_one_ps.py TheOnePSRuntime."""
+
+    def __init__(self, role_maker: PSRoleMaker, mode="async", geo_step=4):
+        self.role = role_maker
+        self.mode = mode
+        self.geo_step = geo_step
+        self._server = None
+        self._client = None
+        self._communicator = None
+
+    # -- server side ---------------------------------------------------------
+    def init_server(self):
+        self._server = PSServer(self.role.my_server_endpoint())
+        return self._server
+
+    def run_server(self):
+        if self._server is None:
+            self.init_server()
+        self._server.run()
+
+    # -- worker side ---------------------------------------------------------
+    def init_worker(self):
+        self._client = PSClient(self.role.server_endpoints)
+        geo_scale = 1.0  # set per-table by DistributedEmbedding in geo mode
+        self._communicator = Communicator(
+            self._client, mode=self.mode, geo_step=self.geo_step,
+            geo_scale=geo_scale).start()
+        return self._client
+
+    @property
+    def client(self):
+        if self._client is None:
+            self.init_worker()
+        return self._client
+
+    @property
+    def communicator(self):
+        if self._communicator is None:
+            self.init_worker()
+        return self._communicator
+
+    def barrier(self):
+        self.client.barrier(self.role.n_trainers)
+
+    def stop_worker(self):
+        if self._communicator is not None:
+            self._communicator.stop()
+        if self._client is not None:
+            self._client.close()
+
+    def stop_server(self):
+        if self._client is not None:
+            self._client.stop_servers()
+        if self._server is not None:
+            self._server.stop()
+
+
+_runtime: PSRuntime | None = None
+
+
+def init_runtime(role_maker=None, mode="async", geo_step=4) -> PSRuntime:
+    global _runtime
+    _runtime = PSRuntime(role_maker or PSRoleMaker(), mode=mode,
+                         geo_step=geo_step)
+    return _runtime
+
+
+def get_runtime() -> PSRuntime:
+    if _runtime is None:
+        raise RuntimeError("PS runtime not initialised; call "
+                           "fleet.init(role) with a PS role maker or "
+                           "ps.init_runtime() first")
+    return _runtime
+
+
+class DistributedEmbedding:
+    """Trainer-side sparse lookup against a PS table (ref
+    distributed_lookup_table_op.cc + pscore/send_op.cc).
+
+    forward: pull the unique rows for `ids`, run a local lookup (taped —
+    gradients flow), and register a hook that pushes the row gradients
+    through the Communicator (async/sync/geo). The table never
+    materialises on the trainer: only the touched rows move.
+    """
+
+    def __init__(self, name, dim, optimizer="sgd", lr=0.01,
+                 init_range=0.05, runtime=None):
+        self.name = name
+        self.dim = int(dim)
+        self.lr = float(lr)
+        self.runtime = runtime or get_runtime()
+        comm = self.runtime.communicator
+        if comm.mode == "geo":
+            # geo tables merge parameter deltas; the server optimizer is a
+            # plain sum and the SGD scale lives client-side
+            self.runtime.client.create_sparse_table(
+                name, dim, optimizer="sum", init_range=init_range)
+            comm.geo_scale = -self.lr
+        else:
+            self.runtime.client.create_sparse_table(
+                name, dim, optimizer=optimizer, lr=lr,
+                init_range=init_range)
+
+    def __call__(self, ids):
+        import jax.numpy as jnp
+
+        from ...core.tensor import Tensor
+
+        ids_arr = np.asarray(ids._value if isinstance(ids, Tensor) else ids,
+                             np.int64)
+        flat = ids_arr.reshape(-1)
+        uniq, inverse = np.unique(flat, return_inverse=True)
+        rows = self.runtime.client.pull_sparse(self.name, uniq)
+
+        table = Tensor(jnp.asarray(rows), stop_gradient=False)
+        comm = self.runtime.communicator
+        name = self.name
+        uniq_ids = uniq
+
+        def push_hook(grad):
+            comm.push_sparse(name, uniq_ids, np.asarray(grad._value))
+            return None
+
+        table.register_hook(push_hook)
+        from ...core.dispatch import apply
+
+        out = apply("lookup_table_v2",
+                    jnp.asarray(inverse.reshape(ids_arr.shape), jnp.int32),
+                    table, padding_idx=-1)
+        return out
+
+
+class PSOptimizer:
+    """Dense-parameter PS path (ref ParameterServerOptimizer +
+    communicator dense send): parameters live in DenseTables, the server
+    applies the update at push time, trainers pull fresh values.
+
+    Wraps a local model's parameters: `register(params)` uploads initial
+    values; `step()` pushes grads + pulls updates (sync) or pushes async
+    and pulls every `stale_steps`.
+    """
+
+    def __init__(self, parameters, lr=0.01, optimizer="sgd", runtime=None,
+                 stale_steps=1):
+        self.runtime = runtime or get_runtime()
+        self.params = list(parameters)
+        self.lr = float(lr)
+        self.stale_steps = int(stale_steps)
+        self._step_count = 0
+        self._names = []
+        client = self.runtime.client
+        for i, p in enumerate(self.params):
+            name = f"dense/{p.name or f'param_{i}'}/{i}"
+            self._names.append(name)
+            client.create_dense_table(
+                name, list(p._value.shape), optimizer=optimizer, lr=lr,
+                initial=np.asarray(p._value, np.float32))
+
+    def step(self):
+        import jax.numpy as jnp
+
+        comm = self.runtime.communicator
+        client = self.runtime.client
+        self._step_count += 1
+        for p, name in zip(self.params, self._names):
+            if p._grad is None:
+                continue
+            comm.push_dense(name, np.asarray(p._grad, np.float32))
+        if comm.mode == "sync" or \
+                self._step_count % self.stale_steps == 0:
+            comm.flush()
+            self.runtime.barrier()
+            for p, name in zip(self.params, self._names):
+                p._value = jnp.asarray(client.pull_dense(name))
+
+    def clear_grad(self):
+        for p in self.params:
+            p.clear_grad()

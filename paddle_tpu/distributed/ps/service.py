@@ -1,0 +1,406 @@
+"""Parameter-server RPC service: PSServer / PSClient / Communicator.
+
+Ref parity: paddle/fluid/distributed/service/ — BrpcPsServer/BrpcPsClient
+(brpc RPC with sendrecv.proto) and Communicator (trainer-side async
+send queues, sync/async/geo modes, communicator.h:197). TPU-native
+redesign: the transport is a length-prefixed binary protocol over TCP
+(numpy buffers serialised raw, no pickle for payload rows), servers are
+a thread pool holding the tables of §tables.py, and sparse rows are
+partitioned across servers by `id % n_servers` (the reference shards by
+id range per table — modulo keeps shard balance without a shard map).
+Trainers talk through PSClient; Communicator batches pushes in a
+background thread (async), pushes inline (sync), or accumulates local
+deltas pushed every k steps (geo, ref SparseGeoTable).
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+import time
+
+import numpy as np
+
+from .tables import DenseTable, SparseTable
+
+_MAGIC = b"PTPS"
+
+
+def _send_msg(sock, obj):
+    payload = pickle.dumps(obj, protocol=4)
+    sock.sendall(_MAGIC + struct.pack("<Q", len(payload)) + payload)
+
+
+def _recv_exact(sock, n):
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def _recv_msg(sock):
+    head = _recv_exact(sock, 12)
+    if head[:4] != _MAGIC:
+        raise ConnectionError("bad frame magic")
+    (size,) = struct.unpack("<Q", head[4:])
+    return pickle.loads(_recv_exact(sock, size))
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self):
+        server: PSServer = self.server.ps  # type: ignore[attr-defined]
+        sock = self.request
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        try:
+            while True:
+                cmd, args = _recv_msg(sock)
+                if cmd == "stop":
+                    _send_msg(sock, ("ok", None))
+                    server._shutdown_flag.set()
+                    break
+                try:
+                    result = server._dispatch(cmd, args)
+                    _send_msg(sock, ("ok", result))
+                except Exception as e:  # noqa: BLE001 — report to client
+                    _send_msg(sock, ("err", repr(e)))
+        except (ConnectionError, OSError):
+            pass
+
+
+class _TCP(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+class PSServer:
+    """One parameter-server rank (ref BrpcPsServer, server.h:64)."""
+
+    def __init__(self, endpoint):
+        host, port = endpoint.rsplit(":", 1)
+        self.endpoint = endpoint
+        self._tables: dict[str, object] = {}
+        self._barrier_count = 0
+        self._barrier_gen = 0
+        self._barrier_cv = threading.Condition()
+        self._shutdown_flag = threading.Event()
+        self._tcp = _TCP((host, int(port)), _Handler)
+        self._tcp.ps = self  # type: ignore[attr-defined]
+        self._thread = None
+
+    @property
+    def port(self):
+        return self._tcp.server_address[1]
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self):
+        """Serve in a background thread (tests / in-process server)."""
+        self._thread = threading.Thread(target=self._tcp.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def run(self):
+        """Blocking serve until a client sends stop (ref run_server)."""
+        t = threading.Thread(target=self._tcp.serve_forever, daemon=True)
+        t.start()
+        self._shutdown_flag.wait()
+        self._tcp.shutdown()
+
+    def stop(self):
+        self._shutdown_flag.set()
+        self._tcp.shutdown()
+        self._tcp.server_close()
+
+    # -- request dispatch ----------------------------------------------------
+    def _dispatch(self, cmd, args):
+        if cmd == "create_dense":
+            name, shape, opt, lr, initial = args
+            if name not in self._tables:
+                self._tables[name] = DenseTable(
+                    name, shape, optimizer=opt, lr=lr, initial=initial)
+            return None
+        if cmd == "create_sparse":
+            name, dim, opt, lr, init_range, seed = args
+            if name not in self._tables:
+                self._tables[name] = SparseTable(
+                    name, dim, optimizer=opt, lr=lr,
+                    init_range=init_range, seed=seed)
+            return None
+        if cmd == "pull_dense":
+            return self._tables[args].pull()
+        if cmd == "push_dense_grad":
+            name, grad = args
+            self._tables[name].push_grad(grad)
+            return None
+        if cmd == "set_dense":
+            name, value = args
+            self._tables[name].set(value)
+            return None
+        if cmd == "pull_sparse":
+            name, ids = args
+            return self._tables[name].pull(ids)
+        if cmd == "push_sparse_grad":
+            name, ids, grads = args
+            self._tables[name].push_grad(ids, grads)
+            return None
+        if cmd == "barrier":
+            n_trainers = args
+            with self._barrier_cv:
+                gen = self._barrier_gen
+                self._barrier_count += 1
+                if self._barrier_count >= n_trainers:
+                    self._barrier_count = 0
+                    self._barrier_gen += 1
+                    self._barrier_cv.notify_all()
+                else:
+                    self._barrier_cv.wait_for(
+                        lambda: self._barrier_gen != gen, timeout=60.0)
+            return None
+        if cmd == "save":
+            return {n: t.state_dict() for n, t in self._tables.items()}
+        if cmd == "load":
+            for n, sd in args.items():
+                if n in self._tables:
+                    self._tables[n].load_state_dict(sd)
+            return None
+        if cmd == "table_size":
+            t = self._tables[args]
+            return len(t) if isinstance(t, SparseTable) else 1
+        raise ValueError(f"unknown PS command {cmd!r}")
+
+
+class PSClient:
+    """Trainer-side connection pool (ref BrpcPsClient, ps_client.h:55).
+
+    Sparse rows are partitioned id % n_servers; dense tables live on
+    server hash(name) % n_servers.
+    """
+
+    def __init__(self, endpoints):
+        self.endpoints = list(endpoints)
+        self._socks = [None] * len(self.endpoints)
+        self._locks = [threading.Lock() for _ in self.endpoints]
+
+    def _sock(self, i):
+        if self._socks[i] is None:
+            host, port = self.endpoints[i].rsplit(":", 1)
+            s = socket.create_connection((host, int(port)), timeout=30.0)
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._socks[i] = s
+        return self._socks[i]
+
+    def _call(self, server_idx, cmd, args):
+        with self._locks[server_idx]:
+            sock = self._sock(server_idx)
+            _send_msg(sock, (cmd, args))
+            status, result = _recv_msg(sock)
+        if status != "ok":
+            raise RuntimeError(f"PS error from "
+                               f"{self.endpoints[server_idx]}: {result}")
+        return result
+
+    def _dense_server(self, name):
+        return hash(name) % len(self.endpoints)
+
+    # -- table management ----------------------------------------------------
+    def create_dense_table(self, name, shape, optimizer="sgd", lr=0.01,
+                           initial=None):
+        self._call(self._dense_server(name), "create_dense",
+                   (name, shape, optimizer, lr, initial))
+
+    def create_sparse_table(self, name, dim, optimizer="sgd", lr=0.01,
+                            init_range=0.05, seed=0):
+        for i in range(len(self.endpoints)):
+            self._call(i, "create_sparse",
+                       (name, dim, optimizer, lr, init_range, seed + i))
+
+    # -- dense ---------------------------------------------------------------
+    def pull_dense(self, name):
+        return self._call(self._dense_server(name), "pull_dense", name)
+
+    def push_dense_grad(self, name, grad):
+        self._call(self._dense_server(name), "push_dense_grad",
+                   (name, np.asarray(grad, np.float32)))
+
+    def set_dense(self, name, value):
+        self._call(self._dense_server(name), "set_dense",
+                   (name, np.asarray(value, np.float32)))
+
+    # -- sparse (partitioned) ------------------------------------------------
+    def pull_sparse(self, name, ids):
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        n = len(self.endpoints)
+        out = np.empty((ids.shape[0], 0), np.float32)
+        parts = [np.nonzero(ids % n == i)[0] for i in range(n)]
+        dim = None
+        results = [None] * n
+        for i, pos in enumerate(parts):
+            if pos.size:
+                results[i] = self._call(i, "pull_sparse", (name, ids[pos]))
+                dim = results[i].shape[1]
+        out = np.empty((ids.shape[0], dim), np.float32)
+        for pos, res in zip(parts, results):
+            if res is not None:
+                out[pos] = res
+        return out
+
+    def push_sparse_grad(self, name, ids, grads):
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        grads = np.asarray(grads, np.float32)
+        n = len(self.endpoints)
+        for i in range(n):
+            pos = np.nonzero(ids % n == i)[0]
+            if pos.size:
+                self._call(i, "push_sparse_grad",
+                           (name, ids[pos], grads[pos]))
+
+    # -- control -------------------------------------------------------------
+    def barrier(self, n_trainers):
+        self._call(0, "barrier", n_trainers)
+
+    def save(self):
+        return [self._call(i, "save", None)
+                for i in range(len(self.endpoints))]
+
+    def load(self, states):
+        for i, sd in enumerate(states):
+            self._call(i, "load", sd)
+
+    def stop_servers(self):
+        for i in range(len(self.endpoints)):
+            try:
+                self._call(i, "stop", None)
+            except (RuntimeError, ConnectionError, OSError):
+                pass
+
+    def close(self):
+        for s in self._socks:
+            if s is not None:
+                try:
+                    s.close()
+                except OSError:
+                    pass
+        self._socks = [None] * len(self.endpoints)
+
+
+class Communicator:
+    """Trainer-side grad pipe (ref distributed/service/communicator.h:197).
+
+    modes:
+      sync  — push_* forwards immediately; callers barrier per step
+      async — pushes enqueue; a background thread drains (Hogwild-style)
+      geo   — sparse pushes accumulate locally as deltas; every
+              `geo_step` flushes merged deltas (optimizer='sum' tables)
+    """
+
+    def __init__(self, client: PSClient, mode="async", geo_step=4,
+                 geo_scale=1.0):
+        self.client = client
+        self.mode = mode
+        self.geo_step = int(geo_step)
+        # geo deltas are scaled at flush (e.g. -lr turns summed grads into
+        # the SGD parameter delta merged by an optimizer='sum' table)
+        self.geo_scale = float(geo_scale)
+        self._queue: list = []
+        self._cv = threading.Condition()
+        self._running = False
+        self._thread = None
+        self._geo_acc: dict[str, dict[int, np.ndarray]] = {}
+        self._geo_count = 0
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self):
+        if self.mode == "async" and not self._running:
+            self._running = True
+            self._thread = threading.Thread(target=self._drain, daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self):
+        if self._running:
+            with self._cv:
+                self._running = False
+                self._cv.notify_all()
+            self._thread.join(timeout=10.0)
+        self.flush()
+
+    def _drain(self):
+        while True:
+            with self._cv:
+                while self._running and not self._queue:
+                    self._cv.wait(timeout=0.5)
+                if not self._running and not self._queue:
+                    return
+                batch, self._queue = self._queue, []
+            for kind, name, a, b in batch:
+                if kind == "sparse":
+                    self.client.push_sparse_grad(name, a, b)
+                else:
+                    self.client.push_dense_grad(name, a)
+
+    # -- pushes --------------------------------------------------------------
+    def push_sparse(self, name, ids, grads):
+        if self.mode == "geo":
+            acc = self._geo_acc.setdefault(name, {})
+            ids = np.asarray(ids, np.int64).reshape(-1)
+            grads = np.asarray(grads, np.float32)
+            for i, g in zip(ids, grads):
+                i = int(i)
+                if i in acc:
+                    acc[i] = acc[i] + g
+                else:
+                    acc[i] = g.copy()
+            return
+        if self.mode == "sync":
+            self.client.push_sparse_grad(name, ids, grads)
+            return
+        with self._cv:
+            self._queue.append(("sparse", name, np.asarray(ids, np.int64),
+                                np.asarray(grads, np.float32)))
+            self._cv.notify()
+
+    def push_dense(self, name, grad):
+        if self.mode != "async":
+            # sync pushes inline; geo applies only to sparse tables (ref
+            # SparseGeoTable) so dense grads also go straight through —
+            # queueing them would never drain (no drain thread in geo)
+            self.client.push_dense_grad(name, grad)
+            return
+        with self._cv:
+            self._queue.append(("dense", name,
+                                np.asarray(grad, np.float32), None))
+            self._cv.notify()
+
+    def step_end(self):
+        """Geo cadence hook: call once per train step."""
+        if self.mode != "geo":
+            return
+        self._geo_count += 1
+        if self._geo_count % self.geo_step == 0:
+            self.flush()
+
+    def flush(self):
+        if self.mode == "geo":
+            for name, acc in self._geo_acc.items():
+                if not acc:
+                    continue
+                ids = np.fromiter(acc.keys(), np.int64, len(acc))
+                grads = np.stack([acc[int(i)] for i in ids])
+                self.client.push_sparse_grad(name, ids,
+                                             self.geo_scale * grads)
+            self._geo_acc = {}
+            return
+        if self.mode == "async":
+            # wait for the queue to empty
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline:
+                with self._cv:
+                    if not self._queue:
+                        break
+                time.sleep(0.005)

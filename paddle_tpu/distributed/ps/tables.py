@@ -1,0 +1,205 @@
+"""Parameter-server tables: dense + sparse shards with server-side
+optimizers.
+
+Ref parity: paddle/fluid/distributed/table/ — CommonDenseTable,
+CommonSparseTable (hash sparse embedding, lazy row init), SparseGeoTable
+(GeoSGD delta merge). The sparse hot path is the native C++ table
+(paddle_tpu/native/ps_table.cc) when the toolchain is available, with a
+numpy fallback. The server applies the optimizer (sgd / adagrad / sum
+for geo deltas) at push time — trainers never hold optimizer state for
+PS-managed parameters, exactly the reference's split.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import threading
+
+import numpy as np
+
+_I64P = ctypes.POINTER(ctypes.c_int64)
+_F32P = ctypes.POINTER(ctypes.c_float)
+
+
+class DenseTable:
+    """Whole-array parameter shard (ref common_dense_table.cc)."""
+
+    def __init__(self, name, shape, dtype="float32", optimizer="sgd",
+                 lr=0.01, epsilon=1e-6, initial=None):
+        self.name = name
+        self.value = (np.zeros(shape, dtype) if initial is None
+                      else np.array(initial, dtype))
+        self.optimizer = optimizer
+        self.lr = float(lr)
+        self.epsilon = float(epsilon)
+        self._accum = None
+        self._lock = threading.Lock()
+
+    def pull(self):
+        with self._lock:
+            return self.value.copy()
+
+    def push_grad(self, grad):
+        grad = np.asarray(grad, self.value.dtype)
+        with self._lock:
+            if self.optimizer == "sgd":
+                self.value -= self.lr * grad
+            elif self.optimizer == "adagrad":
+                if self._accum is None:
+                    self._accum = np.zeros_like(self.value)
+                self._accum += grad * grad
+                self.value -= self.lr * grad / (
+                    np.sqrt(self._accum) + self.epsilon)
+            elif self.optimizer == "sum":  # geo delta merge
+                self.value += grad
+            else:
+                raise ValueError(f"unknown optimizer {self.optimizer!r}")
+
+    def set(self, value):
+        with self._lock:
+            self.value = np.asarray(value, self.value.dtype).copy()
+
+    def state_dict(self):
+        with self._lock:
+            return {"value": self.value.copy()}
+
+    def load_state_dict(self, sd):
+        with self._lock:
+            self.value = np.asarray(sd["value"]).copy()
+
+
+class SparseTable:
+    """id -> row hash table with lazy init and in-push optimizer
+    (ref common_sparse_table.cc). Uses the native C++ table when built."""
+
+    def __init__(self, name, dim, optimizer="sgd", lr=0.01, epsilon=1e-6,
+                 init_range=0.05, seed=0, use_native=True):
+        self.name = name
+        self.dim = int(dim)
+        self.optimizer = optimizer
+        self.lr = float(lr)
+        self.epsilon = float(epsilon)
+        self.init_range = float(init_range)
+        self.seed = int(seed)
+        self._lock = threading.Lock()
+        self._lib = None
+        self._handle = None
+        if use_native:
+            from ...native import ps_table_lib
+
+            self._lib = ps_table_lib()
+        if self._lib is not None:
+            self._handle = self._lib.pst_create(
+                self.dim, ctypes.c_float(-self.init_range),
+                ctypes.c_float(self.init_range),
+                ctypes.c_uint64(self.seed))
+        else:
+            self._rows: dict[int, np.ndarray] = {}
+            self._accum: dict[int, np.ndarray] = {}
+
+    def __del__(self):
+        lib, h = getattr(self, "_lib", None), getattr(self, "_handle", None)
+        if lib is not None and h is not None:
+            lib.pst_free(h)
+
+    # -- numpy fallback helpers ---------------------------------------------
+    def _py_row(self, i):
+        r = self._rows.get(i)
+        if r is None:
+            rng = np.random.RandomState((self.seed * 0x9E3779B9 + i)
+                                        & 0x7FFFFFFF)
+            r = rng.uniform(-self.init_range, self.init_range,
+                            self.dim).astype(np.float32)
+            self._rows[i] = r
+        return r
+
+    # -- API -----------------------------------------------------------------
+    def pull(self, ids):
+        ids = np.ascontiguousarray(np.asarray(ids, np.int64).reshape(-1))
+        out = np.empty((ids.shape[0], self.dim), np.float32)
+        with self._lock:
+            if self._handle is not None:
+                self._lib.pst_pull(self._handle,
+                                   ids.ctypes.data_as(_I64P), ids.shape[0],
+                                   out.ctypes.data_as(_F32P))
+            else:
+                for k, i in enumerate(ids):
+                    out[k] = self._py_row(int(i))
+        return out
+
+    def push_grad(self, ids, grads):
+        ids = np.ascontiguousarray(np.asarray(ids, np.int64).reshape(-1))
+        grads = np.ascontiguousarray(
+            np.asarray(grads, np.float32).reshape(ids.shape[0], self.dim))
+        with self._lock:
+            if self._handle is not None:
+                if self.optimizer == "sgd":
+                    self._lib.pst_push_sgd(
+                        self._handle, ids.ctypes.data_as(_I64P),
+                        ids.shape[0], grads.ctypes.data_as(_F32P),
+                        ctypes.c_float(self.lr))
+                elif self.optimizer == "adagrad":
+                    self._lib.pst_push_adagrad(
+                        self._handle, ids.ctypes.data_as(_I64P),
+                        ids.shape[0], grads.ctypes.data_as(_F32P),
+                        ctypes.c_float(self.lr),
+                        ctypes.c_float(self.epsilon))
+                elif self.optimizer == "sum":
+                    self._lib.pst_push_delta(
+                        self._handle, ids.ctypes.data_as(_I64P),
+                        ids.shape[0], grads.ctypes.data_as(_F32P))
+                else:
+                    raise ValueError(
+                        f"unknown optimizer {self.optimizer!r}")
+                return
+            for k, i in enumerate(ids):
+                i = int(i)
+                r = self._py_row(i)
+                g = grads[k]
+                if self.optimizer == "sgd":
+                    r -= self.lr * g
+                elif self.optimizer == "adagrad":
+                    a = self._accum.setdefault(
+                        i, np.zeros(self.dim, np.float32))
+                    a += g * g
+                    r -= self.lr * g / (np.sqrt(a) + self.epsilon)
+                elif self.optimizer == "sum":
+                    r += g
+                else:
+                    raise ValueError(
+                        f"unknown optimizer {self.optimizer!r}")
+
+    def __len__(self):
+        with self._lock:
+            if self._handle is not None:
+                return int(self._lib.pst_size(self._handle))
+            return len(self._rows)
+
+    def state_dict(self):
+        with self._lock:
+            if self._handle is not None:
+                n = int(self._lib.pst_size(self._handle))
+                ids = np.empty(n, np.int64)
+                rows = np.empty((n, self.dim), np.float32)
+                if n:
+                    self._lib.pst_export(self._handle,
+                                         ids.ctypes.data_as(_I64P),
+                                         rows.ctypes.data_as(_F32P))
+                return {"ids": ids, "rows": rows}
+            ids = np.array(sorted(self._rows), np.int64)
+            rows = (np.stack([self._rows[int(i)] for i in ids])
+                    if len(ids) else np.empty((0, self.dim), np.float32))
+            return {"ids": ids, "rows": rows}
+
+    def load_state_dict(self, sd):
+        ids = np.ascontiguousarray(np.asarray(sd["ids"], np.int64))
+        rows = np.ascontiguousarray(np.asarray(sd["rows"], np.float32))
+        with self._lock:
+            if self._handle is not None:
+                self._lib.pst_import(self._handle,
+                                     ids.ctypes.data_as(_I64P),
+                                     ids.shape[0],
+                                     rows.ctypes.data_as(_F32P))
+            else:
+                for i, r in zip(ids, rows):
+                    self._rows[int(i)] = r.copy()

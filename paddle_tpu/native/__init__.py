@@ -39,15 +39,17 @@ def _cache_dir():
     return root
 
 
-def _build():
+def _compile(src, prefix, extra_flags=()):
+    """Hash-keyed g++ build shared by every native component.
+
+    -march=native binaries are host-specific: the cache key includes the
+    machine/processor/compiler so a shared cache dir never serves a
+    binary with illegal instructions to a different CPU generation."""
     import platform
 
     h = hashlib.sha256()
-    with open(_SRC, "rb") as f:
+    with open(src, "rb") as f:
         h.update(f.read())
-    # -march=native binaries are host-specific: key the cache on the
-    # machine/compiler too so a shared cache dir never serves a binary
-    # with illegal instructions to a different CPU generation
     h.update(platform.machine().encode())
     h.update(platform.processor().encode())
     try:
@@ -56,14 +58,18 @@ def _build():
     except OSError:
         pass
     digest = h.hexdigest()[:16]
-    so = os.path.join(_cache_dir(), f"libptfeed-{digest}.so")
+    so = os.path.join(_cache_dir(), f"{prefix}-{digest}.so")
     if not os.path.exists(so):
         tmp = so + f".tmp{os.getpid()}"
-        cmd = ["g++", "-O3", "-march=native", "-funroll-loops", "-shared",
-               "-fPIC", "-pthread", "-std=c++17", _SRC, "-o", tmp]
+        cmd = ["g++", "-O3", "-march=native", *extra_flags, "-shared",
+               "-fPIC", "-pthread", "-std=c++17", src, "-o", tmp]
         subprocess.run(cmd, check=True, capture_output=True, text=True)
         os.replace(tmp, so)
-    lib = ctypes.CDLL(so)
+    return ctypes.CDLL(so)
+
+
+def _build():
+    lib = _compile(_SRC, "libptfeed", ("-funroll-loops",))
     i64p = ctypes.POINTER(ctypes.c_int64)
     for name, ptr_t in [
         ("pt_gather_rows_f32", ctypes.POINTER(ctypes.c_float)),
@@ -177,3 +183,47 @@ def gather_images_u8_chw(src: np.ndarray, indices, scale=1.0 / 255.0,
         out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
         _nthreads(nthreads))
     return out
+
+
+# ---------------------------------------------------------------------------
+# native sparse parameter table (ps_table.cc; ref
+# paddle/fluid/distributed/table/common_sparse_table.cc)
+# ---------------------------------------------------------------------------
+
+_PS_SRC = os.path.join(_HERE, "ps_table.cc")
+_ps_lib = None
+_ps_build_error: str | None = None
+
+
+def _build_ps():
+    lib = _compile(_PS_SRC, "libpstable")
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    f32p = ctypes.POINTER(ctypes.c_float)
+    lib.pst_create.argtypes = [ctypes.c_int64, ctypes.c_float,
+                               ctypes.c_float, ctypes.c_uint64]
+    lib.pst_create.restype = ctypes.c_void_p
+    lib.pst_free.argtypes = [ctypes.c_void_p]
+    lib.pst_size.argtypes = [ctypes.c_void_p]
+    lib.pst_size.restype = ctypes.c_int64
+    lib.pst_pull.argtypes = [ctypes.c_void_p, i64p, ctypes.c_int64, f32p]
+    lib.pst_push_sgd.argtypes = [ctypes.c_void_p, i64p, ctypes.c_int64,
+                                 f32p, ctypes.c_float]
+    lib.pst_push_adagrad.argtypes = [ctypes.c_void_p, i64p, ctypes.c_int64,
+                                     f32p, ctypes.c_float, ctypes.c_float]
+    lib.pst_push_delta.argtypes = [ctypes.c_void_p, i64p, ctypes.c_int64,
+                                   f32p]
+    lib.pst_export.argtypes = [ctypes.c_void_p, i64p, f32p]
+    lib.pst_import.argtypes = [ctypes.c_void_p, i64p, ctypes.c_int64, f32p]
+    return lib
+
+
+def ps_table_lib():
+    """The compiled sparse-table library, or None (numpy fallback)."""
+    global _ps_lib, _ps_build_error
+    with _lock:
+        if _ps_lib is None and _ps_build_error is None:
+            try:
+                _ps_lib = _build_ps()
+            except (OSError, subprocess.CalledProcessError) as e:
+                _ps_build_error = str(e)
+        return _ps_lib

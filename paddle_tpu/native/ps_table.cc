@@ -1,0 +1,164 @@
+// Native sparse parameter table for the parameter-server runtime.
+//
+// Ref parity: paddle/fluid/distributed/table/common_sparse_table.cc — the
+// reference stores sparse embedding shards in a C++ hash table with
+// server-side optimizer application. This is the TPU build's equivalent:
+// an int64 -> row open-hash (std::unordered_map index + contiguous row
+// arena), lazy deterministic row init (splitmix64 per id), and fused
+// pull / push(+SGD/Adagrad) kernels. Thread-safe: one mutex per table
+// (the PS server is a thread pool; row-granular locking is a later
+// optimisation, contention is dominated by network time).
+//
+// Built with g++ via paddle_tpu.native (ctypes ABI, no pybind11).
+
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Table {
+  int64_t dim;
+  float init_lo, init_hi;
+  uint64_t seed;
+  bool has_accum = false;  // adagrad accumulators allocated on first use
+  std::unordered_map<int64_t, int64_t> index;  // id -> slot
+  std::vector<float> rows;    // slot * dim
+  std::vector<float> accum;   // slot * dim (adagrad G)
+  std::mutex mu;
+
+  static uint64_t splitmix(uint64_t x) {
+    x += 0x9e3779b97f4a7c15ull;
+    x = (x ^ (x >> 30)) * 0xbf58476d1ce4e5b9ull;
+    x = (x ^ (x >> 27)) * 0x94d049bb133111ebull;
+    return x ^ (x >> 31);
+  }
+
+  int64_t slot_of(int64_t id) {
+    auto it = index.find(id);
+    if (it != index.end()) return it->second;
+    int64_t slot = static_cast<int64_t>(index.size());
+    index.emplace(id, slot);
+    rows.resize((slot + 1) * dim);
+    if (has_accum) accum.resize((slot + 1) * dim, 0.f);
+    float* r = rows.data() + slot * dim;
+    if (init_lo == 0.f && init_hi == 0.f) {
+      std::memset(r, 0, sizeof(float) * dim);
+    } else {
+      uint64_t s = splitmix(seed ^ static_cast<uint64_t>(id));
+      const float span = init_hi - init_lo;
+      for (int64_t j = 0; j < dim; ++j) {
+        s = splitmix(s);
+        r[j] = init_lo + span * ((s >> 11) * 0x1.0p-53f);
+      }
+    }
+    return slot;
+  }
+
+  void ensure_accum() {
+    if (!has_accum) {
+      accum.assign(rows.size(), 0.f);
+      has_accum = true;
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* pst_create(int64_t dim, float init_lo, float init_hi, uint64_t seed) {
+  auto* t = new Table();
+  t->dim = dim;
+  t->init_lo = init_lo;
+  t->init_hi = init_hi;
+  t->seed = seed;
+  return t;
+}
+
+void pst_free(void* h) { delete static_cast<Table*>(h); }
+
+int64_t pst_size(void* h) {
+  auto* t = static_cast<Table*>(h);
+  std::lock_guard<std::mutex> g(t->mu);
+  return static_cast<int64_t>(t->index.size());
+}
+
+void pst_pull(void* h, const int64_t* ids, int64_t n, float* out) {
+  auto* t = static_cast<Table*>(h);
+  std::lock_guard<std::mutex> g(t->mu);
+  for (int64_t i = 0; i < n; ++i) {
+    int64_t slot = t->slot_of(ids[i]);
+    std::memcpy(out + i * t->dim, t->rows.data() + slot * t->dim,
+                sizeof(float) * t->dim);
+  }
+}
+
+void pst_push_sgd(void* h, const int64_t* ids, int64_t n, const float* grads,
+                  float lr) {
+  auto* t = static_cast<Table*>(h);
+  std::lock_guard<std::mutex> g(t->mu);
+  for (int64_t i = 0; i < n; ++i) {
+    int64_t slot = t->slot_of(ids[i]);
+    float* r = t->rows.data() + slot * t->dim;
+    const float* gr = grads + i * t->dim;
+    for (int64_t j = 0; j < t->dim; ++j) r[j] -= lr * gr[j];
+  }
+}
+
+void pst_push_adagrad(void* h, const int64_t* ids, int64_t n,
+                      const float* grads, float lr, float eps) {
+  auto* t = static_cast<Table*>(h);
+  std::lock_guard<std::mutex> g(t->mu);
+  t->ensure_accum();
+  for (int64_t i = 0; i < n; ++i) {
+    int64_t slot = t->slot_of(ids[i]);
+    float* r = t->rows.data() + slot * t->dim;
+    float* a = t->accum.data() + slot * t->dim;
+    const float* gr = grads + i * t->dim;
+    for (int64_t j = 0; j < t->dim; ++j) {
+      a[j] += gr[j] * gr[j];
+      r[j] -= lr * gr[j] / (std::sqrt(a[j]) + eps);
+    }
+  }
+}
+
+// delta-add (GeoSGD merge): row += delta
+void pst_push_delta(void* h, const int64_t* ids, int64_t n,
+                    const float* deltas) {
+  auto* t = static_cast<Table*>(h);
+  std::lock_guard<std::mutex> g(t->mu);
+  for (int64_t i = 0; i < n; ++i) {
+    int64_t slot = t->slot_of(ids[i]);
+    float* r = t->rows.data() + slot * t->dim;
+    const float* d = deltas + i * t->dim;
+    for (int64_t j = 0; j < t->dim; ++j) r[j] += d[j];
+  }
+}
+
+void pst_export(void* h, int64_t* ids_out, float* rows_out) {
+  auto* t = static_cast<Table*>(h);
+  std::lock_guard<std::mutex> g(t->mu);
+  int64_t i = 0;
+  for (const auto& kv : t->index) {
+    ids_out[i] = kv.first;
+    std::memcpy(rows_out + i * t->dim, t->rows.data() + kv.second * t->dim,
+                sizeof(float) * t->dim);
+    ++i;
+  }
+}
+
+void pst_import(void* h, const int64_t* ids, int64_t n, const float* rows) {
+  auto* t = static_cast<Table*>(h);
+  std::lock_guard<std::mutex> g(t->mu);
+  for (int64_t i = 0; i < n; ++i) {
+    int64_t slot = t->slot_of(ids[i]);
+    std::memcpy(t->rows.data() + slot * t->dim, rows + i * t->dim,
+                sizeof(float) * t->dim);
+  }
+}
+
+}  // extern "C"

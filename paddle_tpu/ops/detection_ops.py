@@ -111,26 +111,38 @@ def prior_box(input, image, *, min_sizes, max_sizes=None,
     h, w = input.shape[2], input.shape[3]
     ih, iw = image.shape[2], image.shape[3]
 
-    ratios = [1.0] if 1.0 not in aspect_ratios else []
-    ratios += list(aspect_ratios)
-    if flip:
-        ratios += [1.0 / r for r in aspect_ratios if r != 1.0]
-    # de-dup preserving order
-    seen, ars = set(), []
-    for r in ratios:
-        if round(r, 6) not in seen:
-            seen.add(round(r, 6))
-            ars.append(r)
+    # ExpandAspectRatios order (ref prior_box_op.h): 1.0 first, then each
+    # user ratio followed immediately by its flip — anchor order defines
+    # the SSD head channel layout, so it must match the reference exactly
+    ars = [1.0]
+    for r in aspect_ratios:
+        if any(abs(r - e) < 1e-6 for e in ars):
+            continue
+        ars.append(r)
+        if flip:
+            ars.append(1.0 / r)
 
     step_w = step[0] or iw / w
     step_h = step[1] or ih / h
 
     whs = []
-    for ms in min_sizes:
-        for ar in ars:
-            whs.append((ms * (ar ** 0.5), ms / (ar ** 0.5)))
-        if max_sizes:
-            for mx in max_sizes:
+    for i, ms in enumerate(min_sizes):
+        if min_max_aspect_ratios_order and max_sizes:
+            # ref prior_box_op.h min_max_aspect_ratios_order=True: the
+            # max-size prior comes right after the ratio-1 min prior
+            whs.append((ms, ms))
+            mx = max_sizes[i]
+            s = (ms * mx) ** 0.5
+            whs.append((s, s))
+            for ar in ars:
+                if abs(ar - 1.0) < 1e-6:
+                    continue
+                whs.append((ms * (ar ** 0.5), ms / (ar ** 0.5)))
+        else:
+            for ar in ars:
+                whs.append((ms * (ar ** 0.5), ms / (ar ** 0.5)))
+            if max_sizes:
+                mx = max_sizes[i]
                 s = (ms * mx) ** 0.5
                 whs.append((s, s))
     whs = jnp.asarray(whs, jnp.float32)  # [P, 2]
@@ -205,7 +217,13 @@ def roi_align(x, boxes, boxes_num, *, output_size, spatial_scale=1.0,
     """ref roi_align_op.cu: bilinear average pooling inside each RoI.
 
     x: [N, C, H, W]; boxes: [R, 4] (x1, y1, x2, y2 in image coords);
-    boxes_num: [N] rois per image. Differentiable w.r.t. x."""
+    boxes_num: [N] rois per image. Differentiable w.r.t. x.
+
+    TPU divergence: with sampling_ratio=-1 the reference adaptively
+    samples ceil(roi_size/pooled_size) points per bin PER RoI — a
+    data-dependent shape XLA cannot compile. Here -1 means a fixed 2
+    samples per bin axis; pass an explicit sampling_ratio for more
+    resolution when porting models sensitive to large-RoI pooling."""
     if isinstance(output_size, int):
         output_size = (output_size, output_size)
     ph, pw = output_size

@@ -1,0 +1,237 @@
+"""Parameter-server mode: tables, RPC service, communicator, fleet glue.
+
+Ref intent: python/paddle/fluid/tests/unittests/test_dist_base.py
+(start_pserver + trainer procs on localhost) and
+test_dist_fleet_ps*.py — here servers run as in-process threads on
+ephemeral localhost ports, which exercises the identical TCP/RPC path.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed import ps
+
+
+@pytest.fixture()
+def two_servers():
+    s1 = ps.PSServer("127.0.0.1:0").start()
+    s2 = ps.PSServer("127.0.0.1:0").start()
+    eps = [f"127.0.0.1:{s1.port}", f"127.0.0.1:{s2.port}"]
+    client = ps.PSClient(eps)
+    yield client, eps
+    client.close()
+    s1.stop()
+    s2.stop()
+
+
+def _runtime_for(client, eps, mode="sync", n_trainers=1, geo_step=2):
+    rm = ps.PSRoleMaker(server_endpoints=eps, role="TRAINER",
+                        trainer_id=0, n_trainers=n_trainers)
+    rt = ps.PSRuntime(rm, mode=mode, geo_step=geo_step)
+    rt._client = client
+    from paddle_tpu.distributed.ps.service import Communicator
+
+    rt._communicator = Communicator(client, mode=mode,
+                                    geo_step=geo_step).start()
+    import paddle_tpu.distributed.ps.runtime as rtmod
+
+    rtmod._runtime = rt
+    return rt
+
+
+def test_dense_table_sgd(two_servers):
+    client, _ = two_servers
+    client.create_dense_table("w", [3], optimizer="sgd", lr=0.1,
+                              initial=np.array([1.0, 2.0, 3.0], np.float32))
+    client.push_dense_grad("w", np.array([1.0, 1.0, 1.0], np.float32))
+    got = client.pull_dense("w")
+    np.testing.assert_allclose(got, [0.9, 1.9, 2.9], rtol=1e-6)
+
+
+def test_sparse_table_partitioned_pull_push(two_servers):
+    client, _ = two_servers
+    client.create_sparse_table("emb", 4, optimizer="sgd", lr=0.5,
+                               init_range=0.0)  # zero init
+    ids = np.array([0, 1, 2, 3, 10, 11], np.int64)  # both shards
+    rows = client.pull_sparse("emb", ids)
+    np.testing.assert_allclose(rows, 0.0)
+    client.push_sparse_grad("emb", ids, np.ones((6, 4), np.float32))
+    rows = client.pull_sparse("emb", ids)
+    np.testing.assert_allclose(rows, -0.5, rtol=1e-6)
+    # rows actually live on different servers
+    assert client._call(0, "table_size", "emb") > 0
+    assert client._call(1, "table_size", "emb") > 0
+
+
+def test_sparse_lazy_init_deterministic(two_servers):
+    client, _ = two_servers
+    client.create_sparse_table("e2", 8, init_range=0.1)
+    a = client.pull_sparse("e2", np.array([7], np.int64))
+    b = client.pull_sparse("e2", np.array([7], np.int64))
+    np.testing.assert_allclose(a, b)
+    assert np.abs(a).max() <= 0.1 and np.abs(a).sum() > 0
+
+
+def test_save_load_roundtrip(two_servers):
+    client, _ = two_servers
+    client.create_sparse_table("e3", 2, optimizer="sgd", lr=1.0,
+                               init_range=0.0)
+    ids = np.arange(6, dtype=np.int64)
+    client.push_sparse_grad("e3", ids, -np.ones((6, 2), np.float32))
+    state = client.save()
+    client.push_sparse_grad("e3", ids, np.full((6, 2), 5.0, np.float32))
+    client.load(state)
+    rows = client.pull_sparse("e3", ids)
+    np.testing.assert_allclose(rows, 1.0, rtol=1e-6)
+
+
+def test_distributed_embedding_trains(two_servers):
+    client, eps = two_servers
+    _runtime_for(client, eps, mode="sync")
+    emb = ps.DistributedEmbedding("demb", 8, optimizer="sgd", lr=2.0,
+                                  init_range=0.01)
+    ids = paddle.to_tensor(np.array([[1, 3], [5, 3]], np.int64))
+    losses = []
+    for _ in range(40):
+        out = emb(ids)  # [2, 2, 8]
+        loss = ((out - 1.0) ** 2).mean()
+        loss.backward()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.05, (losses[0], losses[-1])
+
+
+def test_ps_optimizer_dense_round(two_servers):
+    client, eps = two_servers
+    _runtime_for(client, eps, mode="sync")
+    paddle.seed(0)
+    lin = paddle.nn.Linear(4, 1)
+    opt = ps.PSOptimizer(lin.parameters(), lr=0.1, optimizer="sgd")
+    rng = np.random.RandomState(0)
+    w = rng.randn(4, 1).astype(np.float32)
+    losses = []
+    for _ in range(40):
+        x = paddle.to_tensor(rng.randn(8, 4).astype(np.float32))
+        y = paddle.to_tensor(np.asarray(x.numpy() @ w, np.float32))
+        loss = ((lin(x) - y) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.1, (losses[0], losses[-1])
+
+
+def test_async_communicator_concurrent_trainers(two_servers):
+    client, eps = two_servers
+    client.create_sparse_table("hog", 4, optimizer="sgd", lr=0.1,
+                               init_range=0.0)
+    from paddle_tpu.distributed.ps.service import Communicator
+
+    comm = Communicator(client, mode="async").start()
+    n_push = 50
+
+    def trainer(tid):
+        ids = np.array([tid], np.int64)
+        for _ in range(n_push):
+            comm.push_sparse("hog", ids, np.ones((1, 4), np.float32))
+
+    threads = [threading.Thread(target=trainer, args=(t,))
+               for t in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    comm.stop()
+    rows = client.pull_sparse("hog", np.arange(4, dtype=np.int64))
+    # every push must land exactly once: row = -lr * n_push
+    np.testing.assert_allclose(rows, -0.1 * n_push, rtol=1e-5)
+
+
+def test_geo_mode_delta_push(two_servers):
+    client, eps = two_servers
+    rt = _runtime_for(client, eps, mode="geo", geo_step=2)
+    emb = ps.DistributedEmbedding("gemb", 4, lr=0.5, init_range=0.0)
+    comm = rt.communicator
+    ids = paddle.to_tensor(np.array([2], np.int64))
+
+    emb(ids).sum().backward()
+    comm.step_end()  # step 1: no flush yet
+    rows = client.pull_sparse("gemb", np.array([2], np.int64))
+    np.testing.assert_allclose(rows, 0.0)
+
+    emb(ids).sum().backward()
+    comm.step_end()  # step 2: flush -lr * (g1+g2) = -0.5 * 2
+    rows = client.pull_sparse("gemb", np.array([2], np.int64))
+    np.testing.assert_allclose(rows, -1.0, rtol=1e-6)
+
+
+def test_fleet_ps_roles(two_servers):
+    client, eps = two_servers
+    from paddle_tpu.distributed import fleet
+
+    strategy = fleet.DistributedStrategy()
+    strategy.a_sync = True
+    rm = ps.PSRoleMaker(server_endpoints=eps, role="TRAINER",
+                        trainer_id=0, n_trainers=1)
+    fleet.init(rm, strategy=strategy)
+    assert fleet.is_worker() and not fleet.is_server()
+    rt = fleet.fleet.ps_runtime
+    assert rt.mode == "async"
+    rt._client = client  # reuse fixture servers
+    fleet.init_worker()
+    client.create_dense_table("fw", [2], lr=0.5,
+                              initial=np.zeros(2, np.float32))
+    rt.communicator.push_dense("fw", np.ones(2, np.float32))
+    rt.communicator.flush()
+    np.testing.assert_allclose(client.pull_dense("fw"), -0.5)
+    fleet.stop_worker()
+
+
+def test_server_subprocess_roundtrip(tmp_path):
+    """Real process isolation: server in a subprocess via the env
+    contract (TRAINING_ROLE=PSERVER), trainer in this process."""
+    import os
+    import socket
+    import subprocess
+    import sys
+    import time
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    code = (
+        "import os\n"
+        "from paddle_tpu.distributed import ps\n"
+        "rm = ps.PSRoleMaker()\n"
+        "assert rm.is_server()\n"
+        "rt = ps.PSRuntime(rm)\n"
+        "rt.run_server()\n"
+    )
+    env = dict(os.environ, TRAINING_ROLE="PSERVER",
+               PADDLE_PORT=str(port), POD_IP="127.0.0.1",
+               JAX_PLATFORMS="cpu",
+               PYTHONPATH="/root/repo")
+    proc = subprocess.Popen([sys.executable, "-c", code], env=env)
+    try:
+        client = ps.PSClient([f"127.0.0.1:{port}"])
+        deadline = time.monotonic() + 30
+        while True:
+            try:
+                client.create_dense_table(
+                    "sub", [2], lr=1.0, initial=np.zeros(2, np.float32))
+                break
+            except (ConnectionError, OSError):
+                client.close()
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(0.2)
+        client.push_dense_grad("sub", np.ones(2, np.float32))
+        np.testing.assert_allclose(client.pull_dense("sub"), -1.0)
+        client.stop_servers()
+        assert proc.wait(timeout=20) == 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
