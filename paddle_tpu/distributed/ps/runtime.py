@@ -81,10 +81,8 @@ class PSRuntime:
     # -- worker side ---------------------------------------------------------
     def init_worker(self):
         self._client = PSClient(self.role.server_endpoints)
-        geo_scale = 1.0  # set per-table by DistributedEmbedding in geo mode
         self._communicator = Communicator(
-            self._client, mode=self.mode, geo_step=self.geo_step,
-            geo_scale=geo_scale).start()
+            self._client, mode=self.mode, geo_step=self.geo_step).start()
         return self._client
 
     @property
@@ -155,7 +153,7 @@ class DistributedEmbedding:
             # plain sum and the SGD scale lives client-side
             self.runtime.client.create_sparse_table(
                 name, dim, optimizer="sum", init_range=init_range)
-            comm.geo_scale = -self.lr
+            comm.set_geo_scale(name, -self.lr)
         else:
             self.runtime.client.create_sparse_table(
                 name, dim, optimizer=optimizer, lr=lr,

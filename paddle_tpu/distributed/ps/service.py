@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import pickle
 import socket
+import zlib
 import socketserver
 import struct
 import threading
@@ -85,6 +86,7 @@ class PSServer:
         host, port = endpoint.rsplit(":", 1)
         self.endpoint = endpoint
         self._tables: dict[str, object] = {}
+        self._tables_lock = threading.Lock()
         self._barrier_count = 0
         self._barrier_gen = 0
         self._barrier_cv = threading.Condition()
@@ -121,16 +123,18 @@ class PSServer:
     def _dispatch(self, cmd, args):
         if cmd == "create_dense":
             name, shape, opt, lr, initial = args
-            if name not in self._tables:
-                self._tables[name] = DenseTable(
-                    name, shape, optimizer=opt, lr=lr, initial=initial)
+            with self._tables_lock:  # racing trainers must not replace a
+                if name not in self._tables:  # table that has taken pushes
+                    self._tables[name] = DenseTable(
+                        name, shape, optimizer=opt, lr=lr, initial=initial)
             return None
         if cmd == "create_sparse":
             name, dim, opt, lr, init_range, seed = args
-            if name not in self._tables:
-                self._tables[name] = SparseTable(
-                    name, dim, optimizer=opt, lr=lr,
-                    init_range=init_range, seed=seed)
+            with self._tables_lock:
+                if name not in self._tables:
+                    self._tables[name] = SparseTable(
+                        name, dim, optimizer=opt, lr=lr,
+                        init_range=init_range, seed=seed)
             return None
         if cmd == "pull_dense":
             return self._tables[args].pull()
@@ -159,8 +163,13 @@ class PSServer:
                     self._barrier_gen += 1
                     self._barrier_cv.notify_all()
                 else:
-                    self._barrier_cv.wait_for(
+                    ok = self._barrier_cv.wait_for(
                         lambda: self._barrier_gen != gen, timeout=60.0)
+                    if not ok:
+                        self._barrier_count = 0  # reset for retry
+                        raise RuntimeError(
+                            "PS barrier timed out: not all trainers "
+                            "arrived within 60s")
             return None
         if cmd == "save":
             return {n: t.state_dict() for n, t in self._tables.items()}
@@ -186,6 +195,7 @@ class PSClient:
         self.endpoints = list(endpoints)
         self._socks = [None] * len(self.endpoints)
         self._locks = [threading.Lock() for _ in self.endpoints]
+        self._sparse_dims: dict[str, int] = {}
 
     def _sock(self, i):
         if self._socks[i] is None:
@@ -206,7 +216,8 @@ class PSClient:
         return result
 
     def _dense_server(self, name):
-        return hash(name) % len(self.endpoints)
+        # stable across processes (builtin hash is randomized per run)
+        return zlib.crc32(name.encode()) % len(self.endpoints)
 
     # -- table management ----------------------------------------------------
     def create_dense_table(self, name, shape, optimizer="sgd", lr=0.01,
@@ -216,6 +227,7 @@ class PSClient:
 
     def create_sparse_table(self, name, dim, optimizer="sgd", lr=0.01,
                             init_range=0.05, seed=0):
+        self._sparse_dims[name] = int(dim)
         for i in range(len(self.endpoints)):
             self._call(i, "create_sparse",
                        (name, dim, optimizer, lr, init_range, seed + i))
@@ -236,9 +248,11 @@ class PSClient:
     def pull_sparse(self, name, ids):
         ids = np.asarray(ids, np.int64).reshape(-1)
         n = len(self.endpoints)
-        out = np.empty((ids.shape[0], 0), np.float32)
+        if ids.size == 0:
+            return np.empty((0, self._sparse_dims.get(name, 0)),
+                            np.float32)
         parts = [np.nonzero(ids % n == i)[0] for i in range(n)]
-        dim = None
+        dim = self._sparse_dims.get(name)
         results = [None] * n
         for i, pos in enumerate(parts):
             if pos.size:
@@ -299,20 +313,24 @@ class Communicator:
               `geo_step` flushes merged deltas (optimizer='sum' tables)
     """
 
-    def __init__(self, client: PSClient, mode="async", geo_step=4,
-                 geo_scale=1.0):
+    def __init__(self, client: PSClient, mode="async", geo_step=4):
         self.client = client
         self.mode = mode
         self.geo_step = int(geo_step)
-        # geo deltas are scaled at flush (e.g. -lr turns summed grads into
-        # the SGD parameter delta merged by an optimizer='sum' table)
-        self.geo_scale = float(geo_scale)
+        # per-table geo delta scale at flush (e.g. -lr turns summed grads
+        # into the SGD parameter delta merged by an optimizer='sum' table)
+        self.geo_scales: dict[str, float] = {}
         self._queue: list = []
         self._cv = threading.Condition()
         self._running = False
         self._thread = None
+        self._inflight = 0
+        self._error: Exception | None = None
         self._geo_acc: dict[str, dict[int, np.ndarray]] = {}
         self._geo_count = 0
+
+    def set_geo_scale(self, table_name, scale):
+        self.geo_scales[table_name] = float(scale)
 
     # -- lifecycle -----------------------------------------------------------
     def start(self):
@@ -338,11 +356,21 @@ class Communicator:
                 if not self._running and not self._queue:
                     return
                 batch, self._queue = self._queue, []
-            for kind, name, a, b in batch:
-                if kind == "sparse":
-                    self.client.push_sparse_grad(name, a, b)
-                else:
-                    self.client.push_dense_grad(name, a)
+                self._inflight = len(batch)
+            try:
+                for kind, name, a, b in batch:
+                    if kind == "sparse":
+                        self.client.push_sparse_grad(name, a, b)
+                    else:
+                        self.client.push_dense_grad(name, a)
+                    with self._cv:
+                        self._inflight -= 1
+                        self._cv.notify_all()
+            except Exception as e:  # noqa: BLE001 — surface via flush()
+                with self._cv:
+                    self._error = e
+                    self._inflight = 0
+                    self._cv.notify_all()
 
     # -- pushes --------------------------------------------------------------
     def push_sparse(self, name, ids, grads):
@@ -392,15 +420,22 @@ class Communicator:
                     continue
                 ids = np.fromiter(acc.keys(), np.int64, len(acc))
                 grads = np.stack([acc[int(i)] for i in ids])
-                self.client.push_sparse_grad(name, ids,
-                                             self.geo_scale * grads)
+                scale = self.geo_scales.get(name, 1.0)
+                self.client.push_sparse_grad(name, ids, scale * grads)
             self._geo_acc = {}
             return
         if self.mode == "async":
-            # wait for the queue to empty
-            deadline = time.monotonic() + 30.0
-            while time.monotonic() < deadline:
-                with self._cv:
-                    if not self._queue:
-                        break
-                time.sleep(0.005)
+            # wait until queued AND in-flight pushes have landed
+            with self._cv:
+                ok = self._cv.wait_for(
+                    lambda: (self._error is not None
+                             or (not self._queue and self._inflight == 0)),
+                    timeout=60.0)
+                err, self._error = self._error, None
+            if err is not None:
+                raise RuntimeError(
+                    "async communicator push failed") from err
+            if not ok:
+                raise RuntimeError(
+                    "async communicator flush timed out (60s) with "
+                    "gradients still in flight")

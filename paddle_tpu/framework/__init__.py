@@ -6,3 +6,9 @@ python/paddle/device.py.
 
 from . import random  # noqa: F401
 from .random import get_rng_state, seed, set_rng_state  # noqa: F401
+from . import dataset  # noqa: F401
+from . import trainer  # noqa: F401
+from .dataset import (  # noqa: F401
+    DatasetFactory, InMemoryDataset, MultiSlotDataFeed, QueueDataset,
+)
+from .trainer import MultiTrainer, train_from_dataset  # noqa: F401

@@ -1,0 +1,237 @@
+"""Fleet-style datasets: file-list ingestion with slot parsing.
+
+Ref parity: paddle/fluid/framework/data_set.h (DatasetImpl:
+set_filelist/load_into_memory/local_shuffle/global_shuffle,
+InMemoryDataset vs QueueDataset) + data_feed.h MultiSlotDataFeed (the
+text slot format: per line, for each declared slot, a count followed by
+that many values) + python/paddle/fluid/dataset.py DatasetFactory.
+
+TPU-native: records parse into fixed-width numpy slot batches (padded
+int slots + dense float slots — static shapes for XLA), files shard
+across reader threads, and global_shuffle coordinates through the PS
+barrier when a PS runtime is active (single-process: local shuffle).
+"""
+
+from __future__ import annotations
+
+import random as _random
+import threading
+
+import numpy as np
+
+__all__ = ["DatasetFactory", "InMemoryDataset", "QueueDataset",
+           "MultiSlotDataFeed"]
+
+
+class MultiSlotDataFeed:
+    """Parses the reference's multi-slot text lines
+    (ref framework/data_feed.cc MultiSlotDataFeed::ParseOneInstance).
+
+    Line format, for each slot in order: `<n> v1 ... vn`.
+    Slot kinds: 'uint64'/'int64' (sparse id slots, padded to
+    `max_len`) and 'float' (dense slots, fixed width)."""
+
+    def __init__(self, slots, pad_value=0, max_len=None):
+        # slots: list of (name, dtype) or (name, dtype, width)
+        self.slots = []
+        for s in slots:
+            name, dtype = s[0], s[1]
+            width = s[2] if len(s) > 2 else None
+            self.slots.append((name, dtype, width))
+        self.pad_value = pad_value
+        self.max_len = max_len
+
+    def parse_line(self, line):
+        toks = line.split()
+        pos = 0
+        rec = {}
+        for name, dtype, _ in self.slots:
+            n = int(toks[pos])
+            pos += 1
+            vals = toks[pos:pos + n]
+            pos += n
+            if dtype in ("uint64", "int64", "int32"):
+                rec[name] = np.asarray([int(v) for v in vals], np.int64)
+            else:
+                rec[name] = np.asarray([float(v) for v in vals],
+                                       np.float32)
+        return rec
+
+    def batch(self, records):
+        """records -> dict of [B, W] arrays (id slots padded)."""
+        out = {}
+        for name, dtype, width in self.slots:
+            vals = [r[name] for r in records]
+            if dtype in ("uint64", "int64", "int32"):
+                w = width or self.max_len or max(len(v) for v in vals)
+                arr = np.full((len(vals), w), self.pad_value, np.int64)
+                for i, v in enumerate(vals):
+                    arr[i, :min(len(v), w)] = v[:w]
+                out[name] = arr
+            else:
+                w = width or max(len(v) for v in vals)
+                arr = np.zeros((len(vals), w), np.float32)
+                for i, v in enumerate(vals):
+                    arr[i, :min(len(v), w)] = v[:w]
+                out[name] = arr
+        return out
+
+
+class _DatasetBase:
+    """ref data_set.h DatasetImpl."""
+
+    def __init__(self):
+        self._filelist = []
+        self._batch_size = 1
+        self._thread_num = 1
+        self._feed = None
+        self._use_vars = []
+        self._pipe_command = None  # accepted for API parity; unused
+
+    # -- config (ref python/paddle/fluid/dataset.py) -------------------------
+    def set_filelist(self, filelist):
+        self._filelist = list(filelist)
+
+    def set_batch_size(self, batch_size):
+        self._batch_size = int(batch_size)
+
+    def set_thread(self, thread_num):
+        self._thread_num = max(int(thread_num), 1)
+
+    def set_use_var(self, var_list):
+        self._use_vars = [getattr(v, "name", v) for v in var_list]
+
+    def set_pipe_command(self, cmd):
+        self._pipe_command = cmd
+
+    def set_feed(self, feed: MultiSlotDataFeed):
+        self._feed = feed
+
+    def _require_feed(self):
+        if self._feed is None:
+            if not self._use_vars:
+                raise ValueError(
+                    "call set_feed(MultiSlotDataFeed(...)) or "
+                    "set_use_var([...]) first")
+            # default: every use_var is an int64 id slot
+            self._feed = MultiSlotDataFeed(
+                [(n, "int64") for n in self._use_vars])
+        return self._feed
+
+    def _read_file(self, path):
+        feed = self._require_feed()
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    yield feed.parse_line(line)
+
+
+class InMemoryDataset(_DatasetBase):
+    """ref data_set.h InMemoryDataset: load all records, shuffle, then
+    iterate batches (PS-mode training feeds from here)."""
+
+    def __init__(self):
+        super().__init__()
+        self._records = []
+        self._loaded = False
+
+    def load_into_memory(self):
+        records = []
+        if self._thread_num <= 1 or len(self._filelist) <= 1:
+            for path in self._filelist:
+                records.extend(self._read_file(path))
+        else:
+            lock = threading.Lock()
+            shards = [self._filelist[i::self._thread_num]
+                      for i in range(self._thread_num)]
+
+            def load(paths):
+                local = []
+                for p in paths:
+                    local.extend(self._read_file(p))
+                with lock:
+                    records.extend(local)
+
+            threads = [threading.Thread(target=load, args=(s,))
+                       for s in shards if s]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        self._records = records
+        self._loaded = True
+
+    def local_shuffle(self, seed=None):
+        _random.Random(seed).shuffle(self._records)
+
+    def global_shuffle(self, fleet=None, thread_num=None, seed=None):
+        """ref DatasetImpl::GlobalShuffle: all trainers barrier, then each
+        shuffles with a shared seed so shards stay disjoint. Without a PS
+        runtime this is a local shuffle."""
+        try:
+            from ..distributed.ps.runtime import get_runtime
+
+            rt = get_runtime()
+            rt.barrier()
+            seed = 7 if seed is None else seed  # shared across trainers
+        except (RuntimeError, ImportError):
+            pass
+        self.local_shuffle(seed)
+
+    def release_memory(self):
+        self._records = []
+        self._loaded = False
+
+    def get_memory_data_size(self, fleet=None):
+        return len(self._records)
+
+    def __iter__(self):
+        """Yield slot batches (dict name -> np array)."""
+        if not self._loaded:
+            self.load_into_memory()
+        feed = self._require_feed()
+        bs = self._batch_size
+        for i in range(0, len(self._records) - bs + 1, bs):
+            yield feed.batch(self._records[i:i + bs])
+
+
+class QueueDataset(_DatasetBase):
+    """ref data_set.h QueueDataset: streaming — records flow from files
+    through a bounded queue without materialising in memory."""
+
+    def __iter__(self):
+        import queue as _q
+
+        feed = self._require_feed()
+        q: _q.Queue = _q.Queue(maxsize=4096)
+        DONE = object()
+
+        def produce():
+            for path in self._filelist:
+                for rec in self._read_file(path):
+                    q.put(rec)
+            q.put(DONE)
+
+        t = threading.Thread(target=produce, daemon=True)
+        t.start()
+        buf = []
+        while True:
+            rec = q.get()
+            if rec is DONE:
+                break
+            buf.append(rec)
+            if len(buf) == self._batch_size:
+                yield feed.batch(buf)
+                buf = []
+
+
+class DatasetFactory:
+    """ref python/paddle/fluid/dataset.py DatasetFactory."""
+
+    def create_dataset(self, datafeed_class="QueueDataset"):
+        if datafeed_class == "InMemoryDataset":
+            return InMemoryDataset()
+        if datafeed_class == "QueueDataset":
+            return QueueDataset()
+        raise ValueError(f"unknown dataset class {datafeed_class!r}")

@@ -1,0 +1,90 @@
+"""Trainer / DeviceWorker: the dataset-driven training loop.
+
+Ref parity: paddle/fluid/framework/trainer.h (TrainerBase ->
+MultiTrainer/DistMultiTrainer), device_worker.h (HogwildWorker,
+DownpourWorker), and Executor::RunFromDataset (executor.h:137). The
+reference builds per-thread scopes and runs the program op-by-op per
+worker; here a worker is a Python callable over slot batches — either
+an eager train function (Hogwild threads, PS-mode with async push/pull
+= DownpourWorker semantics) or a compiled static Program replayed by
+the Executor (one XLA computation per batch shape).
+"""
+
+from __future__ import annotations
+
+import threading
+
+__all__ = ["HogwildWorker", "MultiTrainer", "train_from_dataset"]
+
+
+class HogwildWorker:
+    """ref device_worker.h HogwildWorker: one worker thread running the
+    train function over its shard of batches, lock-free on shared
+    parameters (the PS Communicator carries the gradients in PS mode —
+    DownpourWorker's role)."""
+
+    def __init__(self, worker_id, train_func, fetch_info=None):
+        self.worker_id = worker_id
+        self.train_func = train_func
+        self.fetch_info = fetch_info
+        self.metrics = []
+
+    def run(self, batches):
+        for batch in batches:
+            out = self.train_func(batch)
+            if out is not None:
+                self.metrics.append(out)
+
+
+class MultiTrainer:
+    """ref trainer.h MultiTrainer: N workers over a sharded dataset."""
+
+    def __init__(self, thread_num=1):
+        self.thread_num = max(int(thread_num), 1)
+        self.workers: list[HogwildWorker] = []
+
+    def train(self, dataset, train_func):
+        """Shard the dataset's batches round-robin over worker threads
+        (ref MultiTrainer::Initialize reader split + Run)."""
+        batches = list(dataset)
+        n = self.thread_num
+        self.workers = [HogwildWorker(i, train_func) for i in range(n)]
+        if n == 1:
+            self.workers[0].run(batches)
+        else:
+            threads = [
+                threading.Thread(target=w.run, args=(batches[i::n],))
+                for i, w in enumerate(self.workers)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        out = []
+        for w in self.workers:
+            out.extend(w.metrics)
+        return out
+
+
+def train_from_dataset(program, dataset, fetch_list=None, thread=1,
+                       executor=None, debug=False):
+    """Executor::RunFromDataset for static Programs: replay the compiled
+    program once per slot batch, feeding slots by var name.
+
+    Returns the per-batch fetch values (ref fetch_info printing)."""
+    from ..static.program import Executor
+
+    exe = executor or Executor()
+    results = []
+
+    def step(batch):
+        feed = {k: v for k, v in batch.items()
+                if program.global_block().has_var(k)}
+        vals = exe.run(program, feed=feed, fetch_list=fetch_list or [])
+        if debug and vals:
+            print(f"[train_from_dataset] fetch={vals}")
+        return vals
+
+    trainer = MultiTrainer(thread)
+    results = trainer.train(dataset, step)
+    return results

@@ -705,6 +705,18 @@ class Executor:
             return [np.asarray(v) for v in fetched]
         return [Tensor(v) for v in fetched]
 
+    def train_from_dataset(self, program=None, dataset=None,
+                           fetch_list=None, thread=1, debug=False, **kw):
+        """ref Executor::RunFromDataset (framework/executor.h:137) via
+        the Trainer/DeviceWorker loop (framework/trainer.py)."""
+        from ..framework.trainer import train_from_dataset as _tfd
+
+        return _tfd(program or _main_program, dataset,
+                    fetch_list=fetch_list, thread=thread, executor=self,
+                    debug=debug)
+
+    infer_from_dataset = train_from_dataset
+
     def close(self):
         self._cache.clear()
 
