@@ -75,7 +75,7 @@ class HybridParallelEngine:
 
     def __init__(self, model, criterion, optimizer, hcg, *,
                  block_regex, template_block, embed_fn, head_fn,
-                 accumulate_steps=1, zero_stage=0):
+                 accumulate_steps=1, zero_stage=0, offload=False):
         self.model = model
         self.criterion = criterion
         self.optimizer = optimizer
@@ -83,6 +83,7 @@ class HybridParallelEngine:
         self.mesh = hcg.get_mesh()
         self.accumulate_steps = accumulate_steps
         self.zero_stage = zero_stage
+        self.offload = offload
         self.block_regex = block_regex
         self.template_block = template_block
         self.embed_fn = embed_fn
@@ -112,6 +113,7 @@ class HybridParallelEngine:
                      for k, v in self.rest_params.items()},
         }
         self._step_fn = None
+        self._offload_sh = None
         self._shardings = self._build_shardings(specs)
 
     # -- sharding specs ------------------------------------------------------
@@ -166,22 +168,33 @@ class HybridParallelEngine:
         def ns(spec):
             return NamedSharding(mesh, spec)
 
-        block_sh = {k: ns(self._block_leaf_spec(k, v))
-                    for k, v in self.block_params.items()}
+        ns_opt = ns  # jit shardings stay in device memory; offload keeps
+        # the state at REST in host memory (see train_batch transfers)
+
+        def param_spec_of(k, v, base):
+            # ZeRO-3: shard the parameters themselves on a free divisible
+            # dim (XLA all-gathers where full values are consumed)
+            if self.zero_stage >= 3:
+                return self._opt_leaf_spec(
+                    tuple(base) if base is not None else None, v, name=k)
+            return base if base is not None else P()
+
+        block_sh = {
+            k: ns(param_spec_of(k, v, self._block_leaf_spec(k, v)))
+            for k, v in self.block_params.items()}
         rest_sh = {}
         for k, v in self.rest_params.items():
-            sp = specs.get(k)
-            rest_sh[k] = ns(sp if sp is not None else P())
+            rest_sh[k] = ns(param_spec_of(k, v, specs.get(k)))
         buf_sh = {k: ns(P()) for k in self.rest_buffers}
         opt_block_sh = {
             k: jax.tree.map(
-                lambda a, kk=k: ns(self._opt_leaf_spec(
+                lambda a, kk=k: ns_opt(self._opt_leaf_spec(
                     tuple(self._block_leaf_spec(kk,
                           self.block_params[kk])), a, name=kk)), st)
             for k, st in self.opt_state["blocks"].items()}
         opt_rest_sh = {
             k: jax.tree.map(
-                lambda a, kk=k: ns(self._opt_leaf_spec(
+                lambda a, kk=k: ns_opt(self._opt_leaf_spec(
                     specs.get(kk), a, name=kk)), st)
             for k, st in self.opt_state["rest"].items()}
         data_sh = ns(P(DP_AXIS))  # tokens [B, s]: batch dim over dp
@@ -284,19 +297,47 @@ class HybridParallelEngine:
                            sh["opt"]),
             donate_argnums=(0, 1, 3))
 
+    def _offload_shardings(self):
+        """(device_sh, host_sh) for the opt-state tree, or None."""
+        if not self.offload:
+            return None
+        from ..engine import _host_memory_kind
+
+        kind = _host_memory_kind(self.mesh)
+        if kind is None:
+            return None
+        dev = self._shardings["opt"]
+        host = jax.tree.map(
+            lambda sh: NamedSharding(self.mesh, sh.spec,
+                                     memory_kind=kind), dev,
+            is_leaf=lambda x: isinstance(x, NamedSharding))
+        return dev, host
+
     def train_batch(self, tokens, labels):
         if self._step_fn is None:
             self._build()
+            self._offload_sh = self._offload_shardings()
+            if self._offload_sh is not None:
+                # optimizer state rests in pinned host memory between
+                # steps (ref sharding/offload_helper.py)
+                self.opt_state = jax.device_put(self.opt_state,
+                                                self._offload_sh[1])
         t = tokens._value if isinstance(tokens, Tensor) else \
             jnp.asarray(tokens)
         l = labels._value if isinstance(labels, Tensor) else \
             jnp.asarray(labels)
         key = _random.default_generator.next_key()
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
-        loss, self.block_params, self.rest_params, self.opt_state = \
+        opt_state = self.opt_state
+        if self._offload_sh is not None:
+            opt_state = jax.device_put(opt_state, self._offload_sh[0])
+        loss, self.block_params, self.rest_params, new_opt = \
             self._step_fn(self.block_params, self.rest_params,
-                          self.rest_buffers, self.opt_state, (t, l), lr,
+                          self.rest_buffers, opt_state, (t, l), lr,
                           key)
+        if self._offload_sh is not None:
+            new_opt = jax.device_put(new_opt, self._offload_sh[1])
+        self.opt_state = new_opt
         return Tensor(loss)
 
 
@@ -309,7 +350,8 @@ def values_sub(values, prefix):
 
 
 def make_gpt_hybrid_engine(model, criterion, optimizer, hcg, *,
-                           accumulate_steps=1, zero_stage=0):
+                           accumulate_steps=1, zero_stage=0,
+                           offload=False):
     from ..engine import functional_call
 
     def embed_fn(m, values, tokens):
@@ -331,11 +373,13 @@ def make_gpt_hybrid_engine(model, criterion, optimizer, hcg, *,
         block_regex=r"gpt\.layers\.(\d+)\.(.*)",
         template_block=model.gpt.layers[0],
         embed_fn=embed_fn, head_fn=head_fn,
-        accumulate_steps=accumulate_steps, zero_stage=zero_stage)
+        accumulate_steps=accumulate_steps, zero_stage=zero_stage,
+        offload=offload)
 
 
 def make_ernie_hybrid_engine(model, criterion, optimizer, hcg, *,
-                             accumulate_steps=1, zero_stage=0):
+                             accumulate_steps=1, zero_stage=0,
+                             offload=False):
     """ERNIE pretraining (MLM-only in the hybrid path: NSP head needs the
     pooler over the full sequence, kept in the head_fn)."""
     from ..engine import functional_call

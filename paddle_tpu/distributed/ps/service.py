@@ -166,7 +166,11 @@ class PSServer:
                     ok = self._barrier_cv.wait_for(
                         lambda: self._barrier_gen != gen, timeout=60.0)
                     if not ok:
-                        self._barrier_count = 0  # reset for retry
+                        # withdraw ONLY this trainer's count — zeroing it
+                        # would corrupt trainers still validly waiting
+                        if self._barrier_gen == gen:
+                            self._barrier_count = max(
+                                0, self._barrier_count - 1)
                         raise RuntimeError(
                             "PS barrier timed out: not all trainers "
                             "arrived within 60s")
@@ -201,6 +205,10 @@ class PSClient:
         if self._socks[i] is None:
             host, port = self.endpoints[i].rsplit(":", 1)
             s = socket.create_connection((host, int(port)), timeout=30.0)
+            # per-call timeout must exceed the server's 60s barrier wait,
+            # or a blocked barrier desyncs the RPC framing (the late
+            # reply would be read as the NEXT call's response)
+            s.settimeout(120.0)
             s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             self._socks[i] = s
         return self._socks[i]

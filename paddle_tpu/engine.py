@@ -137,21 +137,56 @@ def write_back(layer, state: TrainState):
             sd[k]._value = v
 
 
+def _host_memory_kind(mesh):
+    """'pinned_host' when the backend exposes it (TPU + recent CPU), else
+    None — offload degrades to device memory with a warning."""
+    try:
+        dev = next(iter(mesh.devices.flat))
+        kinds = {m.kind for m in dev.addressable_memories()}
+        if "pinned_host" in kinds:
+            return "pinned_host"
+    except Exception:  # noqa: BLE001 — older jax without memories API
+        pass
+    import warnings
+
+    warnings.warn("optimizer-state offload requested but the backend has "
+                  "no pinned_host memory space; keeping state on device")
+    return None
+
+
 def build_shardings(layer, optimizer, mesh, *, dp_axis="dp",
                     sharding_axis=None, zero_stage=0):
     """Construct NamedShardings for params / opt state from param_specs.
 
-    ZeRO (`sharding` in fleet terms): stage>=1 shards optimizer moments
-    along `sharding_axis` on the first divisible dimension — the GSPMD
-    equivalent of DygraphShardingOptimizer's rank-wise partition
-    (ref: fleet/meta_optimizers/dygraph_optimizer/
-    dygraph_sharding_optimizer.py:27).
+    ZeRO (`sharding` in fleet terms, ref fleet/meta_optimizers/sharding_
+    optimizer.py + dygraph_sharding_optimizer.py:27):
+      stage>=1  shard optimizer moments along `sharding_axis` on the
+                first divisible dim (GSPMD partitions the update)
+      stage>=3  additionally shard the PARAMETERS the same way — XLA
+                all-gathers them where the forward needs full values and
+                frees the gathered copies after use (the stage-3
+                working-set behaviour)
     """
     specs = param_specs(layer)
 
+    def _zero_spec(arr):
+        """First-divisible-dim sharding spec, or None."""
+        if sharding_axis is None or arr.ndim < 1:
+            return None
+        axis_size = mesh.shape[sharding_axis]
+        if arr.shape[0] % axis_size == 0 and arr.shape[0] >= axis_size:
+            return P(sharding_axis, *([None] * (arr.ndim - 1)))
+        return None
+
     def param_sharding(name, arr):
         spec = specs.get(name)
-        return NamedSharding(mesh, spec if spec is not None else P())
+        if spec is not None:
+            return NamedSharding(mesh, spec)
+        if zero_stage >= 3:
+            zspec = _zero_spec(arr)
+            if zspec is not None:
+                return NamedSharding(mesh, zspec)
+        return NamedSharding(mesh, P())
 
     warned = set()  # once per param name across state leaves AND grads
 
@@ -161,10 +196,10 @@ def build_shardings(layer, optimizer, mesh, *, dp_axis="dp",
             return NamedSharding(mesh, spec) if len(spec) == arr.ndim \
                 else NamedSharding(mesh, P())
         if zero_stage >= 1 and sharding_axis is not None and arr.ndim >= 1:
+            zspec = _zero_spec(arr)
+            if zspec is not None:
+                return NamedSharding(mesh, zspec)
             axis_size = mesh.shape[sharding_axis]
-            if arr.shape[0] % axis_size == 0 and arr.shape[0] >= axis_size:
-                return NamedSharding(
-                    mesh, P(sharding_axis, *([None] * (arr.ndim - 1))))
             if arr.size >= axis_size and name not in warned:
                 warned.add(name)
                 import warnings
@@ -192,13 +227,20 @@ DEFAULT_SCALE_CONFIG = dict(
 
 def make_train_step(layer, loss_fn, optimizer, *, grad_clip=None,
                     donate=True, mesh=None, batch_spec=None, zero_stage=0,
-                    sharding_axis=None, loss_scale=None):
+                    sharding_axis=None, loss_scale=None, comm_dtype=None):
     """Build a jitted step:
     (params, buffers, opt_state, batch, lr, key) ->
         (loss, params, buffers, opt_state)
 
     batch: dict with 'inputs' (tuple of arrays) and optional 'labels'
     (tuple). loss_fn(outputs, *labels) -> scalar Tensor.
+
+    comm_dtype ('bfloat16'/'float16'): the fp16_allreduce strategy (ref
+    fleet/meta_optimizers/fp16_allreduce_optimizer.py). Under GSPMD the
+    gradient all-reduce is fused into the backward matmuls, so reduced-
+    precision communication means computing those grads in the reduced
+    dtype: the step runs under O2 autocast of `comm_dtype` while params
+    and optimizer state stay fp32 (master weights).
     """
     grad_clip = grad_clip if grad_clip is not None else \
         getattr(optimizer, "_grad_clip", None)
@@ -207,7 +249,13 @@ def make_train_step(layer, loss_fn, optimizer, *, grad_clip=None,
     _sd = layer.state_dict()
 
     def loss_of(params, buffers, batch, key):
-        with _random.rng_scope(key):
+        if comm_dtype is not None:
+            from .amp import auto_cast
+
+            amp_ctx = auto_cast(enable=True, level="O2", dtype=comm_dtype)
+        else:
+            amp_ctx = contextlib.nullcontext()
+        with _random.rng_scope(key), amp_ctx:
             inputs = batch["inputs"]
             if not isinstance(inputs, (list, tuple)):
                 inputs = (inputs,)
@@ -344,6 +392,12 @@ def make_train_step(layer, loss_fn, optimizer, *, grad_clip=None,
     # the un-jitted step is re-usable inside larger traced loops (bench
     # scans N steps in one program to amortise dispatch latency)
     jitted._raw_step_fn = step_fn
+    # exposed so Engine can pre-place live state into these shardings
+    # (offload moves opt state to host memory; jit requires the arg's
+    # memory kind to already match)
+    jitted._state_shardings = (
+        (in_shardings[0], in_shardings[1], in_shardings[2])
+        if in_shardings is not None else None)
     return jitted
 
 
@@ -368,7 +422,7 @@ class Engine:
 
     def __init__(self, layer, optimizer, loss_fn, grad_clip=None, mesh=None,
                  batch_spec=None, zero_stage=0, sharding_axis=None,
-                 loss_scale=None):
+                 loss_scale=None, offload=False, comm_dtype=None):
         self.layer = layer
         self.optimizer = optimizer
         self.loss_fn = loss_fn
@@ -377,6 +431,8 @@ class Engine:
         self.zero_stage = zero_stage
         self.sharding_axis = sharding_axis
         self.loss_scale = loss_scale
+        self.offload = offload
+        self.comm_dtype = comm_dtype
         self.state = init_train_state(layer, optimizer)
         if loss_scale == "dynamic" or isinstance(loss_scale, dict):
             # in-graph dynamic loss scaling state (fp16-compat mode)
@@ -388,6 +444,7 @@ class Engine:
             self.state.buffers[GOOD_STEPS_KEY] = jnp.asarray(0, jnp.int32)
             self.state.buffers[BAD_STEPS_KEY] = jnp.asarray(0, jnp.int32)
         self._step_fn = None
+        self._offload_sh = None
         self._grad_clip = grad_clip
 
     def _build(self):
@@ -395,7 +452,25 @@ class Engine:
             self.layer, self.loss_fn, self.optimizer,
             grad_clip=self._grad_clip, mesh=self.mesh,
             batch_spec=self.batch_spec, zero_stage=self.zero_stage,
-            sharding_axis=self.sharding_axis, loss_scale=self.loss_scale)
+            sharding_axis=self.sharding_axis, loss_scale=self.loss_scale,
+            comm_dtype=self.comm_dtype)
+        self._offload_sh = None
+        if self.offload and self._step_fn._state_shardings is not None:
+            # optimizer-state offload (ref sharding/offload_helper.py):
+            # state RESTS in pinned host memory between steps and moves
+            # to device around each call. (In-graph streaming transfers
+            # need TPU host-offload support; the at-rest form works on
+            # every backend and still frees device memory between steps.)
+            kind = _host_memory_kind(self.mesh)
+            if kind is not None:
+                _, _, o_sh = self._step_fn._state_shardings
+                host = jax.tree.map(
+                    lambda sh: NamedSharding(self.mesh, sh.spec,
+                                             memory_kind=kind), o_sh,
+                    is_leaf=lambda x: isinstance(x, NamedSharding))
+                self._offload_sh = (o_sh, host)
+                self.state.opt_state = jax.device_put(
+                    self.state.opt_state, host)
 
     @staticmethod
     def _arrs(ts):
@@ -412,9 +487,16 @@ class Engine:
         batch = {"inputs": self._arrs(inputs), "labels": self._arrs(labels)}
         key = _random.default_generator.next_key()
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
-        loss, self.state.params, self.state.buffers, self.state.opt_state = \
+        opt_state = self.state.opt_state
+        if self._offload_sh is not None:
+            dev_sh, host_sh = self._offload_sh
+            opt_state = jax.device_put(opt_state, dev_sh)
+        loss, self.state.params, self.state.buffers, new_opt = \
             self._step_fn(self.state.params, self.state.buffers,
-                          self.state.opt_state, batch, lr, key)
+                          opt_state, batch, lr, key)
+        if self._offload_sh is not None:
+            new_opt = jax.device_put(new_opt, self._offload_sh[1])
+        self.state.opt_state = new_opt
         self.state.step += 1
         return Tensor(loss)
 

@@ -169,14 +169,13 @@ class InMemoryDataset(_DatasetBase):
         """ref DatasetImpl::GlobalShuffle: all trainers barrier, then each
         shuffles with a shared seed so shards stay disjoint. Without a PS
         runtime this is a local shuffle."""
-        try:
-            from ..distributed.ps.runtime import get_runtime
+        from ..distributed.ps import runtime as ps_runtime
 
-            rt = get_runtime()
-            rt.barrier()
+        if ps_runtime._runtime is not None:
+            # barrier failures must PROPAGATE: a trainer that shuffled
+            # with a different seed silently breaks shard disjointness
+            ps_runtime._runtime.barrier()
             seed = 7 if seed is None else seed  # shared across trainers
-        except (RuntimeError, ImportError):
-            pass
         self.local_shuffle(seed)
 
     def release_memory(self):
@@ -208,10 +207,13 @@ class QueueDataset(_DatasetBase):
         DONE = object()
 
         def produce():
-            for path in self._filelist:
-                for rec in self._read_file(path):
-                    q.put(rec)
-            q.put(DONE)
+            try:
+                for path in self._filelist:
+                    for rec in self._read_file(path):
+                        q.put(rec)
+                q.put(DONE)
+            except BaseException as e:  # noqa: BLE001 — surface, not hang
+                q.put(e)
 
         t = threading.Thread(target=produce, daemon=True)
         t.start()
@@ -220,6 +222,8 @@ class QueueDataset(_DatasetBase):
             rec = q.get()
             if rec is DONE:
                 break
+            if isinstance(rec, BaseException):
+                raise rec
             buf.append(rec)
             if len(buf) == self._batch_size:
                 yield feed.batch(buf)

@@ -44,18 +44,29 @@ class MultiTrainer:
         self.workers: list[HogwildWorker] = []
 
     def train(self, dataset, train_func):
-        """Shard the dataset's batches round-robin over worker threads
-        (ref MultiTrainer::Initialize reader split + Run)."""
-        batches = list(dataset)
+        """Stream the dataset's batches to worker threads through a
+        shared iterator (ref MultiTrainer::Initialize reader split +
+        Run). Streaming keeps QueueDataset's constant-memory property —
+        batches are never materialised up front."""
         n = self.thread_num
         self.workers = [HogwildWorker(i, train_func) for i in range(n)]
+        it = iter(dataset)
         if n == 1:
-            self.workers[0].run(batches)
+            self.workers[0].run(it)
         else:
-            threads = [
-                threading.Thread(target=w.run, args=(batches[i::n],))
-                for i, w in enumerate(self.workers)
-            ]
+            lock = threading.Lock()
+
+            def shard():
+                while True:
+                    with lock:
+                        try:
+                            batch = next(it)
+                        except StopIteration:
+                            return
+                    yield batch
+
+            threads = [threading.Thread(target=w.run, args=(shard(),))
+                       for w in self.workers]
             for t in threads:
                 t.start()
             for t in threads:

@@ -715,7 +715,18 @@ class Executor:
                     fetch_list=fetch_list, thread=thread, executor=self,
                     debug=debug)
 
-    infer_from_dataset = train_from_dataset
+    def infer_from_dataset(self, program=None, dataset=None,
+                           fetch_list=None, thread=1, debug=False, **kw):
+        """Like train_from_dataset but runs NO parameter updates (ref
+        Executor.infer_from_dataset): a training program is replayed
+        through its for_test clone (backward + optimizer ops dropped)."""
+        from ..framework.trainer import train_from_dataset as _tfd
+
+        program = program or _main_program
+        if program.backward_index is not None:
+            program = program.clone(for_test=True)
+        return _tfd(program, dataset, fetch_list=fetch_list,
+                    thread=thread, executor=self, debug=debug)
 
     def close(self):
         self._cache.clear()
