@@ -1,0 +1,117 @@
+"""Perf attribution for the ERNIE train step (not the driver bench).
+
+Times variants with the same differenced scan-N method as bench.py to
+locate where step time goes: full step, dropout off, jnp-SDPA fallback
+vs pallas flash, forward-only, head/loss cost.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    jax.config.update("jax_default_prng_impl", "rbg")
+    import jax.numpy as jnp
+    from jax import lax
+
+    import paddle_tpu as paddle
+    from paddle_tpu import amp
+    from paddle_tpu.engine import Engine
+    from paddle_tpu.framework import random as _random
+    from paddle_tpu.nlp.transformers import (
+        ErnieConfig, ErnieForPretraining, ErniePretrainingCriterion,
+    )
+
+    batch = int(os.environ.get("BENCH_BATCH", "32"))
+    seq = 512
+    iters = 16
+
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, 18000, (batch, seq)).astype(np.int32)
+    labels = ids.copy()
+    labels[rng.rand(batch, seq) > 0.15] = -100
+
+    def build(dropout, force_jnp_attn=False):
+        if force_jnp_attn:
+            os.environ["PADDLE_TPU_FLASH_FORCE"] = "jnp"
+        else:
+            os.environ.pop("PADDLE_TPU_FLASH_FORCE", None)
+        paddle.seed(0)
+        cfg = ErnieConfig(vocab_size=18000, hidden_size=768, num_layers=12,
+                          num_heads=12, ffn_hidden_size=3072,
+                          max_seq_len=seq, dropout=dropout,
+                          attn_dropout=dropout, use_parallel=False)
+        model = ErnieForPretraining(cfg)
+        criterion = ErniePretrainingCriterion(cfg)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                     parameters=model.parameters(),
+                                     weight_decay=0.01)
+
+        def loss_fn(outputs, mlm_labels):
+            logits, nsp = outputs
+            return criterion(logits, nsp, mlm_labels)
+
+        eng = Engine(model, opt, loss_fn)
+        with amp.auto_cast(enable=True, dtype="bfloat16"):
+            eng.train_batch(ids, labels)  # build + warm
+        return eng
+
+    def timed_step(eng, fwd_only=False):
+        raw = eng._step_fn._raw_step_fn
+        xj, yj = jnp.asarray(ids), jnp.asarray(labels)
+        lr = jnp.asarray(1e-4, jnp.float32)
+        key = _random.default_generator.next_key()
+        st = eng.state
+
+        def make(n):
+            @jax.jit
+            def run(params, buffers, opt_state):
+                def body(carry, i):
+                    p, b, o = carry
+                    with amp.auto_cast(enable=True, dtype="bfloat16"):
+                        loss, p2, b2, o2 = raw(
+                            p, b, o, {"inputs": (xj,), "labels": (yj,)},
+                            lr, jax.random.fold_in(key, i))
+                    if fwd_only:
+                        # keep only the loss dependency; params unchanged
+                        return (p, b, o), loss
+                    return (p2, b2, o2), loss
+                (p, b, o), losses = lax.scan(
+                    body, (params, buffers, opt_state), jnp.arange(n))
+                return losses[-1], p, b, o
+            return run
+
+        r1, r2 = make(iters), make(3 * iters)
+
+        def t(run):
+            l, *_ = run(st.params, st.buffers, st.opt_state)
+            float(np.asarray(l))
+            t0 = time.perf_counter()
+            l, *_ = run(st.params, st.buffers, st.opt_state)
+            float(np.asarray(l))
+            return time.perf_counter() - t0
+
+        return (t(r2) - t(r1)) / (2 * iters) * 1e3  # ms/step
+
+    variant = sys.argv[1] if len(sys.argv) > 1 else "full"
+    if variant == "full":
+        eng = build(dropout=0.1)
+    elif variant == "nodrop":
+        eng = build(dropout=0.0)
+    elif variant == "jnp_attn":
+        eng = build(dropout=0.1, force_jnp_attn=True)
+    elif variant == "jnp_nodrop":
+        eng = build(dropout=0.0, force_jnp_attn=True)
+    else:
+        raise SystemExit(f"unknown variant {variant}")
+    ms = timed_step(eng)
+    print(json.dumps({"variant": variant, "step_ms": round(ms, 2)}))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

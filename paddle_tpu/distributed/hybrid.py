@@ -168,9 +168,6 @@ class HybridParallelEngine:
         def ns(spec):
             return NamedSharding(mesh, spec)
 
-        ns_opt = ns  # jit shardings stay in device memory; offload keeps
-        # the state at REST in host memory (see train_batch transfers)
-
         def param_spec_of(k, v, base):
             # ZeRO-3: shard the parameters themselves on a free divisible
             # dim (XLA all-gathers where full values are consumed)
@@ -188,13 +185,13 @@ class HybridParallelEngine:
         buf_sh = {k: ns(P()) for k in self.rest_buffers}
         opt_block_sh = {
             k: jax.tree.map(
-                lambda a, kk=k: ns_opt(self._opt_leaf_spec(
+                lambda a, kk=k: ns(self._opt_leaf_spec(
                     tuple(self._block_leaf_spec(kk,
                           self.block_params[kk])), a, name=kk)), st)
             for k, st in self.opt_state["blocks"].items()}
         opt_rest_sh = {
             k: jax.tree.map(
-                lambda a, kk=k: ns_opt(self._opt_leaf_spec(
+                lambda a, kk=k: ns(self._opt_leaf_spec(
                     specs.get(kk), a, name=kk)), st)
             for k, st in self.opt_state["rest"].items()}
         data_sh = ns(P(DP_AXIS))  # tokens [B, s]: batch dim over dp
@@ -297,31 +294,17 @@ class HybridParallelEngine:
                            sh["opt"]),
             donate_argnums=(0, 1, 3))
 
-    def _offload_shardings(self):
-        """(device_sh, host_sh) for the opt-state tree, or None."""
-        if not self.offload:
-            return None
-        from ..engine import _host_memory_kind
-
-        kind = _host_memory_kind(self.mesh)
-        if kind is None:
-            return None
-        dev = self._shardings["opt"]
-        host = jax.tree.map(
-            lambda sh: NamedSharding(self.mesh, sh.spec,
-                                     memory_kind=kind), dev,
-            is_leaf=lambda x: isinstance(x, NamedSharding))
-        return dev, host
-
     def train_batch(self, tokens, labels):
         if self._step_fn is None:
             self._build()
-            self._offload_sh = self._offload_shardings()
-            if self._offload_sh is not None:
-                # optimizer state rests in pinned host memory between
-                # steps (ref sharding/offload_helper.py)
-                self.opt_state = jax.device_put(self.opt_state,
-                                                self._offload_sh[1])
+            if self.offload:
+                # opt state rests in pinned host memory between steps
+                # (ref sharding/offload_helper.py); initial state stays
+                # on device — the first step would only round-trip it
+                from ..engine import host_offload_shardings
+
+                self._offload_sh = host_offload_shardings(
+                    self.mesh, self._shardings["opt"])
         t = tokens._value if isinstance(tokens, Tensor) else \
             jnp.asarray(tokens)
         l = labels._value if isinstance(labels, Tensor) else \

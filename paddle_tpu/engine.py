@@ -137,6 +137,19 @@ def write_back(layer, state: TrainState):
             sd[k]._value = v
 
 
+def host_offload_shardings(mesh, dev_sh_tree):
+    """(device, host) sharding trees for at-rest optimizer-state offload
+    (ref sharding/offload_helper.py), or None when the backend has no
+    host memory space. Shared by Engine and HybridParallelEngine."""
+    kind = _host_memory_kind(mesh)
+    if kind is None:
+        return None
+    host = jax.tree.map(
+        lambda sh: NamedSharding(mesh, sh.spec, memory_kind=kind),
+        dev_sh_tree, is_leaf=lambda x: isinstance(x, NamedSharding))
+    return dev_sh_tree, host
+
+
 def _host_memory_kind(mesh):
     """'pinned_host' when the backend exposes it (TPU + recent CPU), else
     None — offload degrades to device memory with a warning."""
@@ -461,16 +474,10 @@ class Engine:
             # to device around each call. (In-graph streaming transfers
             # need TPU host-offload support; the at-rest form works on
             # every backend and still frees device memory between steps.)
-            kind = _host_memory_kind(self.mesh)
-            if kind is not None:
-                _, _, o_sh = self._step_fn._state_shardings
-                host = jax.tree.map(
-                    lambda sh: NamedSharding(self.mesh, sh.spec,
-                                             memory_kind=kind), o_sh,
-                    is_leaf=lambda x: isinstance(x, NamedSharding))
-                self._offload_sh = (o_sh, host)
-                self.state.opt_state = jax.device_put(
-                    self.state.opt_state, host)
+            # The freshly-initialised state stays on device — parking it
+            # now would just round-trip it back in the first step.
+            _, _, o_sh = self._step_fn._state_shardings
+            self._offload_sh = host_offload_shardings(self.mesh, o_sh)
 
     @staticmethod
     def _arrs(ts):

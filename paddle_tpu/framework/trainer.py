@@ -28,12 +28,16 @@ class HogwildWorker:
         self.train_func = train_func
         self.fetch_info = fetch_info
         self.metrics = []
+        self.error: BaseException | None = None
 
     def run(self, batches):
-        for batch in batches:
-            out = self.train_func(batch)
-            if out is not None:
-                self.metrics.append(out)
+        try:
+            for batch in batches:
+                out = self.train_func(batch)
+                if out is not None:
+                    self.metrics.append(out)
+        except BaseException as e:  # noqa: BLE001 — re-raised after join
+            self.error = e
 
 
 class MultiTrainer:
@@ -71,6 +75,11 @@ class MultiTrainer:
                 t.start()
             for t in threads:
                 t.join()
+        for w in self.workers:
+            if w.error is not None:
+                # dataset/step failures must surface, not truncate the
+                # epoch silently (single-thread mode raises in-line)
+                raise w.error
         out = []
         for w in self.workers:
             out.extend(w.metrics)

@@ -49,7 +49,7 @@ def _round_up(a: int, b: int) -> int:
     return _cdiv(a, b) * b
 
 
-def _use_pallas() -> bool:
+def _use_pallas(seq_q=None) -> bool:
     force = os.environ.get("PADDLE_TPU_FLASH_FORCE", "")
     if force == "pallas":
         return True
@@ -59,7 +59,18 @@ def _use_pallas() -> bool:
 
     if not flag("FLAGS_use_pallas"):
         return False
+    if seq_q is not None and seq_q < _pallas_min_seq():
+        # at short sequence the s x s matrices are small: XLA's fused
+        # attention (bf16 matmuls + fused softmax) beats the blocked
+        # kernel, whose two-pass recompute backward only pays off once
+        # materialising s x s activations stops fitting — measured on
+        # v5e: ERNIE seq=512 full step 186ms (pallas) vs 133ms (XLA)
+        return False
     return _HAS_PLTPU and jax.default_backend() == "tpu"
+
+
+def _pallas_min_seq() -> int:
+    return int(os.environ.get("PADDLE_TPU_FLASH_MIN_SEQ", "1024"))
 
 
 def _interpret() -> bool:
@@ -389,56 +400,76 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, seed, scale, causal,
 # ---------------------------------------------------------------------------
 
 
-def _jnp_drop_mask(seed, shape, dropout_p):
+def _jnp_keep_mask(seed, shape, dropout_p):
+    """bool keep mask (u16 threshold compare, see _common.keep_mask_u16):
+    random-bit traffic dominates attention-dropout cost on this path —
+    one s x s bits array per layer per pass."""
+    from ._common import keep_mask_u16
+
     key = jax.random.PRNGKey(seed.astype(jnp.uint32))
-    keep = jax.random.bernoulli(key, 1.0 - dropout_p, shape)
-    return jnp.where(keep, 1.0 / (1.0 - dropout_p), 0.0)
+    return keep_mask_u16(key, shape, dropout_p)
+
+
+def _causal_mask_f32(s, sq, sk):
+    q_idx = lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
+    k_idx = lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+    return jnp.where(q_idx + (sk - sq) >= k_idx, s, _NEG_INF)
 
 
 def _flash_fwd_jnp(q, k, v, seed, scale, causal, dropout_p):
-    qf = q.astype(jnp.float32)
-    kf = k.astype(jnp.float32)
-    s = jnp.einsum("bqd,bkd->bqk", qf, kf) * scale
+    # bf16 matmuls with f32 accumulation (MXU native — f32 inputs would
+    # halve matmul throughput); softmax math stays f32
+    s = jnp.einsum("bqd,bkd->bqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
     if causal:
-        sq, sk = s.shape[-2], s.shape[-1]
-        q_idx = lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
-        k_idx = lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
-        s = jnp.where(q_idx + (sk - sq) >= k_idx, s, _NEG_INF)
+        s = _causal_mask_f32(s, s.shape[-2], s.shape[-1])
     m = jnp.max(s, axis=-1)
     p = jnp.exp(s - m[..., None])
     l = jnp.sum(p, axis=-1)
-    pv = p
+    inv = 1.0 / l
     if dropout_p > 0.0:
-        pv = p * _jnp_drop_mask(seed, p.shape, dropout_p)
-    o = jnp.einsum("bqk,bkd->bqd", pv / l[..., None],
-                   v.astype(jnp.float32))
+        inv = inv / (1.0 - dropout_p)
+    probs = (p * inv[..., None]).astype(q.dtype)
+    if dropout_p > 0.0:
+        # mask applied on the bf16 probs (half the s x s traffic of an
+        # f32 where) — numerically identical to masking p first
+        keep = _jnp_keep_mask(seed, probs.shape, dropout_p)
+        probs = jnp.where(keep, probs, jnp.zeros((), probs.dtype))
+    o = jnp.einsum("bqk,bkd->bqd", probs, v,
+                   preferred_element_type=jnp.float32)
     return o.astype(q.dtype), m + jnp.log(l)
 
 
 def _flash_bwd_jnp(q, k, v, o, lse, do, seed, scale, causal, dropout_p):
-    qf, kf, vf = (t.astype(jnp.float32) for t in (q, k, v))
-    dof = do.astype(jnp.float32)
-    s = jnp.einsum("bqd,bkd->bqk", qf, kf) * scale
+    s = jnp.einsum("bqd,bkd->bqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
     if causal:
-        sq, sk = s.shape[-2], s.shape[-1]
-        q_idx = lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
-        k_idx = lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
-        s = jnp.where(q_idx + (sk - sq) >= k_idx, s, _NEG_INF)
-    p = jnp.exp(s - lse[..., None])
-    delta = jnp.sum(dof * o.astype(jnp.float32), axis=-1)
+        s = _causal_mask_f32(s, s.shape[-2], s.shape[-1])
+    p = jnp.exp(s - lse[..., None])  # normalised probs, f32
+    delta = jnp.einsum("bqd,bqd->bq", do, o,
+                       preferred_element_type=jnp.float32)
     if dropout_p > 0.0:
-        dmask = _jnp_drop_mask(seed, p.shape, dropout_p)
-        pd = p * dmask
+        keep = _jnp_keep_mask(seed, p.shape, dropout_p)
+        inv_keep = 1.0 / (1.0 - dropout_p)
+        # masks on the bf16 operands feeding the matmuls (half the
+        # traffic of f32 wheres); ds keeps its one f32 where fused into
+        # the (dp - delta) elementwise chain
+        pd16 = jnp.where(keep, (p * inv_keep).astype(q.dtype),
+                         jnp.zeros((), q.dtype))
     else:
-        dmask = None
-        pd = p
-    dv = jnp.einsum("bqk,bqd->bkd", pd, dof)
-    dp = jnp.einsum("bqd,bkd->bqk", dof, vf)
-    if dmask is not None:
-        dp = dp * dmask
-    ds = p * (dp - delta[..., None])
-    dq = jnp.einsum("bqk,bkd->bqd", ds, kf) * scale
-    dk = jnp.einsum("bqk,bqd->bkd", ds, qf) * scale
+        keep = None
+        pd16 = p.astype(q.dtype)
+    dv = jnp.einsum("bqk,bqd->bkd", pd16, do,
+                    preferred_element_type=jnp.float32)
+    dp = jnp.einsum("bqd,bkd->bqk", do, v,
+                    preferred_element_type=jnp.float32)
+    if keep is not None:
+        dp = jnp.where(keep, dp * inv_keep, 0.0)
+    ds = (p * (dp - delta[..., None])).astype(q.dtype)
+    dq = jnp.einsum("bqk,bkd->bqd", ds, k,
+                    preferred_element_type=jnp.float32) * scale
+    dk = jnp.einsum("bqk,bqd->bkd", ds, q,
+                    preferred_element_type=jnp.float32) * scale
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
@@ -459,7 +490,7 @@ def _flash_fwd(q, k, v, seed, causal, scale, dropout_p):
     q3 = q.reshape(b * h, sq, d)
     k3 = k.reshape(b * h, sk, d)
     v3 = v.reshape(b * h, sk, d)
-    if _use_pallas():
+    if _use_pallas(sq):
         o3, lse3 = _flash_fwd_pallas(q3, k3, v3, seed, scale, causal,
                                      dropout_p)
     else:
@@ -480,7 +511,7 @@ def _flash_bwd_rule(causal, scale, dropout_p, res, g):
     args = (q.reshape(b * h, sq, d), k.reshape(b * h, sk, d),
             v.reshape(b * h, sk, d), o.reshape(b * h, sq, d),
             lse.reshape(b * h, sq), g.reshape(b * h, sq, d))
-    if _use_pallas():
+    if _use_pallas(sq):
         dq, dk, dv = _flash_bwd_pallas(*args, seed, scale, causal,
                                        dropout_p)
     else:

@@ -527,11 +527,12 @@ def dropout(x, key, *, p=0.5, training=True, mode="upscale_in_train"):
             return x * (1.0 - p)
         return x
     keep = 1.0 - p
-    mask = jax.random.bernoulli(jnp.asarray(key), keep, x.shape)
-    mask = mask.astype(x.dtype)
-    if mode == "upscale_in_train":
-        return x * mask / keep
-    return x * mask
+    from ._common import keep_mask_u16
+
+    mask = keep_mask_u16(jnp.asarray(key), x.shape, p)
+    scale = jnp.asarray(1.0 / keep if mode == "upscale_in_train" else 1.0,
+                        x.dtype)
+    return jnp.where(mask, x * scale, jnp.zeros((), x.dtype))
 
 
 # -- attention (jnp fallback; pallas flash attention overrides on TPU) ------

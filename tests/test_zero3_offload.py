@@ -75,7 +75,7 @@ def test_zero3_matches_unsharded(mesh8):
     for i in range(3):
         lr = float(np.asarray(ref.train_batch(x, y)))
         lz = float(np.asarray(z3.train_batch(x, y)))
-        np.testing.assert_allclose(lr, lz, rtol=2e-4), i
+        np.testing.assert_allclose(lr, lz, rtol=2e-4, err_msg=f'step {i}')
 
     # stage 3: the PARAMS themselves are sharded at rest
     w = z3.state.params["fc1.weight"]
