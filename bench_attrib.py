@@ -1,8 +1,9 @@
 """Perf attribution for the ERNIE train step (not the driver bench).
 
 Times variants with the same differenced scan-N method as bench.py to
-locate where step time goes: full step, dropout off, jnp-SDPA fallback
-vs pallas flash, forward-only, head/loss cost.
+locate where step time goes: full step (default dispatch, which at
+seq=512 selects the XLA fallback), dropout off, and the pallas flash
+kernel forced on (for kernel-tuning comparisons against the default).
 """
 
 import json
@@ -36,9 +37,9 @@ def main():
     labels = ids.copy()
     labels[rng.rand(batch, seq) > 0.15] = -100
 
-    def build(dropout, force_jnp_attn=False):
-        if force_jnp_attn:
-            os.environ["PADDLE_TPU_FLASH_FORCE"] = "jnp"
+    def build(dropout, force_attn=None):
+        if force_attn:
+            os.environ["PADDLE_TPU_FLASH_FORCE"] = force_attn
         else:
             os.environ.pop("PADDLE_TPU_FLASH_FORCE", None)
         paddle.seed(0)
@@ -61,7 +62,7 @@ def main():
             eng.train_batch(ids, labels)  # build + warm
         return eng
 
-    def timed_step(eng, fwd_only=False):
+    def timed_step(eng):
         raw = eng._step_fn._raw_step_fn
         xj, yj = jnp.asarray(ids), jnp.asarray(labels)
         lr = jnp.asarray(1e-4, jnp.float32)
@@ -77,9 +78,6 @@ def main():
                         loss, p2, b2, o2 = raw(
                             p, b, o, {"inputs": (xj,), "labels": (yj,)},
                             lr, jax.random.fold_in(key, i))
-                    if fwd_only:
-                        # keep only the loss dependency; params unchanged
-                        return (p, b, o), loss
                     return (p2, b2, o2), loss
                 (p, b, o), losses = lax.scan(
                     body, (params, buffers, opt_state), jnp.arange(n))
@@ -103,10 +101,10 @@ def main():
         eng = build(dropout=0.1)
     elif variant == "nodrop":
         eng = build(dropout=0.0)
-    elif variant == "jnp_attn":
-        eng = build(dropout=0.1, force_jnp_attn=True)
-    elif variant == "jnp_nodrop":
-        eng = build(dropout=0.0, force_jnp_attn=True)
+    elif variant == "pallas_attn":
+        eng = build(dropout=0.1, force_attn="pallas")
+    elif variant == "pallas_nodrop":
+        eng = build(dropout=0.0, force_attn="pallas")
     else:
         raise SystemExit(f"unknown variant {variant}")
     ms = timed_step(eng)

@@ -453,6 +453,38 @@ def l1_loss(input, label, reduction="mean", name=None):
     return apply("l1_loss", input, label, reduction=reduction)
 
 
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """ref python/paddle/nn/functional/loss.py ctc_loss (warpctc_op.cc);
+    log_probs may be [T, B, C] (paddle layout) — transposed internally to
+    the batch-major kernel layout."""
+    lp = log_probs
+    if lp.ndim == 3:
+        lp = lp.transpose([1, 0, 2])  # [B, T, C]
+    loss = apply("warpctc", lp, labels, input_lengths, label_lengths,
+                 blank=blank, norm_by_times=norm_by_times)
+    if reduction == "mean":
+        return (loss / label_lengths.astype(loss.dtype)).mean()
+    if reduction == "sum":
+        return loss.sum()
+    return loss
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    return apply("grid_sampler", x, grid, mode=mode,
+                 padding_mode=padding_mode, align_corners=align_corners)
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    return apply("affine_grid", theta, out_shape=tuple(out_shape),
+                 align_corners=align_corners)
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    return apply("npair_loss", anchor, positive, labels, l2_reg=l2_reg)
+
+
 def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
     return apply("smooth_l1_loss", input, label, delta=delta,
                  reduction=reduction)

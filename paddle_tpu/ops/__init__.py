@@ -17,3 +17,4 @@ from . import fused_ops  # noqa: F401
 from . import rnn_ops  # noqa: F401
 from . import sequence_ops  # noqa: F401
 from . import detection_ops  # noqa: F401
+from . import extra_ops  # noqa: F401

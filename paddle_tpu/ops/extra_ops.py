@@ -1,0 +1,580 @@
+"""Long-tail op families: CTC/CRF sequence losses, spatial warps,
+small losses/metrics, normalization variants, segment/pool extras.
+
+Ref parity (paddle/fluid/operators/): warpctc_op.cc (here a native
+lax.scan forward-backward — no warp-ctc library), linear_chain_crf_op.cc,
+grid_sampler_op.cc, affine_grid_op.cc, affine_channel_op.cc,
+huber_loss_op.cc, log_loss_op.cc, bpr_loss_op.cc, rank_loss_op.cc,
+margin_rank_loss_op.cc, sigmoid_focal_loss (detection/), cos_sim_op.cc,
+dist_op.cc, squared_l2_norm_op.cc, l1_norm_op.cc, lrn_op.cc,
+data_norm_op.cc, roi_pool_op.cc, multiplex_op.cc, shuffle_channel_op.cc,
+space_to_depth_op.cc, segment_pool_op.cc, gather_tree_op.cc,
+pool3d (pool_op.cc), pad3d_op.cc. All pure-jax and XLA-traceable with
+static shapes; CTC/CRF use lax.scan (compiled recurrences, no Python
+loops under jit).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.op_registry import register_op
+
+_NEG = -1e30
+
+
+# ---------------------------------------------------------------------------
+# sequence losses
+# ---------------------------------------------------------------------------
+
+
+@register_op("warpctc")
+def warpctc(logits, labels, logit_lengths, label_lengths, *, blank=0,
+            norm_by_times=False):
+    """CTC loss (ref warpctc_op.cc; native implementation, no warp-ctc
+    dependency): forward algorithm over the extended label sequence in
+    log space, one lax.scan over time.
+
+    logits: [B, T, C] (unnormalised); labels: [B, L] padded with any
+    value beyond label_lengths; returns per-sample loss [B]."""
+    logits = jnp.asarray(logits)
+    labels = jnp.asarray(labels, jnp.int32)
+    logit_lengths = jnp.asarray(logit_lengths, jnp.int32).reshape(-1)
+    label_lengths = jnp.asarray(label_lengths, jnp.int32).reshape(-1)
+    b, t, c = logits.shape
+    l = labels.shape[1]
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+
+    # extended sequence: blank a1 blank a2 ... aL blank  (length 2L+1)
+    ext = jnp.full((b, 2 * l + 1), blank, jnp.int32)
+    ext = ext.at[:, 1::2].set(labels)
+    ext_len = 2 * label_lengths + 1
+    pos = jnp.arange(2 * l + 1)[None, :]
+    valid = pos < ext_len[:, None]
+
+    # allowed skip transition s-2 -> s: ext[s] != blank and ext[s]!=ext[s-2]
+    ext_prev2 = jnp.pad(ext, ((0, 0), (2, 0)), constant_values=-1)[:, :-2]
+    can_skip = (ext != blank) & (ext != ext_prev2)
+
+    def emit(tt):
+        return jnp.take_along_axis(logp[:, tt], ext, axis=1)  # [B, 2L+1]
+
+    alpha0 = jnp.full((b, 2 * l + 1), _NEG, jnp.float32)
+    alpha0 = alpha0.at[:, 0].set(emit(0)[:, 0])
+    has1 = l > 0
+    if has1:
+        alpha0 = alpha0.at[:, 1].set(
+            jnp.where(ext_len > 1, emit(0)[:, 1], _NEG))
+
+    def body(alpha, tt):
+        prev1 = jnp.pad(alpha, ((0, 0), (1, 0)),
+                        constant_values=_NEG)[:, :-1]
+        prev2 = jnp.pad(alpha, ((0, 0), (2, 0)),
+                        constant_values=_NEG)[:, :-2]
+        acc = jnp.logaddexp(alpha, prev1)
+        acc = jnp.where(can_skip, jnp.logaddexp(acc, prev2), acc)
+        new = acc + emit(tt)
+        new = jnp.where(valid, new, _NEG)
+        # frozen past logit_lengths (loss reads the alpha at T_b - 1)
+        new = jnp.where((tt < logit_lengths)[:, None], new, alpha)
+        return new, None
+
+    alpha, _ = lax.scan(body, alpha0, jnp.arange(1, t))
+    last = ext_len - 1
+    a_last = jnp.take_along_axis(alpha, last[:, None], axis=1)[:, 0]
+    a_prev = jnp.take_along_axis(
+        alpha, jnp.maximum(last - 1, 0)[:, None], axis=1)[:, 0]
+    loss = -jnp.where(ext_len > 1, jnp.logaddexp(a_last, a_prev), a_last)
+    if norm_by_times:
+        loss = loss / jnp.maximum(logit_lengths, 1).astype(loss.dtype)
+    return loss
+
+
+@register_op("linear_chain_crf")
+def linear_chain_crf(emission, transition, label, lengths):
+    """Linear-chain CRF negative log-likelihood
+    (ref linear_chain_crf_op.cc). emission: [B, T, C]; transition:
+    [C+2, C] (row 0 = start scores, row 1 = stop scores, rows 2.. =
+    transition matrix as in the reference's layout); label: [B, T];
+    returns nll [B]."""
+    emission = jnp.asarray(emission, jnp.float32)
+    transition = jnp.asarray(transition, jnp.float32)
+    label = jnp.asarray(label, jnp.int32)
+    lengths = jnp.asarray(lengths, jnp.int32).reshape(-1)
+    b, t, c = emission.shape
+    start, stop, trans = transition[0], transition[1], transition[2:]
+
+    # partition function: forward algorithm
+    alpha0 = start[None, :] + emission[:, 0]
+
+    def body(alpha, tt):
+        # [B, C_prev, 1] + [C_prev, C] -> logsumexp over prev
+        scores = alpha[:, :, None] + trans[None, :, :]
+        new = jax.nn.logsumexp(scores, axis=1) + emission[:, tt]
+        new = jnp.where((tt < lengths)[:, None], new, alpha)
+        return new, None
+
+    alpha, _ = lax.scan(body, alpha0, jnp.arange(1, t))
+    logz = jax.nn.logsumexp(alpha + stop[None, :], axis=1)
+
+    # gold path score
+    pos = jnp.arange(t)[None, :]
+    msk = (pos < lengths[:, None]).astype(jnp.float32)
+    emit_scores = jnp.take_along_axis(
+        emission, label[:, :, None], axis=2)[:, :, 0] * msk
+    prev_l = label[:, :-1]
+    next_l = label[:, 1:]
+    trans_scores = trans[prev_l, next_l] * msk[:, 1:]
+    first = start[label[:, 0]]
+    last_idx = jnp.maximum(lengths - 1, 0)
+    last_label = jnp.take_along_axis(label, last_idx[:, None], axis=1)[:, 0]
+    gold = first + emit_scores.sum(1) + trans_scores.sum(1) \
+        + stop[last_label]
+    return logz - gold
+
+
+# ---------------------------------------------------------------------------
+# spatial warps
+# ---------------------------------------------------------------------------
+
+
+@register_op("affine_grid")
+def affine_grid(theta, *, out_shape, align_corners=True):
+    """ref affine_grid_op.cc: sampling grid [N, H, W, 2] from 2x3 theta."""
+    theta = jnp.asarray(theta, jnp.float32)
+    n, h, w = out_shape[0], out_shape[-2], out_shape[-1]
+
+    def axis_coords(size):
+        if align_corners:
+            return jnp.linspace(-1.0, 1.0, size)
+        step = 2.0 / size
+        return jnp.linspace(-1.0 + step / 2, 1.0 - step / 2, size)
+
+    ys = axis_coords(h)
+    xs = axis_coords(w)
+    gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+    base = jnp.stack([gx, gy, jnp.ones_like(gx)], axis=-1)  # [H, W, 3]
+    return jnp.einsum("hwk,nck->nhwc", base, theta)  # [N, H, W, 2]
+
+
+@register_op("grid_sampler")
+def grid_sampler(x, grid, *, mode="bilinear", padding_mode="zeros",
+                 align_corners=True):
+    """ref grid_sampler_op.cc: sample x [N,C,H,W] at grid [N,Ho,Wo,2]
+    (normalised [-1,1] xy coords). bilinear/nearest; zeros/border."""
+    x = jnp.asarray(x)
+    grid = jnp.asarray(grid, jnp.float32)
+    n, c, h, w = x.shape
+
+    def unnorm(g, size):
+        if align_corners:
+            return (g + 1.0) / 2.0 * (size - 1)
+        return ((g + 1.0) * size - 1.0) / 2.0
+
+    gx = unnorm(grid[..., 0], w)
+    gy = unnorm(grid[..., 1], h)
+
+    def reflect_idx(i, size):
+        # reflect without repeating the border (paddle 'reflection'):
+        # period 2*(size-1); -1 -> 1, size -> size-2
+        period = max(2 * (size - 1), 1)
+        i = jnp.abs(i)
+        i = i % period
+        return jnp.where(i >= size, period - i, i)
+
+    def sample_at(yi, xi):
+        inside = (yi >= 0) & (yi < h) & (xi >= 0) & (xi < w)
+        if padding_mode == "reflection":
+            yc = reflect_idx(yi, h)
+            xc = reflect_idx(xi, w)
+        else:  # zeros / border both clamp; zeros masks after
+            yc = jnp.clip(yi, 0, h - 1)
+            xc = jnp.clip(xi, 0, w - 1)
+        vals = jax.vmap(
+            lambda img, yy, xx: img[:, yy, xx])(x, yc, xc)  # [N,C,Ho,Wo]
+        if padding_mode == "zeros":
+            vals = vals * inside[:, None].astype(vals.dtype)
+        return vals
+
+    if mode == "nearest":
+        return sample_at(jnp.round(gy).astype(jnp.int32),
+                         jnp.round(gx).astype(jnp.int32))
+    x0 = jnp.floor(gx)
+    y0 = jnp.floor(gy)
+    wx = (gx - x0)[:, None]
+    wy = (gy - y0)[:, None]
+    x0i, y0i = x0.astype(jnp.int32), y0.astype(jnp.int32)
+    v00 = sample_at(y0i, x0i)
+    v01 = sample_at(y0i, x0i + 1)
+    v10 = sample_at(y0i + 1, x0i)
+    v11 = sample_at(y0i + 1, x0i + 1)
+    return (v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx
+            + v10 * wy * (1 - wx) + v11 * wy * wx)
+
+
+@register_op("affine_channel")
+def affine_channel(x, scale, bias, *, data_layout="NCHW"):
+    """ref affine_channel_op.cc: x * scale + bias per channel."""
+    if data_layout == "NCHW":
+        shape = (1, -1) + (1,) * (x.ndim - 2)
+    else:
+        shape = (1,) * (x.ndim - 1) + (-1,)
+    return x * scale.reshape(shape) + bias.reshape(shape)
+
+
+# ---------------------------------------------------------------------------
+# small losses / similarity
+# ---------------------------------------------------------------------------
+
+
+@register_op("huber_loss")
+def huber_loss(x, y, *, delta=1.0):
+    """ref huber_loss_op.cc (input, label) -> residual loss."""
+    r = jnp.abs(y - x)
+    return jnp.where(r <= delta, 0.5 * r * r,
+                     delta * (r - 0.5 * delta))
+
+
+@register_op("log_loss")
+def log_loss(predicted, labels, *, epsilon=1e-4):
+    """ref log_loss_op.cc: -l*log(p+eps) - (1-l)*log(1-p+eps)."""
+    p = jnp.asarray(predicted)
+    l = jnp.asarray(labels)
+    return -l * jnp.log(p + epsilon) - (1.0 - l) * jnp.log(
+        1.0 - p + epsilon)
+
+
+@register_op("bpr_loss")
+def bpr_loss(x, label):
+    """ref bpr_loss_op.cc (Bayesian personalised ranking over logits
+    [B, C] with positive-class label [B, 1])."""
+    x = jnp.asarray(x)
+    label = jnp.asarray(label, jnp.int32).reshape(-1)
+    b, c = x.shape
+    pos = jnp.take_along_axis(x, label[:, None], axis=1)  # [B, 1]
+    diff = pos - x  # [B, C]
+    lsm = jnp.log1p(jnp.exp(-diff))
+    not_pos = jnp.arange(c)[None, :] != label[:, None]
+    return (lsm * not_pos).sum(axis=1, keepdims=True) / jnp.maximum(
+        c - 1, 1)
+
+
+@register_op("rank_loss")
+def rank_loss(label, left, right):
+    """ref rank_loss_op.cc: RankNet pairwise loss."""
+    d = left - right
+    return jnp.log1p(jnp.exp(d)) - label * d
+
+
+@register_op("margin_rank_loss")
+def margin_rank_loss(label, left, right, *, margin=0.0):
+    """ref margin_rank_loss_op.cc: max(0, -label*(left-right)+margin)."""
+    return jnp.maximum(-label * (left - right) + margin, 0.0)
+
+
+@register_op("sigmoid_focal_loss")
+def sigmoid_focal_loss(x, label, *, normalizer=None, alpha=0.25,
+                       gamma=2.0):
+    """ref detection/sigmoid_focal_loss_op.cc (dense binary-label form:
+    label [..., 1] in {0,1} per anchor-class entry, matching
+    paddle.nn.functional.sigmoid_focal_loss)."""
+    x = jnp.asarray(x, jnp.float32)
+    label = jnp.asarray(label, jnp.float32)
+    p = jax.nn.sigmoid(x)
+    ce = jnp.maximum(x, 0) - x * label + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    p_t = p * label + (1.0 - p) * (1.0 - label)
+    a_t = alpha * label + (1.0 - alpha) * (1.0 - label)
+    loss = a_t * ((1.0 - p_t) ** gamma) * ce
+    if normalizer is not None:
+        loss = loss / normalizer
+    return loss
+
+
+@register_op("cos_sim")
+def cos_sim(x, y):
+    """ref cos_sim_op.cc: row-wise cosine similarity [B, 1]."""
+    x = jnp.asarray(x)
+    y = jnp.asarray(y)
+    xn = jnp.sqrt(jnp.sum(x * x, axis=-1, keepdims=True))
+    yn = jnp.sqrt(jnp.sum(y * y, axis=-1, keepdims=True))
+    return jnp.sum(x * y, axis=-1, keepdims=True) / jnp.maximum(
+        xn * yn, 1e-12)
+
+
+@register_op("dist")
+def dist(x, y, *, p=2.0):
+    """ref dist_op.cc: p-norm of (x - y), scalar."""
+    d = jnp.abs(jnp.asarray(x) - jnp.asarray(y))
+    if p == float("inf"):
+        return jnp.max(d)
+    if p == 0:
+        return jnp.sum((d != 0).astype(d.dtype))
+    return jnp.sum(d ** p) ** (1.0 / p)
+
+
+@register_op("squared_l2_norm")
+def squared_l2_norm(x):
+    return jnp.sum(jnp.square(jnp.asarray(x)))
+
+
+@register_op("l1_norm")
+def l1_norm(x):
+    return jnp.sum(jnp.abs(jnp.asarray(x)))
+
+
+@register_op("npair_loss")
+def npair_loss(anchor, positive, labels, *, l2_reg=0.002):
+    """ref python/paddle/fluid/layers/loss.py npair_loss."""
+    anchor = jnp.asarray(anchor)
+    positive = jnp.asarray(positive)
+    labels = jnp.asarray(labels).reshape(-1)
+    same = (labels[:, None] == labels[None, :]).astype(anchor.dtype)
+    tgt = same / jnp.sum(same, axis=1, keepdims=True)
+    logits = anchor @ positive.T
+    xent = -jnp.sum(tgt * jax.nn.log_softmax(logits, axis=1), axis=1)
+    reg = jnp.mean(jnp.sum(anchor * anchor, 1)
+                   + jnp.sum(positive * positive, 1)) * l2_reg * 0.25
+    return jnp.mean(xent) + reg
+
+
+# ---------------------------------------------------------------------------
+# normalization variants
+# ---------------------------------------------------------------------------
+
+
+@register_op("lrn")
+def lrn(x, *, n=5, k=1.0, alpha=1e-4, beta=0.75, data_format="NCHW"):
+    """ref lrn_op.cc: local response normalisation across channels."""
+    x = jnp.asarray(x)
+    if data_format != "NCHW":
+        x = jnp.moveaxis(x, -1, 1)
+    sq = jnp.square(x)
+    half = n // 2
+    pad = jnp.pad(sq, ((0, 0), (half, n - 1 - half), (0, 0), (0, 0)))
+    # sliding-window channel sum via reduce_window
+    win = lax.reduce_window(pad, 0.0, lax.add, (1, n, 1, 1), (1, 1, 1, 1),
+                            "VALID")
+    out = x / jnp.power(k + alpha * win, beta)
+    if data_format != "NCHW":
+        out = jnp.moveaxis(out, 1, -1)
+    return out
+
+
+@register_op("data_norm", has_aux=True)
+def data_norm(x, batch_size, batch_sum, batch_square_sum, *,
+              epsilon=1e-4):
+    """ref data_norm_op.cc (CTR models): normalise with accumulated
+    batch statistics; aux returns the updated accumulators."""
+    x = jnp.asarray(x, jnp.float32)
+    mean = batch_sum / batch_size
+    scale = jnp.sqrt(batch_size / jnp.maximum(
+        batch_square_sum - batch_size * mean * mean + epsilon, epsilon))
+    out = (x - mean[None, :]) * scale[None, :]
+    b = x.shape[0]
+    new_size = batch_size + b
+    new_sum = batch_sum + x.sum(0)
+    new_sq = batch_square_sum + (x * x).sum(0)
+    return out, (new_size, new_sum, new_sq)
+
+
+@register_op("spectral_norm")
+def spectral_norm(weight, u, v, *, dim=0, power_iters=1, eps=1e-12):
+    """ref spectral_norm_op.cc: weight / sigma with power iteration."""
+    w = jnp.asarray(weight, jnp.float32)
+    w2 = jnp.moveaxis(w, dim, 0).reshape(w.shape[dim], -1)
+    uu, vv = jnp.asarray(u, jnp.float32), jnp.asarray(v, jnp.float32)
+    for _ in range(max(power_iters, 1)):
+        vv = w2.T @ uu
+        vv = vv / jnp.maximum(jnp.linalg.norm(vv), eps)
+        uu = w2 @ vv
+        uu = uu / jnp.maximum(jnp.linalg.norm(uu), eps)
+    sigma = uu @ w2 @ vv
+    return (w / sigma).astype(weight.dtype)
+
+
+# ---------------------------------------------------------------------------
+# pooling / layout extras
+# ---------------------------------------------------------------------------
+
+
+@register_op("pool3d")
+def pool3d(x, *, ksize, stride=None, padding=0, pooling_type="max",
+           ceil_mode=False, exclusive=True, global_pooling=False,
+           data_format="NCDHW"):
+    """ref pool_op.cc 3-D variant (NCDHW/NDHWC, ceil_mode extends hi
+    padding so partial windows are produced, paddle semantics)."""
+    x = jnp.asarray(x)
+    channel_last = data_format == "NDHWC"
+    if channel_last:
+        x = jnp.moveaxis(x, -1, 1)
+    if global_pooling:
+        axes = (2, 3, 4)
+        out = (jnp.max(x, axes, keepdims=True) if pooling_type == "max"
+               else jnp.mean(x, axes, keepdims=True))
+        return jnp.moveaxis(out, 1, -1) if channel_last else out
+    ks = tuple(ksize) if isinstance(ksize, (list, tuple)) else (ksize,) * 3
+    st = tuple(stride) if isinstance(stride, (list, tuple)) else \
+        ((stride,) * 3 if stride is not None else ks)
+    pd = tuple(padding) if isinstance(padding, (list, tuple)) else \
+        (padding,) * 3
+    pairs = [(p, p) for p in pd]
+    if ceil_mode:
+        for i, (dim, k, s) in enumerate(zip(x.shape[2:], ks, st)):
+            lo, hi = pairs[i]
+            rem = (dim + lo + hi - k) % s
+            if rem:
+                pairs[i] = (lo, hi + (s - rem))
+    pads = [(0, 0), (0, 0)] + pairs
+    window = (1, 1) + ks
+    strides = (1, 1) + st
+    if pooling_type == "max":
+        out = lax.reduce_window(x, -jnp.inf, lax.max, window, strides,
+                                pads)
+    else:
+        summed = lax.reduce_window(x, 0.0, lax.add, window, strides, pads)
+        if exclusive and (ceil_mode or any(p for p in pd)):
+            counts = lax.reduce_window(jnp.ones_like(x), 0.0, lax.add,
+                                       window, strides, pads)
+            out = summed / counts
+        else:
+            import numpy as _np
+
+            out = summed / _np.prod(ks)
+    return jnp.moveaxis(out, 1, -1) if channel_last else out
+
+
+@register_op("pad3d")
+def pad3d(x, *, paddings, mode="constant", value=0.0,
+          data_format="NCDHW"):
+    """ref pad3d_op.cc: paddings [front, back, top, bottom, left, right]
+    over (D, H, W) in paddle order (W pairs first in the attr list)."""
+    pl_, pr, pt, pb, pf, pk = [int(p) for p in paddings]
+    if data_format == "NCDHW":
+        cfg = [(0, 0), (0, 0), (pf, pk), (pt, pb), (pl_, pr)]
+    else:
+        cfg = [(0, 0), (pf, pk), (pt, pb), (pl_, pr), (0, 0)]
+    if mode == "constant":
+        return jnp.pad(x, cfg, constant_values=value)
+    jmode = {"reflect": "reflect", "replicate": "edge",
+             "circular": "wrap"}[mode]
+    return jnp.pad(x, cfg, mode=jmode)
+
+
+@register_op("roi_pool", no_grad=True)
+def roi_pool(x, boxes, boxes_num, *, output_size, spatial_scale=1.0):
+    """ref roi_pool_op.cc: max pooling inside each RoI bin (quantised
+    boundaries, unlike roi_align's bilinear sampling)."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    x = jnp.asarray(x)
+    n, c, h, w = x.shape
+    boxes = jnp.asarray(boxes, jnp.float32)
+    bn = jnp.asarray(boxes_num, jnp.int32)
+    r = boxes.shape[0]
+    img_of_roi = jnp.searchsorted(jnp.cumsum(bn), jnp.arange(r),
+                                  side="right").astype(jnp.int32)
+    x1 = jnp.round(boxes[:, 0] * spatial_scale).astype(jnp.int32)
+    y1 = jnp.round(boxes[:, 1] * spatial_scale).astype(jnp.int32)
+    x2 = jnp.round(boxes[:, 2] * spatial_scale).astype(jnp.int32)
+    y2 = jnp.round(boxes[:, 3] * spatial_scale).astype(jnp.int32)
+    rw = jnp.maximum(x2 - x1 + 1, 1)
+    rh = jnp.maximum(y2 - y1 + 1, 1)
+
+    ys = jnp.arange(h)
+    xs = jnp.arange(w)
+
+    def per_roi(i):
+        img = x[img_of_roi[i]]
+
+        def per_bin(py, px):
+            hs = y1[i] + (py * rh[i]) // ph
+            he = y1[i] + ((py + 1) * rh[i] + ph - 1) // ph
+            ws_ = x1[i] + (px * rw[i]) // pw
+            we = x1[i] + ((px + 1) * rw[i] + pw - 1) // pw
+            m = ((ys[:, None] >= hs) & (ys[:, None] < he)
+                 & (xs[None, :] >= ws_) & (xs[None, :] < we))
+            sel = jnp.where(m[None], img, -jnp.inf)
+            v = jnp.max(sel, axis=(1, 2))
+            return jnp.where(jnp.isfinite(v), v, 0.0)
+
+        grid = jax.vmap(lambda py: jax.vmap(
+            lambda px: per_bin(py, px))(jnp.arange(pw)))(jnp.arange(ph))
+        return jnp.moveaxis(grid, -1, 0)  # [C, ph, pw]
+
+    return jax.vmap(per_roi)(jnp.arange(r))
+
+
+@register_op("space_to_depth")
+def space_to_depth(x, *, blocksize):
+    """ref space_to_depth_op.cc: [N,C,H,W] -> [N,C*b*b,H/b,W/b]."""
+    n, c, h, w = x.shape
+    b = blocksize
+    x = x.reshape(n, c, h // b, b, w // b, b)
+    x = jnp.transpose(x, (0, 3, 5, 1, 2, 4))
+    return x.reshape(n, c * b * b, h // b, w // b)
+
+
+@register_op("shuffle_channel")
+def shuffle_channel(x, *, group):
+    """ref shuffle_channel_op.cc (ShuffleNet)."""
+    n, c, h, w = x.shape
+    x = x.reshape(n, group, c // group, h, w)
+    return jnp.swapaxes(x, 1, 2).reshape(n, c, h, w)
+
+
+@register_op("multiplex", no_grad=False)
+def multiplex(index, *inputs):
+    """ref multiplex_op.cc: out[i] = inputs[index[i]][i]."""
+    index = jnp.asarray(index, jnp.int32).reshape(-1)
+    stacked = jnp.stack(inputs)  # [K, B, ...]
+    return jnp.take_along_axis(
+        stacked, index[None, :].reshape(
+            (1, -1) + (1,) * (stacked.ndim - 2)), axis=0)[0]
+
+
+@register_op("segment_pool")
+def segment_pool(x, segment_ids, *, pool_type="sum", num_segments=None):
+    """ref segment_pool_op.cc: pool rows by segment id (sorted ids;
+    num_segments static under jit — defaults to x.shape[0])."""
+    x = jnp.asarray(x)
+    ids = jnp.asarray(segment_ids, jnp.int32).reshape(-1)
+    ns = int(num_segments) if num_segments is not None else x.shape[0]
+    pool = pool_type.lower()
+    if pool == "sum":
+        return jax.ops.segment_sum(x, ids, num_segments=ns)
+    if pool == "mean":
+        s = jax.ops.segment_sum(x, ids, num_segments=ns)
+        cnt = jax.ops.segment_sum(jnp.ones((x.shape[0],), x.dtype), ids,
+                                  num_segments=ns)
+        return s / jnp.maximum(cnt, 1.0)[:, None] if x.ndim > 1 else \
+            s / jnp.maximum(cnt, 1.0)
+    if pool == "max":
+        return jax.ops.segment_max(x, ids, num_segments=ns)
+    if pool == "min":
+        return jax.ops.segment_min(x, ids, num_segments=ns)
+    raise ValueError(f"unknown pool_type {pool_type!r}")
+
+
+@register_op("gather_tree", no_grad=True)
+def gather_tree(ids, parents):
+    """ref gather_tree_op.cc (beam search backtrace): ids/parents
+    [T, B, W] -> full beams re-threaded through parent pointers."""
+    ids = jnp.asarray(ids, jnp.int32)
+    parents = jnp.asarray(parents, jnp.int32)
+    t = ids.shape[0]
+
+    def body(carry, tt):
+        beam = carry  # [B, W] current beam index per slot
+        step = t - 1 - tt
+        out = jnp.take_along_axis(ids[step], beam, axis=1)
+        beam = jnp.take_along_axis(parents[step], beam, axis=1)
+        return beam, out
+
+    w = ids.shape[2]
+    init = jnp.broadcast_to(jnp.arange(w, dtype=jnp.int32)[None, :],
+                            ids.shape[1:])
+    _, outs = lax.scan(body, init, jnp.arange(t))
+    return jnp.flip(outs, axis=0)

@@ -1446,5 +1446,322 @@ case("multiclass_nms3",
      prop=_mc_nms_prop, grad=None, bf16=False)
 
 # ===========================================================================
+# extra ops (ops/extra_ops.py): CTC/CRF, warps, small losses, norm/pool
+# ===========================================================================
+
+
+def _np_logsumexp(a, axis=None):
+    m = np.max(a, axis=axis, keepdims=True)
+    return (m + np.log(np.sum(np.exp(a - m), axis=axis,
+                              keepdims=True))).squeeze(axis)
+
+
+def _np_ctc_brute(logits, labels, t_len, l_len, blank=0):
+    """Enumerate all alignments (tiny cases only)."""
+    import itertools
+
+    logp = logits - _np_logsumexp(logits, axis=-1)[..., None]
+    out = []
+    for b in range(logits.shape[0]):
+        T, L = int(t_len[b]), int(l_len[b])
+        tgt = list(labels[b][:L])
+        total = -np.inf
+        for path in itertools.product(range(logits.shape[2]), repeat=T):
+            # collapse repeats then remove blanks
+            col = []
+            prev = None
+            for s in path:
+                if s != prev:
+                    col.append(s)
+                prev = s
+            col = [s for s in col if s != blank]
+            if col == tgt:
+                score = sum(logp[b, tt, s] for tt, s in enumerate(path))
+                total = np.logaddexp(total, score)
+        out.append(-total)
+    return np.asarray(out, np.float32)
+
+
+_CTC_LOGITS = f32((2, 4, 3), seed=11)
+_CTC_LABELS = np.array([[1, 2], [2, 2]], np.int64)
+_CTC_TLEN = np.array([4, 4], np.int32)
+_CTC_LLEN = np.array([2, 1], np.int32)
+
+case("warpctc", [_CTC_LOGITS, _CTC_LABELS, _CTC_TLEN, _CTC_LLEN],
+     {"blank": 0},
+     ref=lambda lo, la, tl, ll, blank=0: _np_ctc_brute(lo, la, tl, ll,
+                                                       blank),
+     grad=(0,), bf16=False, rtol=1e-4, atol=1e-4)
+
+
+def _np_crf_brute(emission, transition, label, lengths):
+    import itertools
+
+    start, stop, trans = transition[0], transition[1], transition[2:]
+    b, t, c = emission.shape
+    out = []
+    for i in range(b):
+        T = int(lengths[i])
+        logz = -np.inf
+        for path in itertools.product(range(c), repeat=T):
+            s = start[path[0]] + emission[i, 0, path[0]]
+            for tt in range(1, T):
+                s += trans[path[tt - 1], path[tt]] + emission[i, tt,
+                                                              path[tt]]
+            s += stop[path[-1]]
+            logz = np.logaddexp(logz, s)
+        gold = start[label[i, 0]] + emission[i, 0, label[i, 0]]
+        for tt in range(1, T):
+            gold += trans[label[i, tt - 1], label[i, tt]] \
+                + emission[i, tt, label[i, tt]]
+        gold += stop[label[i, T - 1]]
+        out.append(logz - gold)
+    return np.asarray(out, np.float32)
+
+
+_CRF_EM = f32((2, 3, 3), seed=12)
+_CRF_TR = f32((5, 3), seed=13)
+_CRF_LB = ints((2, 3), 0, 3, seed=14, dtype=np.int64)
+_CRF_LEN = np.array([3, 2], np.int32)
+
+case("linear_chain_crf", [_CRF_EM, _CRF_TR, _CRF_LB, _CRF_LEN], {},
+     ref=_np_crf_brute, grad=(0, 1), bf16=False, rtol=1e-4, atol=1e-4)
+
+
+def _ag_prop(outs, inputs, attrs):
+    g = np.asarray(outs[0])
+    assert g.shape == (1, 4, 5, 2)
+    # identity theta -> corners at (-1,-1) and (1,1) with align_corners
+    np.testing.assert_allclose(g[0, 0, 0], [-1, -1], atol=1e-6)
+    np.testing.assert_allclose(g[0, -1, -1], [1, 1], atol=1e-6)
+
+
+case("affine_grid", [np.array([[[1, 0, 0], [0, 1, 0]]], np.float32)],
+     {"out_shape": (1, 1, 4, 5)}, prop=_ag_prop, grad=(0,), bf16=False)
+
+
+def _gs_prop(outs, inputs, attrs):
+    out = np.asarray(outs[0])
+    # identity grid reproduces the input
+    np.testing.assert_allclose(out, inputs[0], rtol=1e-5, atol=1e-5)
+
+
+def _identity_grid(h, w):
+    ys = np.linspace(-1, 1, h)
+    xs = np.linspace(-1, 1, w)
+    gy, gx = np.meshgrid(ys, xs, indexing="ij")
+    return np.stack([gx, gy], -1)[None].astype(np.float32)
+
+
+case("grid_sampler", [f32((1, 2, 4, 4), seed=15), _identity_grid(4, 4)],
+     {"align_corners": True}, prop=_gs_prop, grad=(0,), bf16=False)
+
+case("affine_channel",
+     [f32((2, 3, 2, 2), seed=16), f32((3,), seed=17), f32((3,), seed=18)],
+     {},
+     ref=lambda x, s, b: x * s.reshape(1, 3, 1, 1) + b.reshape(1, 3, 1, 1),
+     grad=(0, 1, 2), bf16=True)
+
+case("huber_loss", [f32((4, 3), seed=19), f32((4, 3), seed=20)],
+     {"delta": 0.5},
+     ref=lambda x, y, delta=0.5: np.where(
+         np.abs(y - x) <= delta, 0.5 * (y - x) ** 2,
+         delta * (np.abs(y - x) - 0.5 * delta)),
+     grad=(0,), bf16=True)
+
+case("log_loss", [pos((4, 1), 0.1, 0.9, seed=21),
+                  (pos((4, 1), 0.0, 1.0, seed=22) > 0.5).astype(np.float32)],
+     {},
+     ref=lambda p, l, epsilon=1e-4: -l * np.log(p + 1e-4)
+     - (1 - l) * np.log(1 - p + 1e-4),
+     grad=(0,), bf16=False)
+
+case("bpr_loss", [f32((3, 4), seed=23), np.array([[1], [0], [3]], np.int64)],
+     {},
+     ref=lambda x, l: np.stack([
+         [sum(np.log1p(np.exp(-(x[i, int(l[i, 0])] - x[i, j])))
+              for j in range(x.shape[1]) if j != int(l[i, 0])) / 3.0]
+         for i in range(x.shape[0])]).astype(np.float32),
+     grad=(0,), bf16=False)
+
+case("rank_loss", [(pos((4, 1), 0, 1, seed=24) > 0.5).astype(np.float32),
+                   f32((4, 1), seed=25), f32((4, 1), seed=26)],
+     {},
+     ref=lambda lab, l, r: np.log1p(np.exp(l - r)) - lab * (l - r),
+     grad=(1, 2), bf16=True)
+
+case("margin_rank_loss",
+     [np.ones((4, 1), np.float32), f32((4, 1), seed=27),
+      f32((4, 1), seed=28)],
+     {"margin": 0.1},
+     ref=lambda lab, l, r, margin=0.1: np.maximum(
+         -lab * (l - r) + margin, 0),
+     grad=(1, 2), bf16=True)
+
+case("sigmoid_focal_loss",
+     [f32((6, 1), seed=29),
+      (pos((6, 1), 0, 1, seed=30) > 0.5).astype(np.float32)],
+     {"alpha": 0.25, "gamma": 2.0},
+     ref=lambda x, l, alpha=0.25, gamma=2.0, normalizer=None: (
+         (alpha * l + (1 - alpha) * (1 - l))
+         * (1 - (np_sigmoid(x) * l + (1 - np_sigmoid(x)) * (1 - l)))
+         ** gamma
+         * (np.maximum(x, 0) - x * l + np.log1p(np.exp(-np.abs(x))))),
+     grad=(0,), bf16=False)
+
+case("cos_sim", [f32((4, 8), seed=31), f32((4, 8), seed=32)], {},
+     ref=lambda x, y: (np.sum(x * y, -1, keepdims=True)
+                       / np.maximum(np.linalg.norm(x, axis=-1,
+                                                   keepdims=True)
+                                    * np.linalg.norm(y, axis=-1,
+                                                     keepdims=True),
+                                    1e-12)),
+     grad=(0, 1), bf16=True)
+
+case("dist", [f32((3, 4), seed=33), f32((3, 4), seed=34)], {"p": 2.0},
+     ref=lambda x, y, p=2.0: np.asarray(
+         np.sum(np.abs(x - y) ** p) ** (1 / p), np.float32),
+     grad=(0,), bf16=True)
+
+case("squared_l2_norm", [f32((3, 4), seed=35)], {},
+     ref=lambda x: np.asarray(np.sum(x * x), np.float32), grad=(0,),
+     bf16=True)
+
+case("l1_norm", [f32((3, 4), seed=36)], {},
+     ref=lambda x: np.asarray(np.sum(np.abs(x)), np.float32), grad=(0,),
+     bf16=True)
+
+case("npair_loss",
+     [f32((4, 6), seed=37), f32((4, 6), seed=38),
+      np.array([0, 1, 0, 2], np.int64)],
+     {"l2_reg": 0.002},
+     prop=lambda outs, inputs, attrs: (
+         np.testing.assert_(np.isfinite(float(np.asarray(outs[0]))))),
+     grad=(0, 1), bf16=False)
+
+
+def _np_lrn(x, n=5, k=1.0, alpha=1e-4, beta=0.75, data_format="NCHW"):
+    out = np.zeros_like(x)
+    c = x.shape[1]
+    half = n // 2
+    for ci in range(c):
+        lo, hi = max(0, ci - half), min(c, ci - half + n)
+        s = (x[:, lo:hi] ** 2).sum(axis=1)
+        out[:, ci] = x[:, ci] / (k + alpha * s) ** beta
+    return out
+
+
+case("lrn", [f32((2, 6, 3, 3), seed=39)], {"n": 3},
+     ref=lambda x, n=3, k=1.0, alpha=1e-4, beta=0.75: _np_lrn(
+         x, n=n, k=k, alpha=alpha, beta=beta),
+     grad=(0,), bf16=False)
+
+
+def _dn_prop(outs, inputs, attrs):
+    out = np.asarray(outs[0])
+    x, size, ssum, sqsum = inputs
+    mean = ssum / size
+    scale = np.sqrt(size / np.maximum(sqsum - size * mean ** 2 + 1e-4,
+                                      1e-4))
+    np.testing.assert_allclose(out, (x - mean) * scale, rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(outs[2]), ssum + x.sum(0),
+                               rtol=1e-5)
+
+
+case("data_norm",
+     [f32((4, 3), seed=40), np.full(3, 8.0, np.float32),
+      f32((3,), seed=41), pos((3,), 5.0, 9.0, seed=42)],
+     {}, prop=_dn_prop, grad=None, bf16=False)
+
+
+def _sn_prop(outs, inputs, attrs):
+    wn = np.asarray(outs[0])
+    # spectral norm of the output is ~1
+    s = np.linalg.svd(wn.reshape(wn.shape[0], -1), compute_uv=False)
+    assert s[0] < 1.5
+
+
+case("spectral_norm",
+     [f32((4, 5), seed=43), f32((4,), seed=44), f32((5,), seed=45)],
+     {"power_iters": 20}, prop=_sn_prop, grad=None, bf16=False)
+
+
+def _np_pool3d_max(x, ksize, **kw):
+    n, c, d, h, w = x.shape
+    kd, kh, kw_ = (ksize,) * 3 if isinstance(ksize, int) else ksize
+    out = np.zeros((n, c, d // kd, h // kh, w // kw_), x.dtype)
+    for i in range(d // kd):
+        for j in range(h // kh):
+            for k in range(w // kw_):
+                out[:, :, i, j, k] = x[:, :, i * kd:(i + 1) * kd,
+                                       j * kh:(j + 1) * kh,
+                                       k * kw_:(k + 1) * kw_].max(
+                    axis=(2, 3, 4))
+    return out
+
+
+case("pool3d", [f32((1, 2, 4, 4, 4), seed=46)],
+     {"ksize": 2, "stride": 2, "pooling_type": "max"},
+     ref=lambda x, **kw: _np_pool3d_max(x, 2), grad=(0,), bf16=True)
+
+case("pad3d", [f32((1, 1, 2, 2, 2), seed=47)],
+     {"paddings": [1, 1, 0, 0, 0, 0], "mode": "constant", "value": 0.0},
+     ref=lambda x, **kw: np.pad(x, [(0, 0), (0, 0), (0, 0), (0, 0),
+                                    (1, 1)]),
+     grad=(0,), bf16=True)
+
+case("roi_pool",
+     [np.arange(64, dtype=np.float32).reshape(1, 1, 8, 8),
+      np.array([[0, 0, 3, 3]], np.float32), np.array([1], np.int32)],
+     {"output_size": 2},
+     ref=lambda x, b, n, **kw: np.array(
+         [[[[x[0, 0, :2, :2].max(), x[0, 0, :2, 2:4].max()],
+            [x[0, 0, 2:4, :2].max(), x[0, 0, 2:4, 2:4].max()]]]],
+         np.float32),
+     grad=None, bf16=False)
+
+case("space_to_depth", [f32((1, 2, 4, 4), seed=48)], {"blocksize": 2},
+     prop=lambda outs, inputs, attrs: (
+         np.testing.assert_(np.asarray(outs[0]).shape == (1, 8, 2, 2))),
+     grad=(0,), bf16=True)
+
+case("shuffle_channel", [f32((1, 6, 2, 2), seed=49)], {"group": 3},
+     ref=lambda x, group=3: x.reshape(1, 3, 2, 2, 2).swapaxes(
+         1, 2).reshape(1, 6, 2, 2),
+     grad=(0,), bf16=True)
+
+case("multiplex",
+     [np.array([1, 0], np.int32), f32((2, 3), seed=50),
+      f32((2, 3), seed=51)],
+     {},
+     ref=lambda idx, a, b: np.stack([b[0], a[1]]), grad=None, bf16=False,
+     mode="fn")
+
+case("segment_pool",
+     [f32((5, 3), seed=52), np.array([0, 0, 1, 1, 2], np.int32)],
+     {"pool_type": "sum", "num_segments": 3},
+     ref=lambda x, ids, **kw: np.stack(
+         [x[:2].sum(0), x[2:4].sum(0), x[4]]),
+     grad=(0,), bf16=True)
+
+
+def _np_gather_tree(ids, parents):
+    t, b, w = ids.shape
+    out = np.zeros_like(ids)
+    beam = np.tile(np.arange(w), (b, 1))
+    for step in range(t - 1, -1, -1):
+        out[step] = np.take_along_axis(ids[step], beam, axis=1)
+        beam = np.take_along_axis(parents[step], beam, axis=1)
+    return out
+
+
+_GT_IDS = ints((3, 1, 2), 0, 9, seed=53, dtype=np.int64)
+_GT_PAR = ints((3, 1, 2), 0, 2, seed=54, dtype=np.int64)
+
+case("gather_tree", [_GT_IDS, _GT_PAR], {},
+     ref=lambda i, p: _np_gather_tree(i, p), grad=None, bf16=False)
+
+
+# ===========================================================================
 # known-unimplemented ops (tracked; implementing removes from this set)
 # ===========================================================================
