@@ -8,6 +8,7 @@ reference.
 from .base.distributed_strategy import DistributedStrategy  # noqa: F401
 from .base.fleet_base import Fleet
 from . import meta_parallel  # noqa: F401
+from . import metrics  # noqa: F401
 from . import utils  # noqa: F401
 from .utils.recompute import recompute  # noqa: F401
 

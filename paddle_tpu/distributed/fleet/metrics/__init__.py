@@ -1,0 +1,101 @@
+"""Distributed metric reduction.
+
+Ref parity: python/paddle/distributed/fleet/metrics/metric.py — global
+sum/max/min/avg/auc/acc across trainers. Reductions ride whichever
+runtime is active: multi-process jax (process_allgather then local
+reduce), or PS mode (each trainer pushes its local stat into a
+fresh per-call dense table and pulls the merged value). Single-process,
+both collapse to the local value.
+
+PS-mode calls must happen in the same order on every trainer (each call
+allocates a sequenced scratch table) — the same contract as the
+reference's barrier-ordered metric ops.
+"""
+
+from __future__ import annotations
+
+import itertools
+
+import numpy as np
+
+__all__ = ["sum", "max", "min", "avg", "acc", "auc"]
+
+_ps_metric_seq = itertools.count()
+
+
+def _reduce(value, op="sum"):
+    value = np.asarray(value, np.float64)
+
+    # PS mode: merge through a per-call scratch dense table (a fresh name
+    # each call — a reused table would keep accumulating across calls)
+    from ...ps.runtime import _runtime
+
+    if _runtime is not None and _runtime._client is not None \
+            and op == "sum":
+        client = _runtime.client
+        name = f"@metric/{op}/{next(_ps_metric_seq)}"
+        client.create_dense_table(name, list(value.reshape(-1).shape),
+                                  optimizer="sum", lr=1.0)
+        client.push_dense_grad(name, value.reshape(-1))
+        _runtime.barrier()
+        return client.pull_dense(name).reshape(value.shape)
+
+    # multi-process jax: gather per-process stats, reduce locally
+    import jax
+
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        gathered = np.asarray(multihost_utils.process_allgather(
+            value.astype(np.float32)), np.float64)
+        if op == "sum":
+            return gathered.sum(axis=0)
+        if op == "max":
+            return gathered.max(axis=0)
+        if op == "min":
+            return gathered.min(axis=0)
+    return value
+
+
+def sum(input):  # noqa: A001 — reference API name
+    """ref metric.py sum: global sum of a local stat array/scalar."""
+    return _reduce(np.asarray(input), "sum")
+
+
+def max(input):  # noqa: A001
+    return _reduce(np.asarray(input), "max")
+
+
+def min(input):  # noqa: A001
+    return _reduce(np.asarray(input), "min")
+
+
+def avg(total, count):
+    """Global average from local (total, count)."""
+    t = sum(np.asarray(total, np.float64))
+    c = sum(np.asarray(count, np.float64))
+    return t / np.maximum(c, 1e-12)
+
+
+def acc(correct, total):
+    """ref metric.py acc: global accuracy from local counts."""
+    return avg(correct, total)
+
+
+def auc(stat_pos, stat_neg):
+    """ref metric.py auc: merge per-trainer positive/negative histogram
+    stats (the paddle.metric.Auc `_stat_pos/_stat_neg` buckets) and
+    compute the global AUC with the same trapezoid rule."""
+    pos = _reduce(np.asarray(stat_pos, np.float64), "sum")
+    neg = _reduce(np.asarray(stat_neg, np.float64), "sum")
+    # walk thresholds from high to low (bucket order reversed)
+    tot_pos = tot_neg = 0.0
+    area = 0.0
+    for i in range(len(pos) - 1, -1, -1):
+        new_pos = tot_pos + pos[i]
+        new_neg = tot_neg + neg[i]
+        area += (new_neg - tot_neg) * (tot_pos + new_pos) / 2.0
+        tot_pos, tot_neg = new_pos, new_neg
+    if tot_pos == 0.0 or tot_neg == 0.0:
+        return 0.0
+    return float(area / (tot_pos * tot_neg))

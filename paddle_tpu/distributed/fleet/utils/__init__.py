@@ -1,3 +1,5 @@
 """Fleet utils (ref: python/paddle/distributed/fleet/utils/)."""
 
 from .recompute import recompute  # noqa: F401
+from . import fs  # noqa: F401
+from .fs import HDFSClient, LocalFS  # noqa: F401

@@ -1,0 +1,230 @@
+"""Filesystem abstraction for checkpoint/data paths.
+
+Ref parity: python/paddle/distributed/fleet/utils/fs.py — FS base with
+LocalFS and HDFSClient. Checkpoints on TPU pods typically target GCS or
+NFS; the FS interface stays so training loops are storage-agnostic.
+HDFSClient shells out to `hadoop fs` exactly like the reference (and
+raises a clear error when the toolchain is absent).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+
+__all__ = ["FS", "LocalFS", "HDFSClient", "FSFileExistsError",
+           "FSFileNotExistsError"]
+
+
+class FSFileExistsError(Exception):
+    pass
+
+
+class FSFileNotExistsError(Exception):
+    pass
+
+
+class FS:
+    def ls_dir(self, fs_path):
+        raise NotImplementedError
+
+    def is_file(self, fs_path):
+        raise NotImplementedError
+
+    def is_dir(self, fs_path):
+        raise NotImplementedError
+
+    def is_exist(self, fs_path):
+        raise NotImplementedError
+
+    def upload(self, local_path, fs_path):
+        raise NotImplementedError
+
+    def download(self, fs_path, local_path):
+        raise NotImplementedError
+
+    def mkdirs(self, fs_path):
+        raise NotImplementedError
+
+    def delete(self, fs_path):
+        raise NotImplementedError
+
+    def rename(self, fs_src_path, fs_dst_path):
+        raise NotImplementedError
+
+    def need_upload_download(self):
+        raise NotImplementedError
+
+    def touch(self, fs_path, exist_ok=True):
+        raise NotImplementedError
+
+    def mv(self, fs_src_path, fs_dst_path, overwrite=False):
+        raise NotImplementedError
+
+
+class LocalFS(FS):
+    """ref fs.py LocalFS."""
+
+    def ls_dir(self, fs_path):
+        if not self.is_exist(fs_path):
+            return [], []
+        dirs, files = [], []
+        for name in os.listdir(fs_path):
+            if os.path.isdir(os.path.join(fs_path, name)):
+                dirs.append(name)
+            else:
+                files.append(name)
+        return dirs, files
+
+    def is_file(self, fs_path):
+        return os.path.isfile(fs_path)
+
+    def is_dir(self, fs_path):
+        return os.path.isdir(fs_path)
+
+    def is_exist(self, fs_path):
+        return os.path.exists(fs_path)
+
+    def mkdirs(self, fs_path):
+        os.makedirs(fs_path, exist_ok=True)
+
+    def delete(self, fs_path):
+        if self.is_file(fs_path):
+            os.remove(fs_path)
+        elif self.is_dir(fs_path):
+            shutil.rmtree(fs_path)
+
+    def rename(self, src, dst):
+        os.rename(src, dst)
+
+    def need_upload_download(self):
+        return False
+
+    def touch(self, fs_path, exist_ok=True):
+        if self.is_exist(fs_path):
+            if exist_ok:
+                return
+            raise FSFileExistsError(fs_path)
+        os.makedirs(os.path.dirname(fs_path) or ".", exist_ok=True)
+        with open(fs_path, "a"):
+            pass
+
+    def mv(self, src, dst, overwrite=False, test_exists=True):
+        if test_exists and not self.is_exist(src):
+            raise FSFileNotExistsError(src)
+        if self.is_exist(dst):
+            if not overwrite:
+                raise FSFileExistsError(dst)
+            self.delete(dst)
+        shutil.move(src, dst)
+
+    def upload(self, local_path, fs_path):
+        if local_path != fs_path:
+            shutil.copy(local_path, fs_path)
+
+    def download(self, fs_path, local_path):
+        if fs_path != local_path:
+            shutil.copy(fs_path, local_path)
+
+    def list_dirs(self, fs_path):
+        return self.ls_dir(fs_path)[0]
+
+
+class ExecuteError(Exception):
+    """A hadoop command failed (ref fs.py ExecuteError)."""
+
+
+class HDFSClient(FS):
+    """ref fs.py HDFSClient: shell over `hadoop fs` (same command surface
+    as the reference; requires the hadoop CLI). Mutating commands check
+    exit codes, retrying `retry_times` times with `sleep_inter` ms
+    backoff before raising ExecuteError — the reference's contract."""
+
+    def __init__(self, hadoop_home=None, configs=None, time_out=5 * 60,
+                 sleep_inter=1000, retry_times=3):
+        self._hadoop = os.path.join(hadoop_home, "bin", "hadoop") \
+            if hadoop_home else "hadoop"
+        self._configs = configs or {}
+        self._time_out = time_out
+        self._sleep_inter = sleep_inter / 1000.0
+        self._retry_times = max(int(retry_times), 1)
+
+    def _run(self, *args):
+        cmd = [self._hadoop, "fs"]
+        for k, v in self._configs.items():
+            cmd += ["-D", f"{k}={v}"]
+        cmd += list(args)
+        try:
+            return subprocess.run(cmd, capture_output=True, text=True,
+                                  timeout=self._time_out)
+        except FileNotFoundError as e:
+            raise RuntimeError(
+                "hadoop CLI not found — HDFSClient needs a hadoop "
+                "installation (pass hadoop_home=)") from e
+
+    def _run_checked(self, *args):
+        """Mutating ops: a silently-discarded failure loses data (e.g. a
+        checkpoint upload that never landed), so retry then raise."""
+        import time as _time
+
+        last = None
+        for attempt in range(self._retry_times):
+            try:
+                r = self._run(*args)
+            except subprocess.TimeoutExpired as e:
+                last = f"timeout after {self._time_out}s: {e}"
+            else:
+                if r.returncode == 0:
+                    return r
+                last = r.stderr.strip() or f"exit code {r.returncode}"
+            if attempt + 1 < self._retry_times:
+                _time.sleep(self._sleep_inter)
+        raise ExecuteError(
+            f"hadoop fs {' '.join(args)} failed after "
+            f"{self._retry_times} attempts: {last}")
+
+    def is_exist(self, fs_path):
+        return self._run("-test", "-e", fs_path).returncode == 0
+
+    def is_file(self, fs_path):
+        return self._run("-test", "-f", fs_path).returncode == 0
+
+    def is_dir(self, fs_path):
+        return self._run("-test", "-d", fs_path).returncode == 0
+
+    def ls_dir(self, fs_path):
+        r = self._run("-ls", fs_path)
+        dirs, files = [], []
+        for line in r.stdout.splitlines():
+            parts = line.split()
+            if len(parts) < 8:
+                continue
+            name = os.path.basename(parts[-1])
+            (dirs if parts[0].startswith("d") else files).append(name)
+        return dirs, files
+
+    def mkdirs(self, fs_path):
+        self._run_checked("-mkdir", "-p", fs_path)
+
+    def delete(self, fs_path):
+        self._run_checked("-rm", "-r", "-f", fs_path)
+
+    def upload(self, local_path, fs_path):
+        self._run_checked("-put", "-f", local_path, fs_path)
+
+    def download(self, fs_path, local_path):
+        self._run_checked("-get", fs_path, local_path)
+
+    def mv(self, src, dst, overwrite=False):
+        if overwrite:
+            self.delete(dst)
+        self._run_checked("-mv", src, dst)
+
+    def touch(self, fs_path, exist_ok=True):
+        if not exist_ok and self.is_exist(fs_path):
+            raise FSFileExistsError(fs_path)
+        self._run_checked("-touchz", fs_path)
+
+    def need_upload_download(self):
+        return True

@@ -12,3 +12,4 @@ from .dataset import (  # noqa: F401
     DatasetFactory, InMemoryDataset, MultiSlotDataFeed, QueueDataset,
 )
 from .trainer import MultiTrainer, train_from_dataset  # noqa: F401
+from . import op_version  # noqa: F401

@@ -1,0 +1,105 @@
+"""Op version registry: compatibility metadata for saved programs.
+
+Ref parity: paddle/fluid/framework/op_version_registry.h
+(REGISTER_OP_VERSION + pass-compat checking): each op records a version
+and a changelog (attrs added/deleted, semantics changes); artifacts
+saved by `static.save_inference_model` / `jit.save` embed the producer's
+version map, and loading warns when the consumer's registry diverges —
+the reference's checkpoint-compat contract.
+"""
+
+from __future__ import annotations
+
+import warnings
+
+__all__ = ["register_op_version", "get_op_version", "version_map",
+           "check_compatibility", "OpVersionDesc"]
+
+
+class OpVersionDesc:
+    """One version bump's changelog entry (ref OpVersionDesc)."""
+
+    def __init__(self, note=""):
+        self.changes: list[tuple[str, str, str]] = []  # (kind, name, note)
+        self.note = note
+
+    def new_attr(self, name, note="", default=None):
+        self.changes.append(("new_attr", name, note))
+        return self
+
+    def delete_attr(self, name, note=""):
+        self.changes.append(("delete_attr", name, note))
+        return self
+
+    def modify_attr(self, name, note=""):
+        self.changes.append(("modify_attr", name, note))
+        return self
+
+    def new_input(self, name, note=""):
+        self.changes.append(("new_input", name, note))
+        return self
+
+    def new_output(self, name, note=""):
+        self.changes.append(("new_output", name, note))
+        return self
+
+    def bug_fix(self, note=""):
+        self.changes.append(("bug_fix", "", note))
+        return self
+
+
+_VERSIONS: dict[str, list[OpVersionDesc]] = {}
+
+
+def register_op_version(op_type, desc=None):
+    """Add one version bump for `op_type`; version = number of bumps
+    (base version 0). Returns the desc for fluent changelog chaining."""
+    desc = desc or OpVersionDesc()
+    _VERSIONS.setdefault(op_type, []).append(desc)
+    return desc
+
+
+def get_op_version(op_type) -> int:
+    return len(_VERSIONS.get(op_type, []))
+
+
+def version_map() -> dict[str, int]:
+    """op_type -> current version for every registered op (ops without
+    explicit bumps are version 0); embedded into saved artifacts."""
+    from ..core.op_registry import registered_ops
+
+    return {op: get_op_version(op) for op in registered_ops()}
+
+
+def check_compatibility(saved_map, strict=False):
+    """Compare a saved artifact's version map against this runtime
+    (ref op_version_registry compat check at program load).
+
+    Returns list of (op, saved_version, current_version) mismatches;
+    warns by default, raises when strict."""
+    mismatches = []
+    for op, saved_v in (saved_map or {}).items():
+        cur = get_op_version(op)
+        if cur != saved_v:
+            mismatches.append((op, saved_v, cur))
+    if mismatches:
+        msg = ("op version mismatch between saved program and runtime: "
+               + ", ".join(f"{op} (saved v{s}, runtime v{c})"
+                           for op, s, c in mismatches[:5])
+               + ("..." if len(mismatches) > 5 else ""))
+        if strict:
+            raise RuntimeError(msg)
+        warnings.warn(msg)
+    return mismatches
+
+
+# ---------------------------------------------------------------------------
+# changelog entries for ops whose semantics evolved in this repo
+# ---------------------------------------------------------------------------
+
+register_op_version("dropout").modify_attr(
+    "mask", "keep-mask generated from a u16 threshold compare "
+    "(rate quantised to 1/65536) instead of an f32 bernoulli draw")
+register_op_version("flash_attention").new_attr(
+    "min_seq_dispatch", "kernel selection is sequence-aware: the XLA "
+    "fallback runs below PADDLE_TPU_FLASH_MIN_SEQ")

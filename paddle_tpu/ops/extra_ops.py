@@ -176,22 +176,28 @@ def grid_sampler(x, grid, *, mode="bilinear", padding_mode="zeros",
     gx = unnorm(grid[..., 0], w)
     gy = unnorm(grid[..., 1], h)
 
-    def reflect_idx(i, size):
-        # reflect without repeating the border (paddle 'reflection'):
-        # period 2*(size-1); -1 -> 1, size -> size-2
-        period = max(2 * (size - 1), 1)
-        i = jnp.abs(i)
-        i = i % period
-        return jnp.where(i >= size, period - i, i)
+    if padding_mode == "reflection":
+        # reflect the FLOAT coordinate before any rounding (paddle
+        # semantics): align_corners=True reflects about [0, size-1]
+        # (period 2(size-1)); False about [-0.5, size-0.5] (period
+        # 2*size, border pixels repeat once)
+        def reflect_coord(c, size):
+            if align_corners:
+                period = jnp.maximum(2.0 * (size - 1), 1.0)
+                r = jnp.abs(c) % period
+                return jnp.where(r > size - 1, period - r, r)
+            period = 2.0 * size
+            r = jnp.abs(c + 0.5) % period
+            r = jnp.minimum(r, period - r)
+            return jnp.clip(r - 0.5, 0.0, size - 1)
+
+        gx = reflect_coord(gx, w)
+        gy = reflect_coord(gy, h)
 
     def sample_at(yi, xi):
         inside = (yi >= 0) & (yi < h) & (xi >= 0) & (xi < w)
-        if padding_mode == "reflection":
-            yc = reflect_idx(yi, h)
-            xc = reflect_idx(xi, w)
-        else:  # zeros / border both clamp; zeros masks after
-            yc = jnp.clip(yi, 0, h - 1)
-            xc = jnp.clip(xi, 0, w - 1)
+        yc = jnp.clip(yi, 0, h - 1)
+        xc = jnp.clip(xi, 0, w - 1)
         vals = jax.vmap(
             lambda img, yy, xx: img[:, yy, xx])(x, yc, xc)  # [N,C,Ho,Wo]
         if padding_mode == "zeros":
@@ -448,8 +454,8 @@ def pool3d(x, *, ksize, stride=None, padding=0, pooling_type="max",
 @register_op("pad3d")
 def pad3d(x, *, paddings, mode="constant", value=0.0,
           data_format="NCDHW"):
-    """ref pad3d_op.cc: paddings [front, back, top, bottom, left, right]
-    over (D, H, W) in paddle order (W pairs first in the attr list)."""
+    """ref pad3d_op.cc: paddings [left, right, top, bottom, front, back]
+    — paddle attr order, W pairs first, then H, then D."""
     pl_, pr, pt, pb, pf, pk = [int(p) for p in paddings]
     if data_format == "NCDHW":
         cfg = [(0, 0), (0, 0), (pf, pk), (pt, pb), (pl_, pr)]

@@ -883,10 +883,14 @@ def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
         if v.persistable and _scope.find_var(n) is not None:
             params[n] = np.asarray(_scope.find_var(n))
 
+    from ..framework.op_version import get_op_version
+
+    op_versions = {rec[0]: get_op_version(rec[0]) for rec in op_records}
     os.makedirs(os.path.dirname(path_prefix) or ".", exist_ok=True)
     with open(path_prefix + ".pdmodel", "wb") as f:
         pickle.dump({"ops": op_records, "vars": var_metas,
-                     "feed": feed_names, "fetch": fetch_names}, f, protocol=4)
+                     "feed": feed_names, "fetch": fetch_names,
+                     "op_versions": op_versions}, f, protocol=4)
     with open(path_prefix + ".pdiparams", "wb") as f:
         pickle.dump(params, f, protocol=4)
 
@@ -898,6 +902,10 @@ def load_inference_model(path_prefix, executor=None, **configs):
         meta = pickle.load(f)
     with open(path_prefix + ".pdiparams", "rb") as f:
         params = pickle.load(f)
+
+    from ..framework.op_version import check_compatibility
+
+    check_compatibility(meta.get("op_versions"))
 
     prog = Program()
     blk = prog.global_block()

@@ -1640,7 +1640,7 @@ case("npair_loss",
      grad=(0, 1), bf16=False)
 
 
-def _np_lrn(x, n=5, k=1.0, alpha=1e-4, beta=0.75, data_format="NCHW"):
+def _np_lrn_cross_channel(x, n=5, k=1.0, alpha=1e-4, beta=0.75):
     out = np.zeros_like(x)
     c = x.shape[1]
     half = n // 2
@@ -1652,7 +1652,7 @@ def _np_lrn(x, n=5, k=1.0, alpha=1e-4, beta=0.75, data_format="NCHW"):
 
 
 case("lrn", [f32((2, 6, 3, 3), seed=39)], {"n": 3},
-     ref=lambda x, n=3, k=1.0, alpha=1e-4, beta=0.75: _np_lrn(
+     ref=lambda x, n=3, k=1.0, alpha=1e-4, beta=0.75: _np_lrn_cross_channel(
          x, n=n, k=k, alpha=alpha, beta=beta),
      grad=(0,), bf16=False)
 

@@ -1,0 +1,130 @@
+"""fleet.utils.fs, fleet.metrics, and the op-version registry.
+
+Ref intent: unittests/test_fs.py, test_fleet_metric.py,
+test_op_version.py — filesystem abstraction round trips, global metric
+reduction (single-process == local; PS mode merges through tables),
+and version-map embedding/checking on saved inference artifacts.
+"""
+
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import static
+from paddle_tpu.distributed import ps
+from paddle_tpu.distributed.fleet import metrics
+from paddle_tpu.distributed.fleet.utils import LocalFS
+from paddle_tpu.framework import op_version
+
+
+def test_local_fs_roundtrip(tmp_path):
+    fs = LocalFS()
+    d = str(tmp_path / "a" / "b")
+    fs.mkdirs(d)
+    assert fs.is_dir(d) and fs.is_exist(d)
+    f = d + "/x.txt"
+    fs.touch(f)
+    assert fs.is_file(f)
+    with pytest.raises(Exception):
+        fs.touch(f, exist_ok=False)
+    fs.mv(f, d + "/y.txt")
+    assert fs.is_file(d + "/y.txt") and not fs.is_exist(f)
+    dirs, files = fs.ls_dir(d)
+    assert files == ["y.txt"] and dirs == []
+    fs.delete(d)
+    assert not fs.is_exist(d)
+    assert not fs.need_upload_download()
+
+
+def test_metrics_local_fallback():
+    # single process: reductions are identity
+    assert float(metrics.sum(3.0)) == 3.0
+    assert float(metrics.acc(8.0, 10.0)) == pytest.approx(0.8)
+    np.testing.assert_allclose(metrics.sum(np.array([1.0, 2.0])),
+                               [1.0, 2.0])
+
+
+def test_metrics_auc_matches_streaming_metric():
+    # merge two trainers' Auc buckets -> same value as one combined Auc
+    from paddle_tpu.metric import Auc
+
+    rng = np.random.RandomState(0)
+    preds = rng.rand(200, 2).astype(np.float64)
+    preds[:, 0] = 1.0 - preds[:, 1]
+    labels = (rng.rand(200) > 0.5).astype(np.int64)[:, None]
+
+    combined = Auc()
+    combined.update(preds, labels)
+
+    a, b = Auc(), Auc()
+    a.update(preds[:100], labels[:100])
+    b.update(preds[100:], labels[100:])
+    # local-mode _reduce is identity, so pass pre-summed buckets
+    got = metrics.auc(
+        np.asarray(a._stat_pos) + np.asarray(b._stat_pos),
+        np.asarray(a._stat_neg) + np.asarray(b._stat_neg))
+    assert got == pytest.approx(combined.accumulate(), abs=1e-9)
+
+
+def test_metrics_ps_mode_sum(tmp_path):
+    server = ps.PSServer("127.0.0.1:0").start()
+    rm = ps.PSRoleMaker(server_endpoints=[f"127.0.0.1:{server.port}"],
+                        role="TRAINER", n_trainers=1)
+    rt = ps.init_runtime(rm, mode="sync")
+    rt.init_worker()
+    try:
+        got = metrics.sum(np.array([2.0, 3.0]))
+        np.testing.assert_allclose(got, [2.0, 3.0])
+    finally:
+        import paddle_tpu.distributed.ps.runtime as rtmod
+
+        rt.stop_worker()
+        server.stop()
+        rtmod._runtime = None
+
+
+def test_op_version_registry():
+    v0 = op_version.get_op_version("matmul_v2")
+    op_version.register_op_version("matmul_v2").new_attr(
+        "test_attr", "testing only")
+    try:
+        assert op_version.get_op_version("matmul_v2") == v0 + 1
+        vm = op_version.version_map()
+        assert vm["matmul_v2"] == v0 + 1
+        assert vm.get("relu", 0) >= 0
+        mism = op_version.check_compatibility({"matmul_v2": v0 + 1})
+        assert mism == []
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            mism = op_version.check_compatibility({"matmul_v2": 99})
+        assert mism and "matmul_v2" in str(w[0].message)
+        with pytest.raises(RuntimeError):
+            op_version.check_compatibility({"matmul_v2": 99}, strict=True)
+    finally:
+        op_version._VERSIONS["matmul_v2"].pop()
+
+
+def test_saved_model_embeds_versions(tmp_path):
+    paddle.enable_static()
+    main, startup = static.Program(), static.Program()
+    try:
+        with static.program_guard(main, startup):
+            x = static.data("x", [2, 3], "float32")
+            out = paddle.tanh(x)
+            exe = static.Executor()
+            path = str(tmp_path / "m")
+            static.save_inference_model(path, [x], [out], exe)
+            import pickle
+
+            meta = pickle.load(open(path + ".pdmodel", "rb"))
+            assert "tanh" in meta["op_versions"]
+            # load re-checks compatibility silently when maps agree
+            prog, feeds, fetches = static.load_inference_model(path, exe)
+            (got,) = exe.run(prog, feed={"x": np.ones((2, 3), np.float32)},
+                             fetch_list=fetches)
+            np.testing.assert_allclose(got, np.tanh(np.ones((2, 3))),
+                                       rtol=1e-6)
+    finally:
+        paddle.disable_static()
