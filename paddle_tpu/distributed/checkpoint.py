@@ -292,3 +292,46 @@ def load_persistables(engine_or_layer, dirname):
     restored = load_state(dirname, tpl)
     for k, v in restored.items():
         sd[k]._value = v
+
+
+def train_epoch_range(max_epoch, directory, engine, save_interval=1,
+                      max_to_keep=3):
+    """Auto-checkpointed epoch loop (ref fluid/incubate/checkpoint/
+    auto_checkpoint.py:71 train_epoch_range): yields epoch indices,
+    snapshotting the engine's full TrainState after each `save_interval`
+    epochs, and TRANSPARENTLY RESUMES — after a restart the generator
+    restores the latest snapshot (params, optimizer state, RNG) and
+    continues from the next epoch, so the training script needs no
+    resume logic of its own:
+
+        for epoch in checkpoint.train_epoch_range(10, ckpt_dir, engine):
+            ... train one epoch ...
+    """
+    from ..engine import Engine
+
+    if not isinstance(engine, Engine):
+        raise TypeError("train_epoch_range drives a compiled Engine; for "
+                        "raw Layers use CheckpointManager directly")
+    mgr = CheckpointManager(os.path.join(directory, "auto_ckpt"),
+                            max_to_keep=max_to_keep)
+    start = 0
+    latest = mgr.latest_step()
+    if latest is not None:
+        st = engine.state
+        tpl = {"params": st.params, "buffers": st.buffers,
+               "opt_state": st.opt_state}
+        restored, meta = mgr.restore(tpl)
+        st.params = restored["params"]
+        st.buffers = restored["buffers"]
+        st.opt_state = restored["opt_state"]
+        st.step = int(meta.get("engine_step", 0))
+        start = latest + 1
+
+    for epoch in range(start, max_epoch):
+        yield epoch
+        if (epoch + 1) % save_interval == 0 or epoch == max_epoch - 1:
+            st = engine.state
+            mgr.save(epoch,
+                     {"params": st.params, "buffers": st.buffers,
+                      "opt_state": st.opt_state},
+                     metadata={"engine_step": int(st.step)})

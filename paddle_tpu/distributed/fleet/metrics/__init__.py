@@ -27,18 +27,27 @@ def _reduce(value, op="sum"):
     value = np.asarray(value, np.float64)
 
     # PS mode: merge through a per-call scratch dense table (a fresh name
-    # each call — a reused table would keep accumulating across calls)
+    # each call — a reused table would keep accumulating across calls;
+    # rank 0 deletes it after the post-pull barrier so the server does
+    # not leak one table per metric call)
     from ...ps.runtime import _runtime
 
-    if _runtime is not None and _runtime._client is not None \
-            and op == "sum":
+    if _runtime is not None and _runtime._client is not None:
         client = _runtime.client
         name = f"@metric/{op}/{next(_ps_metric_seq)}"
-        client.create_dense_table(name, list(value.reshape(-1).shape),
-                                  optimizer="sum", lr=1.0)
+        # the table starts at the reduction identity, not zeros — zeros
+        # would poison min (and max for negative stats)
+        ident = {"sum": 0.0, "max": -np.inf, "min": np.inf}[op]
+        client.create_dense_table(
+            name, list(value.reshape(-1).shape), optimizer=op, lr=1.0,
+            initial=np.full(value.reshape(-1).shape, ident, np.float32))
         client.push_dense_grad(name, value.reshape(-1))
         _runtime.barrier()
-        return client.pull_dense(name).reshape(value.shape)
+        out = client.pull_dense(name).reshape(value.shape)
+        _runtime.barrier()  # everyone pulled before the delete
+        if _runtime.role.trainer_id == 0:
+            client.delete_table(name)
+        return out
 
     # multi-process jax: gather per-process stats, reduce locally
     import jax

@@ -217,12 +217,18 @@ class HDFSClient(FS):
         self._run_checked("-get", fs_path, local_path)
 
     def mv(self, src, dst, overwrite=False):
+        # check src BEFORE any destructive delete of dst (LocalFS.mv
+        # order): a typo'd source must never destroy the destination
+        if not self.is_exist(src):
+            raise FSFileNotExistsError(src)
         if overwrite:
             self.delete(dst)
         self._run_checked("-mv", src, dst)
 
     def touch(self, fs_path, exist_ok=True):
-        if not exist_ok and self.is_exist(fs_path):
+        if self.is_exist(fs_path):
+            if exist_ok:
+                return  # -touchz fails on non-empty existing files
             raise FSFileExistsError(fs_path)
         self._run_checked("-touchz", fs_path)
 

@@ -182,6 +182,10 @@ class PSServer:
                 if n in self._tables:
                     self._tables[n].load_state_dict(sd)
             return None
+        if cmd == "delete_table":
+            with self._tables_lock:
+                self._tables.pop(args, None)
+            return None
         if cmd == "table_size":
             t = self._tables[args]
             return len(t) if isinstance(t, SparseTable) else 1
@@ -281,6 +285,11 @@ class PSClient:
             if pos.size:
                 self._call(i, "push_sparse_grad",
                            (name, ids[pos], grads[pos]))
+
+    def delete_table(self, name):
+        for i in range(len(self.endpoints)):
+            self._call(i, "delete_table", name)
+        self._sparse_dims.pop(name, None)
 
     # -- control -------------------------------------------------------------
     def barrier(self, n_trainers):

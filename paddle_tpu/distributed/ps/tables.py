@@ -50,8 +50,12 @@ class DenseTable:
                 self._accum += grad * grad
                 self.value -= self.lr * grad / (
                     np.sqrt(self._accum) + self.epsilon)
-            elif self.optimizer == "sum":  # geo delta merge
+            elif self.optimizer == "sum":  # geo delta / metric merge
                 self.value += grad
+            elif self.optimizer == "max":  # metric merge
+                self.value = np.maximum(self.value, grad)
+            elif self.optimizer == "min":
+                self.value = np.minimum(self.value, grad)
             else:
                 raise ValueError(f"unknown optimizer {self.optimizer!r}")
 

@@ -154,6 +154,9 @@ def save(layer, path, input_spec=None, **configs):
     if is_layer:
         for k, v in layer.state_dict().items():
             state[k] = np.asarray(v._value)
+    from ..framework.op_version import version_map
+
+    state["__op_versions__"] = version_map()
     with open(path + ".pdiparams", "wb") as f:
         pickle.dump(state, f, protocol=4)
 
@@ -209,9 +212,53 @@ class TranslatedLayer(Layer):
 def load(path, **configs):
     with open(path + ".pdiparams", "rb") as f:
         state = pickle.load(f)
+    saved_versions = state.pop("__op_versions__", None)
+    if saved_versions is not None:
+        from ..framework.op_version import check_compatibility
+
+        check_compatibility(saved_versions)
     model_path = path + ".pdmodel"
     if os.path.exists(model_path):
         with open(model_path, "rb") as f:
             exported = jax.export.deserialize(f.read())
         return TranslatedLayer(exported, state)
     return state
+
+
+class TracedLayer:
+    """ref fluid/dygraph/jit.py:1136 TracedLayer: trace a dygraph layer
+    once with example inputs, then run/serialise the captured program.
+
+        out, traced = TracedLayer.trace(layer, [x])
+        y = traced([x2])
+        traced.save_inference_model("path")
+    """
+
+    def __init__(self, layer, example_inputs):
+        self._layer = layer
+        self._example = [a._value if isinstance(a, Tensor)
+                         else jnp.asarray(a) for a in example_inputs]
+        self._static = StaticFunction(layer.forward, layer=layer)
+
+    @staticmethod
+    def trace(layer, inputs):
+        inputs = list(inputs) if isinstance(inputs, (list, tuple)) \
+            else [inputs]
+        traced = TracedLayer(layer, inputs)
+        out = traced(inputs)
+        return out, traced
+
+    def __call__(self, inputs):
+        inputs = list(inputs) if isinstance(inputs, (list, tuple)) \
+            else [inputs]
+        return self._static(*inputs)
+
+    def save_inference_model(self, path, feed=None, fetch=None, **kw):
+        if feed is not None or fetch is not None:
+            raise NotImplementedError(
+                "TracedLayer.save_inference_model saves the full traced "
+                "forward; feed/fetch pruning is not supported — slice "
+                "inputs/outputs in the layer instead")
+        specs = [InputSpec(list(a.shape), a.dtype.name)
+                 for a in self._example]
+        save(self._layer, path, input_spec=specs)

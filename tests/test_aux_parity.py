@@ -266,3 +266,28 @@ def test_hapi_flops_and_summary():
     assert 700 <= f <= 1200, f
     s = m.summary()
     assert s["total_params"] == 8 * 16 + 16 + 16 * 4 + 4
+
+
+def test_traced_layer_roundtrip(tmp_path):
+    """ref fluid/dygraph/jit.py:1136 TracedLayer: trace, run, save,
+    reload."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.jit import TracedLayer
+
+    paddle.seed(0)
+    lin = nn.Linear(4, 2)
+    x = paddle.randn([3, 4])
+    out, traced = TracedLayer.trace(lin, [x])
+    ones = paddle.ones([3, 4])
+    y = traced([ones])
+    np.testing.assert_allclose(np.asarray(y.numpy()),
+                               np.asarray(lin(ones).numpy()), rtol=1e-6)
+    path = str(tmp_path / "traced")
+    traced.save_inference_model(path)
+    loaded = paddle.jit.load(path)
+    z = loaded(ones)
+    np.testing.assert_allclose(np.asarray(z.numpy()),
+                               np.asarray(y.numpy()), rtol=1e-6)

@@ -128,3 +128,25 @@ def test_saved_model_embeds_versions(tmp_path):
                                        rtol=1e-6)
     finally:
         paddle.disable_static()
+
+
+def test_metrics_ps_mode_max_min_and_cleanup():
+    """max/min must merge through identity-initialised scratch tables
+    (zeros would poison them), and scratch tables must not leak."""
+    import paddle_tpu.distributed.ps.runtime as rtmod
+
+    server = ps.PSServer("127.0.0.1:0").start()
+    rm = ps.PSRoleMaker(server_endpoints=[f"127.0.0.1:{server.port}"],
+                        role="TRAINER", trainer_id=0, n_trainers=1)
+    rt = ps.init_runtime(rm, mode="sync")
+    rt.init_worker()
+    try:
+        assert float(metrics.max(-5.0)) == -5.0
+        assert float(metrics.min(2.0)) == 2.0
+        n = len(server._tables)
+        metrics.sum(1.0)
+        assert len(server._tables) == n  # per-call table deleted
+    finally:
+        rt.stop_worker()
+        server.stop()
+        rtmod._runtime = None
