@@ -312,26 +312,19 @@ def train_epoch_range(max_epoch, directory, engine, save_interval=1,
     if not isinstance(engine, Engine):
         raise TypeError("train_epoch_range drives a compiled Engine; for "
                         "raw Layers use CheckpointManager directly")
+    # compose the full-fidelity engine save/load (params, moments, step,
+    # LR-scheduler position, RNG, target shardings, sync_to_layer) with
+    # CheckpointManager's numbering + retention
     mgr = CheckpointManager(os.path.join(directory, "auto_ckpt"),
                             max_to_keep=max_to_keep)
     start = 0
     latest = mgr.latest_step()
     if latest is not None:
-        st = engine.state
-        tpl = {"params": st.params, "buffers": st.buffers,
-               "opt_state": st.opt_state}
-        restored, meta = mgr.restore(tpl)
-        st.params = restored["params"]
-        st.buffers = restored["buffers"]
-        st.opt_state = restored["opt_state"]
-        st.step = int(meta.get("engine_step", 0))
+        load_train_state(mgr._path(latest), engine)
         start = latest + 1
 
     for epoch in range(start, max_epoch):
         yield epoch
         if (epoch + 1) % save_interval == 0 or epoch == max_epoch - 1:
-            st = engine.state
-            mgr.save(epoch,
-                     {"params": st.params, "buffers": st.buffers,
-                      "opt_state": st.opt_state},
-                     metadata={"engine_step": int(st.step)})
+            save_train_state(mgr._path(epoch), engine)
+            mgr._gc()

@@ -217,11 +217,15 @@ class HDFSClient(FS):
         self._run_checked("-get", fs_path, local_path)
 
     def mv(self, src, dst, overwrite=False):
-        # check src BEFORE any destructive delete of dst (LocalFS.mv
-        # order): a typo'd source must never destroy the destination
+        # full LocalFS.mv parity: src must exist BEFORE any destructive
+        # delete of dst, and without overwrite an existing dst is an
+        # error (hadoop -mv would otherwise silently nest src inside a
+        # dst directory)
         if not self.is_exist(src):
             raise FSFileNotExistsError(src)
-        if overwrite:
+        if self.is_exist(dst):
+            if not overwrite:
+                raise FSFileExistsError(dst)
             self.delete(dst)
         self._run_checked("-mv", src, dst)
 
