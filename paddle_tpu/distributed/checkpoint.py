@@ -160,7 +160,8 @@ def load_train_state(path, engine):
     restored = load_state(path, tpl, shardings=_engine_shardings(engine))
     st.params, st.opt_state, st.buffers = (
         restored["params"], restored["opt_state"], restored["buffers"])
-    st.step = int(meta.get("step", 0))
+    # 'engine_step' is the legacy auto-checkpoint key for the same value
+    st.step = int(meta.get("step", meta.get("engine_step", 0)))
     _restore_rng(meta)
     from ..optimizer.lr import LRScheduler
 
@@ -267,6 +268,36 @@ class CheckpointManager:
             victim = steps.pop(0)
             shutil.rmtree(self._path(victim), ignore_errors=True)
 
+    def save_with(self, step, writer_fn):
+        """Numbered save through an external writer (e.g.
+        save_train_state): writer_fn(path) persists, then retention
+        applies — keeps the numbering+gc contract in one place."""
+        writer_fn(self._path(step))
+        self._gc()
+
+    def restore_with(self, reader_fn, *, step=None):
+        """Numbered restore through an external reader, falling back to
+        OLDER checkpoints when the newest is unreadable (a crash between
+        the array write and the metadata write leaves a torn dir)."""
+        candidates = [step] if step is not None else \
+            list(reversed(self.all_steps()))
+        if not candidates:
+            raise FileNotFoundError(
+                f"no checkpoints under {self.directory}")
+        last_err = None
+        for s in candidates:
+            try:
+                return s, reader_fn(self._path(s))
+            except (FileNotFoundError, ValueError, KeyError) as e:
+                last_err = e
+                import warnings
+
+                warnings.warn(
+                    f"checkpoint ckpt-{s} unreadable ({e}); trying the "
+                    "previous one")
+        raise FileNotFoundError(
+            f"no readable checkpoint under {self.directory}") from last_err
+
 
 def save_persistables(engine_or_layer, dirname):
     """fleet.save_persistables analogue (ref fluid/io.py:668): persist
@@ -318,13 +349,13 @@ def train_epoch_range(max_epoch, directory, engine, save_interval=1,
     mgr = CheckpointManager(os.path.join(directory, "auto_ckpt"),
                             max_to_keep=max_to_keep)
     start = 0
-    latest = mgr.latest_step()
-    if latest is not None:
-        load_train_state(mgr._path(latest), engine)
-        start = latest + 1
+    if mgr.all_steps():
+        restored_step, _ = mgr.restore_with(
+            lambda p: load_train_state(p, engine))
+        start = restored_step + 1
 
     for epoch in range(start, max_epoch):
         yield epoch
         if (epoch + 1) % save_interval == 0 or epoch == max_epoch - 1:
-            save_train_state(mgr._path(epoch), engine)
-            mgr._gc()
+            mgr.save_with(epoch,
+                          lambda p: save_train_state(p, engine))

@@ -34,6 +34,7 @@ from .layer.rnn import (  # noqa: F401
     GRU, GRUCell, LSTM, LSTMCell, RNN, BiRNN, RNNCellBase, SimpleRNN,
     SimpleRNNCell,
 )
+from .layer.decode import BeamSearchDecoder, dynamic_decode  # noqa: F401
 from .layer.transformer import (  # noqa: F401
     MultiHeadAttention, Transformer, TransformerDecoder,
     TransformerDecoderLayer, TransformerEncoder, TransformerEncoderLayer,

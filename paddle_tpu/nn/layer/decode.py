@@ -1,0 +1,133 @@
+"""Seq2seq decoding: BeamSearchDecoder + dynamic_decode.
+
+Ref parity: python/paddle/nn/layer/rnn.py BeamSearchDecoder and
+python/paddle/nn/decode.py dynamic_decode (beam_search_op /
+beam_search_decode_op / gather_tree_op in the reference op set).
+TPU-native: the decode loop runs a fixed `max_step_num` steps with
+static [B, W] beam shapes (finished beams keep extending with end_token
+at probability 1), and the final sequences are re-threaded through the
+`gather_tree` op — no dynamic-length LoD output.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ...core.dispatch import apply
+from ...core.tensor import Tensor
+from .layers import Layer
+
+__all__ = ["BeamSearchDecoder", "dynamic_decode"]
+
+_NEG_INF = -1e9
+
+
+def _raw(t):
+    return t._value if isinstance(t, Tensor) else jnp.asarray(t)
+
+
+def _tile_beam(x, beam_size):
+    """[B, ...] -> [B*W, ...] (repeat each batch item W times)."""
+    x = _raw(x)
+    return jnp.repeat(x, beam_size, axis=0)
+
+
+class BeamSearchDecoder:
+    """ref nn/layer/rnn.py BeamSearchDecoder: wraps an RNN cell for
+    beam-search decoding.
+
+    cell(step_input [B*W, D], states) -> (output, new_states); the cell
+    output is projected to vocab logits by `output_fn` (or is already
+    logits); `embedding_fn` maps token ids -> step inputs.
+    """
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    @staticmethod
+    def tile_beam_merge_with_batch(x, beam_size):
+        """ref BeamSearchDecoder.tile_beam_merge_with_batch: repeat
+        encoder outputs per beam ([B, ...] -> [B*W, ...])."""
+        return Tensor(_tile_beam(x, beam_size))
+
+    def _cell_states_tiled(self, initial_states):
+        import jax
+
+        return jax.tree.map(
+            lambda s: _tile_beam(s, self.beam_size), initial_states,
+            is_leaf=lambda s: isinstance(s, Tensor))
+
+    def decode(self, initial_states, max_step_num):
+        """Run the fixed-length beam search. Returns (ids [B, T, W],
+        scores [B, W]) with beams sorted by score (best first)."""
+        import jax
+
+        W = self.beam_size
+        # infer batch from the first state leaf
+        first = jax.tree.leaves(
+            initial_states,
+            is_leaf=lambda s: isinstance(s, Tensor))[0]
+        B = _raw(first).shape[0]
+
+        states = self._cell_states_tiled(initial_states)
+        tokens = jnp.full((B * W,), self.start_token, jnp.int32)
+        # beam 0 starts live, others muted so step 1 picks W distinct
+        # continuations of the single start hypothesis
+        log_probs = jnp.tile(
+            jnp.asarray([0.0] + [_NEG_INF] * (W - 1), jnp.float32), (B,))
+        finished = jnp.zeros((B * W,), bool)
+
+        step_ids, step_parents = [], []
+        for _ in range(max_step_num):
+            inp = self.embedding_fn(Tensor(tokens)) \
+                if self.embedding_fn is not None else Tensor(tokens)
+            out, states = self.cell(inp, states)
+            logits = self.output_fn(out) if self.output_fn is not None \
+                else out
+            logp = jax.nn.log_softmax(
+                _raw(logits).astype(jnp.float32), axis=-1)  # [B*W, V]
+            V = logp.shape[-1]
+            # finished beams extend ONLY with end_token at prob 1
+            fin_row = jnp.full((V,), _NEG_INF, jnp.float32
+                               ).at[self.end_token].set(0.0)
+            logp = jnp.where(finished[:, None], fin_row[None, :], logp)
+            scores = (log_probs[:, None] + logp).reshape(B, W * V)
+            top_scores, top_idx = jax.lax.top_k(scores, W)  # [B, W]
+            parent = (top_idx // V).astype(jnp.int32)
+            token = (top_idx % V).astype(jnp.int32)
+
+            # reorder beam-major state by chosen parents
+            flat_parent = (parent
+                           + (jnp.arange(B) * W)[:, None]).reshape(-1)
+            states = jax.tree.map(
+                lambda s: _raw(s)[flat_parent], states,
+                is_leaf=lambda s: isinstance(s, Tensor))
+            log_probs = top_scores.reshape(-1)
+            tokens = token.reshape(-1)
+            finished = finished[flat_parent] | (tokens == self.end_token)
+            step_ids.append(token)
+            step_parents.append(parent)
+            if bool(finished.all()):
+                break
+
+        ids = jnp.stack(step_ids)          # [T, B, W]
+        parents = jnp.stack(step_parents)  # [T, B, W]
+        full = _raw(apply("gather_tree", ids, parents))  # [T, B, W]
+        return (Tensor(jnp.transpose(full, (1, 0, 2))),
+                Tensor(log_probs.reshape(B, W)))
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=100, **kwargs):
+    """ref python/paddle/nn/decode.py dynamic_decode: drive a decoder to
+    completion. Returns (ids [B, T, W] best-first, scores [B, W])."""
+    if not isinstance(decoder, BeamSearchDecoder):
+        raise TypeError("dynamic_decode drives a BeamSearchDecoder")
+    return decoder.decode(inits, max_step_num)
