@@ -391,4 +391,5 @@ def make_ernie_hybrid_engine(model, criterion, optimizer, hcg, *,
         block_regex=r"ernie\.encoder\.(\d+)\.(.*)",
         template_block=model.ernie.encoder[0],
         embed_fn=embed_fn, head_fn=head_fn,
-        accumulate_steps=accumulate_steps, zero_stage=zero_stage)
+        accumulate_steps=accumulate_steps, zero_stage=zero_stage,
+        offload=offload)

@@ -109,6 +109,13 @@ def start_local_trainers(args, endpoints, world, append_logs=False):
     master = args.master or endpoints[0]
     if args.log_dir:
         os.makedirs(args.log_dir, exist_ok=True)
+    # single-node: mint a per-pod PS auth token so the handshake is not
+    # the public default. Multi-node: set PADDLE_TPU_PS_TOKEN identically
+    # on every node before launching (it is inherited below).
+    if "PADDLE_TPU_PS_TOKEN" not in os.environ and args.nnodes == 1:
+        import secrets
+
+        os.environ["PADDLE_TPU_PS_TOKEN"] = secrets.token_hex(16)
     for local in range(args.nproc_per_node):
         rank = args.node_rank * args.nproc_per_node + local
         env = dict(os.environ)

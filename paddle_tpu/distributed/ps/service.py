@@ -4,8 +4,10 @@ Ref parity: paddle/fluid/distributed/service/ — BrpcPsServer/BrpcPsClient
 (brpc RPC with sendrecv.proto) and Communicator (trainer-side async
 send queues, sync/async/geo modes, communicator.h:197). TPU-native
 redesign: the transport is a length-prefixed binary protocol over TCP
-(numpy buffers serialised raw, no pickle for payload rows), servers are
-a thread pool holding the tables of §tables.py, and sparse rows are
+with a typed tag codec (the wire schema role sendrecv.proto plays in
+the reference) — never pickle, so a hostile peer cannot execute code —
+plus an HMAC shared-secret handshake per connection. Servers are a
+thread pool holding the tables of §tables.py, and sparse rows are
 partitioned across servers by `id % n_servers` (the reference shards by
 id range per table — modulo keeps shard balance without a shard map).
 Trainers talk through PSClient; Communicator batches pushes in a
@@ -15,7 +17,9 @@ deltas pushed every k steps (geo, ref SparseGeoTable).
 
 from __future__ import annotations
 
-import pickle
+import hashlib
+import hmac
+import os
 import socket
 import zlib
 import socketserver
@@ -28,10 +32,162 @@ import numpy as np
 from .tables import DenseTable, SparseTable
 
 _MAGIC = b"PTPS"
+_MAX_FRAME = 1 << 34          # 16 GiB — sanity bound on frame length
+_MAX_DEPTH = 32               # nesting bound for the decoder
+
+# -- typed wire codec (replaces sendrecv.proto; no pickle anywhere) ----------
+# tags: N none, T true, F false, i int64, I big-int(str), f float64,
+#       s str, b bytes, l list, t tuple, d dict, a ndarray
+_I64_MIN, _I64_MAX = -(1 << 63), (1 << 63) - 1
+
+
+def _enc(obj, out: bytearray):
+    if obj is None:
+        out += b"N"
+    elif isinstance(obj, (bool, np.bool_)):
+        out += b"T" if obj else b"F"
+    elif isinstance(obj, (int, np.integer)):
+        v = int(obj)
+        if _I64_MIN <= v <= _I64_MAX:
+            out += b"i" + struct.pack("<q", v)
+        else:
+            s = str(v).encode()
+            out += b"I" + struct.pack("<I", len(s)) + s
+    elif isinstance(obj, (float, np.floating)):
+        out += b"f" + struct.pack("<d", float(obj))
+    elif isinstance(obj, str):
+        raw = obj.encode()
+        out += b"s" + struct.pack("<I", len(raw)) + raw
+    elif isinstance(obj, bytes):
+        out += b"b" + struct.pack("<Q", len(obj)) + obj
+    elif isinstance(obj, np.ndarray):
+        dt = obj.dtype.str.encode()     # e.g. b'<f4' — endian-explicit
+        raw = np.ascontiguousarray(obj).tobytes()
+        out += (b"a" + struct.pack("<B", len(dt)) + dt
+                + struct.pack("<B", obj.ndim)
+                + struct.pack(f"<{obj.ndim}q", *obj.shape)
+                + struct.pack("<Q", len(raw)) + raw)
+    elif isinstance(obj, (list, tuple)):
+        out += (b"l" if isinstance(obj, list) else b"t")
+        out += struct.pack("<I", len(obj))
+        for x in obj:
+            _enc(x, out)
+    elif isinstance(obj, dict):
+        out += b"d" + struct.pack("<I", len(obj))
+        for k, v in obj.items():
+            _enc(k, out)
+            _enc(v, out)
+    else:
+        raise TypeError(
+            f"PS wire codec cannot serialize {type(obj).__name__}")
+
+
+class _Dec:
+    def __init__(self, buf: bytes):
+        self.buf = buf
+        self.pos = 0
+
+    def _take(self, n):
+        if self.pos + n > len(self.buf):
+            raise ConnectionError("truncated PS frame")
+        v = self.buf[self.pos:self.pos + n]
+        self.pos += n
+        return v
+
+    def value(self, depth=0):
+        if depth > _MAX_DEPTH:
+            raise ConnectionError("PS frame nests too deep")
+        tag = self._take(1)
+        if tag == b"N":
+            return None
+        if tag == b"T":
+            return True
+        if tag == b"F":
+            return False
+        if tag == b"i":
+            return struct.unpack("<q", self._take(8))[0]
+        if tag == b"I":
+            (n,) = struct.unpack("<I", self._take(4))
+            return int(self._take(n).decode())
+        if tag == b"f":
+            return struct.unpack("<d", self._take(8))[0]
+        if tag == b"s":
+            (n,) = struct.unpack("<I", self._take(4))
+            return self._take(n).decode()
+        if tag == b"b":
+            (n,) = struct.unpack("<Q", self._take(8))
+            return self._take(n)
+        if tag == b"a":
+            (dtn,) = struct.unpack("<B", self._take(1))
+            dt = np.dtype(self._take(dtn).decode())
+            if dt.hasobject:
+                raise ConnectionError("object arrays not allowed on wire")
+            (ndim,) = struct.unpack("<B", self._take(1))
+            shape = struct.unpack(f"<{ndim}q", self._take(8 * ndim))
+            (nbytes,) = struct.unpack("<Q", self._take(8))
+            arr = np.frombuffer(self._take(nbytes), dtype=dt)
+            return arr.reshape(shape).copy()
+        if tag in (b"l", b"t"):
+            (n,) = struct.unpack("<I", self._take(4))
+            items = [self.value(depth + 1) for _ in range(n)]
+            return items if tag == b"l" else tuple(items)
+        if tag == b"d":
+            (n,) = struct.unpack("<I", self._take(4))
+            return {self.value(depth + 1): self.value(depth + 1)
+                    for _ in range(n)}
+        raise ConnectionError(f"bad PS wire tag {tag!r}")
+
+
+def _dumps(obj) -> bytes:
+    out = bytearray()
+    _enc(obj, out)
+    return bytes(out)
+
+
+def _loads(buf: bytes):
+    try:
+        dec = _Dec(buf)
+        val = dec.value()
+        if dec.pos != len(buf):
+            raise ConnectionError("trailing bytes in PS frame")
+        return val
+    except ConnectionError:
+        raise
+    except (ValueError, TypeError, UnicodeDecodeError, struct.error) as e:
+        # bad utf-8, dtype strings, buffer-size mismatches, unhashable
+        # dict keys — normalise so the server's drop path handles them
+        raise ConnectionError(f"malformed PS frame: {e!r}") from e
+
+
+_warned_default_token = False
+
+
+def _auth_key() -> bytes:
+    """Shared secret for the connection handshake.
+
+    Set PADDLE_TPU_PS_TOKEN identically on all ranks; the launcher
+    generates a random one per pod and forwards it to every rank.
+    The typed codec alone already removes code execution; the token
+    additionally keeps strangers from mutating tables — but only when
+    it is NOT the well-known fallback, hence the warning."""
+    tok = os.environ.get("PADDLE_TPU_PS_TOKEN")
+    if tok is None:
+        global _warned_default_token
+        if not _warned_default_token:
+            _warned_default_token = True
+            import warnings
+
+            warnings.warn(
+                "PADDLE_TPU_PS_TOKEN is unset — the PS handshake is using "
+                "the public default key, which authenticates nothing. Set "
+                "the same random token on all ranks (the launcher does "
+                "this automatically) to keep untrusted peers out.")
+        tok = "paddle-tpu-ps"
+    return tok.encode()
 
 
 def _send_msg(sock, obj):
-    payload = pickle.dumps(obj, protocol=4)
+    payload = _dumps(obj)
     sock.sendall(_MAGIC + struct.pack("<Q", len(payload)) + payload)
 
 
@@ -50,7 +206,9 @@ def _recv_msg(sock):
     if head[:4] != _MAGIC:
         raise ConnectionError("bad frame magic")
     (size,) = struct.unpack("<Q", head[4:])
-    return pickle.loads(_recv_exact(sock, size))
+    if size > _MAX_FRAME:
+        raise ConnectionError("PS frame exceeds size bound")
+    return _loads(_recv_exact(sock, size))
 
 
 class _Handler(socketserver.BaseRequestHandler):
@@ -59,6 +217,15 @@ class _Handler(socketserver.BaseRequestHandler):
         sock = self.request
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         try:
+            # challenge-response handshake before any command is accepted
+            nonce = os.urandom(16)
+            sock.sendall(_MAGIC + nonce)
+            reply = _recv_exact(sock, 32)
+            want = hmac.new(_auth_key(), nonce, hashlib.sha256).digest()
+            if not hmac.compare_digest(reply, want):
+                sock.sendall(b"NO")  # explicit reject, then drop
+                return
+            sock.sendall(b"OK")
             while True:
                 cmd, args = _recv_msg(sock)
                 if cmd == "stop":
@@ -214,6 +381,17 @@ class PSClient:
             # reply would be read as the NEXT call's response)
             s.settimeout(120.0)
             s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            head = _recv_exact(s, 20)
+            if head[:4] != _MAGIC:
+                raise ConnectionError("bad PS handshake magic")
+            s.sendall(hmac.new(_auth_key(), head[4:],
+                               hashlib.sha256).digest())
+            ack = _recv_exact(s, 2)
+            if ack != b"OK":
+                s.close()
+                raise ConnectionError(
+                    "PS authentication failed — PADDLE_TPU_PS_TOKEN does "
+                    f"not match the server at {self.endpoints[i]}")
             self._socks[i] = s
         return self._socks[i]
 

@@ -305,15 +305,22 @@ def multiclass_nms3(bboxes, scores, *, score_threshold=0.05, nms_top_k=400,
         valid = s > score_threshold
         sub_iou = iou[idx][:, idx]
 
-        def body(i, keep):
+        def body(i, state):
+            keep, thr = state
             # suppressed if it overlaps any higher-scoring kept box
             sup = jnp.any(jnp.where(jnp.arange(top_k) < i,
-                                    (sub_iou[i] > nms_threshold) & keep,
+                                    (sub_iou[i] > thr) & keep,
                                     False))
-            return keep.at[i].set(valid[i] & ~sup)
+            kept = valid[i] & ~sup
+            # adaptive threshold decay (ref NMSFast: after each kept box,
+            # while the current threshold still exceeds 0.5)
+            thr = jnp.where(kept & (nms_eta < 1.0) & (thr > 0.5),
+                            thr * nms_eta, thr)
+            return keep.at[i].set(kept), thr
 
-        keep = jax.lax.fori_loop(0, top_k,
-                                 body, jnp.zeros(top_k, bool))
+        keep, _ = jax.lax.fori_loop(
+            0, top_k, body,
+            (jnp.zeros(top_k, bool), jnp.float32(nms_threshold)))
         return s, idx, keep
 
     s_all, idx_all, keep_all = jax.vmap(one_class)(scores)
