@@ -61,6 +61,8 @@ def _enc(obj, out: bytearray):
     elif isinstance(obj, bytes):
         out += b"b" + struct.pack("<Q", len(obj)) + obj
     elif isinstance(obj, np.ndarray):
+        if obj.dtype.hasobject:
+            raise TypeError("PS wire codec cannot serialize object arrays")
         dt = obj.dtype.str.encode()     # e.g. b'<f4' — endian-explicit
         raw = np.ascontiguousarray(obj).tobytes()
         out += (b"a" + struct.pack("<B", len(dt)) + dt
@@ -217,7 +219,10 @@ class _Handler(socketserver.BaseRequestHandler):
         sock = self.request
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         try:
-            # challenge-response handshake before any command is accepted
+            # challenge-response handshake before any command is accepted;
+            # a short pre-auth timeout keeps a silent stranger from
+            # pinning this server thread forever
+            sock.settimeout(10.0)
             nonce = os.urandom(16)
             sock.sendall(_MAGIC + nonce)
             reply = _recv_exact(sock, 32)
@@ -226,6 +231,7 @@ class _Handler(socketserver.BaseRequestHandler):
                 sock.sendall(b"NO")  # explicit reject, then drop
                 return
             sock.sendall(b"OK")
+            sock.settimeout(None)
             while True:
                 cmd, args = _recv_msg(sock)
                 if cmd == "stop":
@@ -381,17 +387,20 @@ class PSClient:
             # reply would be read as the NEXT call's response)
             s.settimeout(120.0)
             s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            head = _recv_exact(s, 20)
-            if head[:4] != _MAGIC:
-                raise ConnectionError("bad PS handshake magic")
-            s.sendall(hmac.new(_auth_key(), head[4:],
-                               hashlib.sha256).digest())
-            ack = _recv_exact(s, 2)
-            if ack != b"OK":
+            try:
+                head = _recv_exact(s, 20)
+                if head[:4] != _MAGIC:
+                    raise ConnectionError("bad PS handshake magic")
+                s.sendall(hmac.new(_auth_key(), head[4:],
+                                   hashlib.sha256).digest())
+                ack = _recv_exact(s, 2)
+                if ack != b"OK":
+                    raise ConnectionError(
+                        "PS authentication failed — PADDLE_TPU_PS_TOKEN "
+                        f"does not match the server at {self.endpoints[i]}")
+            except BaseException:
                 s.close()
-                raise ConnectionError(
-                    "PS authentication failed — PADDLE_TPU_PS_TOKEN does "
-                    f"not match the server at {self.endpoints[i]}")
+                raise
             self._socks[i] = s
         return self._socks[i]
 

@@ -35,10 +35,23 @@ from ..core.op_registry import register_op
 
 _NEG_INF = -1e30
 
-# Block sizes: MXU-aligned (128 lanes); q/kv tiles of 128 keep the f32
-# accumulators + one k/v stream well under the ~16MB VMEM budget.
-_BLOCK_Q = 128
-_BLOCK_K = 128
+# Block sizes: MXU-aligned (128 lanes). Large tiles (up to 512) keep the
+# MXU fed — at 128 the per-invocation matmuls are only 2 MFLOP and grid
+# overhead dominates (measured 8.3ms vs 4.7ms XLA for one fwd+bwd at
+# b*h=384 s=512 d=64; 512-tiles with bf16 operands bring it under XLA).
+# VMEM check at 512: s tile f32 512*512*4 = 1MB + q/k/v streams << 16MB.
+_BLOCK_Q = 512
+_BLOCK_K = 512
+
+
+def _mm(a, b, ca: int, cb: int):
+    """Matmul contracting a's dim `ca` with b's dim `cb`, f32 accumulate.
+
+    dot_general instead of `a @ b.T` / `a.T @ b`: the MXU reads either
+    operand orientation natively, while an explicit .T materialises a
+    full-tile relayout before the matmul."""
+    return lax.dot_general(a, b, (((ca,), (cb,)), ((), ())),
+                           preferred_element_type=jnp.float32)
 
 
 def _cdiv(a: int, b: int) -> int:
@@ -47,6 +60,23 @@ def _cdiv(a: int, b: int) -> int:
 
 def _round_up(a: int, b: int) -> int:
     return _cdiv(a, b) * b
+
+
+def _block_q(sq: int) -> int:
+    return min(_BLOCK_Q, _round_up(sq, 128))
+
+
+def _block_k(sk: int) -> int:
+    return min(_BLOCK_K, _round_up(sk, 128))
+
+
+def _compiler_params(n_parallel: int):
+    """All grid dims of these kernels are independent (k/v arrive whole
+    per invocation); telling Mosaic lets it skip revisiting state."""
+    if not _HAS_PLTPU:
+        return None
+    return pltpu.CompilerParams(
+        dimension_semantics=("parallel",) * n_parallel)
 
 
 def _use_pallas(seq_q=None) -> bool:
@@ -60,17 +90,16 @@ def _use_pallas(seq_q=None) -> bool:
     if not flag("FLAGS_use_pallas"):
         return False
     if seq_q is not None and seq_q < _pallas_min_seq():
-        # at short sequence the s x s matrices are small: XLA's fused
-        # attention (bf16 matmuls + fused softmax) beats the blocked
-        # kernel, whose two-pass recompute backward only pays off once
-        # materialising s x s activations stops fitting — measured on
-        # v5e: ERNIE seq=512 full step 186ms (pallas) vs 133ms (XLA)
+        # below this the whole attention fits one XLA fusion; measured on
+        # v5e at seq>=128 the kernel already wins (seq=512 fwd+bwd per
+        # layer: pallas 2.6ms vs XLA 3.9-5.7ms), so the default gate is
+        # only the sub-tile regime
         return False
     return _HAS_PLTPU and jax.default_backend() == "tpu"
 
 
 def _pallas_min_seq() -> int:
-    return int(os.environ.get("PADDLE_TPU_FLASH_MIN_SEQ", "1024"))
+    return int(os.environ.get("PADDLE_TPU_FLASH_MIN_SEQ", "128"))
 
 
 def _interpret() -> bool:
@@ -109,7 +138,10 @@ def _fwd_kernel(qpos_ref, bhpos_ref, seed_ref, q_ref, k_ref, v_ref,
     bq, d = q_ref.shape[1], q_ref.shape[2]
     sk = k_ref.shape[1]
     nk = sk // block_k
-    q = q_ref[0].astype(jnp.float32) * scale
+    # operands stay bf16: the MXU natively multiplies bf16 with f32
+    # accumulation — casting to f32 first halves matmul throughput. The
+    # softmax scale moves onto the f32 scores instead of onto q.
+    q = q_ref[0]
     # block offset arrives via an SMEM input: pl.program_id fails to
     # re-trace under nested AD (jax 0.9), positions-as-data does not
     q_off = qpos_ref[0, 0, 0]
@@ -119,9 +151,9 @@ def _fwd_kernel(qpos_ref, bhpos_ref, seed_ref, q_ref, k_ref, v_ref,
 
     def body(t, carry):
         acc, m_i, l_i = carry
-        k = k_ref[0, pl.dslice(t * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.dslice(t * block_k, block_k), :].astype(jnp.float32)
-        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+        k = k_ref[0, pl.dslice(t * block_k, block_k), :]
+        v = v_ref[0, pl.dslice(t * block_k, block_k), :]
+        s = _mm(q, k, 1, 1) * scale
         k_idx = t * block_k + lax.broadcasted_iota(
             jnp.int32, (bq, block_k), 1)
         mask = k_idx < kv_len
@@ -142,7 +174,7 @@ def _fwd_kernel(qpos_ref, bhpos_ref, seed_ref, q_ref, k_ref, v_ref,
             pv = p * _drop_mask(seed, bh_idx, q_off, t * block_k,
                                 (bq, block_k), dropout_p)
         acc = acc * alpha[:, None] + jnp.dot(
-            pv, v, preferred_element_type=jnp.float32)
+            pv.astype(v.dtype), v, preferred_element_type=jnp.float32)
         return acc, m_new, l_new
 
     acc0 = jnp.zeros((bq, d), jnp.float32)
@@ -185,22 +217,21 @@ def _seed_input(seed):
 def _flash_fwd_pallas(q, k, v, seed, scale, causal, dropout_p):
     bh, sq, d = q.shape
     sk = k.shape[1]
-    nq = _cdiv(sq, _BLOCK_Q)
+    bq, bk = _block_q(sq), _block_k(sk)
+    nq = _cdiv(sq, bq)
     grid = (bh, nq)
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal, kv_len=sk,
-        block_k=min(_BLOCK_K, _round_up(sk, _BLOCK_K)),
-        causal_off=sk - sq, dropout_p=dropout_p)
-    sk_pad = _round_up(sk, _BLOCK_K)
-    sq_pad = nq * _BLOCK_Q
+        block_k=bk, causal_off=sk - sq, dropout_p=dropout_p)
+    sk_pad = _round_up(sk, bk)
+    sq_pad = nq * bq
     q = jnp.pad(q, ((0, 0), (0, sq_pad - sq), (0, 0)))
     k = jnp.pad(k, ((0, 0), (0, sk_pad - sk), (0, 0)))
     v = jnp.pad(v, ((0, 0), (0, sk_pad - sk), (0, 0)))
     vmem = pltpu.VMEM if _HAS_PLTPU else None
     bspec = lambda shape, imap: pl.BlockSpec(  # noqa: E731
         shape, imap, memory_space=vmem)
-    qpos, bhpos, pos_spec, bh_spec, seed_spec = _pos_inputs(
-        bh, nq, _BLOCK_Q)
+    qpos, bhpos, pos_spec, bh_spec, seed_spec = _pos_inputs(bh, nq, bq)
     seed_arr = _seed_input(seed)
     o, lse = pl.pallas_call(
         kernel,
@@ -209,18 +240,19 @@ def _flash_fwd_pallas(q, k, v, seed, scale, causal, dropout_p):
             pos_spec,
             bh_spec,
             seed_spec,
-            bspec((1, _BLOCK_Q, d), lambda i, j: (i, j, 0)),
+            bspec((1, bq, d), lambda i, j: (i, j, 0)),
             bspec((1, sk_pad, d), lambda i, j: (i, 0, 0)),
             bspec((1, sk_pad, d), lambda i, j: (i, 0, 0)),
         ],
         out_specs=[
-            bspec((1, _BLOCK_Q, d), lambda i, j: (i, j, 0)),
-            bspec((1, _BLOCK_Q, 128), lambda i, j: (i, j, 0)),
+            bspec((1, bq, d), lambda i, j: (i, j, 0)),
+            bspec((1, bq, 128), lambda i, j: (i, j, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, sq_pad, d), q.dtype),
             jax.ShapeDtypeStruct((bh, sq_pad, 128), jnp.float32),
         ],
+        compiler_params=_compiler_params(2),
         interpret=_interpret(),
     )(qpos, bhpos, seed_arr, q, k, v)
     return o[:, :sq], lse[:, :sq, 0]
@@ -238,8 +270,8 @@ def _bwd_dq_kernel(qpos_ref, bhpos_ref, seed_ref, q_ref, k_ref, v_ref,
     bq, d = q_ref.shape[1], q_ref.shape[2]
     sk = k_ref.shape[1]
     nk = sk // block_k
-    q = q_ref[0].astype(jnp.float32)
-    do = do_ref[0].astype(jnp.float32)
+    q = q_ref[0]
+    do = do_ref[0]
     lse = lse_ref[0, :, 0]
     delta = delta_ref[0, :, 0]
     q_off = qpos_ref[0, 0, 0]
@@ -248,20 +280,20 @@ def _bwd_dq_kernel(qpos_ref, bhpos_ref, seed_ref, q_ref, k_ref, v_ref,
     q_idx = q_off + lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
 
     def body(t, dq):
-        k = k_ref[0, pl.dslice(t * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.dslice(t * block_k, block_k), :].astype(jnp.float32)
-        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        k = k_ref[0, pl.dslice(t * block_k, block_k), :]
+        v = v_ref[0, pl.dslice(t * block_k, block_k), :]
+        s = _mm(q, k, 1, 1) * scale
         k_idx = t * block_k + lax.broadcasted_iota(
             jnp.int32, (bq, block_k), 1)
         mask = k_idx < kv_len
         if causal:
             mask = mask & (q_idx + causal_off >= k_idx)
         p = jnp.where(mask, jnp.exp(s - lse[:, None]), 0.0)
-        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        dp = _mm(do, v, 1, 1)
         if dropout_p > 0.0:
             dp = dp * _drop_mask(seed, bh_idx, q_off, t * block_k,
                                  (bq, block_k), dropout_p)
-        ds = p * (dp - delta[:, None])
+        ds = (p * (dp - delta[:, None])).astype(k.dtype)
         return dq + jnp.dot(ds, k, preferred_element_type=jnp.float32)
 
     dq = lax.fori_loop(0, nk, body, jnp.zeros((bq, d), jnp.float32))
@@ -274,8 +306,8 @@ def _bwd_dkv_kernel(kpos_ref, bhpos_ref, seed_ref, q_ref, k_ref, v_ref,
     bk, d = k_ref.shape[1], k_ref.shape[2]
     sq = q_ref.shape[1]
     nq = sq // block_q
-    k = k_ref[0].astype(jnp.float32)
-    v = v_ref[0].astype(jnp.float32)
+    k = k_ref[0]
+    v = v_ref[0]
     k_off = kpos_ref[0, 0, 0]
     bh_idx = bhpos_ref[0, 0, 0]
     seed = seed_ref[0, 0, 0]
@@ -283,11 +315,11 @@ def _bwd_dkv_kernel(kpos_ref, bhpos_ref, seed_ref, q_ref, k_ref, v_ref,
 
     def body(t, carry):
         dk, dv = carry
-        q = q_ref[0, pl.dslice(t * block_q, block_q), :].astype(jnp.float32)
-        do = do_ref[0, pl.dslice(t * block_q, block_q), :].astype(jnp.float32)
+        q = q_ref[0, pl.dslice(t * block_q, block_q), :]
+        do = do_ref[0, pl.dslice(t * block_q, block_q), :]
         lse = lse_ref[0, pl.dslice(t * block_q, block_q), 0]
         delta = delta_ref[0, pl.dslice(t * block_q, block_q), 0]
-        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        s = _mm(q, k, 1, 1) * scale
         q_idx = t * block_q + lax.broadcasted_iota(
             jnp.int32, (block_q, bk), 0)
         # padded q rows have lse=0 from the padded forward => exp(s) can
@@ -304,12 +336,12 @@ def _bwd_dkv_kernel(kpos_ref, bhpos_ref, seed_ref, q_ref, k_ref, v_ref,
         else:
             dmask = None
             pd = p
-        dv = dv + jnp.dot(pd.T, do, preferred_element_type=jnp.float32)
-        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        dv = dv + _mm(pd.astype(do.dtype), do, 0, 0)
+        dp = _mm(do, v, 1, 1)
         if dmask is not None:
             dp = dp * dmask
-        ds = p * (dp - delta[:, None])
-        dk = dk + jnp.dot(ds.T, q, preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta[:, None])).astype(q.dtype)
+        dk = dk + _mm(ds, q, 0, 0)
         return dk, dv
 
     dk0 = jnp.zeros((bk, d), jnp.float32)
@@ -323,9 +355,10 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, seed, scale, causal,
                       dropout_p):
     bh, sq, d = q.shape
     sk = k.shape[1]
-    nq = _cdiv(sq, _BLOCK_Q)
-    nk = _cdiv(sk, _BLOCK_K)
-    sq_pad, sk_pad = nq * _BLOCK_Q, nk * _BLOCK_K
+    bq, bk = _block_q(sq), _block_k(sk)
+    nq = _cdiv(sq, bq)
+    nk = _cdiv(sk, bk)
+    sq_pad, sk_pad = nq * bq, nk * bk
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
     qp = jnp.pad(q, ((0, 0), (0, sq_pad - sq), (0, 0)))
     kp = jnp.pad(k, ((0, 0), (0, sk_pad - sk), (0, 0)))
@@ -340,35 +373,35 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, seed, scale, causal,
     vmem = pltpu.VMEM if _HAS_PLTPU else None
     bspec = lambda shape, imap: pl.BlockSpec(  # noqa: E731
         shape, imap, memory_space=vmem)
-    qpos, bhpos, pos_spec_q, bh_spec, seed_spec = _pos_inputs(
-        bh, nq, _BLOCK_Q)
-    kpos, _, pos_spec_k, _, _ = _pos_inputs(bh, nk, _BLOCK_K)
+    qpos, bhpos, pos_spec_q, bh_spec, seed_spec = _pos_inputs(bh, nq, bq)
+    kpos, _, pos_spec_k, _, _ = _pos_inputs(bh, nk, bk)
     seed_arr = _seed_input(seed)
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                          kv_len=sk, block_k=_BLOCK_K, causal_off=sk - sq,
+                          kv_len=sk, block_k=bk, causal_off=sk - sq,
                           dropout_p=dropout_p),
         grid=(bh, nq),
         in_specs=[
             pos_spec_q,
             bh_spec,
             seed_spec,
-            bspec((1, _BLOCK_Q, d), lambda i, j: (i, j, 0)),
+            bspec((1, bq, d), lambda i, j: (i, j, 0)),
             bspec((1, sk_pad, d), lambda i, j: (i, 0, 0)),
             bspec((1, sk_pad, d), lambda i, j: (i, 0, 0)),
-            bspec((1, _BLOCK_Q, d), lambda i, j: (i, j, 0)),
-            bspec((1, _BLOCK_Q, 128), lambda i, j: (i, j, 0)),
-            bspec((1, _BLOCK_Q, 128), lambda i, j: (i, j, 0)),
+            bspec((1, bq, d), lambda i, j: (i, j, 0)),
+            bspec((1, bq, 128), lambda i, j: (i, j, 0)),
+            bspec((1, bq, 128), lambda i, j: (i, j, 0)),
         ],
-        out_specs=bspec((1, _BLOCK_Q, d), lambda i, j: (i, j, 0)),
+        out_specs=bspec((1, bq, d), lambda i, j: (i, j, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, sq_pad, d), q.dtype),
+        compiler_params=_compiler_params(2),
         interpret=_interpret(),
     )(qpos, bhpos, seed_arr, qp, kp, vp, dop, lsep, deltap)
 
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
-                          q_len=sq, block_q=_BLOCK_Q, causal_off=sk - sq,
+                          q_len=sq, block_q=bq, causal_off=sk - sq,
                           dropout_p=dropout_p),
         grid=(bh, nk),
         in_specs=[
@@ -376,20 +409,21 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, seed, scale, causal,
             bh_spec,
             seed_spec,
             bspec((1, sq_pad, d), lambda i, j: (i, 0, 0)),
-            bspec((1, _BLOCK_K, d), lambda i, j: (i, j, 0)),
-            bspec((1, _BLOCK_K, d), lambda i, j: (i, j, 0)),
+            bspec((1, bk, d), lambda i, j: (i, j, 0)),
+            bspec((1, bk, d), lambda i, j: (i, j, 0)),
             bspec((1, sq_pad, d), lambda i, j: (i, 0, 0)),
             bspec((1, sq_pad, 128), lambda i, j: (i, 0, 0)),
             bspec((1, sq_pad, 128), lambda i, j: (i, 0, 0)),
         ],
         out_specs=[
-            bspec((1, _BLOCK_K, d), lambda i, j: (i, j, 0)),
-            bspec((1, _BLOCK_K, d), lambda i, j: (i, j, 0)),
+            bspec((1, bk, d), lambda i, j: (i, j, 0)),
+            bspec((1, bk, d), lambda i, j: (i, j, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, sk_pad, d), k.dtype),
             jax.ShapeDtypeStruct((bh, sk_pad, d), v.dtype),
         ],
+        compiler_params=_compiler_params(2),
         interpret=_interpret(),
     )(kpos, bhpos, seed_arr, qp, kp, vp, dop, lsep, deltap)
     return dq[:, :sq], dk[:, :sk], dv[:, :sk]
