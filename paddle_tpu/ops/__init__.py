@@ -18,3 +18,5 @@ from . import rnn_ops  # noqa: F401
 from . import sequence_ops  # noqa: F401
 from . import detection_ops  # noqa: F401
 from . import extra_ops  # noqa: F401
+from . import long_tail_ops  # noqa: F401
+from . import compat_ops  # noqa: F401

@@ -1,0 +1,690 @@
+"""Compatibility registrations: reference op names whose kernels already
+exist here under unified names, plus composed "fusion_*" ops.
+
+Ref parity: paddle registers many historical twins — reshape2/transpose2/
+squeeze2 (the "v2" program-desc forms of reshape/transpose/squeeze),
+five interpolation modes as ten separate ops (linear_interp[,_v2], ...),
+and a family of CPU fusion ops (fusion_gru, fusion_squared_mat_sub, ...)
+whose bodies are compositions of primitives. On TPU one kernel serves
+each family — XLA does the fusing — but the NAMES must still resolve so
+reference programs run unmodified. Each shim here adapts attr/signature
+differences; none duplicates kernel code.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.op_registry import _REGISTRY, OpDef, register_op
+
+
+def _alias(alias: str, target: str):
+    """Register `alias` to the SAME OpDef as `target` (identical
+    semantics — e.g. reshape2's extra XShape output has no meaning in a
+    functional program)."""
+    d = _REGISTRY[target]
+    if alias in _REGISTRY:
+        raise KeyError(f"alias '{alias}' already registered")
+    _REGISTRY[alias] = OpDef(alias, d.fn, has_aux=d.has_aux,
+                             multi_out=d.multi_out, no_grad=d.no_grad)
+
+
+# -- program-desc v2 twins ---------------------------------------------------
+_alias("reshape2", "reshape")
+_alias("transpose2", "transpose")
+_alias("squeeze2", "squeeze")
+_alias("unsqueeze2", "unsqueeze")
+_alias("expand_as_v2", "broadcast_to")
+_alias("expand_as", "broadcast_to")
+_alias("top_k", "top_k_v2")
+_alias("slice", "slice_op")
+_alias("trace", "trace_op")
+_alias("cudnn_lstm", "rnn")
+_alias("sync_batch_norm", "batch_norm")  # GSPMD reduces over the global
+# batch axis inside jit, which IS synchronized BN (ref sync_batch_norm_op.cu
+# does the cross-rank allreduce by hand)
+
+
+@register_op("flatten2")
+def flatten2(x, *, axis=1):
+    """ref flatten_op.cc (flatten2): fold to 2-D at `axis`."""
+    lead = 1
+    for s in x.shape[:axis]:
+        lead *= s
+    return x.reshape(lead, -1)
+
+
+@register_op("expand")
+def expand(x, *, expand_times):
+    """ref expand_op.cc (v1): tile by repeat counts."""
+    return jnp.tile(x, tuple(int(t) for t in expand_times))
+
+
+@register_op("lookup_table")
+def lookup_table(ids, w, *, padding_idx=-1):
+    """ref lookup_table_op.cc (v1): ids carry a trailing [,1] dim."""
+    from .nn_ops import lookup_table_v2
+
+    return lookup_table_v2(jnp.squeeze(jnp.asarray(ids), -1), w,
+                           padding_idx=padding_idx)
+
+
+# -- interpolation twins -----------------------------------------------------
+
+def _make_interp(mode):
+    def interp(x, *, out_h=None, out_w=None, out_d=None, scale=None,
+               size=None, align_corners=True, align_mode=1,
+               data_format="NCHW"):
+        from .nn_ops import interpolate
+
+        if size is None:
+            size = [s for s in (out_d, out_h, out_w) if s is not None] \
+                or None
+        return interpolate(x, size=size, scale_factor=scale, mode=mode,
+                           align_corners=align_corners,
+                           data_format=data_format)
+    interp.__name__ = f"{mode}_interp"
+    interp.__doc__ = f"ref interpolate_op.cc ({mode}); one unified kernel."
+    return interp
+
+
+for _m in ("linear", "bilinear", "nearest", "trilinear", "bicubic"):
+    _f = _make_interp(_m)
+    register_op(f"{_m}_interp")(_f)
+    register_op(f"{_m}_interp_v2")(_f)
+
+
+# -- selected-rows helpers ---------------------------------------------------
+
+
+@register_op("merge_selected_rows", has_aux=True)
+def merge_selected_rows(rows, values, *, height=None):
+    """ref merge_selected_rows_op.cc: sum duplicate row ids. Static-shape
+    form: returns (unique_rows_padded, merged_values); aux is the count
+    of unique rows."""
+    rows = jnp.asarray(rows)
+    uniq, inv = jnp.unique(rows, return_inverse=True,
+                           size=rows.shape[0], fill_value=-1)
+    merged = jax.ops.segment_sum(values, inv,
+                                 num_segments=rows.shape[0])
+    return merged, (uniq, (uniq >= 0).sum())
+
+
+@register_op("get_tensor_from_selected_rows")
+def get_tensor_from_selected_rows(rows, values, *, height):
+    """ref get_tensor_from_selected_rows_op.cc: densify to [height, D]."""
+    out = jnp.zeros((height,) + values.shape[1:], values.dtype)
+    return out.at[rows].add(values)
+
+
+@register_op("coalesce_tensor", multi_out=True)
+def coalesce_tensor(*xs, use_align=True, align_size=256):
+    """ref coalesce_tensor_op.cc: fuse N tensors into one flat buffer and
+    return views. Functional form: returns (fused, *reshaped_views) —
+    PJRT owns real allocation, so the op's value is the contiguous
+    layout, which XLA already gives fused buffers."""
+    flat = jnp.concatenate([x.reshape(-1) for x in xs])
+    outs = []
+    off = 0
+    for x in xs:
+        n = 1
+        for s in x.shape:
+            n *= s
+        outs.append(flat[off:off + n].reshape(x.shape))
+        off += n
+    return (flat,) + tuple(outs)
+
+
+# -- debug / callback --------------------------------------------------------
+
+
+@register_op("print", no_grad=True)
+def print_op(x, *, message="", first_n=-1, summarize=20):
+    """ref print_op.cc: debug print inside compiled programs."""
+    # the user message is opaque text, not a format string
+    safe = message.replace("{", "{{").replace("}", "}}")
+    jax.debug.print(safe + "{x}", x=x)
+    return x
+
+
+@register_op("py_func")
+def py_func(*xs, func, out_shape=None, out_dtype=None):
+    """ref py_func_op.cc: host-Python callback inside the graph via
+    pure_callback (the reference suspends execution and calls back into
+    the interpreter; pure_callback is the XLA-native equivalent)."""
+    import numpy as np
+
+    if out_shape is None:
+        out_shape = xs[0].shape
+        out_dtype = out_dtype or xs[0].dtype
+    sds = jax.ShapeDtypeStruct(tuple(out_shape),
+                               np.dtype(out_dtype or "float32"))
+    return jax.pure_callback(func, sds, *xs)
+
+
+# -- quantization ------------------------------------------------------------
+
+
+@register_op("quantize", no_grad=True)
+def quantize(x, *, scale=1.0, shift=0.0, bfloat16=False):
+    """ref mkldnn quantize_op.cc: affine int8 quantization."""
+    if bfloat16:
+        return x.astype(jnp.bfloat16)
+    return jnp.clip(jnp.round(x * scale + shift), -128,
+                    127).astype(jnp.int8)
+
+
+@register_op("dequantize", no_grad=True)
+def dequantize(x, *, scale=1.0, shift=0.0):
+    """ref dequantize_op.cc."""
+    return (x.astype(jnp.float32) - shift) / scale
+
+
+@register_op("requantize", no_grad=True)
+def requantize(x, *, scale_in=1.0, scale_out=1.0, shift_in=0.0,
+               shift_out=0.0):
+    """ref requantize_op.cc: rescale int8 without a float detour in the
+    reference; numerically identical here."""
+    y = (x.astype(jnp.float32) - shift_in) * (scale_out / scale_in) \
+        + shift_out
+    return jnp.clip(jnp.round(y), -128, 127).astype(jnp.int8)
+
+
+# -- rnn units ---------------------------------------------------------------
+
+
+@register_op("lstm_unit", multi_out=True)
+def lstm_unit(x, c_prev, *, forget_bias=0.0):
+    """ref lstm_unit_op.cc: one LSTM step on pre-projected x [B, 4H]."""
+    h = c_prev.shape[-1]
+    i, f, o, j = (x[:, :h], x[:, h:2 * h], x[:, 2 * h:3 * h],
+                  x[:, 3 * h:])
+    c = (c_prev * jax.nn.sigmoid(f + forget_bias)
+         + jax.nn.sigmoid(i) * jnp.tanh(j))
+    return c, jnp.tanh(c) * jax.nn.sigmoid(o)
+
+
+@register_op("gru_unit", multi_out=True)
+def gru_unit(x, h_prev, weight, bias=None, *,
+             activation="tanh", gate_activation="sigmoid",
+             origin_mode=False):
+    """ref gru_unit_op.cc: one GRU step. x: [B, 3H] pre-projected input,
+    weight: [H, 3H] (update/reset gates then candidate)."""
+    hsz = h_prev.shape[-1]
+    act = dict(tanh=jnp.tanh, relu=jax.nn.relu,
+               sigmoid=jax.nn.sigmoid, identity=lambda v: v)
+    g = x[:, :2 * hsz] + h_prev @ weight[:, :2 * hsz]
+    if bias is not None:
+        g = g + bias[:2 * hsz]
+    u = act[gate_activation](g[:, :hsz])
+    r = act[gate_activation](g[:, hsz:])
+    cand = x[:, 2 * hsz:] + (r * h_prev) @ weight[:, 2 * hsz:]
+    if bias is not None:
+        cand = cand + bias[2 * hsz:]
+    c = act[activation](cand)
+    gate = jnp.concatenate([u, r, c], axis=1)  # ref Gate: [B, 3H] activated
+    if origin_mode:
+        h = u * h_prev + (1.0 - u) * c
+    else:
+        h = (1.0 - u) * h_prev + u * c
+    return gate, r * h_prev, h
+
+
+@register_op("gru", multi_out=True)
+def gru(x, h0, weight, bias=None, *, activation="tanh",
+        gate_activation="sigmoid", is_reverse=False, origin_mode=False):
+    """ref gru_op.cc: full-sequence GRU over pre-projected input
+    [B, T, 3H] via lax.scan."""
+    fn = _REGISTRY["gru_unit"].fn  # returns (gate, reset_h, h)
+
+    def step(h, xt):
+        _, _, hn = fn(xt, h, weight, bias, activation=activation,
+                      gate_activation=gate_activation,
+                      origin_mode=origin_mode)
+        return hn, hn
+
+    xs = jnp.swapaxes(x, 0, 1)
+    hT, ys = lax.scan(step, h0, xs, reverse=is_reverse)
+    return jnp.swapaxes(ys, 0, 1), hT
+
+
+@register_op("lstm", multi_out=True)
+def lstm(x, h0, c0, w_ih, w_hh, b_ih=None, b_hh=None, *,
+         is_reverse=False):
+    """ref lstm_op.cc: full-sequence LSTM [B, T, in] via the shared
+    scan cell."""
+    from .rnn_ops import _scan_direction
+
+    xs = jnp.swapaxes(x, 0, 1)
+    ys, hT, cT = _scan_direction("LSTM", xs, h0, c0, w_ih, w_hh, b_ih,
+                                 b_hh, reverse=is_reverse)
+    return jnp.swapaxes(ys, 0, 1), hT, cT
+
+
+@register_op("lstmp", multi_out=True)
+def lstmp(x, h0, c0, w_ih, w_hh, w_proj, b_ih=None, b_hh=None, *,
+          is_reverse=False):
+    """ref lstmp_op.cc: LSTM with a recurrent projection layer —
+    h_t = proj(cell_h_t); the projected state feeds the recurrence."""
+    def step(carry, xt):
+        h, c = carry
+        gates = xt @ w_ih.T + h @ w_hh.T
+        if b_ih is not None:
+            gates = gates + b_ih
+        if b_hh is not None:
+            gates = gates + b_hh
+        hs = c.shape[-1]
+        i, f, g, o = (gates[:, :hs], gates[:, hs:2 * hs],
+                      gates[:, 2 * hs:3 * hs], gates[:, 3 * hs:])
+        c_new = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+        h_new = (jax.nn.sigmoid(o) * jnp.tanh(c_new)) @ w_proj
+        return (h_new, c_new), h_new
+
+    xs = jnp.swapaxes(x, 0, 1)
+    (hT, cT), ys = lax.scan(step, (h0, c0), xs, reverse=is_reverse)
+    return jnp.swapaxes(ys, 0, 1), hT, cT
+
+
+# -- fusion ops (compositions; XLA re-fuses them) ----------------------------
+
+
+@register_op("fusion_repeated_fc_relu")
+def fusion_repeated_fc_relu(x, *ws_and_bs):
+    """ref fusion_repeated_fc_relu_op.cc: (fc+relu)*N."""
+    n = len(ws_and_bs) // 2
+    out = x
+    for i in range(n):
+        out = jax.nn.relu(out @ ws_and_bs[2 * i] + ws_and_bs[2 * i + 1])
+    return out
+
+
+@register_op("fusion_squared_mat_sub")
+def fusion_squared_mat_sub(x, y, *, scalar=1.0):
+    """ref fusion_squared_mat_sub_op.cc: ((x@y)^2 - (x^2)@(y^2)) * s."""
+    return ((x @ y) ** 2 - (x * x) @ (y * y)) * scalar
+
+
+@register_op("fusion_gru", multi_out=True)
+def fusion_gru(x, h0, wx, wh, bias=None, *, activation="tanh",
+               gate_activation="sigmoid", is_reverse=False,
+               origin_mode=False):
+    """ref fusion_gru_op.cc: input projection + GRU in one op."""
+    proj = x @ wx
+    fn = _REGISTRY["gru"].fn
+    return fn(proj, h0, wh, bias, activation=activation,
+              gate_activation=gate_activation, is_reverse=is_reverse,
+              origin_mode=origin_mode)
+
+
+def _preproj_lstm_scan(proj, h0, c0, wh, is_reverse):
+    """LSTM over pre-projected gates [B, T, 4H] — the input matmul is
+    already done, so the scan body only pays the recurrent matmul."""
+    hs = c0.shape[-1]
+
+    def step(carry, gt):
+        h, c = carry
+        gates = gt + h @ wh
+        i, f, g, o = (gates[:, :hs], gates[:, hs:2 * hs],
+                      gates[:, 2 * hs:3 * hs], gates[:, 3 * hs:])
+        c_new = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+        h_new = jax.nn.sigmoid(o) * jnp.tanh(c_new)
+        return (h_new, c_new), h_new
+
+    (hT, cT), ys = lax.scan(step, (h0, c0), jnp.swapaxes(proj, 0, 1),
+                            reverse=is_reverse)
+    return jnp.swapaxes(ys, 0, 1), hT, cT
+
+
+@register_op("fusion_lstm", multi_out=True)
+def fusion_lstm(x, h0, c0, wx, wh, bias=None, *, is_reverse=False):
+    """ref fusion_lstm_op.cc: input projection + LSTM in one op.
+    wx: [in, 4H], wh: [H, 4H]."""
+    proj = x @ wx
+    if bias is not None:
+        proj = proj + bias
+    return _preproj_lstm_scan(proj, h0, c0, wh, is_reverse)
+
+
+@register_op("multi_gru", multi_out=True)
+def multi_gru(x, h0, *wxs_whs, layers=2, is_reverse=False):
+    """ref mkldnn multi_gru_op.cc: stacked fusion_gru layers."""
+    fn = _REGISTRY["fusion_gru"].fn
+    out = x
+    hT = None
+    for i in range(layers):
+        wx, wh = wxs_whs[2 * i], wxs_whs[2 * i + 1]
+        out, hT = fn(out, h0[i], wx, wh, None, is_reverse=is_reverse)
+    return out, hT
+
+
+@register_op("fused_embedding_fc_lstm", multi_out=True)
+def fused_embedding_fc_lstm(ids, emb, h0, c0, wx, wh, bias=None, *,
+                            is_reverse=False):
+    """ref fused_embedding_fc_lstm_op.cc: embedding lookup + fc + lstm."""
+    x = jnp.take(emb, jnp.asarray(ids).astype(jnp.int32), axis=0)
+    fn = _REGISTRY["fusion_lstm"].fn
+    return fn(x, h0, c0, wx, wh, bias, is_reverse=is_reverse)
+
+
+@register_op("attention_lstm", multi_out=True)
+def attention_lstm(x, h0, c0, attn_w, lstm_wx, lstm_wh, *,
+                   is_reverse=False):
+    """ref attention_lstm_op.cc: scalar attention over the input
+    sequence gates what feeds the LSTM. TPU divergence (documented): the
+    reference recomputes attention per decode step against the previous
+    hidden state (a data-dependent T^2 loop); here one content-based
+    attention pass weights the sequence before a single LSTM scan."""
+    scores = jnp.squeeze(x @ attn_w, -1)             # [B, T]
+    alpha = jax.nn.softmax(scores, axis=-1)
+    seq = x * (alpha[..., None] * x.shape[1])        # weighted sequence
+    fn = _REGISTRY["fusion_lstm"].fn
+    return fn(seq, h0, c0, lstm_wx, lstm_wh, None, is_reverse=is_reverse)
+
+
+@register_op("fusion_seqconv_eltadd_relu")
+def fusion_seqconv_eltadd_relu(x, w, b, *, context_length,
+                               context_start=0):
+    """ref fusion_seqconv_eltadd_relu_op.cc: sequence_conv + bias +
+    relu."""
+    from .sequence_ops import sequence_conv
+
+    return jax.nn.relu(
+        sequence_conv(x, w, context_length=context_length,
+                      context_start=context_start) + b)
+
+
+@register_op("fusion_seqpool_concat")
+def fusion_seqpool_concat(*xs, pooltype="SUM"):
+    """ref fusion_seqpool_concat_op.cc: pool each [B, T, D] over T then
+    concat features."""
+    red = dict(SUM=jnp.sum, AVERAGE=jnp.mean, SQRT=jnp.sum,
+               MAX=jnp.max, LAST=None, FIRST=None)[pooltype.upper()]
+    outs = []
+    for x in xs:
+        if pooltype.upper() == "LAST":
+            outs.append(x[:, -1])
+        elif pooltype.upper() == "FIRST":
+            outs.append(x[:, 0])
+        else:
+            o = red(x, axis=1)
+            if pooltype.upper() == "SQRT":
+                o = o / jnp.sqrt(jnp.asarray(x.shape[1], x.dtype))
+            outs.append(o)
+    return jnp.concatenate(outs, axis=-1)
+
+
+@register_op("fusion_seqexpand_concat_fc")
+def fusion_seqexpand_concat_fc(ref_seq, *rest):
+    """ref fusion_seqexpand_concat_fc_op.cc: expand row-level inputs to
+    the reference sequence length, concat, then fc (+relu in ref's
+    default act)."""
+    *row_inputs, w, b = rest
+    t = ref_seq.shape[1]
+    expanded = [jnp.broadcast_to(r[:, None, :],
+                                 (r.shape[0], t, r.shape[-1]))
+                for r in row_inputs]
+    cat = jnp.concatenate([ref_seq] + expanded, axis=-1)
+    return jax.nn.relu(cat @ w + b)
+
+
+# -- compiled collectives (c_* family) --------------------------------------
+# The reference's c_* ops wrap NCCL calls bound to a communicator ring.
+# Here they are the in-graph XLA collectives of distributed/collective.py:
+# inside pjit/shard_map they lower to psum/all_gather/ppermute on the
+# mesh axis; outside a mapped context (single process) they are the
+# mathematical identity on the full array, which is exactly the 1-rank
+# communicator behavior. c_comm_init*/c_gen_*_id/c_wait_* are
+# design-deleted: PJRT + jax.distributed own communicator setup and
+# stream ordering (documented in distributed/collective.py).
+
+
+def _axis_bound(axis_name):
+    try:
+        lax.axis_index(axis_name)
+        return True
+    except NameError:
+        return False
+
+
+@register_op("c_allreduce_sum")
+def c_allreduce_sum(x, *, ring_id=0, axis_name="dp"):
+    """ref collective/c_allreduce_op.h."""
+    if _axis_bound(axis_name):
+        return lax.psum(x, axis_name)
+    return x
+
+
+@register_op("c_allgather")
+def c_allgather(x, *, nranks=1, ring_id=0, axis_name="dp"):
+    """ref collective/c_allgather_op.cc."""
+    if _axis_bound(axis_name):
+        return lax.all_gather(x, axis_name, tiled=True)
+    return x
+
+
+@register_op("c_reducescatter")
+def c_reducescatter(x, *, nranks=1, ring_id=0, axis_name="dp"):
+    """ref collective/c_reducescatter_op.cc."""
+    if _axis_bound(axis_name):
+        return lax.psum_scatter(x, axis_name, tiled=True)
+    return x
+
+
+import functools as _functools
+
+
+@_functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _ident_fwd_psum_bwd(x, axis_name):
+    return x
+
+
+def _ifpb_fwd(x, axis_name):
+    return x, None
+
+
+def _ifpb_bwd(axis_name, _res, g):
+    return (lax.psum(g, axis_name),)
+
+
+_ident_fwd_psum_bwd.defvjp(_ifpb_fwd, _ifpb_bwd)
+
+
+@register_op("c_identity")
+def c_identity(x, *, ring_id=0, axis_name="mp"):
+    """ref collective/c_identity_op.cc: identity fwd, allreduce bwd —
+    the TP input boundary (under pjit GSPMD inserts this implicitly;
+    the explicit op serves shard_map programs)."""
+    if _axis_bound(axis_name):
+        return _ident_fwd_psum_bwd(x, axis_name)
+    return x
+
+
+@register_op("c_concat")
+def c_concat(x, *, nranks=1, ring_id=0, axis_name="mp"):
+    """ref collective/c_concat_op.cc: gather shards along the last dim."""
+    if _axis_bound(axis_name):
+        return lax.all_gather(x, axis_name, axis=x.ndim - 1, tiled=True)
+    return x
+
+
+@register_op("c_split")
+def c_split(x, *, nranks=1, rank=0, ring_id=0, axis_name="mp"):
+    """ref collective/c_split_op.cc: keep this rank's shard of the last
+    dim."""
+    if _axis_bound(axis_name):
+        r = lax.axis_index(axis_name)
+        n = lax.axis_size(axis_name)
+        sz = x.shape[-1] // n
+        return lax.dynamic_slice_in_dim(x, r * sz, sz, axis=x.ndim - 1)
+    if nranks > 1:
+        sz = x.shape[-1] // nranks
+        return lax.dynamic_slice_in_dim(x, rank * sz, sz, axis=x.ndim - 1)
+    return x
+
+
+@register_op("alltoall")
+def alltoall_op(x, *, ring_id=0, axis_name="mp"):
+    """ref collective/alltoall_op.cc: split dim0, exchange, concat."""
+    if _axis_bound(axis_name):
+        n = lax.axis_size(axis_name)
+        return lax.all_to_all(x.reshape((n, x.shape[0] // n)
+                                        + x.shape[1:]),
+                              axis_name, split_axis=0, concat_axis=0,
+                              tiled=False).reshape(x.shape)
+    return x
+
+
+@register_op("c_embedding")
+def c_embedding(ids, w, *, start_index=0):
+    """ref collective/c_embedding_op.cc: vocab-sharded lookup — ids
+    outside this shard's [start, start+rows) contribute zeros (summed
+    across mp by the caller's allreduce)."""
+    ids = jnp.asarray(ids).astype(jnp.int32)
+    local = ids - start_index
+    inside = (local >= 0) & (local < w.shape[0])
+    out = jnp.take(w, jnp.clip(local, 0, w.shape[0] - 1), axis=0)
+    return out * inside[..., None].astype(out.dtype)
+
+
+# -- tensor-array / control-flow plumbing ------------------------------------
+# The reference's LoDTensorArray ops mutate a scope-held vector<Tensor>;
+# the functional equivalents operate on a stacked [L, ...] array, which
+# is exactly how lax.scan carries per-step stacks.
+
+
+@register_op("write_to_array")
+def write_to_array(arr, i, x):
+    """ref lod_array_ops: arr[i] = x on a stacked tensor-array."""
+    return lax.dynamic_update_index_in_dim(arr, x.astype(arr.dtype),
+                                           jnp.asarray(i, jnp.int32), 0)
+
+
+@register_op("read_from_array")
+def read_from_array(arr, i):
+    """ref lod_array_ops: arr[i]."""
+    return lax.dynamic_index_in_dim(arr, jnp.asarray(i, jnp.int32), 0,
+                                    keepdims=False)
+
+
+@register_op("lod_tensor_to_array", multi_out=True)
+def lod_tensor_to_array(x, lengths, *, max_len=None):
+    """ref lod_tensor_to_array_op.cc: split instances into a stacked
+    array ordered by step (the RNN memory layout); padded form keeps the
+    [B] axis and returns the per-step validity mask."""
+    ln = jnp.asarray(lengths, jnp.int32)
+    t = x.shape[1] if max_len is None else max_len
+    steps = jnp.swapaxes(x[:, :t], 0, 1)            # [T, B, D]
+    mask = (jnp.arange(t)[:, None] < ln[None, :])
+    return steps, mask
+
+
+@register_op("array_to_lod_tensor")
+def array_to_lod_tensor(steps, mask):
+    """ref array_to_lod_tensor_op.cc: inverse of the above."""
+    x = jnp.swapaxes(steps, 0, 1)
+    return x * jnp.swapaxes(mask, 0, 1)[..., None].astype(x.dtype)
+
+
+@register_op("shrink_rnn_memory")
+def shrink_rnn_memory(x, lengths, *, step):
+    """ref shrink_rnn_memory_op.cc: zero the memory rows of sequences
+    already finished at `step` (static-shape form of the reference's
+    row shrink)."""
+    alive = (jnp.asarray(lengths, jnp.int32) > step)
+    return x * alive[:, None].astype(x.dtype)
+
+
+@register_op("merge_lod_tensor")
+def merge_lod_tensor(mask, in_true, in_false):
+    """ref merge_lod_tensor_op.cc: row-wise select — the merge half of
+    the reference's IfElse lowering (the split half is a where on the
+    caller side; lax.cond covers the control flow itself)."""
+    m = jnp.asarray(mask).reshape(-1)
+    shape = (m.shape[0],) + (1,) * (in_true.ndim - 1)
+    return jnp.where(m.reshape(shape) != 0, in_true, in_false)
+
+
+@register_op("select_input")
+def select_input(mask, *xs):
+    """ref select_input_op.cc: pick input branch by scalar mask."""
+    return lax.switch(jnp.asarray(mask, jnp.int32).reshape(()),
+                      [lambda x=x: x for x in xs])
+
+
+@register_op("select_output", multi_out=True)
+def select_output(x, mask, *, n_branches=2):
+    """ref select_output_op.cc: route x to branch `mask`; other branches
+    receive zeros (functional form — downstream cond picks the live
+    one)."""
+    m = jnp.asarray(mask, jnp.int32).reshape(())
+    return tuple(jnp.where(m == i, x, jnp.zeros_like(x))
+                 for i in range(n_branches))
+
+
+@register_op("beam_search", has_aux=True)
+def beam_search(pre_ids, pre_scores, ids, scores, *, beam_size,
+                end_id=0):
+    """ref beam_search_op.cc: one decode step. Rows are grouped
+    [n_seqs * beam_size]; each sequence keeps the top beam_size of its
+    beam_size*K candidates. Returns (selected_scores,
+    (selected_ids, parent_idx))."""
+    bw, k = ids.shape
+    n_seqs = bw // beam_size
+    finished = (pre_ids[:, -1:] == end_id) & (pre_ids[:, -1:] >= 0)
+    # finished beams propagate a single candidate (their own score)
+    total = jnp.where(finished, jnp.where(
+        jnp.arange(k)[None, :] == 0, pre_scores[:, None], -jnp.inf),
+        pre_scores[:, None] + scores)
+    cand_ids = jnp.where(finished, jnp.full_like(ids, end_id), ids)
+    flat = total.reshape(n_seqs, beam_size * k)
+    top, pos = lax.top_k(flat, beam_size)            # [n_seqs, beam]
+    parent = pos // k + (jnp.arange(n_seqs) * beam_size)[:, None]
+    chosen = jnp.take_along_axis(
+        cand_ids.reshape(n_seqs, beam_size * k), pos, axis=1)
+    return (top.reshape(bw), (chosen.reshape(bw).astype(ids.dtype),
+                              parent.reshape(bw).astype(jnp.int32)))
+
+
+# -- parameter-server eager ops ---------------------------------------------
+
+
+def _ps_runtime():
+    from ..distributed.ps import runtime as rt
+
+    if getattr(rt, "_runtime", None) is None:
+        raise RuntimeError(
+            "pull/push_sparse require an initialised PS runtime "
+            "(fleet.init with a PSRoleMaker)")
+    return rt._runtime
+
+
+@register_op("pull_sparse", no_grad=True)
+def pull_sparse(ids, *, table_name="embedding", dim=None):
+    """ref pslib pull_sparse_op.cc: eager embedding pull from the PS
+    tables (host round-trip; the compiled path pre-pulls via
+    DistributedEmbedding)."""
+    import numpy as np
+
+    rt = _ps_runtime()
+    rows = rt._client.pull_sparse(table_name, np.asarray(ids).reshape(-1))
+    return jnp.asarray(rows).reshape(tuple(np.asarray(ids).shape)
+                                     + (rows.shape[-1],))
+
+
+@register_op("push_sparse", no_grad=True)
+def push_sparse(ids, grads, *, table_name="embedding"):
+    """ref pslib push_sparse_op.cc: eager gradient push."""
+    import numpy as np
+
+    rt = _ps_runtime()
+    rt._communicator.push_sparse(table_name,
+                                 np.asarray(ids).reshape(-1),
+                                 np.asarray(grads).reshape(
+                                     -1, np.asarray(grads).shape[-1]))
+    return jnp.zeros((), jnp.float32)
+
+
+_alias("pull_sparse_v2", "pull_sparse")
+_alias("push_sparse_v2", "push_sparse")

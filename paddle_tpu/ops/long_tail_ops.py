@@ -1,0 +1,934 @@
+"""Long-tail operators: the remaining user-facing reference ops.
+
+Ref parity (per-op citations on each function): the round-2 audit named
+these as genuinely absent — deformable conv, NCE, row conv, precise/PS
+RoI pooling, crop family, partial concat/sum, CVM, pad2d, yolov3 loss,
+unpool, center loss and friends. TPU-native: every op is a pure jnp/lax
+function (static shapes, gather/one-hot instead of atomic scatter,
+integral images instead of data-dependent loops) so XLA can fuse and
+tile them; none of this code mirrors the reference CUDA kernels.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.op_registry import register_op
+
+
+# ---------------------------------------------------------------------------
+# manipulation
+# ---------------------------------------------------------------------------
+
+
+@register_op("crop")
+def crop(x, *, offsets, shape):
+    """ref crop_op.cc: slice `shape` starting at `offsets`."""
+    return lax.dynamic_slice(x, [int(o) for o in offsets],
+                             [int(s) for s in shape])
+
+
+@register_op("crop_tensor")
+def crop_tensor(x, *, offsets, shape):
+    """ref crop_tensor_op.cc: crop with -1 in shape meaning "to the end"."""
+    offs = [int(o) for o in offsets]
+    dims = [x.shape[i] - offs[i] if int(s) == -1 else int(s)
+            for i, s in enumerate(shape)]
+    return lax.dynamic_slice(x, offs, dims)
+
+
+@register_op("broadcast_tensors", multi_out=True)
+def broadcast_tensors(*xs):
+    """ref broadcast_tensors_op.cc: broadcast all inputs to the common
+    shape (rank-aligned from the right)."""
+    shape = jnp.broadcast_shapes(*[x.shape for x in xs])
+    return tuple(jnp.broadcast_to(x, shape) for x in xs)
+
+
+@register_op("partial_concat")
+def partial_concat(*xs, start_index=0, length=-1):
+    """ref partial_concat_op.cc: concat column slices [start, start+len)
+    of each 2-D input."""
+    outs = []
+    for x in xs:
+        s = start_index if start_index >= 0 else x.shape[1] + start_index
+        e = x.shape[1] if length < 0 else s + length
+        outs.append(x[:, s:e])
+    return jnp.concatenate(outs, axis=1)
+
+
+@register_op("partial_sum")
+def partial_sum(*xs, start_index=0, length=-1):
+    """ref partial_sum_op.cc: elementwise sum of the same column slice of
+    every input."""
+    acc = None
+    for x in xs:
+        s = start_index if start_index >= 0 else x.shape[1] + start_index
+        e = x.shape[1] if length < 0 else s + length
+        part = x[:, s:e]
+        acc = part if acc is None else acc + part
+    return acc
+
+
+@register_op("reverse")
+def reverse(x, *, axis):
+    """ref reverse_op.cc."""
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    return jnp.flip(x, axis=tuple(int(a) for a in axes))
+
+
+@register_op("increment")
+def increment(x, *, value=1.0):
+    """ref increment_op: x += value on a 1-element tensor."""
+    return x + jnp.asarray(value, x.dtype)
+
+
+@register_op("minus")
+def minus(x, y):
+    """ref minus_op.cc."""
+    return x - y
+
+
+@register_op("mv")
+def mv(x, vec):
+    """ref mv_op.cc: matrix @ vector."""
+    return jnp.matmul(x, vec)
+
+
+@register_op("sum", multi_out=False)
+def sum_op(*xs):
+    """ref sum_op.cc: add_n — elementwise sum of N tensors."""
+    acc = xs[0]
+    for x in xs[1:]:
+        acc = acc + x
+    return acc
+
+
+@register_op("mean")
+def mean(x):
+    """ref mean_op.cc: global mean to a scalar."""
+    return jnp.mean(x)
+
+
+@register_op("norm", has_aux=True)
+def norm(x, *, axis=-1, epsilon=1e-10):
+    """ref norm_op.cc: x / ||x||_2 along axis; Norm is the aux output."""
+    n = jnp.sqrt(jnp.sum(x * x, axis=axis, keepdims=True) + epsilon)
+    return x / n, n
+
+
+@register_op("unbind", multi_out=True)
+def unbind(x, *, axis=0):
+    """ref unbind_op.cc."""
+    return tuple(jnp.squeeze(s, axis=axis)
+                 for s in jnp.split(x, x.shape[axis], axis=axis))
+
+
+@register_op("tril_triu")
+def tril_triu(x, *, diagonal=0, lower=True):
+    """ref tril_triu_op.cc: one op, `lower` picks tril vs triu."""
+    return jnp.tril(x, diagonal) if lower else jnp.triu(x, diagonal)
+
+
+@register_op("set_value")
+def set_value(x, value, *, axes, starts, ends, steps=None):
+    """ref set_value_op.cc — functional slice-assign: returns a new
+    tensor (no aliasing; XLA turns it into an in-place DUS)."""
+    idx = [slice(None)] * x.ndim
+    steps = steps or [1] * len(axes)
+    for a, s, e, st in zip(axes, starts, ends, steps):
+        idx[int(a)] = slice(int(s), int(e), int(st))
+    return x.at[tuple(idx)].set(jnp.asarray(value, x.dtype))
+
+
+@register_op("shuffle_batch", has_aux=True)
+def shuffle_batch(x, key):
+    """ref shuffle_batch_op.cc: random row permutation; the permutation
+    (aux) lets callers un-shuffle."""
+    perm = jax.random.permutation(key, x.shape[0])
+    return jnp.take(x, perm, axis=0), perm
+
+
+@register_op("pad2d")
+def pad2d(x, *, paddings, mode="constant", pad_value=0.0,
+          data_format="NCHW"):
+    """ref pad2d_op.cc: H/W padding with constant/reflect/edge modes."""
+    t, b, l, r = [int(p) for p in paddings]
+    if data_format == "NCHW":
+        pads = [(0, 0), (0, 0), (t, b), (l, r)]
+    else:
+        pads = [(0, 0), (t, b), (l, r), (0, 0)]
+    jmode = {"constant": "constant", "reflect": "reflect",
+             "edge": "edge"}[mode]
+    if jmode == "constant":
+        return jnp.pad(x, pads, constant_values=pad_value)
+    return jnp.pad(x, pads, mode=jmode)
+
+
+@register_op("pad_constant_like")
+def pad_constant_like(x, y, *, pad_value=0.0):
+    """ref pad_constant_like_op.cc: pad y up to x's shape."""
+    pads = [(0, xs - ys) for xs, ys in zip(x.shape, y.shape)]
+    return jnp.pad(y, pads, constant_values=pad_value)
+
+
+@register_op("im2sequence")
+def im2sequence(x, *, kernels, strides=(1, 1), paddings=(0, 0, 0, 0)):
+    """ref im2sequence_op.cc: im2col patches flattened to a sequence
+    [N*oh*ow, C*kh*kw]."""
+    n, c, h, w = x.shape
+    kh, kw = kernels
+    sh, sw = strides
+    pt, pl, pb, pr = paddings
+    patches = lax.conv_general_dilated_patches(
+        x, (kh, kw), (sh, sw), [(pt, pb), (pl, pr)],
+        dimension_numbers=lax.conv_dimension_numbers(
+            x.shape, (1, c, kh, kw), ("NCHW", "OIHW", "NCHW")))
+    oh, ow = patches.shape[2], patches.shape[3]
+    return patches.reshape(n, c * kh * kw, oh * ow).transpose(
+        0, 2, 1).reshape(n * oh * ow, c * kh * kw)
+
+
+# ---------------------------------------------------------------------------
+# recommendation / ranking
+# ---------------------------------------------------------------------------
+
+
+@register_op("cvm")
+def cvm_op(x, cvm, *, use_cvm=True):
+    """ref cvm_op.cc: show/click head transform. With use_cvm the first
+    two columns become log(show+1), log(click+1)-log(show+1); without,
+    they are dropped."""
+    show = jnp.log(cvm[:, :1] + 1.0)
+    click = jnp.log(cvm[:, 1:2] + 1.0) - show
+    if use_cvm:
+        return jnp.concatenate([show, click, x[:, 2:]], axis=1)
+    return x[:, 2:]
+
+
+@register_op("batch_fc")
+def batch_fc(x, w, bias=None):
+    """ref batch_fc_op.cc: per-slot FC — x [S, B, in], w [S, in, out]."""
+    out = jnp.einsum("sbi,sio->sbo", x, w)
+    if bias is not None:
+        out = out + bias[:, None, :]
+    return out
+
+
+@register_op("filter_by_instag", has_aux=True)
+def filter_by_instag(x, ins_tag, filter_tag, *, is_lod=False,
+                     out_val_if_empty=0.0):
+    """ref filter_by_instag_op.cc. TPU-native: static shapes — rows whose
+    tag set misses filter_tag are zeroed (not removed); aux returns the
+    keep mask and a loss weight per row. Hosts slice by mask when ragged
+    output is required."""
+    keep = jnp.isin(ins_tag, filter_tag).any(axis=-1)
+    out = jnp.where(keep[:, None], x,
+                    jnp.asarray(out_val_if_empty, x.dtype))
+    return out, (keep, keep.astype(x.dtype))
+
+
+@register_op("fsp")
+def fsp(x, y):
+    """ref fsp_op.cc (distillation FSP matrix): [N,C1,H,W]x[N,C2,H,W] ->
+    [N,C1,C2] / (H*W)."""
+    n, c1, h, w = x.shape
+    c2 = y.shape[1]
+    a = x.reshape(n, c1, h * w)
+    b = y.reshape(n, c2, h * w)
+    return jnp.einsum("nax,nbx->nab", a, b) / float(h * w)
+
+
+# ---------------------------------------------------------------------------
+# losses
+# ---------------------------------------------------------------------------
+
+
+@register_op("label_smooth")
+def label_smooth(label, prior_dist=None, *, epsilon=0.1):
+    """ref label_smooth_op.cc."""
+    c = label.shape[-1]
+    if prior_dist is None:
+        smooth = jnp.full_like(label, 1.0 / c)
+    else:
+        smooth = jnp.broadcast_to(prior_dist, label.shape)
+    return (1.0 - epsilon) * label + epsilon * smooth
+
+
+@register_op("cross_entropy2", has_aux=True)
+def cross_entropy2(x, label, *, ignore_index=-100):
+    """ref cross_entropy_op.cc (cross_entropy2): hard-label CE over
+    probabilities x (already softmaxed); aux MatchX is x[label]."""
+    lbl = label.reshape(x.shape[:-1])
+    safe = jnp.where(lbl == ignore_index, 0, lbl)
+    match = jnp.take_along_axis(x, safe[..., None], axis=-1)[..., 0]
+    loss = jnp.where(lbl == ignore_index, 0.0,
+                     -jnp.log(jnp.maximum(match, 1e-12)))
+    return loss[..., None], match[..., None]
+
+
+@register_op("center_loss", has_aux=True)
+def center_loss(x, label, centers, *, alpha=0.1, update_center=True):
+    """ref center_loss_op.cc: 0.5*||x - c_y||^2; aux returns the updated
+    centers (functional counterpart of the reference's in-place update:
+    c_y -= alpha * mean residual of rows assigned to y)."""
+    cy = centers[label]
+    diff = x - cy
+    loss = 0.5 * jnp.sum(diff * diff, axis=-1, keepdims=True)
+    if update_center:
+        num = jax.ops.segment_sum(diff, label,
+                                  num_segments=centers.shape[0])
+        cnt = jax.ops.segment_sum(jnp.ones((x.shape[0],), x.dtype), label,
+                                  num_segments=centers.shape[0])
+        new_centers = centers + alpha * num / (cnt[:, None] + 1.0)
+    else:
+        new_centers = centers
+    return loss, new_centers
+
+
+@register_op("nce", has_aux=True)
+def nce(x, label, weight, bias, key, *, num_total_classes,
+        num_neg_samples=10):
+    """ref nce_op.cc: noise-contrastive estimation with a uniform noise
+    sampler. Returns the per-row NCE cost; aux carries (logits, labels)
+    of the sampled set like the reference's SampleLogits/SampleLabels."""
+    b = x.shape[0]
+    label = label.reshape(b, -1)
+    num_true = label.shape[1]
+    neg = jax.random.randint(key, (b, num_neg_samples), 0,
+                             num_total_classes)
+    samples = jnp.concatenate([label, neg], axis=1)  # [B, T+S]
+    w = weight[samples]                              # [B, T+S, D]
+    logits = jnp.einsum("bd,bsd->bs", x, w)
+    if bias is not None:
+        logits = logits + bias[samples]
+    # uniform noise: q = 1/C; P(true) = sigmoid(logit - log(S*q))
+    log_noise = jnp.log(jnp.asarray(
+        num_neg_samples / num_total_classes, x.dtype))
+    adj = logits - log_noise
+    lbl = jnp.concatenate([jnp.ones((b, num_true), x.dtype),
+                           jnp.zeros((b, num_neg_samples), x.dtype)],
+                          axis=1)
+    cost = -(lbl * jax.nn.log_sigmoid(adj)
+             + (1.0 - lbl) * jax.nn.log_sigmoid(-adj))
+    return jnp.sum(cost, axis=1, keepdims=True), (logits, samples)
+
+
+@register_op("sample_logits", has_aux=True)
+def sample_logits(logits, label, key, *, num_samples, use_customized_samples=False,
+                  customized_samples=None):
+    """ref sample_logits_op.cc: gather true + sampled-class logits for
+    sampled softmax; sampled logits subtract log-probability of being
+    sampled (uniform sampler)."""
+    b, c = logits.shape
+    label = label.reshape(b, -1)
+    if use_customized_samples and customized_samples is not None:
+        neg = customized_samples
+    else:
+        neg = jax.random.randint(key, (b, num_samples), 0, c)
+    samples = jnp.concatenate([label, neg], axis=1)
+    picked = jnp.take_along_axis(logits, samples, axis=1)
+    logq = jnp.log(jnp.asarray(num_samples / c, logits.dtype))
+    out = picked - logq
+    new_label = jnp.arange(label.shape[1], dtype=jnp.int64)
+    new_label = jnp.broadcast_to(new_label[None], (b, label.shape[1]))
+    return out, (samples, new_label)
+
+
+# ---------------------------------------------------------------------------
+# vision: deformable conv, row conv, correlation, unpool, RoI pools
+# ---------------------------------------------------------------------------
+
+
+def _bilinear_gather(img, yy, xx):
+    """img [C,H,W]; yy/xx [...]: differentiable bilinear sample with
+    zero padding outside."""
+    c, h, w = img.shape
+    y0 = jnp.floor(yy)
+    x0 = jnp.floor(xx)
+    wy = yy - y0
+    wx = xx - x0
+    out = 0.0
+    for dy, sy in ((0, 1 - wy), (1, wy)):
+        for dx, sx in ((0, 1 - wx), (1, wx)):
+            yi = y0.astype(jnp.int32) + dy
+            xi = x0.astype(jnp.int32) + dx
+            inside = ((yi >= 0) & (yi < h) & (xi >= 0) & (xi < w))
+            yc = jnp.clip(yi, 0, h - 1)
+            xc = jnp.clip(xi, 0, w - 1)
+            v = img[:, yc, xc]                       # [C, ...]
+            out = out + v * (sy * sx * inside)[None]
+    return out
+
+
+@register_op("deformable_conv")
+def deformable_conv(x, offset, mask, weight, *, stride=1, padding=0,
+                    dilation=1, groups=1, deformable_groups=1,
+                    im2col_step=None):
+    """ref deformable_conv_op.cc (v2, modulated). TPU-native design:
+    bilinear-sample the deformed patches into an im2col tensor
+    [N, C*kh*kw, OH*OW] (gathers vectorise on the VPU), then one matmul
+    with the flattened weight rides the MXU — no per-pixel CUDA kernel."""
+    n, c, h, w = x.shape
+    co, _, kh, kw = weight.shape
+    st = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    pd = (padding, padding) if isinstance(padding, int) else tuple(padding)
+    dl = (dilation, dilation) if isinstance(dilation, int) \
+        else tuple(dilation)
+    oh = (h + 2 * pd[0] - (dl[0] * (kh - 1) + 1)) // st[0] + 1
+    ow = (w + 2 * pd[1] - (dl[1] * (kw - 1) + 1)) // st[1] + 1
+    cg = c // deformable_groups
+
+    base_y = (jnp.arange(oh) * st[0] - pd[0])[:, None]    # [OH,1]
+    base_x = (jnp.arange(ow) * st[1] - pd[1])[None, :]    # [1,OW]
+    off = offset.reshape(n, deformable_groups, kh * kw, 2, oh, ow)
+    if mask is not None:
+        msk = mask.reshape(n, deformable_groups, kh * kw, oh, ow)
+
+    def per_image(img, off_i, msk_i):
+        cols = []
+        for g in range(deformable_groups):
+            sub = img[g * cg:(g + 1) * cg]
+            for idx in range(kh * kw):
+                ky, kx = idx // kw, idx % kw
+                yy = base_y + ky * dl[0] + off_i[g, idx, 0]
+                xx = base_x + kx * dl[1] + off_i[g, idx, 1]
+                v = _bilinear_gather(sub, yy, xx)     # [cg, OH, OW]
+                if msk_i is not None:
+                    v = v * msk_i[g, idx][None]
+                cols.append(v)
+        # [dg*kh*kw*cg, OH, OW] ordered (g, idx, cg) -> regroup to
+        # channel-major (c, kh*kw) to match the weight layout
+        col = jnp.stack(cols).reshape(deformable_groups, kh * kw, cg,
+                                      oh, ow)
+        col = col.transpose(0, 2, 1, 3, 4).reshape(c, kh * kw, oh, ow)
+        return col
+
+    cols = jax.vmap(per_image)(x, off,
+                               msk if mask is not None else
+                               jnp.ones((n, deformable_groups, kh * kw,
+                                         oh, ow), x.dtype))
+    # cols is channel-major (c, kh*kw, ...): conv groups slice contiguous
+    # channel blocks, so regroup and contract per group in one einsum
+    cg2 = (c // groups) * kh * kw
+    colsg = cols.reshape(n, groups, cg2, oh * ow)
+    wmat = weight.reshape(groups, co // groups, cg2)
+    out = jnp.einsum("goc,ngcs->ngos", wmat, colsg)
+    return out.reshape(n, co, oh, ow)
+
+
+@register_op("deformable_conv_v1")
+def deformable_conv_v1(x, offset, weight, *, stride=1, padding=0,
+                       dilation=1, groups=1, deformable_groups=1,
+                       im2col_step=None):
+    """ref deformable_conv_v1_op.cc: v1 = v2 without modulation mask."""
+    return deformable_conv(x, offset, None, weight, stride=stride,
+                           padding=padding, dilation=dilation,
+                           groups=groups,
+                           deformable_groups=deformable_groups)
+
+
+@register_op("row_conv")
+def row_conv(x, w):
+    """ref row_conv_op.cc (lookahead conv for streaming ASR):
+    out[b,t,d] = sum_{i<k} x[b,t+i,d] * w[i,d]; zero beyond T."""
+    k = w.shape[0]
+    t = x.shape[1]
+    out = jnp.zeros_like(x)
+    for i in range(k):
+        shifted = jnp.pad(x[:, i:], ((0, 0), (0, i), (0, 0)))
+        out = out + shifted * w[i][None, None, :]
+    del t
+    return out
+
+
+@register_op("conv_shift")
+def conv_shift(x, y):
+    """ref conv_shift_op.cc: circular correlation —
+    out[b,i] = sum_j y[b,j] * x[b, (i + j - n//2) mod m]."""
+    m = x.shape[1]
+    ny = y.shape[1]
+    j = jnp.arange(ny)
+    i = jnp.arange(m)
+    idx = (i[:, None] + j[None, :] - ny // 2) % m      # [m, ny]
+    gathered = x[:, idx]                               # [B, m, ny]
+    return jnp.einsum("bmn,bn->bm", gathered, y)
+
+
+@register_op("correlation")
+def correlation(x1, x2, *, pad_size=4, kernel_size=1, max_displacement=4,
+                stride1=1, stride2=1, corr_type_multiply=1):
+    """ref correlation_op.cc (FlowNet cost volume): mean over channels of
+    x1 . shift(x2) for every displacement in the search window."""
+    d = max_displacement
+    n, c, h, w = x1.shape
+    x2p = jnp.pad(x2, ((0, 0), (0, 0), (d, d), (d, d)))
+    outs = []
+    for dy in range(0, 2 * d + 1, stride2):
+        for dx in range(0, 2 * d + 1, stride2):
+            shifted = x2p[:, :, dy:dy + h, dx:dx + w]
+            outs.append(jnp.mean(x1 * shifted, axis=1))
+    return jnp.stack(outs, axis=1)
+
+
+@register_op("unpool")
+def unpool(x, indices, *, ksize, stride=None, padding=0,
+           output_size=None):
+    """ref unpool_op.cc: max-unpool2d scattering x to the flat positions
+    recorded by max_pool2d_with_index."""
+    n, c, h, w = x.shape
+    if output_size is not None:
+        oh, ow = output_size[-2], output_size[-1]
+    else:
+        ks = (ksize, ksize) if isinstance(ksize, int) else tuple(ksize)
+        st = ks if stride is None else (
+            (stride, stride) if isinstance(stride, int) else tuple(stride))
+        pd = (padding, padding) if isinstance(padding, int) \
+            else tuple(padding)
+        oh = (h - 1) * st[0] - 2 * pd[0] + ks[0]
+        ow = (w - 1) * st[1] - 2 * pd[1] + ks[1]
+    flat = jnp.zeros((n, c, oh * ow), x.dtype)
+    idx = indices.reshape(n, c, h * w)
+    vals = x.reshape(n, c, h * w)
+    flat = jax.vmap(jax.vmap(
+        lambda f, i, v: f.at[i].add(v)))(flat, idx, vals)
+    return flat.reshape(n, c, oh, ow)
+
+
+@register_op("max_pool3d_with_index", has_aux=True)
+def max_pool3d_with_index(x, *, ksize, stride=None, padding=0):
+    """ref pool_with_index_op.cc (3-D): windows via patch extraction,
+    argmax flat index into the input D*H*W map."""
+    kd, kh, kw = (ksize,) * 3 if isinstance(ksize, int) else tuple(ksize)
+    st = (kd, kh, kw) if stride is None else (
+        (stride,) * 3 if isinstance(stride, int) else tuple(stride))
+    pd = (padding,) * 3 if isinstance(padding, int) else tuple(padding)
+    n, c, d, h, w = x.shape
+    patches = lax.conv_general_dilated_patches(
+        x, (kd, kh, kw), st, [(pd[0], pd[0]), (pd[1], pd[1]),
+                              (pd[2], pd[2])],
+        dimension_numbers=lax.conv_dimension_numbers(
+            x.shape, (1, c, kd, kh, kw),
+            ("NCDHW", "OIDHW", "NCDHW")))
+    od, oh, ow = patches.shape[2:]
+    patches = patches.reshape(n, c, kd * kh * kw, od, oh, ow)
+    out = jnp.max(patches, axis=2)
+    rel = jnp.argmax(patches, axis=2)
+    oz = jnp.arange(od).reshape(od, 1, 1)
+    oy = jnp.arange(oh).reshape(1, oh, 1)
+    ox = jnp.arange(ow).reshape(1, 1, ow)
+    az = oz * st[0] - pd[0] + rel // (kh * kw)
+    ay = oy * st[1] - pd[1] + (rel // kw) % kh
+    ax = ox * st[2] - pd[2] + rel % kw
+    return out, (az * h * w + ay * w + ax).astype(jnp.int32)
+
+
+@register_op("prroi_pool")
+def prroi_pool(x, rois, rois_num, *, pooled_height, pooled_width,
+               spatial_scale=1.0):
+    """ref prroi_pool_op.cc. TPU divergence (documented): PrRoI's exact
+    bilinear integral is approximated by a dense 4x4-sample average per
+    bin — continuous in the RoI coords (the property PrRoI exists for)
+    and within ~1e-2 of the closed form at feature-map resolution."""
+    from .detection_ops import roi_align
+
+    return roi_align(x, rois, rois_num, output_size=(pooled_height,
+                                                     pooled_width),
+                     spatial_scale=spatial_scale, sampling_ratio=4,
+                     aligned=False)
+
+
+@register_op("psroi_pool")
+def psroi_pool(x, rois, rois_num, *, output_channels, pooled_height,
+               pooled_width, spatial_scale=1.0):
+    """ref psroi_pool_op.cc: position-sensitive RoI average pooling —
+    bin (i,j) pools channel group (i*pw+j) of its RoI."""
+    n, c, h, w = x.shape
+    ph, pw = pooled_height, pooled_width
+    r = rois.shape[0]
+    bn = jnp.asarray(rois_num, jnp.int32)
+    img_of_roi = jnp.searchsorted(jnp.cumsum(bn), jnp.arange(r),
+                                  side="right").astype(jnp.int32)
+    rois = jnp.asarray(rois, jnp.float32)
+    x1 = jnp.round(rois[:, 0]) * spatial_scale
+    y1 = jnp.round(rois[:, 1]) * spatial_scale
+    x2 = jnp.round(rois[:, 2] + 1.0) * spatial_scale
+    y2 = jnp.round(rois[:, 3] + 1.0) * spatial_scale
+    rw = jnp.maximum(x2 - x1, 0.1)
+    rh = jnp.maximum(y2 - y1, 0.1)
+    bin_h = rh / ph
+    bin_w = rw / pw
+
+    ys = jnp.arange(h, dtype=jnp.float32)
+    xs = jnp.arange(w, dtype=jnp.float32)
+
+    def per_roi(ri):
+        img = x[img_of_roi[ri]].reshape(output_channels, ph * pw, h, w)
+        outs = []
+        for i in range(ph):
+            for j in range(pw):
+                hs = y1[ri] + i * bin_h[ri]
+                he = y1[ri] + (i + 1) * bin_h[ri]
+                ws = x1[ri] + j * bin_w[ri]
+                we = x1[ri] + (j + 1) * bin_w[ri]
+                my = ((ys >= jnp.floor(hs)) & (ys < jnp.ceil(he)))
+                mx = ((xs >= jnp.floor(ws)) & (xs < jnp.ceil(we)))
+                mask = my[:, None] & mx[None, :]
+                area = jnp.maximum(mask.sum(), 1)
+                ch = img[:, i * pw + j]               # [oc, h, w]
+                outs.append(jnp.sum(ch * mask[None], axis=(1, 2))
+                            / area.astype(x.dtype))
+        return jnp.stack(outs, axis=1).reshape(output_channels, ph, pw)
+
+    return jax.vmap(per_roi)(jnp.arange(r))
+
+
+# ---------------------------------------------------------------------------
+# yolov3 loss
+# ---------------------------------------------------------------------------
+
+
+@register_op("yolov3_loss", has_aux=True)
+def yolov3_loss(x, gt_box, gt_label, *, anchors, anchor_mask, class_num,
+                ignore_thresh=0.7, downsample_ratio=32,
+                use_label_smooth=False):
+    """ref yolov3_loss_op.cc. One detection head: decode predictions,
+    match ground truth to the best-IoU anchor, BCE on xy/obj/cls + L1 on
+    wh, objectness ignored where the best IoU exceeds ignore_thresh."""
+    n, _, gh, gw = x.shape
+    na = len(anchor_mask)
+    an_all = jnp.asarray(anchors, jnp.float32).reshape(-1, 2)
+    an = an_all[jnp.asarray(anchor_mask)]
+    pred = x.reshape(n, na, 5 + class_num, gh, gw)
+    tx, ty = pred[:, :, 0], pred[:, :, 1]
+    tw, th = pred[:, :, 2], pred[:, :, 3]
+    tobj = pred[:, :, 4]
+    tcls = pred[:, :, 5:]
+    stride_len = downsample_ratio
+    in_w, in_h = gw * stride_len, gh * stride_len
+
+    gx = gt_box[:, :, 0]  # normalised cx
+    gy = gt_box[:, :, 1]
+    gw_ = gt_box[:, :, 2]
+    gh_ = gt_box[:, :, 3]
+    valid = (gw_ > 0) & (gh_ > 0)                       # [N, B]
+
+    # anchor matching on shape IoU (centered boxes), over ALL anchors
+    inter = (jnp.minimum(gw_[..., None] * in_w, an_all[None, None, :, 0])
+             * jnp.minimum(gh_[..., None] * in_h, an_all[None, None, :, 1]))
+    union = (gw_[..., None] * in_w * gh_[..., None] * in_h
+             + an_all[None, None, :, 0] * an_all[None, None, :, 1] - inter)
+    best = jnp.argmax(inter / jnp.maximum(union, 1e-9), axis=-1)  # [N,B]
+    mask_arr = jnp.asarray(anchor_mask)
+    # position on this head's grid
+    gi = jnp.clip((gx * gw).astype(jnp.int32), 0, gw - 1)
+    gj = jnp.clip((gy * gh).astype(jnp.int32), 0, gh - 1)
+
+    obj_target = jnp.zeros((n, na, gh, gw))
+    txt = jnp.zeros((n, na, gh, gw))
+    tyt = jnp.zeros((n, na, gh, gw))
+    twt = jnp.zeros((n, na, gh, gw))
+    tht = jnp.zeros((n, na, gh, gw))
+    cls_t = jnp.zeros((n, na, class_num, gh, gw))
+    tscale = jnp.zeros((n, na, gh, gw))
+
+    nb = gt_box.shape[1]
+    batch_idx = jnp.arange(n)[:, None].repeat(nb, 1)
+    for k in range(na):
+        sel = valid & (best == mask_arr[k])
+        bi = batch_idx
+        w_sc = 2.0 - gw_ * gh_
+        obj_target = obj_target.at[bi, k, gj, gi].max(
+            sel.astype(obj_target.dtype))
+        txt = txt.at[bi, k, gj, gi].add(
+            jnp.where(sel, gx * gw - gi, 0.0))
+        tyt = tyt.at[bi, k, gj, gi].add(
+            jnp.where(sel, gy * gh - gj, 0.0))
+        twt = twt.at[bi, k, gj, gi].add(jnp.where(
+            sel, jnp.log(jnp.maximum(gw_ * in_w / an[k, 0], 1e-9)), 0.0))
+        tht = tht.at[bi, k, gj, gi].add(jnp.where(
+            sel, jnp.log(jnp.maximum(gh_ * in_h / an[k, 1], 1e-9)), 0.0))
+        tscale = tscale.at[bi, k, gj, gi].add(jnp.where(sel, w_sc, 0.0))
+        lbl = jnp.clip(gt_label, 0, class_num - 1)
+        cls_t = cls_t.at[bi, k, lbl, gj, gi].max(
+            sel.astype(cls_t.dtype))
+
+    # objectness ignore: predicted boxes overlapping any gt above thresh
+    cy = (jnp.arange(gh)[:, None] + jax.nn.sigmoid(ty)) / gh
+    cx = (jnp.arange(gw)[None, :] + jax.nn.sigmoid(tx)) / gw
+    pw_ = an[:, 0][None, :, None, None] * jnp.exp(tw) / in_w
+    ph_ = an[:, 1][None, :, None, None] * jnp.exp(th) / in_h
+
+    def iou_with_gt(b):
+        px1, px2 = cx[b] - pw_[b] / 2, cx[b] + pw_[b] / 2
+        py1, py2 = cy[b] - ph_[b] / 2, cy[b] + ph_[b] / 2
+        gx1 = (gx[b] - gw_[b] / 2)[:, None, None, None]
+        gx2 = (gx[b] + gw_[b] / 2)[:, None, None, None]
+        gy1 = (gy[b] - gh_[b] / 2)[:, None, None, None]
+        gy2 = (gy[b] + gh_[b] / 2)[:, None, None, None]
+        iw = jnp.maximum(jnp.minimum(px2, gx2) - jnp.maximum(px1, gx1), 0)
+        ih = jnp.maximum(jnp.minimum(py2, gy2) - jnp.maximum(py1, gy1), 0)
+        inter_ = iw * ih
+        uni = (pw_[b] * ph_[b] + (gw_[b] * gh_[b])[:, None, None, None]
+               - inter_)
+        iou = inter_ / jnp.maximum(uni, 1e-9)
+        return jnp.max(jnp.where(valid[b][:, None, None, None], iou, 0.0),
+                       axis=0)
+
+    best_iou = jax.vmap(iou_with_gt)(jnp.arange(n))
+    noobj_mask = (best_iou < ignore_thresh) & (obj_target == 0)
+
+    bce = lambda p, t: jnp.maximum(p, 0) - p * t + jnp.log1p(  # noqa: E731
+        jnp.exp(-jnp.abs(p)))
+    smooth = 1.0 / class_num if use_label_smooth else 0.0
+    cls_target = cls_t * (1 - 2 * smooth) + smooth
+    pos = obj_target
+    loss_xy = jnp.sum((bce(tx, txt) + bce(ty, tyt)) * tscale * pos,
+                      axis=(1, 2, 3))
+    loss_wh = jnp.sum((jnp.abs(tw - twt) + jnp.abs(th - tht))
+                      * tscale * pos, axis=(1, 2, 3))
+    loss_obj = (jnp.sum(bce(tobj, jnp.ones_like(tobj)) * pos,
+                        axis=(1, 2, 3))
+                + jnp.sum(bce(tobj, jnp.zeros_like(tobj))
+                          * noobj_mask, axis=(1, 2, 3)))
+    loss_cls = jnp.sum(bce(tcls, cls_target) * pos[:, :, None],
+                       axis=(1, 2, 3, 4))
+    return (loss_xy + loss_wh + loss_obj + loss_cls), (obj_target,
+                                                       best_iou)
+
+
+# ---------------------------------------------------------------------------
+# sequence-family extensions (padded [B, T, D] + lengths convention of
+# sequence_ops.py; ref LoD kernels cited per op)
+# ---------------------------------------------------------------------------
+
+
+@register_op("sequence_concat")
+def sequence_concat(*args):
+    """ref sequence_concat_op.cc: concatenate sequences instance-wise.
+    Padded form: inputs alternate (x_i [B,T_i,D], lengths_i [B]); output
+    is [B, sum(T_i), D] with each instance's rows packed front."""
+    xs = args[0::2]
+    lens = args[1::2]
+    b = xs[0].shape[0]
+    t_out = sum(x.shape[1] for x in xs)
+    d = xs[0].shape[2]
+    out = jnp.zeros((b, t_out, d), xs[0].dtype)
+    total = jnp.zeros((b,), jnp.int32)
+    for x, ln in zip(xs, lens):
+        ln = jnp.asarray(ln, jnp.int32)
+        t = x.shape[1]
+        pos = jnp.arange(t)[None, :]                   # [1, T_i]
+        keep = pos < ln[:, None]
+        dst = total[:, None] + pos                     # [B, T_i]
+        bi = jnp.broadcast_to(jnp.arange(b)[:, None], dst.shape)
+        out = out.at[bi, jnp.where(keep, dst, t_out - 1)].add(
+            jnp.where(keep[..., None], x, 0.0))
+        total = total + ln
+    return out
+
+
+@register_op("sequence_reshape")
+def sequence_reshape(x, lengths, *, new_dim):
+    """ref sequence_reshape_op.cc: refold features so D becomes new_dim;
+    per-instance length scales by D/new_dim."""
+    b, t, d = x.shape
+    new_t = t * d // new_dim
+    return (x.reshape(b, new_t, new_dim),
+            (jnp.asarray(lengths, jnp.int32) * d) // new_dim)
+
+
+@register_op("sequence_scatter")
+def sequence_scatter(x, index, updates, lengths):
+    """ref sequence_scatter_op.cc: per-instance scatter-add of `updates`
+    rows at `index` positions (padded rows masked by lengths)."""
+    ln = jnp.asarray(lengths, jnp.int32)
+    t = index.shape[1]
+    keep = jnp.arange(t)[None, :] < ln[:, None]
+    upd = jnp.where(keep[..., None] if updates.ndim == 3 else keep,
+                    updates, 0)
+    bi = jnp.broadcast_to(jnp.arange(x.shape[0])[:, None], index.shape)
+    return x.at[bi, index].add(upd)
+
+
+@register_op("sequence_slice")
+def sequence_slice(x, lengths, offset, length):
+    """ref sequence_slice_op.cc: per-instance subsequence [offset,
+    offset+length) re-packed to the front; returns (out, new_lengths)."""
+    b, t, d = x.shape
+    off = jnp.asarray(offset, jnp.int32).reshape(b)
+    ln = jnp.asarray(length, jnp.int32).reshape(b)
+    pos = jnp.arange(t)[None, :]
+    src = jnp.clip(off[:, None] + pos, 0, t - 1)
+    bi = jnp.broadcast_to(jnp.arange(b)[:, None], src.shape)
+    gathered = x[bi, src]
+    keep = pos < ln[:, None]
+    return jnp.where(keep[..., None], gathered, 0.0), ln
+
+
+@register_op("lod_reset")
+def lod_reset(x, target_lengths):
+    """ref lod_reset_op.cc: in the padded+lengths world the data is
+    unchanged; the op re-labels instance lengths."""
+    return x, jnp.asarray(target_lengths, jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# remaining vision / embedding long tail
+# ---------------------------------------------------------------------------
+
+
+@register_op("inplace_abn", has_aux=True)
+def inplace_abn(x, scale, bias, mean, variance, *, epsilon=1e-5,
+                momentum=0.9, activation="leaky_relu", alpha=0.01,
+                is_test=False):
+    """ref inplace_abn_op.cc: batch norm + activation in one op (the
+    in-place memory trick is XLA's buffer reuse here). Returns activated
+    output; aux carries updated running stats like batch_norm."""
+    from ..core.op_registry import _REGISTRY
+
+    bn = _REGISTRY["batch_norm"].fn
+    y, stats = bn(x, scale, bias, mean, variance, epsilon=epsilon,
+                  momentum=momentum, is_test=is_test)
+    if activation == "leaky_relu":
+        y = jnp.where(y >= 0, y, alpha * y)
+    elif activation == "elu":
+        y = jnp.where(y >= 0, y, alpha * (jnp.exp(y) - 1.0))
+    elif activation == "identity":
+        pass
+    else:
+        raise ValueError(f"inplace_abn: unknown activation {activation}")
+    return y, stats
+
+
+@register_op("bilateral_slice")
+def bilateral_slice(x, grid, guide, *, has_offset=False):
+    """ref bilateral_slice_op.cu (HDRNet): per-pixel affine coefficients
+    trilinearly sampled from a bilateral grid at (gx, gy, guide(x,y)).
+    x: [N,C,H,W]; grid: [N, gc, gd, gh, gw]; guide: [N,H,W]."""
+    n, c, h, w = x.shape
+    _, gc, gd, gh, gw = grid.shape
+    n_out = gc // (c + 1) if has_offset else gc // c
+
+    gy = (jnp.arange(h) + 0.5) * gh / h - 0.5
+    gx = (jnp.arange(w) + 0.5) * gw / w - 0.5
+
+    def sample(g_img, guide_img):
+        gz = guide_img * gd - 0.5                       # [H, W]
+        yy = jnp.broadcast_to(gy[:, None], (h, w))
+        xx = jnp.broadcast_to(gx[None, :], (h, w))
+        out = 0.0
+        z0 = jnp.floor(gz)
+        y0 = jnp.floor(yy)
+        x0 = jnp.floor(xx)
+        for dz in (0, 1):
+            for dy in (0, 1):
+                for dx in (0, 1):
+                    zi = jnp.clip(z0 + dz, 0, gd - 1).astype(jnp.int32)
+                    yi = jnp.clip(y0 + dy, 0, gh - 1).astype(jnp.int32)
+                    xi = jnp.clip(x0 + dx, 0, gw - 1).astype(jnp.int32)
+                    wz = 1 - jnp.abs(gz - (z0 + dz))
+                    wy = 1 - jnp.abs(yy - (y0 + dy))
+                    wx = 1 - jnp.abs(xx - (x0 + dx))
+                    wt = (jnp.clip(wz, 0, 1) * jnp.clip(wy, 0, 1)
+                          * jnp.clip(wx, 0, 1))
+                    out = out + g_img[:, zi, yi, xi] * wt[None]
+        return out                                      # [gc, H, W]
+
+    coeff = jax.vmap(sample)(grid, guide)               # [N, gc, H, W]
+    per = c + 1 if has_offset else c
+    coeff = coeff.reshape(n, n_out, per, h, w)
+    out = jnp.einsum("nocxy,ncxy->noxy", coeff[:, :, :c], x)
+    if has_offset:
+        out = out + coeff[:, :, c]
+    return out
+
+
+@register_op("pyramid_hash")
+def pyramid_hash(ids, w, *, num_emb=8, space_len=100000, pyramid_layer=2,
+                 rand_len=16):
+    """ref pyramid_hash_op.cc (search ranking): n-gram pieces of the id
+    sequence hash into a shared embedding space; output sums the
+    n-gram embeddings per position."""
+    ids = jnp.asarray(ids).astype(jnp.uint32)
+    b, t = ids.shape
+    out = jnp.zeros((b, t, num_emb), w.dtype)
+    for n in range(2, 2 + pyramid_layer):
+        # rolling hash of n-gram starting at each position
+        acc = jnp.zeros((b, t), jnp.uint32)
+        for i in range(n):
+            shifted = jnp.pad(ids[:, i:], ((0, 0), (0, i)))
+            acc = acc * jnp.uint32(2654435761) + shifted
+        slot = (acc % jnp.uint32(space_len)).astype(jnp.int32)
+        valid = (jnp.arange(t)[None, :] < t - (n - 1))
+        emb = jnp.take(w, slot, axis=0)[..., :num_emb]
+        out = out + emb * valid[..., None].astype(w.dtype)
+    return out
+
+
+@register_op("rank_attention")
+def rank_attention(x, rank_offset, rank_param, *, max_rank=3,
+                   max_size=0):
+    """ref rank_attention_op.cc (CTR ranking): each instance selects the
+    parameter block addressed by its (own rank, other rank) pairs and
+    multiplies its features through; missing pairs (offset < 0)
+    contribute zeros."""
+    b, d = x.shape
+    _, out_dim = rank_param.shape[0] // (max_rank * max_rank * d), \
+        rank_param.shape[1]
+    p = rank_param.reshape(max_rank * max_rank, d, out_dim)
+    ins_rank = jnp.asarray(rank_offset[:, 0], jnp.int32)      # own rank
+    acc = jnp.zeros((b, out_dim), x.dtype)
+    cnt = jnp.zeros((b, 1), x.dtype)
+    for k in range(max_rank):
+        other = jnp.asarray(rank_offset[:, 2 * k + 1], jnp.int32)
+        ok = (other >= 0) & (ins_rank >= 0)
+        block = jnp.clip((ins_rank - 1) * max_rank
+                         + jnp.clip(other - 1, 0, max_rank - 1),
+                         0, max_rank * max_rank - 1)
+        sel = p[block]                                        # [B, D, O]
+        acc = acc + jnp.where(ok[:, None],
+                              jnp.einsum("bd,bdo->bo", x, sel), 0.0)
+        cnt = cnt + ok[:, None].astype(x.dtype)
+    return acc / jnp.maximum(cnt, 1.0)
+
+
+@register_op("tree_conv")
+def tree_conv(nodes, edges, w, *, max_depth=2):
+    """ref tree_conv_op.cc (tree-based CNN): propagate node features down
+    `max_depth` hops of the adjacency and mix with per-hop weights.
+    nodes: [N, V, D]; edges: [N, V, V] row-normalised adjacency;
+    w: [max_depth+1, D, O]."""
+    out = jnp.einsum("nvd,do->nvo", nodes, w[0])
+    h = nodes
+    for k in range(1, max_depth + 1):
+        h = jnp.einsum("nuv,nud->nvd", edges, h)
+        out = out + jnp.einsum("nvd,do->nvo", h, w[k])
+    return jax.nn.relu(out)
+
+
+@register_op("var_conv_2d")
+def var_conv_2d(x, w, *, output_channel, input_channel, kernel_h,
+                kernel_w, stride_h=1, stride_w=1):
+    """ref var_conv_2d_op.cc: conv over per-instance variable-size 2-D
+    feature maps. Padded form: x [B, C, H, W] already padded to the batch
+    max; the kernel is an ordinary conv (padding SAME, stride given) —
+    the LoD bookkeeping of the reference becomes the caller's mask."""
+    from .nn_ops import conv2d
+
+    wk = w.reshape(output_channel, input_channel, kernel_h, kernel_w)
+    return conv2d(x, wk, stride=(stride_h, stride_w),
+                  padding=((kernel_h - 1) // 2, (kernel_w - 1) // 2))
+
+
+@register_op("distributed_lookup_table")
+def distributed_lookup_table(ids, w, *, table_id=0, padding_idx=-1):
+    """ref distributed_lookup_table_op.cc: embedding pull from the
+    parameter server. Inside a compiled program the PS round-trip lives
+    in the data path (ps.DistributedEmbedding pulls rows before the
+    step); the op itself is the local lookup over the pulled shard."""
+    from .nn_ops import lookup_table_v2
+
+    return lookup_table_v2(jnp.asarray(ids), w, padding_idx=padding_idx)

@@ -73,17 +73,18 @@ def depthwise_conv2d(x, weight, *, stride=1, padding=0, dilation=1, groups=1,
                   dilation=dilation, groups=groups, data_format=data_format)
 
 
-@register_op("conv2d_transpose")
-def conv2d_transpose(x, weight, *, stride=1, padding=0, output_padding=0,
-                     dilation=1, groups=1, data_format="NCHW"):
-    if groups != 1:
-        raise NotImplementedError("grouped conv2d_transpose")
-    strides = _pair(stride)
-    dilations = _pair(dilation)
-    opad = _pair(output_padding)
-    kh, kw = weight.shape[-2], weight.shape[-1]
-    pad = _conv_padding(padding, 2, strides, dilations, (kh, kw),
-                        channel_last=(data_format != "NCHW"))
+def _conv_transpose(x, weight, spatial, stride, padding, output_padding,
+                    dilation, groups, data_format):
+    """Shared N-D transposed conv. Paddle weight layout is
+    (C_in, C_out/groups, *k); lax wants OIHW' with feature groups, so the
+    weight is regrouped (g, Ci/g, Co/g, *k) -> (Co, Ci/g, *k) + flipped."""
+    strides = _pair(stride, spatial)
+    dilations = _pair(dilation, spatial)
+    opad = _pair(output_padding, spatial)
+    ks = weight.shape[2:]
+    channel_last = data_format in ("NHWC", "NDHWC")
+    pad = _conv_padding(padding, spatial, strides, dilations, ks,
+                        channel_last=channel_last)
     if isinstance(pad, str):
         lax_pad = pad
     else:
@@ -91,18 +92,45 @@ def conv2d_transpose(x, weight, *, stride=1, padding=0, output_padding=0,
         lax_pad = [
             (dilations[i] * (k - 1) - pad[i][0],
              dilations[i] * (k - 1) - pad[i][1] + opad[i])
-            for i, k in enumerate((kh, kw))
+            for i, k in enumerate(ks)
         ]
-    dn = lax.conv_dimension_numbers(
-        x.shape, (weight.shape[1], weight.shape[0]) + weight.shape[2:],
-        ("NCHW", "OIHW", "NCHW") if data_format == "NCHW"
-        else ("NHWC", "OIHW", "NHWC"))
-    # weight layout for paddle transpose conv is (in, out, kh, kw)
-    w = jnp.swapaxes(weight, 0, 1)
-    w = jnp.flip(w, axis=(-2, -1))
+    ci, cog = weight.shape[0], weight.shape[1]
+    w = weight.reshape((groups, ci // groups, cog) + ks)
+    w = jnp.swapaxes(w, 1, 2).reshape((groups * cog, ci // groups) + ks)
+    w = jnp.flip(w, axis=tuple(range(2, 2 + spatial)))
+    sp = "DHW"[3 - spatial:]
+    fmt = ("NC" + sp) if not channel_last else ("N" + sp + "C")
+    dn = lax.conv_dimension_numbers(x.shape, w.shape,
+                                    (fmt, "OI" + sp, fmt))
     return lax.conv_general_dilated(
-        x, w, window_strides=(1, 1), padding=lax_pad,
-        lhs_dilation=strides, rhs_dilation=dilations, dimension_numbers=dn)
+        x, w, window_strides=(1,) * spatial, padding=lax_pad,
+        lhs_dilation=strides, rhs_dilation=dilations,
+        dimension_numbers=dn, feature_group_count=groups)
+
+
+@register_op("conv2d_transpose")
+def conv2d_transpose(x, weight, *, stride=1, padding=0, output_padding=0,
+                     dilation=1, groups=1, data_format="NCHW"):
+    return _conv_transpose(x, weight, 2, stride, padding, output_padding,
+                           dilation, groups, data_format)
+
+
+@register_op("depthwise_conv2d_transpose")
+def depthwise_conv2d_transpose(x, weight, *, stride=1, padding=0,
+                               output_padding=0, dilation=1, groups=None,
+                               data_format="NCHW"):
+    """ref conv_transpose_op.cc depthwise registration: groups == C_in."""
+    g = x.shape[1] if data_format == "NCHW" else x.shape[-1]
+    return _conv_transpose(x, weight, 2, stride, padding, output_padding,
+                           dilation, groups if groups else g, data_format)
+
+
+@register_op("conv3d_transpose")
+def conv3d_transpose(x, weight, *, stride=1, padding=0, output_padding=0,
+                     dilation=1, groups=1, data_format="NCDHW"):
+    """ref conv_transpose_op.cc:528 (conv3d_transpose)."""
+    return _conv_transpose(x, weight, 3, stride, padding, output_padding,
+                           dilation, groups, data_format)
 
 
 @register_op("conv1d")
@@ -206,8 +234,23 @@ def _adaptive_pool2d(x, output_size, mode, data_format):
     if h % os[0] == 0 and w % os[1] == 0:
         ks = (h // os[0], w // os[1])
         return _pool2d(x, ks, ks, 0, False, mode, True, data_format)
-    raise NotImplementedError(
-        "adaptive pool2d with non-divisible output size")
+    # non-divisible: paddle bins overlap (start floor, end ceil), so
+    # reduce each bin from a static slice — shapes are compile-time
+    # constants, so this unrolls into os[0]*os[1] fused reductions
+    if data_format != "NCHW":
+        x = jnp.moveaxis(x, -1, 1)
+    red = jnp.max if mode == "max" else jnp.mean
+    rows = []
+    for i in range(os[0]):
+        s0, e0 = (i * h) // os[0], -(-((i + 1) * h) // os[0])
+        cols = [red(x[:, :, s0:e0, (j * w) // os[1]:
+                      -(-((j + 1) * w) // os[1])], axis=(2, 3))
+                for j in range(os[1])]
+        rows.append(jnp.stack(cols, axis=-1))
+    out = jnp.stack(rows, axis=-2)
+    if data_format != "NCHW":
+        out = jnp.moveaxis(out, 1, -1)
+    return out
 
 
 @register_op("max_pool2d_with_index", has_aux=True)
@@ -573,16 +616,20 @@ def sdpa(q, k, v, mask=None, key=None, *, dropout_p=0.0, is_causal=False,
 @register_op("interpolate")
 def interpolate(x, *, size=None, scale_factor=None, mode="nearest",
                 align_corners=False, data_format="NCHW"):
-    spatial_axes = (2, 3) if data_format == "NCHW" else (1, 2)
+    nsp = x.ndim - 2
+    channel_last = data_format in ("NWC", "NHWC", "NDHWC")
+    spatial_axes = tuple(range(1, 1 + nsp)) if channel_last \
+        else tuple(range(2, 2 + nsp))
     in_sizes = [x.shape[a] for a in spatial_axes]
     if size is None:
         sf = scale_factor if isinstance(scale_factor, (list, tuple)) \
-            else [scale_factor] * 2
+            else [scale_factor] * nsp
         size = [int(s * f) for s, f in zip(in_sizes, sf)]
     out_shape = list(x.shape)
     for a, s in zip(spatial_axes, size):
         out_shape[a] = int(s)
-    method = {"nearest": "nearest", "bilinear": "bilinear",
+    method = {"nearest": "nearest", "linear": "linear",
+              "bilinear": "bilinear", "trilinear": "trilinear",
               "bicubic": "bicubic", "area": "linear"}[mode]
     return jax.image.resize(x, out_shape, method=method)
 

@@ -1765,3 +1765,724 @@ case("gather_tree", [_GT_IDS, _GT_PAR], {},
 # ===========================================================================
 # known-unimplemented ops (tracked; implementing removes from this set)
 # ===========================================================================
+
+
+# ---------------------------------------------------------------------------
+# round-3 long-tail ops (ops/long_tail_ops.py + ops/compat_ops.py)
+# ---------------------------------------------------------------------------
+
+# ops that need live infrastructure the sweep does not spin up (PS runtime);
+# exercised end-to-end in test_parameter_server.py instead
+ENV_DEPENDENT: set[str] = {"pull_sparse", "push_sparse", "pull_sparse_v2",
+                           "push_sparse_v2"}
+
+_X23 = f32((2, 3))
+_X234 = f32((2, 3, 4))
+
+case("crop", [f32((4, 5))], {"offsets": [1, 2], "shape": [2, 2]},
+     ref=lambda x, offsets, shape: x[1:3, 2:4])
+case("crop_tensor", [f32((4, 5))], {"offsets": [1, 0], "shape": [2, -1]},
+     ref=lambda x, offsets, shape: x[1:3, :])
+case("broadcast_tensors", [f32((2, 1)), f32((1, 3), seed=1)], {},
+     ref=lambda a, b: (np.broadcast_to(a, (2, 3)),
+                       np.broadcast_to(b, (2, 3))))
+case("partial_concat", [f32((2, 6)), f32((2, 6), seed=1)],
+     {"start_index": 1, "length": 3},
+     ref=lambda a, b, **kw: np.concatenate([a[:, 1:4], b[:, 1:4]], 1))
+case("partial_sum", [f32((2, 6)), f32((2, 6), seed=1)],
+     {"start_index": 1, "length": 3},
+     ref=lambda a, b, **kw: a[:, 1:4] + b[:, 1:4])
+case("reverse", [_X234], {"axis": [1]},
+     ref=lambda x, axis: x[:, ::-1])
+case("increment", [f32((1,))], {"value": 2.5},
+     ref=lambda x, value: x + 2.5)
+case("minus", [_X23, f32((2, 3), seed=1)], {},
+     ref=lambda a, b: a - b, grad=(0, 1))
+case("mv", [f32((3, 4)), f32((4,), seed=1)], {},
+     ref=lambda m, v: m @ v, grad=(0, 1))
+case("sum", [_X23, f32((2, 3), seed=1), f32((2, 3), seed=2)], {},
+     ref=lambda *xs: xs[0] + xs[1] + xs[2], grad=(0, 1, 2))
+case("mean", [_X234], {}, ref=lambda x: np.mean(x))
+case("norm", [_X23], {"axis": 1},
+     ref=lambda x, axis: (x / np.sqrt((x * x).sum(1, keepdims=True)
+                                      + 1e-10),
+                          np.sqrt((x * x).sum(1, keepdims=True) + 1e-10)))
+case("unbind", [_X234], {"axis": 1},
+     ref=lambda x, axis: tuple(x[:, i] for i in range(3)))
+case("tril_triu", [f32((4, 4))], {"diagonal": 0, "lower": True},
+     ref=lambda x, **kw: np.tril(x))
+case("tril_triu", [f32((4, 4))], {"diagonal": 1, "lower": False},
+     ref=lambda x, **kw: np.triu(x, 1))
+case("set_value", [f32((3, 4)), np.float32(7.0)],
+     {"axes": [1], "starts": [1], "ends": [3]},
+     ref=lambda x, v, **kw: np.concatenate(
+         [x[:, :1], np.full((3, 2), 7.0, np.float32), x[:, 3:]], 1),
+     grad=None)
+
+
+def _shuffle_prop(outs, inputs, attrs):
+    out, perm = np.asarray(outs[0]), np.asarray(outs[1])
+    np.testing.assert_allclose(out, inputs[0][perm], rtol=1e-6)
+    assert sorted(perm.tolist()) == list(range(inputs[0].shape[0]))
+
+
+case("shuffle_batch", [f32((6, 3)), KEY], {}, prop=_shuffle_prop,
+     grad=None, bf16=False)
+case("pad2d", [f32((1, 2, 3, 3))],
+     {"paddings": [1, 1, 2, 2], "mode": "constant", "pad_value": 0.5},
+     ref=lambda x, **kw: np.pad(x, [(0, 0), (0, 0), (1, 1), (2, 2)],
+                                constant_values=0.5))
+case("pad2d", [f32((1, 2, 4, 4))],
+     {"paddings": [1, 1, 1, 1], "mode": "reflect"},
+     ref=lambda x, **kw: np.pad(x, [(0, 0), (0, 0), (1, 1), (1, 1)],
+                                mode="reflect"))
+case("pad_constant_like", [f32((3, 5)), f32((2, 4), seed=1)],
+     {"pad_value": 0.0},
+     ref=lambda x, y, **kw: np.pad(y, [(0, 1), (0, 1)]), grad=(1,))
+
+
+def _im2seq_ref(x, kernels, **kw):
+    n, c, h, w = x.shape
+    kh, kw_ = kernels
+    rows = []
+    for b in range(n):
+        for i in range(h - kh + 1):
+            for j in range(w - kw_ + 1):
+                rows.append(x[b, :, i:i + kh, j:j + kw_].reshape(-1))
+    return np.stack(rows)
+
+
+case("im2sequence", [f32((1, 2, 4, 4))], {"kernels": (2, 2)},
+     ref=_im2seq_ref)
+case("cvm", [pos((3, 6)), pos((3, 2), seed=1)], {"use_cvm": True},
+     ref=lambda x, cvm, use_cvm: np.concatenate(
+         [np.log(cvm[:, :1] + 1), np.log(cvm[:, 1:2] + 1)
+          - np.log(cvm[:, :1] + 1), x[:, 2:]], 1))
+case("batch_fc", [f32((2, 3, 4)), f32((2, 4, 5), seed=1),
+                  f32((2, 5), seed=2)], {},
+     ref=lambda x, w, b: np.einsum("sbi,sio->sbo", x, w) + b[:, None],
+     grad=(0, 1))
+
+
+def _instag_prop(outs, inputs, attrs):
+    out, keep, wts = (np.asarray(outs[0]), np.asarray(outs[1]),
+                      np.asarray(outs[2]))
+    exp_keep = np.isin(inputs[1], inputs[2]).any(-1)
+    np.testing.assert_array_equal(keep, exp_keep)
+    np.testing.assert_allclose(out[~exp_keep], 0.0)
+
+
+case("filter_by_instag",
+     [f32((4, 3)), ints((4, 2), 0, 5), ints((3,), 0, 3, seed=1,
+                                            dtype=np.int64)],
+     {}, prop=_instag_prop, grad=None, bf16=False)
+case("fsp", [f32((2, 3, 4, 4)), f32((2, 5, 4, 4), seed=1)], {},
+     ref=lambda x, y: np.einsum("nax,nbx->nab", x.reshape(2, 3, 16),
+                                y.reshape(2, 5, 16)) / 16.0,
+     grad=(0, 1))
+case("label_smooth", [f32((2, 5), 0.0, 1.0)], {"epsilon": 0.1},
+     ref=lambda x, epsilon: 0.9 * x + 0.1 / 5)
+
+
+def _ce2_ref(x, label, **kw):
+    p = np.take_along_axis(x, label, axis=-1)
+    return -np.log(np.maximum(p, 1e-12)), p
+
+
+case("cross_entropy2", [pos((4, 5), 0.1, 0.9), ints((4, 1), 0, 5)],
+     {}, ref=_ce2_ref, grad=None, bf16=False)
+
+
+def _center_prop(outs, inputs, attrs):
+    loss, centers = np.asarray(outs[0]), np.asarray(outs[1])
+    x, label, c0 = inputs
+    exp = 0.5 * ((x - c0[label]) ** 2).sum(-1, keepdims=True)
+    np.testing.assert_allclose(loss, exp, rtol=1e-5)
+    assert centers.shape == c0.shape
+
+
+case("center_loss", [f32((4, 3)), ints((4,), 0, 5, dtype=np.int64),
+                     f32((5, 3), seed=1)],
+     {"alpha": 0.1}, prop=_center_prop, grad=None, bf16=False)
+
+
+def _nce_prop(outs, inputs, attrs):
+    cost = np.asarray(outs[0])
+    assert cost.shape == (4, 1) and np.all(cost > 0)
+
+
+case("nce", [f32((4, 3)), ints((4, 1), 0, 10, dtype=np.int64),
+             f32((10, 3), seed=1), f32((10,), seed=2), KEY],
+     {"num_total_classes": 10, "num_neg_samples": 5},
+     prop=_nce_prop, grad=None, bf16=False)
+
+
+def _sample_logits_prop(outs, inputs, attrs):
+    picked, samples, newlab = [np.asarray(o) for o in outs]
+    logits, label = inputs[0], inputs[1]
+    direct = np.take_along_axis(logits, samples, axis=1)
+    logq = np.log(attrs["num_samples"] / logits.shape[1])
+    np.testing.assert_allclose(picked, direct - logq, rtol=1e-5)
+    np.testing.assert_array_equal(samples[:, :1], label)
+
+
+case("sample_logits", [f32((3, 8)), ints((3, 1), 0, 8, dtype=np.int64),
+                       KEY],
+     {"num_samples": 4}, prop=_sample_logits_prop, grad=None, bf16=False)
+
+
+def _np_conv2d(x, w, stride=1, pad=0):
+    n, c, h, wd = x.shape
+    co, _, kh, kw_ = w.shape
+    xp = np.pad(x, [(0, 0), (0, 0), (pad, pad), (pad, pad)])
+    oh = (h + 2 * pad - kh) // stride + 1
+    ow = (wd + 2 * pad - kw_) // stride + 1
+    out = np.zeros((n, co, oh, ow), np.float32)
+    for i in range(oh):
+        for j in range(ow):
+            patch = xp[:, :, i * stride:i * stride + kh,
+                       j * stride:j * stride + kw_]
+            out[:, :, i, j] = np.einsum("nchw,ochw->no", patch, w)
+    return out
+
+
+def _dcn_zero_offset_ref(x, offset, mask, w, **kw):
+    # zero offsets + unit mask reduce deformable conv to plain conv
+    return _np_conv2d(x, w, stride=1, pad=1)
+
+
+case("deformable_conv",
+     [f32((1, 2, 5, 5)), np.zeros((1, 18, 5, 5), np.float32),
+      np.ones((1, 9, 5, 5), np.float32), f32((4, 2, 3, 3), seed=1)],
+     {"stride": 1, "padding": 1, "dilation": 1},
+     ref=_dcn_zero_offset_ref, grad=(0, 3), grad_rtol=5e-3,
+     grad_atol=1e-3)
+case("deformable_conv_v1",
+     [f32((1, 2, 5, 5)), np.zeros((1, 18, 5, 5), np.float32),
+      f32((4, 2, 3, 3), seed=1)],
+     {"stride": 1, "padding": 1},
+     ref=lambda x, off, w, **kw: _np_conv2d(x, w, 1, 1), grad=(0, 2),
+     grad_rtol=5e-3, grad_atol=1e-3)
+
+
+def _row_conv_ref(x, w):
+    k = w.shape[0]
+    out = np.zeros_like(x)
+    t = x.shape[1]
+    for i in range(k):
+        out[:, :t - i] += x[:, i:] * w[i][None, None]
+    return out
+
+
+case("row_conv", [f32((2, 5, 3)), f32((2, 3), seed=1)], {},
+     ref=_row_conv_ref, grad=(0, 1))
+
+
+def _conv_shift_ref(x, y):
+    b, m = x.shape
+    n = y.shape[1]
+    out = np.zeros_like(x)
+    for i in range(m):
+        for j in range(n):
+            out[:, i] += y[:, j] * x[:, (i + j - n // 2) % m]
+    return out
+
+
+case("conv_shift", [f32((2, 7)), f32((2, 3), seed=1)], {},
+     ref=_conv_shift_ref, grad=(0, 1))
+
+
+def _corr_ref(x1, x2, **kw):
+    d = kw.get("max_displacement", 1)
+    n, c, h, w = x1.shape
+    x2p = np.pad(x2, [(0, 0), (0, 0), (d, d), (d, d)])
+    outs = []
+    for dy in range(2 * d + 1):
+        for dx in range(2 * d + 1):
+            outs.append((x1 * x2p[:, :, dy:dy + h, dx:dx + w]).mean(1))
+    return np.stack(outs, 1)
+
+
+case("correlation", [f32((1, 2, 4, 4)), f32((1, 2, 4, 4), seed=1)],
+     {"max_displacement": 1}, ref=_corr_ref, grad=(0, 1))
+
+
+def _unpool_prop(outs, inputs, attrs):
+    out = np.asarray(outs[0])
+    x, idx = inputs
+    n, c, h, w = x.shape
+    flat = out.reshape(n, c, -1)
+    got = np.take_along_axis(flat, idx.reshape(n, c, -1), axis=2)
+    np.testing.assert_allclose(got.reshape(x.shape), x, rtol=1e-6)
+
+
+_UPX = f32((1, 2, 2, 2))
+_UPIDX = np.array([[[[0, 3], [9, 10]], [[5, 6], [12, 15]]]], np.int32)
+case("unpool", [_UPX, _UPIDX], {"ksize": 2, "stride": 2},
+     prop=_unpool_prop, grad=None, bf16=False)
+
+
+def _mp3d_prop(outs, inputs, attrs):
+    out, idx = np.asarray(outs[0]), np.asarray(outs[1])
+    x = inputs[0]
+    n, c, d, h, w = x.shape
+    got = np.take_along_axis(x.reshape(n, c, -1),
+                             idx.reshape(n, c, -1), axis=2)
+    np.testing.assert_allclose(got.reshape(out.shape), out, rtol=1e-6)
+    np.testing.assert_allclose(
+        out, x.reshape(n, c, d // 2, 2, h // 2, 2, w // 2,
+                       2).max((3, 5, 7)), rtol=1e-6)
+
+
+case("max_pool3d_with_index", [f32((1, 2, 4, 4, 4))],
+     {"ksize": 2, "stride": 2}, prop=_mp3d_prop)
+case("prroi_pool", [np.full((1, 1, 8, 8), 2.0, np.float32),
+                    np.array([[0, 0, 4, 4]], np.float32),
+                    np.array([1], np.int32)],
+     {"pooled_height": 2, "pooled_width": 2},
+     ref=lambda x, r, n, **kw: np.full((1, 1, 2, 2), 2.0, np.float32))
+case("psroi_pool", [np.full((1, 8, 6, 6), 3.0, np.float32),
+                    np.array([[0, 0, 4, 4]], np.float32),
+                    np.array([1], np.int32)],
+     {"output_channels": 2, "pooled_height": 2, "pooled_width": 2},
+     ref=lambda x, r, n, **kw: np.full((1, 2, 2, 2), 3.0, np.float32))
+
+
+def _yolo_loss_prop(outs, inputs, attrs):
+    loss = np.asarray(outs[0])
+    assert loss.shape == (1,) and np.isfinite(loss).all() and loss[0] > 0
+
+
+case("yolov3_loss",
+     [f32((1, 16, 4, 4)),
+      np.array([[[0.5, 0.5, 0.25, 0.25]]], np.float32),
+      np.array([[1]], np.int32)],
+     {"anchors": [10, 13, 16, 30], "anchor_mask": [0, 1], "class_num": 3,
+      "downsample_ratio": 32},
+     prop=_yolo_loss_prop, grad=None, bf16=False)
+
+
+def _seq_concat_ref(x1, l1, x2, l2):
+    b = x1.shape[0]
+    t = x1.shape[1] + x2.shape[1]
+    out = np.zeros((b, t, x1.shape[2]), np.float32)
+    for i in range(b):
+        a, c = int(l1[i]), int(l2[i])
+        out[i, :a] = x1[i, :a]
+        out[i, a:a + c] = x2[i, :c]
+    return out
+
+
+case("sequence_concat",
+     [f32((2, 3, 4)), np.array([2, 3], np.int32),
+      f32((2, 2, 4), seed=1), np.array([2, 1], np.int32)],
+     {}, ref=_seq_concat_ref, grad=None, bf16=False)
+case("sequence_reshape", [f32((2, 4, 6)), np.array([2, 4], np.int32)],
+     {"new_dim": 3},
+     ref=lambda x, ln, new_dim: (x.reshape(2, 8, 3),
+                                 (ln * 6) // 3), grad=None, bf16=False)
+
+
+def _seq_scatter_ref(x, idx, upd, ln):
+    out = x.copy()
+    for b in range(x.shape[0]):
+        for t in range(idx.shape[1]):
+            if t < ln[b]:
+                out[b, idx[b, t]] += upd[b, t]
+    return out
+
+
+case("sequence_scatter",
+     [f32((2, 5, 3)), ints((2, 3), 0, 5), f32((2, 3, 3), seed=1),
+      np.array([3, 2], np.int32)],
+     {}, ref=_seq_scatter_ref, grad=None, bf16=False)
+
+
+def _seq_slice_ref(x, ln, off, length):
+    b, t, d = x.shape
+    out = np.zeros_like(x)
+    for i in range(b):
+        o, le = int(off[i]), int(length[i])
+        out[i, :le] = x[i, o:o + le]
+    return out, length.reshape(-1).astype(np.int32)
+
+
+case("sequence_slice",
+     [f32((2, 5, 3)), np.array([5, 4], np.int32),
+      np.array([1, 0], np.int32), np.array([2, 3], np.int32)],
+     {}, ref=_seq_slice_ref, grad=None, bf16=False)
+case("lod_reset", [f32((2, 4, 3)), np.array([3, 2], np.int32)], {},
+     ref=lambda x, ln: (x, ln), grad=None, bf16=False)
+
+
+def _abn_prop(outs, inputs, attrs):
+    y = np.asarray(outs[0])
+    x, scale, bias, mean, var = inputs
+    mu = x.mean((0, 2, 3))
+    sd = np.sqrt(x.var((0, 2, 3)) + 1e-5)
+    ref = (x - mu[None, :, None, None]) / sd[None, :, None, None]
+    ref = ref * scale[None, :, None, None] + bias[None, :, None, None]
+    ref = np.where(ref >= 0, ref, 0.01 * ref)
+    np.testing.assert_allclose(y, ref, rtol=1e-4, atol=1e-5)
+
+
+case("inplace_abn",
+     [f32((2, 3, 4, 4)), pos((3,)), f32((3,), seed=1),
+      np.zeros(3, np.float32), np.ones(3, np.float32)],
+     {"activation": "leaky_relu", "alpha": 0.01},
+     prop=_abn_prop, grad=None, bf16=False)
+
+
+def _bslice_prop(outs, inputs, attrs):
+    out = np.asarray(outs[0])
+    x = inputs[0]
+    # unit grid, no offset: every output channel sums x over channels
+    np.testing.assert_allclose(out, np.repeat(
+        x.sum(1, keepdims=True), out.shape[1], 1), rtol=1e-4)
+
+
+case("bilateral_slice",
+     [f32((1, 2, 6, 6), 0.1, 0.9),
+      np.ones((1, 4, 3, 4, 4), np.float32),
+      pos((1, 6, 6), 0.1, 0.9, seed=1)],
+     {"has_offset": False}, prop=_bslice_prop, grad=None, bf16=False)
+
+
+def _ph_prop(outs, inputs, attrs):
+    out = np.asarray(outs[0])
+    assert out.shape == (2, 5, 8) and np.isfinite(out).all()
+
+
+case("pyramid_hash", [ints((2, 5), 0, 1000, dtype=np.int64),
+                      f32((1000, 8))],
+     {"num_emb": 8, "space_len": 1000}, prop=_ph_prop, grad=None,
+     bf16=False)
+
+
+def _ra_prop(outs, inputs, attrs):
+    out = np.asarray(outs[0])
+    assert out.shape == (3, 4) and np.isfinite(out).all()
+
+
+case("rank_attention",
+     [f32((3, 2)), np.array([[1, 1, -1, 0, 2, 1], [2, 2, 0, 1, -1, 0],
+                             [1, -1, 1, 0, 2, 2]], np.int32),
+      f32((3 * 3 * 2, 4), seed=1)],
+     {"max_rank": 3}, prop=_ra_prop, grad=None, bf16=False)
+case("tree_conv",
+     [f32((1, 4, 3)), pos((1, 4, 4), 0.0, 0.5, seed=1),
+      f32((3, 3, 5), seed=2)],
+     {"max_depth": 2},
+     prop=lambda outs, inputs, attrs: (
+         np.testing.assert_equal(np.asarray(outs[0]).shape, (1, 4, 5))),
+     grad=None, bf16=False)
+case("var_conv_2d", [f32((1, 2, 5, 5)), f32((3 * 2 * 3 * 3,), seed=1)],
+     {"output_channel": 3, "input_channel": 2, "kernel_h": 3,
+      "kernel_w": 3},
+     ref=lambda x, w, **kw: _np_conv2d(
+         x, w.reshape(3, 2, 3, 3), 1, 1), grad=(0, 1))
+case("distributed_lookup_table",
+     [ints((2, 3), 0, 10, dtype=np.int64), f32((10, 4))], {},
+     ref=lambda ids, w, **kw: w[ids], grad=None, bf16=False)
+
+
+# -- compat_ops.py: v2 twins, interp family, fusion, collectives -------------
+
+case("reshape2", [_X234], {"shape": (3, 8)},
+     ref=lambda x, shape: x.reshape(3, 8))
+case("transpose2", [_X234], {"perm": (1, 0, 2)},
+     ref=lambda x, perm: x.transpose(1, 0, 2))
+case("squeeze2", [f32((2, 1, 3))], {"axis": [1]},
+     ref=lambda x, axis: x.reshape(2, 3))
+case("unsqueeze2", [_X23], {"axis": [1]},
+     ref=lambda x, axis: x.reshape(2, 1, 3))
+case("flatten2", [_X234], {"axis": 2},
+     ref=lambda x, axis: x.reshape(6, 4))
+case("expand_as_v2", [f32((1, 3))], {"shape": (4, 3)},
+     ref=lambda x, shape: np.broadcast_to(x, (4, 3)))
+case("expand_as", [f32((1, 3))], {"shape": (4, 3)},
+     ref=lambda x, shape: np.broadcast_to(x, (4, 3)))
+case("expand", [_X23], {"expand_times": (2, 1)},
+     ref=lambda x, expand_times: np.tile(x, (2, 1)))
+case("top_k", [f32((2, 6))], {"k": 3},
+     ref=lambda x, k: (np.sort(x, axis=-1)[:, ::-1][:, :3],
+                       np.argsort(-x, axis=-1)[:, :3]),
+     grad=None, bf16=False)
+case("slice", [_X234], {"axes": [1], "starts": [1], "ends": [3]},
+     ref=lambda x, **kw: x[:, 1:3])
+case("trace", [f32((4, 4))], {}, ref=lambda x: np.trace(x))
+case("lookup_table", [ints((3, 1), 0, 8, dtype=np.int64), f32((8, 4))],
+     {}, ref=lambda ids, w, **kw: w[ids[:, 0]], grad=None, bf16=False)
+
+_INTERP_X = f32((1, 2, 4, 4))
+for _nm in ("bilinear_interp", "bilinear_interp_v2", "nearest_interp",
+            "nearest_interp_v2", "bicubic_interp", "bicubic_interp_v2"):
+    case(_nm, [_INTERP_X],
+         {"out_h": 8, "out_w": 8, "align_corners": False},
+         prop=lambda outs, inputs, attrs: np.testing.assert_equal(
+             np.asarray(outs[0]).shape, (1, 2, 8, 8)),
+         grad=(0,), bf16=False)
+for _nm in ("linear_interp", "linear_interp_v2"):
+    case(_nm, [f32((1, 2, 6))],
+         {"out_w": 12, "align_corners": False, "data_format": "NCW"},
+         prop=lambda outs, inputs, attrs: np.testing.assert_equal(
+             np.asarray(outs[0]).shape, (1, 2, 12)),
+         grad=(0,), bf16=False)
+for _nm in ("trilinear_interp", "trilinear_interp_v2"):
+    case(_nm, [f32((1, 1, 4, 4, 4))],
+         {"out_d": 8, "out_h": 8, "out_w": 8, "align_corners": False,
+          "data_format": "NCDHW"},
+         prop=lambda outs, inputs, attrs: np.testing.assert_equal(
+             np.asarray(outs[0]).shape, (1, 1, 8, 8, 8)),
+         grad=(0,), bf16=False)
+
+
+def _msr_prop(outs, inputs, attrs):
+    merged, uniq, n = [np.asarray(o) for o in outs]
+    rows, vals = inputs
+    assert int(n) == len(set(rows.tolist()))
+    # merged[k] = sum of values whose row maps to uniq slot k
+    for k, r in enumerate(uniq.tolist()):
+        if r >= 0:
+            np.testing.assert_allclose(
+                merged[k], vals[rows == r].sum(0), rtol=1e-5)
+
+
+case("merge_selected_rows",
+     [np.array([3, 1, 3, 2], np.int64), f32((4, 5))], {},
+     prop=_msr_prop, grad=None, bf16=False)
+case("get_tensor_from_selected_rows",
+     [np.array([1, 3], np.int64), f32((2, 4))], {"height": 6},
+     ref=lambda r, v, height: (lambda o: (o.__setitem__((1,), v[0]),
+                                          o.__setitem__((3,), v[1]),
+                                          o)[-1])(np.zeros((6, 4),
+                                                           np.float32)),
+     grad=None, bf16=False)
+case("coalesce_tensor", [_X23, f32((4,), seed=1)], {},
+     ref=lambda a, b: (np.concatenate([a.reshape(-1), b]), a, b),
+     grad=None, bf16=False)
+case("print", [_X23], {"message": "dbg: "}, ref=lambda x, **kw: x,
+     grad=None, bf16=False)
+case("py_func", [_X23],
+     {"func": lambda x: np.asarray(x) * 2.0, "out_shape": (2, 3)},
+     ref=lambda x, **kw: x * 2.0, grad=None, bf16=False, mode="fn")
+case("quantize", [f32((3, 4))], {"scale": 100.0},
+     ref=lambda x, scale: np.clip(np.round(x * 100), -128,
+                                  127).astype(np.int8),
+     grad=None, bf16=False)
+case("dequantize", [ints((3, 4), -100, 100, dtype=np.int8)],
+     {"scale": 100.0},
+     ref=lambda x, scale: x.astype(np.float32) / 100.0, grad=None,
+     bf16=False)
+case("requantize", [ints((3, 4), -100, 100, dtype=np.int8)],
+     {"scale_in": 100.0, "scale_out": 50.0},
+     ref=lambda x, **kw: np.clip(np.round(x.astype(np.float32) * 0.5),
+                                 -128, 127).astype(np.int8),
+     grad=None, bf16=False)
+
+
+def _lstm_unit_ref(x, c_prev, forget_bias=0.0):
+    h = c_prev.shape[-1]
+    sig = lambda v: 1 / (1 + np.exp(-v))
+    i, f, o, j = x[:, :h], x[:, h:2*h], x[:, 2*h:3*h], x[:, 3*h:]
+    c = c_prev * sig(f + forget_bias) + sig(i) * np.tanh(j)
+    return c, np.tanh(c) * sig(o)
+
+
+case("lstm_unit", [f32((2, 12)), f32((2, 3), seed=1)], {},
+     ref=_lstm_unit_ref, grad=(0, 1))
+
+
+def _gru_unit_prop(outs, inputs, attrs):
+    g, rh, h = [np.asarray(o) for o in outs]
+    x, h_prev, w = inputs[:3]
+    sig = lambda v: 1 / (1 + np.exp(-v))
+    hs = h_prev.shape[-1]
+    gg = x[:, :2*hs] + h_prev @ w[:, :2*hs]
+    u, r = sig(gg[:, :hs]), sig(gg[:, hs:])
+    cand = np.tanh(x[:, 2*hs:] + (r * h_prev) @ w[:, 2*hs:])
+    np.testing.assert_allclose(h, (1 - u) * h_prev + u * cand,
+                               rtol=1e-4, atol=1e-5)
+    # Gate output is the activated [u, r, cand] triple (ref gru_unit_op)
+    assert g.shape == (x.shape[0], 3 * hs)
+    np.testing.assert_allclose(g, np.concatenate([u, r, cand], 1),
+                               rtol=1e-4, atol=1e-5)
+
+
+case("gru_unit", [f32((2, 9)), f32((2, 3), seed=1), f32((3, 9), seed=2)],
+     {}, prop=_gru_unit_prop, grad=None, bf16=False)
+
+
+def _finite_shapes(*shapes):
+    def prop(outs, inputs, attrs):
+        for o, s in zip(outs, shapes):
+            a = np.asarray(o)
+            assert a.shape == s and np.isfinite(a).all(), (a.shape, s)
+    return prop
+
+
+case("gru", [f32((2, 4, 9)), f32((2, 3), seed=1), f32((3, 9), seed=2)],
+     {}, prop=_finite_shapes((2, 4, 3), (2, 3)), grad=None, bf16=False)
+case("lstm", [f32((2, 4, 5)), f32((2, 3), seed=1), f32((2, 3), seed=2),
+              f32((12, 5), seed=3), f32((12, 3), seed=4)],
+     {}, prop=_finite_shapes((2, 4, 3), (2, 3), (2, 3)), grad=None,
+     bf16=False)
+case("lstmp", [f32((2, 4, 5)), f32((2, 3), seed=1), f32((2, 4), seed=2),
+               f32((16, 5), seed=3), f32((16, 3), seed=4),
+               f32((4, 3), seed=5)],
+     {}, prop=_finite_shapes((2, 4, 3), (2, 3), (2, 4)), grad=None,
+     bf16=False)
+case("cudnn_lstm", [_RNN_X, _RNN_H0, _RNN_H0, KEY,
+                    _RNN_WIH, _RNN_WHH, _RNN_BIH, _RNN_BHH],
+     {"mode": "LSTM", "num_layers": 1, "hidden_size": 5},
+     prop=lambda outs, inputs, attrs: None, grad=None, bf16=False,
+     mode="fn")
+case("sync_batch_norm",
+     [f32((2, 3, 4, 4)), pos((3,)), f32((3,), seed=1),
+      np.zeros(3, np.float32), np.ones(3, np.float32)],
+     {}, prop=lambda outs, inputs, attrs: np.testing.assert_equal(
+         np.asarray(outs[0]).shape, (2, 3, 4, 4)),
+     grad=None, bf16=False)
+case("fusion_repeated_fc_relu",
+     [f32((2, 4)), f32((4, 5), seed=1), f32((5,), seed=2),
+      f32((5, 3), seed=3), f32((3,), seed=4)], {},
+     ref=lambda x, w1, b1, w2, b2: np.maximum(
+         np.maximum(x @ w1 + b1, 0) @ w2 + b2, 0), grad=(0, 1, 3))
+case("fusion_squared_mat_sub", [f32((2, 3)), f32((3, 4), seed=1)],
+     {"scalar": 0.5},
+     ref=lambda x, y, scalar: ((x @ y) ** 2 - (x * x) @ (y * y)) * 0.5,
+     grad=(0, 1))
+case("fusion_gru", [f32((2, 4, 5)), f32((2, 3), seed=1),
+                    f32((5, 9), seed=2), f32((3, 9), seed=3)],
+     {}, prop=_finite_shapes((2, 4, 3), (2, 3)), grad=None, bf16=False)
+case("fusion_lstm", [f32((2, 4, 5)), f32((2, 3), seed=1),
+                     f32((2, 3), seed=2), f32((5, 12), seed=3),
+                     f32((3, 12), seed=4)],
+     {}, prop=_finite_shapes((2, 4, 3), (2, 3), (2, 3)), grad=None,
+     bf16=False)
+case("multi_gru", [f32((2, 4, 3)), np.stack([f32((2, 3), seed=1),
+                                             f32((2, 3), seed=2)]),
+                   f32((3, 9), seed=3), f32((3, 9), seed=4),
+                   f32((3, 9), seed=5), f32((3, 9), seed=6)],
+     {"layers": 2}, prop=_finite_shapes((2, 4, 3), (2, 3)), grad=None,
+     bf16=False)
+case("fused_embedding_fc_lstm",
+     [ints((2, 4), 0, 8, dtype=np.int64), f32((8, 5)),
+      f32((2, 3), seed=1), f32((2, 3), seed=2), f32((5, 12), seed=3),
+      f32((3, 12), seed=4)],
+     {}, prop=_finite_shapes((2, 4, 3), (2, 3), (2, 3)), grad=None,
+     bf16=False)
+case("attention_lstm",
+     [f32((2, 4, 5)), f32((2, 3), seed=1), f32((2, 3), seed=2),
+      f32((5, 1), seed=3), f32((5, 12), seed=4), f32((3, 12), seed=5)],
+     {}, prop=_finite_shapes((2, 4, 3), (2, 3), (2, 3)), grad=None,
+     bf16=False)
+case("fusion_seqconv_eltadd_relu",
+     [f32((2, 5, 4)), f32((12, 6), seed=1), f32((6,), seed=2)],
+     {"context_length": 3},
+     prop=lambda outs, inputs, attrs: (
+         np.testing.assert_equal(np.asarray(outs[0]).shape, (2, 5, 6)),
+         np.testing.assert_array_equal(np.asarray(outs[0]) >= 0, True)),
+     grad=None, bf16=False)
+case("fusion_seqpool_concat", [f32((2, 4, 3)), f32((2, 4, 5), seed=1)],
+     {"pooltype": "SUM"},
+     ref=lambda a, b, pooltype: np.concatenate(
+         [a.sum(1), b.sum(1)], -1), grad=None, bf16=False)
+case("fusion_seqexpand_concat_fc",
+     [f32((2, 4, 3)), f32((2, 2), seed=1), f32((5, 6), seed=2),
+      f32((6,), seed=3)],
+     {}, prop=lambda outs, inputs, attrs: np.testing.assert_equal(
+         np.asarray(outs[0]).shape, (2, 4, 6)), grad=None, bf16=False)
+
+# collectives: single-process (no mapped axis) semantics = identity /
+# local slice; mesh behavior is covered by tests/test_distributed_parallel
+case("c_allreduce_sum", [_X23], {}, ref=lambda x: x)
+case("c_allgather", [_X23], {}, ref=lambda x: x)
+case("c_reducescatter", [_X23], {}, ref=lambda x: x)
+case("c_identity", [_X23], {}, ref=lambda x: x)
+case("c_concat", [_X23], {}, ref=lambda x: x)
+case("c_split", [f32((2, 6))], {"nranks": 2, "rank": 1},
+     ref=lambda x, **kw: x[:, 3:])
+case("alltoall", [_X23], {}, ref=lambda x: x)
+case("c_embedding", [ints((2, 3), 0, 6, dtype=np.int64), f32((4, 5))],
+     {"start_index": 2},
+     ref=lambda ids, w, start_index: np.where(
+         ((ids >= 2) & (ids < 6))[..., None],
+         w[np.clip(ids - 2, 0, 3)], 0.0),
+     grad=None, bf16=False)
+
+case("write_to_array", [f32((4, 2, 3)), np.int32(1), f32((2, 3), seed=1)],
+     {}, ref=lambda arr, i, x: np.concatenate(
+         [arr[:1], x[None], arr[2:]]), grad=None, bf16=False)
+case("read_from_array", [f32((4, 2, 3)), np.int32(2)], {},
+     ref=lambda arr, i: arr[2], grad=None, bf16=False)
+case("lod_tensor_to_array", [f32((2, 4, 3)), np.array([3, 4], np.int32)],
+     {}, ref=lambda x, ln: (x.transpose(1, 0, 2),
+                            np.arange(4)[:, None] < ln[None, :]),
+     grad=None, bf16=False)
+case("array_to_lod_tensor",
+     [f32((4, 2, 3)), np.ones((4, 2), bool)], {},
+     ref=lambda s, m: s.transpose(1, 0, 2), grad=None, bf16=False)
+case("shrink_rnn_memory", [f32((3, 4)), np.array([1, 3, 2], np.int32)],
+     {"step": 1},
+     ref=lambda x, ln, step: x * (ln > 1)[:, None], grad=None,
+     bf16=False)
+case("merge_lod_tensor",
+     [np.array([1, 0, 1], np.int32), f32((3, 4)), f32((3, 4), seed=1)],
+     {}, ref=lambda m, a, b: np.where(m[:, None] != 0, a, b),
+     grad=None, bf16=False)
+case("select_input", [np.int32(1), _X23, f32((2, 3), seed=1)], {},
+     ref=lambda m, a, b: b, grad=None, bf16=False)
+case("select_output", [_X23, np.int32(0)], {"n_branches": 2},
+     ref=lambda x, m, n_branches: (x, np.zeros_like(x)), grad=None,
+     bf16=False)
+
+
+def _beam_prop(outs, inputs, attrs):
+    scores, ids, parent = [np.asarray(o) for o in outs]
+    assert scores.shape == (4,) and ids.shape == (4,)
+    assert (parent >= 0).all() and (parent < 4).all()
+    # scores must be the top-4 of pre_scores[:,None]+cand within the seq
+    pre_s, cand = inputs[1], inputs[3]
+    total = (pre_s[:, None] + cand).reshape(-1)
+    np.testing.assert_allclose(np.sort(scores)[::-1],
+                               np.sort(total)[::-1][:4], rtol=1e-5)
+
+
+case("beam_search",
+     [np.full((4, 1), -1, np.int64), f32((4,)),
+      ints((4, 3), 1, 9, dtype=np.int64), f32((4, 3), seed=1)],
+     {"beam_size": 4, "end_id": 0}, prop=_beam_prop, grad=None,
+     bf16=False)
+
+
+def _np_convt(x, w, stride, pad, groups=1):
+    import torch
+    import torch.nn.functional as F
+    f = F.conv_transpose3d if x.ndim == 5 else F.conv_transpose2d
+    return f(torch.tensor(x), torch.tensor(w), stride=stride,
+             padding=pad, groups=groups).numpy()
+
+
+case("conv3d_transpose", [f32((1, 2, 3, 3, 3)), f32((2, 3, 2, 2, 2),
+                                                    seed=1)],
+     {"stride": 2, "padding": 0},
+     ref=lambda x, w, **kw: _np_convt(x, w, 2, 0), grad=(0, 1))
+case("depthwise_conv2d_transpose", [f32((1, 3, 4, 4)),
+                                    f32((3, 1, 3, 3), seed=1)],
+     {"stride": 2, "padding": 1},
+     ref=lambda x, w, **kw: _np_convt(x, w, 2, 1, groups=3),
+     grad=(0, 1))
+case("conv2d_transpose", [f32((1, 4, 4, 4)), f32((4, 3, 3, 3), seed=1)],
+     {"stride": 2, "padding": 1, "groups": 2},
+     ref=lambda x, w, **kw: _np_convt(x, w, 2, 1, groups=2),
+     grad=(0, 1))
+
+
+case("deformable_conv",
+     [f32((1, 4, 5, 5)), np.zeros((1, 18, 5, 5), np.float32),
+      np.ones((1, 9, 5, 5), np.float32), f32((4, 2, 3, 3), seed=1)],
+     {"stride": 1, "padding": 1, "groups": 2},
+     prop=lambda outs, inputs, attrs: np.testing.assert_equal(
+         np.asarray(outs[0]).shape, (1, 4, 5, 5)),
+     grad=None, bf16=False)

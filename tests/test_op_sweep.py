@@ -18,7 +18,7 @@ from paddle_tpu.core.dispatch import apply
 from paddle_tpu.core.op_registry import lookup, registered_ops
 from paddle_tpu.core.tensor import Tensor
 
-from op_sweep_configs import CASES, KEY, UNIMPLEMENTED
+from op_sweep_configs import CASES, ENV_DEPENDENT, KEY, UNIMPLEMENTED
 
 
 def _materialise(inputs):
@@ -57,6 +57,7 @@ def test_registry_fully_covered():
 
     missing = [op for op in registered_ops()
                if op not in CASES and op not in UNIMPLEMENTED
+               and op not in ENV_DEPENDENT
                and op not in registered_custom_ops]
     assert not missing, f"ops without sweep config: {missing}"
     stale = [op for op in CASES if op not in registered_ops()]
