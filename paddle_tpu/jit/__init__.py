@@ -63,44 +63,93 @@ class StaticFunction:
     def _build(self):
         layer = self._get_layer()
 
+        fn = self._function
+        from .dy2static import ProgramTranslator, maybe_rewrite
+
+        if ProgramTranslator.enable_to_static:
+            # AST pass: tensor-dependent if/while/for lower to lax
+            # control flow instead of failing at trace time
+            fn = maybe_rewrite(fn)
+
         if layer is not None:
             # call the original forward, not layer() — when to_static
             # replaced layer.forward, going through Layer.__call__ would
             # recurse into this StaticFunction
-            orig_forward = self._function
+            orig_forward = fn
             from ..engine import _swap_state, _unwrap
 
             def run(values, *arrs):
                 from ..core.config import no_tape
 
-                wrapped = [Tensor(a) for a in arrs]
+                wrapped = [Tensor(a) if isinstance(a, jax.Array) else a
+                           for a in arrs]
                 with no_tape(), _swap_state(layer, values):
                     out = orig_forward(*wrapped)
                 return _unwrap(out)
 
-            self._jitted = jax.jit(run)
+            self._run = run
+            self._with_values = True
         else:
-            fn = self._function
-
             def run(*arrs):
-                wrapped = [Tensor(a) for a in arrs]
+                wrapped = [Tensor(a) if isinstance(a, jax.Array) else a
+                           for a in arrs]
                 out = fn(*wrapped)
                 return jax.tree.map(
                     lambda t: t._value if isinstance(t, Tensor) else t, out,
                     is_leaf=lambda t: isinstance(t, Tensor))
 
-            self._jitted = jax.jit(run)
+            self._run = run
+            self._with_values = False
+        self._jitted = {}
 
     def __call__(self, *args, **kwargs):
         if self._jitted is None:
             self._build()
-        arrs = [a._value if isinstance(a, Tensor) else jnp.asarray(a)
-                for a in args]
+        import numpy as _np
+
+        if kwargs:
+            # the compiled runner is positional-only: bind keywords into
+            # their positional slots (silently dropping them would run
+            # the function with default values)
+            import inspect
+
+            bound = inspect.signature(self._function).bind(*args,
+                                                           **kwargs)
+            if bound.kwargs:
+                raise NotImplementedError(
+                    "to_static: keyword-only arguments are not "
+                    f"supported: {sorted(bound.kwargs)}")
+            args = bound.args
+        # tensors/arrays/floats are traced; Python bools/ints (the
+        # values that drive Python control flow and shapes) stay static
+        # so plain-Python `if`/`range` on them keeps exact semantics
+        offset = 1 if self._with_values else 0
+        arrs = []
+        static_idx = []
+        for i, a in enumerate(args):
+            if isinstance(a, Tensor):
+                arrs.append(a._value)
+            elif isinstance(a, (_np.ndarray, jax.Array)):
+                arrs.append(jnp.asarray(a))
+            elif isinstance(a, (bool, int, str)) or a is None:
+                arrs.append(a)
+                static_idx.append(i + offset)
+            elif isinstance(a, (list, tuple)):
+                try:
+                    arrs.append(jnp.asarray(a))
+                except (TypeError, ValueError):
+                    arrs.append(tuple(a) if isinstance(a, list) else a)
+                    static_idx.append(i + offset)
+            else:
+                arrs.append(jnp.asarray(a))
+        key = tuple(static_idx)
+        if key not in self._jitted:
+            self._jitted[key] = jax.jit(self._run, static_argnums=key)
         layer = self._get_layer()
         if layer is not None:
-            out = self._jitted(state_values(layer), *arrs)
+            out = self._jitted[key](state_values(layer), *arrs)
         else:
-            out = self._jitted(*arrs)
+            out = self._jitted[key](*arrs)
         return jax.tree.map(Tensor, out)
 
     @property

@@ -1,0 +1,565 @@
+"""AST dygraph-to-static: rewrite Python control flow over tensors.
+
+Ref parity: python/paddle/fluid/dygraph/dygraph_to_static/
+program_translator.py:759 (ProgramTranslator) + the transformer files
+(ifelse_transformer, loop_transformer, logical_transformer,
+convert_operators). The reference rewrites `if`/`while`/`for`/`and`/`or`
+into convert_* calls that build ProgramDesc cond/while blocks. TPU-native
+redesign: the same source rewrite, but the convert helpers dispatch at
+RUN time — a predicate that is a concrete value keeps exact Python
+semantics (including side effects and early exit), and only an abstract
+traced value lowers to `lax.cond` / `lax.while_loop`, which is what XLA
+compiles. There is no ProgramDesc: the rewritten function is ordinary
+Python that jax.jit traces.
+
+Mechanics (mirrors the reference's UndefinedVar machinery):
+- every name STORED in a branch/loop-body becomes an explicit in/out of
+  a lifted local function; pure reads resolve through the closure;
+- names possibly unbound at the call site are captured with `_d2s_ld`,
+  which yields the UNDEF sentinel (a childless pytree node, so jax
+  treats it as structure, not data);
+- functions using global/nonlocal, or tensor-pred branches containing
+  return/break/continue, fall back to the trace-based path unchanged
+  (the reference's transformer handles early-return by rewriting to
+  flags; documented gap).
+"""
+
+from __future__ import annotations
+
+import ast
+import functools
+import inspect
+import textwrap
+import types
+import warnings
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.tensor import Tensor
+
+__all__ = ["rewrite", "maybe_rewrite", "ProgramTranslator",
+           "convert_ifelse", "convert_while_loop"]
+
+
+# ---------------------------------------------------------------------------
+# runtime convert helpers
+# ---------------------------------------------------------------------------
+
+
+class _Undef:
+    """Placeholder for a possibly-unbound local (ref UndefinedVar).
+    Any use raises like the UnboundLocalError the original code would
+    have produced (instead of the sentinel flowing into results)."""
+
+    _instance = None
+
+    def __new__(cls):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+        return cls._instance
+
+    def __repr__(self):
+        return "<undefined local>"
+
+    def _raise(self, *a, **k):
+        raise UnboundLocalError(
+            "dy2static: local variable referenced before assignment "
+            "(it is only bound in an untaken branch)")
+
+    __add__ = __radd__ = __sub__ = __rsub__ = __mul__ = __rmul__ = _raise
+    __truediv__ = __rtruediv__ = __matmul__ = __rmatmul__ = _raise
+    __neg__ = __abs__ = __bool__ = __float__ = __int__ = _raise
+    __lt__ = __le__ = __gt__ = __ge__ = __call__ = _raise
+    __getitem__ = __setitem__ = __len__ = __iter__ = _raise
+
+
+UNDEF = _Undef()
+jax.tree_util.register_pytree_node(
+    _Undef, lambda u: ((), None), lambda aux, ch: UNDEF)
+
+
+def _d2s_ld(thunk):
+    """Capture a local that may be unbound at this point."""
+    try:
+        return thunk()
+    except NameError:
+        return UNDEF
+
+
+def _unwrap(v):
+    return v._value if isinstance(v, Tensor) else v
+
+
+def _tree_unwrap(t):
+    return jax.tree.map(_unwrap, t,
+                        is_leaf=lambda x: isinstance(x, Tensor))
+
+
+def _tree_wrap(t):
+    return jax.tree.map(
+        lambda x: Tensor(x) if isinstance(x, jax.Array) else x, t)
+
+
+_TRACE_ERRORS = (jax.errors.TracerBoolConversionError,
+                 jax.errors.ConcretizationTypeError)
+
+
+def convert_ifelse(pred, true_fn, false_fn, ins):
+    """ref convert_operators.convert_ifelse: Python `if` for concrete
+    predicates, lax.cond for traced ones."""
+    p = _unwrap(pred)
+    try:
+        pb = bool(p)
+    except _TRACE_ERRORS:
+        init = _tree_unwrap(tuple(ins))
+
+        def branch(fn):
+            def run(operand):
+                outs = fn(*_tree_wrap(operand))
+                return _tree_unwrap(outs)
+            return run
+
+        out = lax.cond(jnp.reshape(p, ()), branch(true_fn),
+                       branch(false_fn), init)
+        return _tree_wrap(out)
+    return true_fn(*ins) if pb else false_fn(*ins)
+
+
+def convert_while_loop(cond_fn, body_fn, ins):
+    """ref convert_operators.convert_while_loop."""
+    ins = tuple(ins)
+    first = cond_fn(*ins)
+    try:
+        cb = bool(_unwrap(first))
+    except _TRACE_ERRORS:
+        init = _tree_unwrap(ins)
+
+        def cond_w(carry):
+            return jnp.reshape(_unwrap(cond_fn(*_tree_wrap(carry))), ())
+
+        def body_w(carry):
+            return _tree_unwrap(body_fn(*_tree_wrap(carry)))
+
+        return _tree_wrap(lax.while_loop(cond_w, body_w, init))
+    vals = ins
+    while cb:
+        vals = tuple(body_fn(*vals))
+        cb = bool(_unwrap(cond_fn(*vals)))
+    return vals
+
+
+def convert_logical_and(a, b_thunk):
+    av = _unwrap(a)
+    try:
+        ab = bool(av)
+    except _TRACE_ERRORS:
+        return Tensor(jnp.logical_and(av, _unwrap(b_thunk())))
+    return b_thunk() if ab else a
+
+
+def convert_logical_or(a, b_thunk):
+    av = _unwrap(a)
+    try:
+        ab = bool(av)
+    except _TRACE_ERRORS:
+        return Tensor(jnp.logical_or(av, _unwrap(b_thunk())))
+    return a if ab else b_thunk()
+
+
+def convert_logical_not(a):
+    av = _unwrap(a)
+    try:
+        ab = bool(av)
+    except _TRACE_ERRORS:
+        return Tensor(jnp.logical_not(av))
+    return not ab
+
+
+_HELPERS = {
+    "_d2s_if": convert_ifelse,
+    "_d2s_while": convert_while_loop,
+    "_d2s_and": convert_logical_and,
+    "_d2s_or": convert_logical_or,
+    "_d2s_not": convert_logical_not,
+    "_d2s_ld": _d2s_ld,
+}
+
+
+# ---------------------------------------------------------------------------
+# scope analysis (skips nested scopes: defs, lambdas, comprehensions)
+# ---------------------------------------------------------------------------
+
+_NESTED_SCOPES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+                  ast.ListComp, ast.SetComp, ast.DictComp,
+                  ast.GeneratorExp, ast.ClassDef)
+
+
+def _walk_scope(node_or_list):
+    """Yield nodes of the current function scope only (never descends
+    into nested defs/lambdas/comprehensions, including when one is a
+    top-level element of the input list)."""
+    stack = list(node_or_list) if isinstance(node_or_list, list) \
+        else [node_or_list]
+    while stack:
+        n = stack.pop()
+        yield n
+        if isinstance(n, _NESTED_SCOPES):
+            continue
+        for child in ast.iter_child_nodes(n):
+            stack.append(child)
+
+
+def _stored_names(stmts):
+    out = []
+    for n in _walk_scope(stmts):
+        if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store):
+            if n.id not in out:
+                out.append(n.id)
+    return out
+
+
+def _has_escape(stmts, *, loop_level=False):
+    """True if the statements contain return (any depth in this scope)
+    or break/continue belonging to an enclosing loop."""
+    def scan(nodes, in_loop):
+        for n in nodes:
+            if isinstance(n, _NESTED_SCOPES):
+                continue
+            if isinstance(n, ast.Return):
+                return True
+            if isinstance(n, (ast.Break, ast.Continue)) and not in_loop:
+                return True
+            inner_loop = in_loop or isinstance(n, (ast.For, ast.While))
+            if scan(list(ast.iter_child_nodes(n)), inner_loop):
+                return True
+        return False
+
+    return scan(stmts, loop_level)
+
+
+# ---------------------------------------------------------------------------
+# the transformer
+# ---------------------------------------------------------------------------
+
+
+def _name(id_, ctx=None):
+    return ast.Name(id=id_, ctx=ctx or ast.Load())
+
+
+def _returns_in(stmts):
+    return [n for n in _walk_scope(stmts) if isinstance(n, ast.Return)]
+
+
+def _tail_return_only(stmts):
+    """True if the only Return in `stmts` is its final statement."""
+    rets = _returns_in(stmts)
+    return len(rets) == 1 and stmts and stmts[-1] is rets[0]
+
+
+def _has_break_continue(stmts):
+    def scan(nodes, in_loop):
+        for n in nodes:
+            if isinstance(n, _NESTED_SCOPES):
+                continue
+            if isinstance(n, (ast.Break, ast.Continue)) and not in_loop:
+                return True
+            inner = in_loop or isinstance(n, (ast.For, ast.While))
+            if scan(list(ast.iter_child_nodes(n)), inner):
+                return True
+        return False
+
+    return scan(stmts, False)
+
+
+def _absorb_tail_returns(stmts):
+    """Normalise `if c: ...; return A` + trailing code into
+    `if c: ...; return A  else: <trailing code>` (ref
+    return_transformer.py's early-return handling, restricted to
+    tail-position returns). Applied recursively outside loops."""
+    out = []
+    i = 0
+    while i < len(stmts):
+        s = stmts[i]
+        if isinstance(s, ast.If):
+            s.body = _absorb_tail_returns(s.body)
+            s.orelse = _absorb_tail_returns(s.orelse)
+            rest = stmts[i + 1:]
+            if (_tail_return_only(s.body)
+                    and not _has_break_continue(s.body)
+                    and not s.orelse and rest
+                    and not _has_break_continue(rest)):
+                s.orelse = _absorb_tail_returns(rest)
+                out.append(s)
+                return out
+        out.append(s)
+        i += 1
+    return out
+
+
+def _ld_tuple(names):
+    """(_d2s_ld(lambda: a), _d2s_ld(lambda: b), ...)"""
+    elts = [
+        ast.Call(func=_name("_d2s_ld"),
+                 args=[ast.Lambda(
+                     args=ast.arguments(posonlyargs=[], args=[],
+                                        kwonlyargs=[], kw_defaults=[],
+                                        defaults=[]),
+                     body=_name(n))],
+                 keywords=[])
+        for n in names
+    ]
+    return ast.Tuple(elts=elts, ctx=ast.Load())
+
+
+def _fn_def(name, params, body, returns):
+    args = ast.arguments(
+        posonlyargs=[], args=[ast.arg(arg=p) for p in params],
+        kwonlyargs=[], kw_defaults=[], defaults=[])
+    ret = ast.Return(value=ast.Tuple(
+        elts=[_name(r) for r in returns], ctx=ast.Load()))
+    return ast.FunctionDef(name=name, args=args, body=body + [ret],
+                           decorator_list=[], returns=None,
+                           type_params=[])
+
+
+class _ControlFlowTransformer(ast.NodeTransformer):
+    def __init__(self):
+        self.counter = 0
+        self.bail = None
+
+    def _next(self):
+        self.counter += 1
+        return self.counter
+
+    # nested scopes keep their own control flow untouched
+    def visit_FunctionDef(self, node):
+        return node
+
+    def visit_AsyncFunctionDef(self, node):
+        return node
+
+    def visit_Lambda(self, node):
+        return node
+
+    def visit_Global(self, node):
+        self.bail = "uses global"
+        return node
+
+    def visit_Nonlocal(self, node):
+        self.bail = "uses nonlocal"
+        return node
+
+    def visit_BoolOp(self, node):
+        self.generic_visit(node)
+        fn = "_d2s_and" if isinstance(node.op, ast.And) else "_d2s_or"
+        out = node.values[0]
+        for v in node.values[1:]:
+            thunk = ast.Lambda(
+                args=ast.arguments(posonlyargs=[], args=[],
+                                   kwonlyargs=[], kw_defaults=[],
+                                   defaults=[]),
+                body=v)
+            out = ast.Call(func=_name(fn), args=[out, thunk], keywords=[])
+        return out
+
+    def visit_UnaryOp(self, node):
+        self.generic_visit(node)
+        if isinstance(node.op, ast.Not):
+            return ast.Call(func=_name("_d2s_not"), args=[node.operand],
+                            keywords=[])
+        return node
+
+    def visit_If(self, node):
+        self.generic_visit(node)
+        if _has_escape(node.body) or _has_escape(node.orelse):
+            ret = self._try_returning_if(node)
+            if ret is not None:
+                return ret
+            # other early-exit shapes stay Python `if` (correct for
+            # concrete preds; a traced pred raises jax's tracer-bool
+            # error)
+            return node
+        outs = _stored_names(node.body + node.orelse)
+        n = self._next()
+        tname, fname = f"__d2s_true_{n}", f"__d2s_false_{n}"
+        tdef = _fn_def(tname, outs, node.body, outs)
+        return self._finish_if(node, n, tname, fname, tdef, outs)
+
+    def _try_returning_if(self, node):
+        """`if c: ...; return A else: ...; return B` (tail returns on
+        both sides) lowers to `return _d2s_if(...)`."""
+        if not (_tail_return_only(node.body) and node.orelse
+                and _tail_return_only(node.orelse)
+                and not _has_break_continue(node.body)
+                and not _has_break_continue(node.orelse)):
+            return None
+        params = _stored_names(node.body[:-1] + node.orelse[:-1])
+        n = self._next()
+        tname, fname = f"__d2s_rtrue_{n}", f"__d2s_rfalse_{n}"
+
+        def mk(name, body):
+            val = body[-1].value or ast.Constant(None)
+            d = _fn_def(name, params, body[:-1], [])
+            d.body[-1] = ast.Return(value=val)
+            return d
+
+        tdef, fdef = mk(tname, node.body), mk(fname, node.orelse)
+        call = ast.Call(func=_name("_d2s_if"),
+                        args=[node.test, _name(tname), _name(fname),
+                              _ld_tuple(params)],
+                        keywords=[])
+        return [tdef, fdef, ast.Return(value=call)]
+
+    def _finish_if(self, node, n, tname, fname, tdef, outs):
+        fbody = node.orelse if node.orelse else [ast.Pass()]
+        fdef = _fn_def(fname, outs, fbody, outs)
+        call = ast.Call(func=_name("_d2s_if"),
+                        args=[node.test, _name(tname), _name(fname),
+                              _ld_tuple(outs)],
+                        keywords=[])
+        target = ast.Tuple(elts=[_name(o, ast.Store()) for o in outs],
+                           ctx=ast.Store())
+        if outs:
+            assign = ast.Assign(targets=[target], value=call)
+        else:
+            assign = ast.Expr(value=call)
+        return [tdef, fdef, assign]
+
+    def visit_While(self, node):
+        self.generic_visit(node)
+        if node.orelse or _has_escape(node.body, loop_level=True):
+            return node
+        carried = _stored_names(node.body)
+        n = self._next()
+        cname, bname = f"__d2s_cond_{n}", f"__d2s_body_{n}"
+        cdef = _fn_def(cname, carried, [ast.Pass()], [])
+        cdef.body = [ast.Return(value=node.test)]
+        bdef = _fn_def(bname, carried, node.body, carried)
+        call = ast.Call(func=_name("_d2s_while"),
+                        args=[_name(cname), _name(bname),
+                              _ld_tuple(carried)],
+                        keywords=[])
+        target = ast.Tuple(
+            elts=[_name(c, ast.Store()) for c in carried],
+            ctx=ast.Store())
+        if carried:
+            assign = ast.Assign(targets=[target], value=call)
+        else:
+            assign = ast.Expr(value=call)
+        return [cdef, bdef, assign]
+
+    def visit_For(self, node):
+        # only `for <name> in range(...)` desugars; everything else stays
+        self.generic_visit(node)
+        if (node.orelse or _has_escape(node.body, loop_level=True)
+                or not isinstance(node.target, ast.Name)
+                or not isinstance(node.iter, ast.Call)
+                or not isinstance(node.iter.func, ast.Name)
+                or node.iter.func.id != "range"
+                or node.iter.keywords):
+            return node
+        rargs = node.iter.args
+        if len(rargs) == 1:
+            start, stop, step = ast.Constant(0), rargs[0], ast.Constant(1)
+        elif len(rargs) == 2:
+            start, stop, step = rargs[0], rargs[1], ast.Constant(1)
+        elif (len(rargs) == 3 and isinstance(rargs[2], ast.Constant)
+                and isinstance(rargs[2].value, int)
+                and rargs[2].value > 0):
+            start, stop, step = rargs
+        else:
+            return node  # negative/dynamic step: keep Python semantics
+        n = self._next()
+        ivar = f"__d2s_i_{n}"
+        init = ast.Assign(targets=[_name(ivar, ast.Store())], value=start)
+        test = ast.Compare(left=_name(ivar), ops=[ast.Lt()],
+                           comparators=[stop])
+        bind = ast.Assign(targets=[ast.Name(id=node.target.id,
+                                            ctx=ast.Store())],
+                          value=_name(ivar))
+        bump = ast.AugAssign(target=_name(ivar, ast.Store()),
+                             op=ast.Add(), value=step)
+        wl = ast.While(test=test, body=[bind] + node.body + [bump],
+                       orelse=[])
+        out = self.visit_While(wl)
+        stmts = out if isinstance(out, list) else [out]
+        return [init] + stmts
+
+
+# ---------------------------------------------------------------------------
+# entry points
+# ---------------------------------------------------------------------------
+
+
+def rewrite(fn):
+    """AST-rewrite `fn`'s control flow. Raises on untransformable input;
+    use maybe_rewrite for the fall-back-to-trace behavior."""
+    bound_self = getattr(fn, "__self__", None)
+    raw = fn.__func__ if bound_self is not None else fn
+    src = textwrap.dedent(inspect.getsource(raw))
+    tree = ast.parse(src)
+    fdef = tree.body[0]
+    if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        raise ValueError("to_static target is not a function")
+    fdef.decorator_list = []
+    fdef.body = _absorb_tail_returns(fdef.body)
+    tr = _ControlFlowTransformer()
+    new_body = []
+    for stmt in fdef.body:
+        out = tr.visit(stmt)
+        new_body.extend(out if isinstance(out, list) else [out])
+    if tr.bail:
+        raise ValueError(f"dy2static cannot rewrite: {tr.bail}")
+    fdef.body = new_body
+    ast.fix_missing_locations(tree)
+    code = compile(tree, filename=f"<dy2static {raw.__name__}>",
+                   mode="exec")
+    ns = dict(raw.__globals__)
+    ns.update(_HELPERS)
+    if raw.__closure__:
+        ns.update(zip(raw.__code__.co_freevars,
+                      [c.cell_contents for c in raw.__closure__]))
+    exec(code, ns)
+    new_fn = ns[raw.__name__]
+    new_fn = functools.wraps(raw)(new_fn)
+    if bound_self is not None:
+        return types.MethodType(new_fn, bound_self)
+    return new_fn
+
+
+def maybe_rewrite(fn):
+    """rewrite(fn), falling back to the original (trace-based capture)
+    when the source is unavailable or uses unsupported constructs."""
+    try:
+        return rewrite(fn)
+    except (OSError, TypeError, SyntaxError, ValueError) as e:
+        warnings.warn(
+            f"dy2static: AST rewrite of {getattr(fn, '__name__', fn)} "
+            f"failed ({e}); falling back to trace-based capture — "
+            "tensor-dependent Python control flow will not compile")
+        return fn
+
+
+class ProgramTranslator:
+    """ref ProgramTranslator singleton: global enable/disable switch."""
+
+    _instance = None
+    enable_to_static = True
+
+    def __new__(cls):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+        return cls._instance
+
+    @classmethod
+    def get_instance(cls):
+        return cls()
+
+    def enable(self, flag: bool):
+        ProgramTranslator.enable_to_static = bool(flag)
+
+
+def enable_to_static(flag: bool):
+    ProgramTranslator().enable(flag)

@@ -384,8 +384,6 @@ def deformable_conv(x, offset, mask, weight, *, stride=1, padding=0,
     base_y = (jnp.arange(oh) * st[0] - pd[0])[:, None]    # [OH,1]
     base_x = (jnp.arange(ow) * st[1] - pd[1])[None, :]    # [1,OW]
     off = offset.reshape(n, deformable_groups, kh * kw, 2, oh, ow)
-    if mask is not None:
-        msk = mask.reshape(n, deformable_groups, kh * kw, oh, ow)
 
     def per_image(img, off_i, msk_i):
         cols = []
@@ -406,10 +404,11 @@ def deformable_conv(x, offset, mask, weight, *, stride=1, padding=0,
         col = col.transpose(0, 2, 1, 3, 4).reshape(c, kh * kw, oh, ow)
         return col
 
-    cols = jax.vmap(per_image)(x, off,
-                               msk if mask is not None else
-                               jnp.ones((n, deformable_groups, kh * kw,
-                                         oh, ow), x.dtype))
+    if mask is not None:
+        msk = mask.reshape(n, deformable_groups, kh * kw, oh, ow)
+        cols = jax.vmap(per_image)(x, off, msk)
+    else:  # v1: no modulation — skip the mask multiply entirely
+        cols = jax.vmap(lambda i, o: per_image(i, o, None))(x, off)
     # cols is channel-major (c, kh*kw, ...): conv groups slice contiguous
     # channel blocks, so regroup and contract per group in one einsum
     cg2 = (c // groups) * kh * kw
