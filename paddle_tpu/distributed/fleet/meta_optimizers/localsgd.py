@@ -40,19 +40,23 @@ class LocalSGDOptimizer:
     def average_parameters(self):
         """Mean of every trainable parameter across jax processes
         (ref localsgd_optimizer.py _generate_avg_loss: c_allreduce/scale).
-        """
+        ONE collective over the whole parameter tree + one jitted tree
+        mean — not a per-parameter host loop."""
         if jax.process_count() <= 1:
             return
         from jax.experimental import multihost_utils
 
         params = [p for p in self.inner._parameter_list
                   if p is not None and not p.stop_gradient]
-        for p in params:
-            gathered = multihost_utils.process_allgather(
-                np.asarray(p._value))
+        tree = {i: p._value for i, p in enumerate(params)}
+        gathered = multihost_utils.process_allgather(tree)
+        # host-side f64-accumulated mean (the gather is the collective;
+        # jit would cap accumulation at f32 under default x64-off)
+        for i, p in enumerate(params):
+            dt = np.asarray(p._value).dtype
             p._value = jax.numpy.asarray(
-                np.mean(gathered, axis=0, dtype=np.float64)
-                .astype(np.asarray(p._value).dtype))
+                np.mean(np.asarray(gathered[i]), axis=0,
+                        dtype=np.float64).astype(dt))
 
 
 class AdaptiveLocalSGDOptimizer(LocalSGDOptimizer):

@@ -208,6 +208,9 @@ class HybridParallelEngine:
         embed_fn, head_fn = self.embed_fn, self.head_fn
         mesh = self.mesh
         opt = self.optimizer
+        from ..incubate.asp import masks_for as _masks_for
+
+        _asp_masks = _masks_for(self.model)
 
         from ..core.config import no_tape
 
@@ -282,6 +285,13 @@ class HybridParallelEngine:
             nr, orr = opt.apply_gradients_tree(rest_params, gr,
                                                opt_state["rest"], lr,
                                                metas=rest_metas)
+            if _asp_masks:
+                from ..incubate.asp import apply_masks_tree
+
+                # rest params keep their state-dict names; stacked block
+                # params trigger the helper's not-visible warning
+                nr = apply_masks_tree(self.model, nr,
+                                      engine_name="HybridParallelEngine")
             return loss, nb, nr, {"blocks": ob, "rest": orr}
 
         sh = self._shardings

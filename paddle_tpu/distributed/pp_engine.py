@@ -47,6 +47,9 @@ class PipelineEngine:
         loss_fn = self.loss_fn
         opt = self.optimizer
         M = self.accumulate_steps
+        from ..incubate.asp import masks_for
+
+        _asp_masks = masks_for(layer)
 
         def micro_loss(params, buffers, x_mb, y_mb, key):
             with _random.rng_scope(key):
@@ -81,6 +84,11 @@ class PipelineEngine:
                 grads = gc._clip_fn(grads)
             new_params, new_opt = opt.apply_gradients_tree(
                 params, grads, opt_state, lr, metas=metas)
+            if _asp_masks:
+                from ..incubate.asp import apply_masks_tree
+
+                new_params = apply_masks_tree(
+                    layer, new_params, engine_name="PipelineEngine")
             return lsum / M, new_params, new_opt
 
         self._step_fn = jax.jit(step_fn, donate_argnums=(0, 1))

@@ -260,6 +260,12 @@ def make_train_step(layer, loss_fn, optimizer, *, grad_clip=None,
     # per-param decay/lr-mult metadata baked in as compile-time constants
     # (mirrors eager Optimizer._preprocess; ADVICE r1 fix)
     _sd = layer.state_dict()
+    # ASP n:m masks re-applied in-graph after every update, so pruned
+    # weights stay zero on the compiled path too (ref asp_optimizer.py)
+    from .incubate.asp import apply_masks_tree as _asp_apply, \
+        masks_for as _asp_masks_for
+
+    asp_masks = _asp_masks_for(layer)
 
     def loss_of(params, buffers, batch, key):
         if comm_dtype is not None:
@@ -355,6 +361,9 @@ def make_train_step(layer, loss_fn, optimizer, *, grad_clip=None,
             grads = grad_clip._clip_fn(grads)
         new_params, new_opt = optimizer.apply_gradients_tree(
             params, grads, opt_state, lr, metas=metas)
+        if asp_masks:
+            new_params = _asp_apply(layer, new_params,
+                                    engine_name="Engine")
         if loss_scale is not None:
             # both static and dynamic scaling skip non-finite steps
             # (paddle GradScaler found_inf semantics)

@@ -87,6 +87,41 @@ def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
     return out
 
 
+def masks_for(layer):
+    """{param_name: mask} for this layer's pruned params — consumed by
+    the compiled engines (ref asp_optimizer.py ASPOptimizer: the same
+    re-masking, but inside the jitted step instead of a program pass).
+    Resolved through the layer's own Parameter identities, so models
+    sharing parameter names never pick up each other's masks.
+
+    Snapshotted when an engine builds its step (first train_batch):
+    call prune_model BEFORE the first step; pruning mid-training only
+    affects the eager ASPOptimizerWrapper path."""
+    return {k: _masks[id(p)] for k, p in layer.state_dict().items()
+            if id(p) in _masks}
+
+
+def apply_masks_tree(layer, new_params, *, engine_name="engine"):
+    """Masking hook shared by ALL compiled engines: re-apply this
+    layer's masks to the name-keyed `new_params` tree; warn once when a
+    pruned parameter is not visible under its name in the tree (e.g.
+    pipeline-stacked blocks rename it), so sparsity is never silently
+    dropped."""
+    masks = masks_for(layer)
+    if not masks:
+        return new_params
+    missing = [k for k in masks if k not in new_params]
+    if missing:
+        import warnings
+
+        warnings.warn(
+            f"ASP: {engine_name} cannot see pruned parameters "
+            f"{missing} under their names (renamed/stacked); their 2:4 "
+            "sparsity is NOT enforced on this path")
+    return {k: (v * masks[k].astype(v.dtype)) if k in masks else v
+            for k, v in new_params.items()}
+
+
 class ASPOptimizerWrapper:
     """Re-applies masks after each step so pruned weights stay zero
     (ref asp_optimizer.py ASPOptimizer)."""

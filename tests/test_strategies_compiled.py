@@ -1,0 +1,182 @@
+"""DGC / ASP / LocalSGD on the COMPILED engine path.
+
+Ref parity: fleet/meta_optimizers/{dgc_optimizer,asp_optimizer,
+localsgd_optimizer}.py — the reference implements these as program
+passes so they survive compilation; round-2 review found this repo ran
+them only in eager mode. Each test proves the semantics inside the
+jitted train step (and, for DGC's exchange, inside shard_map on the
+8-device mesh).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.engine import Engine
+from paddle_tpu.incubate import asp
+from paddle_tpu.distributed.fleet.meta_optimizers.dgc import (
+    DGCMomentumOptimizer, dgc_sparse_allreduce,
+)
+
+
+def _mse(out, y):
+    return ((out - y) ** 2).mean()
+
+
+def _batch(din=16, dout=8, n=8, seed=0):
+    rng = np.random.RandomState(seed)
+    return (rng.randn(n, din).astype(np.float32),
+            rng.randn(n, dout).astype(np.float32))
+
+
+def test_dgc_trains_through_engine():
+    """DGC as a real Optimizer: Engine compiles its _rule; residual
+    accumulators live in opt_state and carry across steps."""
+    paddle.seed(50)
+    m = nn.Linear(16, 8)
+    opt = DGCMomentumOptimizer(learning_rate=0.05, momentum=0.9,
+                               parameters=m.parameters(),
+                               rampup_begin_step=2, sparsity=(0.75,))
+    eng = Engine(m, opt, _mse)
+    x, y = _batch()
+    losses = [float(np.asarray(eng.train_batch((x,), (y,)).item()))
+              for _ in range(25)]
+    assert losses[-1] < losses[2] * 0.8, losses
+    # after compression begins, the residual accumulator holds unsent
+    # mass inside the COMPILED opt_state
+    v = eng.state.opt_state["weight"]["v"]
+    assert float(jnp.abs(v).sum()) > 0.0
+    t = eng.state.opt_state["weight"]["t"]
+    assert int(t) == 25
+
+
+def test_dgc_eager_matches_engine():
+    """Same seed + data: the eager step() and the compiled engine path
+    run the identical rule."""
+    paddle.seed(51)
+    m1 = nn.Linear(8, 4)
+    paddle.seed(51)
+    m2 = nn.Linear(8, 4)
+    x, y = _batch(8, 4)
+
+    o1 = DGCMomentumOptimizer(learning_rate=0.1, momentum=0.9,
+                              parameters=m1.parameters(),
+                              rampup_begin_step=1, sparsity=(0.5,))
+    o2 = DGCMomentumOptimizer(learning_rate=0.1, momentum=0.9,
+                              parameters=m2.parameters(),
+                              rampup_begin_step=1, sparsity=(0.5,))
+    eng = Engine(m2, o2, _mse)
+    eager_losses, eng_losses = [], []
+    for _ in range(6):
+        loss = _mse(m1(Tensor(x)), Tensor(y))
+        loss.backward()
+        o1.step()
+        o1.clear_grad()
+        eager_losses.append(float(loss.numpy()))
+        eng_losses.append(float(np.asarray(
+            eng.train_batch((x,), (y,)).item())))
+    np.testing.assert_allclose(eng_losses, eager_losses, rtol=1e-4)
+
+
+def test_dgc_sparse_allreduce_on_mesh():
+    """The exchange half inside shard_map over dp on the virtual mesh:
+    each rank ships k (index, value) pairs; the summed sparse update
+    matches a numpy reference of per-rank top-k with error feedback."""
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    ndev = min(4, jax.device_count())
+    if ndev < 2:
+        pytest.skip("needs >=2 devices")
+    mesh = Mesh(np.array(jax.devices()[:ndev]), ("dp",))
+    rng = np.random.RandomState(0)
+    g = rng.randn(ndev, 16).astype(np.float32)   # per-rank local grads
+    u0 = np.zeros_like(g)
+    v0 = np.zeros_like(g)
+    k = 3
+
+    def local(gg, uu, vv):
+        upd, u, v = dgc_sparse_allreduce(gg[0], uu[0], vv[0], k=k,
+                                         momentum=0.9, axis_name="dp")
+        return upd, u[None], v[None]
+
+    fn = jax.shard_map(local, mesh=mesh,
+                       in_specs=(P("dp"), P("dp"), P("dp")),
+                       out_specs=(P(), P("dp"), P("dp")),
+                       check_vma=False)
+    update, u1, v1 = jax.jit(fn)(g, u0, v0)
+
+    # numpy reference
+    want = np.zeros(16, np.float32)
+    wu, wv = [], []
+    for r in range(ndev):
+        u = 0.9 * u0[r] + g[r]
+        v = v0[r] + u
+        idx = np.argsort(-np.abs(v))[:k]
+        sel = np.zeros(16, bool)
+        sel[idx] = True
+        want[sel] += v[sel]
+        wu.append(np.where(sel, 0.0, u))
+        wv.append(np.where(sel, 0.0, v))
+    want /= ndev
+    np.testing.assert_allclose(np.asarray(update), want, rtol=1e-5,
+                               atol=1e-6)
+    np.testing.assert_allclose(np.asarray(u1), np.stack(wu), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(v1), np.stack(wv), rtol=1e-5)
+
+
+def test_asp_masks_survive_engine_training():
+    """round-2 weak #6: masks must be re-applied INSIDE the compiled
+    step, not only by the eager wrapper."""
+    paddle.seed(52)
+    model = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 8))
+    masks = asp.prune_model(model)
+    assert masks
+    opt = paddle.optimizer.Momentum(learning_rate=0.05,
+                                    parameters=model.parameters())
+    eng = Engine(model, opt, _mse)
+    x, y = _batch()
+    losses = [float(np.asarray(eng.train_batch((x,), (y,)).item()))
+              for _ in range(6)]
+    assert losses[-1] < losses[0]
+    # compiled-state params keep the 2:4 pattern
+    for name in masks:
+        arr = np.asarray(eng.state.params[name])
+        assert asp.check_sparsity(arr), name
+
+
+def test_localsgd_single_collective(monkeypatch):
+    """Averaging performs ONE process_allgather over the whole tree
+    (round-2 weak #7: was one host round-trip per parameter)."""
+    from paddle_tpu.distributed.fleet.meta_optimizers import localsgd
+
+    paddle.seed(53)
+    m = nn.Linear(8, 4)
+    inner = paddle.optimizer.SGD(learning_rate=0.1,
+                                 parameters=m.parameters())
+    opt = localsgd.LocalSGDOptimizer(inner, k_steps=1)
+
+    calls = []
+
+    def fake_allgather(tree):
+        calls.append(tree)
+        # simulate 2 processes: this rank's values + a zero replica
+        return jax.tree.map(
+            lambda a: jnp.stack([jnp.asarray(a),
+                                 jnp.zeros_like(jnp.asarray(a))]), tree)
+
+    monkeypatch.setattr(localsgd.jax, "process_count", lambda: 2)
+    import jax.experimental.multihost_utils as mh
+    monkeypatch.setattr(mh, "process_allgather", fake_allgather)
+
+    before = {k: np.asarray(v._value)
+              for k, v in m.state_dict().items()}
+    opt.average_parameters()
+    assert len(calls) == 1, "expected exactly one tree-wide collective"
+    for k, v in m.state_dict().items():
+        np.testing.assert_allclose(np.asarray(v._value), before[k] / 2,
+                                   rtol=1e-6)
