@@ -1,0 +1,57 @@
+"""Elastic fault-injection payload (ref
+test_fleet_launch_elastic.sh): two ranks train with auto-checkpointing;
+on the FIRST attempt rank 1 dies by SIGKILL mid-run. The launcher's
+elastic retry must relaunch the pod, and train_epoch_range must resume
+from the latest snapshot instead of epoch 0."""
+
+import os
+import signal
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(__file__)))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+import paddle_tpu.nn as nn  # noqa: E402
+from paddle_tpu.distributed import checkpoint as ckpt  # noqa: E402
+from paddle_tpu.engine import Engine  # noqa: E402
+
+out_dir = sys.argv[1]
+rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+kill_epoch = 2
+max_epoch = 6
+
+attempt_marker = os.path.join(out_dir, f"attempt_r{rank}")
+attempt = 1
+if os.path.exists(attempt_marker):
+    attempt = int(open(attempt_marker).read()) + 1
+with open(attempt_marker, "w") as f:
+    f.write(str(attempt))
+
+paddle.seed(7 + rank)
+model = nn.Linear(8, 4)
+opt = paddle.optimizer.Momentum(learning_rate=0.05, momentum=0.9,
+                                parameters=model.parameters())
+eng = Engine(model, opt, lambda out, y: ((out - y) ** 2).mean())
+rng = np.random.RandomState(rank)
+x = rng.randn(16, 8).astype(np.float32)
+y = rng.randn(16, 4).astype(np.float32)
+
+log = open(os.path.join(out_dir, f"epochs_r{rank}.log"), "a")
+ckpt_dir = os.path.join(out_dir, f"ckpt_r{rank}")
+for epoch in ckpt.train_epoch_range(max_epoch, ckpt_dir, eng,
+                                    save_interval=1):
+    if attempt == 1 and rank == 1 and epoch == kill_epoch:
+        # ungraceful death mid-epoch: no cleanup, no checkpoint flush
+        os.kill(os.getpid(), signal.SIGKILL)
+    loss = float(np.asarray(eng.train_batch((x,), (y,)).item()))
+    log.write(f"{attempt} {epoch} {loss:.6f}\n")
+    log.flush()
+
+log.close()
+print(f"RANK {rank} DONE attempt={attempt}", flush=True)

@@ -246,3 +246,61 @@ def test_train_epoch_range_restores_lr_scheduler(tmp_path):
     # and the layer weights were synced back for eager use
     np.testing.assert_allclose(np.asarray(m2.weight.numpy()),
                                np.asarray(eng2.state.params["weight"]))
+
+
+def test_hybrid_zero3_offload_round_trip(tmp_path):
+    """VERDICT r2 #6: save/restore a HybridParallelEngine mid-run at
+    ZeRO-3 (sharded params + opt state) with offload on; the resumed
+    loss must match the uninterrupted run exactly."""
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.hybrid import make_gpt_hybrid_engine
+    from paddle_tpu.distributed.topology import set_hybrid_communicate_group
+    from paddle_tpu.nlp.transformers import (
+        GPTConfig, GPTForPretraining, GPTPretrainingCriterion,
+    )
+
+    try:
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 2,
+                                   "pp_degree": 2, "sharding_degree": 2}
+        fleet.init(is_collective=True, strategy=strategy)
+        hcg = fleet.get_hybrid_communicate_group()
+
+        paddle.seed(9)
+        cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                        num_heads=4, max_seq_len=16, dropout=0.0,
+                        use_parallel=True)
+        model = GPTForPretraining(cfg)
+        crit = GPTPretrainingCriterion(cfg)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=model.parameters())
+        eng = make_gpt_hybrid_engine(model, crit, opt, hcg,
+                                     accumulate_steps=2, zero_stage=3,
+                                     offload=True)
+        toks = np.random.RandomState(2).randint(
+            0, 64, (4, 17)).astype(np.int32)
+        x, y = toks[:, :-1], toks[:, 1:]
+        eng.train_batch(x, y)
+        eng.train_batch(x, y)
+        ckpt.save_hybrid_state(str(tmp_path / "h3"), eng)
+        next_loss = float(eng.train_batch(x, y).item())
+
+        # fresh engine, different init, restore mid-run state
+        paddle.seed(321)
+        model2 = GPTForPretraining(cfg)
+        opt2 = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                      parameters=model2.parameters())
+        eng2 = make_gpt_hybrid_engine(model2, crit, opt2, hcg,
+                                      accumulate_steps=2, zero_stage=3,
+                                      offload=True)
+        ckpt.load_hybrid_state(str(tmp_path / "h3"), eng2)
+        resumed_loss = float(eng2.train_batch(x, y).item())
+        assert resumed_loss == pytest.approx(next_loss, rel=1e-6)
+        # block params really are ZeRO-3 sharded over 'sharding'
+        sharded = [
+            k for k, sh in eng2._shardings["blocks"].items()
+            if any(ax == "sharding" for ax in (sh.spec or ()) if ax)
+        ]
+        assert sharded, "no block param sharded at stage 3"
+    finally:
+        set_hybrid_communicate_group(None)

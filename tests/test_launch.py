@@ -58,3 +58,46 @@ def test_watchdog_kills_pod_on_child_failure(tmp_path):
     # the surviving rank sleeps 120s; the watchdog must not wait for it
     assert elapsed < 100, f"watchdog too slow: {elapsed}s"
     assert "terminating the pod" in proc.stderr
+
+
+def test_elastic_fault_injection_resumes_from_checkpoint(tmp_path):
+    """ref test_fleet_launch_elastic.sh: SIGKILL one rank mid-epoch; the
+    launcher must relaunch the pod and training must resume from the
+    auto-checkpoint, completing all epochs without restarting at 0."""
+    import subprocess
+    import sys
+
+    payload = os.path.join(REPO, "tests", "elastic_payload.py")
+    out = str(tmp_path)
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "2", "--elastic_retries", "1",
+         "--log_dir", os.path.join(out, "logs"), payload, out],
+        cwd=REPO, env=_clean_env(), timeout=300, capture_output=True,
+        text=True)
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    # the pod was relaunched exactly once
+    assert open(os.path.join(out, "attempt_r1")).read() == "2"
+    assert "elastic restart 1/1" in r.stderr
+
+    for rank in (0, 1):
+        lines = [l.split() for l in
+                 open(os.path.join(out, f"epochs_r{rank}.log"))]
+        epochs_by_attempt = {}
+        for att, ep, _ in lines:
+            epochs_by_attempt.setdefault(int(att), []).append(int(ep))
+        # full coverage, and at most ONE re-trained epoch (the one a
+        # SIGTERM can catch between its log line and its snapshot)
+        all_epochs = sorted(e for eps in epochs_by_attempt.values()
+                            for e in eps)
+        assert sorted(set(all_epochs)) == list(range(6)), (rank, lines)
+        assert len(all_epochs) <= 7, (rank, lines)
+        # a relaunched rank resumed at most one epoch behind where its
+        # first attempt stopped — never from scratch
+        if 2 in epochs_by_attempt:
+            assert min(epochs_by_attempt[2]) >= \
+                max(epochs_by_attempt[1]), (rank, lines)
+    # the killed rank specifically restarted from its epoch-1 snapshot
+    r1 = [l.split() for l in open(os.path.join(out, "epochs_r1.log"))]
+    a2 = [int(ep) for att, ep, _ in r1 if att == "2"]
+    assert a2 and min(a2) == 2, r1
