@@ -13,6 +13,43 @@ jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
+# -- suite tiers (ref unittests/CMakeLists.txt DIST/EXCLUSIVE/NIGHTLY
+# labels): `-m smoke` < 2 min core loop; `-m dist` = multi-device /
+# multi-process; everything else is the full tier. Markers attach by
+# module so new tests inherit a tier automatically.
+_SMOKE_MODULES = {
+    "test_ops_math", "test_autograd", "test_advice_r1", "test_advice_r2",
+    "test_dy2static", "test_selected_rows", "test_optimizer",
+    "test_static", "test_controlflow_pylayer", "test_nn_layers",
+    "test_asp_dgc", "test_fs_metrics_opversion", "test_beam_search",
+}
+_DIST_MODULES = {
+    "test_multichip_sweep", "test_distributed_parallel",
+    "test_pipeline_schedule", "test_launch", "test_zero2_lars",
+    "test_zero3_offload", "test_context_parallel",
+    "test_parameter_server", "test_strategies_compiled",
+}
+
+
+def pytest_configure(config):
+    config.addinivalue_line("markers", "smoke: fast core tier (<2 min)")
+    config.addinivalue_line("markers", "dist: multi-device/process tier")
+    config.addinivalue_line("markers", "full: everything else")
+
+
+def pytest_collection_modifyitems(items):
+    tiers = {"smoke", "dist", "full"}
+    for item in items:
+        if any(m.name in tiers for m in item.iter_markers()):
+            continue  # explicit per-test tier wins over the module tier
+        mod = item.module.__name__.rsplit(".", 1)[-1]
+        if mod in _SMOKE_MODULES:
+            item.add_marker(pytest.mark.smoke)
+        elif mod in _DIST_MODULES:
+            item.add_marker(pytest.mark.dist)
+        else:
+            item.add_marker(pytest.mark.full)
+
 
 @pytest.fixture(autouse=True)
 def _seed():
