@@ -20,11 +20,13 @@ from .runtime import (  # noqa: F401
     DistributedEmbedding, PSOptimizer, PSRoleMaker, PSRuntime, get_runtime,
     init_runtime,
 )
+from .heter import TPUEmbeddingCache  # noqa: F401
 from .service import Communicator, PSClient, PSServer  # noqa: F401
 from .tables import DenseTable, SparseTable  # noqa: F401
 
 __all__ = [
     "PSRoleMaker", "PSRuntime", "PSServer", "PSClient", "Communicator",
     "DenseTable", "SparseTable", "DistributedEmbedding", "PSOptimizer",
+    "TPUEmbeddingCache",
     "get_runtime", "init_runtime",
 ]

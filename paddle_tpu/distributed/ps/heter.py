@@ -1,0 +1,158 @@
+"""HeterPS analogue: accelerator-resident embedding cache over PS tables.
+
+Ref parity: paddle/fluid/framework/fleet/ps_gpu_wrapper.h:50 +
+fleet/heter_ps/ — the reference builds a per-pass "GPU table" of the
+feasigns a pass will touch, trains whole passes against accelerator
+memory (the optimizer runs on the accelerator), and syncs back to the
+host/SSD table at pass end. TPU-native redesign: the cache is one
+[capacity, dim] device array (gathers/updates ride the VPU; no per-row
+device hashmap — the id->slot map is host-side numpy), misses arrive in
+a single batched pull_sparse, the SGD update applies on device from the
+lookup's gradient, and `flush()` pushes per-row DELTAS merged by an
+optimizer='sum' server table, so multiple trainers compose exactly like
+the reference's pass-end sync.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from .runtime import get_runtime
+
+
+class TPUEmbeddingCache:
+    """Device-cached sparse embedding with write-back to the PS.
+
+    lookup ids -> device gather; gradients update the cache ON DEVICE
+    (local SGD, ref heter_ps optimizer.cuh); `flush()` (= the
+    reference's end_pass) ships accumulated row deltas to the servers.
+    """
+
+    def __init__(self, name, dim, capacity, *, lr=0.01, init_range=0.05,
+                 runtime=None):
+        self.name = name
+        self.dim = int(dim)
+        self.capacity = int(capacity)
+        self.lr = float(lr)
+        self.runtime = runtime or get_runtime()
+        # deltas merge server-side: multiple trainers' pass-end syncs sum
+        self.runtime.client.create_sparse_table(
+            name, dim, optimizer="sum", init_range=init_range)
+        self.cache = jnp.zeros((self.capacity, self.dim), jnp.float32)
+        self._base = np.zeros((self.capacity, self.dim), np.float32)
+        self._ids = np.full(self.capacity, -1, np.int64)   # slot -> id
+        self._slot_of: dict[int, int] = {}                 # id -> slot
+        self._dirty = np.zeros(self.capacity, bool)
+        self._last_used = np.zeros(self.capacity, np.int64)
+        self._clock = 0
+        self.hits = 0
+        self.misses = 0
+
+    # -- cache management ----------------------------------------------------
+    def prepare(self, ids) -> None:
+        """Ensure every id is resident (the reference's BuildPull /
+        pass-begin): one batched pull for all misses; LRU slots not used
+        by THIS batch are evicted, dirty ones flushed first."""
+        uniq = np.unique(np.asarray(ids, np.int64).reshape(-1))
+        self._clock += 1
+        resident = np.fromiter(
+            (i in self._slot_of for i in uniq), bool, len(uniq))
+        hit_slots = np.fromiter(
+            (self._slot_of[i] for i in uniq[resident]), np.int64,
+            int(resident.sum()))
+        self._last_used[hit_slots] = self._clock
+        miss_ids = uniq[~resident]
+        self.hits += int(resident.sum())
+        self.misses += miss_ids.size
+        if miss_ids.size == 0:
+            return
+        if uniq.size > self.capacity:
+            # hits are pinned for this batch, so residency needs room
+            # for EVERY unique id in it, not just the misses
+            raise ValueError(
+                f"batch touches {uniq.size} unique rows > cache "
+                f"capacity {self.capacity}")
+        # deltas still buffered in the communicator (geo accumulator /
+        # async queue) must land before the pull, or a re-touched
+        # evicted id reads a stale row missing its own update
+        self.runtime.communicator.flush()
+        # free slots first, then LRU among slots this batch doesn't use
+        free = np.nonzero(self._ids < 0)[0]
+        need = miss_ids.size - free.size
+        victims = np.empty(0, np.int64)
+        if need > 0:
+            used_now = np.zeros(self.capacity, bool)
+            used_now[hit_slots] = True
+            cand = np.nonzero(~used_now & (self._ids >= 0))[0]
+            order = np.argsort(self._last_used[cand], kind="stable")
+            victims = cand[order[:need]]
+            self._evict(victims)
+        slots = np.concatenate([free[:miss_ids.size], victims])[
+            :miss_ids.size]
+        rows = self.runtime.client.pull_sparse(self.name, miss_ids)
+        self.cache = self.cache.at[jnp.asarray(slots)].set(
+            jnp.asarray(rows))
+        self._base[slots] = rows
+        self._ids[slots] = miss_ids
+        self._dirty[slots] = False
+        self._last_used[slots] = self._clock
+        for i, s in zip(miss_ids.tolist(), slots.tolist()):
+            self._slot_of[i] = s
+
+    def _evict(self, slots) -> None:
+        dirty = slots[self._dirty[slots]]
+        if dirty.size:
+            self._push_deltas(dirty)
+        for s in slots.tolist():
+            self._slot_of.pop(int(self._ids[s]), None)
+        self._ids[slots] = -1
+        self._dirty[slots] = False
+
+    def _push_deltas(self, slots) -> None:
+        vals = np.asarray(self.cache[jnp.asarray(slots)])
+        deltas = vals - self._base[slots]
+        self.runtime.communicator.push_sparse(
+            self.name, self._ids[slots], deltas)
+        self._base[slots] = vals
+
+    def flush(self) -> None:
+        """Pass-end sync (ref ps_gpu_wrapper EndPass): push every dirty
+        row's delta; the cache stays resident for the next pass."""
+        dirty = np.nonzero(self._dirty)[0]
+        if dirty.size:
+            self._push_deltas(dirty)
+            self._dirty[dirty] = False
+        self.runtime.communicator.flush()
+
+    # -- training-path lookup ------------------------------------------------
+    def __call__(self, ids):
+        from ...core.dispatch import apply
+        from ...core.tensor import Tensor
+
+        ids_arr = np.asarray(
+            ids._value if isinstance(ids, Tensor) else ids, np.int64)
+        self.prepare(ids_arr)
+        slots = np.fromiter((self._slot_of[i] for i in
+                             ids_arr.reshape(-1).tolist()),
+                            np.int64, ids_arr.size).reshape(ids_arr.shape)
+        table = Tensor(self.cache, stop_gradient=False)
+        touched = np.unique(slots)
+
+        def sgd_hook(grad):
+            # the optimizer runs ON the accelerator (ref heter_ps
+            # optimizer.cuh): one device op, no host round-trip
+            self.cache = self.cache - self.lr * grad._value
+            self._dirty[touched] = True
+            return None
+
+        table.register_hook(sgd_hook)
+        return apply("lookup_table_v2",
+                     jnp.asarray(slots, jnp.int32), table,
+                     padding_idx=-1)
+
+    @property
+    def hit_rate(self) -> float:
+        total = self.hits + self.misses
+        return self.hits / total if total else 0.0

@@ -28,6 +28,7 @@ _DIST_MODULES = {
     "test_pipeline_schedule", "test_launch", "test_zero2_lars",
     "test_zero3_offload", "test_context_parallel",
     "test_parameter_server", "test_strategies_compiled",
+    "test_heter_ps",
 }
 
 
@@ -57,3 +58,28 @@ def _seed():
 
     paddle.seed(1234)
     yield
+
+
+@pytest.fixture()
+def ps_runtime():
+    """In-process PS server + sync trainer runtime (shared by the PS and
+    heter-cache suites)."""
+    from paddle_tpu.distributed import ps
+    from paddle_tpu.distributed.ps.service import Communicator
+    import paddle_tpu.distributed.ps.runtime as rtmod
+
+    srv = ps.PSServer("127.0.0.1:0").start()
+    eps = [f"127.0.0.1:{srv.port}"]
+    client = ps.PSClient(eps)
+    rm = ps.PSRoleMaker(server_endpoints=eps, role="TRAINER",
+                        trainer_id=0, n_trainers=1)
+    rt = ps.PSRuntime(rm, mode="sync")
+    rt._client = client
+    rt._communicator = Communicator(client, mode="sync").start()
+    prev = getattr(rtmod, "_runtime", None)
+    rtmod._runtime = rt
+    yield rt
+    rtmod._runtime = prev
+    client.stop_servers()
+    client.close()
+    srv.stop()
