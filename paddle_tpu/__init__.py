@@ -110,6 +110,7 @@ from . import profiler  # noqa: F401,E402
 from . import autograd  # noqa: F401,E402
 from . import static  # noqa: F401,E402
 from . import text  # noqa: F401,E402
+from . import rec  # noqa: F401,E402
 from . import inference  # noqa: F401,E402
 from . import utils  # noqa: F401,E402
 from .framework.flags import get_flags, set_flags  # noqa: F401,E402

@@ -18,8 +18,24 @@ __all__ = [
     "decorate", "set_excluded_layers", "reset_excluded_layers",
 ]
 
+import weakref
+
 _excluded_names: set = set()
-_masks: dict = {}  # id(param) -> jnp mask
+# id(param) -> (weakref(param), jnp mask). The weakref guards against
+# CPython id reuse: a dead parameter's id can be recycled by an
+# unrelated Parameter, which must NOT inherit the mask.
+_masks: dict = {}
+
+
+def _mask_of(p):
+    entry = _masks.get(id(p))
+    if entry is None:
+        return None
+    ref, mask = entry
+    if ref() is not p:
+        del _masks[id(p)]  # stale id-reuse entry
+        return None
+    return mask
 
 
 def calculate_density(mat) -> float:
@@ -82,7 +98,11 @@ def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
         jmask = jnp.asarray(mask, p._value.dtype)
         p._value = p._value * jmask
         if with_mask:
-            _masks[id(p)] = jmask
+            pid = id(p)
+            # the callback evicts the entry when the parameter dies, so
+            # masks of discarded models don't accumulate
+            _masks[pid] = (weakref.ref(
+                p, lambda _, pid=pid: _masks.pop(pid, None)), jmask)
         out[name] = mask
     return out
 
@@ -97,8 +117,12 @@ def masks_for(layer):
     Snapshotted when an engine builds its step (first train_batch):
     call prune_model BEFORE the first step; pruning mid-training only
     affects the eager ASPOptimizerWrapper path."""
-    return {k: _masks[id(p)] for k, p in layer.state_dict().items()
-            if id(p) in _masks}
+    out = {}
+    for k, p in layer.state_dict().items():
+        mask = _mask_of(p)
+        if mask is not None:
+            out[k] = mask
+    return out
 
 
 def apply_masks_tree(layer, new_params, *, engine_name="engine"):
@@ -135,7 +159,7 @@ class ASPOptimizerWrapper:
     def step(self):
         self.inner.step()
         for p in self.inner._parameter_list:
-            mask = _masks.get(id(p))
+            mask = _mask_of(p)
             if mask is not None:
                 p._value = p._value * mask
 
