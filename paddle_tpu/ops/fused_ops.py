@@ -6,8 +6,10 @@ CUDA kernels for attention and friends; here the TPU equivalents are
 Pallas/Mosaic kernels with custom-VJP backward passes.
 
 flash_attention: blockwise online-softmax attention (fwd) + the standard
-two-pass recompute backward (dq pass gridded over q blocks, dkv pass
-gridded over kv blocks).  Layout [batch, heads, seq, head_dim].  A jnp
+two-pass recompute backward on 3-D grids (dq: bh x q-block x k-block;
+dkv: bh x k-block x q-block) whose innermost dim accumulates into f32
+VMEM scratch, so VMEM use is bounded by block sizes and the kernel
+scales to 8k+ sequences.  Layout [batch, heads, seq, head_dim].  A jnp
 reference path with the identical log-sum-exp formulation runs on CPU so
 the same op (and its gradients) is testable without a TPU; set
 PADDLE_TPU_FLASH_FORCE=pallas to exercise the kernels in interpreter mode.
@@ -70,18 +72,31 @@ def _block_k(sk: int) -> int:
     return min(_BLOCK_K, _round_up(sk, 128))
 
 
-def _compiler_params(n_parallel: int):
-    """All grid dims of these kernels are independent (k/v arrive whole
-    per invocation); telling Mosaic lets it skip revisiting state."""
+def _compiler_params(semantics):
+    """Mosaic grid-dimension semantics ('parallel' dims never revisit
+    state; 'arbitrary' dims run sequentially for accumulation)."""
     if not _HAS_PLTPU:
         return None
-    return pltpu.CompilerParams(
-        dimension_semantics=("parallel",) * n_parallel)
+    return pltpu.CompilerParams(dimension_semantics=tuple(semantics))
+
+
+_warned_no_pltpu = False
 
 
 def _use_pallas(seq_q=None) -> bool:
     force = os.environ.get("PADDLE_TPU_FLASH_FORCE", "")
     if force == "pallas":
+        if not _HAS_PLTPU:
+            # the kernels need pltpu (VMEM scratch, PRNG); without it
+            # the numerically-identical jnp formulation serves
+            global _warned_no_pltpu
+            if not _warned_no_pltpu:
+                _warned_no_pltpu = True
+                import warnings
+
+                warnings.warn("pallas TPU backend unavailable; "
+                              "flash_attention uses the jnp path")
+            return False
         return True
     if force == "jnp":
         return False
@@ -252,7 +267,7 @@ def _flash_fwd_pallas(q, k, v, seed, scale, causal, dropout_p):
             jax.ShapeDtypeStruct((bh, sq_pad, d), q.dtype),
             jax.ShapeDtypeStruct((bh, sq_pad, 128), jnp.float32),
         ],
-        compiler_params=_compiler_params(2),
+        compiler_params=_compiler_params(("parallel", "parallel")),
         interpret=_interpret(),
     )(qpos, bhpos, seed_arr, q, k, v)
     return o[:, :sq], lse[:, :sq, 0]
@@ -263,92 +278,101 @@ def _flash_fwd_pallas(q, k, v, seed, scale, causal, dropout_p):
 # ---------------------------------------------------------------------------
 
 
-def _bwd_dq_kernel(qpos_ref, bhpos_ref, seed_ref, q_ref, k_ref, v_ref,
-                   do_ref, lse_ref, delta_ref, dq_ref, *, scale, causal,
-                   kv_len, block_k, causal_off, dropout_p):
+def _bwd_dq_kernel(qpos_ref, kpos_ref, bhpos_ref, seed_ref, q_ref, k_ref,
+                   v_ref, do_ref, lse_ref, delta_ref, dq_ref, acc_ref, *,
+                   scale, causal, kv_len, last_k_off, causal_off,
+                   dropout_p):
+    # 3-D grid (bh, q block, k block): the k dim is innermost/sequential
+    # and accumulates into an f32 VMEM scratch, so VMEM use is bounded
+    # by the BLOCK sizes, not the sequence length.
     # lse_ref/delta_ref: (1, bq, 128) lane-broadcast (see _fwd_kernel)
-    bq, d = q_ref.shape[1], q_ref.shape[2]
-    sk = k_ref.shape[1]
-    nk = sk // block_k
+    bq = q_ref.shape[1]
+    bk = k_ref.shape[1]
     q = q_ref[0]
     do = do_ref[0]
     lse = lse_ref[0, :, 0]
     delta = delta_ref[0, :, 0]
     q_off = qpos_ref[0, 0, 0]
-    bh_idx = bhpos_ref[0, 0, 0]
-    seed = seed_ref[0, 0, 0]
-    q_idx = q_off + lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
-
-    def body(t, dq):
-        k = k_ref[0, pl.dslice(t * block_k, block_k), :]
-        v = v_ref[0, pl.dslice(t * block_k, block_k), :]
-        s = _mm(q, k, 1, 1) * scale
-        k_idx = t * block_k + lax.broadcasted_iota(
-            jnp.int32, (bq, block_k), 1)
-        mask = k_idx < kv_len
-        if causal:
-            mask = mask & (q_idx + causal_off >= k_idx)
-        p = jnp.where(mask, jnp.exp(s - lse[:, None]), 0.0)
-        dp = _mm(do, v, 1, 1)
-        if dropout_p > 0.0:
-            dp = dp * _drop_mask(seed, bh_idx, q_off, t * block_k,
-                                 (bq, block_k), dropout_p)
-        ds = (p * (dp - delta[:, None])).astype(k.dtype)
-        return dq + jnp.dot(ds, k, preferred_element_type=jnp.float32)
-
-    dq = lax.fori_loop(0, nk, body, jnp.zeros((bq, d), jnp.float32))
-    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
-
-
-def _bwd_dkv_kernel(kpos_ref, bhpos_ref, seed_ref, q_ref, k_ref, v_ref,
-                    do_ref, lse_ref, delta_ref, dk_ref, dv_ref, *, scale,
-                    causal, q_len, block_q, causal_off, dropout_p):
-    bk, d = k_ref.shape[1], k_ref.shape[2]
-    sq = q_ref.shape[1]
-    nq = sq // block_q
-    k = k_ref[0]
-    v = v_ref[0]
     k_off = kpos_ref[0, 0, 0]
     bh_idx = bhpos_ref[0, 0, 0]
     seed = seed_ref[0, 0, 0]
-    k_idx = k_off + lax.broadcasted_iota(jnp.int32, (block_q, bk), 1)
 
-    def body(t, carry):
-        dk, dv = carry
-        q = q_ref[0, pl.dslice(t * block_q, block_q), :]
-        do = do_ref[0, pl.dslice(t * block_q, block_q), :]
-        lse = lse_ref[0, pl.dslice(t * block_q, block_q), 0]
-        delta = delta_ref[0, pl.dslice(t * block_q, block_q), 0]
-        s = _mm(q, k, 1, 1) * scale
-        q_idx = t * block_q + lax.broadcasted_iota(
-            jnp.int32, (block_q, bk), 0)
-        # padded q rows have lse=0 from the padded forward => exp(s) can
-        # explode; mask on q_len as well as causal structure.
-        mask = q_idx < q_len
-        if causal:
-            mask = mask & (q_idx + causal_off >= k_idx)
-        p = jnp.where(mask, jnp.exp(s - lse[:, None]), 0.0)
-        if dropout_p > 0.0:
-            # same (q_off, k_off) tile coordinates as the forward
-            dmask = _drop_mask(seed, bh_idx, t * block_q, k_off,
-                               (block_q, bk), dropout_p)
-            pd = p * dmask
-        else:
-            dmask = None
-            pd = p
-        dv = dv + _mm(pd.astype(do.dtype), do, 0, 0)
-        dp = _mm(do, v, 1, 1)
-        if dmask is not None:
-            dp = dp * dmask
-        ds = (p * (dp - delta[:, None])).astype(q.dtype)
-        dk = dk + _mm(ds, q, 0, 0)
-        return dk, dv
+    @pl.when(k_off == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
 
-    dk0 = jnp.zeros((bk, d), jnp.float32)
-    dv0 = jnp.zeros((bk, d), jnp.float32)
-    dk, dv = lax.fori_loop(0, nq, body, (dk0, dv0))
-    dk_ref[0] = (dk * scale).astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
+    q_idx = q_off + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    k_idx = k_off + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    k = k_ref[0]
+    v = v_ref[0]
+    s = _mm(q, k, 1, 1) * scale
+    mask = k_idx < kv_len
+    if causal:
+        mask = mask & (q_idx + causal_off >= k_idx)
+    p = jnp.where(mask, jnp.exp(s - lse[:, None]), 0.0)
+    dp = _mm(do, v, 1, 1)
+    if dropout_p > 0.0:
+        dp = dp * _drop_mask(seed, bh_idx, q_off, k_off, (bq, bk),
+                             dropout_p)
+    ds = (p * (dp - delta[:, None])).astype(k.dtype)
+    acc_ref[...] += jnp.dot(ds, k, preferred_element_type=jnp.float32)
+
+    @pl.when(k_off == last_k_off)
+    def _done():
+        dq_ref[0] = (acc_ref[...] * scale).astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(kpos_ref, qpos_ref, bhpos_ref, seed_ref, q_ref,
+                    k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
+                    dv_ref, dk_acc, dv_acc, *, scale, causal, q_len,
+                    last_q_off, causal_off, dropout_p):
+    # 3-D grid (bh, k block, q block), q innermost/sequential
+    bk = k_ref.shape[1]
+    bq = q_ref.shape[1]
+    k = k_ref[0]
+    v = v_ref[0]
+    k_off = kpos_ref[0, 0, 0]
+    q_off = qpos_ref[0, 0, 0]
+    bh_idx = bhpos_ref[0, 0, 0]
+    seed = seed_ref[0, 0, 0]
+
+    @pl.when(q_off == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    q = q_ref[0]
+    do = do_ref[0]
+    lse = lse_ref[0, :, 0]
+    delta = delta_ref[0, :, 0]
+    s = _mm(q, k, 1, 1) * scale
+    q_idx = q_off + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    k_idx = k_off + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    # padded q rows have lse=0 from the padded forward => exp(s) can
+    # explode; mask on q_len as well as causal structure.
+    mask = q_idx < q_len
+    if causal:
+        mask = mask & (q_idx + causal_off >= k_idx)
+    p = jnp.where(mask, jnp.exp(s - lse[:, None]), 0.0)
+    if dropout_p > 0.0:
+        # same (q_off, k_off) tile coordinates as the forward
+        dmask = _drop_mask(seed, bh_idx, q_off, k_off, (bq, bk),
+                           dropout_p)
+        pd = p * dmask
+    else:
+        dmask = None
+        pd = p
+    dv_acc[...] += _mm(pd.astype(do.dtype), do, 0, 0)
+    dp = _mm(do, v, 1, 1)
+    if dmask is not None:
+        dp = dp * dmask
+    ds = (p * (dp - delta[:, None])).astype(q.dtype)
+    dk_acc[...] += _mm(ds, q, 0, 0)
+
+    @pl.when(q_off == last_q_off)
+    def _done():
+        dk_ref[0] = (dk_acc[...] * scale).astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
 
 
 def _flash_bwd_pallas(q, k, v, o, lse, do, seed, scale, causal,
@@ -373,59 +397,72 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, seed, scale, causal,
     vmem = pltpu.VMEM if _HAS_PLTPU else None
     bspec = lambda shape, imap: pl.BlockSpec(  # noqa: E731
         shape, imap, memory_space=vmem)
-    qpos, bhpos, pos_spec_q, bh_spec, seed_spec = _pos_inputs(bh, nq, bq)
-    kpos, _, pos_spec_k, _, _ = _pos_inputs(bh, nk, bk)
+    qpos, bhpos, _, _, _ = _pos_inputs(bh, nq, bq)
+    kpos, _, _, _, _ = _pos_inputs(bh, nk, bk)
     seed_arr = _seed_input(seed)
+    pos128 = lambda imap: bspec((1, 8, 128), imap)  # noqa: E731
+    scratch = pltpu.VMEM if _HAS_PLTPU else None
 
+    # dq: grid (bh, q block, k block) — k sequential into f32 scratch
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                          kv_len=sk, block_k=bk, causal_off=sk - sq,
-                          dropout_p=dropout_p),
-        grid=(bh, nq),
+                          kv_len=sk, last_k_off=(nk - 1) * bk,
+                          causal_off=sk - sq, dropout_p=dropout_p),
+        grid=(bh, nq, nk),
         in_specs=[
-            pos_spec_q,
-            bh_spec,
-            seed_spec,
-            bspec((1, bq, d), lambda i, j: (i, j, 0)),
-            bspec((1, sk_pad, d), lambda i, j: (i, 0, 0)),
-            bspec((1, sk_pad, d), lambda i, j: (i, 0, 0)),
-            bspec((1, bq, d), lambda i, j: (i, j, 0)),
-            bspec((1, bq, 128), lambda i, j: (i, j, 0)),
-            bspec((1, bq, 128), lambda i, j: (i, j, 0)),
+            pos128(lambda i, j, t: (j, 0, 0)),
+            pos128(lambda i, j, t: (t, 0, 0)),
+            pos128(lambda i, j, t: (i, 0, 0)),
+            pos128(lambda i, j, t: (0, 0, 0)),
+            bspec((1, bq, d), lambda i, j, t: (i, j, 0)),
+            bspec((1, bk, d), lambda i, j, t: (i, t, 0)),
+            bspec((1, bk, d), lambda i, j, t: (i, t, 0)),
+            bspec((1, bq, d), lambda i, j, t: (i, j, 0)),
+            bspec((1, bq, 128), lambda i, j, t: (i, j, 0)),
+            bspec((1, bq, 128), lambda i, j, t: (i, j, 0)),
         ],
-        out_specs=bspec((1, bq, d), lambda i, j: (i, j, 0)),
+        out_specs=bspec((1, bq, d), lambda i, j, t: (i, j, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, sq_pad, d), q.dtype),
-        compiler_params=_compiler_params(2),
+        scratch_shapes=[scratch((bq, d), jnp.float32)] if _HAS_PLTPU
+        else [],
+        compiler_params=_compiler_params(
+            ("parallel", "parallel", "arbitrary")),
         interpret=_interpret(),
-    )(qpos, bhpos, seed_arr, qp, kp, vp, dop, lsep, deltap)
+    )(qpos, kpos, bhpos, seed_arr, qp, kp, vp, dop, lsep, deltap)
 
+    # dk/dv: grid (bh, k block, q block) — q sequential into scratch
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
-                          q_len=sq, block_q=bq, causal_off=sk - sq,
-                          dropout_p=dropout_p),
-        grid=(bh, nk),
+                          q_len=sq, last_q_off=(nq - 1) * bq,
+                          causal_off=sk - sq, dropout_p=dropout_p),
+        grid=(bh, nk, nq),
         in_specs=[
-            pos_spec_k,
-            bh_spec,
-            seed_spec,
-            bspec((1, sq_pad, d), lambda i, j: (i, 0, 0)),
-            bspec((1, bk, d), lambda i, j: (i, j, 0)),
-            bspec((1, bk, d), lambda i, j: (i, j, 0)),
-            bspec((1, sq_pad, d), lambda i, j: (i, 0, 0)),
-            bspec((1, sq_pad, 128), lambda i, j: (i, 0, 0)),
-            bspec((1, sq_pad, 128), lambda i, j: (i, 0, 0)),
+            pos128(lambda i, j, t: (j, 0, 0)),
+            pos128(lambda i, j, t: (t, 0, 0)),
+            pos128(lambda i, j, t: (i, 0, 0)),
+            pos128(lambda i, j, t: (0, 0, 0)),
+            bspec((1, bq, d), lambda i, j, t: (i, t, 0)),
+            bspec((1, bk, d), lambda i, j, t: (i, j, 0)),
+            bspec((1, bk, d), lambda i, j, t: (i, j, 0)),
+            bspec((1, bq, d), lambda i, j, t: (i, t, 0)),
+            bspec((1, bq, 128), lambda i, j, t: (i, t, 0)),
+            bspec((1, bq, 128), lambda i, j, t: (i, t, 0)),
         ],
         out_specs=[
-            bspec((1, bk, d), lambda i, j: (i, j, 0)),
-            bspec((1, bk, d), lambda i, j: (i, j, 0)),
+            bspec((1, bk, d), lambda i, j, t: (i, j, 0)),
+            bspec((1, bk, d), lambda i, j, t: (i, j, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, sk_pad, d), k.dtype),
             jax.ShapeDtypeStruct((bh, sk_pad, d), v.dtype),
         ],
-        compiler_params=_compiler_params(2),
+        scratch_shapes=[scratch((bk, d), jnp.float32),
+                        scratch((bk, d), jnp.float32)] if _HAS_PLTPU
+        else [],
+        compiler_params=_compiler_params(
+            ("parallel", "parallel", "arbitrary")),
         interpret=_interpret(),
-    )(kpos, bhpos, seed_arr, qp, kp, vp, dop, lsep, deltap)
+    )(kpos, qpos, bhpos, seed_arr, qp, kp, vp, dop, lsep, deltap)
     return dq[:, :sq], dk[:, :sk], dv[:, :sk]
 
 

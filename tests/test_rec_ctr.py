@@ -10,6 +10,7 @@ device-resident cache — all on the synthetic avazu-shaped stream.
 """
 
 import numpy as np
+import pytest
 
 import paddle_tpu as paddle
 import paddle_tpu.nn.functional as F
@@ -78,6 +79,7 @@ def test_wide_deep_ctr_local():
     assert losses[-1] < losses[0] * 0.9
 
 
+@pytest.mark.dist
 def test_wide_deep_ctr_ps_embedding(ps_runtime):
     """Deep embedding served by the PS (ref fleet_deep_ctr distributed
     mode): rows pull per batch, grads push through the communicator."""
@@ -99,6 +101,7 @@ def test_wide_deep_ctr_ps_embedding(ps_runtime):
     assert np.abs(rows).sum() > 0
 
 
+@pytest.mark.dist
 def test_wide_deep_ctr_heter_cache(ps_runtime):
     """Device-cached embedding (HeterPS analogue) behind the same
     network; flush lands the trained rows on the server."""
