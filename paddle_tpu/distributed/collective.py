@@ -195,16 +195,26 @@ def alltoall(in_tensor_list, out_tensor_list, group=None, sync_op=True):
 
 
 def send(tensor, dst=0, group=None, sync_op=True):
-    raise NotImplementedError(
-        "eager p2p send is not expressible on TPU; use the pipeline "
-        "engine (paddle_tpu.distributed.fleet.meta_parallel) whose "
-        "stage transfers compile to collective-permute")
+    """Eager p2p send (ref collective/send_v2_op.cc). Host-staged over
+    the hardened PS transport — see distributed/p2p.py. The compiled
+    pipeline engines remain the fast path for stage transfers."""
+    from .p2p import mailbox
+
+    import numpy as np
+
+    mailbox().send(np.asarray(_value(tensor)), int(dst))
+    return tensor
 
 
 def recv(tensor, src=0, group=None, sync_op=True):
-    raise NotImplementedError(
-        "eager p2p recv is not expressible on TPU; use the pipeline "
-        "engine (paddle_tpu.distributed.fleet.meta_parallel)")
+    """Eager p2p recv (ref collective/recv_v2_op.cc): blocks for the
+    next message from `src` and writes it into `tensor` in place."""
+    from .p2p import mailbox
+
+    arr = mailbox().recv(int(src))
+    v = jnp.asarray(arr).reshape(tensor.shape).astype(
+        _value(tensor).dtype)
+    return _wrap_like(tensor, v)
 
 
 def barrier(group=None):

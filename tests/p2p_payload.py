@@ -1,0 +1,44 @@
+"""Payload for the eager p2p test: 2 ranks exchange tensors through
+paddle.distributed.send/recv (ref send_v2/recv_v2 unit flows) — a
+ping-pong with ordering and a self-send."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(__file__)))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+from paddle_tpu.core.tensor import Tensor  # noqa: E402
+from paddle_tpu.distributed import collective  # noqa: E402
+
+rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+
+if rank == 0:
+    # two ordered sends, then await the doubled reply
+    collective.send(Tensor(np.full((4,), 1.0, np.float32)), dst=1)
+    collective.send(Tensor(np.full((4,), 2.0, np.float32)), dst=1)
+    out = Tensor(np.zeros((4,), np.float32))
+    collective.recv(out, src=1)
+    np.testing.assert_allclose(np.asarray(out.numpy()), 6.0)
+    # self-send round-trips through the local queue
+    collective.send(Tensor(np.arange(3, dtype=np.float32)), dst=0)
+    self_out = Tensor(np.zeros((3,), np.float32))
+    collective.recv(self_out, src=0)
+    np.testing.assert_allclose(np.asarray(self_out.numpy()), [0, 1, 2])
+else:
+    a = Tensor(np.zeros((4,), np.float32))
+    b = Tensor(np.zeros((4,), np.float32))
+    collective.recv(a, src=0)
+    collective.recv(b, src=0)
+    # TCP ordering: first send arrives first
+    np.testing.assert_allclose(np.asarray(a.numpy()), 1.0)
+    np.testing.assert_allclose(np.asarray(b.numpy()), 2.0)
+    collective.send((a + b) * 2, dst=0)
+
+print(f"RANK {rank} P2P OK", flush=True)

@@ -103,3 +103,23 @@ def test_elastic_fault_injection_resumes_from_checkpoint(tmp_path):
     # the killed rank specifically restarted from its epoch-1 snapshot
     a2 = by_rank[1].get(2)
     assert a2 and min(a2) == 2, by_rank[1]
+
+
+def test_eager_p2p_send_recv(tmp_path):
+    """ref collective/send_v2_op.cc test flows: eager tensors move
+    between launched ranks with per-peer ordering; round-2's documented
+    deletion is closed."""
+    log_dir = str(tmp_path / "logs")
+    payload = os.path.join(REPO, "tests", "p2p_payload.py")
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "2", "--log_dir", log_dir, payload],
+        cwd=REPO, env=_clean_env(), capture_output=True, text=True,
+        timeout=240)
+    logs = ""
+    for rank in (0, 1):
+        with open(os.path.join(log_dir, f"workerlog.{rank}")) as f:
+            logs += f.read()
+    assert proc.returncode == 0, f"launcher failed:\n{logs}\n{proc.stderr}"
+    assert "RANK 0 P2P OK" in logs
+    assert "RANK 1 P2P OK" in logs
