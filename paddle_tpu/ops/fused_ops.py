@@ -401,7 +401,8 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, seed, scale, causal,
     kpos, _, _, _, _ = _pos_inputs(bh, nk, bk)
     seed_arr = _seed_input(seed)
     pos128 = lambda imap: bspec((1, 8, 128), imap)  # noqa: E731
-    scratch = pltpu.VMEM if _HAS_PLTPU else None
+    # these call sites are only reachable with pltpu present
+    # (_use_pallas gates on _HAS_PLTPU even when forced)
 
     # dq: grid (bh, q block, k block) — k sequential into f32 scratch
     dq = pl.pallas_call(
@@ -423,8 +424,7 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, seed, scale, causal,
         ],
         out_specs=bspec((1, bq, d), lambda i, j, t: (i, j, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, sq_pad, d), q.dtype),
-        scratch_shapes=[scratch((bq, d), jnp.float32)] if _HAS_PLTPU
-        else [],
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         compiler_params=_compiler_params(
             ("parallel", "parallel", "arbitrary")),
         interpret=_interpret(),
@@ -456,9 +456,8 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, seed, scale, causal,
             jax.ShapeDtypeStruct((bh, sk_pad, d), k.dtype),
             jax.ShapeDtypeStruct((bh, sk_pad, d), v.dtype),
         ],
-        scratch_shapes=[scratch((bk, d), jnp.float32),
-                        scratch((bk, d), jnp.float32)] if _HAS_PLTPU
-        else [],
+        scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
+                        pltpu.VMEM((bk, d), jnp.float32)],
         compiler_params=_compiler_params(
             ("parallel", "parallel", "arbitrary")),
         interpret=_interpret(),
