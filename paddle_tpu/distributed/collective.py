@@ -112,6 +112,27 @@ def _wrap_like(t, v):
 # -- collectives ------------------------------------------------------------
 
 
+def _eager_allgather(v, group):
+    """Cross-process gather of a host-staged array (gloo/DCN via
+    jax.distributed); None when single-process or the value is traced
+    (in-trace collectives need a mesh axis, not a host round-trip).
+    Sub-groups are rejected: the multihost transport is whole-world, and
+    a partial-membership call would deadlock the absent ranks."""
+    import numpy as np
+
+    if jax.process_count() <= 1 or _in_trace(v):
+        return None
+    g = group if group is not None else _get_default_group()
+    if len(g.ranks) != jax.process_count():
+        raise NotImplementedError(
+            "eager cross-process collectives support only the default "
+            "(whole-world) group; build sub-group communication inside "
+            "shard_map over a mesh axis")
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(np.asarray(v)))
+
+
 def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     v = _value(tensor)
     axis = _axis(group)
@@ -127,6 +148,15 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
         else:
             out = jnp.exp(jax.lax.psum(jnp.log(v), axis))
         return _wrap_like(tensor, out)
+    gathered = _eager_allgather(v, group)
+    if gathered is not None:
+        import numpy as np
+
+        red = {ReduceOp.SUM: np.sum, ReduceOp.MAX: np.max,
+               ReduceOp.MIN: np.min, ReduceOp.AVG: np.mean,
+               ReduceOp.PROD: np.prod}[op]
+        return _wrap_like(tensor, jnp.asarray(
+            red(gathered, axis=0).astype(np.asarray(v).dtype)))
     # eager, single-process world: identity
     return tensor
 
@@ -141,6 +171,12 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True):
             tensor_list.extend(Tensor(gathered[i]) for i in range(n))
             return tensor_list
         return gathered
+    gathered = _eager_allgather(v, group)
+    if gathered is not None:
+        if isinstance(tensor_list, list):
+            tensor_list.extend(Tensor(jnp.asarray(g)) for g in gathered)
+            return tensor_list
+        return gathered
     if isinstance(tensor_list, list):
         tensor_list.append(tensor)
         return tensor_list
@@ -153,7 +189,22 @@ def all_gather_object(object_list, obj, group=None):
 
 
 def broadcast(tensor, src, group=None, sync_op=True):
-    # inside SPMD traces all replicas compute identically; eager 1-proc: id
+    # inside SPMD traces all replicas compute identically; eager
+    # multi-process: one-to-all from src (O(N) per host, not an
+    # allgather)
+    v = _value(tensor)
+    if jax.process_count() > 1 and not _in_trace(v):
+        g = group if group is not None else _get_default_group()
+        if len(g.ranks) != jax.process_count():
+            raise NotImplementedError(
+                "eager broadcast supports only the whole-world group")
+        import numpy as np
+
+        from jax.experimental import multihost_utils
+
+        out = multihost_utils.broadcast_one_to_all(
+            np.asarray(v), is_source=jax.process_index() == int(src))
+        return _wrap_like(tensor, jnp.asarray(np.asarray(out)))
     return tensor
 
 
@@ -218,6 +269,11 @@ def recv(tensor, src=0, group=None, sync_op=True):
 
 
 def barrier(group=None):
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices("paddle_tpu.barrier")
+        return
     # eager single-process: nothing to synchronise; jax.block_until_ready on
     # a trivial computation stands in for a device barrier
     jnp.zeros(()).block_until_ready()

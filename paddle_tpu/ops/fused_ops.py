@@ -210,7 +210,7 @@ def _pos_inputs(bh, n_blocks, block_size):
     coordinates, so fwd and bwd must build them identically — single
     construction point. Returns (pos, bhpos, specs) where specs maps
     kwargs for pallas in_specs."""
-    vmem = pltpu.VMEM if _HAS_PLTPU else None
+    vmem = pltpu.VMEM  # call sites gate on _HAS_PLTPU
     pos = jnp.broadcast_to(
         (jnp.arange(n_blocks, dtype=jnp.int32) * block_size)[
             :, None, None], (n_blocks, 8, 128))
@@ -243,7 +243,7 @@ def _flash_fwd_pallas(q, k, v, seed, scale, causal, dropout_p):
     q = jnp.pad(q, ((0, 0), (0, sq_pad - sq), (0, 0)))
     k = jnp.pad(k, ((0, 0), (0, sk_pad - sk), (0, 0)))
     v = jnp.pad(v, ((0, 0), (0, sk_pad - sk), (0, 0)))
-    vmem = pltpu.VMEM if _HAS_PLTPU else None
+    vmem = pltpu.VMEM  # call sites gate on _HAS_PLTPU
     bspec = lambda shape, imap: pl.BlockSpec(  # noqa: E731
         shape, imap, memory_space=vmem)
     qpos, bhpos, pos_spec, bh_spec, seed_spec = _pos_inputs(bh, nq, bq)
@@ -394,9 +394,8 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, seed, scale, causal,
     deltap = jnp.broadcast_to(
         jnp.pad(delta, ((0, 0), (0, sq_pad - sq)))[..., None],
         (bh, sq_pad, 128))
-    vmem = pltpu.VMEM if _HAS_PLTPU else None
     bspec = lambda shape, imap: pl.BlockSpec(  # noqa: E731
-        shape, imap, memory_space=vmem)
+        shape, imap, memory_space=pltpu.VMEM)
     qpos, bhpos, _, _, _ = _pos_inputs(bh, nq, bq)
     kpos, _, _, _, _ = _pos_inputs(bh, nk, bk)
     seed_arr = _seed_input(seed)

@@ -36,4 +36,23 @@ from jax.experimental import multihost_utils  # noqa: E402
 gathered = multihost_utils.process_allgather(
     np.array([jax.process_index()]))
 assert sorted(gathered.ravel().tolist()) == [0, 1], gathered
+
+# public API eager collectives across the two launched processes
+from paddle_tpu.core.tensor import Tensor  # noqa: E402
+from paddle_tpu.distributed import collective  # noqa: E402
+
+t = Tensor(np.full((3,), float(env.rank + 1), np.float32))
+out = collective.all_reduce(t)
+np.testing.assert_allclose(np.asarray(out.numpy()), 3.0)  # 1 + 2
+
+b = Tensor(np.full((2,), float(env.rank), np.float32))
+collective.broadcast(b, src=1)
+np.testing.assert_allclose(np.asarray(b.numpy()), 1.0)
+
+lst = []
+collective.all_gather(lst, Tensor(np.array([float(env.rank)],
+                                           np.float32)))
+got = sorted(float(np.asarray(x.numpy())[0]) for x in lst)
+assert got == [0.0, 1.0], got
+collective.barrier()
 print(f"RANK {env.rank} COLLECTIVE OK", flush=True)
