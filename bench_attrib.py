@@ -1,9 +1,9 @@
 """Perf attribution for the ERNIE train step (not the driver bench).
 
 Times variants with the same differenced scan-N method as bench.py to
-locate where step time goes: full step (default dispatch, which at
-seq=512 selects the XLA fallback), dropout off, and the pallas flash
-kernel forced on (for kernel-tuning comparisons against the default).
+locate where step time goes: full step (default dispatch — the Pallas
+flash kernel at seq >= 128), dropout off, and forced pallas/jnp paths
+for kernel-vs-XLA comparisons.
 """
 
 import json

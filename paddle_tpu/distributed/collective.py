@@ -112,22 +112,26 @@ def _wrap_like(t, v):
 # -- collectives ------------------------------------------------------------
 
 
-def _eager_allgather(v, group):
-    """Cross-process gather of a host-staged array (gloo/DCN via
-    jax.distributed); None when single-process or the value is traced
-    (in-trace collectives need a mesh axis, not a host round-trip).
-    Sub-groups are rejected: the multihost transport is whole-world, and
-    a partial-membership call would deadlock the absent ranks."""
-    import numpy as np
-
-    if jax.process_count() <= 1 or _in_trace(v):
-        return None
+def _require_whole_world(group):
+    """The eager multihost transport is whole-world; a partial-membership
+    call would deadlock the absent ranks, so sub-groups are rejected."""
     g = group if group is not None else _get_default_group()
     if len(g.ranks) != jax.process_count():
         raise NotImplementedError(
             "eager cross-process collectives support only the default "
             "(whole-world) group; build sub-group communication inside "
             "shard_map over a mesh axis")
+
+
+def _eager_allgather(v, group):
+    """Cross-process gather of a host-staged array (gloo/DCN via
+    jax.distributed); None when single-process or the value is traced
+    (in-trace collectives need a mesh axis, not a host round-trip)."""
+    import numpy as np
+
+    if jax.process_count() <= 1 or _in_trace(v):
+        return None
+    _require_whole_world(group)
     from jax.experimental import multihost_utils
 
     return np.asarray(multihost_utils.process_allgather(np.asarray(v)))
@@ -194,10 +198,7 @@ def broadcast(tensor, src, group=None, sync_op=True):
     # allgather)
     v = _value(tensor)
     if jax.process_count() > 1 and not _in_trace(v):
-        g = group if group is not None else _get_default_group()
-        if len(g.ranks) != jax.process_count():
-            raise NotImplementedError(
-                "eager broadcast supports only the whole-world group")
+        _require_whole_world(group)
         import numpy as np
 
         from jax.experimental import multihost_utils
@@ -270,6 +271,7 @@ def recv(tensor, src=0, group=None, sync_op=True):
 
 def barrier(group=None):
     if jax.process_count() > 1:
+        _require_whole_world(group)
         from jax.experimental import multihost_utils
 
         multihost_utils.sync_global_devices("paddle_tpu.barrier")

@@ -162,11 +162,34 @@ class LocalResponseNorm(Layer):
 
 
 class SpectralNorm(Layer):
+    """ref nn/layer/norm.py SpectralNorm (spectral_norm_op.cc): the
+    layer form — holds the power-iteration vectors as buffers and
+    normalises the given weight on every call."""
+
     def __init__(self, weight_shape, dim=0, power_iters=1, eps=1e-12,
                  name=None):
         super().__init__()
-        raise NotImplementedError(
-            "SpectralNorm pending; use paddle_tpu.nn.utils.spectral_norm")
+        self._dim = dim
+        self._power_iters = power_iters
+        self._eps = eps
+        h = weight_shape[dim]
+        w = 1
+        for i, s in enumerate(weight_shape):
+            if i != dim:
+                w *= s
+        self.weight_u = self.create_parameter(
+            shape=[h], default_initializer=I.Normal(0.0, 1.0))
+        self.weight_u.stop_gradient = True
+        self.weight_v = self.create_parameter(
+            shape=[w], default_initializer=I.Normal(0.0, 1.0))
+        self.weight_v.stop_gradient = True
+
+    def forward(self, weight):
+        from ...core.dispatch import apply
+
+        return apply("spectral_norm", weight, self.weight_u,
+                     self.weight_v, dim=self._dim,
+                     power_iters=self._power_iters, eps=self._eps)
 
 
 class RMSNorm(Layer):

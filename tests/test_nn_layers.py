@@ -181,3 +181,16 @@ def test_initializers():
     assert abs(float(np.asarray(w).std()) - float(np.sqrt(2 / 200))) < 0.01
     c = I.Constant(3.0)([5])
     np.testing.assert_allclose(np.asarray(c), 3.0)
+
+
+def test_spectral_norm_layer():
+    """ref test_spectral_norm_op.py: the layer normalises the weight's
+    top singular value to ~1."""
+    paddle.seed(5)
+    from paddle_tpu.core.tensor import Tensor
+
+    sn = nn.SpectralNorm([4, 6], dim=0, power_iters=5)
+    w = Tensor(np.random.RandomState(0).randn(4, 6).astype(np.float32))
+    out = sn(w)
+    sv = np.linalg.svd(np.asarray(out.numpy()), compute_uv=False)
+    assert abs(float(sv[0]) - 1.0) < 0.05
