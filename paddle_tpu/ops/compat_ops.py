@@ -42,9 +42,6 @@ _alias("top_k", "top_k_v2")
 _alias("slice", "slice_op")
 _alias("trace", "trace_op")
 _alias("cudnn_lstm", "rnn")
-_alias("sync_batch_norm", "batch_norm")  # GSPMD reduces over the global
-# batch axis inside jit, which IS synchronized BN (ref sync_batch_norm_op.cu
-# does the cross-rank allreduce by hand)
 
 
 @register_op("flatten2")
@@ -427,6 +424,43 @@ def fusion_seqexpand_concat_fc(ref_seq, *rest):
                 for r in row_inputs]
     cat = jnp.concatenate([ref_seq] + expanded, axis=-1)
     return jax.nn.relu(cat @ w + b)
+
+
+@register_op("sync_batch_norm", has_aux=True)
+def sync_batch_norm(x, scale, bias, mean, variance, *, momentum=0.9,
+                    epsilon=1e-5, is_test=False, data_format="NCHW",
+                    use_global_stats=False, axis_name="dp"):
+    """ref sync_batch_norm_op.cu: BN statistics reduced across the data
+    axis. Under pjit, GSPMD's global batch reduction already IS sync-BN;
+    inside shard_map (per-rank shards) the count/sum/sumsq are psum'd
+    over `axis_name` by hand, exactly like the reference's NCCL
+    allreduce of the partial moments."""
+    if is_test or use_global_stats or not _axis_bound(axis_name):
+        from .nn_ops import batch_norm
+
+        return batch_norm(x, scale, bias, mean, variance,
+                          momentum=momentum, epsilon=epsilon,
+                          is_test=is_test, data_format=data_format,
+                          use_global_stats=use_global_stats)
+    from .nn_ops import batch_norm_apply
+
+    c_axis = 1 if data_format == "NCHW" else x.ndim - 1
+    reduce_axes = tuple(a for a in range(x.ndim) if a != c_axis)
+    x32 = x.astype(jnp.float32) if x.dtype in (jnp.bfloat16,
+                                               jnp.float16) else x
+    n_local = 1
+    for a in reduce_axes:
+        n_local *= x.shape[a]
+    s1 = lax.psum(jnp.sum(x32, axis=reduce_axes), axis_name)
+    s2 = lax.psum(jnp.sum(x32 * x32, axis=reduce_axes), axis_name)
+    n = n_local * lax.axis_size(axis_name)
+    use_mean = s1 / n
+    # E[x^2]-E[x]^2 can round negative in fp32 at large means; clamp
+    # before rsqrt and the running-stat update
+    use_var = jnp.maximum(s2 / n - use_mean * use_mean, 0.0)
+    return batch_norm_apply(x, scale, bias, mean, variance, use_mean,
+                            use_var, momentum=momentum, epsilon=epsilon,
+                            c_axis=c_axis)
 
 
 # -- compiled collectives (c_* family) --------------------------------------

@@ -296,28 +296,40 @@ def layer_norm(x, scale=None, bias=None, *, epsilon=1e-5, begin_norm_axis=1):
     return y
 
 
-@register_op("batch_norm", has_aux=True)
-def batch_norm(x, scale, bias, mean, variance, *, momentum=0.9, epsilon=1e-5,
-               is_test=False, data_format="NCHW", use_global_stats=False):
-    c_axis = 1 if data_format == "NCHW" else x.ndim - 1
-    reduce_axes = tuple(a for a in range(x.ndim) if a != c_axis)
+def batch_norm_apply(x, scale, bias, mean, variance, use_mean, use_var,
+                     *, momentum, epsilon, c_axis):
+    """Shared BN tail (normalise + running-stat update) used by both
+    batch_norm and the cross-rank sync_batch_norm."""
     bshape = [1] * x.ndim
     bshape[c_axis] = x.shape[c_axis]
-
-    if is_test or use_global_stats:
-        use_mean, use_var = mean, variance
-        new_mean, new_var = mean, variance
-    else:
-        x32 = x.astype(jnp.float32) if x.dtype in (jnp.bfloat16, jnp.float16) else x
-        use_mean = jnp.mean(x32, axis=reduce_axes)
-        use_var = jnp.var(x32, axis=reduce_axes)
-        new_mean = momentum * mean + (1 - momentum) * use_mean
-        new_var = momentum * variance + (1 - momentum) * use_var
+    new_mean = momentum * mean + (1 - momentum) * use_mean
+    new_var = momentum * variance + (1 - momentum) * use_var
     inv = lax.rsqrt(use_var + epsilon)
     y = (x - use_mean.reshape(bshape).astype(x.dtype)) * \
         inv.reshape(bshape).astype(x.dtype)
     y = y * scale.reshape(bshape) + bias.reshape(bshape)
     return y, (lax.stop_gradient(new_mean), lax.stop_gradient(new_var))
+
+
+@register_op("batch_norm", has_aux=True)
+def batch_norm(x, scale, bias, mean, variance, *, momentum=0.9, epsilon=1e-5,
+               is_test=False, data_format="NCHW", use_global_stats=False):
+    c_axis = 1 if data_format == "NCHW" else x.ndim - 1
+    reduce_axes = tuple(a for a in range(x.ndim) if a != c_axis)
+    if is_test or use_global_stats:
+        bshape = [1] * x.ndim
+        bshape[c_axis] = x.shape[c_axis]
+        inv = lax.rsqrt(variance + epsilon)
+        y = (x - mean.reshape(bshape).astype(x.dtype)) * \
+            inv.reshape(bshape).astype(x.dtype)
+        y = y * scale.reshape(bshape) + bias.reshape(bshape)
+        return y, (mean, variance)
+    x32 = x.astype(jnp.float32) if x.dtype in (jnp.bfloat16, jnp.float16) else x
+    use_mean = jnp.mean(x32, axis=reduce_axes)
+    use_var = jnp.var(x32, axis=reduce_axes)
+    return batch_norm_apply(x, scale, bias, mean, variance, use_mean,
+                            use_var, momentum=momentum, epsilon=epsilon,
+                            c_axis=c_axis)
 
 
 @register_op("instance_norm")

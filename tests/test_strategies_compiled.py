@@ -180,3 +180,45 @@ def test_localsgd_single_collective(monkeypatch):
     for k, v in m.state_dict().items():
         np.testing.assert_allclose(np.asarray(v._value), before[k] / 2,
                                    rtol=1e-6)
+
+
+def test_sync_batch_norm_matches_global_bn():
+    """sync_batch_norm inside shard_map over dp must equal plain BN on
+    the concatenated global batch (ref sync_batch_norm_op.cu tests)."""
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from paddle_tpu.core.op_registry import _REGISTRY
+
+    ndev = min(4, jax.device_count())
+    if ndev < 2:
+        pytest.skip("needs >=2 devices")
+    mesh = Mesh(np.array(jax.devices()[:ndev]), ("dp",))
+    rng = np.random.RandomState(0)
+    x = rng.randn(ndev * 2, 3, 4, 4).astype(np.float32)
+    scale = rng.rand(3).astype(np.float32) + 0.5
+    bias = rng.randn(3).astype(np.float32)
+    mean = np.zeros(3, np.float32)
+    var = np.ones(3, np.float32)
+
+    sbn = _REGISTRY["sync_batch_norm"].fn
+
+    def local(xx):
+        y, (m, v) = sbn(xx, jnp.asarray(scale), jnp.asarray(bias),
+                        jnp.asarray(mean), jnp.asarray(var))
+        return y, m, v
+
+    fn = jax.shard_map(local, mesh=mesh, in_specs=(P("dp"),),
+                       out_specs=(P("dp"), P(), P()),
+                       check_vma=False)
+    y, m, v = jax.jit(fn)(x)
+
+    bn = _REGISTRY["batch_norm"].fn
+    want_y, (want_m, want_v) = bn(jnp.asarray(x), jnp.asarray(scale),
+                                  jnp.asarray(bias), jnp.asarray(mean),
+                                  jnp.asarray(var))
+    np.testing.assert_allclose(np.asarray(y), np.asarray(want_y),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(m), np.asarray(want_m),
+                               rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(v), np.asarray(want_v),
+                               rtol=1e-4, atol=1e-6)
