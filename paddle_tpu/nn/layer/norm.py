@@ -56,10 +56,29 @@ class BatchNorm3D(_BatchNormBase):
 
 
 class SyncBatchNorm(_BatchNormBase):
-    """On TPU, batch-norm stats sync falls out of GSPMD when the batch axis
-    is sharded (XLA emits the cross-replica reduction); eager single-process
-    behaviour equals BatchNorm. ref: python/paddle/nn/layer/norm.py
-    SyncBatchNorm (which needs a custom CUDA kernel + NCCL)."""
+    """ref python/paddle/nn/layer/norm.py SyncBatchNorm (custom CUDA
+    kernel + NCCL allreduce of partial moments). Under pjit, stats sync
+    falls out of GSPMD when the batch axis is sharded; inside shard_map
+    the forward dispatches the sync_batch_norm op, which psums the
+    moments over the 'dp' axis by hand. Eager single-process behaviour
+    equals BatchNorm."""
+
+    def forward(self, x):
+        from ...core.dispatch import apply
+
+        out = apply("sync_batch_norm", x, self.weight, self.bias,
+                    self._mean, self._variance,
+                    momentum=self._momentum, epsilon=self._epsilon,
+                    is_test=not self.training,
+                    data_format=self._data_format,
+                    use_global_stats=bool(self._use_global_stats))
+        y, new_mean, new_var = out[0], out[1], out[2]
+        if self.training:
+            self._mean._value = new_mean._value \
+                if hasattr(new_mean, "_value") else new_mean
+            self._variance._value = new_var._value \
+                if hasattr(new_var, "_value") else new_var
+        return y
 
     @classmethod
     def convert_sync_batchnorm(cls, layer):
@@ -169,6 +188,7 @@ class SpectralNorm(Layer):
     def __init__(self, weight_shape, dim=0, power_iters=1, eps=1e-12,
                  name=None):
         super().__init__()
+        dim = dim % len(weight_shape)  # normalise negative dims
         self._dim = dim
         self._power_iters = power_iters
         self._eps = eps

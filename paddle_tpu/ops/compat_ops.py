@@ -451,8 +451,10 @@ def sync_batch_norm(x, scale, bias, mean, variance, *, momentum=0.9,
     n_local = 1
     for a in reduce_axes:
         n_local *= x.shape[a]
-    s1 = lax.psum(jnp.sum(x32, axis=reduce_axes), axis_name)
-    s2 = lax.psum(jnp.sum(x32 * x32, axis=reduce_axes), axis_name)
+    # ONE fused allreduce of both partial moments (the reference's
+    # single NCCL allreduce of the stacked sums)
+    s1, s2 = lax.psum((jnp.sum(x32, axis=reduce_axes),
+                       jnp.sum(x32 * x32, axis=reduce_axes)), axis_name)
     n = n_local * lax.axis_size(axis_name)
     use_mean = s1 / n
     # E[x^2]-E[x]^2 can round negative in fp32 at large means; clamp
