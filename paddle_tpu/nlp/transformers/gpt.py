@@ -21,6 +21,7 @@ from __future__ import annotations
 import math
 
 from ... import nn
+from ...core.config import no_grad
 from ...core.tensor import Tensor
 from ...distributed.fleet.meta_parallel.mp_layers import (
     ColumnParallelLinear, ParallelCrossEntropy, RowParallelLinear,
@@ -85,7 +86,7 @@ class GPTAttention(nn.Layer):
             self.qkv_proj = nn.Linear(h, 3 * h, weight_attr=init)
             self.out_proj = nn.Linear(h, h, weight_attr=init)
 
-    def forward(self, x):
+    def forward(self, x, cache=None):
         b, s, h = x.shape
         # single packed transpose (see ernie.py): minimises physical
         # copies around the pallas flash custom-call
@@ -93,12 +94,50 @@ class GPTAttention(nn.Layer):
             [b, s, 3, self.num_heads, self.head_dim]).transpose(
             [2, 0, 3, 1, 4])
         q, k, v = qkv.unstack(axis=0)
+        if cache is not None:
+            out, new_cache = self._attend_cached(q, k, v, cache)
+            # [b, nh, s, hd] -> [b, s, nh*hd] (sdpa's bhsd mode returns
+            # seq-major already; the cached path must match)
+            out = out.transpose([0, 2, 1, 3]).reshape([b, s, h])
+            return self.out_proj(out), new_cache
         out = F.scaled_dot_product_attention(
             q, k, v, is_causal=True,
             dropout_p=self.attn_dropout if self.training else 0.0,
             qkv_layout="bhsd")
         out = out.reshape([b, s, h])
         return self.out_proj(out)
+
+    def _attend_cached(self, q, k, v, cache):
+        """Incremental decode attention over a static-shape KV cache
+        (ref paddlenlp generation + fused multi_transformer decode
+        caches): new keys/values land at `pos` via dynamic_update_slice;
+        queries attend to all cached positions <= their own. Inference
+        only — jnp math, no tape."""
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        k_cache, v_cache, pos = cache
+        qv = q._value if isinstance(q, Tensor) else q
+        kv = k._value if isinstance(k, Tensor) else k
+        vv = v._value if isinstance(v, Tensor) else v
+        s_new = qv.shape[2]
+        k_cache = lax.dynamic_update_slice(
+            k_cache, kv.astype(k_cache.dtype), (0, 0, pos, 0))
+        v_cache = lax.dynamic_update_slice(
+            v_cache, vv.astype(v_cache.dtype), (0, 0, pos, 0))
+        scale = 1.0 / (self.head_dim ** 0.5)
+        scores = jnp.einsum("bhqd,bhkd->bhqk", qv.astype(jnp.float32),
+                            k_cache.astype(jnp.float32)) * scale
+        s_max = k_cache.shape[2]
+        key_idx = jnp.arange(s_max)
+        q_pos = pos + jnp.arange(s_new)
+        mask = key_idx[None, :] <= q_pos[:, None]     # [s_new, s_max]
+        scores = jnp.where(mask[None, None], scores, -1e30)
+        p = jax.nn.softmax(scores, axis=-1)
+        out = jnp.einsum("bhqk,bhkd->bhqd", p,
+                         v_cache.astype(jnp.float32)).astype(qv.dtype)
+        return Tensor(out), (k_cache, v_cache, pos + s_new)
 
 
 class GPTMLP(nn.Layer):
@@ -141,15 +180,21 @@ class GPTDecoderLayer(nn.Layer):
             return shard_hint(x, DP_AXIS, MP_AXIS, None)
         return shard_hint(x, DP_AXIS, None, None)
 
-    def forward(self, x):
+    def forward(self, x, cache=None):
         x = self._sp(x)
-        h = self.attn(self.norm1(x))
+        if cache is not None:
+            h, new_cache = self.attn(self.norm1(x), cache)
+        else:
+            h = self.attn(self.norm1(x))
         h = F.dropout(h, self.dropout, training=self.training)
         x = x + h
         x = self._sp(x)
         h = self.mlp(self.norm2(x))
         h = F.dropout(h, self.dropout, training=self.training)
-        return x + h
+        x = x + h
+        if cache is not None:
+            return x, new_cache
+        return x
 
 
 class GPTEmbeddings(nn.Layer):
@@ -187,11 +232,28 @@ class GPTModel(nn.Layer):
         self.final_norm = nn.LayerNorm(config.hidden_size,
                                        epsilon=config.layer_norm_eps)
 
-    def forward(self, input_ids, position_ids=None):
+    def forward(self, input_ids, position_ids=None, caches=None):
         x = self.embeddings(input_ids, position_ids)
+        if caches is not None:
+            new_caches = []
+            for layer, c in zip(self.layers, caches):
+                x, nc = layer(x, c)
+                new_caches.append(nc)
+            return self.final_norm(x), new_caches
         for layer in self.layers:
             x = layer(x)
         return self.final_norm(x)
+
+    def init_caches(self, batch_size, max_len, dtype=None):
+        """Zeroed static-shape KV caches for incremental decode."""
+        import jax.numpy as jnp
+
+        cfg = self.config
+        hd = cfg.hidden_size // cfg.num_heads
+        dtype = dtype or jnp.bfloat16
+        shape = (batch_size, cfg.num_heads, max_len, hd)
+        return [(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype), 0)
+                for _ in range(cfg.num_layers)]
 
 
 class GPTForPretraining(nn.Layer):
@@ -209,6 +271,80 @@ class GPTForPretraining(nn.Layer):
     def forward(self, input_ids, position_ids=None):
         h = self.gpt(input_ids, position_ids)
         return self.logits(h)
+
+    @no_grad()
+    def generate(self, input_ids, *, max_new_tokens=20, do_sample=False,
+                 top_k=50, temperature=1.0, eos_token_id=None, seed=0):
+        """Autoregressive decoding with a static-shape KV cache (ref
+        paddlenlp GenerationMixin.generate greedy/sampling): one prefill
+        pass over the prompt, then one single-token step per new token —
+        O(1) attention work per step instead of re-running the prompt.
+        Returns [batch, prompt + max_new_tokens] ids; positions after an
+        eos repeat eos."""
+        import jax
+        import jax.numpy as jnp
+
+        was_training = self.training
+        self.eval()
+        try:
+            ids = input_ids._value if isinstance(input_ids, Tensor) \
+                else jnp.asarray(input_ids)
+            ids = jnp.asarray(ids, jnp.int32)
+            b, s0 = ids.shape
+            max_len = s0 + max_new_tokens
+            if max_len > self.config.max_seq_len:
+                raise ValueError(
+                    f"prompt + max_new_tokens = {max_len} exceeds "
+                    f"max_seq_len {self.config.max_seq_len}")
+            caches = self.gpt.init_caches(b, max_len)
+            key = jax.random.PRNGKey(seed)
+            done = jnp.zeros((b,), bool)
+
+            def step(tok_ids, pos_ids, caches):
+                h, caches = self.gpt(Tensor(tok_ids),
+                                     Tensor(pos_ids), caches)
+                # only the last position feeds sampling: skip the
+                # full-vocab projection of the rest of the prompt
+                logits = self.logits(h[:, -1:])
+                lv = logits._value if isinstance(logits, Tensor) \
+                    else logits
+                return lv[:, 0, :].astype(jnp.float32), caches
+
+            logits, caches = step(ids, jnp.arange(s0, dtype=jnp.int32),
+                                  caches)
+            out = [ids]
+            for t in range(max_new_tokens):
+                if do_sample:
+                    scaled = logits / max(temperature, 1e-6)
+                    if top_k:
+                        kth = jax.lax.top_k(scaled,
+                                            min(top_k,
+                                                scaled.shape[-1]))[0]
+                        scaled = jnp.where(
+                            scaled < kth[:, -1:], -jnp.inf, scaled)
+                    key, sub = jax.random.split(key)
+                    nxt = jax.random.categorical(sub, scaled, axis=-1)
+                else:
+                    nxt = jnp.argmax(logits, axis=-1)
+                nxt = nxt.astype(jnp.int32)
+                if eos_token_id is not None:
+                    nxt = jnp.where(done, eos_token_id, nxt)
+                    done = done | (nxt == eos_token_id)
+                out.append(nxt[:, None])
+                if t == max_new_tokens - 1:
+                    break
+                if eos_token_id is not None and bool(done.all()):
+                    # pad the remainder with eos and stop early
+                    rest = max_new_tokens - t - 1
+                    out.append(jnp.full((b, rest), eos_token_id,
+                                        jnp.int32))
+                    break
+                pos = jnp.asarray([s0 + t], jnp.int32)
+                logits, caches = step(nxt[:, None], pos, caches)
+            return Tensor(jnp.concatenate(out, axis=1))
+        finally:
+            if was_training:
+                self.train()
 
     def logits(self, h):
         from ...core.dispatch import apply
