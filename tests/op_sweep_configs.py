@@ -1827,7 +1827,7 @@ def _shuffle_prop(outs, inputs, attrs):
 
 
 case("shuffle_batch", [f32((6, 3)), KEY], {}, prop=_shuffle_prop,
-     grad=None, bf16=False)
+     grad=(0,), bf16=False)
 case("pad2d", [f32((1, 2, 3, 3))],
      {"paddings": [1, 1, 2, 2], "mode": "constant", "pad_value": 0.5},
      ref=lambda x, **kw: np.pad(x, [(0, 0), (0, 0), (1, 1), (2, 2)],
@@ -1875,7 +1875,7 @@ def _instag_prop(outs, inputs, attrs):
 case("filter_by_instag",
      [f32((4, 3)), ints((4, 2), 0, 5), ints((3,), 0, 3, seed=1,
                                             dtype=np.int64)],
-     {}, prop=_instag_prop, grad=None, bf16=False)
+     {}, prop=_instag_prop, grad=(0,), bf16=False)
 case("fsp", [f32((2, 3, 4, 4)), f32((2, 5, 4, 4), seed=1)], {},
      ref=lambda x, y: np.einsum("nax,nbx->nab", x.reshape(2, 3, 16),
                                 y.reshape(2, 5, 16)) / 16.0,
@@ -1890,7 +1890,7 @@ def _ce2_ref(x, label, **kw):
 
 
 case("cross_entropy2", [pos((4, 5), 0.1, 0.9), ints((4, 1), 0, 5)],
-     {}, ref=_ce2_ref, grad=None, bf16=False)
+     {}, ref=_ce2_ref, grad=(0,), bf16=False)
 
 
 def _center_prop(outs, inputs, attrs):
@@ -1903,7 +1903,7 @@ def _center_prop(outs, inputs, attrs):
 
 case("center_loss", [f32((4, 3)), ints((4,), 0, 5, dtype=np.int64),
                      f32((5, 3), seed=1)],
-     {"alpha": 0.1}, prop=_center_prop, grad=None, bf16=False)
+     {"alpha": 0.1}, prop=_center_prop, grad=(0,), bf16=False)
 
 
 def _nce_prop(outs, inputs, attrs):
@@ -1914,7 +1914,7 @@ def _nce_prop(outs, inputs, attrs):
 case("nce", [f32((4, 3)), ints((4, 1), 0, 10, dtype=np.int64),
              f32((10, 3), seed=1), f32((10,), seed=2), KEY],
      {"num_total_classes": 10, "num_neg_samples": 5},
-     prop=_nce_prop, grad=None, bf16=False)
+     prop=_nce_prop, grad=(0, 2, 3), bf16=False)
 
 
 def _sample_logits_prop(outs, inputs, attrs):
@@ -1928,7 +1928,7 @@ def _sample_logits_prop(outs, inputs, attrs):
 
 case("sample_logits", [f32((3, 8)), ints((3, 1), 0, 8, dtype=np.int64),
                        KEY],
-     {"num_samples": 4}, prop=_sample_logits_prop, grad=None, bf16=False)
+     {"num_samples": 4}, prop=_sample_logits_prop, grad=(0,), bf16=False)
 
 
 def _np_conv2d(x, w, stride=1, pad=0):
@@ -2019,7 +2019,7 @@ def _unpool_prop(outs, inputs, attrs):
 _UPX = f32((1, 2, 2, 2))
 _UPIDX = np.array([[[[0, 3], [9, 10]], [[5, 6], [12, 15]]]], np.int32)
 case("unpool", [_UPX, _UPIDX], {"ksize": 2, "stride": 2},
-     prop=_unpool_prop, grad=None, bf16=False)
+     prop=_unpool_prop, grad=(0,), bf16=False)
 
 
 def _mp3d_prop(outs, inputs, attrs):
@@ -2059,7 +2059,7 @@ case("yolov3_loss",
       np.array([[1]], np.int32)],
      {"anchors": [10, 13, 16, 30], "anchor_mask": [0, 1], "class_num": 3,
       "downsample_ratio": 32},
-     prop=_yolo_loss_prop, grad=None, bf16=False)
+     prop=_yolo_loss_prop, grad=(0,), bf16=False)
 
 
 def _seq_concat_ref(x1, l1, x2, l2):
@@ -2076,7 +2076,7 @@ def _seq_concat_ref(x1, l1, x2, l2):
 case("sequence_concat",
      [f32((2, 3, 4)), np.array([2, 3], np.int32),
       f32((2, 2, 4), seed=1), np.array([2, 1], np.int32)],
-     {}, ref=_seq_concat_ref, grad=None, bf16=False)
+     {}, ref=_seq_concat_ref, grad=(0, 2), bf16=False)
 case("sequence_reshape", [f32((2, 4, 6)), np.array([2, 4], np.int32)],
      {"new_dim": 3},
      ref=lambda x, ln, new_dim: (x.reshape(2, 8, 3),
@@ -2095,7 +2095,7 @@ def _seq_scatter_ref(x, idx, upd, ln):
 case("sequence_scatter",
      [f32((2, 5, 3)), ints((2, 3), 0, 5), f32((2, 3, 3), seed=1),
       np.array([3, 2], np.int32)],
-     {}, ref=_seq_scatter_ref, grad=None, bf16=False)
+     {}, ref=_seq_scatter_ref, grad=(0, 2), bf16=False)
 
 
 def _seq_slice_ref(x, ln, off, length):
@@ -2110,7 +2110,7 @@ def _seq_slice_ref(x, ln, off, length):
 case("sequence_slice",
      [f32((2, 5, 3)), np.array([5, 4], np.int32),
       np.array([1, 0], np.int32), np.array([2, 3], np.int32)],
-     {}, ref=_seq_slice_ref, grad=None, bf16=False)
+     {}, ref=_seq_slice_ref, grad=(0,), bf16=False)
 case("lod_reset", [f32((2, 4, 3)), np.array([3, 2], np.int32)], {},
      ref=lambda x, ln: (x, ln), grad=None, bf16=False)
 
@@ -2130,7 +2130,7 @@ case("inplace_abn",
      [f32((2, 3, 4, 4)), pos((3,)), f32((3,), seed=1),
       np.zeros(3, np.float32), np.ones(3, np.float32)],
      {"activation": "leaky_relu", "alpha": 0.01},
-     prop=_abn_prop, grad=None, bf16=False)
+     prop=_abn_prop, grad=(0,), bf16=False)
 
 
 def _bslice_prop(outs, inputs, attrs):
@@ -2145,7 +2145,7 @@ case("bilateral_slice",
      [f32((1, 2, 6, 6), 0.1, 0.9),
       np.ones((1, 4, 3, 4, 4), np.float32),
       pos((1, 6, 6), 0.1, 0.9, seed=1)],
-     {"has_offset": False}, prop=_bslice_prop, grad=None, bf16=False)
+     {"has_offset": False}, prop=_bslice_prop, grad=(0,), bf16=False)
 
 
 def _ph_prop(outs, inputs, attrs):
