@@ -69,6 +69,87 @@ def _tpu_usable(timeout_s: float = 120.0) -> bool:
         return False
 
 
+def _resnet50_fwd_flops(hw: int = 224, num_classes: int = 1000) -> float:
+    """Analytic forward FLOPs for one ResNet-50 image.
+
+    Convs counted as 2*Kh*Kw*Cin*Cout*Hout*Wout (bias-free); fc as
+    2*in*out.  BN/ReLU/residual-add/pooling are excluded (<1% of total),
+    so the derived MFU is slightly conservative.  At hw=224 this yields
+    8.18e9 FLOPs = 4.09 GMACs, matching the published ResNet-50 count.
+    """
+    f = 0.0
+    h = hw // 2                      # conv1 stride 2
+    f += 2 * 7 * 7 * 3 * 64 * h * h
+    h //= 2                          # maxpool stride 2
+    inplanes = 64
+    for planes, blocks, stride in ((64, 3, 1), (128, 4, 2),
+                                   (256, 6, 2), (512, 3, 2)):
+        hin, h = h, h // stride
+        width, out_c = planes, planes * 4
+        # first block: 1x1 reduce at the pre-stride spatial size, strided
+        # 3x3, 1x1 expand, plus the strided 1x1 downsample shortcut
+        f += 2 * inplanes * width * hin * hin
+        f += 2 * 9 * width * width * h * h
+        f += 2 * width * out_c * h * h
+        f += 2 * inplanes * out_c * h * h
+        inplanes = out_c
+        for _ in range(blocks - 1):
+            f += 2 * inplanes * width * h * h
+            f += 2 * 9 * width * width * h * h
+            f += 2 * width * out_c * h * h
+    f += 2 * 512 * 4 * num_classes   # fc
+    return f
+
+
+def _bench_resnet50(peak: float, on_tpu: bool) -> dict:
+    """ResNet-50 ImageNet-shape train step (fwd+bwd+Momentum) on one chip.
+
+    Same differenced-scan method as the ERNIE headline: two scan-N
+    programs (N and 3N) with a real step-to-step data dependency through
+    params/momentum, timed to a host read, differenced so the fixed
+    dispatch+transfer overhead cancels.  MFU from analytic conv FLOPs
+    (3x fwd for training) against peak bf16.  Reference analogue:
+    tools/test_model_benchmark.sh:19-45 (whole-model perf gate).
+    """
+    import paddle_tpu as paddle
+    from paddle_tpu import amp, nn
+    from paddle_tpu.engine import Engine
+    from paddle_tpu.vision.models import resnet50
+    from bench_attrib import _timed_scan_ms
+
+    if on_tpu:
+        batch = int(os.environ.get("BENCH_RESNET_BATCH", "256"))
+        hw, iters = 224, 8
+    else:
+        batch, hw, iters = 2, 32, 2
+
+    paddle.seed(0)
+    model = resnet50(num_classes=1000)
+    crit = nn.CrossEntropyLoss()
+    opt = paddle.optimizer.Momentum(
+        learning_rate=0.1, momentum=0.9,
+        parameters=model.parameters(), weight_decay=1e-4)
+    eng = Engine(model, opt, lambda logits, labels: crit(logits, labels))
+
+    rng = np.random.RandomState(0)
+    imgs = rng.rand(batch, 3, hw, hw).astype(np.float32)
+    labels = rng.randint(0, 1000, (batch,)).astype(np.int32)
+    with amp.auto_cast(enable=True, dtype="bfloat16"):
+        eng.train_batch(imgs, labels)  # build + compile the step
+
+    ms = _timed_scan_ms(eng, imgs, labels, n1=iters, reps=2)
+    imgs_per_sec = batch / (ms / 1e3)
+    train_flops = 3.0 * _resnet50_fwd_flops(hw)
+    mfu = imgs_per_sec * train_flops / peak
+    return {
+        "images_per_sec": round(imgs_per_sec, 1),
+        "mfu_pct": round(mfu * 100.0, 2),
+        "step_ms": round(ms, 2),
+        "batch": batch, "image_hw": hw,
+        "train_gflops_per_image": round(train_flops / 1e9, 2),
+    }
+
+
 def main():
     if os.environ.get("BENCH_PLATFORM", "") == "cpu" or not _tpu_usable():
         # Force host CPU *before* first backend touch; the axon site hook
@@ -214,6 +295,16 @@ def main():
         profiler.export_chrome_tracing(
             os.path.join(profile_dir, "host_trace.json"))
 
+    # ResNet-50 ladder metric (VERDICT r3 item 1): measured in the same
+    # run, merged into the same JSON line; guarded so a conv-path failure
+    # can never take down the headline metric.
+    resnet_stats = None
+    if os.environ.get("BENCH_RESNET", "1") != "0":
+        try:
+            resnet_stats = _bench_resnet50(peak, on_tpu)
+        except Exception as e:  # noqa: BLE001 - report, don't die
+            resnet_stats = {"error": f"{type(e).__name__}: {e}"}
+
     step_s = dt / timed_iters
     tokens_per_sec = tokens_per_step / step_s
     achieved = flops_per_token * tokens_per_sec
@@ -232,6 +323,7 @@ def main():
         "params": n_params,
         "device": getattr(dev, "device_kind", dev.platform),
         "loss": loss_v,
+        "resnet50": resnet_stats,
     }))
 
 

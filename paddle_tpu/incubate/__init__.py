@@ -1,3 +1,6 @@
 """paddle.incubate (ref python/paddle/fluid/incubate + paddle/incubate)."""
 
 from . import asp  # noqa: F401
+from .optimizer import (  # noqa: F401
+    ExponentialMovingAverage, LookAhead, ModelAverage,
+)

@@ -1,0 +1,373 @@
+"""Optimizer wrappers: LookAhead, ModelAverage, ExponentialMovingAverage.
+
+Parity targets: python/paddle/incubate/optimizer/lookahead.py:118 (slow/
+fast two-speed update), python/paddle/incubate/optimizer/modelaverage.py
++ paddle/fluid/operators/average_accumulates_op.h:80-106 (windowed sum
+rotation), python/paddle/fluid/optimizer.py:3883 (ExponentialMovingAverage
+with bias correction and thres_steps decay scheduling).
+
+TPU-native design: each wrapper is itself an `Optimizer` whose pure
+per-parameter `_rule` runs the wrapped optimizer's rule and then the
+wrapper's own state transition, so the whole composite lowers into the
+SAME compiled train step as the inner optimizer (the Engine maps `_rule`
+over the parameter tree inside jit).  `jnp.where` on traced step
+counters replaces the reference's host-side branches, so the k-step
+LookAhead sync and the ModelAverage window rotation compile once and
+never re-trace.  Wrapper state lives in the same flat per-param state
+dict as the inner state (prefixed keys), so optimizer.state_dict() /
+checkpointing work unchanged.
+
+Deviation from the reference kernel (documented): average_accumulates'
+16384-step precision spill uses the *pre-accumulation* sum_1 and drops
+the current param from the spilled bucket (average_accumulates_op.h:87-93
+reads in_sum_* after out_sum_1 was already updated); we spill the
+post-accumulation sum so no step is ever dropped.  The difference is one
+sample per 16384 at the spill boundary.
+"""
+
+from __future__ import annotations
+
+from contextlib import contextmanager
+
+import jax.numpy as jnp
+
+from ..core import config
+from ..optimizer import Optimizer
+
+__all__ = ["LookAhead", "ModelAverage", "ExponentialMovingAverage"]
+
+
+def _split_state(state, prefix):
+    inner = {k: v for k, v in state.items() if not k.startswith(prefix)}
+    return inner
+
+
+class _WrappedOptimizer(Optimizer):
+    """Shared plumbing: delegate lr/hyper/decay semantics to the inner
+    optimizer and provide apply()/restore() swapping for eval."""
+
+    _PREFIX = "wrap_"
+
+    def __init__(self, inner_optimizer, parameters=None):
+        self.inner = inner_optimizer
+        if inner_optimizer is not None:
+            params = (parameters if parameters is not None
+                      else inner_optimizer._parameter_list)
+            super().__init__(inner_optimizer._learning_rate, params,
+                             None, inner_optimizer._grad_clip)
+            # already-normalised decay object; bypass _as_decay
+            self._weight_decay = inner_optimizer._weight_decay
+        else:
+            super().__init__(0.0, parameters, None, None)
+        self._backup = {}
+
+    # -- delegation ----------------------------------------------------------
+    def get_lr(self):
+        return self.inner.get_lr() if self.inner is not None else 0.0
+
+    def set_lr(self, value):
+        if self.inner is None:
+            raise RuntimeError("no inner optimizer")
+        self.inner.set_lr(value)
+
+    def _hyper(self):
+        return self.inner._hyper() if self.inner is not None else {}
+
+    def _hyper_for(self, p):
+        return self.inner._hyper_for(p) if self.inner is not None else {}
+
+    def _decoupled_weight_decay(self):
+        return (self.inner._decoupled_weight_decay()
+                if self.inner is not None else False)
+
+    def _inner_apply(self, param, grad, state, lr, hyper):
+        if self.inner is None:
+            return param, {}
+        inner_st = _split_state(state, self._PREFIX)
+        return self.inner._rule(param, grad, inner_st, lr, **hyper)
+
+    # -- eval-time parameter swap -------------------------------------------
+    def _averaged_value(self, state, param):
+        raise NotImplementedError
+
+    def _iter_param_states(self, engine=None):
+        """Yield (setter, getter, state) triples for every parameter,
+        from either the eager accumulators or an Engine's compiled
+        opt_state."""
+        if engine is not None:
+            sd = engine.layer.state_dict()
+            for name, value in list(engine.state.params.items()):
+                st = engine.state.opt_state.get(name)
+                if st is None:
+                    continue
+
+                def setter(v, name=name):
+                    engine.state.params[name] = v
+                    if name in sd:
+                        sd[name]._value = v
+                yield name, setter, value, st
+        else:
+            for i, p in enumerate(self._parameter_list or []):
+                if p is None:
+                    continue
+                st = self._accumulators.get(id(p))
+                if st is None:
+                    continue
+
+                def setter(v, p=p):
+                    p._value = v
+                yield (p.name or f"param_{i}"), setter, p._value, st
+
+    @config.no_grad()
+    def _apply_swap(self, engine=None):
+        if self._backup:
+            raise RuntimeError("apply() is not reentrant; call restore()")
+        for name, setter, value, st in self._iter_param_states(engine):
+            self._backup[name] = value
+            setter(self._averaged_value(st, value))
+
+    @config.no_grad()
+    def restore(self, executor=None, engine=None):
+        """Put the original (non-averaged) parameters back.  Pass the
+        same `engine=` that apply() was given — the backups are keyed by
+        the parameter set that was swapped."""
+        for name, setter, value, st in self._iter_param_states(engine):
+            if name in self._backup:
+                setter(self._backup.pop(name))
+        if self._backup:
+            raise RuntimeError(
+                "restore() could not find parameters for saved backups "
+                f"{sorted(self._backup)}; if apply() was given engine=, "
+                "restore() needs the same engine= (originals are still "
+                "held in ._backup)")
+
+    @contextmanager
+    def apply(self, executor=None, need_restore=True, engine=None):
+        """Swap parameters to their averaged values for evaluation.
+
+        `engine=` applies to an Engine's compiled state (and writes
+        through to the layer); otherwise the eager Parameter list is
+        swapped in place.  `executor` accepted for reference-API
+        compatibility and ignored (no separate apply program is needed —
+        the swap is a host-side tree update).
+        """
+        self._apply_swap(engine=engine)
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore(engine=engine)
+
+
+class LookAhead(_WrappedOptimizer):
+    """k-step slow/fast weights (ref incubate/optimizer/lookahead.py:118).
+
+    Every step the inner optimizer updates the fast weights; every k-th
+    step  slow += alpha * (fast - slow);  fast = slow.
+    """
+
+    _PREFIX = "la_"
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        if inner_optimizer is None:
+            raise ValueError("LookAhead needs an inner optimizer")
+        super().__init__(inner_optimizer)
+        self.alpha = float(alpha)
+        self.k = int(k)
+
+    def _init_state(self, value):
+        st = dict(self.inner._init_state(value))
+        # copy=True: the engine donates params and opt_state separately,
+        # so the slow weights must not alias the parameter buffer
+        st["la_slow"] = jnp.array(value, copy=True)
+        st["la_step"] = jnp.zeros((), jnp.int32)
+        return st
+
+    def _rule(self, param, grad, state, lr, **hyper):
+        fast, new_inner = self._inner_apply(param, grad, state, lr, hyper)
+        step = state["la_step"] + 1
+        sync = (step % self.k) == 0
+        slow = jnp.where(
+            sync,
+            state["la_slow"] + self.alpha * (fast - state["la_slow"]),
+            state["la_slow"]).astype(param.dtype)
+        fast = jnp.where(sync, slow, fast).astype(param.dtype)
+        out = dict(new_inner)
+        out["la_slow"] = slow
+        out["la_step"] = step
+        return fast, out
+
+    def _averaged_value(self, state, param):
+        # eval on the slow weights
+        return state["la_slow"]
+
+
+class ModelAverage(_WrappedOptimizer):
+    """Windowed parameter averaging (ref incubate/optimizer/
+    modelaverage.py + average_accumulates_op.h:80-106).
+
+    Maintains sum_1/sum_2/sum_3 running-parameter sums; when the window
+    num_accumulates >= max(min_average_window,
+                           min(max_average_window, num_updates * rate))
+    is exceeded the old sums rotate into sum_3.  `apply()` swaps params
+    to (sum_1+sum_2+sum_3)/(num_accumulates+old_num_accumulates).
+
+    Use standalone (reference API: step() after the main optimizer's
+    step) or as a wrapper (`inner_optimizer=`) so the accumulation runs
+    inside the compiled Engine train step.
+    """
+
+    _PREFIX = "ma_"
+    _SPILL = 16384  # ref kMaxNumAccumulates precision spill
+
+    def __init__(self, average_window_rate, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None, inner_optimizer=None):
+        super().__init__(inner_optimizer, parameters=parameters)
+        self.average_window = float(average_window_rate)
+        self.min_average_window = int(min_average_window)
+        self.max_average_window = int(max_average_window)
+
+    def _init_state(self, value):
+        st = (dict(self.inner._init_state(value))
+              if self.inner is not None else {})
+        # three distinct buffers: donation forbids aliased leaves
+        st.update({
+            "ma_sum_1": jnp.zeros_like(value),
+            "ma_sum_2": jnp.zeros_like(value),
+            "ma_sum_3": jnp.zeros_like(value),
+            "ma_num_acc": jnp.zeros((), jnp.int32),
+            "ma_old_num_acc": jnp.zeros((), jnp.int32),
+            "ma_num_upd": jnp.zeros((), jnp.int32),
+        })
+        return st
+
+    def _accumulate(self, param, st):
+        n_upd = st["ma_num_upd"] + 1
+        n_acc = st["ma_num_acc"] + 1
+        s1 = st["ma_sum_1"] + param
+        s2, s3 = st["ma_sum_2"], st["ma_sum_3"]
+        spill = (n_upd % self._SPILL) == 0
+        s2 = jnp.where(spill, s2 + s1, s2)
+        s1 = jnp.where(spill, jnp.zeros_like(s1), s1)
+        window = jnp.minimum(
+            jnp.float32(self.max_average_window),
+            n_upd.astype(jnp.float32) * self.average_window)
+        rot = ((n_acc >= self.min_average_window)
+               & (n_acc.astype(jnp.float32) >= window))
+        s3 = jnp.where(rot, s1 + s2, s3)
+        s1 = jnp.where(rot, jnp.zeros_like(s1), s1)
+        s2 = jnp.where(rot, jnp.zeros_like(s2), s2)
+        old = jnp.where(rot, n_acc, st["ma_old_num_acc"])
+        n_acc = jnp.where(rot, 0, n_acc)
+        return {"ma_sum_1": s1, "ma_sum_2": s2, "ma_sum_3": s3,
+                "ma_num_acc": n_acc, "ma_old_num_acc": old,
+                "ma_num_upd": n_upd}
+
+    def _rule(self, param, grad, state, lr, **hyper):
+        new_p, new_inner = self._inner_apply(param, grad, state, lr, hyper)
+        out = dict(new_inner)
+        out.update(self._accumulate(new_p, state))
+        return new_p, out
+
+    @config.no_grad()
+    def step(self):
+        """Standalone accumulation pass (call after the main optimizer's
+        step, reference usage).  Accumulates every parameter in the list
+        whether or not it has a gradient this step."""
+        if self.inner is not None:
+            return super().step()
+        self._global_step += 1
+        for p in self._parameter_list or []:
+            if p is None:
+                continue
+            st = self._state_for(p)
+            new_p, new_st = self._run_rule(
+                p._value, p._value, st, 0.0, self._hyper_for(p))
+            self._accumulators[id(p)] = new_st
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        self.step()
+
+    def _averaged_value(self, state, param):
+        total = (state["ma_num_acc"]
+                 + state["ma_old_num_acc"]).astype(param.dtype)
+        avg = ((state["ma_sum_1"] + state["ma_sum_2"] + state["ma_sum_3"])
+               / jnp.maximum(total, 1))
+        return jnp.where(total > 0, avg, param).astype(param.dtype)
+
+
+class ExponentialMovingAverage(_WrappedOptimizer):
+    """EMA of parameters with bias correction (ref fluid/optimizer.py:3883).
+
+        ema_t = decay * ema_{t-1} + (1 - decay) * theta_t
+        apply:  theta_eval = ema_t / (1 - prod_i decay_i)
+
+    `thres_steps=None` uses the constant decay; any other value enables
+    the reference's decay schedule min(decay, (1+t)/(10+t)) driven by
+    the internal update counter (the static-graph reference threads a
+    global-step Variable; the counter already lives in compiled state
+    here, so no Variable plumbing is needed).
+
+    Use standalone (update() after each optimizer step, reference API)
+    or as a wrapper (`inner_optimizer=`) so the EMA accumulates inside
+    the compiled Engine train step.
+    """
+
+    _PREFIX = "ema_"
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None,
+                 parameters=None, inner_optimizer=None):
+        super().__init__(inner_optimizer, parameters=parameters)
+        self.decay = float(decay)
+        self._thres_steps = thres_steps
+
+    def _init_state(self, value):
+        st = (dict(self.inner._init_state(value))
+              if self.inner is not None else {})
+        st.update({
+            "ema_avg": jnp.zeros_like(value),
+            "ema_decay_prod": jnp.ones((), jnp.float32),
+            "ema_t": jnp.zeros((), jnp.int32),
+        })
+        return st
+
+    def _decay_t(self, t):
+        if self._thres_steps is None:
+            return jnp.float32(self.decay)
+        tf = t.astype(jnp.float32)
+        return jnp.minimum(jnp.float32(self.decay),
+                           (1.0 + tf) / (10.0 + tf))
+
+    def _ema_update(self, param, st):
+        d = self._decay_t(st["ema_t"])
+        avg = (d * st["ema_avg"]
+               + (1.0 - d) * param.astype(st["ema_avg"].dtype))
+        return {"ema_avg": avg,
+                "ema_decay_prod": st["ema_decay_prod"] * d,
+                "ema_t": st["ema_t"] + 1}
+
+    def _rule(self, param, grad, state, lr, **hyper):
+        new_p, new_inner = self._inner_apply(param, grad, state, lr, hyper)
+        out = dict(new_inner)
+        out.update(self._ema_update(new_p, state))
+        return new_p, out
+
+    @config.no_grad()
+    def update(self):
+        """Standalone EMA accumulation (call after each optimizer step,
+        reference API)."""
+        self._global_step += 1
+        for p in self._parameter_list or []:
+            if p is None:
+                continue
+            st = self._state_for(p)
+            _, new_st = self._run_rule(
+                p._value, p._value, st, 0.0, self._hyper_for(p))
+            self._accumulators[id(p)] = new_st
+
+    def _averaged_value(self, state, param):
+        corr = 1.0 - state["ema_decay_prod"]
+        avg = state["ema_avg"] / jnp.maximum(corr, 1e-12)
+        return jnp.where(state["ema_t"] > 0, avg, param).astype(param.dtype)

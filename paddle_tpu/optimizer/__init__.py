@@ -24,6 +24,7 @@ from .lr import LRScheduler
 __all__ = [
     "Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adamax", "Adagrad",
     "Adadelta", "RMSProp", "Lamb", "LarsMomentum", "Lars", "lr",
+    "ExponentialMovingAverage", "LookAhead", "ModelAverage",
 ]
 
 
@@ -778,3 +779,12 @@ class Lamb(Optimizer):
         new_p = p32 - lr * trust * r
         return new_p.astype(param.dtype), {
             "moment1": m, "moment2": v, "beta1_pow": b1p, "beta2_pow": b2p}
+
+
+# Wrappers live in incubate (their home in the reference API tree); the
+# reference also exposes ExponentialMovingAverage from fluid.optimizer,
+# so re-export all three here.  Import is at module tail so the circular
+# incubate->optimizer import resolves against the finished class defs.
+from ..incubate.optimizer import (  # noqa: E402,F401
+    ExponentialMovingAverage, LookAhead, ModelAverage,
+)

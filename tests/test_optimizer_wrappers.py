@@ -1,0 +1,295 @@
+"""LookAhead / ModelAverage / ExponentialMovingAverage equivalence tests.
+
+Each wrapper is checked against an independent numpy hand-rolling of the
+reference semantics (incubate/optimizer/lookahead.py:118,
+average_accumulates_op.h:80-106, fluid/optimizer.py:3883), on both the
+eager step() path and the compiled Engine path.
+"""
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.engine import Engine
+
+
+def _sgd_quadratic(w0, lr, steps):
+    """Hand-rolled SGD on loss = sum(w^2): returns list of param values
+    AFTER each step (grad = 2w)."""
+    w = w0.copy()
+    traj = []
+    for _ in range(steps):
+        w = w - lr * 2.0 * w
+        traj.append(w.copy())
+    return traj
+
+
+# -- LookAhead ---------------------------------------------------------------
+
+def test_lookahead_eager_matches_handrolled():
+    lr, alpha, k, steps = 0.1, 0.5, 3, 10
+    w0 = np.array([5.0, -3.0], np.float32)
+
+    # hand-rolled reference: fast SGD + every-k slow sync
+    fast, slow = w0.copy(), w0.copy()
+    for t in range(1, steps + 1):
+        fast = fast - lr * 2.0 * fast
+        if t % k == 0:
+            slow = slow + alpha * (fast - slow)
+            fast = slow.copy()
+
+    w = paddle.core.Parameter(w0.copy())
+    opt = optimizer.LookAhead(
+        optimizer.SGD(learning_rate=lr, parameters=[w]), alpha=alpha, k=k)
+    for _ in range(steps):
+        loss = (w * w).sum()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    np.testing.assert_allclose(w.numpy(), fast, rtol=1e-6)
+
+
+def test_lookahead_engine_matches_eager():
+    paddle.seed(7)
+    lin = nn.Linear(4, 3)
+    w0 = {k: np.asarray(v._value).copy()
+          for k, v in lin.state_dict().items()}
+    rng = np.random.RandomState(0)
+    xs = [rng.randn(8, 4).astype(np.float32) for _ in range(7)]
+    ys = [rng.randn(8, 3).astype(np.float32) for _ in range(7)]
+
+    def loss_fn(out, y):
+        return ((out - y) ** 2).mean()
+
+    opt = optimizer.LookAhead(
+        optimizer.SGD(learning_rate=0.05, parameters=lin.parameters()),
+        alpha=0.8, k=2)
+    eng = Engine(lin, opt, loss_fn)
+    for x, y in zip(xs, ys):
+        eng.train_batch(x, y)
+
+    # eager replay from the same init
+    paddle.seed(7)
+    lin2 = nn.Linear(4, 3)
+    for k2, v in lin2.state_dict().items():
+        v._value = paddle.core.Tensor(w0[k2])._value
+    opt2 = optimizer.LookAhead(
+        optimizer.SGD(learning_rate=0.05, parameters=lin2.parameters()),
+        alpha=0.8, k=2)
+    for x, y in zip(xs, ys):
+        out = lin2(paddle.core.Tensor(x))
+        loss = ((out - paddle.core.Tensor(y)) ** 2).mean()
+        loss.backward()
+        opt2.step()
+        opt2.clear_grad()
+
+    for name, v in lin2.state_dict().items():
+        np.testing.assert_allclose(
+            np.asarray(eng.state.params[name]), np.asarray(v._value),
+            rtol=2e-5, atol=1e-6)
+
+
+# -- ModelAverage ------------------------------------------------------------
+
+def _modelaverage_ref(traj, rate, min_w, max_w):
+    """Numpy hand-rolling of the average_accumulates rule over a
+    parameter trajectory; returns the applied average after the last
+    accumulation."""
+    s1 = np.zeros_like(traj[0])
+    s2 = np.zeros_like(traj[0])
+    s3 = np.zeros_like(traj[0])
+    n_acc = old = n_upd = 0
+    for p in traj:
+        n_upd += 1
+        n_acc += 1
+        s1 = s1 + p
+        if n_acc >= min_w and n_acc >= min(max_w, n_upd * rate):
+            s3 = s1 + s2
+            s1, s2 = np.zeros_like(s1), np.zeros_like(s2)
+            old, n_acc = n_acc, 0
+    total = n_acc + old
+    return (s1 + s2 + s3) / max(total, 1)
+
+
+def test_modelaverage_standalone_matches_handrolled():
+    lr, steps = 0.1, 9
+    rate, min_w, max_w = 0.5, 2, 4
+    w0 = np.array([4.0, -2.0], np.float32)
+    traj = _sgd_quadratic(w0, lr, steps)
+    want = _modelaverage_ref(traj, rate, min_w, max_w)
+
+    w = paddle.core.Parameter(w0.copy())
+    sgd = optimizer.SGD(learning_rate=lr, parameters=[w])
+    ma = optimizer.ModelAverage(rate, parameters=[w],
+                                min_average_window=min_w,
+                                max_average_window=max_w)
+    for _ in range(steps):
+        loss = (w * w).sum()
+        loss.backward()
+        sgd.step()
+        ma.step()          # reference usage: accumulate after the update
+        sgd.clear_grad()
+    before = w.numpy().copy()
+    with ma.apply():
+        np.testing.assert_allclose(w.numpy(), want, rtol=1e-5)
+    np.testing.assert_allclose(w.numpy(), before)  # restored
+
+
+def test_modelaverage_engine_wrapper():
+    paddle.seed(11)
+    lin = nn.Linear(3, 2)
+    rng = np.random.RandomState(1)
+    xs = [rng.randn(6, 3).astype(np.float32) for _ in range(6)]
+    ys = [rng.randn(6, 2).astype(np.float32) for _ in range(6)]
+
+    def loss_fn(out, y):
+        return ((out - y) ** 2).mean()
+
+    ma = optimizer.ModelAverage(
+        1.0, min_average_window=2, max_average_window=3,
+        inner_optimizer=optimizer.SGD(learning_rate=0.05,
+                                      parameters=lin.parameters()))
+    eng = Engine(lin, ma, loss_fn)
+    traj = []
+    for x, y in zip(xs, ys):
+        eng.train_batch(x, y)
+        traj.append(np.asarray(eng.state.params["weight"]).copy())
+
+    want = _modelaverage_ref(traj, 1.0, 2, 3)
+    raw = traj[-1]
+    with ma.apply(engine=eng):
+        np.testing.assert_allclose(
+            np.asarray(eng.state.params["weight"]), want, rtol=1e-5)
+        # write-through to the layer for eval
+        np.testing.assert_allclose(
+            np.asarray(lin.state_dict()["weight"]._value), want, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(eng.state.params["weight"]), raw)
+
+
+# -- ExponentialMovingAverage ------------------------------------------------
+
+def test_ema_matches_handrolled_bias_correction():
+    lr, decay, steps = 0.1, 0.9, 6
+    w0 = np.array([3.0, 1.0], np.float32)
+    traj = _sgd_quadratic(w0, lr, steps)
+    ema = np.zeros_like(w0)
+    for p in traj:
+        ema = decay * ema + (1 - decay) * p
+    want = ema / (1 - decay ** steps)
+
+    w = paddle.core.Parameter(w0.copy())
+    sgd = optimizer.SGD(learning_rate=lr, parameters=[w])
+    e = optimizer.ExponentialMovingAverage(decay, parameters=[w])
+    for _ in range(steps):
+        loss = (w * w).sum()
+        loss.backward()
+        sgd.step()
+        e.update()
+        sgd.clear_grad()
+    before = w.numpy().copy()
+    with e.apply():
+        np.testing.assert_allclose(w.numpy(), want, rtol=1e-5)
+    np.testing.assert_allclose(w.numpy(), before)
+
+
+def test_ema_thres_steps_schedule():
+    # scheduled decay: d_t = min(decay, (1+t)/(10+t)), t = 0-based count
+    lr, decay, steps = 0.1, 0.999, 5
+    w0 = np.array([2.0], np.float32)
+    traj = _sgd_quadratic(w0, lr, steps)
+    ema, prod = np.zeros_like(w0), 1.0
+    for t, p in enumerate(traj):
+        d = min(decay, (1 + t) / (10 + t))
+        ema = d * ema + (1 - d) * p
+        prod *= d
+    want = ema / (1 - prod)
+
+    w = paddle.core.Parameter(w0.copy())
+    sgd = optimizer.SGD(learning_rate=lr, parameters=[w])
+    e = optimizer.ExponentialMovingAverage(decay, thres_steps=True,
+                                           parameters=[w])
+    for _ in range(steps):
+        loss = (w * w).sum()
+        loss.backward()
+        sgd.step()
+        e.update()
+        sgd.clear_grad()
+    with e.apply():
+        np.testing.assert_allclose(w.numpy(), want, rtol=1e-5)
+
+
+def test_ema_engine_wrapper():
+    paddle.seed(3)
+    lin = nn.Linear(3, 2)
+    rng = np.random.RandomState(2)
+    xs = [rng.randn(5, 3).astype(np.float32) for _ in range(5)]
+    ys = [rng.randn(5, 2).astype(np.float32) for _ in range(5)]
+
+    def loss_fn(out, y):
+        return ((out - y) ** 2).mean()
+
+    decay = 0.8
+    e = optimizer.ExponentialMovingAverage(
+        decay, inner_optimizer=optimizer.SGD(
+            learning_rate=0.05, parameters=lin.parameters()))
+    eng = Engine(lin, e, loss_fn)
+    traj = []
+    for x, y in zip(xs, ys):
+        eng.train_batch(x, y)
+        traj.append(np.asarray(eng.state.params["bias"]).copy())
+
+    ema = np.zeros_like(traj[0])
+    for p in traj:
+        ema = decay * ema + (1 - decay) * p
+    want = ema / (1 - decay ** len(traj))
+    raw = traj[-1]
+    with e.apply(engine=eng):
+        np.testing.assert_allclose(
+            np.asarray(eng.state.params["bias"]), want, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(eng.state.params["bias"]), raw)
+
+
+def test_wrapper_state_dict_roundtrip():
+    w = paddle.core.Parameter(np.array([1.0, 2.0], np.float32))
+    opt = optimizer.LookAhead(
+        optimizer.Momentum(learning_rate=0.1, momentum=0.9,
+                           parameters=[w]), alpha=0.5, k=2)
+    for _ in range(3):
+        ((w * w).sum()).backward()
+        opt.step()
+        opt.clear_grad()
+    sd = opt.state_dict()
+    assert any(k.endswith(".la_slow") for k in sd)
+    assert any(k.endswith(".velocity") for k in sd)
+
+    w2 = paddle.core.Parameter(np.array([1.0, 2.0], np.float32))
+    opt2 = optimizer.LookAhead(
+        optimizer.Momentum(learning_rate=0.1, momentum=0.9,
+                           parameters=[w2]), alpha=0.5, k=2)
+    opt2.set_state_dict(sd)
+    st = opt2._accumulators[id(w2)]
+    np.testing.assert_allclose(np.asarray(st["la_slow"]),
+                               np.asarray(opt._accumulators[id(w)]["la_slow"]))
+
+
+def test_restore_engine_mismatch_raises_and_recovers():
+    # review finding (r4): restore() without the engine apply() was given
+    # must not silently discard the saved originals
+    import pytest
+
+    paddle.seed(5)
+    lin = nn.Linear(2, 2)
+    e = optimizer.ExponentialMovingAverage(
+        0.9, inner_optimizer=optimizer.SGD(
+            learning_rate=0.1, parameters=lin.parameters()))
+    eng = Engine(lin, e, lambda out, y: ((out - y) ** 2).mean())
+    x = np.ones((3, 2), np.float32)
+    y = np.zeros((3, 2), np.float32)
+    eng.train_batch(x, y)
+    raw = np.asarray(eng.state.params["weight"]).copy()
+
+    e._apply_swap(engine=eng)
+    with pytest.raises(RuntimeError, match="restore"):
+        e.restore()  # wrong: eager path has no accumulators
+    e.restore(engine=eng)  # originals still held; correct call recovers
+    np.testing.assert_allclose(np.asarray(eng.state.params["weight"]), raw)
