@@ -2486,3 +2486,48 @@ case("deformable_conv",
      prop=lambda outs, inputs, attrs: np.testing.assert_equal(
          np.asarray(outs[0]).shape, (1, 4, 5, 5)),
      grad=None, bf16=False)
+
+
+# ---------------------------------------------------------------------------
+# Finite-difference gradient certification (VERDICT r3 item 3).
+#
+# The tape-vs-jax.grad sweep above certifies tape PLUMBING; both sides run
+# the same AD through the same registered fn, so it cannot catch wrong
+# gradient MATH (hand-written custom_vjp rules most of all).  The ops named
+# here additionally have their analytic gradient checked against centred
+# finite differences of the op's pure function (ref op_test.py:1409
+# numeric-vs-analytic check — the load-bearing reference fixture).
+#
+# Curation rule: smooth (or C1) ops only — fd across a relu/abs/max kink or
+# a sort/topk permutation boundary is noise, so piecewise ops whose case
+# inputs straddle kinks stay out.  Value = per-op overrides:
+#   case      which grad case to certify (default 0)
+#   rtol/atol fd comparison tolerances (default 5e-2 / 2e-2)
+#   max_elems cap on sampled input elements per wrt tensor (default 256)
+FD_OPS: dict[str, dict] = {op: {} for op in """
+sigmoid tanh exp expm1 log log1p log2 log10 sin cos sinh cosh atan atan2
+erf gelu silu swish mish softplus softsign logsigmoid stanh square sqrt
+rsqrt reciprocal pow cumsum logcumsumexp logsumexp lgamma
+reduce_sum reduce_mean mean var std frobenius_norm squared_l2_norm
+l2_normalize
+matmul matmul_v2 mul bmm mv dot outer addmm kron cos_sim cosine_similarity
+conv1d conv2d conv3d conv2d_transpose depthwise_conv2d row_conv conv_shift
+sequence_conv
+layer_norm batch_norm instance_norm group_norm rms_norm label_smooth
+affine_channel
+mse_loss log_loss bce_loss kldiv_loss huber_loss smooth_l1_loss nll_loss
+cross_entropy softmax_with_cross_entropy sigmoid_cross_entropy_with_logits
+sigmoid_focal_loss bpr_loss npair_loss
+softmax log_softmax sequence_softmax
+flash_attention scaled_dot_product_attention
+sequence_pool sequence_pad sequence_unpad sequence_concat sequence_reverse
+sequence_first_step sequence_last_step
+bilinear_interp_v2 nearest_interp_v2 grid_sampler roi_align pixel_shuffle
+unfold temporal_shift
+lerp dist cross logaddexp elementwise_mul elementwise_div
+linear_chain_crf warpctc solve cholesky det slogdet
+""".split()}
+# attention kernels sum many products: loosen for f32 fd roundoff
+FD_OPS["flash_attention"].update(rtol=8e-2, atol=4e-2)
+FD_OPS["scaled_dot_product_attention"].update(rtol=8e-2, atol=4e-2)
+FD_OPS["warpctc"].update(rtol=8e-2, atol=4e-2)
