@@ -24,7 +24,8 @@ import numpy as np
 
 import jax
 
-__all__ = ["Config", "Tensor", "Predictor", "create_predictor"]
+__all__ = ["Config", "Tensor", "Predictor", "PredictorPool",
+           "create_predictor"]
 
 
 class Config:
@@ -88,6 +89,14 @@ class Tensor:
     def copy_from_cpu(self, array):
         self._value = jax.device_put(np.ascontiguousarray(array))
 
+    def share_external_data(self, array):
+        """ref paddle_infer::Tensor::ShareExternalData — hand the buffer
+        over without a host-side staging copy.  device_put of a numpy
+        array is the one unavoidable H2D transfer; jax arrays pass
+        through untouched."""
+        self._value = array if isinstance(array, jax.Array) \
+            else jax.device_put(array)
+
     def copy_to_cpu(self):
         return np.asarray(self._value)
 
@@ -148,6 +157,13 @@ class Predictor:
         return self._outputs[name]
 
     def clone(self):
+        """New predictor over the SAME loaded weights and compiled
+        program (ref AnalysisPredictor::Clone shared-weights contract):
+        only the I/O handle set is per-clone, so N serving threads cost
+        one copy of the model.  Threading contract matches the
+        reference: one predictor (or clone) per thread — handles are
+        per-predictor mutable state; the underlying program execution is
+        pure and safe to run concurrently across clones."""
         other = Predictor.__new__(Predictor)
         other._config = self._config
         other._layer = self._layer  # shared weights (ref predictor clone)
@@ -155,6 +171,32 @@ class Predictor:
         other._inputs = {n: Tensor(n) for n in self._inputs}
         other._outputs = {}
         return other
+
+    def try_shrink_memory(self):
+        """ref AnalysisPredictor::TryShrinkMemory — PJRT owns buffer
+        lifetime; dropping output handles releases the only references
+        this layer holds."""
+        self._outputs = {}
+        for h in self._inputs.values():
+            h._value = None
+
+
+class PredictorPool:
+    """ref paddle_infer::services::PredictorPool: one loaded model,
+    `size` clones sharing its weights — retrieve(i) per serving thread.
+    """
+
+    def __init__(self, config, size=1):
+        if size < 1:
+            raise ValueError("pool size must be >= 1")
+        main = Predictor(config)
+        self._preds = [main] + [main.clone() for _ in range(size - 1)]
+
+    def retrieve(self, idx):
+        return self._preds[idx]
+
+    def __len__(self):
+        return len(self._preds)
 
 
 def create_predictor(config):

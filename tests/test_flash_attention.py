@@ -244,6 +244,7 @@ def test_sdpa_dropout_routes_to_flash_and_trains():
     assert np.isfinite(q.grad.numpy()).all()
 
 
+@pytest.mark.tpu
 @pytest.mark.skipif(jax.default_backend() != "tpu",
                     reason="pallas dropout PRNG requires a real TPU "
                            "(interpret mode cannot execute the "

@@ -1,0 +1,110 @@
+"""Serving-depth tests (VERDICT r3 item 7): predictor clone shares
+weights, concurrent multi-threaded run over one exported artifact, pool
+API, zero-copy input handles.
+
+Ref parity: paddle/fluid/inference/api/analysis_predictor.h:82 (Clone
+shared-weights contract), paddle_infer::services::PredictorPool,
+paddle_infer::Tensor::ShareExternalData.
+"""
+
+import threading
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.jit import InputSpec
+import paddle_tpu.nn as nn
+
+
+def _export(tmp_path, seed=5):
+    paddle.seed(seed)
+    model = nn.Sequential(nn.Linear(8, 16), nn.GELU(), nn.Linear(16, 4))
+    model.eval()
+    prefix = str(tmp_path / "served")
+    paddle.jit.save(model, prefix,
+                    input_spec=[InputSpec([4, 8], "float32")])
+    return model, prefix
+
+
+def test_clone_shares_weights_and_program(tmp_path):
+    _, prefix = _export(tmp_path)
+    pred = paddle.inference.create_predictor(
+        paddle.inference.Config(prefix))
+    clone = pred.clone()
+    # the shared-weights contract is structural: same loaded layer
+    # object, so N clones hold ONE copy of params + compiled program
+    assert clone._layer is pred._layer
+    assert clone.get_input_names() == pred.get_input_names()
+    # handles must NOT be shared (per-thread mutable state)
+    assert clone.get_input_handle(clone.get_input_names()[0]) is not \
+        pred.get_input_handle(pred.get_input_names()[0])
+
+
+def test_multithreaded_serving_over_one_artifact(tmp_path):
+    """N threads, each with its own clone from a PredictorPool, hammer
+    the same exported artifact concurrently; every result must equal the
+    single-threaded reference for its batch."""
+    model, prefix = _export(tmp_path)
+    n_threads, n_reqs = 4, 12
+    pool = paddle.inference.PredictorPool(
+        paddle.inference.Config(prefix), n_threads)
+    assert len(pool) == n_threads
+
+    rng = np.random.RandomState(0)
+    batches = [rng.randn(4, 8).astype(np.float32)
+               for _ in range(n_threads * n_reqs)]
+    expect = [model(Tensor(b)).numpy() for b in batches]
+
+    results = [None] * len(batches)
+    errors = []
+    start = threading.Barrier(n_threads)
+
+    def worker(tid):
+        try:
+            p = pool.retrieve(tid)
+            h_in = p.get_input_handle(p.get_input_names()[0])
+            start.wait()
+            for r in range(n_reqs):
+                i = tid * n_reqs + r
+                h_in.copy_from_cpu(batches[i])
+                assert p.run()
+                results[i] = p.get_output_handle(
+                    p.get_output_names()[0]).copy_to_cpu()
+        except Exception as e:  # noqa: BLE001 — surface in main thread
+            errors.append((tid, e))
+
+    threads = [threading.Thread(target=worker, args=(t,))
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+    for got, exp in zip(results, expect):
+        np.testing.assert_allclose(got, exp, rtol=1e-5, atol=1e-6)
+
+
+def test_share_external_data_and_shrink(tmp_path):
+    import jax
+
+    _, prefix = _export(tmp_path)
+    pred = paddle.inference.create_predictor(
+        paddle.inference.Config(prefix))
+    x = np.random.RandomState(1).randn(4, 8).astype(np.float32)
+    dev_x = jax.device_put(x)  # caller-owned device buffer
+    h = pred.get_input_handle(pred.get_input_names()[0])
+    h.share_external_data(dev_x)
+    assert h._value is dev_x  # no copy for device-resident input
+    pred.run()
+    out1 = pred.get_output_handle(
+        pred.get_output_names()[0]).copy_to_cpu()
+
+    h.copy_from_cpu(x)
+    pred.run()
+    out2 = pred.get_output_handle(
+        pred.get_output_names()[0]).copy_to_cpu()
+    np.testing.assert_allclose(out1, out2, rtol=1e-6)
+
+    pred.try_shrink_memory()
+    assert pred.get_output_names() == []

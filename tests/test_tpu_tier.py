@@ -1,0 +1,173 @@
+"""Real-chip tier (VERDICT r3 item 2): the CPU-mesh suite never touches
+the TPU, so bf16-on-MXU numerics, VMEM limits, the non-interpreted
+Pallas kernels, and compiled-engine behaviour on hardware were verified
+by nothing but bench.py's single config.  These tests run the same
+load-bearing paths on the attached chip:
+
+    PADDLE_TPU_TESTS_TPU=1 python -m pytest tests/ -m tpu
+
+Self-skips when no TPU is attached (e.g. plain CPU suite runs).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+pytestmark = [
+    pytest.mark.tpu,
+    pytest.mark.skipif(jax.default_backend() != "tpu",
+                       reason="needs a real TPU backend"),
+]
+
+
+def _sdpa_ref(q, k, v, causal, scale=None):
+    import math
+    d = q.shape[-1]
+    s = scale or 1.0 / math.sqrt(d)
+    logits = jnp.einsum("bhqd,bhkd->bhqk",
+                        q.astype(jnp.float32), k.astype(jnp.float32)) * s
+    if causal:
+        ql, kl = logits.shape[-2], logits.shape[-1]
+        mask = jnp.tril(jnp.ones((ql, kl), bool), k=kl - ql)
+        logits = jnp.where(mask, logits, -1e30)
+    p = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("dtype,tol", [(jnp.float32, 3e-3),
+                                       (jnp.bfloat16, 3e-2)])
+def test_flash_attention_pallas_on_chip(causal, dtype, tol):
+    """The ACTUAL Pallas kernels (not interpreter): fwd + bwd vs the jnp
+    softmax reference, fp32 and bf16.  Tolerances sized for MXU matmul
+    precision (f32 ~bf16x3 passes, bf16 inputs)."""
+    from paddle_tpu.ops import fused_ops
+
+    rng = np.random.default_rng(0)
+    shape = (2, 4, 256, 64)
+    q, k, v = (jnp.asarray(rng.standard_normal(shape), dtype)
+               for _ in range(3))
+    os.environ["PADDLE_TPU_FLASH_FORCE"] = "pallas"
+    try:
+        got = fused_ops.flash_attention(q, k, v, is_causal=causal)
+        gq, gk, gv = jax.grad(
+            lambda a, b, c: jnp.sum(
+                fused_ops.flash_attention(
+                    a, b, c, is_causal=causal).astype(jnp.float32) ** 2),
+            argnums=(0, 1, 2))(q, k, v)
+    finally:
+        os.environ.pop("PADDLE_TPU_FLASH_FORCE", None)
+
+    want = _sdpa_ref(q, k, v, causal)
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(want), rtol=tol, atol=tol)
+
+    rq, rk, rv = jax.grad(
+        lambda a, b, c: jnp.sum(_sdpa_ref(a, b, c, causal) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    gtol = max(tol, 1e-2)  # bwd accumulates one more matmul
+    for g, r in zip((gq, gk, gv), (rq, rk, rv)):
+        np.testing.assert_allclose(
+            np.asarray(g, np.float32), np.asarray(r, np.float32),
+            rtol=gtol, atol=gtol)
+
+
+def test_engine_train_step_on_chip():
+    """One compiled Engine train step sequence on hardware: loss falls,
+    params move, everything stays finite under bf16 autocast."""
+    import paddle_tpu as paddle
+    from paddle_tpu import amp, nn
+    from paddle_tpu.engine import Engine
+
+    paddle.seed(0)
+    model = nn.Sequential(nn.Linear(32, 64), nn.GELU(), nn.Linear(64, 8))
+    opt = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                 parameters=model.parameters())
+    eng = Engine(model, opt, lambda out, y: ((out - y) ** 2).mean())
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(16, 32).astype(np.float32)
+    y = rng.randn(16, 8).astype(np.float32)
+    losses = []
+    for _ in range(8):
+        with amp.auto_cast(enable=True, dtype="bfloat16"):
+            losses.append(float(np.asarray(eng.train_batch(x, y)._value)))
+    assert all(np.isfinite(losses)), losses
+    assert losses[-1] < losses[0] * 0.7, losses
+    w = np.asarray(eng.state.params[next(iter(eng.state.params))])
+    assert np.isfinite(w).all()
+
+
+def test_static_executor_on_chip():
+    """Static-graph Executor: build, minimize, run feed/fetch on the
+    chip; loss must drop on a fit-a-line problem."""
+    import paddle_tpu as paddle
+    from paddle_tpu import static
+
+    paddle.enable_static()
+    try:
+        main = static.Program()
+        startup = static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [None, 4], "float32")
+            y = static.data("y", [None, 1], "float32")
+            pred = static.nn.fc(x, size=1)
+            loss = paddle.mean((pred - y) ** 2)
+            opt = paddle.optimizer.SGD(learning_rate=0.05)
+            opt.minimize(loss)
+        exe = static.Executor()
+        exe.run(startup)
+        rng = np.random.RandomState(1)
+        xv = rng.randn(64, 4).astype(np.float32)
+        yv = (xv @ np.array([[1.0], [-2.0], [0.5], [3.0]], np.float32)
+              + 0.1).astype(np.float32)
+        first = None
+        for _ in range(30):
+            (lv,) = exe.run(main, feed={"x": xv, "y": yv},
+                            fetch_list=[loss])
+            if first is None:
+                first = float(lv)
+        assert float(lv) < 0.1 * first, (first, float(lv))
+    finally:
+        paddle.disable_static()
+
+
+def test_bf16_matmul_mxu_tolerance():
+    """bf16 on the MXU must stay within the expected error band of the
+    f64 reference — catches accidental fp8/truncation regressions in
+    default matmul precision."""
+    rng = np.random.RandomState(0)
+    a = rng.randn(256, 512).astype(np.float32)
+    b = rng.randn(512, 128).astype(np.float32)
+    ref = np.asarray(a, np.float64) @ np.asarray(b, np.float64)
+    got = np.asarray(
+        jnp.asarray(a, jnp.bfloat16) @ jnp.asarray(b, jnp.bfloat16),
+        np.float64)
+    # bf16 has 8 mantissa bits: relative error ~2^-8 per element times
+    # sqrt(K) accumulation; 5e-2 relative on O(sqrt(512)) outputs
+    denom = np.maximum(np.abs(ref), 1.0)
+    assert (np.abs(got - ref) / denom).max() < 5e-2
+
+
+def test_dropout_rbg_prng_on_chip():
+    """Hardware PRNG path (rbg impl, as bench.py configures): masks are
+    deterministic for a fixed key and differ across keys."""
+    from paddle_tpu.nn import functional as F
+    from paddle_tpu.core.tensor import Tensor
+    import paddle_tpu as paddle
+
+    x = Tensor(np.ones((64, 64), np.float32))
+    paddle.seed(7)
+    a = F.dropout(x, p=0.5, training=True).numpy()
+    paddle.seed(7)
+    b = F.dropout(x, p=0.5, training=True).numpy()
+    paddle.seed(8)
+    c = F.dropout(x, p=0.5, training=True).numpy()
+    np.testing.assert_array_equal(a, b)
+    assert (a != c).any()
+    frac = (a == 0).mean()
+    assert 0.35 < frac < 0.65, frac
