@@ -42,6 +42,20 @@ def pipeline_spmd(stage_fn, mesh, *, num_stages, num_micro):
     microbatches:   [num_micro, micro_batch, ...]
     outputs:        [num_micro, micro_batch, ...] (from the last stage)
 
+    NON-UNIFORM stages (ref pp_layers.py:76 SharedLayerDesc / custom
+    segmentation): pass a LIST of `num_stages` callables instead of one
+    `stage_fn` — stage s runs `stage_fns[s]` via `lax.switch` on the pp
+    axis index (XLA executes only the taken branch per device).  Two
+    contracts: every stage maps the same activation shape to the same
+    activation shape (the ring carries one layout), and per-stage
+    weights that do not fit the uniform stacked-params tree are closed
+    over (as traced values, so AD still reaches them) or left in GSPMD
+    land outside the shard_map.  Weight TYING across stages (GPT-2
+    embedding/head) needs no machinery at all in this design: tied
+    weights live once in the non-pipelined params and jax AD sums their
+    gradient contributions from every use site — see
+    hybrid.make_gpt_hybrid_engine.
+
     Memory schedule (the 1F1B working-set analogue,
     ref section_worker.cc:134-180): the micro-batch stream is SHARDED over
     'pp' (device s holds micro-batches {j*S+s}, L = M/S each) instead of
@@ -66,6 +80,20 @@ def pipeline_spmd(stage_fn, mesh, *, num_stages, num_micro):
     M_pad = L * S
     fwd = [(i, (i + 1) % S) for i in range(S)]
     back = [(i, (i - 1) % S) for i in range(S)]
+
+    if callable(stage_fn):
+        def apply_stage(stage, local, inp):
+            return stage_fn(local, inp)
+    else:
+        fns = list(stage_fn)
+        if len(fns) != S:
+            raise ValueError(
+                f"stage_fns has {len(fns)} entries for {S} stages")
+
+        def apply_stage(stage, local, inp):
+            return jax.lax.switch(
+                stage, [lambda l, x, f=f: f(l, x) for f in fns],
+                local, inp)
 
     def per_device(params, x_local):
         # inside shard_map over 'pp': params leaves are [1, ...] (this
@@ -97,7 +125,7 @@ def pipeline_spmd(stage_fn, mesh, *, num_stages, num_micro):
                 cap, outs.at[jnp.clip(jcap, 0, L - 1)].set(oring), outs)
             # 3) stage compute (stage 0 eats the input ring)
             inp = jnp.where(stage == 0, iring, act)
-            out = stage_fn(local, inp)
+            out = apply_stage(stage, local, inp)
             # 4) last stage: emit into the output ring; micro-batches it
             # owns itself (t % S == S-1) are stored directly
             t = u - (S - 1)

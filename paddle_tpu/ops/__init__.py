@@ -20,3 +20,4 @@ from . import detection_ops  # noqa: F401
 from . import extra_ops  # noqa: F401
 from . import long_tail_ops  # noqa: F401
 from . import compat_ops  # noqa: F401
+from . import quant_ops  # noqa: F401

@@ -1,0 +1,89 @@
+"""Fake-quantization ops.
+
+Ref parity: paddle/fluid/operators/fake_quantize_op.cc kernels behind
+the slim quantization passes (python/paddle/fluid/contrib/slim/
+quantization/quantization_pass.py op set).  None of the code mirrors the
+reference kernels — each op is a pure jnp composition.
+
+TPU-native design: quant-dequant is SIMULATED in float arithmetic with a
+straight-through estimator spelled as `x + stop_gradient(qdq(x) - x)`,
+so one registered op serves QAT training, PTQ calibration, and frozen
+inference under jit with no custom gradient plumbing (the reference
+pairs each fake_quantize op with a pass-through grad op).  True int8
+storage happens at freeze time in paddle_tpu.quantization, where weights
+are kept as int8 arrays and dequantized on the fly — on TPU the win is
+HBM bytes, not int8 ALUs, so dequant-to-bf16 before the MXU matmul is
+the native lowering.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.op_registry import register_op
+
+
+def _qmax(bit_length):
+    return float(2 ** (bit_length - 1) - 1)
+
+
+def quant_dequant(x, scale, qmax):
+    """Symmetric uniform quantize-dequantize: round(x/scale*qmax) bucket
+    values, clipped to [-qmax, qmax], mapped back to float."""
+    s = jnp.maximum(jnp.asarray(scale, x.dtype), 1e-9)
+    q = jnp.clip(jnp.round(x / s * qmax), -qmax, qmax)
+    return q * s / qmax
+
+
+def _ste(x, y):
+    """Straight-through estimator: forward y, gradient of identity."""
+    return x + lax.stop_gradient(y - x)
+
+
+@register_op("fake_quantize_dequantize_abs_max", has_aux=True)
+def fake_quantize_dequantize_abs_max(x, *, bit_length=8):
+    """ref fake_quantize_op.cc FakeQuantizeDequantizeAbsMax: per-tensor
+    dynamic scale = max|x|; returns (out, scale)."""
+    qmax = _qmax(bit_length)
+    scale = jnp.max(jnp.abs(x)).astype(jnp.float32)
+    y = _ste(x, quant_dequant(x, scale, qmax))
+    return y, lax.stop_gradient(scale)
+
+
+@register_op("fake_channel_wise_quantize_dequantize_abs_max", has_aux=True)
+def fake_channel_wise_quantize_dequantize_abs_max(x, *, bit_length=8,
+                                                  quant_axis=0):
+    """ref fake_quantize_op.cc channel-wise variant: one scale per slice
+    along quant_axis (conv OIHW -> axis 0; linear [in,out] -> axis 1)."""
+    qmax = _qmax(bit_length)
+    axes = tuple(a for a in range(x.ndim) if a != quant_axis)
+    scale = jnp.max(jnp.abs(x), axis=axes).astype(jnp.float32)
+    sshape = [1] * x.ndim
+    sshape[quant_axis] = x.shape[quant_axis]
+    y = _ste(x, quant_dequant(x, scale.reshape(sshape), qmax))
+    return y, lax.stop_gradient(scale)
+
+
+@register_op("fake_quantize_dequantize_moving_average_abs_max",
+             has_aux=True)
+def fake_quantize_dequantize_moving_average_abs_max(
+        x, in_scale, *, bit_length=8, moving_rate=0.9, is_test=False):
+    """ref fake_quantize_op.cc moving-average variant: activations keep
+    an EMA of per-batch abs-max; inference freezes it.  Returns
+    (out, new_scale) — the caller threads new_scale back into its
+    buffer, exactly the running-stat pattern batch_norm uses."""
+    qmax = _qmax(bit_length)
+    in_scale = jnp.asarray(in_scale, jnp.float32).reshape(())
+    if is_test:
+        scale = in_scale
+    else:
+        cur = jnp.max(jnp.abs(x)).astype(jnp.float32)
+        # first batch (scale==0) adopts the batch stat outright so the
+        # EMA never anchors on the zero init
+        ema = moving_rate * in_scale + (1.0 - moving_rate) * cur
+        scale = jnp.where(in_scale > 0, ema, cur)
+    # an uncalibrated scale (eval/export before any training batch) must
+    # pass the activation through, not clamp it to ~0
+    y = jnp.where(scale > 0, _ste(x, quant_dequant(x, scale, qmax)), x)
+    return y, lax.stop_gradient(scale)

@@ -1,0 +1,383 @@
+"""Quantization toolkit: QAT wrapping + post-training quantization.
+
+Ref parity: python/paddle/fluid/contrib/slim/quantization/imperative/
+qat.py:40 (ImperativeQuantAware), post_training_quantization.py:124
+(PostTrainingQuantization), quantization_pass.py (fake-quant op
+insertion), paddle/fluid/inference/tensorrt/trt_int8_calibrator.h
+(calibration-driven int8 serving).
+
+TPU-native design: the reference rewrites ProgramDesc graphs and hands
+int8 GEMMs to TensorRT/MKL-DNN.  Here quantization is a LAYER transform:
+
+* QAT — `ImperativeQuantAware.quantize(model)` swaps Linear/Conv2D for
+  wrappers that fake-quant weights (channel-wise abs-max) and
+  activations (moving-average abs-max, scale in a buffer that threads
+  through the compiled Engine step like BN running stats).  The
+  straight-through estimator lives inside the registered fake-quant
+  ops, so the wrapped model trains under jit unchanged.
+* PTQ — `PostTrainingQuantization` runs eager calibration batches
+  through observer wrappers, picks activation scales (abs_max / avg /
+  hist percentile), then FREEZES: weights stored as int8 arrays with
+  per-channel f32 scales, dequantized to the compute dtype in forward.
+  On TPU the win is HBM bytes (int8 at rest, half of bf16), not int8
+  ALUs — dequant-to-bf16 feeding the MXU is the native lowering, and
+  XLA fuses the dequant into the matmul's operand read.
+
+The frozen model is a normal Layer: jit.save exports it (int8 weights
+and all), and the serving Predictor runs it with no quant-specific code.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..core.dispatch import apply
+from ..core.tensor import Tensor
+from ..nn import functional as F
+from ..nn.layer.layers import Layer
+from ..ops.quant_ops import quant_dequant
+
+__all__ = [
+    "ImperativeQuantAware", "PostTrainingQuantization",
+    "QuantedLinear", "QuantedConv2D",
+    "QuantizedLinearInt8", "QuantizedConv2DInt8",
+    "quantize_weight_int8",
+]
+
+
+def quantize_weight_int8(w, quant_axis):
+    """w (f32 array) -> (int8 array, per-channel f32 scale along
+    quant_axis)."""
+    w = np.asarray(w, np.float32)
+    axes = tuple(a for a in range(w.ndim) if a != quant_axis)
+    scale = np.maximum(np.abs(w).max(axis=axes), 1e-9).astype(np.float32)
+    sshape = [1] * w.ndim
+    sshape[quant_axis] = w.shape[quant_axis]
+    q = np.clip(np.round(w / scale.reshape(sshape) * 127.0),
+                -127, 127).astype(np.int8)
+    return q, scale
+
+
+def _dequantize_int8(q, scale, quant_axis, dtype):
+    sshape = [1] * q.ndim
+    sshape[quant_axis] = q.shape[quant_axis]
+    return (q.astype(jnp.float32) *
+            scale.reshape(sshape) / 127.0).astype(dtype)
+
+
+class _MovingAverageObserver(Layer):
+    """Activation fake-quant with an EMA abs-max scale buffer (QAT) or a
+    raw-statistics recorder (PTQ calibration)."""
+
+    def __init__(self, activation_bits=8, moving_rate=0.9):
+        super().__init__()
+        self._bits = activation_bits
+        self._moving_rate = moving_rate
+        self.register_buffer("scale", Tensor(jnp.zeros((), jnp.float32)))
+        self._collect = None  # PTQ mode: {"max": [...], "samples": [...]}
+
+    def forward(self, x):
+        if self._collect is not None:
+            # eager calibration pass: record, do not quantize.  Per-batch
+            # abs-max feeds 'abs_max'/'avg'; a strided |x| subsample
+            # (bounded per batch) feeds the 'hist' percentile so it sees
+            # the activation DISTRIBUTION, not just its extremes.
+            a = np.abs(np.asarray(x._value, np.float32)).ravel()
+            self._collect["max"].append(float(a.max()))
+            stride = max(1, a.size // 4096)
+            self._collect["samples"].append(a[::stride])
+            return x
+        y, new_scale = apply(
+            "fake_quantize_dequantize_moving_average_abs_max",
+            x, self.scale, bit_length=self._bits,
+            moving_rate=self._moving_rate, is_test=not self.training)
+        if self.training:
+            self.scale.set_value(new_scale)
+        return y
+
+
+def _fake_quant_weight(weight, bits, quant_axis, channel_wise):
+    if channel_wise:
+        w, _ = apply("fake_channel_wise_quantize_dequantize_abs_max",
+                     weight, bit_length=bits, quant_axis=quant_axis)
+    else:
+        w, _ = apply("fake_quantize_dequantize_abs_max", weight,
+                     bit_length=bits)
+    return w
+
+
+class QuantedLinear(Layer):
+    """QAT wrapper (ref imperative/qat.py QuantizedLinear): fake-quants
+    the activation (EMA abs-max) and the weight (abs-max, per-tensor or
+    per-channel on out-channel axis 1 of paddle's [in, out] layout)
+    around the original Linear's parameters, which keep training
+    normally."""
+
+    def __init__(self, inner, weight_bits=8, activation_bits=8,
+                 moving_rate=0.9, channel_wise=True):
+        super().__init__()
+        self.inner = inner
+        self._weight_bits = weight_bits
+        self._channel_wise = channel_wise
+        self.act_quant = _MovingAverageObserver(activation_bits,
+                                                moving_rate)
+
+    def forward(self, x):
+        x = self.act_quant(x)
+        w = _fake_quant_weight(self.inner.weight, self._weight_bits, 1,
+                               self._channel_wise)
+        return F.linear(x, w, self.inner.bias)
+
+
+class QuantedConv2D(Layer):
+    """QAT wrapper for Conv2D (weight OIHW -> quant_axis 0)."""
+
+    def __init__(self, inner, weight_bits=8, activation_bits=8,
+                 moving_rate=0.9, channel_wise=True):
+        super().__init__()
+        self.inner = inner
+        self._weight_bits = weight_bits
+        self._channel_wise = channel_wise
+        self.act_quant = _MovingAverageObserver(activation_bits,
+                                                moving_rate)
+
+    def forward(self, x):
+        x = self.act_quant(x)
+        w = _fake_quant_weight(self.inner.weight, self._weight_bits, 0,
+                               self._channel_wise)
+        inner = self.inner
+        return F.conv2d(x, w, inner.bias, stride=inner._stride,
+                        padding=inner._padding, dilation=inner._dilation,
+                        groups=inner._groups,
+                        data_format=inner._data_format)
+
+
+class _FrozenActQuant(Layer):
+    """Frozen activation fake-quant with a fixed calibrated scale."""
+
+    def __init__(self, scale, bits=8):
+        super().__init__()
+        self._scale = float(scale)
+        self._qmax = float(2 ** (bits - 1) - 1)
+
+    def forward(self, x):
+        return Tensor(quant_dequant(x._value, self._scale, self._qmax))
+
+
+class QuantizedLinearInt8(Layer):
+    """Frozen int8-weight Linear: weight at rest as int8 + per-out-
+    channel f32 scale; dequantized to the input dtype in forward (XLA
+    fuses the dequant into the matmul operand read)."""
+
+    def __init__(self, inner, act_scale=None, activation_bits=8):
+        super().__init__()
+        q, scale = quantize_weight_int8(inner.weight._value, quant_axis=1)
+        self.register_buffer("weight_int8", Tensor(jnp.asarray(q)))
+        self.register_buffer("weight_scale", Tensor(jnp.asarray(scale)))
+        self.bias = inner.bias
+        self.act_quant = (None if act_scale is None
+                          else _FrozenActQuant(act_scale, activation_bits))
+
+    def forward(self, x):
+        if self.act_quant is not None:
+            x = self.act_quant(x)
+        w = _dequantize_int8(self.weight_int8._value,
+                             self.weight_scale._value, 1, x._value.dtype)
+        return F.linear(x, Tensor(w), self.bias)
+
+
+class QuantizedConv2DInt8(Layer):
+    """Frozen int8-weight Conv2D (OIHW, per-out-channel scales)."""
+
+    def __init__(self, inner, act_scale=None, activation_bits=8):
+        super().__init__()
+        q, scale = quantize_weight_int8(inner.weight._value, quant_axis=0)
+        self.register_buffer("weight_int8", Tensor(jnp.asarray(q)))
+        self.register_buffer("weight_scale", Tensor(jnp.asarray(scale)))
+        self.bias = inner.bias
+        self._stride = inner._stride
+        self._padding = inner._padding
+        self._dilation = inner._dilation
+        self._groups = inner._groups
+        self._data_format = inner._data_format
+        self.act_quant = (None if act_scale is None
+                          else _FrozenActQuant(act_scale, activation_bits))
+
+    def forward(self, x):
+        if self.act_quant is not None:
+            x = self.act_quant(x)
+        w = _dequantize_int8(self.weight_int8._value,
+                             self.weight_scale._value, 0, x._value.dtype)
+        return F.conv2d(x, Tensor(w), self.bias, stride=self._stride,
+                        padding=self._padding, dilation=self._dilation,
+                        groups=self._groups,
+                        data_format=self._data_format)
+
+
+_WRAPPER_TYPES = (QuantedLinear, QuantedConv2D,
+                  QuantizedLinearInt8, QuantizedConv2DInt8)
+
+
+def _walk_replace(layer, predicate, factory):
+    """Replace matching sublayers in place (recursive); honours the
+    reference's `skip_quant` attribute.  Never recurses into an
+    existing quant wrapper — re-quantizing a wrapped layer's inner
+    would double-quantize silently."""
+    for name, child in list(layer._sub_layers.items()):
+        if isinstance(child, _WRAPPER_TYPES):
+            if predicate(child):
+                layer._sub_layers[name] = factory(child)
+            continue
+        if predicate(child) and not getattr(child, "skip_quant", False):
+            layer._sub_layers[name] = factory(child)
+        else:
+            _walk_replace(child, predicate, factory)
+
+
+def _quantizable(types):
+    from ..nn import Conv2D, Linear
+
+    type_map = {"Linear": Linear, "Conv2D": Conv2D}
+    resolved = tuple(type_map[t] if isinstance(t, str) else t
+                     for t in types)
+
+    def pred(child):
+        return isinstance(child, resolved) and \
+            not isinstance(child, _WRAPPER_TYPES)
+    return pred
+
+
+class ImperativeQuantAware:
+    """ref imperative/qat.py:40 — dygraph QAT: quantize(model) swaps
+    quantizable sublayers for fake-quant wrappers; train as usual (the
+    wrappers ride the compiled Engine step); save_quantized_model
+    exports via jit.save."""
+
+    def __init__(self, quantizable_layer_type=("Conv2D", "Linear"),
+                 weight_quantize_type="channel_wise_abs_max",
+                 activation_quantize_type="moving_average_abs_max",
+                 weight_bits=8, activation_bits=8, moving_rate=0.9):
+        if weight_quantize_type not in ("abs_max", "channel_wise_abs_max"):
+            raise ValueError(weight_quantize_type)
+        if activation_quantize_type != "moving_average_abs_max":
+            raise ValueError(
+                "only moving_average_abs_max activation quant is "
+                "supported (the reference's dynamic abs_max mode has no "
+                "frozen-scale inference story)")
+        self._types = quantizable_layer_type
+        self._wbits = weight_bits
+        self._abits = activation_bits
+        self._rate = moving_rate
+        self._channel_wise = weight_quantize_type == "channel_wise_abs_max"
+
+    def quantize(self, model):
+        from ..nn import Linear
+
+        def factory(child):
+            cls = QuantedLinear if isinstance(child, Linear) \
+                else QuantedConv2D
+            return cls(child, self._wbits, self._abits, self._rate,
+                       channel_wise=self._channel_wise)
+
+        _walk_replace(model, _quantizable(self._types), factory)
+        return model
+
+    def save_quantized_model(self, layer, path, input_spec=None, **config):
+        from .. import jit
+
+        layer.eval()
+        jit.save(layer, path, input_spec=input_spec, **config)
+
+
+class PostTrainingQuantization:
+    """ref post_training_quantization.py:124, adapted to the dygraph-
+    first frontend: calibrate a Layer on sample batches, then freeze to
+    int8-at-rest weights + fixed activation scales.
+
+        ptq = PostTrainingQuantization(model, data_loader,
+                                       batch_nums=8, algo='hist')
+        qmodel = ptq.quantize()
+        ptq.save_quantized_model(prefix, input_spec=[...])
+
+    `algo`: 'abs_max' (max over all calibration batches), 'avg' (mean of
+    per-batch maxes), 'hist' (99.99th percentile of |x|).  `weight_only`
+    skips activation quant — pure HBM-savings mode.
+    """
+
+    def __init__(self, model, data_loader, batch_nums=None,
+                 quantizable_layer_type=("Conv2D", "Linear"),
+                 algo="hist", hist_percent=0.9999,
+                 weight_bits=8, activation_bits=8, weight_only=False):
+        if algo not in ("abs_max", "avg", "hist"):
+            raise ValueError(f"unsupported algo {algo!r}")
+        self._model = model
+        self._loader = data_loader
+        self._batch_nums = batch_nums
+        self._types = quantizable_layer_type
+        self._algo = algo
+        self._hist_percent = hist_percent
+        self._wbits = weight_bits
+        self._abits = activation_bits
+        self._weight_only = weight_only
+
+    def _scale_from(self, collect):
+        if collect is None or not collect["max"]:
+            return None
+        if self._algo == "abs_max":
+            return max(collect["max"])
+        if self._algo == "avg":
+            return float(np.mean(collect["max"]))
+        # hist: percentile of the pooled |x| subsample — clips the
+        # outlier tail the way the reference's histogram algo does
+        pooled = np.concatenate(collect["samples"])
+        return float(np.quantile(pooled, self._hist_percent))
+
+    def quantize(self):
+        from ..nn import Linear
+
+        model = self._model
+
+        if not self._weight_only:
+            # stage 1: wrap with observers and run eager calibration
+            def obs_factory(child):
+                cls = QuantedLinear if isinstance(child, Linear) \
+                    else QuantedConv2D
+                w = cls(child, self._wbits, self._abits)
+                w.act_quant._collect = {"max": [], "samples": []}
+                return w
+
+            _walk_replace(model, _quantizable(self._types), obs_factory)
+            model.eval()
+            for n, batch in enumerate(self._loader):
+                if self._batch_nums is not None and n >= self._batch_nums:
+                    break
+                xs = batch if isinstance(batch, (list, tuple)) else [batch]
+                model(*[x if isinstance(x, Tensor) else Tensor(x)
+                        for x in xs])
+
+        # stage 2: freeze — int8 weights, fixed activation scales
+        def freeze_factory(child):
+            if isinstance(child, (QuantedLinear, QuantedConv2D)):
+                scale = self._scale_from(child.act_quant._collect)
+                inner = child.inner
+            else:  # weight_only: raw layers, no observer pass happened
+                scale, inner = None, child
+            cls = QuantizedLinearInt8 if isinstance(inner, Linear) \
+                else QuantizedConv2DInt8
+            return cls(inner, act_scale=scale,
+                       activation_bits=self._abits)
+
+        def frozen_pred(child):
+            return isinstance(child, (QuantedLinear, QuantedConv2D)) or \
+                (self._weight_only and _quantizable(self._types)(child))
+
+        _walk_replace(model, frozen_pred, freeze_factory)
+        return model
+
+    def save_quantized_model(self, path, input_spec=None, **config):
+        from .. import jit
+
+        self._model.eval()
+        jit.save(self._model, path, input_spec=input_spec, **config)

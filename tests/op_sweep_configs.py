@@ -2531,3 +2531,46 @@ linear_chain_crf warpctc solve cholesky det slogdet
 FD_OPS["flash_attention"].update(rtol=8e-2, atol=4e-2)
 FD_OPS["scaled_dot_product_attention"].update(rtol=8e-2, atol=4e-2)
 FD_OPS["warpctc"].update(rtol=8e-2, atol=4e-2)
+
+
+# ---- fake-quant ops (quant_ops.py; ref fake_quantize_op.cc) ----
+
+def _np_qdq(x, scale, qmax=127.0):
+    s = np.maximum(scale, 1e-9)
+    return np.clip(np.round(x / s * qmax), -qmax, qmax) * s / qmax
+
+
+def _np_fake_qdq_abs_max(x, bit_length=8):
+    scale = np.abs(x).max().astype(np.float32)
+    return _np_qdq(x, scale), scale
+
+
+def _np_fake_qdq_channel(x, bit_length=8, quant_axis=0):
+    axes = tuple(a for a in range(x.ndim) if a != quant_axis)
+    scale = np.abs(x).max(axis=axes).astype(np.float32)
+    sshape = [1] * x.ndim
+    sshape[quant_axis] = x.shape[quant_axis]
+    return _np_qdq(x, scale.reshape(sshape)), scale
+
+
+def _np_fake_qdq_ema(x, in_scale, bit_length=8, moving_rate=0.9,
+                     is_test=False):
+    cur = np.abs(x).max()
+    if is_test:
+        scale = float(in_scale)
+    elif float(in_scale) > 0:
+        scale = moving_rate * float(in_scale) + (1 - moving_rate) * cur
+    else:
+        scale = cur
+    return _np_qdq(x, np.float32(scale)), np.float32(scale)
+
+
+case("fake_quantize_dequantize_abs_max", [f32((4, 5), -3, 3)],
+     ref=_np_fake_qdq_abs_max, grad=(0,))
+case("fake_channel_wise_quantize_dequantize_abs_max",
+     [f32((4, 5), -3, 3)], {"quant_axis": 1},
+     ref=_np_fake_qdq_channel, grad=(0,))
+case("fake_quantize_dequantize_moving_average_abs_max",
+     [f32((4, 5), -2, 2), np.asarray(1.5, np.float32)],
+     {"moving_rate": 0.9},
+     ref=_np_fake_qdq_ema, grad=(0,))

@@ -106,3 +106,63 @@ def test_indivisible_microbatches_padded(S, M):
     g_ref = jax.grad(lambda p: jnp.sum(_sequential(p, x) ** 2))(params)
     np.testing.assert_allclose(np.asarray(g["w"]), np.asarray(g_ref["w"]),
                                rtol=1e-4, atol=1e-5)
+
+
+# -- non-uniform stage bodies (VERDICT r3 item 5) ---------------------------
+
+
+def _het_stage_fns(ws):
+    """Four structurally different bodies with a uniform activation
+    interface; per-stage weights are closed over as traced values so AD
+    reaches them through the lax.switch."""
+    return [
+        lambda p, x: jnp.tanh(x @ ws[0]),
+        lambda p, x: jax.nn.gelu(x @ ws[1]) + x,
+        lambda p, x: (x @ ws[2]) * jax.nn.sigmoid(x),
+        lambda p, x: jnp.sin(x) + x @ ws[3],
+    ]
+
+
+def _het_sequential(ws, x):
+    fns = _het_stage_fns(ws)
+    out = x
+    for f in fns:
+        out = jax.vmap(lambda mb: f(None, mb))(out)
+    return out
+
+
+@pytest.mark.parametrize("M", [4, 8, 6])
+def test_pipeline_nonuniform_stages(M):
+    S, micro, d = 4, 2, 4
+    rng = np.random.RandomState(3)
+    ws = [jnp.asarray(rng.randn(d, d).astype(np.float32) * 0.4)
+          for _ in range(S)]
+    x = jnp.asarray(rng.randn(M, micro, d).astype(np.float32))
+    mesh = _mesh(S)
+    # the stacked-params tree is unused by these bodies; a [S,1] dummy
+    # keeps the pipeline signature uniform
+    dummy = {"z": jnp.zeros((S, 1), jnp.float32)}
+
+    def run(ws, x):
+        pipe = pipeline_spmd(_het_stage_fns(ws), mesh,
+                             num_stages=S, num_micro=M)
+        return pipe(dummy, x)
+
+    got = jax.jit(run)(ws, x)
+    want = _het_sequential(ws, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-6)
+
+    # gradients flow to per-stage closed-over weights through the switch
+    g = jax.grad(lambda w: jnp.sum(run(w, x) ** 2))(ws)
+    g_ref = jax.grad(lambda w: jnp.sum(_het_sequential(w, x) ** 2))(ws)
+    for s in range(S):
+        np.testing.assert_allclose(np.asarray(g[s]), np.asarray(g_ref[s]),
+                                   rtol=1e-4, atol=1e-5,
+                                   err_msg=f"stage {s} weight grad")
+
+
+def test_pipeline_stage_fns_length_checked():
+    with pytest.raises(ValueError, match="stage_fns"):
+        pipeline_spmd([lambda p, x: x], _mesh(2), num_stages=2,
+                      num_micro=2)

@@ -1,0 +1,299 @@
+"""Quantization toolkit tests (VERDICT r3 item 6): fake-quant op math,
+QAT wrapping + training, PTQ calibration/freeze, int8-at-rest export,
+and the quantized-Predictor accuracy gate on the vision ladder.
+
+Ref parity: slim/quantization/imperative/qat.py,
+post_training_quantization.py, fake_quantize_op.cc.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, quantization
+from paddle_tpu.core.dispatch import apply
+from paddle_tpu.core.tensor import Tensor
+
+
+# -- op math -----------------------------------------------------------------
+
+def _np_qdq(x, scale, qmax=127.0):
+    s = max(float(scale), 1e-9)
+    return np.clip(np.round(x / s * qmax), -qmax, qmax) * s / qmax
+
+
+def test_fake_qdq_abs_max_matches_numpy():
+    x = np.random.RandomState(0).randn(4, 6).astype(np.float32) * 3
+    y, scale = apply("fake_quantize_dequantize_abs_max", Tensor(x))
+    assert float(scale.numpy()) == pytest.approx(np.abs(x).max(), rel=1e-6)
+    np.testing.assert_allclose(y.numpy(),
+                               _np_qdq(x, np.abs(x).max()), atol=1e-6)
+    # quantization error bounded by half a bucket
+    assert np.abs(y.numpy() - x).max() <= np.abs(x).max() / 127.0
+
+
+def test_fake_qdq_channel_wise():
+    x = np.random.RandomState(1).randn(5, 3).astype(np.float32)
+    x[:, 1] *= 10  # very different per-channel ranges
+    y, scales = apply("fake_channel_wise_quantize_dequantize_abs_max",
+                      Tensor(x), quant_axis=1)
+    np.testing.assert_allclose(scales.numpy(), np.abs(x).max(0), rtol=1e-6)
+    for c in range(3):
+        np.testing.assert_allclose(
+            y.numpy()[:, c], _np_qdq(x[:, c], np.abs(x[:, c]).max()),
+            atol=1e-6)
+
+
+def test_fake_qdq_ste_gradient_passthrough():
+    x = Tensor(np.random.RandomState(2).randn(3, 3).astype(np.float32),
+               stop_gradient=False)
+    y, _ = apply("fake_quantize_dequantize_abs_max", x)
+    y.backward(Tensor(np.ones((3, 3), np.float32)))
+    # straight-through: gradient of identity
+    np.testing.assert_allclose(x.grad.numpy(), np.ones((3, 3)), atol=1e-6)
+
+
+def test_moving_average_scale_ema():
+    x1 = np.full((2, 2), 4.0, np.float32)
+    x2 = np.full((2, 2), 2.0, np.float32)
+    _, s1 = apply("fake_quantize_dequantize_moving_average_abs_max",
+                  Tensor(x1), Tensor(np.zeros((), np.float32)),
+                  moving_rate=0.9)
+    assert float(s1.numpy()) == pytest.approx(4.0)  # zero init adopts
+    _, s2 = apply("fake_quantize_dequantize_moving_average_abs_max",
+                  Tensor(x2), s1, moving_rate=0.9)
+    assert float(s2.numpy()) == pytest.approx(0.9 * 4.0 + 0.1 * 2.0)
+    # is_test freezes the scale
+    _, s3 = apply("fake_quantize_dequantize_moving_average_abs_max",
+                  Tensor(x1), s2, moving_rate=0.9, is_test=True)
+    assert float(s3.numpy()) == pytest.approx(float(s2.numpy()))
+
+
+# -- QAT ---------------------------------------------------------------------
+
+def test_qat_wraps_and_trains():
+    paddle.seed(0)
+    model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    qat = quantization.ImperativeQuantAware()
+    qat.quantize(model)
+    assert isinstance(model._sub_layers["0"], quantization.QuantedLinear)
+    assert isinstance(model._sub_layers["2"], quantization.QuantedLinear)
+
+    opt = paddle.optimizer.Adam(learning_rate=0.01,
+                                parameters=model.parameters())
+    rng = np.random.RandomState(0)
+    x = rng.randn(16, 8).astype(np.float32)
+    y = rng.randn(16, 4).astype(np.float32)
+    losses = []
+    for _ in range(25):
+        out = model(Tensor(x))
+        loss = ((out - Tensor(y)) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+    # activation scales were learned
+    scale = float(model._sub_layers["0"].act_quant.scale.numpy())
+    assert scale > 0
+
+
+def test_qat_skip_quant_honoured():
+    model = nn.Sequential(nn.Linear(4, 4), nn.Linear(4, 4))
+    model._sub_layers["0"].skip_quant = True
+    quantization.ImperativeQuantAware().quantize(model)
+    assert isinstance(model._sub_layers["0"], nn.Linear)
+    assert isinstance(model._sub_layers["1"], quantization.QuantedLinear)
+
+
+def test_qat_under_compiled_engine():
+    """The fake-quant wrappers must ride the compiled Engine step (scale
+    buffer threading included)."""
+    from paddle_tpu.engine import Engine
+
+    paddle.seed(3)
+    model = nn.Sequential(nn.Linear(6, 12), nn.GELU(), nn.Linear(12, 2))
+    quantization.ImperativeQuantAware().quantize(model)
+    opt = paddle.optimizer.Adam(learning_rate=0.01,
+                                parameters=model.parameters())
+    eng = Engine(model, opt, lambda out, y: ((out - y) ** 2).mean())
+    rng = np.random.RandomState(1)
+    x = rng.randn(8, 6).astype(np.float32)
+    y = rng.randn(8, 2).astype(np.float32)
+    losses = [float(np.asarray(eng.train_batch(x, y)._value))
+              for _ in range(20)]
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0] * 0.7
+    # the EMA scale buffer must have advanced inside the compiled step
+    key = next(k for k in eng.state.buffers if k.endswith("scale"))
+    assert float(np.asarray(eng.state.buffers[key])) > 0
+
+
+# -- PTQ ---------------------------------------------------------------------
+
+def _calib_batches(rng, n, shape):
+    return [rng.randn(*shape).astype(np.float32) for _ in range(n)]
+
+
+@pytest.mark.parametrize("algo", ["abs_max", "avg", "hist"])
+def test_ptq_freezes_int8_weights(algo):
+    paddle.seed(1)
+    model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    w0 = np.asarray(model._sub_layers["0"].weight._value).copy()
+    loader = _calib_batches(np.random.RandomState(0), 6, (4, 8))
+    ptq = quantization.PostTrainingQuantization(model, loader,
+                                                algo=algo)
+    ptq.quantize()
+    q0 = model._sub_layers["0"]
+    assert isinstance(q0, quantization.QuantizedLinearInt8)
+    assert np.asarray(q0.weight_int8._value).dtype == np.int8
+    # dequantized weight close to the original
+    deq = (np.asarray(q0.weight_int8._value, np.float32)
+           * np.asarray(q0.weight_scale._value)[None, :] / 127.0)
+    assert np.abs(deq - w0).max() <= np.abs(w0).max() / 127.0 + 1e-6
+    assert q0.act_quant is not None  # calibrated activation scale
+
+
+def test_ptq_weight_only():
+    model = nn.Sequential(nn.Linear(8, 8))
+    ptq = quantization.PostTrainingQuantization(
+        model, [], weight_only=True)
+    ptq.quantize()
+    q = model._sub_layers["0"]
+    assert isinstance(q, quantization.QuantizedLinearInt8)
+    assert q.act_quant is None
+
+
+def test_quantized_predictor_accuracy_on_lenet(tmp_path):
+    """The vision-ladder gate (VERDICT r3 item 6): int8 PTQ LeNet served
+    through the Predictor must be within 1% of the fp32 Predictor's
+    accuracy.  The model is trained first — an untrained net has
+    near-tied logits whose argmax flips under any perturbation, which
+    measures nothing about quantization quality."""
+    from paddle_tpu.engine import Engine
+    from paddle_tpu.jit import InputSpec
+    from paddle_tpu.vision.models import LeNet
+
+    paddle.seed(7)
+    rng = np.random.RandomState(0)
+    # synthetic task with a real decision boundary: each class is a
+    # fixed template plus noise — separable, so a briefly-trained LeNet
+    # produces confident logits (the precondition for a meaningful
+    # quantization accuracy delta)
+    templates = rng.randn(10, 1, 28, 28).astype(np.float32)
+
+    def make(n, seed):
+        r = np.random.RandomState(seed)
+        y = r.randint(0, 10, n).astype(np.int64)
+        x = templates[y] + 0.7 * r.randn(n, 1, 28, 28).astype(np.float32)
+        return x, y
+
+    model = LeNet()
+    crit = nn.CrossEntropyLoss()
+    opt = paddle.optimizer.Adam(learning_rate=2e-3,
+                                parameters=model.parameters())
+    eng = Engine(model, opt, lambda logits, y: crit(logits, y))
+    for step in range(60):
+        x, y = make(64, 100 + step)
+        eng.train_batch(x, y)
+    eng.sync_to_layer()
+    model.eval()
+
+    # fp32 export
+    fp32_prefix = str(tmp_path / "lenet_fp32")
+    paddle.jit.save(model, fp32_prefix,
+                    input_spec=[InputSpec([50, 1, 28, 28], "float32")])
+
+    # PTQ with hist calibration, then int8 export
+    loader = _calib_batches(rng, 8, (50, 1, 28, 28))
+    ptq = quantization.PostTrainingQuantization(model, loader,
+                                                algo="hist")
+    ptq.quantize()
+    int8_prefix = str(tmp_path / "lenet_int8")
+    ptq.save_quantized_model(
+        int8_prefix, input_spec=[InputSpec([50, 1, 28, 28], "float32")])
+
+    # int8 artifact stores int8 weights (HBM-at-rest win)
+    import pickle
+    with open(int8_prefix + ".pdiparams", "rb") as f:
+        state = pickle.load(f)
+    int8_keys = [k for k, v in state.items()
+                 if np.asarray(v).dtype == np.int8]
+    assert len(int8_keys) >= 5, sorted(state)  # 2 convs + 3 linears
+
+    def serve(prefix, batches):
+        cfg = paddle.inference.Config(prefix)
+        pred = paddle.inference.create_predictor(cfg)
+        h = pred.get_input_handle(pred.get_input_names()[0])
+        outs = []
+        for b in batches:
+            h.copy_from_cpu(b)
+            pred.run()
+            outs.append(pred.get_output_handle(
+                pred.get_output_names()[0]).copy_to_cpu())
+        return np.concatenate(outs)
+
+    eval_x, eval_y = make(1000, 999)
+    eval_batches = [eval_x[i:i + 50] for i in range(0, 1000, 50)]
+    logits_fp32 = serve(fp32_prefix, eval_batches)
+    logits_int8 = serve(int8_prefix, eval_batches)
+    acc_fp32 = (logits_fp32.argmax(-1) == eval_y).mean()
+    acc_int8 = (logits_int8.argmax(-1) == eval_y).mean()
+    # the trained net must actually have learned the task, or the gate
+    # is vacuous
+    assert acc_fp32 > 0.5, acc_fp32
+    assert acc_fp32 - acc_int8 <= 0.01, (acc_fp32, acc_int8)
+
+
+# -- review-finding regressions (r4) ----------------------------------------
+
+def test_quantize_twice_does_not_nest():
+    model = nn.Sequential(nn.Linear(4, 4))
+    qat = quantization.ImperativeQuantAware()
+    qat.quantize(model)
+    qat.quantize(model)  # second pass must be a no-op, not a re-wrap
+    q = model._sub_layers["0"]
+    assert isinstance(q, quantization.QuantedLinear)
+    assert isinstance(q.inner, nn.Linear)
+    x = Tensor(np.ones((2, 4), np.float32))
+    assert np.isfinite(model(x).numpy()).all()
+
+
+def test_weight_quantize_type_per_tensor_differs():
+    paddle.seed(2)
+    x = np.random.RandomState(0).randn(4, 6).astype(np.float32)
+
+    def out_with(kind):
+        paddle.seed(2)
+        m = nn.Sequential(nn.Linear(6, 6))
+        # per-channel vs per-tensor must disagree given skewed channels
+        m._sub_layers["0"].weight._value = jnp.asarray(
+            np.diag([0.01, 0.1, 1, 2, 4, 8]).astype(np.float32))
+        quantization.ImperativeQuantAware(
+            weight_quantize_type=kind).quantize(m)
+        m.eval()
+        return m(Tensor(x)).numpy()
+
+    per_tensor = out_with("abs_max")
+    per_channel = out_with("channel_wise_abs_max")
+    assert np.abs(per_tensor - per_channel).max() > 1e-4
+
+
+def test_uncalibrated_eval_passes_through():
+    paddle.seed(4)
+    model = nn.Sequential(nn.Linear(5, 5))
+    raw_w = np.asarray(model._sub_layers["0"].weight._value).copy()
+    raw_b = np.asarray(model._sub_layers["0"].bias._value).copy()
+    x = np.random.RandomState(1).randn(3, 5).astype(np.float32)
+    quantization.ImperativeQuantAware().quantize(model)
+    model.eval()  # NO training batches: activation scale is still 0
+    got = model(Tensor(x)).numpy()
+    # activations must pass through un-zeroed; only the weight is
+    # fake-quantized (within one bucket of the raw weight)
+    want = x @ raw_w + raw_b
+    assert np.abs(got).max() > 0.01
+    np.testing.assert_allclose(got, want,
+                               atol=np.abs(raw_w).max() / 127 * 5 + 1e-4)
