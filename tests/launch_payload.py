@@ -11,7 +11,10 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(__file__)))
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 2)
+# --compiled-step builds a 2-host x 4-device global mesh (VERDICT r3
+# item 4); the plain collective payload keeps the original 2+2 layout
+jax.config.update("jax_num_cpu_devices",
+                  4 if "--compiled-step" in sys.argv else 2)
 
 from paddle_tpu.distributed.parallel import init_parallel_env  # noqa: E402
 
@@ -26,6 +29,20 @@ if "--crash-rank" in sys.argv:
         # hang the watchdog exists to break
         os._exit(3)
     time.sleep(120)  # the watchdog must kill us well before this
+    sys.exit(0)
+
+if "--compiled-step" in sys.argv:
+    # one jitted hybrid (dp x mp) train step over the GLOBAL mesh
+    # spanning both processes — the DCN-analogue compiled path
+    import json
+
+    import compiled_step_common as csc
+
+    assert jax.process_count() == 2, jax.process_count()
+    assert jax.device_count() == 8, jax.device_count()
+    mesh = csc.make_mesh()
+    losses = csc.run(mesh)
+    print(f"COMPILED LOSSES {json.dumps(losses)}", flush=True)
     sys.exit(0)
 
 assert jax.process_count() == 2, jax.process_count()

@@ -123,3 +123,37 @@ def test_eager_p2p_send_recv(tmp_path):
     assert proc.returncode == 0, f"launcher failed:\n{logs}\n{proc.stderr}"
     assert "RANK 0 P2P OK" in logs
     assert "RANK 1 P2P OK" in logs
+
+
+def test_multiprocess_compiled_hybrid_step(tmp_path):
+    """VERDICT r3 item 4: a jitted dp x mp train step over a global mesh
+    SPANNING 2 processes (gloo carrying the cross-process dp allreduce)
+    must reproduce the single-process 8-device trajectory."""
+    import json
+
+    import numpy as np
+
+    log_dir = str(tmp_path / "logs")
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "2", "--log_dir", log_dir, PAYLOAD,
+         "--compiled-step"],
+        cwd=REPO, env=_clean_env(), capture_output=True, text=True,
+        timeout=240)
+    logs = ""
+    for rank in (0, 1):
+        with open(os.path.join(log_dir, f"workerlog.{rank}")) as f:
+            logs += f.read()
+    assert proc.returncode == 0, f"launcher failed:\n{logs}\n{proc.stderr}"
+    line = next(ln for ln in logs.splitlines()
+                if ln.startswith("COMPILED LOSSES"))
+    got = json.loads(line[len("COMPILED LOSSES "):])
+
+    # single-process reference on the 8-device virtual mesh (this pytest
+    # process) — same code, same mesh shape, local transport
+    sys.path.insert(0, os.path.dirname(PAYLOAD))
+    import compiled_step_common as csc
+
+    ref = csc.run(csc.make_mesh())
+    assert ref[-1] < ref[0], ref  # it actually trains
+    np.testing.assert_allclose(got, ref, rtol=1e-4)
