@@ -309,6 +309,40 @@ class PSServer:
                         name, dim, optimizer=opt, lr=lr,
                         init_range=init_range, seed=seed)
             return None
+        if cmd == "create_ssd_sparse":
+            name, dim, opt, lr, init_range, seed, mem_rows = args
+            from .tables import SSDSparseTable
+
+            with self._tables_lock:
+                if name not in self._tables:
+                    self._tables[name] = SSDSparseTable(
+                        name, dim, optimizer=opt, lr=lr,
+                        init_range=init_range, seed=seed,
+                        mem_rows=mem_rows)
+            return None
+        if cmd == "create_graph":
+            name, seed = args
+            from .tables import GraphTable
+
+            with self._tables_lock:
+                if name not in self._tables:
+                    self._tables[name] = GraphTable(name, seed=seed)
+            return None
+        if cmd == "graph_add_edges":
+            name, src, dst, weight = args
+            return self._tables[name].add_edges(src, dst, weight)
+        if cmd == "graph_sample":
+            name, ids, n = args
+            return self._tables[name].sample_neighbors(ids, n)
+        if cmd == "graph_degree":
+            name, ids = args
+            return self._tables[name].degree(ids)
+        if cmd == "graph_set_feat":
+            name, ids, feats = args
+            return self._tables[name].set_node_feat(ids, feats)
+        if cmd == "graph_get_feat":
+            name, ids, dim = args
+            return self._tables[name].get_node_feat(ids, dim)
         if cmd == "pull_dense":
             return self._tables[args].pull()
         if cmd == "push_dense_grad":
@@ -430,6 +464,74 @@ class PSClient:
         for i in range(len(self.endpoints)):
             self._call(i, "create_sparse",
                        (name, dim, optimizer, lr, init_range, seed + i))
+
+    def create_ssd_sparse_table(self, name, dim, optimizer="sgd",
+                                lr=0.01, init_range=0.05, seed=0,
+                                mem_rows=100_000):
+        """Disk-spilling sparse table (ref ssd_sparse_table.h): same
+        pull/push API as create_sparse_table, rows beyond `mem_rows`
+        spill to the server's disk."""
+        self._sparse_dims[name] = int(dim)
+        for i in range(len(self.endpoints)):
+            self._call(i, "create_ssd_sparse",
+                       (name, dim, optimizer, lr, init_range, seed + i,
+                        mem_rows))
+
+    # -- graph (partitioned by src id) ---------------------------------------
+    def create_graph_table(self, name, seed=0):
+        for i in range(len(self.endpoints)):
+            self._call(i, "create_graph", (name, seed + i))
+
+    def _by_server(self, ids):
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        n = len(self.endpoints)
+        return ids, [np.nonzero(ids % n == s)[0] for s in range(n)]
+
+    def graph_add_edges(self, name, src, dst, weight=None):
+        src = np.asarray(src, np.int64).reshape(-1)
+        dst = np.asarray(dst, np.int64).reshape(-1)
+        w = None if weight is None else \
+            np.asarray(weight, np.float32).reshape(-1)
+        _, parts = self._by_server(src)
+        for s, idx in enumerate(parts):
+            if idx.size:
+                self._call(s, "graph_add_edges",
+                           (name, src[idx], dst[idx],
+                            None if w is None else w[idx]))
+
+    def graph_sample_neighbors(self, name, ids, n):
+        ids, parts = self._by_server(ids)
+        out = np.full((ids.size, n), -1, np.int64)
+        for s, idx in enumerate(parts):
+            if idx.size:
+                out[idx] = self._call(s, "graph_sample",
+                                      (name, ids[idx], n))
+        return out
+
+    def graph_degree(self, name, ids):
+        ids, parts = self._by_server(ids)
+        out = np.zeros(ids.size, np.int64)
+        for s, idx in enumerate(parts):
+            if idx.size:
+                out[idx] = self._call(s, "graph_degree", (name, ids[idx]))
+        return out
+
+    def graph_set_node_feat(self, name, ids, feats):
+        ids, parts = self._by_server(ids)
+        feats = np.asarray(feats, np.float32)
+        for s, idx in enumerate(parts):
+            if idx.size:
+                self._call(s, "graph_set_feat",
+                           (name, ids[idx], feats[idx]))
+
+    def graph_get_node_feat(self, name, ids, dim):
+        ids, parts = self._by_server(ids)
+        out = np.zeros((ids.size, dim), np.float32)
+        for s, idx in enumerate(parts):
+            if idx.size:
+                out[idx] = self._call(s, "graph_get_feat",
+                                      (name, ids[idx], dim))
+        return out
 
     # -- dense ---------------------------------------------------------------
     def pull_dense(self, name):

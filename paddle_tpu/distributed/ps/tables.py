@@ -207,3 +207,237 @@ class SparseTable:
             else:
                 for i, r in zip(ids, rows):
                     self._rows[int(i)] = r.copy()
+
+
+class SSDSparseTable(SparseTable):
+    """Beyond-RAM sparse embedding: hot rows in memory, cold rows
+    spilled to disk (ref ssd_sparse_table.h, which pairs an in-memory
+    shard with rocksdb).
+
+    Design: the in-memory dict is an LRU of at most `mem_rows` rows;
+    eviction appends the row (and its adagrad accumulator, when used) as
+    a fixed-size record to an append-only spill file, with an in-memory
+    id -> offset index pointing at the newest record.  Re-touching a
+    spilled id reads it back and re-inserts it hot.  When dead records
+    exceed half the file, it is compacted in place.  No rocksdb in the
+    image — fixed-record append + index IS the LSM level this workload
+    needs (point lookups by id, whole-table scan at save time).
+    """
+
+    def __init__(self, name, dim, optimizer="sgd", lr=0.01, epsilon=1e-6,
+                 init_range=0.05, seed=0, mem_rows=100_000,
+                 spill_dir=None):
+        # the native in-RAM table cannot spill; force the python rows
+        super().__init__(name, dim, optimizer=optimizer, lr=lr,
+                         epsilon=epsilon, init_range=init_range,
+                         seed=seed, use_native=False)
+        import os
+        import tempfile
+        from collections import OrderedDict
+
+        self.mem_rows = int(mem_rows)
+        self._rows = OrderedDict()  # LRU: oldest first
+        self._spill_dir = spill_dir or tempfile.mkdtemp(
+            prefix=f"pst_ssd_{name}_")
+        os.makedirs(self._spill_dir, exist_ok=True)
+        self._spill_path = os.path.join(self._spill_dir, "rows.bin")
+        self._spill_f = open(self._spill_path, "w+b")
+        self._index: dict[int, int] = {}  # id -> file offset
+        self._dead_records = 0
+        self._has_accum = optimizer == "adagrad"
+        self._rec_dim = self.dim * (2 if self._has_accum else 1)
+        self._rec_bytes = 8 + 4 * self._rec_dim  # i64 id + f32 payload
+
+    # -- spill machinery -----------------------------------------------------
+    def _record(self, i):
+        row = self._rows[i]
+        if self._has_accum:
+            acc = self._accum.get(i)
+            if acc is None:
+                acc = np.zeros(self.dim, np.float32)
+            payload = np.concatenate([row, acc])
+        else:
+            payload = row
+        return np.int64(i).tobytes() + payload.astype(np.float32).tobytes()
+
+    def _evict_lru(self):
+        while len(self._rows) > self.mem_rows:
+            i, _ = next(iter(self._rows.items()))
+            if i in self._index:
+                self._dead_records += 1
+            self._spill_f.seek(0, 2)
+            self._index[i] = self._spill_f.tell()
+            self._spill_f.write(self._record(i))
+            del self._rows[i]
+            self._accum.pop(i, None)
+        if self._dead_records > max(64, len(self._index)):
+            self._compact()
+
+    def _read_spilled(self, i):
+        off = self._index.get(i)
+        if off is None:
+            return False
+        self._spill_f.seek(off)
+        rec = self._spill_f.read(self._rec_bytes)
+        payload = np.frombuffer(rec[8:], np.float32)
+        self._rows[i] = payload[:self.dim].copy()
+        if self._has_accum:
+            self._accum[i] = payload[self.dim:].copy()
+        del self._index[i]
+        self._dead_records += 1
+        return True
+
+    def _compact(self):
+        import os
+
+        new_path = self._spill_path + ".compact"
+        with open(new_path, "w+b") as nf:
+            new_index = {}
+            for i, off in self._index.items():
+                self._spill_f.seek(off)
+                rec = self._spill_f.read(self._rec_bytes)
+                new_index[i] = nf.tell()
+                nf.write(rec)
+        self._spill_f.close()
+        os.replace(new_path, self._spill_path)
+        self._spill_f = open(self._spill_path, "r+b")
+        self._index = new_index
+        self._dead_records = 0
+
+    def _py_row(self, i):
+        r = self._rows.get(i)
+        if r is not None:
+            self._rows.move_to_end(i)  # LRU touch
+            return r
+        if self._read_spilled(i):
+            return self._rows[i]
+        return super()._py_row(i)
+
+    def pull(self, ids):
+        out = super().pull(ids)
+        with self._lock:
+            self._evict_lru()
+        return out
+
+    def push_grad(self, ids, grads):
+        super().push_grad(ids, grads)
+        with self._lock:
+            self._evict_lru()
+
+    def __len__(self):
+        with self._lock:
+            return len(self._rows) + len(self._index)
+
+    def state_dict(self):
+        with self._lock:
+            ids = sorted(set(self._rows) | set(self._index))
+            rows = np.empty((len(ids), self.dim), np.float32)
+        for k, i in enumerate(ids):
+            with self._lock:
+                rows[k] = self._py_row(int(i))
+                self._evict_lru()
+        return {"ids": np.asarray(ids, np.int64), "rows": rows}
+
+    def load_state_dict(self, sd):
+        super().load_state_dict(sd)
+        with self._lock:
+            self._evict_lru()
+
+    def __del__(self):
+        try:
+            self._spill_f.close()
+        except Exception:  # noqa: BLE001 — interpreter teardown
+            pass
+
+
+class GraphTable:
+    """Server-side graph store for GNN sampling workers (ref
+    common_graph_table.h: add edges, weighted neighbour sampling, node
+    features).  Adjacency is per-node id/weight arrays with cumulative
+    weights precomputed at first sample, so each sample_neighbors RPC is
+    a vectorised searchsorted draw."""
+
+    def __init__(self, name, seed=0):
+        self.name = name
+        self._adj: dict[int, list] = {}     # id -> [ids list, w list]
+        self._cum: dict[int, np.ndarray] = {}
+        self._feat: dict[int, np.ndarray] = {}
+        self._rng = np.random.RandomState(seed)
+        self._lock = threading.Lock()
+
+    def add_edges(self, src, dst, weight=None):
+        src = np.asarray(src, np.int64).reshape(-1)
+        dst = np.asarray(dst, np.int64).reshape(-1)
+        w = (np.ones(len(src), np.float32) if weight is None
+             else np.asarray(weight, np.float32).reshape(-1))
+        with self._lock:
+            for s, d, ww in zip(src, dst, w):
+                ent = self._adj.setdefault(int(s), [[], []])
+                ent[0].append(int(d))
+                ent[1].append(float(ww))
+                self._cum.pop(int(s), None)
+        return None
+
+    def sample_neighbors(self, ids, n):
+        """For each id: n neighbours drawn with probability proportional
+        to edge weight (with replacement, reference sampling semantics);
+        isolated nodes return -1 padding."""
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        out = np.full((len(ids), n), -1, np.int64)
+        with self._lock:
+            for k, i in enumerate(ids):
+                i = int(i)
+                ent = self._adj.get(i)
+                if not ent or not ent[0]:
+                    continue
+                cum = self._cum.get(i)
+                if cum is None:
+                    cum = np.cumsum(np.asarray(ent[1], np.float64))
+                    self._cum[i] = cum
+                draws = self._rng.rand(n) * cum[-1]
+                out[k] = np.asarray(ent[0], np.int64)[
+                    np.searchsorted(cum, draws)]
+        return out
+
+    def degree(self, ids):
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        with self._lock:
+            return np.asarray(
+                [len(self._adj.get(int(i), [[], []])[0]) for i in ids],
+                np.int64)
+
+    def set_node_feat(self, ids, feats):
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        feats = np.asarray(feats, np.float32)
+        with self._lock:
+            for i, f in zip(ids, feats):
+                self._feat[int(i)] = f.copy()
+        return None
+
+    def get_node_feat(self, ids, dim):
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        out = np.zeros((len(ids), dim), np.float32)
+        with self._lock:
+            for k, i in enumerate(ids):
+                f = self._feat.get(int(i))
+                if f is not None:
+                    out[k] = f
+        return out
+
+    def state_dict(self):
+        with self._lock:
+            return {
+                "adj": {i: (np.asarray(e[0], np.int64),
+                            np.asarray(e[1], np.float32))
+                        for i, e in self._adj.items()},
+                "feat": dict(self._feat),
+            }
+
+    def load_state_dict(self, sd):
+        with self._lock:
+            self._adj = {int(i): [list(map(int, e[0])),
+                                  list(map(float, e[1]))]
+                         for i, e in sd["adj"].items()}
+            self._cum = {}
+            self._feat = {int(i): np.asarray(f, np.float32)
+                          for i, f in sd["feat"].items()}

@@ -1,0 +1,137 @@
+"""SSD-spill sparse table + graph table tests (VERDICT r3 missing #4).
+
+Ref parity: paddle/fluid/distributed/table/ssd_sparse_table.h (beyond-RAM
+embeddings), common_graph_table.h (neighbour sampling for GNN workers).
+"""
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed import ps
+from paddle_tpu.distributed.ps.tables import SparseTable, SSDSparseTable
+
+
+def test_ssd_table_spills_and_reloads(tmp_path):
+    t = SSDSparseTable("emb", dim=4, optimizer="sgd", lr=0.1,
+                       mem_rows=8, spill_dir=str(tmp_path))
+    ids = np.arange(100, dtype=np.int64)
+    first = t.pull(ids).copy()          # lazy init + mass eviction
+    assert len(t) == 100
+    assert len(t._rows) <= 8            # hot set bounded
+    assert len(t._index) >= 92          # the rest live on disk
+    # spilled rows read back bit-identical
+    again = t.pull(ids)
+    np.testing.assert_array_equal(first, again)
+
+
+def test_ssd_table_matches_in_memory_reference(tmp_path):
+    """Same op stream against the pure in-memory table: spilling must
+    never change values (incl. adagrad accumulators riding the spill
+    records)."""
+    rng = np.random.RandomState(0)
+    for optimizer in ("sgd", "adagrad"):
+        ref = SparseTable("r", dim=3, optimizer=optimizer, lr=0.05,
+                          seed=7, use_native=False)
+        ssd = SSDSparseTable("s", dim=3, optimizer=optimizer, lr=0.05,
+                             seed=7, mem_rows=4,
+                             spill_dir=str(tmp_path / optimizer))
+        for step in range(30):
+            ids = rng.randint(0, 40, 6).astype(np.int64)
+            np.testing.assert_allclose(ssd.pull(ids), ref.pull(ids),
+                                       rtol=1e-6, atol=1e-7,
+                                       err_msg=f"{optimizer} step {step}")
+            g = rng.randn(6, 3).astype(np.float32)
+            ref.push_grad(ids, g)
+            ssd.push_grad(ids, g)
+        sd_ref, sd_ssd = ref.state_dict(), ssd.state_dict()
+        np.testing.assert_array_equal(sd_ref["ids"], sd_ssd["ids"])
+        np.testing.assert_allclose(sd_ref["rows"], sd_ssd["rows"],
+                                   rtol=1e-6, atol=1e-7)
+
+
+def test_ssd_table_compaction_bounds_file(tmp_path):
+    t = SSDSparseTable("emb", dim=2, optimizer="sgd", lr=0.1,
+                       mem_rows=2, spill_dir=str(tmp_path))
+    ids = np.arange(16, dtype=np.int64)
+    for _ in range(40):  # hammer the same ids: constant re-spill churn
+        t.push_grad(ids, np.ones((16, 2), np.float32))
+    t._spill_f.seek(0, 2)
+    # file bounded by live records + the dead-record compaction
+    # threshold (max(64, live)) with slack for in-flight evictions
+    cap = (len(t._index) + max(64, len(t._index)) + 16) * t._rec_bytes
+    assert t._spill_f.tell() <= cap, (t._spill_f.tell(), cap)
+
+
+def test_ssd_table_over_rpc(tmp_path):
+    srv = ps.PSServer("127.0.0.1:0").start()
+    client = ps.PSClient([f"127.0.0.1:{srv.port}"])
+    try:
+        client.create_ssd_sparse_table("big_emb", dim=4, lr=0.1,
+                                       mem_rows=8)
+        ids = np.arange(50, dtype=np.int64)
+        v0 = client.pull_sparse("big_emb", ids)
+        g = np.ones((50, 4), np.float32)
+        client.push_sparse_grad("big_emb", ids, g)
+        v1 = client.pull_sparse("big_emb", ids)
+        np.testing.assert_allclose(v1, v0 - 0.1 * g, rtol=1e-6)
+        states = client.save()
+        client.load(states)
+        np.testing.assert_allclose(client.pull_sparse("big_emb", ids),
+                                   v1, rtol=1e-6)
+    finally:
+        client.stop_servers()
+        client.close()
+        srv.stop()
+
+
+def test_graph_table_sampling_and_feats():
+    srv0 = ps.PSServer("127.0.0.1:0").start()
+    srv1 = ps.PSServer("127.0.0.1:0").start()
+    client = ps.PSClient([f"127.0.0.1:{srv0.port}",
+                          f"127.0.0.1:{srv1.port}"])
+    try:
+        client.create_graph_table("g", seed=0)
+        # node 10: neighbour 1 with weight 9, neighbour 2 with weight 1
+        client.graph_add_edges("g", [10, 10, 11], [1, 2, 5],
+                               weight=[9.0, 1.0, 1.0])
+        deg = client.graph_degree("g", [10, 11, 12])
+        np.testing.assert_array_equal(deg, [2, 1, 0])
+
+        s = client.graph_sample_neighbors("g", [10], 2000)[0]
+        frac1 = (s == 1).mean()
+        assert 0.85 < frac1 < 0.95, frac1  # weighted draw ~0.9
+        assert set(np.unique(s)) <= {1, 2}
+
+        np.testing.assert_array_equal(
+            client.graph_sample_neighbors("g", [12], 4)[0], [-1] * 4)
+
+        feats = np.arange(6, dtype=np.float32).reshape(2, 3)
+        client.graph_set_node_feat("g", [10, 11], feats)
+        got = client.graph_get_node_feat("g", [11, 10, 12], 3)
+        np.testing.assert_allclose(got[0], feats[1])
+        np.testing.assert_allclose(got[1], feats[0])
+        np.testing.assert_allclose(got[2], 0.0)
+    finally:
+        client.stop_servers()
+        client.close()
+        srv0.stop()
+        srv1.stop()
+
+
+def test_graph_state_survives_save_load():
+    srv = ps.PSServer("127.0.0.1:0").start()
+    client = ps.PSClient([f"127.0.0.1:{srv.port}"])
+    try:
+        client.create_graph_table("g")
+        client.graph_add_edges("g", [1, 1], [2, 3])
+        client.graph_set_node_feat("g", [1], np.ones((1, 2), np.float32))
+        states = client.save()
+        client.load(states)
+        assert set(client.graph_sample_neighbors(
+            "g", [1], 50)[0]) <= {2, 3}
+        np.testing.assert_allclose(
+            client.graph_get_node_feat("g", [1], 2), 1.0)
+    finally:
+        client.stop_servers()
+        client.close()
+        srv.stop()
