@@ -110,6 +110,23 @@ def _bench_resnet50(peak: float, on_tpu: bool) -> dict:
     dispatch+transfer overhead cancels.  MFU from analytic conv FLOPs
     (3x fwd for training) against peak bf16.  Reference analogue:
     tools/test_model_benchmark.sh:19-45 (whole-model perf gate).
+
+    Measured ceiling (v5e, round 4): ~25% MFU, FLAT across batch
+    64/128/256 (24.9/24.4/23.1) — so not a batch/parallelism limit.
+    Decomposition on-chip: fwd+bwd alone is the whole step (65.8 vs
+    65.2 ms at batch 128; Momentum update + BN running stats are
+    noise), and the same harness reaches 44.5% MFU on ERNIE, so the
+    gap is conv-pipeline-specific: (a) conv1 and stage-1 run at C<=64
+    against a 128x128 MXU (channel underfill caps those layers near
+    50%), (b) BN/ReLU/pooling between every conv are VPU/HBM-bound with
+    zero MXU work on ~1.2 GB of fwd activations re-read in bwd, (c) the
+    backward of the strided 3x3 convs lowers to input-dilated convs
+    whose tiling is inherently worse than the fwd.  Layout is NOT the
+    gap: a raw-jnp NHWC build of the same net measures 54.9 ms/step vs
+    NCHW's 55.6 at batch 128 — XLA's TPU layout assignment already
+    handles NCHW.  The remaining known lever is MLPerf-style model
+    surgery (space-to-depth stem folding conv1's C=3 into C=12); the
+    number here is the honest out-of-the-box model-zoo path.
     """
     import paddle_tpu as paddle
     from paddle_tpu import amp, nn
@@ -118,7 +135,9 @@ def _bench_resnet50(peak: float, on_tpu: bool) -> dict:
     from bench_attrib import _timed_scan_ms
 
     if on_tpu:
-        batch = int(os.environ.get("BENCH_RESNET_BATCH", "256"))
+        # 128 sits on the measured MFU plateau (see docstring) with a
+        # step long enough to dominate timing noise
+        batch = int(os.environ.get("BENCH_RESNET_BATCH", "128"))
         hw, iters = 224, 8
     else:
         batch, hw, iters = 2, 32, 2

@@ -361,6 +361,12 @@ class PostTrainingQuantization:
         def freeze_factory(child):
             if isinstance(child, (QuantedLinear, QuantedConv2D)):
                 scale = self._scale_from(child.act_quant._collect)
+                if scale is None:
+                    # QAT-trained wrapper: its EMA buffer already holds
+                    # the learned activation scale — freeze with it
+                    learned = float(np.asarray(
+                        child.act_quant.scale._value))
+                    scale = learned if learned > 0 else None
                 inner = child.inner
             else:  # weight_only: raw layers, no observer pass happened
                 scale, inner = None, child

@@ -297,3 +297,30 @@ def test_uncalibrated_eval_passes_through():
     assert np.abs(got).max() > 0.01
     np.testing.assert_allclose(got, want,
                                atol=np.abs(raw_w).max() / 127 * 5 + 1e-4)
+
+
+def test_qat_model_freezes_with_learned_scales(tmp_path):
+    """QAT -> int8 freeze: the EMA activation scales learned during
+    training must carry into the frozen model (no calibration pass
+    needed)."""
+    paddle.seed(9)
+    model = nn.Sequential(nn.Linear(6, 6), nn.ReLU(), nn.Linear(6, 3))
+    quantization.ImperativeQuantAware().quantize(model)
+    opt = paddle.optimizer.SGD(learning_rate=0.05,
+                               parameters=model.parameters())
+    rng = np.random.RandomState(0)
+    for _ in range(5):
+        out = model(Tensor(rng.randn(8, 6).astype(np.float32)))
+        loss = (out ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    learned = float(model._sub_layers["0"].act_quant.scale.numpy())
+    assert learned > 0
+
+    ptq = quantization.PostTrainingQuantization(model, [])  # no calib
+    ptq.quantize()
+    q0 = model._sub_layers["0"]
+    assert isinstance(q0, quantization.QuantizedLinearInt8)
+    assert q0.act_quant is not None
+    assert q0.act_quant._scale == pytest.approx(learned)
