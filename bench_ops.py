@@ -232,8 +232,10 @@ def _finish(results, dev, save, check):
         base = {"device": kind, "ops": {}}
         if os.path.exists(BASELINE_PATH):
             base = json.load(open(BASELINE_PATH))
+        if base.get("device") != kind:
+            # numbers from another device are meaningless to merge with
+            base = {"device": kind, "ops": {}}
         # merge: micro and macro runs each maintain their own keys
-        base["device"] = kind
         base.setdefault("ops", {}).update(results)
         with open(BASELINE_PATH, "w") as f:
             json.dump(base, f, indent=1, sort_keys=True)

@@ -391,7 +391,9 @@ class PSServer:
             return None
         if cmd == "delete_table":
             with self._tables_lock:
-                self._tables.pop(args, None)
+                t = self._tables.pop(args, None)
+            if t is not None and hasattr(t, "close"):
+                t.close()  # SSD tables reclaim their spill directory
             return None
         if cmd == "table_size":
             t = self._tables[args]

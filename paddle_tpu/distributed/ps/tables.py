@@ -237,6 +237,7 @@ class SSDSparseTable(SparseTable):
 
         self.mem_rows = int(mem_rows)
         self._rows = OrderedDict()  # LRU: oldest first
+        self._owns_spill_dir = spill_dir is None
         self._spill_dir = spill_dir or tempfile.mkdtemp(
             prefix=f"pst_ssd_{name}_")
         os.makedirs(self._spill_dir, exist_ok=True)
@@ -329,23 +330,45 @@ class SSDSparseTable(SparseTable):
             return len(self._rows) + len(self._index)
 
     def state_dict(self):
+        # one lock for the WHOLE export (base-class contract: a save
+        # must be an atomic snapshot, never interleaved with pushes);
+        # spilled rows are peeked read-only so the export causes no LRU
+        # churn
         with self._lock:
             ids = sorted(set(self._rows) | set(self._index))
             rows = np.empty((len(ids), self.dim), np.float32)
-        for k, i in enumerate(ids):
-            with self._lock:
-                rows[k] = self._py_row(int(i))
-                self._evict_lru()
-        return {"ids": np.asarray(ids, np.int64), "rows": rows}
+            for k, i in enumerate(ids):
+                i = int(i)
+                r = self._rows.get(i)
+                if r is None:
+                    self._spill_f.seek(self._index[i])
+                    rec = self._spill_f.read(self._rec_bytes)
+                    r = np.frombuffer(rec[8:], np.float32)[:self.dim]
+                rows[k] = r
+            return {"ids": np.asarray(ids, np.int64), "rows": rows}
 
     def load_state_dict(self, sd):
         super().load_state_dict(sd)
         with self._lock:
             self._evict_lru()
 
-    def __del__(self):
+    def close(self):
+        """Release the spill file and delete a self-created spill dir
+        (delete_table / server shutdown path)."""
+        import os
+        import shutil
+
         try:
             self._spill_f.close()
+        except Exception:  # noqa: BLE001 — already closed
+            pass
+        if getattr(self, "_owns_spill_dir", False) and \
+                os.path.isdir(self._spill_dir):
+            shutil.rmtree(self._spill_dir, ignore_errors=True)
+
+    def __del__(self):
+        try:
+            self.close()
         except Exception:  # noqa: BLE001 — interpreter teardown
             pass
 

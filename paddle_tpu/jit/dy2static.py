@@ -580,6 +580,14 @@ def rewrite(fn):
         if not _definitely_returns(fdef.body):
             fdef.body.append(ast.Return(value=ast.Constant(value=None)))
         fdef.body = _flatten_returns(fdef.body, [])
+        # duplication is O(2^k) over k partially-returning ifs; a deep
+        # chain must fall back to trace capture, not hang in compile()
+        n_nodes = sum(1 for _ in ast.walk(fdef))
+        if n_nodes > 20_000:
+            raise ValueError(
+                f"early-return normalisation grew the AST to {n_nodes} "
+                "nodes (deeply chained partial returns); use explicit "
+                "if/else structure or the trace path")
     else:
         fdef.body = _absorb_tail_returns(fdef.body)
     tr = _ControlFlowTransformer()

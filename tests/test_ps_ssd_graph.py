@@ -135,3 +135,59 @@ def test_graph_state_survives_save_load():
         client.stop_servers()
         client.close()
         srv.stop()
+
+
+def test_ssd_table_delete_reclaims_spill_dir():
+    import os
+
+    srv = ps.PSServer("127.0.0.1:0").start()
+    client = ps.PSClient([f"127.0.0.1:{srv.port}"])
+    try:
+        client.create_ssd_sparse_table("tmp_emb", dim=2, mem_rows=2)
+        client.pull_sparse("tmp_emb", np.arange(20, dtype=np.int64))
+        table = srv._tables["tmp_emb"]
+        spill_dir = table._spill_dir
+        assert os.path.isdir(spill_dir)
+        client.delete_table("tmp_emb")
+        assert not os.path.isdir(spill_dir)
+    finally:
+        client.stop_servers()
+        client.close()
+        srv.stop()
+
+
+def test_ssd_state_dict_atomic_under_concurrent_push():
+    """Review finding (r4): save must snapshot atomically while another
+    thread pushes — every exported row equals a value that existed at
+    SOME whole number of pushes (never a torn mix within one row)."""
+    import threading
+
+    t = SSDSparseTable("emb", dim=8, optimizer="sum", mem_rows=4)
+    ids = np.arange(32, dtype=np.int64)
+    t.pull(ids)  # init all rows (values deterministic per id)
+    base = t.pull(ids).copy()
+    stop = threading.Event()
+
+    def pusher():
+        g = np.ones((32, 8), np.float32)
+        while not stop.is_set():
+            t.push_grad(ids, g)
+
+    th = threading.Thread(target=pusher)
+    th.start()
+    try:
+        for _ in range(20):
+            sd = t.state_dict()
+            # 'sum' optimizer: row = base + k * ones for integer k >= 0,
+            # and k must be CONSTANT within each row
+            delta = sd["rows"] - base[np.argsort(np.argsort(sd["ids"]))]
+            k = np.round(delta)
+            # integer push-count per element, constant within each row
+            # (f32 rounding of base+k leaves sub-1e-2 residue; a torn
+            # row would differ by whole pushes)
+            np.testing.assert_allclose(delta, k, atol=2e-2)
+            for row in k:
+                assert np.all(row == row[0]), row
+    finally:
+        stop.set()
+        th.join()
