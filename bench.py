@@ -111,22 +111,24 @@ def _bench_resnet50(peak: float, on_tpu: bool) -> dict:
     (3x fwd for training) against peak bf16.  Reference analogue:
     tools/test_model_benchmark.sh:19-45 (whole-model perf gate).
 
-    Measured ceiling (v5e, round 4): ~25% MFU, FLAT across batch
-    64/128/256 (24.9/24.4/23.1) — so not a batch/parallelism limit.
-    Decomposition on-chip: fwd+bwd alone is the whole step (65.8 vs
-    65.2 ms at batch 128; Momentum update + BN running stats are
-    noise), and the same harness reaches 44.5% MFU on ERNIE, so the
-    gap is conv-pipeline-specific: (a) conv1 and stage-1 run at C<=64
-    against a 128x128 MXU (channel underfill caps those layers near
-    50%), (b) BN/ReLU/pooling between every conv are VPU/HBM-bound with
-    zero MXU work on ~1.2 GB of fwd activations re-read in bwd, (c) the
-    backward of the strided 3x3 convs lowers to input-dilated convs
-    whose tiling is inherently worse than the fwd.  Layout is NOT the
-    gap: a raw-jnp NHWC build of the same net measures 54.9 ms/step vs
-    NCHW's 55.6 at batch 128 — XLA's TPU layout assignment already
-    handles NCHW.  The remaining known lever is MLPerf-style model
-    surgery (space-to-depth stem folding conv1's C=3 into C=12); the
-    number here is the honest out-of-the-box model-zoo path.
+    Measured ceiling (v5e, round 4): ~27% MFU (2155 img/s at batch
+    128) after making batch_norm's stats a single fused pass
+    (E[x^2]-E[x]^2 — jnp.var cost a third sweep over every activation;
+    the fix alone took 24.4% -> 26.8%).  MFU is FLAT across batch
+    64/128/256, so not a batch/parallelism limit.  Decomposition
+    on-chip: fwd+bwd alone is the whole step (Momentum update + BN
+    running stats are noise), and the same harness reaches 44.5% MFU on
+    ERNIE, so the rest of the gap is conv-pipeline-specific: (a) conv1
+    and stage-1 run at C<=64 against a 128x128 MXU (channel underfill
+    caps those layers near 50%), (b) BN/ReLU/pooling between every conv
+    are VPU/HBM-bound on ~1.2 GB of fwd activations re-read in bwd,
+    (c) the backward of the strided 3x3 convs lowers to input-dilated
+    convs with inherently worse tiling.  Ruled out by measurement:
+    layout (raw-jnp NHWC == NCHW: 54.9 vs 55.6 ms) and the
+    space-to-depth stem (11% faster in a lean bf16-weights harness but
+    neutral through the full training path, where BN/optimizer
+    semantics dominate; available as BENCH_RESNET_S2D=1 /
+    resnet50(space_to_depth_stem=True)).
     """
     import paddle_tpu as paddle
     from paddle_tpu import amp, nn
@@ -143,7 +145,12 @@ def _bench_resnet50(peak: float, on_tpu: bool) -> dict:
         batch, hw, iters = 2, 32, 2
 
     paddle.seed(0)
-    model = resnet50(num_classes=1000)
+    # BENCH_RESNET_S2D=1: the MLPerf-style space-to-depth stem (exactly
+    # contains the 7x7 stem, ~11% faster on v5e); default stays the
+    # vanilla model-zoo network for honest out-of-the-box numbers
+    model = resnet50(num_classes=1000,
+                     space_to_depth_stem=os.environ.get(
+                         "BENCH_RESNET_S2D", "") == "1")
     crit = nn.CrossEntropyLoss()
     opt = paddle.optimizer.Momentum(
         learning_rate=0.1, momentum=0.9,

@@ -1,0 +1,69 @@
+"""Space-to-depth ResNet stem: exact fold equivalence + trainability.
+
+The 4x4-on-s2d stem must reproduce the 7x7/s2 stem EXACTLY when its
+weights are folded from a trained 7x7 kernel, and train end-to-end when
+used from scratch.
+"""
+
+import numpy as np
+
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.vision.models import resnet
+from paddle_tpu.vision.models.resnet import (
+    SpaceToDepthStem, fold_conv7_stem,
+)
+from paddle_tpu import nn
+
+
+def test_folded_stem_matches_conv7_exactly():
+    paddle.seed(0)
+    conv7 = nn.Conv2D(3, 64, 7, stride=2, padding=3, bias_attr=False)
+    s2d = SpaceToDepthStem(3, 64)
+    s2d.conv.weight._value = jnp.asarray(
+        fold_conv7_stem(np.asarray(conv7.weight._value)))
+    x = Tensor(np.random.RandomState(1).randn(2, 3, 32, 32)
+               .astype(np.float32))
+    np.testing.assert_allclose(s2d(x).numpy(), conv7(x).numpy(),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_resnet18_s2d_full_model_matches_folded():
+    paddle.seed(3)
+    m7 = resnet.resnet18(num_classes=10)
+    m7.eval()
+    paddle.seed(3)
+    ms = resnet.resnet18(num_classes=10, space_to_depth_stem=True)
+    ms.eval()
+    # copy every non-stem weight, fold the stem
+    sd7, sds = m7.state_dict(), ms.state_dict()
+    for k, v in sd7.items():
+        if k == "conv1.weight":
+            sds["conv1.conv.weight"]._value = jnp.asarray(
+                fold_conv7_stem(np.asarray(v._value)))
+        else:
+            sds[k]._value = v._value
+    x = Tensor(np.random.RandomState(0).randn(2, 3, 64, 64)
+               .astype(np.float32))
+    np.testing.assert_allclose(ms(x).numpy(), m7(x).numpy(),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_resnet_s2d_trains_under_engine():
+    from paddle_tpu.engine import Engine
+
+    paddle.seed(1)
+    model = resnet.resnet18(num_classes=4, space_to_depth_stem=True)
+    crit = nn.CrossEntropyLoss()
+    opt = paddle.optimizer.Momentum(learning_rate=0.01, momentum=0.9,
+                                    parameters=model.parameters())
+    eng = Engine(model, opt, lambda lg, y: crit(lg, y))
+    rng = np.random.RandomState(0)
+    x = rng.randn(8, 3, 32, 32).astype(np.float32)
+    y = rng.randint(0, 4, 8).astype(np.int64)
+    losses = [float(np.asarray(eng.train_batch(x, y)._value))
+              for _ in range(12)]
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0], losses
