@@ -157,3 +157,6 @@ def backward(tensors, grad_tensors=None, retain_graph=False):
 # attributes to the modules; the paddle API names are the functions
 from .tensor.math import clip as clip  # noqa: F401,E402
 
+from . import reader  # noqa: F401,E402
+from . import onnx  # noqa: F401,E402
+from .reader import batch  # noqa: F401,E402

@@ -1,0 +1,29 @@
+"""paddle.onnx (ref python/paddle/onnx/export.py).
+
+The reference delegates to the external paddle2onnx package; this image
+ships no onnx runtime, so export() is gated with guidance toward the
+framework's native serving artifact (jit.save's StableHLO export, which
+the inference Predictor consumes directly).
+"""
+
+from __future__ import annotations
+
+__all__ = ["export"]
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    """Gated ONNX export (ref onnx/export.py:21, which requires the
+    external paddle2onnx).  Uses the `onnx` package when importable;
+    otherwise raises with the native alternative."""
+    try:
+        import onnx  # noqa: F401
+    except ImportError as e:
+        raise RuntimeError(
+            "ONNX export needs the 'onnx' package, which is not "
+            "installed. The TPU-native serving path is paddle.jit.save("
+            "layer, prefix, input_spec=...) — a StableHLO artifact the "
+            "paddle_tpu.inference Predictor (and any PJRT runtime) "
+            "loads directly.") from e
+    raise NotImplementedError(
+        "onnx is importable but paddle_tpu does not convert StableHLO "
+        "to ONNX graphs; serve the jit.save artifact instead")

@@ -1,0 +1,235 @@
+"""Reader decorators (ref python/paddle/reader/decorator.py).
+
+A "reader" is a zero-arg callable returning an iterable of samples; the
+decorators compose them.  Original generator-based implementations —
+`xmap_readers`/`multiprocess_reader` use threads (the io.DataLoader owns
+the real multiprocess path; these exist for fluid-era API parity).
+"""
+
+from __future__ import annotations
+
+import itertools
+import queue
+import random as _random
+import threading
+
+__all__ = [
+    "cache", "map_readers", "shuffle", "chain", "compose", "buffered",
+    "firstn", "xmap_readers", "multiprocess_reader", "batch",
+]
+
+
+def cache(reader):
+    """Materialise the full stream once; replay from memory after."""
+    all_data = []
+    loaded = False
+
+    def rd():
+        nonlocal loaded
+        if not loaded:
+            all_data.extend(reader())
+            loaded = True
+        return iter(all_data)
+
+    return rd
+
+
+def map_readers(func, *readers):
+    """Yield func(*samples) zipped across readers."""
+    def rd():
+        for items in zip(*[r() for r in readers]):
+            yield func(*items)
+
+    return rd
+
+
+def shuffle(reader, buf_size):
+    """Shuffle within a sliding buffer of `buf_size` samples."""
+    def rd():
+        buf = []
+        for s in reader():
+            buf.append(s)
+            if len(buf) >= buf_size:
+                _random.shuffle(buf)
+                yield from buf
+                buf = []
+        if buf:
+            _random.shuffle(buf)
+            yield from buf
+
+    return rd
+
+
+def chain(*readers):
+    """Concatenate streams (ref chain: outputs one after another)."""
+    def rd():
+        return itertools.chain(*[r() for r in readers])
+
+    return rd
+
+
+def compose(*readers, **kwargs):
+    """Zip readers into flattened tuples: (a, b1, b2) from a-reader and
+    (b1,b2)-reader. check_alignment=True (default) raises on length
+    mismatch."""
+    check_alignment = kwargs.pop("check_alignment", True)
+
+    def _flatten(items):
+        out = []
+        for it in items:
+            if isinstance(it, tuple):
+                out.extend(it)
+            else:
+                out.append(it)
+        return tuple(out)
+
+    def rd():
+        its = [r() for r in readers]
+        for items in (zip(*its) if not check_alignment
+                      else itertools.zip_longest(*its)):
+            if check_alignment and any(i is None for i in items):
+                raise ValueError("readers have different lengths")
+            yield _flatten(items)
+
+    return rd
+
+
+def buffered(reader, size):
+    """Decouple producer/consumer through a bounded queue fed by a
+    background thread."""
+    end = object()
+
+    def rd():
+        q = queue.Queue(maxsize=size)
+
+        def fill():
+            try:
+                for s in reader():
+                    q.put(s)
+            finally:
+                q.put(end)
+
+        t = threading.Thread(target=fill, daemon=True)
+        t.start()
+        while True:
+            s = q.get()
+            if s is end:
+                break
+            yield s
+
+    return rd
+
+
+def firstn(reader, n):
+    def rd():
+        return itertools.islice(reader(), n)
+
+    return rd
+
+
+def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
+    """Parallel map over a reader with `process_num` worker threads.
+    order=True preserves input order (sequence-tagged heap merge)."""
+    end = object()
+
+    def rd():
+        in_q = queue.Queue(buffer_size)
+        out_q = queue.Queue(buffer_size)
+
+        def feed():
+            for i, s in enumerate(reader()):
+                in_q.put((i, s))
+            for _ in range(process_num):
+                in_q.put(end)
+
+        def work():
+            while True:
+                item = in_q.get()
+                if item is end:
+                    out_q.put(end)
+                    break
+                i, s = item
+                out_q.put((i, mapper(s)))
+
+        threading.Thread(target=feed, daemon=True).start()
+        for _ in range(process_num):
+            threading.Thread(target=work, daemon=True).start()
+
+        finished = 0
+        if not order:
+            while finished < process_num:
+                item = out_q.get()
+                if item is end:
+                    finished += 1
+                    continue
+                yield item[1]
+        else:
+            import heapq
+
+            heap, want = [], 0
+            while finished < process_num or heap:
+                if heap and heap[0][0] == want:
+                    _, v = heapq.heappop(heap)
+                    want += 1
+                    yield v
+                    continue
+                if finished >= process_num:
+                    # stream ended with a gap: impossible unless a
+                    # worker died; drain what exists
+                    _, v = heapq.heappop(heap)
+                    want = heap[0][0] if heap else want
+                    yield v
+                    continue
+                item = out_q.get()
+                if item is end:
+                    finished += 1
+                    continue
+                heapq.heappush(heap, item)
+
+    return rd
+
+
+def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
+    """Interleave several readers concurrently (thread-backed here; the
+    reference forks processes — io.DataLoader owns that machinery)."""
+    def rd():
+        q = queue.Queue(queue_size)
+        end = object()
+
+        def fill(r):
+            try:
+                for s in r():
+                    q.put(s)
+            finally:
+                q.put(end)
+
+        for r in readers:
+            threading.Thread(target=fill, args=(r,), daemon=True).start()
+        finished = 0
+        while finished < len(readers):
+            s = q.get()
+            if s is end:
+                finished += 1
+                continue
+            yield s
+
+    return rd
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Group samples into lists of `batch_size` (ref python/paddle/
+    batch.py:18; exposed as paddle.batch)."""
+    if batch_size <= 0:
+        raise ValueError(f"batch_size must be positive, got {batch_size}")
+
+    def rd():
+        buf = []
+        for s in reader():
+            buf.append(s)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+
+    return rd

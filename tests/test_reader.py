@@ -1,0 +1,72 @@
+"""paddle.reader decorators + paddle.batch (ref reader/decorator.py,
+batch.py)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import reader
+
+
+def _r(n):
+    return lambda: iter(range(n))
+
+
+def test_batch():
+    assert list(paddle.batch(_r(7), 3)()) == [[0, 1, 2], [3, 4, 5], [6]]
+    assert list(paddle.batch(_r(7), 3, drop_last=True)()) == \
+        [[0, 1, 2], [3, 4, 5]]
+    with pytest.raises(ValueError):
+        paddle.batch(_r(3), 0)
+
+
+def test_cache_and_firstn():
+    calls = []
+
+    def src():
+        calls.append(1)
+        return iter(range(5))
+
+    c = reader.cache(src)
+    assert list(c()) == list(range(5))
+    assert list(c()) == list(range(5))
+    assert len(calls) == 1  # second run replays from memory
+    assert list(reader.firstn(_r(10), 3)()) == [0, 1, 2]
+
+
+def test_map_chain_compose():
+    assert list(reader.map_readers(lambda a, b: a + b, _r(3), _r(3))()) \
+        == [0, 2, 4]
+    assert list(reader.chain(_r(2), _r(3))()) == [0, 1, 0, 1, 2]
+    got = list(reader.compose(
+        lambda: iter([(1, 2), (3, 4)]), lambda: iter([5, 6]))())
+    assert got == [(1, 2, 5), (3, 4, 6)]
+    with pytest.raises(ValueError):
+        list(reader.compose(_r(2), _r(3))())
+    assert len(list(reader.compose(_r(2), _r(3),
+                                   check_alignment=False)())) == 2
+
+
+def test_shuffle_buffered():
+    np.random.seed(0)
+    got = list(reader.shuffle(_r(20), 5)())
+    assert sorted(got) == list(range(20))
+    assert list(reader.buffered(_r(50), 4)()) == list(range(50))
+
+
+def test_xmap_readers_ordered_and_unordered():
+    got = list(reader.xmap_readers(lambda x: x * 2, _r(30), 4, 8,
+                                   order=True)())
+    assert got == [2 * i for i in range(30)]
+    got = list(reader.xmap_readers(lambda x: x * 2, _r(30), 4, 8)())
+    assert sorted(got) == [2 * i for i in range(30)]
+
+
+def test_multiprocess_reader():
+    got = list(reader.multiprocess_reader([_r(5), _r(5)])())
+    assert sorted(got) == sorted(list(range(5)) * 2)
+
+
+def test_onnx_export_gated():
+    with pytest.raises(RuntimeError, match="jit.save"):
+        paddle.onnx.export(None, "x")
