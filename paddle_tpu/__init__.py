@@ -160,3 +160,4 @@ from .tensor.math import clip as clip  # noqa: F401,E402
 from . import reader  # noqa: F401,E402
 from . import onnx  # noqa: F401,E402
 from .reader import batch  # noqa: F401,E402
+from . import dataset  # noqa: F401,E402

@@ -1,0 +1,67 @@
+"""paddle.dataset — fluid-era reader-style dataset API.
+
+Ref parity: python/paddle/dataset/{mnist,cifar,imdb,uci_housing,
+conll05,movielens,wmt14}.py, whose surface is `train()`/`test()`
+functions returning zero-arg READERS (composable with paddle.reader /
+paddle.batch).  Implemented as thin adapters over the map-style Dataset
+classes in `vision.datasets` / `text` (which carry the zero-egress
+synthetic fallbacks), so both API generations share one data source.
+"""
+
+from __future__ import annotations
+
+import types
+
+
+def _reader_of(dataset_cls, mode, **kwargs):
+    def rd():
+        ds = dataset_cls(mode=mode, **kwargs)
+        for i in range(len(ds)):
+            yield tuple(ds[i])
+
+    return rd
+
+
+def _module(name, dataset_cls, train_mode="train", test_mode="test",
+            **kwargs):
+    m = types.ModuleType(f"{__name__}.{name}")
+    m.train = lambda **kw: _reader_of(dataset_cls, train_mode,
+                                      **{**kwargs, **kw})
+    m.test = lambda **kw: _reader_of(dataset_cls, test_mode,
+                                     **{**kwargs, **kw})
+    return m
+
+
+def _build():
+    from ..text import WMT14, Conll05st, Imdb, Movielens, UCIHousing
+    from ..vision.datasets import MNIST, Cifar10, Cifar100
+
+    mods = {
+        "mnist": _module("mnist", MNIST),
+        "cifar": None,  # filled below (cifar has train10/test10 names)
+        "imdb": _module("imdb", Imdb),
+        "uci_housing": _module("uci_housing", UCIHousing),
+        "conll05": _module("conll05", Conll05st),
+        "movielens": _module("movielens", Movielens),
+        "wmt14": _module("wmt14", WMT14),
+    }
+    cifar = types.ModuleType(f"{__name__}.cifar")
+    cifar.train10 = lambda **kw: _reader_of(Cifar10, "train", **kw)
+    cifar.test10 = lambda **kw: _reader_of(Cifar10, "test", **kw)
+    cifar.train100 = lambda **kw: _reader_of(Cifar100, "train", **kw)
+    cifar.test100 = lambda **kw: _reader_of(Cifar100, "test", **kw)
+    mods["cifar"] = cifar
+    return mods
+
+
+_mods = _build()
+mnist = _mods["mnist"]
+cifar = _mods["cifar"]
+imdb = _mods["imdb"]
+uci_housing = _mods["uci_housing"]
+conll05 = _mods["conll05"]
+movielens = _mods["movielens"]
+wmt14 = _mods["wmt14"]
+
+__all__ = ["mnist", "cifar", "imdb", "uci_housing", "conll05",
+           "movielens", "wmt14"]

@@ -70,3 +70,17 @@ def test_multiprocess_reader():
 def test_onnx_export_gated():
     with pytest.raises(RuntimeError, match="jit.save"):
         paddle.onnx.export(None, "x")
+
+
+def test_dataset_reader_api():
+    """paddle.dataset.<name>.train()/test() return composable readers
+    (ref dataset/mnist.py:98 surface) over the same synthetic-fallback
+    sources as the Dataset classes."""
+    r = paddle.batch(paddle.dataset.uci_housing.train(), 8)
+    xb = next(iter(r()))
+    assert len(xb) == 8 and xb[0][0].shape == (13,)
+    img, label = next(iter(paddle.dataset.mnist.test()()))
+    assert img.shape[-2:] == (28, 28)
+    assert 0 <= int(label) < 10
+    x, y = next(iter(paddle.dataset.cifar.train10()()))
+    assert x.shape[0] == 3 and 0 <= int(y) < 10
