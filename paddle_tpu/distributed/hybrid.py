@@ -269,6 +269,14 @@ class HybridParallelEngine:
 
         def step_fn(block_params, rest_params, buffers, opt_state, batch,
                     lr, key):
+            from ..ops.fused_ops import gspmd_tracing
+
+            with gspmd_tracing():  # meshed: no Mosaic under GSPMD
+                return _step_impl(block_params, rest_params, buffers,
+                                  opt_state, batch, lr, key)
+
+        def _step_impl(block_params, rest_params, buffers, opt_state,
+                       batch, lr, key):
             loss, (gb, gr) = jax.value_and_grad(
                 loss_of, argnums=(0, 1))(block_params, rest_params,
                                          buffers, batch, key)

@@ -65,6 +65,13 @@ class PipelineEngine:
         metas = opt.param_metas_for(self.params, layer.state_dict())
 
         def step_fn(params, opt_state, buffers, x, y, lr, key):
+            from ..ops.fused_ops import gspmd_tracing
+
+            with gspmd_tracing():  # sharded args: no Mosaic dispatch
+                return _step_impl(params, opt_state, buffers, x, y, lr,
+                                  key)
+
+        def _step_impl(params, opt_state, buffers, x, y, lr, key):
             # x, y: [M, micro_batch, ...]
             def accum(carry, mb):
                 gsum, lsum, i = carry

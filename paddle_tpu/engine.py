@@ -119,10 +119,28 @@ class TrainState:
         self.step = step
 
 
-def init_train_state(layer, optimizer):
+def init_train_state(layer, optimizer, *, opt_state_mesh_host=None):
+    """Build the compiled-path state.  `opt_state_mesh_host`: a mesh —
+    park each parameter's freshly-built optimizer state in pinned host
+    memory immediately, so the whole-tree state (2x params for Adam)
+    never coexists on device.  For billion-parameter offload configs
+    that transient footprint is itself the OOM; the per-param peak here
+    is one parameter's state."""
     params = dict(param_values(layer))
     buffers = dict(buffer_values(layer))
-    opt_state = {k: optimizer._init_state(v) for k, v in params.items()}
+    host_sh = None
+    if opt_state_mesh_host is not None:
+        kind = _host_memory_kind(opt_state_mesh_host)
+        if kind is not None:
+            host_sh = NamedSharding(opt_state_mesh_host, P(),
+                                    memory_kind=kind)
+    opt_state = {}
+    for k, v in params.items():
+        st = optimizer._init_state(v)
+        if host_sh is not None:
+            st = jax.device_put(st, host_sh)
+            jax.block_until_ready(st)  # free the device copy promptly
+        opt_state[k] = st
     return TrainState(params, opt_state, buffers)
 
 
@@ -324,7 +342,7 @@ def make_train_step(layer, loss_fn, optimizer, *, grad_clip=None,
         loss_scale is not None and not dynamic_scale
         and not isinstance(loss_scale, dict)) else None
 
-    def step_fn(params, buffers, opt_state, batch, lr, key):
+    def _step_impl(params, buffers, opt_state, batch, lr, key):
         if dynamic_scale:
             scale = buffers[LOSS_SCALE_KEY]
             good = buffers[GOOD_STEPS_KEY]
@@ -385,6 +403,18 @@ def make_train_step(layer, loss_fn, optimizer, *, grad_clip=None,
             new_buffers[GOOD_STEPS_KEY] = jnp.where(grow, 0, good_next)
             new_buffers[BAD_STEPS_KEY] = jnp.where(shrink, 0, bad_next)
         return loss, new_params, new_buffers, new_opt
+
+    if mesh is None:
+        step_fn = _step_impl
+    else:
+        # meshed step: GSPMD-partitioned program — gate Mosaic kernels
+        # to the jnp path at trace time (fused_ops.gspmd_tracing)
+        def step_fn(params, buffers, opt_state, batch, lr, key):
+            from .ops.fused_ops import gspmd_tracing
+
+            with gspmd_tracing():
+                return _step_impl(params, buffers, opt_state, batch,
+                                  lr, key)
 
     in_shardings = None
     out_shardings = None
@@ -455,7 +485,9 @@ class Engine:
         self.loss_scale = loss_scale
         self.offload = offload
         self.comm_dtype = comm_dtype
-        self.state = init_train_state(layer, optimizer)
+        self.state = init_train_state(
+            layer, optimizer,
+            opt_state_mesh_host=mesh if offload else None)
         if loss_scale == "dynamic" or isinstance(loss_scale, dict):
             # in-graph dynamic loss scaling state (fp16-compat mode)
             cfg = dict(DEFAULT_SCALE_CONFIG)

@@ -36,7 +36,8 @@ class GPTConfig:
                  num_heads=12, ffn_hidden_size=None, max_seq_len=1024,
                  dropout=0.1, attn_dropout=0.1, layer_norm_eps=1e-5,
                  initializer_range=0.02, use_parallel=True,
-                 sequence_parallel=False, tie_word_embeddings=True):
+                 sequence_parallel=False, tie_word_embeddings=True,
+                 recompute=False):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_layers = num_layers
@@ -50,6 +51,7 @@ class GPTConfig:
         self.use_parallel = use_parallel
         self.sequence_parallel = sequence_parallel
         self.tie_word_embeddings = tie_word_embeddings
+        self.recompute = recompute
 
 
 _PRESETS = {
@@ -240,8 +242,17 @@ class GPTModel(nn.Layer):
                 x, nc = layer(x, c)
                 new_caches.append(nc)
             return self.final_norm(x), new_caches
-        for layer in self.layers:
-            x = layer(x)
+        if self.config.recompute and self.training:
+            # per-block rematerialisation: activations recomputed in the
+            # backward, trading FLOPs for the memory that puts billion-
+            # parameter configs on one chip (ref recompute strategy)
+            from ...distributed.fleet.utils.recompute import recompute
+
+            for layer in self.layers:
+                x = recompute(layer, x)
+        else:
+            for layer in self.layers:
+                x = layer(x)
         return self.final_norm(x)
 
     def init_caches(self, batch_size, max_len, dtype=None):

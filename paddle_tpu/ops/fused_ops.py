@@ -17,6 +17,7 @@ PADDLE_TPU_FLASH_FORCE=pallas to exercise the kernels in interpreter mode.
 
 from __future__ import annotations
 
+import contextlib
 import functools
 import math
 import os
@@ -81,9 +82,39 @@ def _compiler_params(semantics):
 
 
 _warned_no_pltpu = False
+_gspmd_tracing = False
+_warned_gspmd = False
+
+
+@contextlib.contextmanager
+def gspmd_tracing():
+    """Trace-time gate set by the meshed engines: a Mosaic call inside a
+    GSPMD-partitioned jit fails with 'Mosaic kernels cannot be
+    automatically partitioned' unless every mesh axis is manual, so
+    meshed programs take the jnp attention path.  (Proper fix: a
+    custom_partitioning rule declaring the bh dim shardable — tracked
+    for the next round.)"""
+    global _gspmd_tracing
+    prev = _gspmd_tracing
+    _gspmd_tracing = True
+    try:
+        yield
+    finally:
+        _gspmd_tracing = prev
 
 
 def _use_pallas(seq_q=None) -> bool:
+    if _gspmd_tracing:
+        global _warned_gspmd
+        if not _warned_gspmd:
+            _warned_gspmd = True
+            import warnings
+
+            warnings.warn(
+                "flash attention uses the jnp path inside "
+                "GSPMD-partitioned programs (Mosaic calls cannot be "
+                "auto-partitioned)")
+        return False
     force = os.environ.get("PADDLE_TPU_FLASH_FORCE", "")
     if force == "pallas":
         if not _HAS_PLTPU:
