@@ -343,10 +343,14 @@ def batch_norm(x, scale, bias, mean, variance, *, momentum=0.9, epsilon=1e-5,
 @register_op("instance_norm")
 def instance_norm(x, scale=None, bias=None, *, epsilon=1e-5):
     axes = tuple(range(2, x.ndim))
-    mean = jnp.mean(x, axis=axes, keepdims=True)
-    var = jnp.maximum(jnp.mean(x * x, axis=axes, keepdims=True)
+    # f32 stats: the one-pass E[x^2]-mean^2 form cancels catastrophically
+    # in bf16 (mean^2 and E[x^2] collide at 8 mantissa bits)
+    x32 = x.astype(jnp.float32) if x.dtype in (jnp.bfloat16,
+                                               jnp.float16) else x
+    mean = jnp.mean(x32, axis=axes, keepdims=True)
+    var = jnp.maximum(jnp.mean(x32 * x32, axis=axes, keepdims=True)
                       - mean * mean, 0.0)
-    y = (x - mean) * lax.rsqrt(var + epsilon)
+    y = ((x32 - mean) * lax.rsqrt(var + epsilon)).astype(x.dtype)
     bshape = [1, x.shape[1]] + [1] * (x.ndim - 2)
     if scale is not None:
         y = y * scale.reshape(bshape)
@@ -362,10 +366,13 @@ def group_norm(x, scale=None, bias=None, *, epsilon=1e-5, groups=1,
     g = groups
     xg = x.reshape((n, g, c // g) + x.shape[2:])
     axes = tuple(range(2, xg.ndim))
-    mean = jnp.mean(xg, axis=axes, keepdims=True)
-    var = jnp.maximum(jnp.mean(xg * xg, axis=axes, keepdims=True)
+    xg32 = xg.astype(jnp.float32) if xg.dtype in (jnp.bfloat16,
+                                                  jnp.float16) else xg
+    mean = jnp.mean(xg32, axis=axes, keepdims=True)
+    var = jnp.maximum(jnp.mean(xg32 * xg32, axis=axes, keepdims=True)
                       - mean * mean, 0.0)
-    y = ((xg - mean) * lax.rsqrt(var + epsilon)).reshape(x.shape)
+    y = ((xg32 - mean) * lax.rsqrt(var + epsilon)).reshape(
+        x.shape).astype(x.dtype)
     bshape = [1, c] + [1] * (x.ndim - 2)
     if scale is not None:
         y = y * scale.reshape(bshape)

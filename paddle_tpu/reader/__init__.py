@@ -20,14 +20,17 @@ __all__ = [
 
 
 def cache(reader):
-    """Materialise the full stream once; replay from memory after."""
+    """Materialise the full stream once; replay from memory after.  A
+    source failure mid-load leaves the cache EMPTY (not a stale prefix
+    that a retry would duplicate)."""
     all_data = []
     loaded = False
 
     def rd():
         nonlocal loaded
         if not loaded:
-            all_data.extend(reader())
+            fresh = list(reader())  # only commit a complete load
+            all_data.extend(fresh)
             loaded = True
         return iter(all_data)
 
@@ -94,9 +97,17 @@ def compose(*readers, **kwargs):
     return rd
 
 
+class _ReaderError:
+    """Exception envelope crossing a reader thread boundary."""
+
+    def __init__(self, exc):
+        self.exc = exc
+
+
 def buffered(reader, size):
     """Decouple producer/consumer through a bounded queue fed by a
-    background thread."""
+    background thread; a producer exception re-raises in the consumer
+    (never a silently truncated stream)."""
     end = object()
 
     def rd():
@@ -106,8 +117,10 @@ def buffered(reader, size):
             try:
                 for s in reader():
                     q.put(s)
-            finally:
-                q.put(end)
+            except BaseException as e:  # noqa: BLE001 — re-raised below
+                q.put(_ReaderError(e))
+                return
+            q.put(end)
 
         t = threading.Thread(target=fill, daemon=True)
         t.start()
@@ -115,6 +128,8 @@ def buffered(reader, size):
             s = q.get()
             if s is end:
                 break
+            if isinstance(s, _ReaderError):
+                raise s.exc
             yield s
 
     return rd
@@ -137,19 +152,29 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
         out_q = queue.Queue(buffer_size)
 
         def feed():
-            for i, s in enumerate(reader()):
-                in_q.put((i, s))
-            for _ in range(process_num):
-                in_q.put(end)
+            try:
+                for i, s in enumerate(reader()):
+                    in_q.put((i, s))
+            except BaseException as e:  # noqa: BLE001 — re-raised below
+                out_q.put(_ReaderError(e))
+            finally:
+                # sentinels flow regardless: a dead feed must not leave
+                # workers (and through them the consumer) blocked forever
+                for _ in range(process_num):
+                    in_q.put(end)
 
         def work():
-            while True:
-                item = in_q.get()
-                if item is end:
-                    out_q.put(end)
-                    break
-                i, s = item
-                out_q.put((i, mapper(s)))
+            try:
+                while True:
+                    item = in_q.get()
+                    if item is end:
+                        break
+                    i, s = item
+                    out_q.put((i, mapper(s)))
+            except BaseException as e:  # noqa: BLE001 — re-raised below
+                out_q.put(_ReaderError(e))
+            finally:
+                out_q.put(end)
 
         threading.Thread(target=feed, daemon=True).start()
         for _ in range(process_num):
@@ -162,6 +187,8 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
                 if item is end:
                     finished += 1
                     continue
+                if isinstance(item, _ReaderError):
+                    raise item.exc
                 yield item[1]
         else:
             import heapq
@@ -184,6 +211,8 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
                 if item is end:
                     finished += 1
                     continue
+                if isinstance(item, _ReaderError):
+                    raise item.exc
                 heapq.heappush(heap, item)
 
     return rd
@@ -200,6 +229,9 @@ def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
             try:
                 for s in r():
                     q.put(s)
+            except BaseException as e:  # noqa: BLE001 — re-raised below
+                q.put(_ReaderError(e))
+                return
             finally:
                 q.put(end)
 
@@ -211,6 +243,8 @@ def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
             if s is end:
                 finished += 1
                 continue
+            if isinstance(s, _ReaderError):
+                raise s.exc
             yield s
 
     return rd

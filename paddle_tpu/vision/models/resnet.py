@@ -15,7 +15,6 @@ from __future__ import annotations
 import numpy as np
 
 from ... import nn
-from ...core.tensor import Tensor
 from ...nn import functional as F
 
 
@@ -47,7 +46,13 @@ class SpaceToDepthStem(nn.Layer):
                               padding=0, bias_attr=False)
 
     def forward(self, x):
-        x = F.pad(x, [3, 3, 3, 3])
+        # odd padded dims get one extra zero row/col on the bottom/right
+        # so the 2x2 space-to-depth divides evenly; the extra zeros fall
+        # on the (3,1) taps that are zero in the folded 7x7 weights, so
+        # equivalence holds for any input size (the vanilla stride-2
+        # stem produces floor((h-1)/2)+1 rows — so does this)
+        h_in, w_in = x.shape[2], x.shape[3]
+        x = F.pad(x, [3, 3 + (h_in % 2), 3, 3 + (w_in % 2)])
         n, c, h, w = x.shape
         x = x.reshape([n, c, h // 2, 2, w // 2, 2]) \
              .transpose([0, 1, 3, 5, 2, 4]) \
