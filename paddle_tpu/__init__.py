@@ -161,3 +161,8 @@ from . import reader  # noqa: F401,E402
 from . import onnx  # noqa: F401,E402
 from .reader import batch  # noqa: F401,E402
 from . import dataset  # noqa: F401,E402
+from . import regularizer  # noqa: F401,E402
+from . import sysconfig  # noqa: F401,E402
+from . import compat  # noqa: F401,E402
+from . import callbacks  # noqa: F401,E402
+from . import hub  # noqa: F401,E402

@@ -1,0 +1,106 @@
+"""paddle.compat namespace (ref: python/paddle/compat.py).
+
+The reference carries Python-2/3 bridging helpers; this environment is
+Python-3 only, so the implementations are the py3 halves with the same
+signatures and container-recursion behavior.
+"""
+
+from __future__ import annotations
+
+import math
+
+__all__ = []
+
+
+def to_text(obj, encoding="utf-8", inplace=False):
+    """Convert ``obj`` (str/bytes or a list/set/dict of them) to str."""
+    if obj is None:
+        return obj
+    if isinstance(obj, list):
+        if inplace:
+            for i in range(len(obj)):
+                obj[i] = _to_text(obj[i], encoding)
+            return obj
+        return [_to_text(item, encoding) for item in obj]
+    if isinstance(obj, set):
+        if inplace:
+            for item in list(obj):
+                obj.remove(item)
+                obj.add(_to_text(item, encoding))
+            return obj
+        return {_to_text(item, encoding) for item in obj}
+    if isinstance(obj, dict):
+        if inplace:
+            new_obj = {_to_text(k, encoding): _to_text(v, encoding)
+                       for k, v in obj.items()}
+            obj.clear()
+            obj.update(new_obj)
+            return obj
+        return {_to_text(k, encoding): _to_text(v, encoding)
+                for k, v in obj.items()}
+    return _to_text(obj, encoding)
+
+
+def _to_text(obj, encoding):
+    if obj is None:
+        return obj
+    if isinstance(obj, bytes):
+        return obj.decode(encoding)
+    if isinstance(obj, str):
+        return obj
+    if isinstance(obj, (bool, float)):
+        return obj
+    return str(obj)
+
+
+def to_bytes(obj, encoding="utf-8", inplace=False):
+    """Convert ``obj`` (str/bytes or a list/set of them) to bytes."""
+    if obj is None:
+        return obj
+    if isinstance(obj, list):
+        if inplace:
+            for i in range(len(obj)):
+                obj[i] = _to_bytes(obj[i], encoding)
+            return obj
+        return [_to_bytes(item, encoding) for item in obj]
+    if isinstance(obj, set):
+        if inplace:
+            for item in list(obj):
+                obj.remove(item)
+                obj.add(_to_bytes(item, encoding))
+            return obj
+        return {_to_bytes(item, encoding) for item in obj}
+    return _to_bytes(obj, encoding)
+
+
+def _to_bytes(obj, encoding):
+    if obj is None:
+        return obj
+    assert encoding is not None
+    if isinstance(obj, str):
+        return obj.encode(encoding)
+    if isinstance(obj, bytes):
+        return obj
+    return str(obj).encode(encoding)
+
+
+def round(x, d=0):
+    """Half-away-from-zero rounding (python2 semantics the reference
+    preserves), unlike builtin round()'s banker's rounding."""
+    if x is None:
+        return None
+    if math.isinf(x) or math.isnan(x):
+        return x
+    p = 10 ** d
+    if x >= 0.0:
+        return float(math.floor((x * p) + math.copysign(0.5, x))) / p
+    return float(math.ceil((x * p) + math.copysign(0.5, x))) / p
+
+
+def floor_division(x, y):
+    return x // y
+
+
+def get_exception_message(exc):
+    assert exc is not None
+    return str(exc)

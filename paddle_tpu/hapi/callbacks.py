@@ -132,36 +132,67 @@ class ModelCheckpoint(Callback):
             self.model.save(os.path.join(self.save_dir, "final"))
 
 
+def _monitored_value(logs, monitor):
+    """Look up a monitored metric in eval logs.
+
+    Model.evaluate prefixes its keys with ``eval_`` — accept both the
+    bare name (reference spelling, e.g. ``loss``) and the prefixed one.
+    Streaming metrics report lists; use the first element.
+    """
+    cur = logs.get(monitor)
+    if cur is None:
+        cur = logs.get("eval_" + monitor)
+    if isinstance(cur, (list, tuple)):
+        cur = cur[0] if cur else None
+    return cur
+
+
+def _improvement_cmp(mode, monitor, min_delta):
+    if mode == "max" or (mode == "auto" and "acc" in monitor):
+        return lambda cur, best: cur > best + min_delta
+    return lambda cur, best: cur < best - min_delta
+
+
 class EarlyStopping(Callback):
     def __init__(self, monitor="loss", mode="auto", patience=0, verbose=1,
                  min_delta=0, baseline=None, save_best_model=True):
         super().__init__()
         self.monitor = monitor
         self.patience = patience
+        self.verbose = verbose
         self.min_delta = abs(min_delta)
         self.baseline = baseline
+        self.save_best_model = save_best_model
         self.wait = 0
         self.best = None
         self.stopped_epoch = 0
-        if mode == "max" or (mode == "auto" and "acc" in monitor):
-            self.better = lambda cur, best: cur > best + self.min_delta
-        else:
-            self.better = lambda cur, best: cur < best - self.min_delta
+        self.better = _improvement_cmp(mode, monitor, self.min_delta)
+
+    def on_train_begin(self, logs=None):
+        self.wait = 0
+        # A baseline is a bar the metric must clear, not a best value to
+        # update: a run that never beats it accrues wait every eval
+        # (reference hapi/callbacks.py EarlyStopping.on_train_begin).
+        self.best = self.baseline
 
     def on_eval_end(self, logs=None):
-        logs = logs or {}
-        cur = logs.get(self.monitor)
+        cur = _monitored_value(logs or {}, self.monitor)
         if cur is None:
             return
-        if isinstance(cur, (list, tuple)):
-            cur = cur[0]
         if self.best is None or self.better(cur, self.best):
             self.best = cur
             self.wait = 0
+            save_dir = self.params.get("save_dir")
+            if self.save_best_model and save_dir and self.model is not None:
+                self.model.save(os.path.join(save_dir, "best_model"))
         else:
             self.wait += 1
             if self.wait >= self.patience:
                 self.model.stop_training = True
+                if self.verbose:
+                    print(f"Epoch {self.stopped_epoch + 1}: "
+                          "Early stopping.")
+        self.stopped_epoch += 1
 
 
 class LRScheduler(Callback):
@@ -187,6 +218,66 @@ class LRScheduler(Callback):
             s = self._sched()
             if s is not None:
                 s.step()
+
+
+class ReduceLROnPlateau(Callback):
+    """Reduce the optimizer LR when a monitored metric stops improving.
+
+    Ref parity: python/paddle/hapi/callbacks.py ReduceLROnPlateau (same
+    knobs).  Only a plain-float optimizer LR can be stepped down
+    (matching the reference, which warns and skips for scheduler LRs).
+    """
+
+    def __init__(self, monitor="loss", factor=0.1, patience=10, verbose=1,
+                 mode="auto", min_delta=1e-4, cooldown=0, min_lr=0):
+        super().__init__()
+        if factor >= 1.0:
+            raise ValueError("ReduceLROnPlateau does not support a "
+                             "factor >= 1.0")
+        self.monitor = monitor
+        self.factor = factor
+        self.patience = patience
+        self.verbose = verbose
+        self.min_delta = abs(min_delta)
+        self.cooldown = cooldown
+        self.min_lr = min_lr
+        self.cooldown_counter = 0
+        self.wait = 0
+        self.best = None
+        self.better = _improvement_cmp(mode, monitor, self.min_delta)
+
+    def on_eval_end(self, logs=None):
+        cur = _monitored_value(logs or {}, self.monitor)
+        if cur is None:
+            return
+        if self.cooldown_counter > 0:
+            self.cooldown_counter -= 1
+            self.wait = 0
+        if self.best is None or self.better(cur, self.best):
+            self.best = cur
+            self.wait = 0
+        elif self.cooldown_counter <= 0:
+            self.wait += 1
+            if self.wait >= self.patience:
+                opt = getattr(self.model, "_optimizer", None)
+                if opt is not None:
+                    if not isinstance(opt._learning_rate, float):
+                        import warnings
+
+                        warnings.warn(
+                            "ReduceLROnPlateau only supports a float "
+                            "learning rate; the optimizer uses an "
+                            "LRScheduler, skipping the reduction.")
+                        return
+                    old = opt.get_lr()
+                    new = max(old * self.factor, self.min_lr)
+                    if old - new > 1e-12:
+                        opt.set_lr(new)
+                        if self.verbose:
+                            print(f"ReduceLROnPlateau: lr {old:.6g} "
+                                  f"-> {new:.6g}")
+                self.cooldown_counter = self.cooldown
+                self.wait = 0
 
 
 class VisualDL(Callback):

@@ -1,0 +1,105 @@
+"""paddle.hub — hubconf-based model loading (ref: python/paddle/hapi/hub.py).
+
+A hub repo is a directory with a ``hubconf.py`` whose public callables
+are model entrypoints and whose optional ``dependencies`` list names
+required importable packages.  The ``local`` source is fully supported;
+``github``/``gitee`` need network egress, which this environment does
+not have, so they raise a clear RuntimeError (same validation and call
+surface as the reference).
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+__all__ = ["list", "help", "load"]
+
+MODULE_HUBCONF = "hubconf.py"
+_builtin_list = list
+
+
+def _resolve_repo(repo_dir, source, force_reload):
+    if source not in ("github", "gitee", "local"):
+        raise ValueError(
+            'Unknown source: "{}". Allowed values: "github" | "gitee" | '
+            '"local".'.format(source))
+    if source in ("github", "gitee"):
+        raise RuntimeError(
+            "paddle.hub source='{}' needs network access, which is not "
+            "available in this environment; clone the repo yourself and "
+            "use source='local'.".format(source))
+    if not os.path.isdir(repo_dir):
+        raise ValueError("local hub repo not found: {}".format(repo_dir))
+    return repo_dir
+
+
+def _import_module(name, repo_dir):
+    path = os.path.join(repo_dir, MODULE_HUBCONF)
+    if not os.path.isfile(path):
+        raise FileNotFoundError(
+            "{} has no {}".format(repo_dir, MODULE_HUBCONF))
+    spec = importlib.util.spec_from_file_location(name, path)
+    module = importlib.util.module_from_spec(spec)
+    sys.path.insert(0, repo_dir)
+    try:
+        spec.loader.exec_module(module)
+    finally:
+        sys.path.remove(repo_dir)
+    return module
+
+
+def _check_dependencies(module):
+    deps = getattr(module, "dependencies", None)
+    if not deps:
+        return
+    missing = [d for d in deps
+               if importlib.util.find_spec(d) is None]
+    if missing:
+        raise RuntimeError(
+            "Missing dependencies for hub repo: {}".format(missing))
+
+
+def _entries(module):
+    # Reference semantics: any public callable in hubconf.py is an
+    # entrypoint, including ones re-exported from sibling modules.
+    return {
+        name: fn
+        for name, fn in vars(module).items()
+        if callable(fn) and not name.startswith("_")
+    }
+
+
+def _load_entry_from_hubconf(module, name):
+    if not isinstance(name, str):
+        raise ValueError(
+            "Invalid input: model should be a str of function name")
+    entry = _entries(module).get(name)
+    if entry is None:
+        raise RuntimeError(
+            "Cannot find callable {} in {}".format(name, MODULE_HUBCONF))
+    return entry
+
+
+def list(repo_dir, source="github", force_reload=False):  # noqa: A001
+    """List entrypoint names exposed by a hub repo's hubconf.py."""
+    repo_dir = _resolve_repo(repo_dir, source, force_reload)
+    module = _import_module(MODULE_HUBCONF.split(".")[0], repo_dir)
+    return _builtin_list(_entries(module))
+
+
+def help(repo_dir, model, source="github", force_reload=False):  # noqa: A001
+    """Return the docstring of one entrypoint."""
+    repo_dir = _resolve_repo(repo_dir, source, force_reload)
+    module = _import_module(MODULE_HUBCONF.split(".")[0], repo_dir)
+    return _load_entry_from_hubconf(module, model).__doc__
+
+
+def load(repo_dir, model, source="github", force_reload=False, **kwargs):
+    """Build a model from a hub repo entrypoint."""
+    repo_dir = _resolve_repo(repo_dir, source, force_reload)
+    module = _import_module(MODULE_HUBCONF.split(".")[0], repo_dir)
+    _check_dependencies(module)
+    entry = _load_entry_from_hubconf(module, model)
+    return entry(**kwargs)

@@ -154,7 +154,7 @@ class Model:
         cbks.set_model(self)
         steps = _safe_len(train_loader)
         cbks.set_params({"epochs": epochs, "steps": steps,
-                         "verbose": verbose})
+                         "verbose": verbose, "save_dir": save_dir})
         cbks.on_train_begin()
         self.stop_training = False
         it = 0

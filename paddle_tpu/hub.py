@@ -1,0 +1,5 @@
+"""paddle.hub namespace (ref: python/paddle/hub.py)."""
+
+from .hapi.hub import help, list, load  # noqa: F401
+
+__all__ = ["list", "help", "load"]

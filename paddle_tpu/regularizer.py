@@ -1,0 +1,10 @@
+"""paddle.regularizer namespace (ref: python/paddle/regularizer.py).
+
+L1Decay/L2Decay are defined with the optimizer update rules (they feed
+straight into the compiled per-parameter step); this module gives them
+the reference's public import path.
+"""
+
+from .optimizer import L1Decay, L2Decay  # noqa: F401
+
+__all__ = ["L1Decay", "L2Decay"]

@@ -1,0 +1,21 @@
+"""paddle.sysconfig namespace (ref: python/paddle/sysconfig.py).
+
+Returns the header / native-library directories for the C++ extension
+toolchain (utils.cpp_extension builds against these).
+"""
+
+import os
+
+__all__ = ["get_include", "get_lib"]
+
+
+def get_include():
+    """Directory containing the framework's C++ headers."""
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "native")
+
+
+def get_lib():
+    """Directory containing the framework's native shared objects."""
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "native")

@@ -1,0 +1,166 @@
+"""paddle.{regularizer,sysconfig,compat,callbacks,hub} namespace parity.
+
+Ref: python/paddle/{regularizer,sysconfig,compat,callbacks,hub}.py.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+pytestmark = pytest.mark.smoke
+
+
+def test_regularizer_namespace():
+    assert paddle.regularizer.L2Decay is paddle.optimizer.L2Decay
+    wd = paddle.regularizer.L2Decay(1e-4)
+    assert wd.coeff == pytest.approx(1e-4)
+    paddle.regularizer.L1Decay(0.01)
+
+
+def test_sysconfig_paths():
+    inc = paddle.sysconfig.get_include()
+    lib = paddle.sysconfig.get_lib()
+    assert os.path.isdir(inc) and os.path.isdir(lib)
+
+
+def test_compat_text_bytes():
+    c = paddle.compat
+    assert c.to_text(b"ab") == "ab"
+    assert c.to_bytes("ab") == b"ab"
+    assert c.to_text([b"a", "b"]) == ["a", "b"]
+    assert c.to_bytes({"a", "b"}) == {b"a", b"b"}
+    d = {b"k": b"v"}
+    out = c.to_text(d, inplace=True)
+    assert out is d and d == {"k": "v"}
+
+
+def test_compat_round_half_away_from_zero():
+    assert paddle.compat.round(0.5) == 1.0
+    assert paddle.compat.round(-0.5) == -1.0
+    assert paddle.compat.round(2.675, 2) == pytest.approx(2.68)
+    assert paddle.compat.floor_division(7, 2) == 3
+
+
+def test_callbacks_namespace():
+    assert paddle.callbacks.ModelCheckpoint is not None
+    assert paddle.callbacks.ReduceLROnPlateau is not None
+
+
+def test_reduce_lr_on_plateau():
+    cb = paddle.callbacks.ReduceLROnPlateau(
+        monitor="loss", factor=0.5, patience=1, verbose=0)
+
+    class FakeModel:
+        _optimizer = paddle.optimizer.SGD(learning_rate=0.1)
+
+    cb.set_model(FakeModel())
+    cb.on_eval_end({"loss": 1.0})
+    cb.on_eval_end({"loss": 1.0})   # wait=1 -> patience hit
+    assert FakeModel._optimizer.get_lr() == pytest.approx(0.05)
+    with pytest.raises(ValueError):
+        paddle.callbacks.ReduceLROnPlateau(factor=1.5)
+
+
+def test_reduce_lr_on_plateau_eval_prefixed_logs():
+    # Model.evaluate emits "eval_loss"; the default monitor="loss" must
+    # still see it.
+    cb = paddle.callbacks.ReduceLROnPlateau(
+        monitor="loss", factor=0.5, patience=0, verbose=0)
+
+    class FakeModel:
+        _optimizer = paddle.optimizer.SGD(learning_rate=0.2)
+
+    cb.set_model(FakeModel())
+    cb.on_eval_end({"eval_loss": [1.0]})
+    cb.on_eval_end({"eval_loss": [1.0]})
+    assert FakeModel._optimizer.get_lr() == pytest.approx(0.1)
+
+
+def test_reduce_lr_on_plateau_scheduler_lr_warns():
+    import paddle_tpu.optimizer.lr as lr
+    cb = paddle.callbacks.ReduceLROnPlateau(
+        monitor="loss", factor=0.5, patience=0, verbose=0)
+
+    class FakeModel:
+        _optimizer = paddle.optimizer.SGD(
+            learning_rate=lr.NaturalExpDecay(0.1, gamma=0.5))
+
+    cb.set_model(FakeModel())
+    cb.on_eval_end({"loss": 1.0})
+    with pytest.warns(UserWarning, match="LRScheduler"):
+        cb.on_eval_end({"loss": 1.0})
+
+
+def test_hub_local_repo(tmp_path):
+    repo = tmp_path / "hubrepo"
+    repo.mkdir()
+    (repo / "hubconf.py").write_text(
+        "dependencies = ['numpy']\n"
+        "def lenet(num_classes=10):\n"
+        "    '''A LeNet.'''\n"
+        "    import paddle_tpu as paddle\n"
+        "    return paddle.vision.models.LeNet(num_classes=num_classes)\n")
+    names = paddle.hub.list(str(repo), source="local")
+    assert names == ["lenet"]
+    assert "LeNet" in paddle.hub.help(str(repo), "lenet", source="local")
+    model = paddle.hub.load(str(repo), "lenet", source="local",
+                            num_classes=7)
+    x = paddle.to_tensor(np.zeros((1, 1, 28, 28), np.float32))
+    assert model(x).shape[-1] == 7
+
+
+def test_hub_reexported_entrypoint(tmp_path):
+    repo = tmp_path / "hubrepo2"
+    repo.mkdir()
+    (repo / "_impl.py").write_text(
+        "def mlp(width=4):\n"
+        "    '''An MLP.'''\n"
+        "    import paddle_tpu as paddle\n"
+        "    return paddle.nn.Linear(width, width)\n")
+    (repo / "hubconf.py").write_text("from _impl import mlp\n")
+    assert paddle.hub.list(str(repo), source="local") == ["mlp"]
+    layer = paddle.hub.load(str(repo), "mlp", source="local", width=3)
+    assert layer.weight.shape == [3, 3]
+
+
+def test_early_stopping_baseline():
+    cb = paddle.callbacks.EarlyStopping(
+        monitor="loss", baseline=0.5, patience=1, verbose=0)
+
+    class FakeModel:
+        stop_training = False
+    fm = FakeModel()
+    cb.set_model(fm)
+    cb.set_params({})
+    cb.on_train_begin()
+    cb.on_eval_end({"loss": 0.9})   # worse than baseline -> stop (patience 1)
+    assert fm.stop_training
+
+
+def test_early_stopping_saves_best_model(tmp_path):
+    saved = []
+
+    class FakeModel:
+        stop_training = False
+
+        def save(self, path):
+            saved.append(path)
+
+    cb = paddle.callbacks.EarlyStopping(
+        monitor="loss", patience=5, verbose=0, save_best_model=True)
+    cb.set_model(FakeModel())
+    cb.set_params({"save_dir": str(tmp_path)})
+    cb.on_train_begin()
+    cb.on_eval_end({"loss": 1.0})
+    cb.on_eval_end({"loss": 0.5})
+    assert len(saved) == 2 and saved[-1].endswith("best_model")
+
+
+def test_hub_remote_gated(tmp_path):
+    with pytest.raises(RuntimeError, match="network"):
+        paddle.hub.load("owner/repo", "m", source="github")
+    with pytest.raises(ValueError, match="Unknown source"):
+        paddle.hub.list("x", source="ftp")
