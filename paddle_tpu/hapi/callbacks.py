@@ -170,6 +170,7 @@ class EarlyStopping(Callback):
 
     def on_train_begin(self, logs=None):
         self.wait = 0
+        self.stopped_epoch = 0
         # A baseline is a bar the metric must clear, not a best value to
         # update: a run that never beats it accrues wait every eval
         # (reference hapi/callbacks.py EarlyStopping.on_train_begin).
@@ -268,14 +269,14 @@ class ReduceLROnPlateau(Callback):
                             "ReduceLROnPlateau only supports a float "
                             "learning rate; the optimizer uses an "
                             "LRScheduler, skipping the reduction.")
-                        return
-                    old = opt.get_lr()
-                    new = max(old * self.factor, self.min_lr)
-                    if old - new > 1e-12:
-                        opt.set_lr(new)
-                        if self.verbose:
-                            print(f"ReduceLROnPlateau: lr {old:.6g} "
-                                  f"-> {new:.6g}")
+                    else:
+                        old = opt.get_lr()
+                        new = max(old * self.factor, self.min_lr)
+                        if old - new > 1e-12:
+                            opt.set_lr(new)
+                            if self.verbose:
+                                print(f"ReduceLROnPlateau: lr {old:.6g} "
+                                      f"-> {new:.6g}")
                 self.cooldown_counter = self.cooldown
                 self.wait = 0
 

@@ -35,18 +35,29 @@ def _resolve_repo(repo_dir, source, force_reload):
     return repo_dir
 
 
+# module names that past hub loads injected into sys.modules (sibling
+# imports of a hubconf); purged before each load so two repos with
+# same-named siblings never see each other's code
+_hub_loaded_names: set = set()
+
+
 def _import_module(name, repo_dir):
     path = os.path.join(repo_dir, MODULE_HUBCONF)
     if not os.path.isfile(path):
         raise FileNotFoundError(
             "{} has no {}".format(repo_dir, MODULE_HUBCONF))
+    for stale in _hub_loaded_names:
+        sys.modules.pop(stale, None)
+    _hub_loaded_names.clear()
     spec = importlib.util.spec_from_file_location(name, path)
     module = importlib.util.module_from_spec(spec)
+    before = set(sys.modules)
     sys.path.insert(0, repo_dir)
     try:
         spec.loader.exec_module(module)
     finally:
         sys.path.remove(repo_dir)
+        _hub_loaded_names.update(set(sys.modules) - before)
     return module
 
 

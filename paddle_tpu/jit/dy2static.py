@@ -24,9 +24,16 @@ Mechanics (mirrors the reference's UndefinedVar machinery):
   return_transformer reaches the same form with a guard flag — flags
   would join a returned value with an undefined one, which lax.cond's
   matched-pytree branches cannot express);
-- functions using global/nonlocal, or tensor-pred branches containing
-  break/continue or returns inside loops, fall back to the trace-based
-  path unchanged (documented gap).
+- return/break/continue INSIDE While/For(range) bodies lower through a
+  flag pre-pass (`_LoopEscapeLowerer`): escapes become boolean guards
+  threaded through the loop carry, the loop test gains `not brk`, and
+  a post-loop `if ret: return rv` re-enters the early-return
+  normalisation; the return-value slot starts as an AutoZero sentinel
+  the runtime promotes to structure-matched zeros (never observable —
+  every read is guarded by the flag);
+- functions using global/nonlocal, escapes inside try blocks, and
+  For loops over non-range iterables containing escapes fall back to
+  the trace-based path unchanged (documented gap).
 """
 
 from __future__ import annotations
@@ -85,6 +92,65 @@ jax.tree_util.register_pytree_node(
     _Undef, lambda u: ((), None), lambda aux, ch: UNDEF)
 
 
+class _AutoZero:
+    """Initializer for COMPILER-GENERATED slots (the loop-escape return
+    value `__d2s_rvN`).  Unlike UNDEF, a traced branch join is allowed
+    to promote it to zeros matching the other side's structure — safe
+    only because generated code guards every read of the slot behind
+    the escape flag, so the zeros are never observable."""
+
+    _instance = None
+
+    def __new__(cls):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+        return cls._instance
+
+    def __repr__(self):
+        return "<autozero>"
+
+
+AUTOZERO = _AutoZero()
+jax.tree_util.register_pytree_node(
+    _AutoZero, lambda u: ((), None), lambda aux, ch: AUTOZERO)
+
+
+def _contains_auto(t):
+    leaf = lambda v: isinstance(v, _AutoZero)  # noqa: E731
+    return any(leaf(x) for x in jax.tree_util.tree_leaves(t, is_leaf=leaf))
+
+
+def _zeros_like_sds(t):
+    return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), t)
+
+
+def _promote_autozero(run, self_shapes, other_shapes):
+    """Wrap a traced branch/body so output slots that are AutoZero on
+    this side but concrete on the other come out as zeros of the other
+    side's structure, letting lax.cond join a returned value with its
+    not-yet-assigned slot."""
+    if not (isinstance(self_shapes, tuple) and isinstance(other_shapes,
+                                                          tuple)
+            and len(self_shapes) == len(other_shapes)):
+        return run
+    fixes = {
+        i: other_shapes[i]
+        for i in range(len(self_shapes))
+        if isinstance(self_shapes[i], _AutoZero)
+        and not _contains_auto(other_shapes[i])
+    }
+    if not fixes:
+        return run
+
+    def fixed(operand):
+        outs = list(run(operand))
+        for i, sds in fixes.items():
+            outs[i] = _zeros_like_sds(sds)
+        return tuple(outs)
+
+    return fixed
+
+
 def _d2s_ld(thunk):
     """Capture a local that may be unbound at this point."""
     try:
@@ -126,33 +192,61 @@ def convert_ifelse(pred, true_fn, false_fn, ins):
                 return _tree_unwrap(outs)
             return run
 
-        out = lax.cond(jnp.reshape(p, ()), branch(true_fn),
-                       branch(false_fn), init)
+        tb, fb = branch(true_fn), branch(false_fn)
+        if _contains_auto(init):
+            ts = jax.eval_shape(tb, init)
+            fs = jax.eval_shape(fb, init)
+            tb = _promote_autozero(tb, ts, fs)
+            fb = _promote_autozero(fb, fs, ts)
+        out = lax.cond(jnp.reshape(p, ()), tb, fb, init)
         return _tree_wrap(out)
     return true_fn(*ins) if pb else false_fn(*ins)
 
 
 def convert_while_loop(cond_fn, body_fn, ins):
-    """ref convert_operators.convert_while_loop."""
-    ins = tuple(ins)
-    first = cond_fn(*ins)
-    try:
-        cb = bool(_unwrap(first))
-    except _TRACE_ERRORS:
-        init = _tree_unwrap(ins)
-
-        def cond_w(carry):
-            return jnp.reshape(_unwrap(cond_fn(*_tree_wrap(carry))), ())
-
-        def body_w(carry):
-            return _tree_unwrap(body_fn(*_tree_wrap(carry)))
-
-        return _tree_wrap(lax.while_loop(cond_w, body_w, init))
-    vals = ins
-    while cb:
+    """ref convert_operators.convert_while_loop.  Concrete predicates
+    run as a Python loop; the first traced predicate — including one
+    that only BECOMES traced mid-loop, e.g. `while True` whose escape
+    flag turns traced when a tensor-pred `break` fires — lowers the
+    remaining iterations to lax.while_loop (loop peeling)."""
+    vals = tuple(ins)
+    while True:
+        try:
+            cb = bool(_unwrap(cond_fn(*vals)))
+        except _TRACE_ERRORS:
+            return _lax_while(cond_fn, body_fn, vals)
+        if not cb:
+            return vals
         vals = tuple(body_fn(*vals))
-        cb = bool(_unwrap(cond_fn(*vals)))
-    return vals
+
+
+def _lax_while(cond_fn, body_fn, ins):
+    init = _tree_unwrap(tuple(ins))
+
+    def cond_w(carry):
+        return jnp.reshape(_unwrap(cond_fn(*_tree_wrap(carry))), ())
+
+    def body_w(carry):
+        return _tree_unwrap(body_fn(*_tree_wrap(carry)))
+
+    if _contains_auto(init):
+        # Materialize compiler-generated AutoZero slots (loop-escape
+        # return values) at the structure the body produces for them.
+        # Fixed-point iteration: one slot's promotion can concretize
+        # another's structure (chained escapes through nested loops).
+        for _ in range(8):
+            out_s = jax.eval_shape(body_w, init)
+            init2, changed = [], False
+            for a, b in zip(init, tuple(out_s)):
+                if isinstance(a, _AutoZero) and not _contains_auto(b):
+                    init2.append(_zeros_like_sds(b))
+                    changed = True
+                else:
+                    init2.append(a)
+            init = tuple(init2)
+            if not changed:
+                break
+    return _tree_wrap(lax.while_loop(cond_w, body_w, init))
 
 
 def convert_logical_and(a, b_thunk):
@@ -189,6 +283,7 @@ _HELPERS = {
     "_d2s_or": convert_logical_or,
     "_d2s_not": convert_logical_not,
     "_d2s_ld": _d2s_ld,
+    "_d2s_auto": AUTOZERO,
 }
 
 
@@ -225,23 +320,33 @@ def _stored_names(stmts):
     return out
 
 
+def _scan_scope(stmts, visit, *, in_loop=False, in_try=False):
+    """Shared walker for the escape analyses: depth-first over the
+    current function scope (never entering nested defs/lambdas/
+    comprehensions), tracking whether each node sits inside a loop /
+    try *of this scope*.  `visit(node, in_loop, in_try)` returning True
+    short-circuits the walk."""
+    for n in stmts:
+        if isinstance(n, _NESTED_SCOPES):
+            continue
+        if visit(n, in_loop, in_try):
+            return True
+        if _scan_scope(list(ast.iter_child_nodes(n)), visit,
+                       in_loop=in_loop or isinstance(
+                           n, (ast.For, ast.While)),
+                       in_try=in_try or isinstance(n, ast.Try)):
+            return True
+    return False
+
+
 def _has_escape(stmts, *, loop_level=False):
     """True if the statements contain return (any depth in this scope)
     or break/continue belonging to an enclosing loop."""
-    def scan(nodes, in_loop):
-        for n in nodes:
-            if isinstance(n, _NESTED_SCOPES):
-                continue
-            if isinstance(n, ast.Return):
-                return True
-            if isinstance(n, (ast.Break, ast.Continue)) and not in_loop:
-                return True
-            inner_loop = in_loop or isinstance(n, (ast.For, ast.While))
-            if scan(list(ast.iter_child_nodes(n)), inner_loop):
-                return True
-        return False
-
-    return scan(stmts, loop_level)
+    return _scan_scope(
+        stmts,
+        lambda n, in_loop, _t: isinstance(n, ast.Return) or (
+            isinstance(n, (ast.Break, ast.Continue)) and not in_loop),
+        in_loop=loop_level)
 
 
 # ---------------------------------------------------------------------------
@@ -264,34 +369,17 @@ def _tail_return_only(stmts):
 
 
 def _has_break_continue(stmts):
-    def scan(nodes, in_loop):
-        for n in nodes:
-            if isinstance(n, _NESTED_SCOPES):
-                continue
-            if isinstance(n, (ast.Break, ast.Continue)) and not in_loop:
-                return True
-            inner = in_loop or isinstance(n, (ast.For, ast.While))
-            if scan(list(ast.iter_child_nodes(n)), inner):
-                return True
-        return False
-
-    return scan(stmts, False)
+    return _scan_scope(
+        stmts,
+        lambda n, in_loop, _t: isinstance(
+            n, (ast.Break, ast.Continue)) and not in_loop)
 
 
 def _returns_inside_loops(stmts):
     """True if any Return sits inside a For/While of this scope."""
-    def scan(nodes, in_loop):
-        for n in nodes:
-            if isinstance(n, _NESTED_SCOPES):
-                continue
-            if isinstance(n, ast.Return) and in_loop:
-                return True
-            inner = in_loop or isinstance(n, (ast.For, ast.While))
-            if scan(list(ast.iter_child_nodes(n)), inner):
-                return True
-        return False
-
-    return scan(stmts, False)
+    return _scan_scope(
+        stmts,
+        lambda n, in_loop, _t: isinstance(n, ast.Return) and in_loop)
 
 
 def _definitely_returns(stmts):
@@ -361,6 +449,215 @@ def _absorb_tail_returns(stmts):
         out.append(s)
         i += 1
     return out
+
+
+def _assign(name, value):
+    return ast.Assign(targets=[_name(name, ast.Store())], value=value)
+
+
+def _loop_escapes(body):
+    """(has_return, has_break, has_continue) at THIS loop's level:
+    returns at any scope depth; break/continue not inside a nested
+    loop (those belong to the nested loop)."""
+    has_ret = has_brk = has_cnt = False
+
+    def visit(n, nested, _t):
+        nonlocal has_ret, has_brk, has_cnt
+        if isinstance(n, ast.Return):
+            has_ret = True
+        if not nested and isinstance(n, ast.Break):
+            has_brk = True
+        if not nested and isinstance(n, ast.Continue):
+            has_cnt = True
+        return False  # full walk, no short-circuit
+
+    _scan_scope(body, visit)
+    return has_ret, has_brk, has_cnt
+
+
+def _escape_inside_try(body):
+    """True if an escape this loop must handle sits inside a try block
+    (finally/except interplay with the flag rewrite is not modelled)."""
+    return _scan_scope(
+        body,
+        lambda n, nested, in_try: in_try and (
+            isinstance(n, ast.Return) or (not nested and isinstance(
+                n, (ast.Break, ast.Continue)))))
+
+
+def _range_for_parts(node, ivar):
+    """Decompose `for <name> in range(...)` into (init, test, bind,
+    bump) statements over loop counter `ivar`, or None if the iterable
+    is not a supported range call."""
+    if (not isinstance(node.target, ast.Name)
+            or not isinstance(node.iter, ast.Call)
+            or not isinstance(node.iter.func, ast.Name)
+            or node.iter.func.id != "range"
+            or node.iter.keywords):
+        return None
+    rargs = node.iter.args
+    if len(rargs) == 1:
+        start, stop, step = ast.Constant(0), rargs[0], ast.Constant(1)
+    elif len(rargs) == 2:
+        start, stop, step = rargs[0], rargs[1], ast.Constant(1)
+    elif (len(rargs) == 3 and isinstance(rargs[2], ast.Constant)
+            and isinstance(rargs[2].value, int) and rargs[2].value > 0):
+        start, stop, step = rargs
+    else:
+        return None  # negative/dynamic step: keep Python semantics
+    init = _assign(ivar, start)
+    test = ast.Compare(left=_name(ivar), ops=[ast.Lt()],
+                       comparators=[stop])
+    bind = ast.Assign(targets=[ast.Name(id=node.target.id,
+                                        ctx=ast.Store())],
+                      value=_name(ivar))
+    bump = ast.AugAssign(target=_name(ivar, ast.Store()),
+                         op=ast.Add(), value=step)
+    return init, test, bind, bump
+
+
+class _LoopEscapeLowerer(ast.NodeTransformer):
+    """Pre-pass: lower return/break/continue INSIDE While/For(range)
+    bodies into escape flags threaded through the loop (ref
+    break_continue_transformer.py + return_transformer.py — the
+    reference reaches the same form with boolean guard variables; here
+    the flags ride the lax.while_loop carry, and the return-value slot
+    is an AutoZero the runtime promotes to a structure-matched zeros
+    init).  Runs bottom-up so nested-loop returns chain outward.
+    Loops whose escapes sit in try blocks, or For loops over non-range
+    iterables, are left unchanged (existing Python/trace behavior)."""
+
+    def __init__(self):
+        self.counter = 0
+
+    def _next(self):
+        self.counter += 1
+        return self.counter
+
+    # nested scopes keep their own control flow
+    def visit_FunctionDef(self, node):
+        return node
+
+    def visit_AsyncFunctionDef(self, node):
+        return node
+
+    def visit_Lambda(self, node):
+        return node
+
+    def visit_ClassDef(self, node):
+        return node
+
+    def _liftable(self, body):
+        has_ret, has_brk, has_cnt = _loop_escapes(body)
+        if not (has_ret or has_brk or has_cnt):
+            return None
+        if _escape_inside_try(body) or _returns_inside_loops(body):
+            # nested loop kept its returns (it was itself unliftable):
+            # rewriting them here would change the inner loop's meaning
+            return None
+        return has_ret, has_brk, has_cnt
+
+    def visit_While(self, node):
+        self.generic_visit(node)
+        esc = self._liftable(node.body)
+        if esc is None:
+            return node
+        return self._lower(node.test, node.body, [], [], node.orelse,
+                           esc)
+
+    def visit_For(self, node):
+        self.generic_visit(node)
+        esc = self._liftable(node.body)
+        if esc is None:
+            return node
+        n = self._next()
+        parts = _range_for_parts(node, f"__d2s_fi{n}")
+        if parts is None:
+            return node
+        init, test, bind, bump = parts
+        out = self._lower(test, node.body, [bind], [bump], node.orelse,
+                          esc)
+        return [init] + out
+
+    def _lower(self, test, body, head, tail, orelse, esc):
+        has_ret, has_brk, has_cnt = esc
+        n = self._next()
+        brk, cnt = f"__d2s_brk{n}", f"__d2s_cnt{n}"
+        ret, rv = f"__d2s_ret{n}", f"__d2s_rv{n}"
+
+        def guard_expr():
+            e = _name(brk)
+            if has_cnt:
+                e = ast.BoolOp(op=ast.Or(),
+                               values=[e, _name(cnt)])
+            return ast.UnaryOp(op=ast.Not(), operand=e)
+
+        flag_names = {brk, cnt, ret}
+
+        def xf(stmts):
+            out = []
+            for i, s in enumerate(stmts):
+                if isinstance(s, ast.Break):
+                    repl = [_assign(brk, ast.Constant(True))]
+                elif isinstance(s, ast.Continue):
+                    repl = [_assign(cnt, ast.Constant(True))]
+                elif isinstance(s, ast.Return):
+                    repl = [_assign(rv, s.value or ast.Constant(None)),
+                            _assign(ret, ast.Constant(True)),
+                            _assign(brk, ast.Constant(True))]
+                else:
+                    if isinstance(s, ast.If):
+                        s.body = xf(s.body)
+                        s.orelse = xf(s.orelse)
+                    elif isinstance(s, ast.With):
+                        s.body = xf(s.body)
+                    repl = [s]
+                out.extend(repl)
+                sets_flag = any(
+                    isinstance(m, ast.Name)
+                    and isinstance(m.ctx, ast.Store)
+                    and m.id in flag_names
+                    for r in repl for m in ast.walk(r))
+                if sets_flag and i + 1 < len(stmts):
+                    out.append(ast.If(test=guard_expr(),
+                                      body=xf(stmts[i + 1:]),
+                                      orelse=[]))
+                    break
+            return out
+
+        new_body = ([_assign(cnt, ast.Constant(False))] if has_cnt
+                    else []) + head + xf(body) + tail
+        new_test = ast.BoolOp(
+            op=ast.And(),
+            values=[ast.UnaryOp(op=ast.Not(), operand=_name(brk)),
+                    test])
+        init = [_assign(brk, ast.Constant(False))]
+        if has_cnt:
+            init.append(_assign(cnt, ast.Constant(False)))
+        if has_ret:
+            init += [_assign(ret, ast.Constant(False)),
+                     _assign(rv, _name("_d2s_auto"))]
+        out = init + [ast.While(test=new_test, body=new_body, orelse=[])]
+        if has_ret:
+            out.append(ast.If(test=_name(ret),
+                              body=[ast.Return(value=_name(rv))],
+                              orelse=[]))
+        if orelse:
+            # while/for-else: runs only when the loop exited without
+            # break (a lowered return also sets brk, and exits above)
+            out.append(ast.If(
+                test=ast.UnaryOp(op=ast.Not(), operand=_name(brk)),
+                body=orelse, orelse=[]))
+        return out
+
+
+def _lower_loop_escapes(body):
+    tr = _LoopEscapeLowerer()
+    new = []
+    for s in body:
+        o = tr.visit(s)
+        new.extend(o if isinstance(o, list) else [o])
+    return new
 
 
 def _ld_tuple(names):
@@ -518,34 +815,13 @@ class _ControlFlowTransformer(ast.NodeTransformer):
     def visit_For(self, node):
         # only `for <name> in range(...)` desugars; everything else stays
         self.generic_visit(node)
-        if (node.orelse or _has_escape(node.body, loop_level=True)
-                or not isinstance(node.target, ast.Name)
-                or not isinstance(node.iter, ast.Call)
-                or not isinstance(node.iter.func, ast.Name)
-                or node.iter.func.id != "range"
-                or node.iter.keywords):
+        if node.orelse or _has_escape(node.body, loop_level=True):
             return node
-        rargs = node.iter.args
-        if len(rargs) == 1:
-            start, stop, step = ast.Constant(0), rargs[0], ast.Constant(1)
-        elif len(rargs) == 2:
-            start, stop, step = rargs[0], rargs[1], ast.Constant(1)
-        elif (len(rargs) == 3 and isinstance(rargs[2], ast.Constant)
-                and isinstance(rargs[2].value, int)
-                and rargs[2].value > 0):
-            start, stop, step = rargs
-        else:
-            return node  # negative/dynamic step: keep Python semantics
         n = self._next()
-        ivar = f"__d2s_i_{n}"
-        init = ast.Assign(targets=[_name(ivar, ast.Store())], value=start)
-        test = ast.Compare(left=_name(ivar), ops=[ast.Lt()],
-                           comparators=[stop])
-        bind = ast.Assign(targets=[ast.Name(id=node.target.id,
-                                            ctx=ast.Store())],
-                          value=_name(ivar))
-        bump = ast.AugAssign(target=_name(ivar, ast.Store()),
-                             op=ast.Add(), value=step)
+        parts = _range_for_parts(node, f"__d2s_i_{n}")
+        if parts is None:
+            return node
+        init, test, bind, bump = parts
         wl = ast.While(test=test, body=[bind] + node.body + [bump],
                        orelse=[])
         out = self.visit_While(wl)
@@ -569,6 +845,9 @@ def rewrite(fn):
     if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
         raise ValueError("to_static target is not a function")
     fdef.decorator_list = []
+    # lower loop-body return/break/continue to escape flags first, so
+    # the early-return normalisation below sees loop-free returns
+    fdef.body = _lower_loop_escapes(fdef.body)
     body_returns = _returns_in(fdef.body)
     non_tail = [r for r in body_returns if r is not (
         fdef.body[-1] if fdef.body else None)]

@@ -344,3 +344,194 @@ def test_early_return_compiles_to_cond():
     jaxpr = str(jax.make_jaxpr(
         lambda a: st(Tensor(a))._value)(np.ones((2,), np.float32)))
     assert "cond" in jaxpr, jaxpr
+
+
+# -- loop escapes: break/continue/return inside loop bodies ------------------
+# (ref break_continue_transformer.py + return_transformer.py)
+
+def test_loop_break_tensor_pred():
+    def fn(x):
+        s = x * 0
+        i = 0
+        while i < 100:
+            s = s + x
+            if s.sum() > 10:
+                break
+            i += 1
+        return s
+
+    _check(fn, _t([3.0]))   # breaks after 4 adds
+    _check(fn, _t([0.01]))  # runs to the count limit
+
+
+def test_loop_break_compiles_to_single_while():
+    import jax
+
+    def fn(x):
+        s = x * 0
+        i = 0
+        while i < 100:
+            s = s + x
+            if s.sum() > 10:
+                break
+            i += 1
+        return s
+
+    rewritten = rewrite(fn)
+    jaxpr = jax.make_jaxpr(
+        lambda a: rewritten(Tensor(a))._value)(np.ones((2,), np.float32))
+    prims = [e.primitive.name for e in jaxpr.jaxpr.eqns]
+    assert prims.count("while") == 1, prims
+
+
+def test_loop_continue_tensor_pred():
+    def fn(x):
+        s = x * 0
+        for i in range(6):
+            if (s + i).sum() > 6:
+                continue
+            s = s + i
+        return s
+
+    _check(fn, _t([0.0]))
+    _check(fn, _t([100.0]))  # continue every iteration
+
+
+def test_loop_return_tensor_pred():
+    def fn(x):
+        s = x * 0
+        i = 0
+        while i < 50:
+            s = s + x
+            if s.sum() > 9:
+                return s * 10
+            i += 1
+        return s - 1
+
+    _check(fn, _t([2.5]))    # returns from inside the loop
+    _check(fn, _t([0.01]))   # falls through to the tail return
+
+
+def test_loop_return_in_for_range():
+    def fn(x):
+        for i in range(8):
+            x = x + 1
+            if x.sum() > 5:
+                return x * 100
+        return x
+
+    _check(fn, _t([3.0]))
+    _check(fn, _t([-100.0]))
+
+
+def test_while_true_traced_break_peels():
+    """`while True` with a tensor-dependent break: the first concrete
+    iteration peels, the rest lower to lax.while_loop."""
+    def fn(x):
+        s = x * 0
+        while True:
+            s = s + x
+            if s.sum() > 4:
+                break
+        return s
+
+    _check(fn, _t([1.5]))
+
+    import jax
+    rewritten = rewrite(fn)
+    jaxpr = jax.make_jaxpr(
+        lambda a: rewritten(Tensor(a))._value)(np.ones((2,), np.float32))
+    assert "while" in [e.primitive.name for e in jaxpr.jaxpr.eqns]
+
+
+def test_nested_loop_return_chains_outward():
+    def fn(x):
+        for i in range(4):
+            j = 0
+            while j < 4:
+                x = x + 1
+                if x.sum() > 10:
+                    return x * 2
+                j += 1
+        return -x
+
+    _check(fn, _t([7.0]))    # inner return fires
+    _check(fn, _t([-90.0]))  # completes both loops
+
+
+def test_loop_else_with_break():
+    def fn(x):
+        i = 0
+        while i < 5:
+            if x.sum() > 3:
+                break
+            i += 1
+        else:
+            x = x + 100
+        return x
+
+    _check(fn, _t([5.0]))   # break -> else skipped
+    _check(fn, _t([1.0]))   # normal exit -> else runs
+
+
+def test_break_statements_after_loop_still_run():
+    def fn(x):
+        total = x * 0
+        i = 0
+        while i < 10:
+            total = total + x
+            if total.sum() > 5:
+                break
+            i += 1
+        total = total * 2     # must run on both exit paths
+        return total
+
+    _check(fn, _t([2.0]))
+    _check(fn, _t([0.1]))
+
+
+def test_loop_escape_no_fallback_warning():
+    import warnings
+
+    def fn(x):
+        s = x * 0
+        i = 0
+        while i < 20:
+            if (s + x).sum() > 3:
+                break
+            s = s + x
+            i += 1
+        return s
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        st = to_static(fn)
+        out = st(_t([1.0]))
+    np.testing.assert_allclose(np.asarray(out.numpy()), [3.0])
+
+
+def test_loop_return_under_jit_compiles():
+    """The whole function (loop + in-loop return) must trace under
+    jax.jit via the AutoZero promotion path."""
+    import jax
+
+    def fn(x):
+        s = x * 0
+        i = 0
+        while i < 30:
+            s = s + x
+            if s.sum() > 9:
+                return s * 10
+            i += 1
+        return s - 1
+
+    rewritten = rewrite(fn)
+
+    @jax.jit
+    def run(a):
+        return rewritten(Tensor(a))._value
+
+    out = run(np.asarray([2.5], np.float32))
+    np.testing.assert_allclose(np.asarray(out), [100.0])
+    out = run(np.asarray([0.01], np.float32))
+    np.testing.assert_allclose(np.asarray(out), [-0.7], rtol=1e-5)

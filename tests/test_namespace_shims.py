@@ -126,6 +126,19 @@ def test_hub_reexported_entrypoint(tmp_path):
     assert layer.weight.shape == [3, 3]
 
 
+def test_hub_sibling_modules_not_cached_across_repos(tmp_path):
+    repos = []
+    for tag in ("one", "two"):
+        repo = tmp_path / f"hub_{tag}"
+        repo.mkdir()
+        (repo / "_impl.py").write_text(
+            f"def which():\n    return '{tag}'\n")
+        (repo / "hubconf.py").write_text("from _impl import which\n")
+        repos.append(str(repo))
+    assert paddle.hub.load(repos[0], "which", source="local") == "one"
+    assert paddle.hub.load(repos[1], "which", source="local") == "two"
+
+
 def test_early_stopping_baseline():
     cb = paddle.callbacks.EarlyStopping(
         monitor="loss", baseline=0.5, patience=1, verbose=0)
