@@ -208,9 +208,15 @@ class HybridParallelEngine:
         embed_fn, head_fn = self.embed_fn, self.head_fn
         mesh = self.mesh
         opt = self.optimizer
-        from ..incubate.asp import masks_for as _masks_for
+        from ..incubate.asp import masks_for as _masks_for, \
+            stacked_masks_for as _stacked_masks_for
 
-        _asp_masks = _masks_for(self.model)
+        # stacked block params re-mask via [S, L/S, ...] stacked masks;
+        # everything else (embeddings/head) by state-dict name
+        _asp_block_masks, _asp_covered = _stacked_masks_for(
+            self.model, self.block_regex, self.num_layers, S)
+        _asp_rest_masks = {k: v for k, v in _masks_for(self.model).items()
+                           if k not in _asp_covered}
 
         from ..core.config import no_tape
 
@@ -293,13 +299,16 @@ class HybridParallelEngine:
             nr, orr = opt.apply_gradients_tree(rest_params, gr,
                                                opt_state["rest"], lr,
                                                metas=rest_metas)
-            if _asp_masks:
+            if _asp_block_masks:
+                nb = {k: (v * _asp_block_masks[k].astype(v.dtype))
+                      if k in _asp_block_masks else v
+                      for k, v in nb.items()}
+            if _asp_rest_masks:
                 from ..incubate.asp import apply_masks_tree
 
-                # rest params keep their state-dict names; stacked block
-                # params trigger the helper's not-visible warning
                 nr = apply_masks_tree(self.model, nr,
-                                      engine_name="HybridParallelEngine")
+                                      engine_name="HybridParallelEngine",
+                                      masks=_asp_rest_masks)
             return loss, nb, nr, {"blocks": ob, "rest": orr}
 
         sh = self._shardings

@@ -57,7 +57,14 @@ def _import_module(name, repo_dir):
         spec.loader.exec_module(module)
     finally:
         sys.path.remove(repo_dir)
-        _hub_loaded_names.update(set(sys.modules) - before)
+        # track only the repo's OWN sibling modules for next-load
+        # purging; third-party imports a hubconf triggers must stay
+        # cached (re-executing them would duplicate class identities)
+        repo_prefix = os.path.abspath(repo_dir) + os.sep
+        for n in set(sys.modules) - before:
+            f = getattr(sys.modules.get(n), "__file__", None) or ""
+            if f and os.path.abspath(f).startswith(repo_prefix):
+                _hub_loaded_names.add(n)
     return module
 
 

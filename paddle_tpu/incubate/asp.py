@@ -125,13 +125,47 @@ def masks_for(layer):
     return out
 
 
-def apply_masks_tree(layer, new_params, *, engine_name="engine"):
+def stacked_masks_for(layer, block_regex, num_layers, num_stages):
+    """Masks for pipeline-STACKED block params (HybridParallelEngine):
+    per-layer masks of params matching `block_regex` (one group for the
+    layer index, one for the within-block name) are stacked in layer
+    order to [L, ...] and folded to [S, L/S, ...], matching the
+    engine's block_params layout.  Unpruned layers of a partially
+    pruned stack get all-ones slices.  Returns (block_masks keyed by
+    within-block name, covered full-name set)."""
+    import re
+
+    pat = re.compile(block_regex)
+    per: dict = {}
+    covered = set()
+    for name, p in layer.state_dict().items():
+        m = pat.match(name)
+        if not m:
+            continue
+        mask = _mask_of(p)
+        if mask is not None:
+            per.setdefault(m.group(2), {})[int(m.group(1))] = mask
+            covered.add(name)
+    out = {}
+    for sub, by_idx in per.items():
+        shape = next(iter(by_idx.values())).shape
+        ones = jnp.ones(shape, jnp.bool_)
+        full = jnp.stack([by_idx.get(i, ones)
+                          for i in range(num_layers)])
+        out[sub] = full.reshape(
+            (num_stages, num_layers // num_stages) + tuple(shape))
+    return out, covered
+
+
+def apply_masks_tree(layer, new_params, *, engine_name="engine",
+                     masks=None):
     """Masking hook shared by ALL compiled engines: re-apply this
     layer's masks to the name-keyed `new_params` tree; warn once when a
     pruned parameter is not visible under its name in the tree (e.g.
-    pipeline-stacked blocks rename it), so sparsity is never silently
-    dropped."""
-    masks = masks_for(layer)
+    pipeline-stacked blocks rename it — pass `masks` with those names
+    already removed after applying their stacked form), so sparsity is
+    never silently dropped."""
+    masks = masks_for(layer) if masks is None else masks
     if not masks:
         return new_params
     missing = [k for k in masks if k not in new_params]

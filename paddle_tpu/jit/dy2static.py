@@ -33,7 +33,11 @@ Mechanics (mirrors the reference's UndefinedVar machinery):
   every read is guarded by the flag);
 - functions using global/nonlocal, escapes inside try blocks, and
   For loops over non-range iterables containing escapes fall back to
-  the trace-based path unchanged (documented gap).
+  the trace-based path unchanged (documented gap);
+- an in-loop `return x` in a function that can also fall off the end
+  (implicit None) cannot trace — the structures differ; the cond join
+  raises a TypeError explaining the fix (concrete inputs still run
+  with exact Python semantics).
 """
 
 from __future__ import annotations
@@ -41,6 +45,7 @@ from __future__ import annotations
 import ast
 import functools
 import inspect
+import re
 import textwrap
 import types
 import warnings
@@ -198,7 +203,18 @@ def convert_ifelse(pred, true_fn, false_fn, ins):
             fs = jax.eval_shape(fb, init)
             tb = _promote_autozero(tb, ts, fs)
             fb = _promote_autozero(fb, fs, ts)
-        out = lax.cond(jnp.reshape(p, ()), tb, fb, init)
+        try:
+            out = lax.cond(jnp.reshape(p, ()), tb, fb, init)
+        except TypeError as e:
+            if "structure" in str(e) or "pytree" in str(e):
+                raise TypeError(
+                    "dy2static: the two paths of a tensor-dependent "
+                    "branch produce different value structures (e.g. a "
+                    "lowered in-loop `return x` joining a fall-off-the-"
+                    "end implicit `return None`). Give every exit path "
+                    "of the function the same structure. Original "
+                    "error: " + str(e)) from e
+            raise
         return _tree_wrap(out)
     return true_fn(*ins) if pb else false_fn(*ins)
 
@@ -229,16 +245,24 @@ def _lax_while(cond_fn, body_fn, ins):
     def body_w(carry):
         return _tree_unwrap(body_fn(*_tree_wrap(carry)))
 
-    if _contains_auto(init):
-        # Materialize compiler-generated AutoZero slots (loop-escape
-        # return values) at the structure the body produces for them.
+    if any(isinstance(a, (_AutoZero, _Undef)) for a in init):
+        # Materialize placeholder carry slots at the structure the body
+        # produces for them: AutoZero (compiler-generated loop-escape
+        # return values) and UNDEF (names first assigned inside the
+        # loop body, e.g. an inner loop's variable — Python would only
+        # raise if the name were READ before assignment, and a
+        # read-before-write still raises here, during eval_shape).
         # Fixed-point iteration: one slot's promotion can concretize
         # another's structure (chained escapes through nested loops).
         for _ in range(8):
             out_s = jax.eval_shape(body_w, init)
             init2, changed = [], False
             for a, b in zip(init, tuple(out_s)):
-                if isinstance(a, _AutoZero) and not _contains_auto(b):
+                if (isinstance(a, (_AutoZero, _Undef))
+                        and not any(isinstance(x, (_AutoZero, _Undef))
+                                    for x in jax.tree_util.tree_leaves(
+                                        b, is_leaf=lambda v: isinstance(
+                                            v, (_AutoZero, _Undef))))):
                     init2.append(_zeros_like_sds(b))
                     changed = True
                 else:
@@ -455,6 +479,34 @@ def _assign(name, value):
     return ast.Assign(targets=[_name(name, ast.Store())], value=value)
 
 
+_GEN_LOCAL_RE = re.compile(r"__d2s_(brk|cnt|ret|rv|fi|i_)\d+$")
+
+
+def _hoist_escape_inits(body, exclude=frozenset()):
+    """Pre-bind compiler-generated escape flags / loop counters stored
+    inside `body` so an ENCLOSING lowered loop's carry has a stable
+    pytree structure (an inner lowered loop initializes them mid-body,
+    which an outer lax.while_loop carry would otherwise capture as
+    UNDEF).  Safe because every generated local is re-initialized
+    before any read within one iteration.  `exclude` skips the loop's
+    OWN counter, whose real init precedes the loop."""
+    inits, seen = [], set(exclude)
+    for n in _walk_scope(body):
+        if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store):
+            m = _GEN_LOCAL_RE.match(n.id)
+            if m and n.id not in seen:
+                seen.add(n.id)
+                kind = m.group(1)
+                if kind == "rv":
+                    v = _name("_d2s_auto")
+                elif kind in ("fi", "i_"):
+                    v = ast.Constant(0)
+                else:
+                    v = ast.Constant(False)
+                inits.append(_assign(n.id, v))
+    return inits
+
+
 def _loop_escapes(body):
     """(has_return, has_break, has_continue) at THIS loop's level:
     returns at any scope depth; break/continue not inside a nested
@@ -571,15 +623,17 @@ class _LoopEscapeLowerer(ast.NodeTransformer):
         if esc is None:
             return node
         n = self._next()
-        parts = _range_for_parts(node, f"__d2s_fi{n}")
+        ivar = f"__d2s_fi{n}"
+        parts = _range_for_parts(node, ivar)
         if parts is None:
             return node
         init, test, bind, bump = parts
         out = self._lower(test, node.body, [bind], [bump], node.orelse,
-                          esc)
+                          esc, exclude=frozenset((ivar,)))
         return [init] + out
 
-    def _lower(self, test, body, head, tail, orelse, esc):
+    def _lower(self, test, body, head, tail, orelse, esc,
+               exclude=frozenset()):
         has_ret, has_brk, has_cnt = esc
         n = self._next()
         brk, cnt = f"__d2s_brk{n}", f"__d2s_cnt{n}"
@@ -611,6 +665,9 @@ class _LoopEscapeLowerer(ast.NodeTransformer):
                         s.orelse = xf(s.orelse)
                     elif isinstance(s, ast.With):
                         s.body = xf(s.body)
+                    elif isinstance(s, ast.Match):
+                        for c in s.cases:
+                            c.body = xf(c.body)
                     repl = [s]
                 out.extend(repl)
                 sets_flag = any(
@@ -631,7 +688,9 @@ class _LoopEscapeLowerer(ast.NodeTransformer):
             op=ast.And(),
             values=[ast.UnaryOp(op=ast.Not(), operand=_name(brk)),
                     test])
-        init = [_assign(brk, ast.Constant(False))]
+        init = _hoist_escape_inits(
+            new_body, exclude | {brk, cnt, ret, rv})
+        init += [_assign(brk, ast.Constant(False))]
         if has_cnt:
             init.append(_assign(cnt, ast.Constant(False)))
         if has_ret:
@@ -791,8 +850,12 @@ class _ControlFlowTransformer(ast.NodeTransformer):
 
     def visit_While(self, node):
         self.generic_visit(node)
+        return self._lower_while(node)
+
+    def _lower_while(self, node, exclude=frozenset()):
         if node.orelse or _has_escape(node.body, loop_level=True):
             return node
+        hoists = _hoist_escape_inits(node.body, exclude)
         carried = _stored_names(node.body)
         n = self._next()
         cname, bname = f"__d2s_cond_{n}", f"__d2s_body_{n}"
@@ -810,7 +873,7 @@ class _ControlFlowTransformer(ast.NodeTransformer):
             assign = ast.Assign(targets=[target], value=call)
         else:
             assign = ast.Expr(value=call)
-        return [cdef, bdef, assign]
+        return hoists + [cdef, bdef, assign]
 
     def visit_For(self, node):
         # only `for <name> in range(...)` desugars; everything else stays
@@ -818,13 +881,14 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         if node.orelse or _has_escape(node.body, loop_level=True):
             return node
         n = self._next()
-        parts = _range_for_parts(node, f"__d2s_i_{n}")
+        ivar = f"__d2s_i_{n}"
+        parts = _range_for_parts(node, ivar)
         if parts is None:
             return node
         init, test, bind, bump = parts
         wl = ast.While(test=test, body=[bind] + node.body + [bump],
                        orelse=[])
-        out = self.visit_While(wl)
+        out = self._lower_while(wl, exclude=frozenset((ivar,)))
         stmts = out if isinstance(out, list) else [out]
         return [init] + stmts
 

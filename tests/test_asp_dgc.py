@@ -93,3 +93,67 @@ def test_dgc_compresses_and_converges():
     assert losses[-1] < losses[2] * 0.8
     # error accumulators hold the unsent residuals after compression
     assert any(np.abs(v).sum() > 0 for v in opt._v.values())
+
+
+def test_asp_masks_on_pipeline_stacked_blocks():
+    """VERDICT gap closure: a pruned model trained through the
+    HybridParallelEngine keeps 2:4 sparsity on the pipeline-STACKED
+    block params (previously warned + dropped)."""
+    import warnings
+
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.hybrid import make_gpt_hybrid_engine
+    from paddle_tpu.distributed.topology import (
+        set_hybrid_communicate_group,
+    )
+    from paddle_tpu.nlp.transformers import (
+        GPTConfig, GPTForPretraining, GPTPretrainingCriterion,
+    )
+
+    try:
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 1,
+                                   "pp_degree": 2, "sharding_degree": 1}
+        fleet.init(is_collective=True, strategy=strategy)
+        hcg = fleet.get_hybrid_communicate_group()
+
+        paddle.seed(0)
+        cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                        num_heads=4, max_seq_len=16, dropout=0.0,
+                        use_parallel=True)
+        model = GPTForPretraining(cfg)
+        asp.reset_excluded_layers()
+        masks = asp.prune_model(model)
+        block_names = [k for k in masks if "gpt.layers." in k]
+        assert block_names, "pruning found no block params"
+
+        crit = GPTPretrainingCriterion(cfg)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=model.parameters())
+        eng = make_gpt_hybrid_engine(model, crit, opt, hcg,
+                                     accumulate_steps=2)
+        toks = np.random.RandomState(1).randint(
+            0, 64, (4, 17)).astype(np.int32)
+        x, y = toks[:, :-1], toks[:, 1:]
+        with warnings.catch_warnings():
+            # the old path warned "ASP: ... NOT enforced" here; other
+            # warnings (flash-under-GSPMD fallback note) are expected
+            warnings.filterwarnings("error", message=".*ASP.*")
+            for _ in range(3):
+                eng.train_batch(x, y)
+
+        from paddle_tpu.incubate.asp import stacked_masks_for
+
+        block_masks, covered = stacked_masks_for(
+            model, r"gpt\.layers\.(\d+)\.(.*)", cfg.num_layers, 2)
+        assert set(covered) == set(block_names)
+        checked = 0
+        for sub, m in block_masks.items():
+            v = np.asarray(eng.block_params[sub])
+            assert v.shape == np.asarray(m).shape
+            assert asp.check_sparsity(v), f"{sub} lost 2:4 sparsity"
+            checked += 1
+        assert checked > 0
+    finally:
+        asp.reset_excluded_layers()
+        set_hybrid_communicate_group(None)

@@ -535,3 +535,87 @@ def test_loop_return_under_jit_compiles():
     np.testing.assert_allclose(np.asarray(out), [100.0])
     out = run(np.asarray([0.01], np.float32))
     np.testing.assert_allclose(np.asarray(out), [-0.7], rtol=1e-5)
+
+
+def test_nested_lowered_loop_inside_traced_while():
+    """Inner lowered-loop escape flags are pre-bound (hoisted), so an
+    OUTER traced while's carry has stable structure."""
+    import jax
+
+    def fn(x):
+        while x.sum() < 100:
+            j = 0
+            while j < 3:
+                x = x + 1
+                if x.sum() > 50:
+                    return x * 2
+                j += 1
+            x = x * 1.5
+        return -x
+
+    _check(fn, _t([1.0]))
+    _check(fn, _t([60.0]))
+
+    rewritten = rewrite(fn)
+    run = jax.jit(lambda a: rewritten(Tensor(a))._value)
+    for v in ([1.0], [60.0], [200.0]):
+        a = np.asarray(v, np.float32)
+        want = fn(Tensor(a.copy()))
+        np.testing.assert_allclose(np.asarray(run(a)),
+                                   np.asarray(want.numpy()), rtol=1e-6)
+
+
+def test_nested_for_inside_traced_while():
+    """A plain nested for-range inside a traced while: the inner
+    counter is hoisted so the outer carry never sees UNDEF."""
+    import jax
+
+    def fn(x):
+        while x.sum() < 10:
+            for j in range(2):
+                x = x + 1
+        return x
+
+    _check(fn, _t([0.0]))
+    rewritten = rewrite(fn)
+    out = jax.jit(lambda a: rewritten(Tensor(a))._value)(
+        np.asarray([0.0], np.float32))
+    np.testing.assert_allclose(np.asarray(out), [10.0])
+
+
+def test_match_case_break_in_lowered_loop():
+    def fn(x):
+        i = 0
+        while i < 5:
+            match i:
+                case 3:
+                    break
+                case _:
+                    x = x + 1
+            i += 1
+        return x
+
+    _check(fn, _t([0.0]))
+
+
+def test_loop_return_fall_off_end_clear_error():
+    """A lowered in-loop return joining the implicit fall-off-the-end
+    None cannot trace; the error must say so (not a raw pytree
+    TypeError). The concrete path still runs fine."""
+    import jax
+    import pytest
+
+    def fn(x):
+        for i in range(5):
+            x = x + 1
+            if x.sum() > 3:
+                return x
+
+    rewritten = rewrite(fn)  # concrete dispatch keeps Python semantics
+    np.testing.assert_allclose(
+        np.asarray(rewritten(_t([3.0])).numpy()), [4.0])
+    assert rewritten(_t([-100.0])) is None         # falls off the end
+
+    with pytest.raises(TypeError, match="dy2static"):
+        jax.jit(lambda a: rewritten(Tensor(a))._value)(
+            np.asarray([3.0], np.float32))

@@ -139,6 +139,30 @@ def test_hub_sibling_modules_not_cached_across_repos(tmp_path):
     assert paddle.hub.load(repos[1], "which", source="local") == "two"
 
 
+def test_hub_purge_spares_external_modules(tmp_path, monkeypatch):
+    """Only the repo's OWN siblings are purged between loads; modules a
+    hubconf imports from elsewhere stay cached (re-executing them would
+    duplicate class identities)."""
+    import sys
+
+    ext_dir = tmp_path / "ext"
+    ext_dir.mkdir()
+    (ext_dir / "hub_ext_dep.py").write_text("MARK = object()\n")
+    monkeypatch.syspath_prepend(str(ext_dir))
+
+    repo = tmp_path / "hubrepo_ext"
+    repo.mkdir()
+    (repo / "hubconf.py").write_text(
+        "import hub_ext_dep\n"
+        "def probe():\n"
+        "    return hub_ext_dep.MARK\n")
+    mark1 = paddle.hub.load(str(repo), "probe", source="local")
+    first = sys.modules["hub_ext_dep"]
+    mark2 = paddle.hub.load(str(repo), "probe", source="local")
+    assert mark1 is mark2                      # same module object
+    assert sys.modules["hub_ext_dep"] is first
+
+
 def test_early_stopping_baseline():
     cb = paddle.callbacks.EarlyStopping(
         monitor="loss", baseline=0.5, patience=1, verbose=0)
