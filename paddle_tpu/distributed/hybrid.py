@@ -299,13 +299,12 @@ class HybridParallelEngine:
             nr, orr = opt.apply_gradients_tree(rest_params, gr,
                                                opt_state["rest"], lr,
                                                metas=rest_metas)
-            if _asp_block_masks:
-                nb = {k: (v * _asp_block_masks[k].astype(v.dtype))
-                      if k in _asp_block_masks else v
-                      for k, v in nb.items()}
-            if _asp_rest_masks:
+            if _asp_block_masks or _asp_rest_masks:
                 from ..incubate.asp import apply_masks_tree
 
+                nb = apply_masks_tree(self.model, nb,
+                                      engine_name="HybridParallelEngine",
+                                      masks=_asp_block_masks)
                 nr = apply_masks_tree(self.model, nr,
                                       engine_name="HybridParallelEngine",
                                       masks=_asp_rest_masks)

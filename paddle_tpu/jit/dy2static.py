@@ -129,6 +129,23 @@ def _zeros_like_sds(t):
     return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), t)
 
 
+def _poison_like_sds(t):
+    """Loud initializer for USER variables first assigned inside a
+    traced loop: if the loop runs zero iterations at runtime, a
+    post-loop read sees NaN (floats) / int-min (ints) instead of the
+    UnboundLocalError eager Python would raise — trace-time lowering
+    cannot raise data-dependently, so make the value propagate visibly
+    rather than silently as zeros."""
+    def fill(s):
+        if jnp.issubdtype(s.dtype, jnp.floating):
+            return jnp.full(s.shape, jnp.nan, s.dtype)
+        if jnp.issubdtype(s.dtype, jnp.integer):
+            return jnp.full(s.shape, jnp.iinfo(s.dtype).min, s.dtype)
+        return jnp.zeros(s.shape, s.dtype)
+
+    return jax.tree.map(fill, t)
+
+
 def _promote_autozero(run, self_shapes, other_shapes):
     """Wrap a traced branch/body so output slots that are AutoZero on
     this side but concrete on the other come out as zeros of the other
@@ -248,22 +265,31 @@ def _lax_while(cond_fn, body_fn, ins):
     if any(isinstance(a, (_AutoZero, _Undef)) for a in init):
         # Materialize placeholder carry slots at the structure the body
         # produces for them: AutoZero (compiler-generated loop-escape
-        # return values) and UNDEF (names first assigned inside the
-        # loop body, e.g. an inner loop's variable — Python would only
-        # raise if the name were READ before assignment, and a
-        # read-before-write still raises here, during eval_shape).
-        # Fixed-point iteration: one slot's promotion can concretize
-        # another's structure (chained escapes through nested loops).
+        # return values; zero-filled — every read is flag-guarded) and
+        # UNDEF (user names first assigned inside the loop body —
+        # poison-filled, so a post-loop read after a zero-trip loop is
+        # loudly NaN, and a read-before-write inside the body still
+        # raises during eval_shape).  Fixed-point iteration: one slot's
+        # promotion can concretize another's structure (chained escapes
+        # through nested loops).
+        def is_ph(v):
+            return isinstance(v, (_AutoZero, _Undef))
+
         for _ in range(8):
             out_s = jax.eval_shape(body_w, init)
             init2, changed = [], False
             for a, b in zip(init, tuple(out_s)):
-                if (isinstance(a, (_AutoZero, _Undef))
-                        and not any(isinstance(x, (_AutoZero, _Undef))
-                                    for x in jax.tree_util.tree_leaves(
-                                        b, is_leaf=lambda v: isinstance(
-                                            v, (_AutoZero, _Undef))))):
-                    init2.append(_zeros_like_sds(b))
+                if is_ph(a) and not any(
+                        is_ph(x) for x in jax.tree_util.tree_leaves(
+                            b, is_leaf=is_ph)):
+                    init2.append(_zeros_like_sds(b)
+                                 if isinstance(a, _AutoZero)
+                                 else _poison_like_sds(b))
+                    changed = True
+                elif isinstance(a, _Undef) and isinstance(b, _AutoZero):
+                    # inner lowered loop whose return never fired in
+                    # this trace: converge the slot to AutoZero
+                    init2.append(AUTOZERO)
                     changed = True
                 else:
                     init2.append(a)
@@ -479,32 +505,6 @@ def _assign(name, value):
     return ast.Assign(targets=[_name(name, ast.Store())], value=value)
 
 
-_GEN_LOCAL_RE = re.compile(r"__d2s_(brk|cnt|ret|rv|fi|i_)\d+$")
-
-
-def _hoist_escape_inits(body, exclude=frozenset()):
-    """Pre-bind compiler-generated escape flags / loop counters stored
-    inside `body` so an ENCLOSING lowered loop's carry has a stable
-    pytree structure (an inner lowered loop initializes them mid-body,
-    which an outer lax.while_loop carry would otherwise capture as
-    UNDEF).  Safe because every generated local is re-initialized
-    before any read within one iteration.  `exclude` skips the loop's
-    OWN counter, whose real init precedes the loop."""
-    inits, seen = [], set(exclude)
-    for n in _walk_scope(body):
-        if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store):
-            m = _GEN_LOCAL_RE.match(n.id)
-            if m and n.id not in seen:
-                seen.add(n.id)
-                kind = m.group(1)
-                if kind == "rv":
-                    v = _name("_d2s_auto")
-                elif kind in ("fi", "i_"):
-                    v = ast.Constant(0)
-                else:
-                    v = ast.Constant(False)
-                inits.append(_assign(n.id, v))
-    return inits
 
 
 def _loop_escapes(body):
@@ -629,11 +629,10 @@ class _LoopEscapeLowerer(ast.NodeTransformer):
             return node
         init, test, bind, bump = parts
         out = self._lower(test, node.body, [bind], [bump], node.orelse,
-                          esc, exclude=frozenset((ivar,)))
+                          esc)
         return [init] + out
 
-    def _lower(self, test, body, head, tail, orelse, esc,
-               exclude=frozenset()):
+    def _lower(self, test, body, head, tail, orelse, esc):
         has_ret, has_brk, has_cnt = esc
         n = self._next()
         brk, cnt = f"__d2s_brk{n}", f"__d2s_cnt{n}"
@@ -688,9 +687,7 @@ class _LoopEscapeLowerer(ast.NodeTransformer):
             op=ast.And(),
             values=[ast.UnaryOp(op=ast.Not(), operand=_name(brk)),
                     test])
-        init = _hoist_escape_inits(
-            new_body, exclude | {brk, cnt, ret, rv})
-        init += [_assign(brk, ast.Constant(False))]
+        init = [_assign(brk, ast.Constant(False))]
         if has_cnt:
             init.append(_assign(cnt, ast.Constant(False)))
         if has_ret:
@@ -850,12 +847,8 @@ class _ControlFlowTransformer(ast.NodeTransformer):
 
     def visit_While(self, node):
         self.generic_visit(node)
-        return self._lower_while(node)
-
-    def _lower_while(self, node, exclude=frozenset()):
         if node.orelse or _has_escape(node.body, loop_level=True):
             return node
-        hoists = _hoist_escape_inits(node.body, exclude)
         carried = _stored_names(node.body)
         n = self._next()
         cname, bname = f"__d2s_cond_{n}", f"__d2s_body_{n}"
@@ -873,7 +866,7 @@ class _ControlFlowTransformer(ast.NodeTransformer):
             assign = ast.Assign(targets=[target], value=call)
         else:
             assign = ast.Expr(value=call)
-        return hoists + [cdef, bdef, assign]
+        return [cdef, bdef, assign]
 
     def visit_For(self, node):
         # only `for <name> in range(...)` desugars; everything else stays
@@ -881,14 +874,13 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         if node.orelse or _has_escape(node.body, loop_level=True):
             return node
         n = self._next()
-        ivar = f"__d2s_i_{n}"
-        parts = _range_for_parts(node, ivar)
+        parts = _range_for_parts(node, f"__d2s_i_{n}")
         if parts is None:
             return node
         init, test, bind, bump = parts
         wl = ast.While(test=test, body=[bind] + node.body + [bump],
                        orelse=[])
-        out = self._lower_while(wl, exclude=frozenset((ivar,)))
+        out = self.visit_While(wl)
         stmts = out if isinstance(out, list) else [out]
         return [init] + stmts
 

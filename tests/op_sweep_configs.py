@@ -2526,6 +2526,21 @@ bilinear_interp_v2 nearest_interp_v2 grid_sampler roi_align pixel_shuffle
 unfold temporal_shift
 lerp dist cross logaddexp elementwise_mul elementwise_div
 linear_chain_crf warpctc solve cholesky det slogdet
+assign broadcast_to broadcast_tensors concat diag diag_embed diagonal
+einsum tensordot elementwise_add elementwise_sub minus neg scale sum
+expand expand_as expand_as_v2 expand_v2 flatten flatten2 flip gather
+gather_nd getitem index_sample index_select masked_fill meshgrid moveaxis
+pad pad2d pad3d pad_constant_like partial_concat partial_sum
+repeat_interleave reshape reshape2 reverse roll rot90 slice slice_op
+split squeeze squeeze2 stack strided_slice swapaxes take_along_axis tile
+trace trace_op transpose transpose2 tril triu tril_triu unbind unsqueeze
+unsqueeze2 unstack where space_to_depth shuffle_channel im2sequence
+scatter scatter_nd_add lookup_table_v2
+acos acosh asin asinh atanh tan digamma erfinv i0 cumprod matrix_power
+inverse fsp rank_loss local_response_norm lrn p_norm
+bilinear_interp linear_interp linear_interp_v2
+trilinear_interp trilinear_interp_v2 bicubic_interp bicubic_interp_v2
+nearest_interp interpolate affine_grid pool2d pool3d
 """.split()}
 # attention kernels sum many products: loosen for f32 fd roundoff
 FD_OPS["flash_attention"].update(rtol=8e-2, atol=4e-2)

@@ -619,3 +619,52 @@ def test_loop_return_fall_off_end_clear_error():
     with pytest.raises(TypeError, match="dy2static"):
         jax.jit(lambda a: rewritten(Tensor(a))._value)(
             np.asarray([3.0], np.float32))
+
+
+def test_escape_for_range_nonzero_start():
+    """Regression: the lowered for-range counter must keep its real
+    start (a hoisting bug once reset it to 0)."""
+    def fn(x):
+        for i in range(2, 5):
+            x = x + 1
+            if x.sum() > 100:
+                break
+        return x
+
+    _check(fn, _t([0.0]))   # 3 iterations, not 5
+    rewritten = rewrite(fn)
+    np.testing.assert_allclose(
+        np.asarray(rewritten(_t([0.0])).numpy()), [3.0])
+
+    import jax
+    out = jax.jit(lambda a: rewritten(Tensor(a))._value)(
+        np.asarray([0.0], np.float32))
+    np.testing.assert_allclose(np.asarray(out), [3.0])
+
+
+def test_zero_trip_traced_loop_poisons_undef_read():
+    """A name assigned only inside a zero-trip traced loop reads as NaN
+    (loud), not silently zero — eager Python would raise
+    UnboundLocalError, which a traced program cannot."""
+    import jax
+    import pytest
+
+    def fn(x):
+        while x.sum() < 0:
+            y = x + 1
+            x = x + 2
+        return y
+
+    rewritten = rewrite(fn)
+    # concrete path: the UNDEF sentinel comes back; any USE raises the
+    # UnboundLocalError eager Python would have raised at `return y`
+    undef = rewritten(_t([5.0]))
+    with pytest.raises(UnboundLocalError):
+        undef + 1
+    out = jax.jit(lambda a: rewritten(Tensor(a))._value)(
+        np.asarray([5.0], np.float32))
+    assert np.isnan(np.asarray(out)).all()
+    # and when the loop DOES run, the real value comes through
+    out = jax.jit(lambda a: rewritten(Tensor(a))._value)(
+        np.asarray([-5.0], np.float32))
+    np.testing.assert_allclose(np.asarray(out), [0.0])
