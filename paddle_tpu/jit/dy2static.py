@@ -45,7 +45,6 @@ from __future__ import annotations
 import ast
 import functools
 import inspect
-import re
 import textwrap
 import types
 import warnings

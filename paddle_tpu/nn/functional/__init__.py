@@ -174,6 +174,43 @@ def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
     return out
 
 
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1,
+                     data_format="NCL", name=None):
+    def _one(v):
+        return v if isinstance(v, int) else v[0]
+
+    if data_format == "NLC":
+        x = x.transpose([0, 2, 1])
+    pad = padding if isinstance(padding, str) \
+        else (0, _one(padding))
+    out = apply("conv2d_transpose", x.unsqueeze(2),
+                weight.unsqueeze(2) if hasattr(weight, "unsqueeze")
+                else weight[:, :, None, :],
+                stride=(1, _one(stride)), padding=pad,
+                output_padding=(0, _one(output_padding)),
+                dilation=(1, _one(dilation)), groups=groups)
+    out = out.squeeze(2)
+    if bias is not None:
+        out = out + bias.reshape([1, -1, 1])
+    if data_format == "NLC":
+        out = out.transpose([0, 2, 1])
+    return out
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1,
+                     data_format="NCDHW", name=None):
+    out = apply("conv3d_transpose", x, weight, stride=stride,
+                padding=padding, output_padding=output_padding,
+                dilation=dilation, groups=groups, data_format=data_format)
+    if bias is not None:
+        shape = [1, -1, 1, 1, 1] if data_format == "NCDHW" \
+            else [1, 1, 1, 1, -1]
+        out = out + bias.reshape(shape)
+    return out
+
+
 # -- pooling ----------------------------------------------------------------
 
 
@@ -228,6 +265,74 @@ def avg_pool1d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                 stride=(1, s if s is not None else k), padding=(0, p),
                 ceil_mode=ceil_mode, pooling_type="avg", exclusive=exclusive)
     return out.squeeze(2)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, data_format="NCDHW", name=None):
+    out = apply("pool3d", x, ksize=kernel_size, stride=stride,
+                padding=padding, ceil_mode=ceil_mode, pooling_type="max",
+                data_format=data_format)
+    return (out, None) if return_mask else out
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW",
+               name=None):
+    return apply("pool3d", x, ksize=kernel_size, stride=stride,
+                 padding=padding, ceil_mode=ceil_mode, pooling_type="avg",
+                 exclusive=exclusive, data_format=data_format)
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    out = apply("pool2d", x.unsqueeze(2), ksize=(1, output_size),
+                adaptive=True, pooling_type="avg")
+    return out.squeeze(2)
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    out = apply("pool2d", x.unsqueeze(2), ksize=(1, output_size),
+                adaptive=True, pooling_type="max").squeeze(2)
+    return (out, None) if return_mask else out
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return apply("pool3d", x, ksize=output_size, adaptive=True,
+                 pooling_type="avg", data_format=data_format)
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    out = apply("pool3d", x, ksize=output_size, adaptive=True,
+                pooling_type="max")
+    return (out, None) if return_mask else out
+
+
+def maxout(x, groups, axis=1, name=None):
+    return apply("maxout", x, groups=groups, axis=axis)
+
+
+def thresholded_relu(x, threshold=1.0, name=None):
+    return apply("thresholded_relu", x, threshold=threshold)
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    """ref dist_op.cc usage in PairwiseDistance: p-norm of x - y + eps
+    along the last axis."""
+    d = (x - y).abs() + epsilon
+    if p == float("inf"):
+        out = d.max(axis=-1, keepdim=keepdim)
+    elif p == 0:
+        out = (d != 0).astype(d.dtype).sum(axis=-1, keepdim=keepdim)
+    else:
+        out = (d ** p).sum(axis=-1, keepdim=keepdim) ** (1.0 / p)
+    return out
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    # returns per-sample [N, 1] losses unreduced (reference semantics)
+    return apply("hierarchical_sigmoid", input, weight, label, bias,
+                 path_table, path_code, num_classes=num_classes)
 
 
 # -- normalisation ----------------------------------------------------------
@@ -315,6 +420,39 @@ def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
         (x.shape[0], 1, 1, x.shape[3])
     mask = jax.random.bernoulli(key, 1.0 - p, shape)
     return x * Tensor(mask.astype(x._value.dtype)) / (1.0 - p)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    """Whole-channel dropout over 5-D input (ref nn/functional/common.py
+    dropout3d)."""
+    if not training or p == 0.0:
+        return x
+    import jax
+
+    key = _random.next_key()
+    shape = (x.shape[0], x.shape[1], 1, 1, 1) if data_format == "NCDHW" \
+        else (x.shape[0], 1, 1, 1, x.shape[4])
+    mask = jax.random.bernoulli(key, 1.0 - p, shape)
+    return x * Tensor(mask.astype(x._value.dtype)) / (1.0 - p)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    """SELU-preserving dropout (ref nn/functional/common.py
+    alpha_dropout): dropped units take alpha', then an affine correction
+    restores mean/variance."""
+    if not training or p == 0.0:
+        return x
+    import jax
+
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+    a = ((1 - p) * (1 + p * alpha_p ** 2)) ** -0.5
+    b = -a * alpha_p * p
+    key = _random.next_key()
+    keep = jax.random.bernoulli(key, 1.0 - p, x.shape)
+    keep = Tensor(keep.astype(x._value.dtype))
+    return (x * keep + alpha_p * (1 - keep)) * a + b
 
 
 # -- embedding --------------------------------------------------------------

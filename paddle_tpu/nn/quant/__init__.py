@@ -1,0 +1,59 @@
+"""paddle.nn.quant — QAT layer surface (ref: python/paddle/nn/quant/).
+
+The quantization machinery itself lives in paddle_tpu.quantization
+(observers, fake-quant rewrite, int8 freeze); this namespace exposes it
+under the reference's layer names, plus the FloatFunctionalLayer
+wrappers quant-aware graphs use for non-layer math.
+"""
+
+from __future__ import annotations
+
+from ...quantization import (  # noqa: F401
+    QuantedConv2D,
+    QuantedLinear,
+    QuantizedConv2DInt8,
+    QuantizedLinearInt8,
+    _MovingAverageObserver as MovingAverageAbsMaxScale,
+)
+
+# reference class names for the trainable fake-quant wrappers
+QuantizedConv2D = QuantedConv2D
+QuantizedLinear = QuantedLinear
+
+from ..layer.layers import Layer  # noqa: E402
+
+
+class FloatFunctionalLayer(Layer):
+    """Base for functional ops as layers (ref quant/functional_layers.py)
+    so activation observers can hook non-layer math."""
+
+
+def _functional(name):
+    class _Op(FloatFunctionalLayer):
+        def forward(self, x, y=None, *args, **kwargs):
+            import paddle_tpu as paddle
+
+            fn = getattr(paddle, name)
+            if y is None:
+                return fn(x, *args, **kwargs)
+            return fn(x, y, *args, **kwargs)
+
+    _Op.__name__ = name
+    return _Op
+
+
+add = _functional("add")
+subtract = _functional("subtract")
+multiply = _functional("multiply")
+divide = _functional("divide")
+reshape = _functional("reshape")
+transpose = _functional("transpose")
+concat = _functional("concat")
+flatten = _functional("flatten")
+
+__all__ = [
+    "FloatFunctionalLayer", "QuantizedConv2D", "QuantizedLinear",
+    "QuantizedConv2DInt8", "QuantizedLinearInt8",
+    "MovingAverageAbsMaxScale", "add", "subtract", "multiply", "divide",
+    "reshape", "transpose", "concat", "flatten",
+]

@@ -1,0 +1,127 @@
+"""paddle.nn.utils — weight/spectral norm reparameterization hooks
+(ref: python/paddle/nn/utils/{weight_norm_hook,spectral_norm_hook}.py).
+
+Both rewrite an existing layer's weight parameter into derived form and
+recompute the effective weight in a forward-pre-hook with TAPED tensor
+ops, so gradients flow to the derived parameters (g/v, weight_orig) and
+the layer's own forward stays untouched.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ...core.tensor import Parameter, Tensor
+
+__all__ = ["weight_norm", "remove_weight_norm", "spectral_norm"]
+
+
+def _norm_except_t(v, dim):
+    """Taped L2 norm of Tensor `v` over every axis except `dim`,
+    keepdims for broadcasting."""
+    axes = [i for i in range(len(v.shape)) if i != dim]
+    return ((v * v).sum(axis=axes, keepdim=True)) ** 0.5
+
+
+def weight_norm(layer, name="weight", dim=0):
+    """Reparameterize ``layer.<name>`` as g * v / ||v|| (ref
+    weight_norm_hook.py).  Adds ``<name>_g`` / ``<name>_v`` parameters
+    and recomputes the weight before every forward."""
+    w = getattr(layer, name)
+    wv = w._value
+    d = None if dim is None else dim % wv.ndim
+    if d is None:
+        g0 = jnp.sqrt(jnp.sum(jnp.square(wv)))
+    else:
+        axes = tuple(i for i in range(wv.ndim) if i != d)
+        g0 = jnp.sqrt(jnp.sum(jnp.square(wv), axis=axes, keepdims=True))
+    g = Parameter(np.asarray(g0))
+    v = Parameter(np.asarray(wv))
+    del layer._parameters[name]
+    layer.add_parameter(name + "_g", g)
+    layer.add_parameter(name + "_v", v)
+
+    def hook(lyr, inputs):
+        vv = getattr(lyr, name + "_v")
+        gg = getattr(lyr, name + "_g")
+        if d is None:
+            nrm = ((vv * vv).sum()) ** 0.5
+        else:
+            nrm = _norm_except_t(vv, d)
+        object.__setattr__(lyr, name, vv * (gg / nrm))
+        return None
+
+    handle = layer.register_forward_pre_hook(hook)
+    layer._weight_norm_state = (name, dim, handle, hook)
+    hook(layer, None)  # materialize immediately (parity: eager access)
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    """Fold g*v/||v|| back into a plain parameter (ref
+    weight_norm_hook.py remove_weight_norm)."""
+    state = getattr(layer, "_weight_norm_state", None)
+    if state is None or state[0] != name:
+        raise ValueError(f"weight_norm not applied to '{name}'")
+    _, dim, handle, hook = state
+    hook(layer, None)  # recompute from CURRENT g/v (post-step values)
+    w = getattr(layer, name)
+    handle.remove()
+    del layer._parameters[name + "_g"]
+    del layer._parameters[name + "_v"]
+    layer.__dict__.pop(name, None)  # drop the hook-computed shadow attr
+    layer.add_parameter(name, Parameter(np.asarray(w._value)))
+    del layer._weight_norm_state
+    return layer
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
+                  dim=None):
+    """Divide ``layer.<name>`` by its largest singular value, estimated
+    by power iteration on persistent u/v buffers (ref
+    spectral_norm_hook.py).  The u/v iteration runs untaped (buffers);
+    sigma = u^T W v is taped so gradients reach ``<name>_orig``."""
+    w = getattr(layer, name)
+    wv = w._value
+    d = 0 if dim is None else dim % wv.ndim
+    h = wv.shape[d]
+    rng = np.random.default_rng(0)
+    u0 = rng.standard_normal(h).astype(np.float32)
+    u0 /= max(np.linalg.norm(u0), eps)
+    wmat_cols = int(np.prod(wv.shape)) // h
+    v0 = rng.standard_normal(wmat_cols).astype(np.float32)
+    v0 /= max(np.linalg.norm(v0), eps)
+    orig = Parameter(np.asarray(wv))
+    del layer._parameters[name]
+    layer.add_parameter(name + "_orig", orig)
+    layer.register_buffer(name + "_u", Tensor(u0))
+    layer.register_buffer(name + "_v", Tensor(v0))
+
+    def _l2(x):
+        return x / jnp.maximum(jnp.linalg.norm(x), eps)
+
+    def hook(lyr, inputs):
+        worig = getattr(lyr, name + "_orig")
+        wraw = worig._value
+        wmat = jnp.moveaxis(wraw, d, 0).reshape(h, -1)
+        u = getattr(lyr, name + "_u")._value
+        v = getattr(lyr, name + "_v")._value
+        for _ in range(max(1, n_power_iterations)):
+            v = _l2(wmat.T @ u)
+            u = _l2(wmat @ v)
+        getattr(lyr, name + "_u")._value = u
+        getattr(lyr, name + "_v")._value = v
+        # taped sigma: sum over W * (u v^T) mapped back to W's layout
+        uvT = jnp.moveaxis(
+            jnp.outer(u, v).reshape((h,) + tuple(
+                s for i, s in enumerate(wraw.shape) if i != d)), 0, d)
+        sigma = (worig * Tensor(uvT)).sum()
+        object.__setattr__(lyr, name, worig / sigma)
+        return None
+
+    handle = layer.register_forward_pre_hook(hook)
+    layer._spectral_norm_state = (name, handle)
+    hook(layer, None)
+    return layer

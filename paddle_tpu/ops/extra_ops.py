@@ -408,13 +408,16 @@ def spectral_norm(weight, u, v, *, dim=0, power_iters=1, eps=1e-12):
 @register_op("pool3d")
 def pool3d(x, *, ksize, stride=None, padding=0, pooling_type="max",
            ceil_mode=False, exclusive=True, global_pooling=False,
-           data_format="NCDHW"):
+           adaptive=False, data_format="NCDHW"):
     """ref pool_op.cc 3-D variant (NCDHW/NDHWC, ceil_mode extends hi
     padding so partial windows are produced, paddle semantics)."""
     x = jnp.asarray(x)
     channel_last = data_format == "NDHWC"
     if channel_last:
         x = jnp.moveaxis(x, -1, 1)
+    if adaptive:
+        out = _adaptive_pool3d(x, ksize, pooling_type)
+        return jnp.moveaxis(out, 1, -1) if channel_last else out
     if global_pooling:
         axes = (2, 3, 4)
         out = (jnp.max(x, axes, keepdims=True) if pooling_type == "max"
@@ -449,6 +452,108 @@ def pool3d(x, *, ksize, stride=None, padding=0, pooling_type="max",
 
             out = summed / _np.prod(ks)
     return jnp.moveaxis(out, 1, -1) if channel_last else out
+
+
+def _adaptive_pool3d(x, output_size, mode):
+    """Adaptive 3-D pooling over NCDHW input (ref pool_op.cc adaptive
+    attr).  Divisible dims collapse to a strided reduce_window; uneven
+    dims use paddle's floor/ceil bin bounds, unrolled as static slices
+    (output sizes are small compile-time constants)."""
+    os3 = tuple(output_size) if isinstance(output_size, (list, tuple)) \
+        else (output_size,) * 3
+    d, h, w = x.shape[2:]
+    if d % os3[0] == 0 and h % os3[1] == 0 and w % os3[2] == 0:
+        ks = (d // os3[0], h // os3[1], w // os3[2])
+        window, strides = (1, 1) + ks, (1, 1) + ks
+        if mode == "max":
+            return lax.reduce_window(x, -jnp.inf, lax.max, window,
+                                     strides, [(0, 0)] * 5)
+        summed = lax.reduce_window(x, 0.0, lax.add, window, strides,
+                                   [(0, 0)] * 5)
+        import numpy as _np
+
+        return summed / _np.prod(ks)
+    red = jnp.max if mode == "max" else jnp.mean
+
+    def bounds(i, dim, out):
+        return (i * dim) // out, -(-((i + 1) * dim) // out)
+
+    planes = []
+    for i in range(os3[0]):
+        s0, e0 = bounds(i, d, os3[0])
+        rows = []
+        for j in range(os3[1]):
+            s1, e1 = bounds(j, h, os3[1])
+            cols = [red(x[:, :, s0:e0, s1:e1,
+                          bounds(k, w, os3[2])[0]:bounds(k, w, os3[2])[1]],
+                        axis=(2, 3, 4))
+                    for k in range(os3[2])]
+            rows.append(jnp.stack(cols, axis=-1))
+        planes.append(jnp.stack(rows, axis=-2))
+    return jnp.stack(planes, axis=-3)
+
+
+@register_op("maxout")
+def maxout(x, *, groups, axis=1):
+    """ref maxout_op.cc: split the channel axis into `groups`-sized
+    chunks and take the elementwise max."""
+    x = jnp.asarray(x)
+    axis = axis % x.ndim
+    c = x.shape[axis]
+    if c % groups != 0:
+        raise ValueError(f"channels {c} not divisible by groups {groups}")
+    shape = x.shape[:axis] + (c // groups, groups) + x.shape[axis + 1:]
+    return jnp.max(x.reshape(shape), axis=axis + 1)
+
+
+@register_op("thresholded_relu")
+def thresholded_relu(x, *, threshold=1.0):
+    """ref thresholded_relu_op.cc: x if x > threshold else 0."""
+    x = jnp.asarray(x)
+    return jnp.where(x > threshold, x, jnp.zeros_like(x))
+
+
+@register_op("hierarchical_sigmoid")
+def hierarchical_sigmoid(x, w, label, bias=None, path_table=None,
+                         path_code=None, *, num_classes):
+    """ref hierarchical_sigmoid_op.cc: hierarchical sigmoid loss.
+
+    Default tree: classes are leaves of a heap-numbered complete binary
+    tree (leaf id = label + num_classes, root = 1); the loss walks the
+    root->leaf path, scoring internal node n with weight row n-1 and
+    sign from the branch bit.  Custom trees pass path_table (node rows,
+    -1 padded) and path_code (branch bits).  Returns [N, 1] losses."""
+    x = jnp.asarray(x)
+    lbl = jnp.asarray(label).reshape(-1).astype(jnp.int32)
+    if path_table is not None:
+        nodes = jnp.asarray(path_table).astype(jnp.int32)
+        bits = jnp.asarray(path_code).astype(jnp.float32)
+        valid = (nodes >= 0)
+        nodes_safe = jnp.maximum(nodes, 0)
+    else:
+        import numpy as _np
+
+        depth = max(int(_np.ceil(_np.log2(num_classes))), 1)
+        leaf = lbl + num_classes              # heap leaf index
+        # bits of `leaf` below its MSB, walked MSB-first; internal node
+        # visited at step k is leaf >> (depth - k)
+        ks = jnp.arange(depth, 0, -1)
+        anc = leaf[:, None] >> ks[None, :]    # [N, depth] ancestors
+        valid = anc >= 1
+        nodes_safe = jnp.maximum(anc - 1, 0)  # weight row = node - 1
+        bits = ((leaf[:, None] >> (ks[None, :] - 1)) & 1).astype(
+            jnp.float32)
+    wrows = jnp.take(jnp.asarray(w), nodes_safe, axis=0)  # [N, L, F]
+    logit = jnp.einsum("nlf,nf->nl", wrows.astype(jnp.float32),
+                       x.astype(jnp.float32))
+    if bias is not None:
+        logit = logit + jnp.take(jnp.asarray(bias).reshape(-1),
+                                 nodes_safe)
+    # bit==1 -> right branch -> sigmoid(+logit); paddle codes bits as
+    # (1 - 2*code)*logit inside log(1+exp(.)) == softplus
+    z = jnp.where(bits > 0.5, -logit, logit)
+    losses = jnp.where(valid, jax.nn.softplus(z), 0.0)
+    return jnp.sum(losses, axis=1, keepdims=True)
 
 
 @register_op("pad3d")

@@ -1,0 +1,265 @@
+"""3-D/adaptive pooling, transpose convs, small activations/losses, and
+nn.utils norm hooks.
+
+Ref parity: python/paddle/nn/layer/{pooling,conv,activation,common,
+loss}.py + nn/utils/{weight_norm_hook,spectral_norm_hook}.py +
+operators/{maxout_op,thresholded_relu_op,hierarchical_sigmoid_op}.cc.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.core.tensor import Tensor
+
+pytestmark = pytest.mark.smoke
+
+
+def _t(a):
+    return Tensor(np.asarray(a, np.float32))
+
+
+def _rand(*shape, seed=0):
+    return np.random.RandomState(seed).randn(*shape).astype(np.float32)
+
+
+# -- 3-D / adaptive pooling --------------------------------------------------
+
+def test_max_avg_pool3d_shapes_and_values():
+    x = _rand(2, 3, 4, 4, 4)
+    out = F.max_pool3d(_t(x), 2, 2)
+    assert list(out.shape) == [2, 3, 2, 2, 2]
+    want = x.reshape(2, 3, 2, 2, 2, 2, 2, 2).transpose(
+        0, 1, 2, 4, 6, 3, 5, 7).reshape(2, 3, 2, 2, 2, -1).max(-1)
+    np.testing.assert_allclose(np.asarray(out.numpy()), want, rtol=1e-6)
+    avg = F.avg_pool3d(_t(x), 2, 2)
+    wanta = x.reshape(2, 3, 2, 2, 2, 2, 2, 2).transpose(
+        0, 1, 2, 4, 6, 3, 5, 7).reshape(2, 3, 2, 2, 2, -1).mean(-1)
+    np.testing.assert_allclose(np.asarray(avg.numpy()), wanta, rtol=1e-6)
+
+
+def test_adaptive_pool3d_uneven_bins():
+    x = _rand(1, 2, 5, 7, 6)
+    out = nn.AdaptiveAvgPool3D((2, 3, 4))(_t(x))
+    assert list(out.shape) == [1, 2, 2, 3, 4]
+    # paddle bin bounds: start floor(i*L/out), end ceil((i+1)*L/out)
+    s0, e0 = 0, -(-5 // 2)  # first D bin: [0, 3)
+    np.testing.assert_allclose(
+        np.asarray(out.numpy())[0, 0, 0, 0, 0],
+        x[0, 0, s0:e0, 0:3, 0:2].mean(), rtol=1e-6)
+    mx = nn.AdaptiveMaxPool3D(2)(_t(x))
+    assert list(mx.shape) == [1, 2, 2, 2, 2]
+
+
+def test_adaptive_pool1d():
+    x = _rand(2, 3, 12)
+    out = nn.AdaptiveAvgPool1D(4)(_t(x))
+    np.testing.assert_allclose(
+        np.asarray(out.numpy()), x.reshape(2, 3, 4, 3).mean(-1),
+        rtol=1e-6)
+    mx = nn.AdaptiveMaxPool1D(3)(_t(x))
+    np.testing.assert_allclose(
+        np.asarray(mx.numpy()), x.reshape(2, 3, 3, 4).max(-1), rtol=1e-6)
+
+
+# -- transpose convolutions --------------------------------------------------
+
+def test_conv1d_transpose_matches_conv2d_transpose():
+    paddle.seed(0)
+    layer = nn.Conv1DTranspose(3, 5, 4, stride=2, padding=1)
+    x = _rand(2, 3, 10, seed=1)
+    out = layer(_t(x))
+    assert list(out.shape) == [2, 5, 20]
+    # torch-checked formula: L_out = (L-1)*s - 2p + k
+    assert out.shape[2] == (10 - 1) * 2 - 2 * 1 + 4
+
+
+def test_conv3d_transpose_shape_and_grad():
+    paddle.seed(0)
+    layer = nn.Conv3DTranspose(2, 4, 3, stride=2)
+    x = _t(_rand(1, 2, 3, 4, 5, seed=2))
+    out = layer(x)
+    assert list(out.shape) == [1, 4, 7, 9, 11]
+    out.sum().backward()
+    assert layer.weight.grad is not None
+
+
+# -- activations / distances -------------------------------------------------
+
+def test_maxout():
+    x = _rand(2, 6, 3, 3)
+    out = nn.Maxout(3)(_t(x))
+    want = x.reshape(2, 2, 3, 3, 3).max(2)
+    np.testing.assert_allclose(np.asarray(out.numpy()), want, rtol=1e-6)
+
+
+def test_thresholded_relu():
+    x = np.array([[-1.0, 0.5, 1.0, 2.5]], np.float32)
+    out = nn.ThresholdedReLU(1.0)(_t(x))
+    np.testing.assert_allclose(np.asarray(out.numpy()),
+                               [[0.0, 0.0, 0.0, 2.5]])
+
+
+def test_pairwise_distance():
+    x, y = _rand(3, 5, seed=3), _rand(3, 5, seed=4)
+    out = nn.PairwiseDistance(p=2.0)(_t(x), _t(y))
+    want = np.linalg.norm(np.abs(x - y) + 1e-6, axis=-1)
+    np.testing.assert_allclose(np.asarray(out.numpy()), want, rtol=1e-5)
+
+
+def test_alpha_dropout_moments_and_eval():
+    layer = nn.AlphaDropout(p=0.3)
+    layer.eval()
+    x = _t(_rand(4, 8))
+    np.testing.assert_array_equal(np.asarray(layer(x).numpy()),
+                                  np.asarray(x.numpy()))
+    layer.train()
+    paddle.seed(7)
+    big = _t(_rand(512, 512, seed=5))
+    out = np.asarray(layer(big).numpy())
+    # SELU-preserving: mean~0, var~1 for standard-normal input
+    assert abs(out.mean()) < 0.05
+    assert abs(out.std() - 1.0) < 0.1
+
+
+def test_dropout3d_drops_whole_channels():
+    layer = nn.Dropout3D(p=0.5)
+    layer.train()
+    paddle.seed(11)
+    x = _t(np.ones((2, 8, 3, 3, 3), np.float32))
+    out = np.asarray(layer(x).numpy())
+    per_channel = out.reshape(2, 8, -1)
+    for b in range(2):
+        for c in range(8):
+            vals = np.unique(per_channel[b, c])
+            assert len(vals) == 1  # whole channel kept or dropped
+
+
+# -- losses ------------------------------------------------------------------
+
+def test_ctc_loss_layer():
+    logits = _t(_rand(6, 2, 5, seed=6))
+    labels = Tensor(np.array([[1, 2], [2, 3]], np.int32))
+    loss = nn.CTCLoss()(logits, labels,
+                        Tensor(np.array([6, 6], np.int32)),
+                        Tensor(np.array([2, 2], np.int32)))
+    assert np.asarray(loss.numpy()).shape == ()
+    assert float(loss.numpy()) > 0
+
+
+def test_hsigmoid_loss_default_tree():
+    paddle.seed(0)
+    hs = nn.HSigmoidLoss(8, 6)
+    x = _t(_rand(4, 8, seed=7))
+    label = Tensor(np.array([[0], [1], [4], [5]], np.int32))
+    loss = hs(x, label)
+    # reference semantics: per-sample [N, 1] losses, unreduced
+    assert list(loss.shape) == [4, 1]
+    assert (np.asarray(loss.numpy()) > 0).all()
+    loss.mean().backward()
+    assert hs.weight.grad is not None
+    # a confident model drives the loss down: fit one batch
+    opt = paddle.optimizer.Adam(learning_rate=0.1,
+                                parameters=hs.parameters())
+    first = None
+    for _ in range(30):
+        out = hs(x, label).mean()
+        out.backward()
+        opt.step()
+        opt.clear_grad()
+        first = first if first is not None else float(out.numpy())
+    assert float(out.numpy()) < first * 0.2
+
+
+def test_hsigmoid_loss_custom_path():
+    hs = nn.HSigmoidLoss(8, 5, is_custom=True)
+    pt = Tensor(np.array([[0, 1, -1], [0, 2, 3]], np.int32))
+    pc = Tensor(np.array([[1, 0, 0], [0, 1, 1]], np.float32))
+    x = _t(_rand(2, 8, seed=8))
+    loss = hs(x, Tensor(np.array([[1], [2]], np.int32)), pt, pc)
+    assert list(loss.shape) == [2, 1]
+    assert (np.asarray(loss.numpy()) > 0).all()
+    with pytest.raises(ValueError):
+        hs(x, Tensor(np.array([[1], [2]], np.int32)))
+
+
+# -- nn.utils hooks ----------------------------------------------------------
+
+def test_weight_norm_roundtrip_and_grads():
+    paddle.seed(0)
+    lin = nn.Linear(6, 4)
+    x = _t(_rand(2, 6, seed=9))
+    ref = np.asarray(lin(x).numpy())
+    nn.utils.weight_norm(lin, dim=0)
+    names = dict(lin.named_parameters())
+    assert any(k.endswith("weight_g") for k in names)
+    np.testing.assert_allclose(np.asarray(lin(x).numpy()), ref,
+                               rtol=1e-5)
+    lin(x).sum().backward()
+    assert lin.weight_g.grad is not None
+    assert lin.weight_v.grad is not None
+    nn.utils.remove_weight_norm(lin)
+    np.testing.assert_allclose(np.asarray(lin(x).numpy()), ref,
+                               rtol=1e-5)
+    assert not any(k.endswith("weight_g")
+                   for k in dict(lin.named_parameters()))
+
+
+def test_remove_weight_norm_after_optimizer_step():
+    """Folding must use the CURRENT g/v, not the last-materialized
+    weight from the previous forward."""
+    paddle.seed(1)
+    lin = nn.Linear(4, 3)
+    x = _t(_rand(2, 4, seed=11))
+    nn.utils.weight_norm(lin)
+    opt = paddle.optimizer.SGD(learning_rate=0.5,
+                               parameters=lin.parameters())
+    lin(x).sum().backward()
+    opt.step()          # g/v updated; no forward ran since
+    opt.clear_grad()
+    want = np.asarray(lin(x).numpy())   # effective post-step output
+    nn.utils.remove_weight_norm(lin)
+    np.testing.assert_allclose(np.asarray(lin(x).numpy()), want,
+                               rtol=1e-6)
+
+
+def test_conv1d_transpose_nlc_layout():
+    paddle.seed(0)
+    w = _t(_rand(3, 5, 4, seed=12))
+    x = _rand(2, 3, 10, seed=13)
+    ncl = F.conv1d_transpose(_t(x), w, stride=2)
+    nlc = F.conv1d_transpose(_t(x.transpose(0, 2, 1)), w, stride=2,
+                             data_format="NLC")
+    np.testing.assert_allclose(
+        np.asarray(nlc.numpy()).transpose(0, 2, 1),
+        np.asarray(ncl.numpy()), rtol=1e-5)
+
+
+def test_weight_norm_dim_none_scalar_g():
+    lin = nn.Linear(5, 3)
+    nn.utils.weight_norm(lin, dim=None)
+    assert np.asarray(lin.weight_g.numpy()).shape == ()
+
+
+def test_spectral_norm_hook_normalizes():
+    paddle.seed(0)
+    lin = nn.Linear(6, 4)
+    with np.errstate(all="ignore"):
+        nn.utils.spectral_norm(lin, n_power_iterations=5)
+    x = _t(_rand(2, 6, seed=10))
+    lin(x)  # runs hook, updates u/v, recomputes weight
+    s = np.linalg.svd(np.asarray(lin.weight._value),
+                      compute_uv=False)[0]
+    assert abs(s - 1.0) < 0.05
+    lin(x).sum().backward()
+    assert lin.weight_orig.grad is not None
+
+
+def test_nn_quant_namespace():
+    q = nn.quant
+    out = q.add()(_t([1.0, 2.0]), _t([3.0, 4.0]))
+    np.testing.assert_allclose(np.asarray(out.numpy()), [4.0, 6.0])
+    assert q.QuantizedLinear is not None
+    assert nn.spectral_norm is nn.utils.spectral_norm
