@@ -182,8 +182,14 @@ def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
 
     if data_format == "NLC":
         x = x.transpose([0, 2, 1])
-    pad = padding if isinstance(padding, str) \
-        else (0, _one(padding))
+    if isinstance(padding, str):
+        pad = padding
+    elif (isinstance(padding, (list, tuple)) and len(padding) == 2
+            and all(isinstance(p, int) for p in padding)):
+        # [pad_left, pad_right] asymmetric form -> explicit pairs
+        pad = [(0, 0), (padding[0], padding[1])]
+    else:
+        pad = (0, _one(padding))
     out = apply("conv2d_transpose", x.unsqueeze(2),
                 weight.unsqueeze(2) if hasattr(weight, "unsqueeze")
                 else weight[:, :, None, :],
@@ -316,8 +322,8 @@ def thresholded_relu(x, threshold=1.0, name=None):
 
 def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
     """ref dist_op.cc usage in PairwiseDistance: p-norm of x - y + eps
-    along the last axis."""
-    d = (x - y).abs() + epsilon
+    along the last axis (eps added to the SIGNED difference)."""
+    d = (x - y + epsilon).abs()
     if p == float("inf"):
         out = d.max(axis=-1, keepdim=keepdim)
     elif p == 0:

@@ -35,13 +35,17 @@ def weight_norm(layer, name="weight", dim=0):
     if d is None:
         g0 = jnp.sqrt(jnp.sum(jnp.square(wv)))
     else:
+        # 1-D [k] parameter, matching the reference's norm_except_dim
+        # output shape (state-dict parity with reference checkpoints)
         axes = tuple(i for i in range(wv.ndim) if i != d)
-        g0 = jnp.sqrt(jnp.sum(jnp.square(wv), axis=axes, keepdims=True))
+        g0 = jnp.sqrt(jnp.sum(jnp.square(wv), axis=axes))
     g = Parameter(np.asarray(g0))
     v = Parameter(np.asarray(wv))
     del layer._parameters[name]
     layer.add_parameter(name + "_g", g)
     layer.add_parameter(name + "_v", v)
+    bshape = None if d is None else [
+        wv.shape[d] if i == d else 1 for i in range(wv.ndim)]
 
     def hook(lyr, inputs):
         vv = getattr(lyr, name + "_v")
@@ -50,6 +54,7 @@ def weight_norm(layer, name="weight", dim=0):
             nrm = ((vv * vv).sum()) ** 0.5
         else:
             nrm = _norm_except_t(vv, d)
+            gg = gg.reshape(bshape)
         object.__setattr__(lyr, name, vv * (gg / nrm))
         return None
 
@@ -85,7 +90,13 @@ def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
     sigma = u^T W v is taped so gradients reach ``<name>_orig``."""
     w = getattr(layer, name)
     wv = w._value
-    d = 0 if dim is None else dim % wv.ndim
+    if dim is None:
+        # reference default: dim 1 for Linear / Conv*DTranspose (weight
+        # layout [in, out, ...]), else 0 (out-channel-major layouts)
+        cls = type(layer).__name__
+        dim = 1 if (cls == "Linear" or "Transpose" in cls) \
+            and wv.ndim > 1 else 0
+    d = dim % wv.ndim
     h = wv.shape[d]
     rng = np.random.default_rng(0)
     u0 = rng.standard_normal(h).astype(np.float32)

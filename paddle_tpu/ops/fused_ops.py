@@ -57,6 +57,24 @@ def _mm(a, b, ca: int, cb: int):
                            preferred_element_type=jnp.float32)
 
 
+def _ld(ref, sl=None):
+    """Load a (rows, d) tile from a q/k/v/o-style (1, n, d) ref.
+
+    NOTE on layouts: a zero-copy packed-QKV kernel ([b, s, 3, h, d]
+    operand sliced by BlockSpec index maps) was tried and REVERTED —
+    Mosaic requires a block's last two dims to tile the (sublane, lane)
+    plane, so with `h`(=12) second-to-last the spec cannot lower; the
+    bhsd transposes around the kernel are load-bearing for TPU tiling."""
+    if sl is None:
+        sl = slice(None)
+    return ref[0, sl, :]
+
+
+def _st(ref, val):
+    """Store a (rows, d) tile (see _ld)."""
+    ref[0] = val
+
+
 def _cdiv(a: int, b: int) -> int:
     return -(-a // b)
 
@@ -178,16 +196,16 @@ def _fwd_kernel(qpos_ref, bhpos_ref, seed_ref, q_ref, k_ref, v_ref,
                 o_ref, lse_ref, *, scale, causal, kv_len, block_k,
                 causal_off, dropout_p):
     # q_ref: (1, bq, d), k/v_ref: (1, sk, d), o_ref: (1, bq, d),
-    # lse_ref: (1, bq, 128) — lse broadcast along a lane dim because TPU
-    # blocks need the last two dims (8,128)-aligned (same layout as the
-    # jax.experimental.pallas.ops.tpu.flash_attention scratch).
-    bq, d = q_ref.shape[1], q_ref.shape[2]
+    # lse_ref: (1, bq, 8) — per-row lse broadcast along a SMALL lane dim
+    # (Mosaic pads lanes to 128 in VMEM, but HBM stores/loads only 8
+    # lanes — 16x less traffic than a 128-lane broadcast).
+    bq, d = q_ref.shape[1], q_ref.shape[-1]
     sk = k_ref.shape[1]
     nk = sk // block_k
     # operands stay bf16: the MXU natively multiplies bf16 with f32
     # accumulation — casting to f32 first halves matmul throughput. The
     # softmax scale moves onto the f32 scores instead of onto q.
-    q = q_ref[0]
+    q = _ld(q_ref)
     # block offset arrives via an SMEM input: pl.program_id fails to
     # re-trace under nested AD (jax 0.9), positions-as-data does not
     q_off = qpos_ref[0, 0, 0]
@@ -197,8 +215,8 @@ def _fwd_kernel(qpos_ref, bhpos_ref, seed_ref, q_ref, k_ref, v_ref,
 
     def body(t, carry):
         acc, m_i, l_i = carry
-        k = k_ref[0, pl.dslice(t * block_k, block_k), :]
-        v = v_ref[0, pl.dslice(t * block_k, block_k), :]
+        k = _ld(k_ref, pl.dslice(t * block_k, block_k))
+        v = _ld(v_ref, pl.dslice(t * block_k, block_k))
         s = _mm(q, k, 1, 1) * scale
         k_idx = t * block_k + lax.broadcasted_iota(
             jnp.int32, (bq, block_k), 1)
@@ -228,7 +246,7 @@ def _fwd_kernel(qpos_ref, bhpos_ref, seed_ref, q_ref, k_ref, v_ref,
     l0 = jnp.zeros((bq,), jnp.float32)
     acc, m_i, l_i = lax.fori_loop(0, nk, body, (acc0, m0, l0))
     l_safe = jnp.where(l_i == 0.0, 1.0, l_i)
-    o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+    _st(o_ref, (acc / l_safe[:, None]).astype(o_ref.dtype))
     lse_ref[0] = jnp.broadcast_to((m_i + jnp.log(l_safe))[:, None],
                                   lse_ref.shape[1:])
 
@@ -292,11 +310,11 @@ def _flash_fwd_pallas(q, k, v, seed, scale, causal, dropout_p):
         ],
         out_specs=[
             bspec((1, bq, d), lambda i, j: (i, j, 0)),
-            bspec((1, bq, 128), lambda i, j: (i, j, 0)),
+            bspec((1, bq, 8), lambda i, j: (i, j, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, sq_pad, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, sq_pad, 128), jnp.float32),
+            jax.ShapeDtypeStruct((bh, sq_pad, 8), jnp.float32),
         ],
         compiler_params=_compiler_params(("parallel", "parallel")),
         interpret=_interpret(),
@@ -316,11 +334,11 @@ def _bwd_dq_kernel(qpos_ref, kpos_ref, bhpos_ref, seed_ref, q_ref, k_ref,
     # 3-D grid (bh, q block, k block): the k dim is innermost/sequential
     # and accumulates into an f32 VMEM scratch, so VMEM use is bounded
     # by the BLOCK sizes, not the sequence length.
-    # lse_ref/delta_ref: (1, bq, 128) lane-broadcast (see _fwd_kernel)
+    # lse_ref/delta_ref: (1, bq, 8) lane-broadcast (see _fwd_kernel)
     bq = q_ref.shape[1]
     bk = k_ref.shape[1]
-    q = q_ref[0]
-    do = do_ref[0]
+    q = _ld(q_ref)
+    do = _ld(do_ref)
     lse = lse_ref[0, :, 0]
     delta = delta_ref[0, :, 0]
     q_off = qpos_ref[0, 0, 0]
@@ -334,8 +352,8 @@ def _bwd_dq_kernel(qpos_ref, kpos_ref, bhpos_ref, seed_ref, q_ref, k_ref,
 
     q_idx = q_off + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
     k_idx = k_off + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-    k = k_ref[0]
-    v = v_ref[0]
+    k = _ld(k_ref)
+    v = _ld(v_ref)
     s = _mm(q, k, 1, 1) * scale
     mask = k_idx < kv_len
     if causal:
@@ -350,7 +368,7 @@ def _bwd_dq_kernel(qpos_ref, kpos_ref, bhpos_ref, seed_ref, q_ref, k_ref,
 
     @pl.when(k_off == last_k_off)
     def _done():
-        dq_ref[0] = (acc_ref[...] * scale).astype(dq_ref.dtype)
+        _st(dq_ref, (acc_ref[...] * scale).astype(dq_ref.dtype))
 
 
 def _bwd_dkv_kernel(kpos_ref, qpos_ref, bhpos_ref, seed_ref, q_ref,
@@ -360,8 +378,8 @@ def _bwd_dkv_kernel(kpos_ref, qpos_ref, bhpos_ref, seed_ref, q_ref,
     # 3-D grid (bh, k block, q block), q innermost/sequential
     bk = k_ref.shape[1]
     bq = q_ref.shape[1]
-    k = k_ref[0]
-    v = v_ref[0]
+    k = _ld(k_ref)
+    v = _ld(v_ref)
     k_off = kpos_ref[0, 0, 0]
     q_off = qpos_ref[0, 0, 0]
     bh_idx = bhpos_ref[0, 0, 0]
@@ -372,8 +390,8 @@ def _bwd_dkv_kernel(kpos_ref, qpos_ref, bhpos_ref, seed_ref, q_ref,
         dk_acc[...] = jnp.zeros_like(dk_acc)
         dv_acc[...] = jnp.zeros_like(dv_acc)
 
-    q = q_ref[0]
-    do = do_ref[0]
+    q = _ld(q_ref)
+    do = _ld(do_ref)
     lse = lse_ref[0, :, 0]
     delta = delta_ref[0, :, 0]
     s = _mm(q, k, 1, 1) * scale
@@ -402,8 +420,8 @@ def _bwd_dkv_kernel(kpos_ref, qpos_ref, bhpos_ref, seed_ref, q_ref,
 
     @pl.when(q_off == last_q_off)
     def _done():
-        dk_ref[0] = (dk_acc[...] * scale).astype(dk_ref.dtype)
-        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
+        _st(dk_ref, (dk_acc[...] * scale).astype(dk_ref.dtype))
+        _st(dv_ref, dv_acc[...].astype(dv_ref.dtype))
 
 
 def _flash_bwd_pallas(q, k, v, o, lse, do, seed, scale, causal,
@@ -421,10 +439,10 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, seed, scale, causal,
     dop = jnp.pad(do, ((0, 0), (0, sq_pad - sq), (0, 0)))
     lsep = jnp.broadcast_to(
         jnp.pad(lse, ((0, 0), (0, sq_pad - sq)))[..., None],
-        (bh, sq_pad, 128))
+        (bh, sq_pad, 8))
     deltap = jnp.broadcast_to(
         jnp.pad(delta, ((0, 0), (0, sq_pad - sq)))[..., None],
-        (bh, sq_pad, 128))
+        (bh, sq_pad, 8))
     bspec = lambda shape, imap: pl.BlockSpec(  # noqa: E731
         shape, imap, memory_space=pltpu.VMEM)
     qpos, bhpos, _, _, _ = _pos_inputs(bh, nq, bq)
@@ -449,8 +467,8 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, seed, scale, causal,
             bspec((1, bk, d), lambda i, j, t: (i, t, 0)),
             bspec((1, bk, d), lambda i, j, t: (i, t, 0)),
             bspec((1, bq, d), lambda i, j, t: (i, j, 0)),
-            bspec((1, bq, 128), lambda i, j, t: (i, j, 0)),
-            bspec((1, bq, 128), lambda i, j, t: (i, j, 0)),
+            bspec((1, bq, 8), lambda i, j, t: (i, j, 0)),
+            bspec((1, bq, 8), lambda i, j, t: (i, j, 0)),
         ],
         out_specs=bspec((1, bq, d), lambda i, j, t: (i, j, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, sq_pad, d), q.dtype),
@@ -475,8 +493,8 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, seed, scale, causal,
             bspec((1, bk, d), lambda i, j, t: (i, j, 0)),
             bspec((1, bk, d), lambda i, j, t: (i, j, 0)),
             bspec((1, bq, d), lambda i, j, t: (i, t, 0)),
-            bspec((1, bq, 128), lambda i, j, t: (i, t, 0)),
-            bspec((1, bq, 128), lambda i, j, t: (i, t, 0)),
+            bspec((1, bq, 8), lambda i, j, t: (i, t, 0)),
+            bspec((1, bq, 8), lambda i, j, t: (i, t, 0)),
         ],
         out_specs=[
             bspec((1, bk, d), lambda i, j, t: (i, j, 0)),

@@ -105,7 +105,8 @@ def test_thresholded_relu():
 def test_pairwise_distance():
     x, y = _rand(3, 5, seed=3), _rand(3, 5, seed=4)
     out = nn.PairwiseDistance(p=2.0)(_t(x), _t(y))
-    want = np.linalg.norm(np.abs(x - y) + 1e-6, axis=-1)
+    # eps is added to the SIGNED difference (reference semantics)
+    want = np.linalg.norm(x - y + 1e-6, axis=-1)
     np.testing.assert_allclose(np.asarray(out.numpy()), want, rtol=1e-5)
 
 
@@ -223,6 +224,35 @@ def test_remove_weight_norm_after_optimizer_step():
     nn.utils.remove_weight_norm(lin)
     np.testing.assert_allclose(np.asarray(lin(x).numpy()), want,
                                rtol=1e-6)
+
+
+def test_conv1d_transpose_asymmetric_padding():
+    w = _t(_rand(3, 5, 4, seed=14))
+    x = _t(_rand(2, 3, 8, seed=15))
+    out = F.conv1d_transpose(x, w, stride=2, padding=[1, 2])
+    # L_out = (L-1)*s + k - pad_lo - pad_hi = 7*2 + 4 - 3 = 15
+    assert out.shape[2] == 15
+    sym = F.conv1d_transpose(x, w, stride=2, padding=1)
+    assert sym.shape[2] == 16
+
+
+def test_weight_norm_g_is_1d():
+    conv = nn.Conv2D(3, 8, 3)
+    nn.utils.weight_norm(conv, dim=0)
+    # reference norm_except_dim shape: 1-D [k], not keepdims
+    assert list(np.asarray(conv.weight_g.numpy()).shape) == [8]
+    x = _t(_rand(1, 3, 8, 8, seed=16))
+    assert conv(x).shape[1] == 8
+
+
+def test_spectral_norm_default_dim_linear_vs_conv():
+    # Linear/Conv*DTranspose default to dim=1 (reference), others dim=0
+    lin = nn.Linear(6, 4)
+    nn.utils.spectral_norm(lin)
+    assert lin.weight_u.shape[0] == 4      # out axis of [in, out]
+    conv = nn.Conv2D(3, 8, 3)
+    nn.utils.spectral_norm(conv)
+    assert conv.weight_u.shape[0] == 8     # out axis of [out, in, kh, kw]
 
 
 def test_conv1d_transpose_nlc_layout():
