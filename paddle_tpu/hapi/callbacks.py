@@ -166,15 +166,21 @@ class EarlyStopping(Callback):
         self.wait = 0
         self.best = None
         self.stopped_epoch = 0
+        self._epoch = 0
         self.better = _improvement_cmp(mode, monitor, self.min_delta)
 
     def on_train_begin(self, logs=None):
         self.wait = 0
         self.stopped_epoch = 0
+        self._epoch = 0
         # A baseline is a bar the metric must clear, not a best value to
         # update: a run that never beats it accrues wait every eval
         # (reference hapi/callbacks.py EarlyStopping.on_train_begin).
         self.best = self.baseline
+
+    def on_epoch_begin(self, epoch=None, logs=None):
+        if epoch is not None:
+            self._epoch = epoch
 
     def on_eval_end(self, logs=None):
         cur = _monitored_value(logs or {}, self.monitor)
@@ -190,10 +196,14 @@ class EarlyStopping(Callback):
             self.wait += 1
             if self.wait >= self.patience:
                 self.model.stop_training = True
+                # stopped_epoch is the 0-based epoch that triggered the
+                # stop, taken from on_epoch_begin — NOT an eval counter
+                # (the reference counts evals here, hapi/callbacks.py:838,
+                # which miscounts under eval_freq != 1; deliberate fix)
+                self.stopped_epoch = self._epoch
                 if self.verbose:
                     print(f"Epoch {self.stopped_epoch + 1}: "
                           "Early stopping.")
-        self.stopped_epoch += 1
 
 
 class LRScheduler(Callback):

@@ -538,8 +538,10 @@ def _escape_inside_try(body):
 
 def _range_for_parts(node, ivar):
     """Decompose `for <name> in range(...)` into (init, test, bind,
-    bump) statements over loop counter `ivar`, or None if the iterable
-    is not a supported range call."""
+    bump) over loop counter `ivar`, or None if the iterable is not a
+    supported range call.  `init` is a statement list: Python evaluates
+    range() bounds exactly once, so non-constant bounds are snapshotted
+    into a hidden temp there rather than re-evaluated by the test."""
     if (not isinstance(node.target, ast.Name)
             or not isinstance(node.iter, ast.Call)
             or not isinstance(node.iter.func, ast.Name)
@@ -556,7 +558,11 @@ def _range_for_parts(node, ivar):
         start, stop, step = rargs
     else:
         return None  # negative/dynamic step: keep Python semantics
-    init = _assign(ivar, start)
+    init = [_assign(ivar, start)]
+    if not isinstance(stop, ast.Constant):
+        svar = ivar + "_stop"
+        init.append(_assign(svar, stop))
+        stop = _name(svar)
     test = ast.Compare(left=_name(ivar), ops=[ast.Lt()],
                        comparators=[stop])
     bind = ast.Assign(targets=[ast.Name(id=node.target.id,
@@ -629,7 +635,7 @@ class _LoopEscapeLowerer(ast.NodeTransformer):
         init, test, bind, bump = parts
         out = self._lower(test, node.body, [bind], [bump], node.orelse,
                           esc)
-        return [init] + out
+        return init + out
 
     def _lower(self, test, body, head, tail, orelse, esc):
         has_ret, has_brk, has_cnt = esc
@@ -881,7 +887,7 @@ class _ControlFlowTransformer(ast.NodeTransformer):
                        orelse=[])
         out = self.visit_While(wl)
         stmts = out if isinstance(out, list) else [out]
-        return [init] + stmts
+        return init + stmts
 
 
 # ---------------------------------------------------------------------------

@@ -222,12 +222,18 @@ def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
 
 def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                return_mask=False, data_format="NCHW", name=None):
-    out = apply("pool2d", x, ksize=kernel_size, stride=stride,
-                padding=padding, ceil_mode=ceil_mode, pooling_type="max",
-                data_format=data_format)
     if return_mask:
-        return out, None
-    return out
+        # ref pool_with_index_op.cc: mask = argmax flat index into H*W
+        if ceil_mode or data_format != "NCHW" or isinstance(padding, str):
+            raise NotImplementedError(
+                "max_pool2d(return_mask=True) supports NCHW with "
+                "numeric padding and no ceil_mode (reference "
+                "pool_with_index constraint)")
+        return apply("max_pool2d_with_index", x, ksize=kernel_size,
+                     stride=stride, padding=padding)
+    return apply("pool2d", x, ksize=kernel_size, stride=stride,
+                 padding=padding, ceil_mode=ceil_mode, pooling_type="max",
+                 data_format=data_format)
 
 
 def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
@@ -244,9 +250,11 @@ def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
 
 
 def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
-    out = apply("pool2d", x, ksize=output_size, adaptive=True,
-                pooling_type="max")
-    return (out, None) if return_mask else out
+    if return_mask:
+        return apply("max_pool2d_with_index", x, ksize=output_size,
+                     adaptive=True)
+    return apply("pool2d", x, ksize=output_size, adaptive=True,
+                 pooling_type="max")
 
 
 def max_pool1d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
@@ -254,9 +262,23 @@ def max_pool1d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
     x4 = x.unsqueeze(2)
     k = kernel_size if isinstance(kernel_size, int) else kernel_size[0]
     s = stride if stride is None or isinstance(stride, int) else stride[0]
-    p = padding if isinstance(padding, int) else padding[0]
+    # "SAME"/"VALID" pass through whole; numeric padding pads W only
+    pad = padding if isinstance(padding, str) else \
+        (0, padding if isinstance(padding, int) else padding[0])
+    if return_mask:
+        if ceil_mode or isinstance(padding, str):
+            raise NotImplementedError(
+                "max_pool1d(return_mask=True) needs numeric padding "
+                "and no ceil_mode (reference pool_with_index "
+                "constraint)")
+        # on the (1, L) map abs_y == 0, so the flat index IS the
+        # position along L
+        out, idx = apply("max_pool2d_with_index", x4, ksize=(1, k),
+                         stride=(1, s if s is not None else k),
+                         padding=pad)
+        return out.squeeze(2), idx.squeeze(2)
     out = apply("pool2d", x4, ksize=(1, k),
-                stride=(1, s if s is not None else k), padding=(0, p),
+                stride=(1, s if s is not None else k), padding=pad,
                 ceil_mode=ceil_mode, pooling_type="max")
     return out.squeeze(2)
 
@@ -266,19 +288,27 @@ def avg_pool1d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
     x4 = x.unsqueeze(2)
     k = kernel_size if isinstance(kernel_size, int) else kernel_size[0]
     s = stride if stride is None or isinstance(stride, int) else stride[0]
-    p = padding if isinstance(padding, int) else padding[0]
+    pad = padding if isinstance(padding, str) else \
+        (0, padding if isinstance(padding, int) else padding[0])
     out = apply("pool2d", x4, ksize=(1, k),
-                stride=(1, s if s is not None else k), padding=(0, p),
+                stride=(1, s if s is not None else k), padding=pad,
                 ceil_mode=ceil_mode, pooling_type="avg", exclusive=exclusive)
     return out.squeeze(2)
 
 
 def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                return_mask=False, data_format="NCDHW", name=None):
-    out = apply("pool3d", x, ksize=kernel_size, stride=stride,
-                padding=padding, ceil_mode=ceil_mode, pooling_type="max",
-                data_format=data_format)
-    return (out, None) if return_mask else out
+    if return_mask:
+        if ceil_mode or data_format != "NCDHW" or isinstance(padding, str):
+            raise NotImplementedError(
+                "max_pool3d(return_mask=True) supports NCDHW with "
+                "numeric padding and no ceil_mode (reference "
+                "pool_with_index constraint)")
+        return apply("max_pool3d_with_index", x, ksize=kernel_size,
+                     stride=stride, padding=padding)
+    return apply("pool3d", x, ksize=kernel_size, stride=stride,
+                 padding=padding, ceil_mode=ceil_mode, pooling_type="max",
+                 data_format=data_format)
 
 
 def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
@@ -296,9 +326,12 @@ def adaptive_avg_pool1d(x, output_size, name=None):
 
 
 def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
-    out = apply("pool2d", x.unsqueeze(2), ksize=(1, output_size),
-                adaptive=True, pooling_type="max").squeeze(2)
-    return (out, None) if return_mask else out
+    if return_mask:
+        out, idx = apply("max_pool2d_with_index", x.unsqueeze(2),
+                         ksize=(1, output_size), adaptive=True)
+        return out.squeeze(2), idx.squeeze(2)
+    return apply("pool2d", x.unsqueeze(2), ksize=(1, output_size),
+                 adaptive=True, pooling_type="max").squeeze(2)
 
 
 def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
@@ -307,9 +340,11 @@ def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
 
 
 def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
-    out = apply("pool3d", x, ksize=output_size, adaptive=True,
-                pooling_type="max")
-    return (out, None) if return_mask else out
+    if return_mask:
+        return apply("max_pool3d_with_index", x, ksize=output_size,
+                     adaptive=True)
+    return apply("pool3d", x, ksize=output_size, adaptive=True,
+                 pooling_type="max")
 
 
 def maxout(x, groups, axis=1, name=None):

@@ -474,9 +474,7 @@ def _adaptive_pool3d(x, output_size, mode):
 
         return summed / _np.prod(ks)
     red = jnp.max if mode == "max" else jnp.mean
-
-    def bounds(i, dim, out):
-        return (i * dim) // out, -(-((i + 1) * dim) // out)
+    from .nn_ops import adaptive_bounds as bounds
 
     planes = []
     for i in range(os3[0]):

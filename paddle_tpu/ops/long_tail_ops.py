@@ -497,31 +497,23 @@ def unpool(x, indices, *, ksize, stride=None, padding=0,
 
 
 @register_op("max_pool3d_with_index", has_aux=True)
-def max_pool3d_with_index(x, *, ksize, stride=None, padding=0):
-    """ref pool_with_index_op.cc (3-D): windows via patch extraction,
-    argmax flat index into the input D*H*W map."""
-    kd, kh, kw = (ksize,) * 3 if isinstance(ksize, int) else tuple(ksize)
-    st = (kd, kh, kw) if stride is None else (
+def max_pool3d_with_index(x, *, ksize, stride=None, padding=0,
+                          adaptive=False):
+    """ref pool_with_index_op.cc (3-D): argmax flat index into the
+    input D*H*W map; adaptive branch uses per-cell
+    [floor(i*D/oD), ceil((i+1)*D/oD)) windows.  Both paths share the
+    N-D helpers in nn_ops."""
+    from .nn_ops import (adaptive_max_pool_with_index_nd,
+                         max_pool_with_index_nd)
+
+    if adaptive:
+        os = (ksize,) * 3 if isinstance(ksize, int) else tuple(ksize)
+        return adaptive_max_pool_with_index_nd(x, os)
+    ks = (ksize,) * 3 if isinstance(ksize, int) else tuple(ksize)
+    st = ks if stride is None else (
         (stride,) * 3 if isinstance(stride, int) else tuple(stride))
     pd = (padding,) * 3 if isinstance(padding, int) else tuple(padding)
-    n, c, d, h, w = x.shape
-    patches = lax.conv_general_dilated_patches(
-        x, (kd, kh, kw), st, [(pd[0], pd[0]), (pd[1], pd[1]),
-                              (pd[2], pd[2])],
-        dimension_numbers=lax.conv_dimension_numbers(
-            x.shape, (1, c, kd, kh, kw),
-            ("NCDHW", "OIDHW", "NCDHW")))
-    od, oh, ow = patches.shape[2:]
-    patches = patches.reshape(n, c, kd * kh * kw, od, oh, ow)
-    out = jnp.max(patches, axis=2)
-    rel = jnp.argmax(patches, axis=2)
-    oz = jnp.arange(od).reshape(od, 1, 1)
-    oy = jnp.arange(oh).reshape(1, oh, 1)
-    ox = jnp.arange(ow).reshape(1, 1, ow)
-    az = oz * st[0] - pd[0] + rel // (kh * kw)
-    ay = oy * st[1] - pd[1] + (rel // kw) % kh
-    ax = ox * st[2] - pd[2] + rel % kw
-    return out, (az * h * w + ay * w + ax).astype(jnp.int32)
+    return max_pool_with_index_nd(x, ks, st, pd)
 
 
 @register_op("prroi_pool")

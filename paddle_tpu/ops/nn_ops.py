@@ -227,6 +227,13 @@ def pool2d(x, *, ksize, stride=None, padding=0, ceil_mode=False,
                    exclusive, data_format)
 
 
+def adaptive_bounds(i, size, bins):
+    """Paddle adaptive-pool bin i over `size` elements in `bins` cells:
+    [floor(i*size/bins), ceil((i+1)*size/bins)) — shared by every
+    adaptive pool so values and masks can never disagree."""
+    return (i * size) // bins, -(-((i + 1) * size) // bins)
+
+
 def _adaptive_pool2d(x, output_size, mode, data_format):
     os = _pair(output_size)
     axes = (2, 3) if data_format == "NCHW" else (1, 2)
@@ -242,10 +249,11 @@ def _adaptive_pool2d(x, output_size, mode, data_format):
     red = jnp.max if mode == "max" else jnp.mean
     rows = []
     for i in range(os[0]):
-        s0, e0 = (i * h) // os[0], -(-((i + 1) * h) // os[0])
-        cols = [red(x[:, :, s0:e0, (j * w) // os[1]:
-                      -(-((j + 1) * w) // os[1])], axis=(2, 3))
-                for j in range(os[1])]
+        s0, e0 = adaptive_bounds(i, h, os[0])
+        cols = []
+        for j in range(os[1]):
+            s1, e1 = adaptive_bounds(j, w, os[1])
+            cols.append(red(x[:, :, s0:e0, s1:e1], axis=(2, 3)))
         rows.append(jnp.stack(cols, axis=-1))
     out = jnp.stack(rows, axis=-2)
     if data_format != "NCHW":
@@ -253,27 +261,101 @@ def _adaptive_pool2d(x, output_size, mode, data_format):
     return out
 
 
+def max_pool_with_index_nd(x, ks, st, pd):
+    """Shared N-D (N=2,3) max-pool with argmax indices flat into the
+    input spatial map (ref pool_with_index_op.cc).  Values are gathered
+    from the INPUT by the computed index — exact by construction
+    (x.flat[idx] == out), immune to patch-extraction roundoff."""
+    import numpy as _np
+
+    n, c, *sp = x.shape
+    nd = len(sp)
+    fmt = {2: ("NCHW", "OIHW", "NCHW"),
+           3: ("NCDHW", "OIDHW", "NCDHW")}[nd]
+    # HIGHEST precision: the one-hot extraction conv must not quantize
+    # values on the MXU, or near-equal competitors flip the argmax
+    patches = lax.conv_general_dilated_patches(
+        x, tuple(ks), tuple(st), [(p, p) for p in pd],
+        dimension_numbers=lax.conv_dimension_numbers(
+            x.shape, (1, c, *ks), fmt),
+        precision=lax.Precision.HIGHEST)
+    osp = patches.shape[2:]
+    ktot = int(_np.prod(ks))
+    patches = patches.reshape(n, c, ktot, *osp)
+
+    def coords_of(rel, lead_axes):
+        """Per-dim absolute input coordinate for window-relative flat
+        index `rel`; output-position bases broadcast over lead_axes."""
+        out, rem = [None] * nd, rel
+        for d in reversed(range(nd)):
+            shape = [1] * (lead_axes + nd)
+            shape[lead_axes + d] = osp[d]
+            base = jnp.arange(osp[d]).reshape(shape)
+            out[d] = base * st[d] - pd[d] + rem % ks[d]
+            rem = rem // ks[d]
+        return out
+
+    # patch extraction zero-fills padding; mask positions outside the
+    # input to -inf so a pad zero can never win the argmax (the
+    # reference clamps window bounds to the valid region instead)
+    rel_idx = jnp.arange(ktot).reshape((ktot,) + (1,) * nd)
+    wc = coords_of(rel_idx, 1)
+    valid = wc[0] >= 0
+    for d in range(nd):
+        valid = valid & (wc[d] >= 0) & (wc[d] < sp[d])
+    patches = jnp.where(valid[None, None], patches,
+                        jnp.asarray(-jnp.inf, patches.dtype))
+    rel = jnp.argmax(patches, axis=2)
+    ac = coords_of(rel, 2)
+    idx, mult = 0, 1
+    for d in reversed(range(nd)):
+        idx = idx + ac[d] * mult
+        mult *= sp[d]
+    idx = idx.astype(jnp.int32)
+    out = jnp.take_along_axis(
+        x.reshape(n, c, -1), idx.reshape(n, c, -1),
+        axis=2).reshape(n, c, *osp)
+    return out, idx
+
+
+def adaptive_max_pool_with_index_nd(x, os):
+    """Shared N-D adaptive max pool with indices: per-cell windows
+    [floor(i*S/oS), ceil((i+1)*S/oS)) from adaptive_bounds, indices
+    flat into the input spatial map."""
+    import itertools
+
+    n, c, *sp = x.shape
+    nd = len(sp)
+    vals, idxs = [], []
+    for cell in itertools.product(*[range(o) for o in os]):
+        bounds = [adaptive_bounds(cell[d], sp[d], os[d])
+                  for d in range(nd)]
+        win = x[(slice(None), slice(None))
+                + tuple(slice(s, e) for s, e in bounds)]
+        wshape = [e - s for s, e in bounds]
+        flat = win.reshape(n, c, -1)
+        rel = jnp.argmax(flat, axis=2)
+        vals.append(jnp.max(flat, axis=2))
+        pos, rem, mult = 0, rel, 1
+        for d in reversed(range(nd)):
+            pos = pos + (bounds[d][0] + rem % wshape[d]) * mult
+            rem = rem // wshape[d]
+            mult *= sp[d]
+        idxs.append(pos.astype(jnp.int32))
+    # itertools.product iterates row-major, so a straight reshape
+    # restores the output grid
+    return (jnp.stack(vals, axis=-1).reshape(n, c, *os),
+            jnp.stack(idxs, axis=-1).reshape(n, c, *os))
+
+
 @register_op("max_pool2d_with_index", has_aux=True)
-def max_pool2d_with_index(x, *, ksize, stride=None, padding=0):
-    out = _pool2d(x, ksize, stride, padding, False, "max", True, "NCHW")
-    # indices = per-window argmax as flat positions into the input H*W map
+def max_pool2d_with_index(x, *, ksize, stride=None, padding=0,
+                          adaptive=False):
+    if adaptive:
+        return adaptive_max_pool_with_index_nd(x, _pair(ksize))
     kh, kw = _pair(ksize)
     st = _pair(stride) if stride is not None else (kh, kw)
-    ph, pw = _pair(padding)
-    n, c, h, w = x.shape
-    patches = lax.conv_general_dilated_patches(
-        x, (kh, kw), st, [(ph, ph), (pw, pw)],
-        dimension_numbers=lax.conv_dimension_numbers(
-            x.shape, (1, c, kh, kw), ("NCHW", "OIHW", "NCHW")))
-    oh, ow = patches.shape[2], patches.shape[3]
-    patches = patches.reshape(n, c, kh * kw, oh, ow)
-    rel = jnp.argmax(patches, axis=2)  # window-relative flat index
-    oy = jnp.arange(oh).reshape(1, 1, oh, 1)
-    ox = jnp.arange(ow).reshape(1, 1, 1, ow)
-    abs_y = oy * st[0] - ph + rel // kw
-    abs_x = ox * st[1] - pw + rel % kw
-    idx = (abs_y * w + abs_x).astype(jnp.int32)
-    return out, idx
+    return max_pool_with_index_nd(x, (kh, kw), st, _pair(padding))
 
 
 # -- normalisation ----------------------------------------------------------

@@ -1,0 +1,207 @@
+"""Regression tests for round-4 advisor findings.
+
+1. dy2static: `for i in range(expr)` with escapes must evaluate the
+   range bounds ONCE, like Python — not re-evaluate `expr` per
+   iteration (ADVICE r4 medium, dy2static.py _range_for_parts).
+2. max-pool return_mask=True must return real argmax indices, never
+   None (ADVICE r4 low, ref pool_with_index_op.cc).
+3. EarlyStopping.stopped_epoch must report the epoch, not count eval
+   calls (ADVICE r4 low; deliberate fix of the reference's own
+   counter bug at hapi/callbacks.py:838).
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.jit import to_static
+
+
+def _t(x):
+    return Tensor(np.asarray(x, np.float32))
+
+
+# -- 1. range bounds snapshot -------------------------------------------------
+
+def test_escape_for_range_bound_mutated_by_body():
+    """Python evaluates range() once; a body that grows the bound's
+    dependency must not extend the lowered loop."""
+    def fn(x):
+        lst = [0]
+        for i in range(len(lst)):
+            lst.append(i)          # would diverge if len re-evaluated
+            x = x + 1
+            if x.sum() > 100:
+                break
+        return x
+
+    eager = fn(_t([0.0]))
+    static = to_static(fn)(_t([0.0]))
+    np.testing.assert_allclose(np.asarray(static.numpy()),
+                               np.asarray(eager.numpy()))
+    np.testing.assert_allclose(np.asarray(static.numpy()), [1.0])
+
+
+def test_escape_for_range_var_reassigned_in_body():
+    def fn(x):
+        n = 4
+        for i in range(n):
+            n = 100                # Python ignores: bound already taken
+            x = x + 1
+            if x.sum() > 1000:
+                break
+        return x
+
+    eager = fn(_t([0.0]))
+    static = to_static(fn)(_t([0.0]))
+    np.testing.assert_allclose(np.asarray(static.numpy()),
+                               np.asarray(eager.numpy()))
+    np.testing.assert_allclose(np.asarray(static.numpy()), [4.0])
+
+
+def test_plain_for_range_var_reassigned_in_body():
+    """Same once-only semantics on the escape-free desugar path."""
+    def fn(x):
+        n = 3
+        for i in range(n):
+            n = 0
+            x = x + 1
+        return x
+
+    eager = fn(_t([0.0]))
+    static = to_static(fn)(_t([0.0]))
+    np.testing.assert_allclose(np.asarray(static.numpy()),
+                               np.asarray(eager.numpy()))
+    np.testing.assert_allclose(np.asarray(static.numpy()), [3.0])
+
+
+# -- 2. pool return_mask real indices ----------------------------------------
+
+def _np_unravel_check(x, out, idx):
+    """Every (out, idx) pair must satisfy x.flat_spatial[idx] == out."""
+    n, c = x.shape[:2]
+    flat = x.reshape(n, c, -1)
+    o = np.asarray(out.numpy()).reshape(n, c, -1)
+    i = np.asarray(idx.numpy()).reshape(n, c, -1)
+    assert i.dtype in (np.int32, np.int64)
+    for b in range(n):
+        for ch in range(c):
+            np.testing.assert_allclose(flat[b, ch][i[b, ch]], o[b, ch],
+                                       rtol=1e-6)
+
+
+def test_max_pool2d_return_mask_indices():
+    x = np.random.RandomState(0).randn(2, 3, 8, 8).astype(np.float32)
+    out, idx = F.max_pool2d(Tensor(x), kernel_size=2, return_mask=True)
+    assert idx is not None
+    _np_unravel_check(x, out, idx)
+
+
+def test_max_pool1d_return_mask_indices():
+    x = np.random.RandomState(1).randn(2, 3, 12).astype(np.float32)
+    out, idx = F.max_pool1d(Tensor(x), kernel_size=3, return_mask=True)
+    assert idx is not None and np.asarray(idx.numpy()).shape == (2, 3, 4)
+    _np_unravel_check(x, out, idx)
+
+
+def test_max_pool3d_return_mask_indices():
+    x = np.random.RandomState(2).randn(2, 2, 4, 4, 4).astype(np.float32)
+    out, idx = F.max_pool3d(Tensor(x), kernel_size=2, return_mask=True)
+    assert idx is not None
+    _np_unravel_check(x, out, idx)
+
+
+def test_adaptive_max_pool2d_return_mask_nonuniform():
+    # 7 -> 3: non-divisible, windows vary per cell
+    x = np.random.RandomState(3).randn(1, 2, 7, 7).astype(np.float32)
+    out, idx = F.adaptive_max_pool2d(Tensor(x), 3, return_mask=True)
+    _np_unravel_check(x, out, idx)
+    # adaptive max values must match the mask-free path
+    ref = F.adaptive_max_pool2d(Tensor(x), 3)
+    np.testing.assert_allclose(np.asarray(out.numpy()),
+                               np.asarray(ref.numpy()), rtol=1e-6)
+
+
+def test_adaptive_max_pool1d_return_mask():
+    x = np.random.RandomState(4).randn(2, 3, 10).astype(np.float32)
+    out, idx = F.adaptive_max_pool1d(Tensor(x), 4, return_mask=True)
+    assert np.asarray(out.numpy()).shape == (2, 3, 4)
+    _np_unravel_check(x, out, idx)
+
+
+def test_adaptive_max_pool3d_return_mask():
+    x = np.random.RandomState(5).randn(1, 2, 5, 6, 7).astype(np.float32)
+    out, idx = F.adaptive_max_pool3d(Tensor(x), (2, 3, 3),
+                                     return_mask=True)
+    _np_unravel_check(x, out, idx)
+    ref = F.adaptive_max_pool3d(Tensor(x), (2, 3, 3))
+    np.testing.assert_allclose(np.asarray(out.numpy()),
+                               np.asarray(ref.numpy()), rtol=1e-6)
+
+
+def test_max_pool_return_mask_unsupported_raises():
+    x = Tensor(np.zeros((1, 1, 4, 4), np.float32))
+    with pytest.raises(NotImplementedError):
+        F.max_pool2d(x, 2, ceil_mode=True, return_mask=True)
+    with pytest.raises(NotImplementedError):
+        F.max_pool2d(x, 2, padding="SAME", return_mask=True)
+
+
+def test_max_pool2d_return_mask_padded_all_negative():
+    """Zero-filled pad positions must never win max/argmax: with
+    padding=1 and an all-negative input, the padded-window max must be
+    the true (negative) max, indices in-range, and values must match
+    the mask-free pool path."""
+    x = -1.0 - np.random.RandomState(6).rand(2, 2, 5, 5).astype(np.float32)
+    out, idx = F.max_pool2d(Tensor(x), 2, stride=2, padding=1,
+                            return_mask=True)
+    o = np.asarray(out.numpy())
+    assert (o < 0).all(), "pad zeros leaked into the pooled max"
+    i = np.asarray(idx.numpy())
+    assert i.min() >= 0 and i.max() < 25, "mask points at padding"
+    ref = F.max_pool2d(Tensor(x), 2, stride=2, padding=1)
+    np.testing.assert_allclose(o, np.asarray(ref.numpy()), rtol=1e-6)
+    _np_unravel_check(x, out, idx)
+
+
+def test_max_pool3d_return_mask_padded_all_negative():
+    x = -1.0 - np.random.RandomState(7).rand(1, 2, 4, 4, 4).astype(
+        np.float32)
+    out, idx = F.max_pool3d(Tensor(x), 2, stride=2, padding=1,
+                            return_mask=True)
+    o = np.asarray(out.numpy())
+    assert (o < 0).all(), "pad zeros leaked into the pooled max"
+    i = np.asarray(idx.numpy())
+    assert i.min() >= 0 and i.max() < 64, "mask points at padding"
+    ref = F.max_pool3d(Tensor(x), 2, stride=2, padding=1)
+    np.testing.assert_allclose(o, np.asarray(ref.numpy()), rtol=1e-6)
+    _np_unravel_check(x, out, idx)
+
+
+# -- 3. EarlyStopping epoch tracking -----------------------------------------
+
+def test_early_stopping_epoch_with_eval_freq():
+    """With eval every 2 epochs, the stop message/attribute must carry
+    the epoch that triggered the stop, not the eval count."""
+    cb = paddle.callbacks.EarlyStopping(
+        monitor="loss", patience=1, verbose=0, save_best_model=False)
+
+    class FakeModel:
+        stop_training = False
+
+    fm = FakeModel()
+    cb.set_model(fm)
+    cb.set_params({})
+    cb.on_train_begin()
+    # epochs 0..5, eval_freq=2 -> evals after epochs 1, 3, 5
+    losses = {1: 1.0, 3: 0.9, 5: 0.95}   # worse at epoch 5 -> stop
+    for epoch in range(6):
+        cb.on_epoch_begin(epoch)
+        if epoch in losses:
+            cb.on_eval_end({"loss": losses[epoch]})
+        if fm.stop_training:
+            break
+    assert fm.stop_training
+    assert cb.stopped_epoch == 5   # the epoch, not eval count (3)

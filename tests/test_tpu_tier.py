@@ -185,3 +185,35 @@ def test_dropout_rbg_prng_on_chip():
     assert (a != c).any()
     frac = (a == 0).mean()
     assert 0.35 < frac < 0.65, frac
+
+
+def test_max_pool_with_index_exact_on_chip():
+    """Pool-with-index values must be bitwise the input elements the
+    indices name, on the real chip: the patch-extraction conv runs at
+    HIGHEST precision and out is gathered from x (ADVICE/code-review r5
+    — default MXU precision quantized patch values)."""
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.core.tensor import Tensor
+
+    x = (np.random.RandomState(0).randn(2, 3, 33, 33)
+         .astype(np.float32) * 4 - 4)
+    out, idx = F.max_pool2d(Tensor(x), 3, stride=2, padding=1,
+                            return_mask=True)
+    o = np.asarray(out.numpy())
+    i = np.asarray(idx.numpy())
+    flat = x.reshape(2, 3, -1)
+    np.testing.assert_array_equal(
+        np.take_along_axis(flat, i.reshape(2, 3, -1), axis=2).ravel(),
+        o.ravel())
+    ref = F.max_pool2d(Tensor(x), 3, stride=2, padding=1)
+    np.testing.assert_array_equal(o, np.asarray(ref.numpy()))
+
+    x3 = (np.random.RandomState(1).randn(1, 2, 9, 9, 9)
+          .astype(np.float32) * 4 - 4)
+    o3, i3 = F.max_pool3d(Tensor(x3), 2, stride=2, padding=1,
+                          return_mask=True)
+    np.testing.assert_array_equal(
+        np.take_along_axis(x3.reshape(1, 2, -1),
+                           np.asarray(i3.numpy()).reshape(1, 2, -1),
+                           axis=2).ravel(),
+        np.asarray(o3.numpy()).ravel())
