@@ -84,7 +84,7 @@ def main():
     labels = ids.copy()
     labels[rng.rand(batch, seq) > 0.15] = -100
 
-    def build(dropout, force_attn=None):
+    def build(dropout, force_attn=None, mesh=None):
         if force_attn:
             os.environ["PADDLE_TPU_FLASH_FORCE"] = force_attn
         else:
@@ -104,7 +104,13 @@ def main():
             logits, nsp = outputs
             return criterion(logits, nsp, mlm_labels)
 
-        eng = Engine(model, opt, loss_fn)
+        kwargs = {}
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            kwargs = dict(mesh=mesh,
+                          batch_spec=NamedSharding(mesh, P("dp")))
+        eng = Engine(model, opt, loss_fn, **kwargs)
         with amp.auto_cast(enable=True, dtype="bfloat16"):
             eng.train_batch(ids, labels)  # build + warm
         return eng
@@ -123,6 +129,14 @@ def main():
         eng = build(dropout=0.1, force_attn="pallas")
     elif variant == "pallas_nodrop":
         eng = build(dropout=0.0, force_attn="pallas")
+    elif variant == "mesh1":
+        # GSPMD-partitioned step over a 1-device mesh: must match the
+        # un-meshed step time now that the Pallas kernel survives
+        # partitioning via custom_partitioning (VERDICT r4 item 1)
+        from jax.sharding import Mesh
+
+        mesh = Mesh(np.array(jax.devices()[:1]), ("dp",))
+        eng = build(dropout=0.1, mesh=mesh)
     else:
         raise SystemExit(f"unknown variant {variant}")
     ms = timed_step(eng)

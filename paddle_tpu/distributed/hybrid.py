@@ -277,7 +277,7 @@ class HybridParallelEngine:
                     lr, key):
             from ..ops.fused_ops import gspmd_tracing
 
-            with gspmd_tracing():  # meshed: no Mosaic under GSPMD
+            with gspmd_tracing():  # meshed: attention partitions via cp
                 return _step_impl(block_params, rest_params, buffers,
                                   opt_state, batch, lr, key)
 

@@ -67,7 +67,7 @@ class PipelineEngine:
         def step_fn(params, opt_state, buffers, x, y, lr, key):
             from ..ops.fused_ops import gspmd_tracing
 
-            with gspmd_tracing():  # sharded args: no Mosaic dispatch
+            with gspmd_tracing():  # meshed: attention partitions via cp
                 return _step_impl(params, opt_state, buffers, x, y, lr,
                                   key)
 

@@ -407,8 +407,9 @@ def make_train_step(layer, loss_fn, optimizer, *, grad_clip=None,
     if mesh is None:
         step_fn = _step_impl
     else:
-        # meshed step: GSPMD-partitioned program — gate Mosaic kernels
-        # to the jnp path at trace time (fused_ops.gspmd_tracing)
+        # meshed step: GSPMD-partitioned program — attention routes
+        # through custom_partitioning so the Mosaic kernel runs
+        # per-shard (fused_ops.gspmd_tracing)
         def step_fn(params, buffers, opt_state, batch, lr, key):
             from .ops.fused_ops import gspmd_tracing
 

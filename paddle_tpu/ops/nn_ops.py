@@ -300,8 +300,8 @@ def max_pool_with_index_nd(x, ks, st, pd):
     # reference clamps window bounds to the valid region instead)
     rel_idx = jnp.arange(ktot).reshape((ktot,) + (1,) * nd)
     wc = coords_of(rel_idx, 1)
-    valid = wc[0] >= 0
-    for d in range(nd):
+    valid = (wc[0] >= 0) & (wc[0] < sp[0])
+    for d in range(1, nd):
         valid = valid & (wc[d] >= 0) & (wc[d] < sp[d])
     patches = jnp.where(valid[None, None], patches,
                         jnp.asarray(-jnp.inf, patches.dtype))

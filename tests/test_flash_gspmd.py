@@ -1,0 +1,183 @@
+"""Flash attention inside GSPMD-partitioned programs (VERDICT r4 item 1).
+
+The custom_partitioning rule (fused_ops._flash_fwd_cp/_flash_bwd_cp)
+declares batch/heads shardable and runs the same pallas-or-jnp dispatch
+per shard, so meshed programs keep the fused kernel instead of falling
+back to jnp.  Ref parity: the reference's fused attention kernels run
+unmodified under every parallelism because NCCL parallelism is
+per-process (paddle/fluid/operators/fused/multihead_matmul_op.cu).
+"""
+
+import os
+import warnings
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from paddle_tpu.ops import fused_ops as fo
+
+pytestmark = pytest.mark.dist
+
+B, H, S, D = 4, 4, 256, 32
+SCALE = 1.0 / np.sqrt(D)
+
+
+def _qkv(seed=0):
+    rs = np.random.RandomState(seed)
+    return tuple(rs.randn(B, H, S, D).astype(np.float32)
+                 for _ in range(3))
+
+
+def _mesh():
+    return Mesh(np.array(jax.devices()[:8]).reshape(2, 4), ("dp", "mp"))
+
+
+def _meshed_out_and_grads(q, k, v, sharding, dropout_p=0.0):
+    seed = jnp.zeros((), jnp.int32)
+
+    def loss(q, k, v):
+        o = fo._flash_attention(q, k, v, seed, True, SCALE, dropout_p)
+        return jnp.sum(o * o), o
+
+    def step(q, k, v):
+        with fo.gspmd_tracing():
+            (_, o), grads = jax.value_and_grad(
+                loss, argnums=(0, 1, 2), has_aux=True)(q, k, v)
+        return o, grads
+
+    jitted = jax.jit(step, in_shardings=(sharding,) * 3)
+    return jitted(*(jax.device_put(t, sharding) for t in (q, k, v)))
+
+
+def test_meshed_matches_unmeshed():
+    """fwd+bwd parity: GSPMD-partitioned (dp x mp over b, h) vs the
+    plain single-device path; no fallback warning may fire."""
+    q, k, v = _qkv()
+    seed = jnp.zeros((), jnp.int32)
+    ref_o = fo._flash_attention(q, k, v, seed, True, SCALE, 0.0)
+    ref_g = jax.grad(
+        lambda *a: jnp.sum(fo._flash_attention(
+            *a, seed, True, SCALE, 0.0) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+
+    mesh = _mesh()
+    sh = NamedSharding(mesh, P("dp", "mp", None, None))
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        o, grads = _meshed_out_and_grads(q, k, v, sh)
+    assert o.sharding.spec == P("dp", "mp", None, None)
+    np.testing.assert_allclose(np.asarray(o), np.asarray(ref_o),
+                               rtol=2e-5, atol=2e-5)
+    for got, ref in zip(grads, ref_g):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_seq_sharded_operands_get_gathered():
+    """Operands arriving seq-sharded must still produce correct output
+    (the rule declares seq need_replication; the partitioner inserts
+    the gather) — the dedicated seq-parallel path is context_parallel."""
+    q, k, v = _qkv(1)
+    seed = jnp.zeros((), jnp.int32)
+    ref_o = fo._flash_attention(q, k, v, seed, True, SCALE, 0.0)
+    mesh = _mesh()
+    sh = NamedSharding(mesh, P("dp", None, "mp", None))  # seq on mp!
+    o, _ = _meshed_out_and_grads(q, k, v, sh)
+    np.testing.assert_allclose(np.asarray(o), np.asarray(ref_o),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_pallas_path_taken_inside_partitioned_program(monkeypatch):
+    """With PADDLE_TPU_FLASH_FORCE=pallas the per-shard lowering must
+    invoke the ACTUAL pallas kernels (interpret mode on the CPU mesh),
+    not the jnp fallback — certifies the Mosaic call survives GSPMD."""
+    monkeypatch.setenv("PADDLE_TPU_FLASH_FORCE", "pallas")
+    calls = {"fwd": 0, "bwd": 0}
+    real_fwd, real_bwd = fo._flash_fwd_pallas, fo._flash_bwd_pallas
+
+    def spy_fwd(*a, **kw):
+        calls["fwd"] += 1
+        return real_fwd(*a, **kw)
+
+    def spy_bwd(*a, **kw):
+        calls["bwd"] += 1
+        return real_bwd(*a, **kw)
+
+    monkeypatch.setattr(fo, "_flash_fwd_pallas", spy_fwd)
+    monkeypatch.setattr(fo, "_flash_bwd_pallas", spy_bwd)
+
+    q, k, v = _qkv(2)
+    seed = jnp.zeros((), jnp.int32)
+    ref_o = np.asarray(fo._fwd_impl4(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), seed,
+        True, SCALE, 0.0)[0])
+    assert calls["fwd"] == 1  # sanity: the spy sees the plain path
+
+    mesh = _mesh()
+    sh = NamedSharding(mesh, P("dp", "mp", None, None))
+    o, grads = _meshed_out_and_grads(q, k, v, sh)
+    assert calls["fwd"] >= 2, "pallas fwd not traced inside partition"
+    assert calls["bwd"] >= 1, "pallas bwd not traced inside partition"
+    np.testing.assert_allclose(np.asarray(o), ref_o, rtol=2e-5,
+                               atol=2e-5)
+    for g in grads:
+        assert np.isfinite(np.asarray(g)).all()
+
+
+def test_dropout_runs_meshed_and_scales():
+    """Dropout inside the partitioned program: output stays unbiased
+    (mean magnitude comparable to no-dropout) and finite; per-shard
+    streams are decorrelated by the shard-id seed fold."""
+    q, k, v = _qkv(3)
+    mesh = _mesh()
+    sh = NamedSharding(mesh, P("dp", "mp", None, None))
+    o_p, _ = _meshed_out_and_grads(q, k, v, sh, dropout_p=0.3)
+    o_0, _ = _meshed_out_and_grads(q, k, v, sh, dropout_p=0.0)
+    a, b = np.asarray(o_p), np.asarray(o_0)
+    assert np.isfinite(a).all()
+    assert not np.allclose(a, b)          # dropout actually applied
+    # unbiased rescale keeps magnitudes in the same ballpark
+    ratio = np.abs(a).mean() / np.abs(b).mean()
+    assert 0.7 < ratio < 1.4, ratio
+
+
+def test_engine_meshed_uses_cp_path():
+    """An Engine built with a mesh must trace attention through the
+    custom_partitioning wrappers (the gspmd_tracing gate) and still
+    reproduce the unmeshed loss."""
+    import paddle_tpu as paddle
+    from paddle_tpu.engine import Engine
+    from paddle_tpu import nn
+
+    class TinyAttn(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.proj = nn.Linear(D, D)
+
+        def forward(self, x):
+            # x: [b, h, s, d] pre-split heads (bhsd layout)
+            o = paddle.nn.functional.scaled_dot_product_attention(
+                x, x, x, is_causal=True, qkv_layout="bhsd")
+            return self.proj(o).mean()
+
+    def build(mesh):
+        paddle.seed(7)
+        model = TinyAttn()
+        opt = paddle.optimizer.SGD(learning_rate=0.0,
+                                   parameters=model.parameters())
+        kwargs = {}
+        if mesh is not None:
+            kwargs = dict(mesh=mesh,
+                          batch_spec=NamedSharding(mesh, P("dp")))
+        return Engine(model, opt, lambda out, y: out, **kwargs)
+
+    x = np.random.RandomState(4).randn(B, H, S, D).astype(np.float32)
+    y = np.zeros((B,), np.float32)
+    ref = float(build(None).train_batch((x,), (y,)).item())
+    mesh = _mesh()
+    got = float(build(mesh).train_batch((x,), (y,)).item())
+    np.testing.assert_allclose(got, ref, rtol=1e-4)
