@@ -111,24 +111,33 @@ def _bench_resnet50(peak: float, on_tpu: bool) -> dict:
     (3x fwd for training) against peak bf16.  Reference analogue:
     tools/test_model_benchmark.sh:19-45 (whole-model perf gate).
 
-    Measured ceiling (v5e, round 4): ~27% MFU (2155 img/s at batch
-    128) after making batch_norm's stats a single fused pass
-    (E[x^2]-E[x]^2 — jnp.var cost a third sweep over every activation;
-    the fix alone took 24.4% -> 26.8%).  MFU is FLAT across batch
-    64/128/256, so not a batch/parallelism limit.  Decomposition
-    on-chip: fwd+bwd alone is the whole step (Momentum update + BN
-    running stats are noise), and the same harness reaches 44.5% MFU on
-    ERNIE, so the rest of the gap is conv-pipeline-specific: (a) conv1
-    and stage-1 run at C<=64 against a 128x128 MXU (channel underfill
-    caps those layers near 50%), (b) BN/ReLU/pooling between every conv
-    are VPU/HBM-bound on ~1.2 GB of fwd activations re-read in bwd,
-    (c) the backward of the strided 3x3 convs lowers to input-dilated
-    convs with inherently worse tiling.  Ruled out by measurement:
-    layout (raw-jnp NHWC == NCHW: 54.9 vs 55.6 ms) and the
-    space-to-depth stem (11% faster in a lean bf16-weights harness but
-    neutral through the full training path, where BN/optimizer
-    semantics dominate; available as BENCH_RESNET_S2D=1 /
-    resnet50(space_to_depth_stem=True)).
+    Measured ceiling (v5e, round 5): **31.5% MFU (2531 img/s, 50.6 ms
+    at batch 128)** after routing every block BN through the fused
+    BN+act(+residual) custom-VJP op (ops/nn_ops.py fused_bn_act, ref
+    fused_bn_activation_op.cu): forward saves only (x, mean, inv) and
+    backward recomputes the normalized activation and ReLU mask in one
+    fused pass instead of re-reading saved y/masks.  That single change
+    took 27.98% -> 31.5% (57.0 -> 50.6 ms).  Round-5 experiment log,
+    all measured on-chip at batch 128 unless noted:
+      - fused BN+ReLU(+residual) in blocks: 50.58 ms / 31.52% (the win)
+      - + fused downsample Conv->BN shortcut: 50.88 ms / 31.33%
+        (neutral within noise; kept — fewer saved residuals)
+      - space-to-depth stem on top: 50.53 ms / 31.55% (still neutral)
+      - batch sweep: 64 -> 28.6%, 128 -> 31.5%, 192 -> 28.4%,
+        256 -> 30.1% (no longer flat: 128 is the plateau peak)
+      - conv-only skeleton (BN stubbed to identity): 32.26 ms / 49.4%
+        — the conv pipeline's own ceiling, per round-4 items (a)/(c):
+        C<=64 MXU underfill in the stem + input-dilated strided-conv
+        backwards.
+    Remaining BN cost is ~18.3 ms =~ 6.3 full traversals of the ~2.4 GB
+    (bf16, batch 128) activation set at ~819 GB/s HBM — BELOW the
+    8-traversal naive minimum for two-pass stats + normalize forward
+    and reduce + dx backward, i.e. XLA is already fusing past the
+    textbook floor and a hand Pallas BN kernel has no traversal left to
+    remove (each pass needs the full reduction before any output
+    element).  Closing the rest of the 31.5 -> 49.4 gap requires
+    fusing stats/normalize into the conv epilogue itself (a Pallas
+    conv, out of scope this round).
     """
     import paddle_tpu as paddle
     from paddle_tpu import amp, nn

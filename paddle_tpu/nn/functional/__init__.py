@@ -407,6 +407,26 @@ def batch_norm(x, running_mean, running_var, weight, bias, training=False,
     return y
 
 
+def fused_bn_act(x, running_mean, running_var, weight, bias,
+                 residual=None, act="relu", training=False, momentum=0.9,
+                 epsilon=1e-5, data_format="NCHW", use_global_stats=None,
+                 name=None):
+    """act(batch_norm(x) [+ residual]) through the minimal-residual
+    custom-VJP op (ref fused_bn_activation_op.cu): backward recomputes
+    the normalized activation instead of re-reading saved y/masks."""
+    if use_global_stats is None:
+        use_global_stats = not training
+    y, new_mean, new_var = apply(
+        "fused_bn_act", x, weight, bias, running_mean, running_var,
+        residual, momentum=momentum, epsilon=epsilon, act=act,
+        is_test=not training, data_format=data_format,
+        use_global_stats=use_global_stats)
+    if training and not use_global_stats:
+        running_mean.set_value(new_mean)
+        running_var.set_value(new_var)
+    return y
+
+
 def instance_norm(x, running_mean=None, running_var=None, weight=None,
                   bias=None, use_input_stats=True, momentum=0.9, eps=1e-5,
                   data_format="NCHW", name=None):

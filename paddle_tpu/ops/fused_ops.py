@@ -741,9 +741,7 @@ def _route_cp() -> bool:
     subgroup shardings."""
     if not _gspmd_tracing:
         return False
-    from jax._src import mesh as mesh_lib
-
-    m = mesh_lib.get_abstract_mesh()
+    m = jax.sharding.get_abstract_mesh()
     manual = tuple(getattr(m, "manual_axes", ()) or ())
     if not manual:
         return True

@@ -60,6 +60,39 @@ class SpaceToDepthStem(nn.Layer):
         return self.conv(x)
 
 
+def _downsample(ds, x):
+    """Fuse the shortcut's Conv->BN when it is the stock Sequential
+    (identity act, minimal-residual VJP); _bn_act's own dispatch keeps
+    non-plain norms on the composed path, which for an identity act
+    equals ds(x).  Any other downsample runs as-is."""
+    if isinstance(ds, nn.Sequential) and len(ds) == 2:
+        return _bn_act(ds[1], ds[0](x), act="identity")
+    return ds(x)
+
+
+def _bn_act(bn, x, residual=None, act="relu"):
+    """Route block BNs through the fused BN+act(+residual) op (minimal
+    backward residuals, ref fuse_bn_act_pass.cc).  Non-plain norm
+    layers (SyncBatchNorm, user norm_layer overrides) and BNs carrying
+    forward hooks keep the composed Layer.__call__ path so hooks and
+    overridden forwards still fire."""
+    from ...nn.layer.norm import SyncBatchNorm, _BatchNormBase
+
+    if (not isinstance(bn, _BatchNormBase)
+            or isinstance(bn, SyncBatchNorm)
+            or bn._forward_pre_hooks or bn._forward_post_hooks):
+        y = bn(x)
+        if residual is not None:
+            y = y + residual
+        return F.relu(y) if act == "relu" else y
+    return F.fused_bn_act(
+        x, bn._mean, bn._variance, bn.weight, bn.bias,
+        residual=residual, act=act, training=bn.training,
+        momentum=bn._momentum, epsilon=bn._epsilon,
+        data_format=bn._data_format,
+        use_global_stats=bn._use_global_stats)
+
+
 class BasicBlock(nn.Layer):
     expansion = 1
 
@@ -79,16 +112,10 @@ class BasicBlock(nn.Layer):
         self.stride = stride
 
     def forward(self, x):
-        identity = x
-        out = self.conv1(x)
-        out = self.bn1(out)
-        out = self.relu(out)
-        out = self.conv2(out)
-        out = self.bn2(out)
-        if self.downsample is not None:
-            identity = self.downsample(x)
-        out = out + identity
-        return self.relu(out)
+        identity = x if self.downsample is None else _downsample(
+            self.downsample, x)
+        out = _bn_act(self.bn1, self.conv1(x))
+        return _bn_act(self.bn2, self.conv2(out), residual=identity)
 
 
 class BottleneckBlock(nn.Layer):
@@ -114,14 +141,11 @@ class BottleneckBlock(nn.Layer):
         self.stride = stride
 
     def forward(self, x):
-        identity = x
-        out = self.relu(self.bn1(self.conv1(x)))
-        out = self.relu(self.bn2(self.conv2(out)))
-        out = self.bn3(self.conv3(out))
-        if self.downsample is not None:
-            identity = self.downsample(x)
-        out = out + identity
-        return self.relu(out)
+        identity = x if self.downsample is None else _downsample(
+            self.downsample, x)
+        out = _bn_act(self.bn1, self.conv1(x))
+        out = _bn_act(self.bn2, self.conv2(out))
+        return _bn_act(self.bn3, self.conv3(out), residual=identity)
 
 
 class ResNet(nn.Layer):
@@ -177,7 +201,7 @@ class ResNet(nn.Layer):
         return nn.Sequential(*layers)
 
     def forward(self, x):
-        x = self.relu(self.bn1(self.conv1(x)))
+        x = _bn_act(self.bn1, self.conv1(x))
         x = self.maxpool(x)
         x = self.layer1(x)
         x = self.layer2(x)

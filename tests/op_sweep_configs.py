@@ -2662,3 +2662,46 @@ case("hierarchical_sigmoid",
      _np_hsig(x, w, label, bias, path_table, path_code, num_classes),
      rtol=1e-4, atol=1e-5)
 FD_OPS["hierarchical_sigmoid"] = {}
+
+
+# ---- fused_bn_act (round 5; ref fused_bn_activation_op.cu) ----
+
+def _np_fused_bn_act(x, scale, bias, mean, variance, residual=None,
+                     act="relu", is_test=False, epsilon=1e-5):
+    if is_test:
+        um, uv = mean, variance
+    else:
+        um = x.mean(axis=(0, 2, 3))
+        uv = x.var(axis=(0, 2, 3))
+    b = (1, -1, 1, 1)
+    z = (x - um.reshape(b)) / np.sqrt(uv.reshape(b) + epsilon)
+    z = z * scale.reshape(b) + bias.reshape(b)
+    if residual is not None:
+        z = z + residual
+    return np.maximum(z, 0.0) if act == "relu" else z
+
+
+_FBR = f32((2, 3, 4, 4), seed=120)
+case("fused_bn_act", [_BNX, _BNS, _BNB, _BNM, _BNV], {"act": "relu"},
+     ref=lambda x, s, b, m, v, act: _np_fused_bn_act(x, s, b, m, v,
+                                                     act=act),
+     grad=(0, 1, 2), rtol=1e-4, atol=1e-5)
+case("fused_bn_act", [_BNX, _BNS, _BNB, _BNM, _BNV, _FBR],
+     {"act": "relu"},
+     ref=lambda x, s, b, m, v, r, act: _np_fused_bn_act(
+         x, s, b, m, v, r, act=act),
+     grad=(0, 1, 2, 5), rtol=1e-4, atol=1e-5)
+case("fused_bn_act", [_BNX, _BNS, _BNB, _BNM, _BNV, _FBR],
+     {"act": "identity"},
+     ref=lambda x, s, b, m, v, r, act: _np_fused_bn_act(
+         x, s, b, m, v, r, act=act),
+     grad=(0, 1, 2, 5), rtol=1e-4, atol=1e-5)
+case("fused_bn_act", [_BNX, _BNS, _BNB, _BNM, _BNV],
+     {"act": "relu", "is_test": True},
+     ref=lambda x, s, b, m, v, act, is_test: _np_fused_bn_act(
+         x, s, b, m, v, act=act, is_test=is_test),
+     grad=(0, 1, 2), rtol=1e-4, atol=1e-5)
+# fd-certify through the smooth identity-act case (relu kinks sit at
+# z=0 where standardized activations cluster — same curation rule that
+# keeps relu itself out of FD_OPS)
+FD_OPS["fused_bn_act"] = {"case": 2}

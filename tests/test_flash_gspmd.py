@@ -8,7 +8,6 @@ unmodified under every parallelism because NCCL parallelism is
 per-process (paddle/fluid/operators/fused/multihead_matmul_op.cu).
 """
 
-import os
 import warnings
 
 import numpy as np

@@ -67,3 +67,55 @@ def test_resnet_s2d_trains_under_engine():
               for _ in range(12)]
     assert all(np.isfinite(losses))
     assert losses[-1] < losses[0], losses
+
+
+def test_fused_bn_act_preserves_forward_hooks():
+    """BNs carrying forward hooks must take the composed Layer.__call__
+    path (observers/feature extractors), not the fused op."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.vision.models import resnet18
+
+    m = resnet18(num_classes=10)
+    fired = []
+    m.bn1.register_forward_post_hook(
+        lambda layer, inp, out: fired.append(1))
+    x = paddle.to_tensor(
+        np.random.RandomState(0).rand(1, 3, 32, 32).astype("float32"))
+    m(x)
+    assert fired
+
+
+def test_fused_bn_act_matches_composed_blocks():
+    """Fused-block ResNet forward must equal the composed
+    bn->relu->add math (training and eval)."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.vision.models.resnet import BottleneckBlock
+
+    paddle.seed(0)
+    blk = BottleneckBlock(16, 4)
+    x = paddle.to_tensor(
+        np.random.RandomState(1).randn(2, 16, 8, 8).astype("float32"))
+
+    def composed(blk, x):
+        out = nn.functional.relu(blk.bn1(blk.conv1(x)))
+        out = nn.functional.relu(blk.bn2(blk.conv2(out)))
+        out = blk.bn3(blk.conv3(out))
+        return nn.functional.relu(out + x)
+
+    for training in (True, False):
+        blk.train() if training else blk.eval()
+        got = np.asarray(blk(x).numpy())
+        # re-sync running stats (fused fwd updated them) before the
+        # composed pass so both see identical buffers
+        paddle.seed(0)
+        blk2 = BottleneckBlock(16, 4)
+        blk2.load_dict(blk.state_dict()) if hasattr(blk2, "load_dict") \
+            else blk2.set_state_dict(blk.state_dict())
+        blk2.train() if training else blk2.eval()
+        ref = np.asarray(composed(blk2, x).numpy())
+        np.testing.assert_allclose(got, ref, rtol=2e-5, atol=2e-5)
