@@ -170,6 +170,188 @@ def pipeline_spmd(stage_fn, mesh, *, num_stages, num_micro):
     return run
 
 
+def pack_stage_rows(stage_trees):
+    """Ragged per-stage parameter placement (ref section_worker.cc —
+    each rank materialises only its stage): pack a list of S pytrees
+    with DIFFERENT structures into one [S, Pmax] f32 buffer whose row s
+    is stage s's flattened leaves (zero padded to the largest stage).
+    Sharded P('pp'), per-device parameter memory is max_s |params_s| —
+    true placement, not replication.
+
+    Returns (rows, unpack, pack) where unpack(s, row) rebuilds stage
+    s's pytree from its [Pmax] row (static slicing, so it traces inside
+    a lax.switch branch) and pack(trees) re-packs updated pytrees."""
+    import numpy as np
+
+    metas = []
+    for tree in stage_trees:
+        leaves, treedef = jax.tree.flatten(tree)
+        info, off = [], 0
+        for leaf in leaves:
+            size = int(np.prod(leaf.shape)) if leaf.shape else 1
+            info.append((tuple(leaf.shape), leaf.dtype, off, size))
+            off += size
+        metas.append((treedef, info, off))
+    pmax = max([m[2] for m in metas] + [1])
+
+    def pack(trees):
+        rows = []
+        for tree, (treedef, info, tot) in zip(trees, metas):
+            leaves = jax.tree.leaves(tree)
+            if leaves:
+                row = jnp.concatenate(
+                    [jnp.ravel(l).astype(jnp.float32) for l in leaves])
+            else:
+                row = jnp.zeros((0,), jnp.float32)
+            rows.append(jnp.pad(row, (0, pmax - row.shape[0])))
+        return jnp.stack(rows)
+
+    def unpack(stage, row):
+        treedef, info, _ = metas[stage]
+        leaves = [row[off:off + size].reshape(shape).astype(dtype)
+                  for (shape, dtype, off, size) in info]
+        return jax.tree.unflatten(treedef, leaves)
+
+    return pack(stage_trees), unpack, pack
+
+
+def pipeline_spmd_hetero(stage_fns, mesh, *, num_stages, num_micro,
+                         unpack, act_proto, out_proto, has_extra=False):
+    """Heterogeneous-stage compiled pipeline (VERDICT r4 item 4; ref
+    section_worker.cc:104-180 F-then-B/1F1B over arbitrary per-stage
+    programs).
+
+    Removes pipeline_spmd's two uniformity constraints:
+    - per-stage PROGRAMS and PARAMETER STRUCTURES differ (embedding
+      stage != block stage != head stage): stage s's params arrive as
+      row s of a pack_stage_rows buffer sharded over 'pp', and stage
+      bodies run under lax.switch;
+    - boundary SHAPES differ: three ring buffers carry the injected
+      input (x micro-batch shape), the inter-stage activation
+      (act_proto), and the final output (out_proto) independently.
+
+    Contracts: stage_fns[0](params, shared, x_mb) -> act;
+    stage_fns[s](params, shared, act) -> act for 0 < s < S-1;
+    stage_fns[-1](params, shared, act[, extra_mb]) -> out.  The
+    inter-stage activation is ONE array of a single shape (the ring's
+    layout) — that is the remaining contract, matching the reference's
+    single boundary tensor between sections.
+
+    Returns run(rows, shared, x, extra=None, key=None) -> [M, *out]."""
+    S, M = num_stages, num_micro
+    L = -(-M // S)
+    M_pad = L * S
+    fwd = [(i, (i + 1) % S) for i in range(S)]
+    back = [(i, (i - 1) % S) for i in range(S)]
+    fns = list(stage_fns)
+    if len(fns) != S:
+        raise ValueError(f"stage_fns has {len(fns)} entries for {S} stages")
+    act_shape = tuple(act_proto.shape)
+    act_dtype = act_proto.dtype
+    out_shape = tuple(out_proto.shape)
+    out_dtype = out_proto.dtype
+
+    from ....framework import random as _random
+
+    def per_device(rows, shared, x_local, extra, key):
+        stage = jax.lax.axis_index(PP_AXIS)
+        row = rows[0]                      # this device's stage row
+        total = M_pad + 2 * S - 2 if S > 1 else M_pad
+
+        zero_in = jnp.zeros_like(x_local[0])
+        zero_act = jnp.zeros(act_shape, act_dtype)
+        zero_out = jnp.zeros(out_shape, out_dtype)
+        outs0 = jnp.zeros((L,) + out_shape, out_dtype)
+
+        def branch_fn(s):
+            def go(row, shared, iring, act, extra_mb, k):
+                with _random.rng_scope(k):
+                    local = unpack(s, row)
+                    if s == 0:
+                        a = fns[0](local, shared, iring)
+                        return (a.astype(act_dtype), zero_out)
+                    if s < S - 1:
+                        a = fns[s](local, shared, act)
+                        return (a.astype(act_dtype), zero_out)
+                    args = (local, shared, act) + (
+                        (extra_mb,) if has_extra else ())
+                    o = fns[s](*args)
+                    return (zero_act, jnp.asarray(o, out_dtype))
+            return go
+
+        branches = [branch_fn(s) for s in range(S)]
+
+        def tick(carry, u):
+            act, iring, oring, outs = carry
+            jj = u // S
+            inject = (u % S == 0) & (jj < L)
+            iring = jnp.where(inject, x_local[jnp.clip(jj, 0, L - 1)],
+                              iring)
+            num = u - 2 * stage - S
+            jcap = num // S
+            cap = (stage < S - 1) & (num >= 0) & (num % S == 0) \
+                & (jcap < L)
+            outs = jnp.where(
+                cap, outs.at[jnp.clip(jcap, 0, L - 1)].set(oring), outs)
+            # stream slot finished by the last stage at this tick
+            t = u - (S - 1)
+            if has_extra:
+                extra_mb = extra[jnp.clip(t, 0, M_pad - 1)]
+            else:
+                extra_mb = jnp.zeros((), jnp.float32)
+            k = jax.random.fold_in(jax.random.fold_in(key, u), stage)
+            new_act, out = jax.lax.switch(
+                stage, branches, row, shared, iring, act, extra_mb, k)
+            emitting = (stage == S - 1) & (t >= 0) & (t < M_pad)
+            own = emitting & (t % S == S - 1)
+            outs = jnp.where(
+                own, outs.at[jnp.clip(t // S, 0, L - 1)].set(out), outs)
+            oring = jnp.where(emitting, out, oring)
+            act = jax.lax.ppermute(new_act, PP_AXIS, fwd)
+            iring = jax.lax.ppermute(iring, PP_AXIS, back)
+            oring = jax.lax.ppermute(oring, PP_AXIS, fwd)
+            return (act, iring, oring, outs), None
+
+        (_, _, _, outs), _ = jax.lax.scan(
+            tick, (zero_act, zero_in, zero_out, outs0),
+            jnp.arange(total))
+        return outs
+
+    sm = jax.shard_map(
+        per_device, mesh=mesh,
+        in_specs=(P(PP_AXIS), P(), P(PP_AXIS), P(), P()),
+        out_specs=P(PP_AXIS),
+        axis_names={PP_AXIS},
+        check_vma=False)
+
+    def run(rows, shared, x, extra=None, key=None):
+        tail = x.shape[1:]
+        if M_pad != M:
+            x = jnp.concatenate(
+                [x, jnp.zeros((M_pad - M,) + tail, x.dtype)], axis=0)
+        xs = x.reshape((L, S) + tail).swapaxes(0, 1).reshape(
+            (M_pad,) + tail)
+        if extra is not None:
+            # tick t consumes ORIGINAL stream slot t (the striding is a
+            # per-device ownership layout, undone by the injection ring),
+            # so the last stage indexes extra in original order
+            if M_pad != M:
+                extra = jnp.concatenate(
+                    [extra, jnp.zeros((M_pad - M,) + extra.shape[1:],
+                                      extra.dtype)], axis=0)
+            es = extra
+        else:
+            es = jnp.zeros((M_pad,), jnp.float32)
+        if key is None:
+            key = jax.random.PRNGKey(0)
+        y = sm(rows, shared, xs, es, key)
+        y = y.reshape((S, L) + out_shape).swapaxes(0, 1).reshape(
+            (M_pad,) + out_shape)
+        return y[:M]
+
+    return run
+
+
 class PipelineParallel:
     """Dygraph-style wrapper driving the compiled pipeline
     (ref: meta_parallel/pipeline_parallel.py:32 PipelineParallel)."""

@@ -80,6 +80,7 @@ def _bn_act(bn, x, residual=None, act="relu"):
 
     if (not isinstance(bn, _BatchNormBase)
             or isinstance(bn, SyncBatchNorm)
+            or type(bn).forward is not _BatchNormBase.forward
             or bn._forward_pre_hooks or bn._forward_post_hooks):
         y = bn(x)
         if residual is not None:

@@ -37,7 +37,7 @@ _DIST_MODULES = {
     "test_pipeline_schedule", "test_launch", "test_zero2_lars",
     "test_zero3_offload", "test_context_parallel",
     "test_parameter_server", "test_strategies_compiled",
-    "test_heter_ps", "test_flash_gspmd",
+    "test_heter_ps", "test_flash_gspmd", "test_pipeline_hetero",
 }
 
 

@@ -1,0 +1,222 @@
+"""Heterogeneous-stage pipeline schedule (VERDICT r4 item 4).
+
+The general PipelineLayer must PIPELINE (scan+ppermute ring over
+per-stage programs with placed parameters), not silently fall back to
+gradient accumulation.  Ref parity:
+paddle/fluid/framework/section_worker.cc:104-180 (F-then-B / 1F1B over
+arbitrary per-stage section programs).
+"""
+
+import warnings
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.fleet.meta_parallel.pp_layers import (
+    PipelineLayer, SharedLayerDesc,
+)
+from paddle_tpu.distributed.pp_engine import PipelineEngine
+from paddle_tpu.distributed.topology import set_hybrid_communicate_group
+from paddle_tpu.engine import Engine
+from paddle_tpu.nlp.transformers import GPTConfig, GPTPretrainingCriterion
+from paddle_tpu.nlp.transformers.gpt import GPTDecoderLayer, GPTEmbeddings
+
+pytestmark = pytest.mark.dist
+
+VOCAB, H, L, SEQ = 128, 32, 4, 16
+
+
+class GPTHead(nn.Layer):
+    def __init__(self, cfg):
+        super().__init__()
+        self.norm = nn.LayerNorm(cfg.hidden_size,
+                                 epsilon=cfg.layer_norm_eps)
+        self.proj = nn.Linear(cfg.hidden_size, cfg.vocab_size,
+                              bias_attr=False)
+
+    def forward(self, x):
+        return self.proj(self.norm(x))
+
+
+def _cfg():
+    return GPTConfig(vocab_size=VOCAB, hidden_size=H, num_layers=L,
+                     num_heads=4, max_seq_len=32, dropout=0.0,
+                     attn_dropout=0.0, use_parallel=False)
+
+
+def _build_pl(seed, tied=False):
+    paddle.seed(seed)
+    cfg = _cfg()
+    crit = GPTPretrainingCriterion(cfg)
+    if tied:
+        def tied_logits(base, x):
+            from paddle_tpu.core.dispatch import apply
+
+            return apply("matmul_v2", x, base.word_embeddings.weight,
+                         trans_y=True)
+
+        descs = [SharedLayerDesc("emb", GPTEmbeddings, None, "weight",
+                                 cfg)]
+        descs += [GPTDecoderLayer(cfg) for _ in range(L)]
+        descs.append(nn.LayerNorm(cfg.hidden_size,
+                                  epsilon=cfg.layer_norm_eps))
+        descs.append(SharedLayerDesc("emb", GPTEmbeddings, tied_logits,
+                                     "weight", cfg))
+    else:
+        descs = [GPTEmbeddings(cfg)] + \
+            [GPTDecoderLayer(cfg) for _ in range(L)] + [GPTHead(cfg)]
+    pl = PipelineLayer(descs, num_stages=2,
+                       loss_fn=lambda lg, lb: crit(lg, lb))
+    return pl, crit
+
+
+def _batch():
+    rs = np.random.RandomState(4)
+    toks = rs.randint(0, VOCAB, (8, SEQ + 1)).astype(np.int32)
+    return toks[:, :-1], toks[:, 1:]
+
+
+@pytest.fixture()
+def pp2_hcg():
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 1,
+                               "pp_degree": 2, "sharding_degree": 1}
+    fleet.init(is_collective=True, strategy=strategy)
+    yield fleet.get_hybrid_communicate_group()
+    set_hybrid_communicate_group(None)
+
+
+@pytest.mark.parametrize("tied", [False, True],
+                         ids=["untied-head", "tied-embeddings"])
+def test_hetero_matches_sequential(pp2_hcg, tied):
+    """Embedding stage != block stage != head stage: losses AND trained
+    params must match a single-device sequential run; the hetero ring
+    schedule (not accum) must be active with no fallback warning."""
+    x, y = _batch()
+    pl_ref, crit = _build_pl(21, tied)
+    master = {k: np.asarray(v._value)
+              for k, v in pl_ref.state_dict().items()}
+    opt_ref = paddle.optimizer.SGD(learning_rate=0.05,
+                                   parameters=pl_ref.parameters())
+    eng_ref = Engine(pl_ref, opt_ref, lambda out, yy: crit(out, yy))
+    ref = [float(eng_ref.train_batch((x,), (y,)).item())
+           for _ in range(3)]
+
+    pl, _ = _build_pl(21, tied)
+    for k, t in pl.state_dict().items():
+        t._value = jnp.asarray(master[k])
+    opt = paddle.optimizer.SGD(learning_rate=0.05,
+                               parameters=pl.parameters())
+    eng = PipelineEngine(pl, opt, pp2_hcg, accumulate_steps=4)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        got = [float(eng.train_batch(x, y).item()) for _ in range(3)]
+    assert eng.schedule == "hetero"
+    np.testing.assert_allclose(got, ref, rtol=2e-4)
+
+    # trained params equal the sequential run's
+    eng.sync_to_layer()
+    sd = pl.state_dict()
+    ref_params = eng_ref.state.params
+    worst = max(float(jnp.max(jnp.abs(sd[k]._value - ref_params[k])))
+                for k in sd if k in ref_params)
+    assert worst < 1e-4, worst
+
+
+def test_hetero_places_stage_params(pp2_hcg):
+    """Per-stage params live as [S, Pmax] rows sharded over 'pp' —
+    per-device parameter memory is the largest stage, not the sum."""
+    from jax.sharding import PartitionSpec as P
+
+    x, y = _batch()
+    pl, _ = _build_pl(7)
+    opt = paddle.optimizer.SGD(learning_rate=0.05,
+                               parameters=pl.parameters())
+    eng = PipelineEngine(pl, opt, pp2_hcg, accumulate_steps=4)
+    eng.train_batch(x, y)
+    assert eng.schedule == "hetero"
+    assert eng._rows.sharding.spec == P("pp")
+    assert eng._rows.shape[0] == 2
+    # each stage row round-trips through unpack
+    for s, tree in enumerate(eng._stage_trees):
+        vals = eng._unpack(s, eng._rows[s])
+        assert set(vals) == set(tree)
+
+
+def test_hetero_unsupported_warns_and_accum_works(pp2_hcg):
+    """A boundary that is not a single array cannot ride the ring: the
+    engine must warn LOUDLY and still train via accumulation."""
+    class TwoOut(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(8, 8)
+
+        def forward(self, x):
+            h = self.fc(x)
+            return h, x            # tuple boundary
+
+    class Join(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(8, 1)
+
+        def forward(self, xs):
+            h, x = xs
+            return self.fc(h + x)
+
+    paddle.seed(3)
+    pl = PipelineLayer(
+        [TwoOut(), Join()], num_stages=2,
+        loss_fn=lambda out, yy: ((out - yy) ** 2).mean())
+    opt = paddle.optimizer.SGD(learning_rate=0.01,
+                               parameters=pl.parameters())
+    eng = PipelineEngine(pl, opt, pp2_hcg, accumulate_steps=2)
+    rs = np.random.RandomState(0)
+    x = rs.randn(4, 8).astype(np.float32)
+    y = rs.randn(4, 1).astype(np.float32)
+    with pytest.warns(UserWarning, match="NOT overlap"):
+        l0 = float(eng.train_batch(x, y).item())
+    assert eng.schedule == "accum"
+    l1 = float(eng.train_batch(x, y).item())
+    assert np.isfinite([l0, l1]).all() and l1 < l0
+
+
+def test_hetero_falls_back_for_trust_ratio_optimizer(pp2_hcg):
+    """Lamb computes per-parameter trust ratios; packed rows would merge
+    them — must warn and take the accum path, not silently diverge."""
+    x, y = _batch()
+    pl, _ = _build_pl(9)
+    opt = paddle.optimizer.Lamb(learning_rate=1e-3,
+                                parameters=pl.parameters())
+    eng = PipelineEngine(pl, opt, pp2_hcg, accumulate_steps=4)
+    with pytest.warns(UserWarning, match="NOT overlap"):
+        loss = float(eng.train_batch(x, y).item())
+    assert eng.schedule == "accum" and np.isfinite(loss)
+
+
+def test_hetero_falls_back_for_nonscalar_loss(pp2_hcg):
+    """A loss_fn that does not reduce to a scalar cannot ride the
+    output ring: the hetero probe must warn and fall back (not crash
+    with an opaque lax.switch shape error); the accum path then raises
+    jax's CLEAR scalar-output TypeError — the loss contract is scalar
+    in every engine path."""
+    paddle.seed(5)
+    pl = PipelineLayer(
+        [nn.Linear(8, 8), nn.Linear(8, 1)], num_stages=2,
+        loss_fn=lambda out, yy: (out - yy) ** 2)   # unreduced
+    opt = paddle.optimizer.SGD(learning_rate=0.01,
+                               parameters=pl.parameters())
+    eng = PipelineEngine(pl, opt, pp2_hcg, accumulate_steps=2)
+    rs = np.random.RandomState(0)
+    x = rs.randn(4, 8).astype(np.float32)
+    y = rs.randn(4, 1).astype(np.float32)
+    with pytest.warns(UserWarning, match="NOT overlap"), \
+            pytest.raises(TypeError, match="scalar-output"):
+        eng.train_batch(x, y)
+    assert eng.schedule == "accum"
