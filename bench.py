@@ -101,6 +101,16 @@ def _resnet50_fwd_flops(hw: int = 224, num_classes: int = 1000) -> float:
     return f
 
 
+def _peak_hbm_gb(engine):
+    """Measured per-step peak HBM of an engine's compiled program, or
+    None when the engine has not run / the backend lacks the analysis
+    (never takes down the bench line)."""
+    try:
+        return round(engine.memory_analysis()["peak"] / 2**30, 3)
+    except Exception:
+        return None
+
+
 def _bench_resnet50(peak: float, on_tpu: bool) -> dict:
     """ResNet-50 ImageNet-shape train step (fwd+bwd+Momentum) on one chip.
 
@@ -182,6 +192,7 @@ def _bench_resnet50(peak: float, on_tpu: bool) -> dict:
         "step_ms": round(ms, 2),
         "batch": batch, "image_hw": hw,
         "train_gflops_per_image": round(train_flops / 1e9, 2),
+        "peak_hbm_gb": _peak_hbm_gb(eng),
     }
 
 
@@ -346,6 +357,10 @@ def main():
     mfu = achieved / peak
     target_mfu = 0.35  # BASELINE.json north star: ERNIE-1.0 >=35% MFU
 
+    # MEASURED per-step device memory from XLA's buffer assignment
+    # (VERDICT r4 item 7: record peak HBM per ladder config)
+    peak_hbm_gb = _peak_hbm_gb(engine)
+
     print(json.dumps({
         "metric": "ernie_base_pretrain_mfu",
         "value": round(mfu * 100.0, 2),
@@ -358,6 +373,7 @@ def main():
         "params": n_params,
         "device": getattr(dev, "device_kind", dev.platform),
         "loss": loss_v,
+        "peak_hbm_gb": peak_hbm_gb,
         "resnet50": resnet_stats,
     }))
 

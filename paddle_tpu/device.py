@@ -55,6 +55,49 @@ def is_compiled_with_tpu() -> bool:
     return True
 
 
+def memory_stats(device=None) -> dict:
+    """MEASURED per-device memory (ref platform/monitor.h:77 GPU mem
+    high-watermark + memory/stats.h): PJRT allocator stats when the
+    backend exposes them (`bytes_in_use`, `peak_bytes_in_use`, ...);
+    otherwise a live-array census over the device's addressable shards,
+    split by memory kind:
+
+      bytes_in_use       device-resident jax array bytes
+      host_bytes_in_use  pinned-host-resident bytes (opt-state offload)
+      peak_bytes_in_use  allocator high-watermark, or -1 when only the
+                         census is available (no allocator on host CPU
+                         and some tunneled TPU backends)
+
+    `device`: a jax Device, an integer ordinal, or None (device 0)."""
+    if device is None:
+        device = jax.devices()[0]
+    elif isinstance(device, int):
+        device = jax.devices()[device]
+    dev_bytes = 0
+    host_bytes = 0
+    for arr in jax.live_arrays():
+        try:
+            kind = getattr(arr.sharding, "memory_kind", None)
+            for sh in arr.addressable_shards:
+                if sh.device == device:
+                    nb = int(sh.data.size) * sh.data.dtype.itemsize
+                    if kind and "host" in str(kind):
+                        host_bytes += nb
+                    else:
+                        dev_bytes += nb
+        except Exception:  # deleted/donated arrays mid-iteration
+            continue
+    stats = device.memory_stats() or {}
+    if stats.get("bytes_in_use") is not None:
+        # allocator stats never cover pinned-host buffers: graft the
+        # census host figure so offload stays measurable on real TPUs
+        out = dict(stats)
+        out.setdefault("host_bytes_in_use", host_bytes)
+        return out
+    return {"bytes_in_use": dev_bytes, "host_bytes_in_use": host_bytes,
+            "peak_bytes_in_use": -1, "source": "live_array_census"}
+
+
 class CPUPlace:
     def __repr__(self):
         return "Place(cpu)"

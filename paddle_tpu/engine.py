@@ -501,6 +501,9 @@ class Engine:
         self._step_fn = None
         self._offload_sh = None
         self._grad_clip = grad_clip
+        self._step_protos = None
+        self._mem_analysis = None
+        self._batch_sig = None
 
     def _build(self):
         self._step_fn = make_train_step(
@@ -540,6 +543,17 @@ class Engine:
         if self._offload_sh is not None:
             dev_sh, host_sh = self._offload_sh
             opt_state = jax.device_put(opt_state, dev_sh)
+        batch_sig = jax.tree.map(lambda a: (a.shape, str(a.dtype)),
+                                 batch)
+        if self._step_protos is None or batch_sig != self._batch_sig:
+            # a new batch shape means a new compiled program: refresh
+            # the protos so memory_analysis() reports the live program
+            self._batch_sig = batch_sig
+            self._mem_analysis = None
+            self._step_protos = jax.tree.map(
+                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+                (self.state.params, self.state.buffers, opt_state,
+                 batch, lr, key))
         loss, self.state.params, self.state.buffers, new_opt = \
             self._step_fn(self.state.params, self.state.buffers,
                           opt_state, batch, lr, key)
@@ -547,7 +561,45 @@ class Engine:
             new_opt = jax.device_put(new_opt, self._offload_sh[1])
         self.state.opt_state = new_opt
         self.state.step += 1
+        from . import profiler as _profiler
+
+        if _profiler.is_op_profiling_enabled():
+            _profiler.record_device_memory("train_batch")
         return Tensor(loss)
+
+    def memory_analysis(self) -> dict:
+        """MEASURED per-step device memory of the compiled train step
+        (XLA's buffer assignment — ref profiler.proto:38 MemEvent /
+        monitor.h:77 GPU mem high-watermark, which infer what XLA here
+        reports exactly).  Keys in bytes: arguments (resident state:
+        params/opt/batch), temps (activations + workspace), outputs,
+        alias (donated arg<->output reuse), generated_code, peak
+        (XLA's peak liveness when reported, else arg+temp+out-alias);
+        host_* mirror them for host-memory-kind buffers (offload)."""
+        if self._step_fn is None or self._step_protos is None:
+            raise RuntimeError("run train_batch() once first")
+        if self._mem_analysis is None:
+            ma = self._step_fn.lower(*self._step_protos) \
+                .compile().memory_analysis()
+            peak = getattr(ma, "peak_memory_in_bytes", 0) or (
+                ma.argument_size_in_bytes + ma.temp_size_in_bytes
+                + ma.output_size_in_bytes - ma.alias_size_in_bytes)
+            self._mem_analysis = {
+                "arguments": ma.argument_size_in_bytes,
+                "temps": ma.temp_size_in_bytes,
+                "outputs": ma.output_size_in_bytes,
+                "alias": ma.alias_size_in_bytes,
+                "generated_code": ma.generated_code_size_in_bytes,
+                "peak": peak,
+                "host_arguments": ma.host_argument_size_in_bytes,
+                "host_temps": ma.host_temp_size_in_bytes,
+                "host_outputs": ma.host_output_size_in_bytes,
+            }
+            from .framework import monitor
+
+            monitor.stat_max("device_mem_step_peak_bytes",
+                             self._mem_analysis["peak"])
+        return dict(self._mem_analysis)
 
     def sync_to_layer(self):
         write_back(self.layer, self.state)

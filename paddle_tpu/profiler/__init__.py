@@ -26,8 +26,9 @@ import threading
 import time
 
 __all__ = [
-    "RecordEvent", "enable_op_profiling", "disable_op_profiling",
-    "is_op_profiling_enabled", "reset", "events", "summary",
+    "RecordEvent", "RecordMemEvent", "enable_op_profiling",
+    "disable_op_profiling", "is_op_profiling_enabled", "reset", "events",
+    "mem_events", "record_device_memory", "summary",
     "export_chrome_tracing", "profile", "start_trace", "stop_trace",
 ]
 
@@ -80,14 +81,63 @@ class RecordEvent:
         return False
 
 
+_mem_events: list[dict] = []  # {annotation, place, bytes_in_use, ...}
+
+
+class RecordMemEvent:
+    """Memory event (ref platform/profiler.proto:38 MemEvent): a named
+    allocation/deallocation or snapshot with byte counts and place.
+    Usable directly (`RecordMemEvent("alloc", bytes=..., place=...)`)
+    or via record_device_memory() snapshots."""
+
+    def __init__(self, annotation, *, bytes=0, place=None, kind="alloc",
+                 extra=None):
+        ev = {
+            "annotation": annotation, "kind": kind,
+            "bytes": int(bytes), "place": str(place or "device:0"),
+            "ts": _now_us(), "tid": threading.get_ident(),
+        }
+        if extra:
+            ev.update(extra)
+        with _lock:
+            _mem_events.append(ev)
+
+
+def record_device_memory(annotation="snapshot", device=None):
+    """Snapshot the device's MEASURED memory (device.memory_stats) as a
+    MemEvent and roll the high-watermark into framework.monitor
+    (STAT_ADD analogue of the reference's GPU mem stat)."""
+    from ..device import memory_stats
+    from ..framework import monitor
+
+    stats = memory_stats(device)
+    in_use = int(stats.get("bytes_in_use", 0))
+    peak = int(stats.get("peak_bytes_in_use", -1))
+    RecordMemEvent(annotation, bytes=in_use, kind="snapshot",
+                   place="device", extra={
+                       "peak_bytes_in_use": peak,
+                       "host_bytes_in_use":
+                           int(stats.get("host_bytes_in_use", 0)),
+                   })
+    monitor.stat_max("device_mem_bytes_in_use_peak",
+                     peak if peak >= 0 else in_use)
+    return stats
+
+
 def reset():
     with _lock:
         _events.clear()
+        _mem_events.clear()
 
 
 def events():
     with _lock:
         return list(_events)
+
+
+def mem_events():
+    with _lock:
+        return list(_mem_events)
 
 
 def enable_op_profiling():
@@ -152,6 +202,29 @@ def summary(sorted_by="total", limit=None):
         lines.append(
             f"{r['name'][:39]:<40}{r['calls']:>8}{r['total']:>14.1f}"
             f"{r['avg']:>12.1f}{r['max']:>12.1f}{r['min']:>12.1f}")
+    mems = mem_events()
+    if mems:
+        # device-memory section (ref fluid/profiler.py mem table /
+        # profiler.proto MemEvent): measured snapshots, peak first
+        lines.append("")
+        lines.append("Device memory (measured)")
+        lines.append(f"{'Annotation':<32}{'Kind':>10}{'Bytes':>16}"
+                     f"{'Peak':>16}{'HostBytes':>14}")
+        lines.append("-" * 88)
+        peak_all = max((m.get("peak_bytes_in_use", -1) for m in mems),
+                       default=-1)
+        in_use_max = max((m["bytes"] for m in mems), default=0)
+        host_max = max((m.get("host_bytes_in_use", 0) for m in mems),
+                       default=0)
+        for m in mems[-20:]:
+            lines.append(
+                f"{m['annotation'][:31]:<32}{m['kind']:>10}"
+                f"{m['bytes']:>16}"
+                f"{m.get('peak_bytes_in_use', -1):>16}"
+                f"{m.get('host_bytes_in_use', 0):>14}")
+        lines.append(
+            f"{'== high watermark ==':<32}{'':>10}{in_use_max:>16}"
+            f"{peak_all:>16}{host_max:>14}")
     return "\n".join(lines)
 
 

@@ -14,7 +14,8 @@ jax.config.update("jax_platforms", "cpu")
 # --compiled-step builds a 2-host x 4-device global mesh (VERDICT r3
 # item 4); the plain collective payload keeps the original 2+2 layout
 jax.config.update("jax_num_cpu_devices",
-                  4 if "--compiled-step" in sys.argv else 2)
+                  4 if ("--compiled-step" in sys.argv
+                        or "--compiled-pp-step" in sys.argv) else 2)
 
 from paddle_tpu.distributed.parallel import init_parallel_env  # noqa: E402
 
@@ -29,6 +30,21 @@ if "--crash-rank" in sys.argv:
         # hang the watchdog exists to break
         os._exit(3)
     time.sleep(120)  # the watchdog must kill us well before this
+    sys.exit(0)
+
+if "--compiled-pp-step" in sys.argv:
+    # pipeline ring over 'pp' SPANNING the two processes: the
+    # lax.ppermute collective-permute crosses the process boundary
+    # (VERDICT r4 item 6 — the DCN analogue of the reference's
+    # pipeline-parallel dist test)
+    import json
+
+    import compiled_step_common as csc
+
+    assert jax.process_count() == 2, jax.process_count()
+    assert jax.device_count() == 8, jax.device_count()
+    losses = csc.run_pp(csc.make_pp_mesh())
+    print(f"COMPILED PP LOSSES {json.dumps(losses)}", flush=True)
     sys.exit(0)
 
 if "--compiled-step" in sys.argv:

@@ -157,3 +157,35 @@ def test_multiprocess_compiled_hybrid_step(tmp_path):
     ref = csc.run(csc.make_mesh())
     assert ref[-1] < ref[0], ref  # it actually trains
     np.testing.assert_allclose(got, ref, rtol=1e-4)
+
+
+def test_multiprocess_pipeline_step(tmp_path):
+    """VERDICT r4 item 6: the pipeline ring's ppermute must cross a REAL
+    process boundary (pp axis spanning 2 launched processes) and still
+    reproduce the single-process 8-device trajectory."""
+    import json
+
+    import numpy as np
+
+    log_dir = str(tmp_path / "logs")
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "2", "--log_dir", log_dir, PAYLOAD,
+         "--compiled-pp-step"],
+        cwd=REPO, env=_clean_env(), capture_output=True, text=True,
+        timeout=240)
+    logs = ""
+    for rank in (0, 1):
+        with open(os.path.join(log_dir, f"workerlog.{rank}")) as f:
+            logs += f.read()
+    assert proc.returncode == 0, f"launcher failed:\n{logs}\n{proc.stderr}"
+    line = next(ln for ln in logs.splitlines()
+                if ln.startswith("COMPILED PP LOSSES"))
+    got = json.loads(line[len("COMPILED PP LOSSES "):])
+
+    sys.path.insert(0, os.path.dirname(PAYLOAD))
+    import compiled_step_common as csc
+
+    ref = csc.run_pp(csc.make_pp_mesh())
+    assert ref[-1] < ref[0], ref  # it actually trains
+    np.testing.assert_allclose(got, ref, rtol=1e-4)

@@ -1,0 +1,119 @@
+"""Measured memory observability (VERDICT r4 item 7).
+
+ZeRO/offload claims must be validated by MEASURED memory, not inferred
+from loss parity: Engine.memory_analysis() reads XLA's buffer
+assignment for the compiled step; device.memory_stats() reads PJRT
+allocator stats (or a live-array census split by memory kind).
+Ref parity: platform/profiler.proto:38 (MemEvent),
+platform/monitor.h:77 (GPU mem high-watermark stat).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import profiler
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.topology import set_hybrid_communicate_group
+from paddle_tpu.engine import Engine
+from paddle_tpu.framework import monitor
+
+pytestmark = pytest.mark.dist
+
+
+def _tiny_gpt():
+    from paddle_tpu.nlp.transformers import (
+        GPTConfig, GPTForPretraining, GPTPretrainingCriterion,
+    )
+
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=256, hidden_size=64, num_layers=2,
+                    num_heads=4, max_seq_len=32, dropout=0.0,
+                    attn_dropout=0.0, use_parallel=False)
+    return (GPTForPretraining(cfg), GPTPretrainingCriterion(cfg), cfg)
+
+
+def _engine(zero_stage, offload, hcg):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    model, crit, cfg = _tiny_gpt()
+    opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                parameters=model.parameters())
+    mesh = hcg.get_mesh()
+    eng = Engine(model, opt, lambda out, y: crit(out, y), mesh=mesh,
+                 batch_spec=NamedSharding(mesh, P()),
+                 zero_stage=zero_stage, sharding_axis="sharding",
+                 offload=offload)
+    rs = np.random.RandomState(0)
+    toks = rs.randint(0, cfg.vocab_size, (8, 17)).astype(np.int32)
+    eng.train_batch((toks[:, :-1],), (toks[:, 1:],))
+    return eng
+
+
+@pytest.fixture()
+def sharding4_hcg():
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 1,
+                               "pp_degree": 1, "sharding_degree": 4}
+    strategy.sharding = True
+    fleet.init(is_collective=True, strategy=strategy)
+    yield fleet.get_hybrid_communicate_group()
+    set_hybrid_communicate_group(None)
+
+
+def test_zero3_peak_below_zero1(sharding4_hcg):
+    """MEASURED: ZeRO-3's per-device resident state (XLA argument
+    bytes) and peak must be below ZeRO-1's on the same model/mesh."""
+    e1 = _engine(1, False, sharding4_hcg)
+    m1 = e1.memory_analysis()
+    e3 = _engine(3, False, sharding4_hcg)
+    m3 = e3.memory_analysis()
+    assert m3["arguments"] < m1["arguments"], (m3, m1)
+    assert m3["peak"] < m1["peak"], (m3, m1)
+    # both report sane structure
+    for m in (m1, m3):
+        assert m["peak"] > 0 and m["temps"] >= 0
+
+
+def test_offload_moves_state_off_device(sharding4_hcg):
+    """MEASURED: with opt-state offload, the state rests in host memory
+    (live-array census host_bytes > 0) and device-resident bytes drop
+    below the no-offload engine's."""
+    import gc
+
+    e_off = _engine(2, True, sharding4_hcg)
+    stats_off = paddle.device.memory_stats()
+    del e_off
+    gc.collect()
+    e_on = _engine(2, False, sharding4_hcg)
+    stats_on = paddle.device.memory_stats()
+    assert stats_off["host_bytes_in_use"] > 0
+    assert stats_on["host_bytes_in_use"] < stats_off["host_bytes_in_use"]
+    assert stats_off["bytes_in_use"] < stats_on["bytes_in_use"]
+
+
+def test_memory_analysis_recorded_in_monitor(sharding4_hcg):
+    monitor.reset()
+    eng = _engine(1, False, sharding4_hcg)
+    m = eng.memory_analysis()
+    assert monitor.stat_get("device_mem_step_peak_bytes") == m["peak"]
+
+
+def test_profiler_mem_events_and_summary(sharding4_hcg):
+    profiler.reset()
+    monitor.reset()
+    with profiler.profile(op_detail=True):
+        _engine(1, False, sharding4_hcg)
+    mems = profiler.mem_events()
+    assert mems and mems[-1]["kind"] == "snapshot"
+    assert mems[-1]["bytes"] > 0          # census measured something
+    text = profiler.summary()
+    assert "Device memory (measured)" in text
+    assert monitor.stat_get("device_mem_bytes_in_use_peak") > 0
+    # explicit MemEvent API (profiler.proto:38 parity)
+    profiler.RecordMemEvent("my_alloc", bytes=1024, place="device:0",
+                            kind="alloc")
+    assert profiler.mem_events()[-1]["annotation"] == "my_alloc"
