@@ -189,6 +189,10 @@ class SparseTable:
                     self._lib.pst_export(self._handle,
                                          ids.ctypes.data_as(_I64P),
                                          rows.ctypes.data_as(_F32P))
+                    # hash-map iteration order is arbitrary: export
+                    # sorted so snapshots are deterministic/diffable
+                    order = np.argsort(ids, kind="stable")
+                    ids, rows = ids[order], rows[order]
                 return {"ids": ids, "rows": rows}
             ids = np.array(sorted(self._rows), np.int64)
             rows = (np.stack([self._rows[int(i)] for i in ids])
@@ -226,8 +230,11 @@ class SSDSparseTable(SparseTable):
 
     def __init__(self, name, dim, optimizer="sgd", lr=0.01, epsilon=1e-6,
                  init_range=0.05, seed=0, mem_rows=100_000,
-                 spill_dir=None):
-        # the native in-RAM table cannot spill; force the python rows
+                 spill_dir=None, use_native=True):
+        # base class stays on python rows; the native SSD table (when
+        # available and requested) owns the whole LRU+spill hot path in
+        # C++ — the python machinery below remains the reference
+        # implementation the conformance tests diff against
         super().__init__(name, dim, optimizer=optimizer, lr=lr,
                          epsilon=epsilon, init_range=init_range,
                          seed=seed, use_native=False)
@@ -248,6 +255,24 @@ class SSDSparseTable(SparseTable):
         self._has_accum = optimizer == "adagrad"
         self._rec_dim = self.dim * (2 if self._has_accum else 1)
         self._rec_bytes = 8 + 4 * self._rec_dim  # i64 id + f32 payload
+        self._ssd_handle = None
+        if use_native:
+            from ...native import ps_table_lib
+
+            lib = ps_table_lib()
+            if lib is not None and hasattr(lib, "pst_ssd_create"):
+                native_path = os.path.join(self._spill_dir,
+                                           "rows_native.bin")
+                h = lib.pst_ssd_create(
+                    self.dim, ctypes.c_float(-self.init_range),
+                    ctypes.c_float(self.init_range),
+                    ctypes.c_uint64(self.seed),
+                    ctypes.c_int64(self.mem_rows),
+                    native_path.encode(),
+                    1 if self._has_accum else 0)
+                if h:
+                    self._lib = lib
+                    self._ssd_handle = h
 
     # -- spill machinery -----------------------------------------------------
     def _record(self, i):
@@ -315,18 +340,69 @@ class SSDSparseTable(SparseTable):
         return super()._py_row(i)
 
     def pull(self, ids):
+        if self._ssd_handle is not None:
+            ids = np.ascontiguousarray(
+                np.asarray(ids, np.int64).reshape(-1))
+            out = np.empty((ids.shape[0], self.dim), np.float32)
+            with self._lock:
+                self._lib.pst_ssd_pull(self._native_handle(),
+                                       ids.ctypes.data_as(_I64P),
+                                       ids.shape[0],
+                                       out.ctypes.data_as(_F32P))
+            return out
         out = super().pull(ids)
         with self._lock:
             self._evict_lru()
         return out
 
     def push_grad(self, ids, grads):
+        if self._ssd_handle is not None:
+            ids = np.ascontiguousarray(
+                np.asarray(ids, np.int64).reshape(-1))
+            grads = np.ascontiguousarray(
+                np.asarray(grads, np.float32).reshape(ids.shape[0],
+                                                      self.dim))
+            with self._lock:
+                if self.optimizer == "sgd":
+                    self._lib.pst_ssd_push_sgd(
+                        self._native_handle(), ids.ctypes.data_as(_I64P),
+                        ids.shape[0], grads.ctypes.data_as(_F32P),
+                        ctypes.c_float(self.lr))
+                elif self.optimizer == "adagrad":
+                    self._lib.pst_ssd_push_adagrad(
+                        self._native_handle(), ids.ctypes.data_as(_I64P),
+                        ids.shape[0], grads.ctypes.data_as(_F32P),
+                        ctypes.c_float(self.lr),
+                        ctypes.c_float(self.epsilon))
+                elif self.optimizer == "sum":
+                    self._lib.pst_ssd_push_delta(
+                        self._native_handle(), ids.ctypes.data_as(_I64P),
+                        ids.shape[0], grads.ctypes.data_as(_F32P))
+                else:
+                    raise ValueError(
+                        f"unknown optimizer {self.optimizer!r}")
+            return
         super().push_grad(ids, grads)
         with self._lock:
             self._evict_lru()
 
+    def resident_rows(self):
+        """In-memory (hot) row count — observability for the LRU bound."""
+        with self._lock:
+            if self._ssd_handle is not None:
+                return int(self._lib.pst_ssd_resident(self._native_handle()))
+            return len(self._rows)
+
+    def spilled_rows(self):
+        with self._lock:
+            if self._ssd_handle is not None:
+                return int(self._lib.pst_ssd_spilled(self._native_handle()))
+            return len(self._index)
+
     def __len__(self):
         with self._lock:
+            if self._ssd_handle is not None:
+                return int(self._lib.pst_ssd_size(self._native_handle()))
             return len(self._rows) + len(self._index)
 
     def state_dict(self):
@@ -335,6 +411,17 @@ class SSDSparseTable(SparseTable):
         # spilled rows are peeked read-only so the export causes no LRU
         # churn
         with self._lock:
+            if self._ssd_handle is not None:
+                n = int(self._lib.pst_ssd_size(self._ssd_handle))
+                ids = np.empty(n, np.int64)
+                rows = np.empty((n, self.dim), np.float32)
+                if n:
+                    self._lib.pst_ssd_export(
+                        self._ssd_handle, ids.ctypes.data_as(_I64P),
+                        rows.ctypes.data_as(_F32P))
+                    order = np.argsort(ids, kind="stable")
+                    ids, rows = ids[order], rows[order]
+                return {"ids": ids, "rows": rows}
             ids = sorted(set(self._rows) | set(self._index))
             rows = np.empty((len(ids), self.dim), np.float32)
             for k, i in enumerate(ids):
@@ -348,23 +435,48 @@ class SSDSparseTable(SparseTable):
             return {"ids": np.asarray(ids, np.int64), "rows": rows}
 
     def load_state_dict(self, sd):
+        if self._ssd_handle is not None:
+            ids = np.ascontiguousarray(np.asarray(sd["ids"], np.int64))
+            rows = np.ascontiguousarray(
+                np.asarray(sd["rows"], np.float32))
+            with self._lock:
+                self._lib.pst_ssd_import(self._native_handle(),
+                                         ids.ctypes.data_as(_I64P),
+                                         ids.shape[0],
+                                         rows.ctypes.data_as(_F32P))
+            return
         super().load_state_dict(sd)
         with self._lock:
             self._evict_lru()
 
     def close(self):
-        """Release the spill file and delete a self-created spill dir
-        (delete_table / server shutdown path)."""
+        """Release the spill file/handle and delete a self-created spill
+        dir (delete_table / server shutdown path).  Takes the table lock
+        so an in-flight pull/push finishes before the native object is
+        freed (the PS server is a thread pool)."""
         import os
         import shutil
 
-        try:
-            self._spill_f.close()
-        except Exception:  # noqa: BLE001 — already closed
-            pass
+        with self._lock:
+            if self._ssd_handle is not None:
+                self._lib.pst_ssd_free(self._ssd_handle)
+                self._ssd_handle = None
+            try:
+                self._spill_f.close()
+            except Exception:  # noqa: BLE001 — already closed
+                pass
         if getattr(self, "_owns_spill_dir", False) and \
                 os.path.isdir(self._spill_dir):
             shutil.rmtree(self._spill_dir, ignore_errors=True)
+
+    def _native_handle(self):
+        """Handle re-read UNDER the lock: a concurrent close() nulls it,
+        and calling into freed native memory would be a use-after-free —
+        raise instead."""
+        h = self._ssd_handle
+        if h is None:
+            raise RuntimeError(f"SSD table {self.name!r} is closed")
+        return h
 
     def __del__(self):
         try:

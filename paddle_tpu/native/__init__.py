@@ -214,6 +214,29 @@ def _build_ps():
                                    f32p]
     lib.pst_export.argtypes = [ctypes.c_void_p, i64p, f32p]
     lib.pst_import.argtypes = [ctypes.c_void_p, i64p, ctypes.c_int64, f32p]
+    # SSD spill variant (ref ssd_sparse_table.h)
+    lib.pst_ssd_create.argtypes = [
+        ctypes.c_int64, ctypes.c_float, ctypes.c_float, ctypes.c_uint64,
+        ctypes.c_int64, ctypes.c_char_p, ctypes.c_int]
+    lib.pst_ssd_create.restype = ctypes.c_void_p
+    lib.pst_ssd_free.argtypes = [ctypes.c_void_p]
+    for name in ("pst_ssd_size", "pst_ssd_resident", "pst_ssd_spilled"):
+        fn = getattr(lib, name)
+        fn.argtypes = [ctypes.c_void_p]
+        fn.restype = ctypes.c_int64
+    lib.pst_ssd_pull.argtypes = [ctypes.c_void_p, i64p, ctypes.c_int64,
+                                 f32p]
+    lib.pst_ssd_push_sgd.argtypes = [ctypes.c_void_p, i64p,
+                                     ctypes.c_int64, f32p, ctypes.c_float]
+    lib.pst_ssd_push_adagrad.argtypes = [
+        ctypes.c_void_p, i64p, ctypes.c_int64, f32p, ctypes.c_float,
+        ctypes.c_float]
+    lib.pst_ssd_push_delta.argtypes = [ctypes.c_void_p, i64p,
+                                       ctypes.c_int64, f32p]
+    lib.pst_ssd_export.argtypes = [ctypes.c_void_p, i64p, f32p]
+    lib.pst_ssd_export.restype = ctypes.c_int64
+    lib.pst_ssd_import.argtypes = [ctypes.c_void_p, i64p, ctypes.c_int64,
+                                   f32p]
     return lib
 
 

@@ -20,6 +20,29 @@
 
 namespace {
 
+// shared by BOTH tables: initial row values must stay bit-identical
+// between the in-RAM and SSD variants (the conformance tests diff them)
+inline uint64_t pst_splitmix(uint64_t x) {
+  x += 0x9e3779b97f4a7c15ull;
+  x = (x ^ (x >> 30)) * 0xbf58476d1ce4e5b9ull;
+  x = (x ^ (x >> 27)) * 0x94d049bb133111ebull;
+  return x ^ (x >> 31);
+}
+
+inline void pst_init_row(float* r, int64_t dim, float lo, float hi,
+                         uint64_t seed, int64_t id) {
+  if (lo == 0.f && hi == 0.f) {
+    std::memset(r, 0, sizeof(float) * dim);
+    return;
+  }
+  uint64_t s = pst_splitmix(seed ^ static_cast<uint64_t>(id));
+  const float span = hi - lo;
+  for (int64_t j = 0; j < dim; ++j) {
+    s = pst_splitmix(s);
+    r[j] = lo + span * ((s >> 11) * 0x1.0p-53f);
+  }
+}
+
 struct Table {
   int64_t dim;
   float init_lo, init_hi;
@@ -30,13 +53,6 @@ struct Table {
   std::vector<float> accum;   // slot * dim (adagrad G)
   std::mutex mu;
 
-  static uint64_t splitmix(uint64_t x) {
-    x += 0x9e3779b97f4a7c15ull;
-    x = (x ^ (x >> 30)) * 0xbf58476d1ce4e5b9ull;
-    x = (x ^ (x >> 27)) * 0x94d049bb133111ebull;
-    return x ^ (x >> 31);
-  }
-
   int64_t slot_of(int64_t id) {
     auto it = index.find(id);
     if (it != index.end()) return it->second;
@@ -44,17 +60,8 @@ struct Table {
     index.emplace(id, slot);
     rows.resize((slot + 1) * dim);
     if (has_accum) accum.resize((slot + 1) * dim, 0.f);
-    float* r = rows.data() + slot * dim;
-    if (init_lo == 0.f && init_hi == 0.f) {
-      std::memset(r, 0, sizeof(float) * dim);
-    } else {
-      uint64_t s = splitmix(seed ^ static_cast<uint64_t>(id));
-      const float span = init_hi - init_lo;
-      for (int64_t j = 0; j < dim; ++j) {
-        s = splitmix(s);
-        r[j] = init_lo + span * ((s >> 11) * 0x1.0p-53f);
-      }
-    }
+    pst_init_row(rows.data() + slot * dim, dim, init_lo, init_hi, seed,
+                 id);
     return slot;
   }
 
@@ -159,6 +166,321 @@ void pst_import(void* h, const int64_t* ids, int64_t n, const float* rows) {
     std::memcpy(t->rows.data() + slot * t->dim, rows + i * t->dim,
                 sizeof(float) * t->dim);
   }
+}
+
+}  // extern "C"
+
+// ---------------------------------------------------------------------------
+// SSD spill table (ref paddle/fluid/distributed/table/ssd_sparse_table.h:
+// in-memory shard paired with an on-disk store).  Hot rows live in a
+// bounded LRU arena; eviction appends a fixed-size record
+// [int64 id][f32 payload] to the spill file with an id -> offset index
+// pointing at the newest record; re-touching a spilled id reads it back
+// hot.  Dead records beyond the live count trigger in-place compaction.
+// The fixed-record append-only file + hash index IS the LSM level this
+// workload needs (point lookups by id, full scan at save) — no rocksdb
+// in the image.
+// ---------------------------------------------------------------------------
+
+#include <fcntl.h>
+#include <unistd.h>
+
+#include <cstdio>
+#include <string>
+
+namespace {
+
+struct SsdTable {
+  int64_t dim;            // embedding dim
+  int64_t rec_dim;        // payload floats (dim, or 2*dim with adagrad)
+  int64_t mem_rows;       // LRU capacity
+  float init_lo, init_hi;
+  uint64_t seed;
+  bool has_accum;
+
+  // resident arena + intrusive LRU list over slots
+  std::unordered_map<int64_t, int32_t> resident;  // id -> slot
+  std::vector<float> arena;                        // slot * rec_dim
+  std::vector<int64_t> slot_id;
+  std::vector<int32_t> lru_prev, lru_next;
+  std::vector<int32_t> free_slots;
+  int32_t lru_head = -1, lru_tail = -1;  // head = MRU
+
+  // spill file
+  std::unordered_map<int64_t, int64_t> offsets;  // id -> file offset
+  int fd = -1;
+  int64_t tail_off = 0;
+  int64_t dead = 0;
+  std::string path;
+  std::vector<char> recbuf;
+  std::mutex mu;
+
+  int64_t rec_bytes() const { return 8 + 4 * rec_dim; }
+
+  void lru_unlink(int32_t s) {
+    int32_t p = lru_prev[s], n = lru_next[s];
+    if (p >= 0) lru_next[p] = n; else lru_head = n;
+    if (n >= 0) lru_prev[n] = p; else lru_tail = p;
+  }
+
+  void lru_push_front(int32_t s) {
+    lru_prev[s] = -1;
+    lru_next[s] = lru_head;
+    if (lru_head >= 0) lru_prev[lru_head] = s;
+    lru_head = s;
+    if (lru_tail < 0) lru_tail = s;
+  }
+
+  void touch(int32_t s) {
+    if (lru_head == s) return;
+    lru_unlink(s);
+    lru_push_front(s);
+  }
+
+  int32_t alloc_slot(int64_t id) {
+    int32_t s;
+    if (!free_slots.empty()) {
+      s = free_slots.back();
+      free_slots.pop_back();
+    } else {
+      s = static_cast<int32_t>(slot_id.size());
+      slot_id.push_back(0);
+      lru_prev.push_back(-1);
+      lru_next.push_back(-1);
+      arena.resize((s + 1) * rec_dim);
+    }
+    slot_id[s] = id;
+    lru_push_front(s);
+    resident.emplace(id, s);
+    return s;
+  }
+
+  void init_row(float* r, int64_t id) {
+    pst_init_row(r, dim, init_lo, init_hi, seed, id);
+    if (rec_dim > dim)
+      std::memset(r + dim, 0, sizeof(float) * (rec_dim - dim));
+  }
+
+  // resident payload for id, faulting from disk / initialising fresh
+  float* payload_of(int64_t id) {
+    auto it = resident.find(id);
+    if (it != resident.end()) {
+      touch(it->second);
+      return arena.data() + static_cast<int64_t>(it->second) * rec_dim;
+    }
+    int32_t s = alloc_slot(id);
+    float* r = arena.data() + static_cast<int64_t>(s) * rec_dim;
+    auto sp = offsets.find(id);
+    if (sp != offsets.end()) {
+      if (pread(fd, recbuf.data(), rec_bytes(), sp->second) ==
+          (ssize_t)rec_bytes()) {
+        std::memcpy(r, recbuf.data() + 8, sizeof(float) * rec_dim);
+      } else {
+        init_row(r, id);  // unreadable record: deterministic re-init
+      }
+      offsets.erase(sp);
+      ++dead;
+    } else {
+      init_row(r, id);
+    }
+    return r;
+  }
+
+  void evict() {
+    while (static_cast<int64_t>(resident.size()) > mem_rows &&
+           lru_tail >= 0) {
+      int32_t s = lru_tail;
+      int64_t id = slot_id[s];
+      std::memcpy(recbuf.data(), &id, 8);
+      std::memcpy(recbuf.data() + 8,
+                  arena.data() + static_cast<int64_t>(s) * rec_dim,
+                  sizeof(float) * rec_dim);
+      if (pwrite(fd, recbuf.data(), rec_bytes(), tail_off) !=
+          (ssize_t)rec_bytes()) {
+        // short write (ENOSPC etc.): keep the row RESIDENT rather than
+        // record a corrupt offset — the table degrades to over-capacity
+        // memory use instead of silently losing trained state
+        break;
+      }
+      if (offsets.count(id)) ++dead;
+      offsets[id] = tail_off;
+      tail_off += rec_bytes();
+      lru_unlink(s);
+      resident.erase(id);
+      free_slots.push_back(s);
+    }
+    if (dead > 64 && dead > static_cast<int64_t>(offsets.size()))
+      compact();
+  }
+
+  void compact() {
+    std::string tmp = path + ".compact";
+    int nfd = ::open(tmp.c_str(), O_RDWR | O_CREAT | O_TRUNC, 0600);
+    if (nfd < 0) return;
+    int64_t off = 0;
+    std::unordered_map<int64_t, int64_t> fresh;
+    fresh.reserve(offsets.size());
+    for (const auto& kv : offsets) {
+      if (pread(fd, recbuf.data(), rec_bytes(), kv.second) !=
+          (ssize_t)rec_bytes())
+        continue;
+      if (pwrite(nfd, recbuf.data(), rec_bytes(), off) !=
+          (ssize_t)rec_bytes()) {
+        // can't complete the compacted copy: keep the old file intact
+        ::close(nfd);
+        ::unlink(tmp.c_str());
+        return;
+      }
+      fresh[kv.first] = off;
+      off += rec_bytes();
+    }
+    ::close(fd);
+    ::rename(tmp.c_str(), path.c_str());
+    fd = nfd;
+    tail_off = off;
+    offsets.swap(fresh);
+    dead = 0;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* pst_ssd_create(int64_t dim, float init_lo, float init_hi,
+                     uint64_t seed, int64_t mem_rows,
+                     const char* spill_path, int has_accum) {
+  auto* t = new SsdTable();
+  t->dim = dim;
+  t->has_accum = has_accum != 0;
+  t->rec_dim = dim * (t->has_accum ? 2 : 1);
+  t->mem_rows = mem_rows > 0 ? mem_rows : 1;
+  t->init_lo = init_lo;
+  t->init_hi = init_hi;
+  t->seed = seed;
+  t->path = spill_path;
+  t->fd = ::open(spill_path, O_RDWR | O_CREAT | O_TRUNC, 0600);
+  if (t->fd < 0) {
+    delete t;
+    return nullptr;
+  }
+  t->recbuf.resize(t->rec_bytes());
+  return t;
+}
+
+void pst_ssd_free(void* h) {
+  auto* t = static_cast<SsdTable*>(h);
+  if (t->fd >= 0) ::close(t->fd);
+  delete t;
+}
+
+int64_t pst_ssd_size(void* h) {
+  auto* t = static_cast<SsdTable*>(h);
+  std::lock_guard<std::mutex> g(t->mu);
+  return static_cast<int64_t>(t->resident.size() + t->offsets.size());
+}
+
+int64_t pst_ssd_resident(void* h) {
+  auto* t = static_cast<SsdTable*>(h);
+  std::lock_guard<std::mutex> g(t->mu);
+  return static_cast<int64_t>(t->resident.size());
+}
+
+int64_t pst_ssd_spilled(void* h) {
+  auto* t = static_cast<SsdTable*>(h);
+  std::lock_guard<std::mutex> g(t->mu);
+  return static_cast<int64_t>(t->offsets.size());
+}
+
+void pst_ssd_pull(void* h, const int64_t* ids, int64_t n, float* out) {
+  auto* t = static_cast<SsdTable*>(h);
+  std::lock_guard<std::mutex> g(t->mu);
+  for (int64_t i = 0; i < n; ++i) {
+    std::memcpy(out + i * t->dim, t->payload_of(ids[i]),
+                sizeof(float) * t->dim);
+  }
+  t->evict();
+}
+
+void pst_ssd_push_sgd(void* h, const int64_t* ids, int64_t n,
+                      const float* grads, float lr) {
+  auto* t = static_cast<SsdTable*>(h);
+  std::lock_guard<std::mutex> g(t->mu);
+  for (int64_t i = 0; i < n; ++i) {
+    float* r = t->payload_of(ids[i]);
+    const float* gr = grads + i * t->dim;
+    for (int64_t j = 0; j < t->dim; ++j) r[j] -= lr * gr[j];
+  }
+  t->evict();
+}
+
+void pst_ssd_push_adagrad(void* h, const int64_t* ids, int64_t n,
+                          const float* grads, float lr, float eps) {
+  auto* t = static_cast<SsdTable*>(h);
+  std::lock_guard<std::mutex> g(t->mu);
+  for (int64_t i = 0; i < n; ++i) {
+    float* r = t->payload_of(ids[i]);
+    float* a = r + t->dim;  // accumulator rides the payload
+    const float* gr = grads + i * t->dim;
+    for (int64_t j = 0; j < t->dim; ++j) {
+      a[j] += gr[j] * gr[j];
+      r[j] -= lr * gr[j] / (std::sqrt(a[j]) + eps);
+    }
+  }
+  t->evict();
+}
+
+void pst_ssd_push_delta(void* h, const int64_t* ids, int64_t n,
+                        const float* deltas) {
+  auto* t = static_cast<SsdTable*>(h);
+  std::lock_guard<std::mutex> g(t->mu);
+  for (int64_t i = 0; i < n; ++i) {
+    float* r = t->payload_of(ids[i]);
+    const float* d = deltas + i * t->dim;
+    for (int64_t j = 0; j < t->dim; ++j) r[j] += d[j];
+  }
+  t->evict();
+}
+
+// export ids (sorted not required; caller sorts) then rows: two-call
+// protocol so the caller can size buffers from pst_ssd_size first.
+// Returns the number of entries actually filled (unreadable spill
+// records are skipped, never exported as garbage).
+int64_t pst_ssd_export(void* h, int64_t* ids_out, float* rows_out) {
+  auto* t = static_cast<SsdTable*>(h);
+  std::lock_guard<std::mutex> g(t->mu);
+  int64_t i = 0;
+  for (const auto& kv : t->resident) {
+    ids_out[i] = kv.first;
+    std::memcpy(rows_out + i * t->dim,
+                t->arena.data() +
+                    static_cast<int64_t>(kv.second) * t->rec_dim,
+                sizeof(float) * t->dim);
+    ++i;
+  }
+  for (const auto& kv : t->offsets) {
+    if (pread(t->fd, t->recbuf.data(), t->rec_bytes(), kv.second) !=
+        (ssize_t)t->rec_bytes())
+      continue;
+    ids_out[i] = kv.first;
+    std::memcpy(rows_out + i * t->dim, t->recbuf.data() + 8,
+                sizeof(float) * t->dim);
+    ++i;
+  }
+  return i;
+}
+
+void pst_ssd_import(void* h, const int64_t* ids, int64_t n,
+                    const float* rows) {
+  auto* t = static_cast<SsdTable*>(h);
+  std::lock_guard<std::mutex> g(t->mu);
+  for (int64_t i = 0; i < n; ++i) {
+    // payload_of zero-inits the accumulator for fresh ids and keeps it
+    // for existing ones — matching the python table's load semantics
+    std::memcpy(t->payload_of(ids[i]), rows + i * t->dim,
+                sizeof(float) * t->dim);
+  }
+  t->evict();
 }
 
 }  // extern "C"
