@@ -117,6 +117,25 @@ class SparseTable:
             self._rows[i] = r
         return r
 
+    def _native_push(self, prefix, handle, ids, grads):
+        """Optimizer dispatch shared by the in-RAM and SSD native
+        tables (prefix 'pst' / 'pst_ssd')."""
+        if self.optimizer == "sgd":
+            getattr(self._lib, f"{prefix}_push_sgd")(
+                handle, ids.ctypes.data_as(_I64P), ids.shape[0],
+                grads.ctypes.data_as(_F32P), ctypes.c_float(self.lr))
+        elif self.optimizer == "adagrad":
+            getattr(self._lib, f"{prefix}_push_adagrad")(
+                handle, ids.ctypes.data_as(_I64P), ids.shape[0],
+                grads.ctypes.data_as(_F32P), ctypes.c_float(self.lr),
+                ctypes.c_float(self.epsilon))
+        elif self.optimizer == "sum":
+            getattr(self._lib, f"{prefix}_push_delta")(
+                handle, ids.ctypes.data_as(_I64P), ids.shape[0],
+                grads.ctypes.data_as(_F32P))
+        else:
+            raise ValueError(f"unknown optimizer {self.optimizer!r}")
+
     # -- API -----------------------------------------------------------------
     def pull(self, ids):
         ids = np.ascontiguousarray(np.asarray(ids, np.int64).reshape(-1))
@@ -137,24 +156,7 @@ class SparseTable:
             np.asarray(grads, np.float32).reshape(ids.shape[0], self.dim))
         with self._lock:
             if self._handle is not None:
-                if self.optimizer == "sgd":
-                    self._lib.pst_push_sgd(
-                        self._handle, ids.ctypes.data_as(_I64P),
-                        ids.shape[0], grads.ctypes.data_as(_F32P),
-                        ctypes.c_float(self.lr))
-                elif self.optimizer == "adagrad":
-                    self._lib.pst_push_adagrad(
-                        self._handle, ids.ctypes.data_as(_I64P),
-                        ids.shape[0], grads.ctypes.data_as(_F32P),
-                        ctypes.c_float(self.lr),
-                        ctypes.c_float(self.epsilon))
-                elif self.optimizer == "sum":
-                    self._lib.pst_push_delta(
-                        self._handle, ids.ctypes.data_as(_I64P),
-                        ids.shape[0], grads.ctypes.data_as(_F32P))
-                else:
-                    raise ValueError(
-                        f"unknown optimizer {self.optimizer!r}")
+                self._native_push("pst", self._handle, ids, grads)
                 return
             for k, i in enumerate(ids):
                 i = int(i)
@@ -243,19 +245,15 @@ class SSDSparseTable(SparseTable):
         from collections import OrderedDict
 
         self.mem_rows = int(mem_rows)
-        self._rows = OrderedDict()  # LRU: oldest first
         self._owns_spill_dir = spill_dir is None
         self._spill_dir = spill_dir or tempfile.mkdtemp(
             prefix=f"pst_ssd_{name}_")
         os.makedirs(self._spill_dir, exist_ok=True)
-        self._spill_path = os.path.join(self._spill_dir, "rows.bin")
-        self._spill_f = open(self._spill_path, "w+b")
-        self._index: dict[int, int] = {}  # id -> file offset
-        self._dead_records = 0
         self._has_accum = optimizer == "adagrad"
         self._rec_dim = self.dim * (2 if self._has_accum else 1)
         self._rec_bytes = 8 + 4 * self._rec_dim  # i64 id + f32 payload
         self._ssd_handle = None
+        self._spill_f = None
         if use_native:
             from ...native import ps_table_lib
 
@@ -273,6 +271,14 @@ class SSDSparseTable(SparseTable):
                 if h:
                     self._lib = lib
                     self._ssd_handle = h
+        if self._ssd_handle is None:
+            # python spill apparatus built only when actually used —
+            # native tables would otherwise hold a dead fd + file each
+            self._rows = OrderedDict()  # LRU: oldest first
+            self._spill_path = os.path.join(self._spill_dir, "rows.bin")
+            self._spill_f = open(self._spill_path, "w+b")
+            self._index: dict[int, int] = {}  # id -> file offset
+            self._dead_records = 0
 
     # -- spill machinery -----------------------------------------------------
     def _record(self, i):
@@ -339,8 +345,16 @@ class SSDSparseTable(SparseTable):
             return self._rows[i]
         return super()._py_row(i)
 
+    @property
+    def _native_mode(self):
+        # dispatch on table KIND, not live handle: a closed native
+        # table must raise (via _native_handle) rather than silently
+        # fall through to the empty python fallback and hand back
+        # freshly-initialised rows
+        return self._spill_f is None
+
     def pull(self, ids):
-        if self._ssd_handle is not None:
+        if self._native_mode:
             ids = np.ascontiguousarray(
                 np.asarray(ids, np.int64).reshape(-1))
             out = np.empty((ids.shape[0], self.dim), np.float32)
@@ -356,31 +370,15 @@ class SSDSparseTable(SparseTable):
         return out
 
     def push_grad(self, ids, grads):
-        if self._ssd_handle is not None:
+        if self._native_mode:
             ids = np.ascontiguousarray(
                 np.asarray(ids, np.int64).reshape(-1))
             grads = np.ascontiguousarray(
                 np.asarray(grads, np.float32).reshape(ids.shape[0],
                                                       self.dim))
             with self._lock:
-                if self.optimizer == "sgd":
-                    self._lib.pst_ssd_push_sgd(
-                        self._native_handle(), ids.ctypes.data_as(_I64P),
-                        ids.shape[0], grads.ctypes.data_as(_F32P),
-                        ctypes.c_float(self.lr))
-                elif self.optimizer == "adagrad":
-                    self._lib.pst_ssd_push_adagrad(
-                        self._native_handle(), ids.ctypes.data_as(_I64P),
-                        ids.shape[0], grads.ctypes.data_as(_F32P),
-                        ctypes.c_float(self.lr),
-                        ctypes.c_float(self.epsilon))
-                elif self.optimizer == "sum":
-                    self._lib.pst_ssd_push_delta(
-                        self._native_handle(), ids.ctypes.data_as(_I64P),
-                        ids.shape[0], grads.ctypes.data_as(_F32P))
-                else:
-                    raise ValueError(
-                        f"unknown optimizer {self.optimizer!r}")
+                self._native_push("pst_ssd", self._native_handle(),
+                                  ids, grads)
             return
         super().push_grad(ids, grads)
         with self._lock:
@@ -389,19 +387,19 @@ class SSDSparseTable(SparseTable):
     def resident_rows(self):
         """In-memory (hot) row count — observability for the LRU bound."""
         with self._lock:
-            if self._ssd_handle is not None:
+            if self._native_mode:
                 return int(self._lib.pst_ssd_resident(self._native_handle()))
             return len(self._rows)
 
     def spilled_rows(self):
         with self._lock:
-            if self._ssd_handle is not None:
+            if self._native_mode:
                 return int(self._lib.pst_ssd_spilled(self._native_handle()))
             return len(self._index)
 
     def __len__(self):
         with self._lock:
-            if self._ssd_handle is not None:
+            if self._native_mode:
                 return int(self._lib.pst_ssd_size(self._native_handle()))
             return len(self._rows) + len(self._index)
 
@@ -411,14 +409,18 @@ class SSDSparseTable(SparseTable):
         # spilled rows are peeked read-only so the export causes no LRU
         # churn
         with self._lock:
-            if self._ssd_handle is not None:
-                n = int(self._lib.pst_ssd_size(self._ssd_handle))
+            if self._native_mode:
+                h = self._native_handle()
+                n = int(self._lib.pst_ssd_size(h))
                 ids = np.empty(n, np.int64)
                 rows = np.empty((n, self.dim), np.float32)
                 if n:
-                    self._lib.pst_ssd_export(
-                        self._ssd_handle, ids.ctypes.data_as(_I64P),
-                        rows.ctypes.data_as(_F32P))
+                    # export returns the FILLED count: unreadable spill
+                    # records are skipped, never exported as garbage
+                    filled = int(self._lib.pst_ssd_export(
+                        h, ids.ctypes.data_as(_I64P),
+                        rows.ctypes.data_as(_F32P)))
+                    ids, rows = ids[:filled], rows[:filled]
                     order = np.argsort(ids, kind="stable")
                     ids, rows = ids[order], rows[order]
                 return {"ids": ids, "rows": rows}
@@ -435,7 +437,7 @@ class SSDSparseTable(SparseTable):
             return {"ids": np.asarray(ids, np.int64), "rows": rows}
 
     def load_state_dict(self, sd):
-        if self._ssd_handle is not None:
+        if self._native_mode:
             ids = np.ascontiguousarray(np.asarray(sd["ids"], np.int64))
             rows = np.ascontiguousarray(
                 np.asarray(sd["rows"], np.float32))
@@ -461,10 +463,11 @@ class SSDSparseTable(SparseTable):
             if self._ssd_handle is not None:
                 self._lib.pst_ssd_free(self._ssd_handle)
                 self._ssd_handle = None
-            try:
-                self._spill_f.close()
-            except Exception:  # noqa: BLE001 — already closed
-                pass
+            if self._spill_f is not None:
+                try:
+                    self._spill_f.close()
+                except Exception:  # noqa: BLE001 — already closed
+                    pass
         if getattr(self, "_owns_spill_dir", False) and \
                 os.path.isdir(self._spill_dir):
             shutil.rmtree(self._spill_dir, ignore_errors=True)

@@ -24,8 +24,14 @@ import numpy as np
 
 import jax
 
+from .ref_import import (  # noqa: F401  (reference-artifact import)
+    load_inference_params, load_vars_dir, read_program_persistables,
+    read_tensors,
+)
+
 __all__ = ["Config", "Tensor", "Predictor", "PredictorPool",
-           "create_predictor"]
+           "create_predictor", "load_inference_params", "load_vars_dir",
+           "read_program_persistables", "read_tensors"]
 
 
 class Config:

@@ -1,0 +1,238 @@
+"""Reference-artifact import: read PaddlePaddle `.pdmodel/.pdiparams`.
+
+Ref parity decision (VERDICT r4 item 10): the reference predictor
+interprets serialized ProgramDesc programs
+(paddle/fluid/inference/api/analysis_predictor.h:82).  This framework
+compiles StableHLO, not ProgramDesc — re-implementing a ProgramDesc
+INTERPRETER would mean reviving the op-by-op executor this design
+deliberately deleted (SURVEY §7), so program execution stays out of
+scope (documented in COVERAGE.md).  What users actually need to migrate
+is the WEIGHTS: this module reads the reference's binary formats
+exactly —
+
+- `.pdiparams` / save_combine files: back-to-back LoDTensor streams
+  (paddle/fluid/framework/lod_tensor.cc:244 SerializeToStream —
+  u32 version, LoD levels, then tensor_util.cc:774 TensorToStream:
+  u32 version, i32-length VarType.TensorDesc proto, raw data), ordered
+  SORTED BY NAME (fluid/io.py:408);
+- `.pdmodel`: the ProgramDesc protobuf, walked with a minimal
+  wire-format parser (framework.proto: blocks=1 > vars=3 >
+  {name=1, type=2{lod_tensor=3{tensor=1{data_type=1, dims=2}}},
+  persistable=3}) to recover persistable names/shapes/dtypes;
+- per-variable files written by save_vars without `filename` (one
+  tensor stream per file, file name = variable name).
+
+`load_inference_params(prefix)` zips the two and verifies every
+tensor's dims/dtype against its VarDesc.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+
+import numpy as np
+
+__all__ = [
+    "load_inference_params", "read_tensor_stream", "read_tensors",
+    "read_program_persistables",
+]
+
+# framework.proto VarType.Type -> numpy dtype (POD entries only)
+_DTYPES = {
+    0: np.dtype(np.bool_), 1: np.dtype(np.int16), 2: np.dtype(np.int32),
+    3: np.dtype(np.int64), 4: np.dtype(np.float16),
+    5: np.dtype(np.float32), 6: np.dtype(np.float64),
+    20: np.dtype(np.uint8), 21: np.dtype(np.int8),
+    22: np.dtype(np.uint16),  # BF16 carried as raw u16 (jax reinterprets)
+}
+
+
+# -- minimal protobuf wire parser -------------------------------------------
+
+
+def _read_varint(buf, pos):
+    result = 0
+    shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def _fields(buf):
+    """Yield (field_number, wire_type, value) over a protobuf message.
+    wire 0 -> int, wire 2 -> bytes, wire 1/5 -> raw fixed bytes."""
+    pos = 0
+    n = len(buf)
+    while pos < n:
+        key, pos = _read_varint(buf, pos)
+        field, wire = key >> 3, key & 0x7
+        if wire == 0:
+            val, pos = _read_varint(buf, pos)
+        elif wire == 2:
+            ln, pos = _read_varint(buf, pos)
+            val = buf[pos:pos + ln]
+            pos += ln
+        elif wire == 5:
+            val = buf[pos:pos + 4]
+            pos += 4
+        elif wire == 1:
+            val = buf[pos:pos + 8]
+            pos += 8
+        else:
+            raise ValueError(f"unsupported wire type {wire}")
+        yield field, wire, val
+
+
+def _parse_tensor_desc(buf):
+    """VarType.TensorDesc: data_type=1 (enum), dims=2 (repeated int64)."""
+    dtype = None
+    dims = []
+    for field, wire, val in _fields(buf):
+        if field == 1 and wire == 0:
+            dtype = val
+        elif field == 2:
+            if wire == 0:
+                dims.append(_to_signed(val))
+            else:  # packed
+                pos = 0
+                while pos < len(val):
+                    v, pos = _read_varint(val, pos)
+                    dims.append(_to_signed(v))
+    return dtype, dims
+
+
+def _to_signed(v):
+    return v - (1 << 64) if v >= (1 << 63) else v
+
+
+def _parse_var_desc(buf):
+    """VarDesc -> (name, persistable, dtype, dims) — dtype/dims from
+    type.lod_tensor.tensor when present."""
+    name, persistable, dtype, dims = None, False, None, None
+    for field, wire, val in _fields(buf):
+        if field == 1:
+            name = val.decode("utf-8")
+        elif field == 2:
+            for f2, w2, v2 in _fields(val):        # VarType
+                if f2 == 3:                         # lod_tensor
+                    for f3, w3, v3 in _fields(v2):  # LoDTensorDesc
+                        if f3 == 1:                 # tensor
+                            dtype, dims = _parse_tensor_desc(v3)
+        elif field == 3 and wire == 0:
+            persistable = bool(val)
+    return name, persistable, dtype, dims
+
+
+def read_program_persistables(pdmodel_path):
+    """Persistable LoDTensor variables of block 0 of a serialized
+    ProgramDesc: {name: (dims, numpy dtype)}."""
+    with open(pdmodel_path, "rb") as f:
+        buf = f.read()
+    out = {}
+    for field, wire, val in _fields(buf):
+        if field != 1:                  # ProgramDesc.blocks
+            continue
+        for f2, w2, v2 in _fields(val):
+            if f2 != 3:                 # BlockDesc.vars
+                continue
+            name, persistable, dtype, dims = _parse_var_desc(v2)
+            if persistable and dtype is not None and name not in (
+                    "feed", "fetch"):
+                out[name] = (dims, _DTYPES.get(dtype))
+        break                           # weights live in block 0
+    return out
+
+
+# -- tensor stream (.pdiparams / save_combine / per-var files) --------------
+
+
+def read_tensor_stream(f):
+    """One serialized LoDTensor from an open binary file; None at EOF."""
+    head = f.read(4)
+    if len(head) < 4:
+        return None
+    version = struct.unpack("<I", head)[0]
+    if version != 0:
+        raise ValueError(f"unsupported LoDTensor version {version}")
+    (lod_levels,) = struct.unpack("<Q", f.read(8))
+    for _ in range(lod_levels):
+        (nbytes,) = struct.unpack("<Q", f.read(8))
+        f.read(nbytes)                 # LoD offsets (unused: padded+mask)
+    (tversion,) = struct.unpack("<I", f.read(4))
+    if tversion != 0:
+        raise ValueError(f"unsupported tensor version {tversion}")
+    (desc_size,) = struct.unpack("<i", f.read(4))
+    dtype_enum, dims = _parse_tensor_desc(f.read(desc_size))
+    dt = _DTYPES.get(dtype_enum)
+    if dt is None:
+        raise ValueError(f"unsupported tensor dtype enum {dtype_enum}")
+    numel = int(np.prod(dims)) if dims else 1
+    data = f.read(numel * dt.itemsize)
+    if len(data) != numel * dt.itemsize:
+        raise ValueError("truncated tensor data")
+    return np.frombuffer(data, dt).reshape(dims).copy()
+
+
+def read_tensors(path):
+    """Every tensor in a combined file, in file order."""
+    out = []
+    with open(path, "rb") as f:
+        while True:
+            t = read_tensor_stream(f)
+            if t is None:
+                return out
+            out.append(t)
+
+
+def load_inference_params(prefix_or_model, params_path=None):
+    """{name: ndarray} from a reference `paddle.jit.save` /
+    `save_inference_model` export.
+
+    Accepts a path prefix (`x` -> `x.pdmodel` + `x.pdiparams`) or the
+    two explicit paths.  Combined params are stored sorted by name
+    (fluid/io.py:408): names come from the .pdmodel's persistable vars,
+    and every tensor is shape/dtype-checked against its VarDesc."""
+    if params_path is None:
+        pdmodel = prefix_or_model + ".pdmodel"
+        params_path = prefix_or_model + ".pdiparams"
+    else:
+        pdmodel = prefix_or_model
+    persistables = read_program_persistables(pdmodel)
+    names = sorted(persistables)
+    tensors = read_tensors(params_path)
+    if len(tensors) != len(names):
+        raise ValueError(
+            f"{params_path} holds {len(tensors)} tensors but the "
+            f"program declares {len(names)} persistables")
+    out = {}
+    for name, t in zip(names, tensors):
+        dims, dt = persistables[name]
+        want = [d if d >= 0 else t.shape[i] for i, d in enumerate(dims)]
+        if list(t.shape) != want:
+            raise ValueError(
+                f"shape mismatch for {name!r}: program says {dims}, "
+                f"params file has {list(t.shape)} — artifact pair "
+                "mismatch?")
+        if dt is not None and t.dtype != dt:
+            raise ValueError(
+                f"dtype mismatch for {name!r}: {dt} vs {t.dtype}")
+        out[name] = t
+    return out
+
+
+def load_vars_dir(dirname, names=None):
+    """Per-variable save_vars layout: one tensor file per variable,
+    file name == variable name.  The co-located program file
+    (`__model__` / `*.pdmodel`) is not a tensor and is skipped when
+    names are auto-discovered."""
+    if names is None:
+        names = sorted(
+            n for n in os.listdir(dirname)
+            if os.path.isfile(os.path.join(dirname, n))
+            and n != "__model__" and not n.endswith(".pdmodel"))
+    return {n: read_tensors(os.path.join(dirname, n))[0] for n in names}

@@ -1,0 +1,160 @@
+"""Reference-artifact import (VERDICT r4 item 10).
+
+The writer side of these tests re-implements the REFERENCE's binary
+formats from its sources (lod_tensor.cc:244 SerializeToStream,
+tensor_util.cc:774 TensorToStream, framework.proto field numbers,
+io.py:408 sorted-by-name combined order) so the reader is checked
+against an independent encoding, not against itself.
+"""
+
+import struct
+
+import numpy as np
+import pytest
+
+from paddle_tpu import inference
+
+_DT_ENUM = {np.dtype(np.float32): 5, np.dtype(np.int64): 3,
+            np.dtype(np.float64): 6, np.dtype(np.int32): 2}
+
+
+def _varint(v):
+    out = b""
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out += bytes([b | 0x80])
+        else:
+            return out + bytes([b])
+
+
+def _field(num, wire, payload):
+    key = _varint((num << 3) | wire)
+    if wire == 2:
+        return key + _varint(len(payload)) + payload
+    return key + payload
+
+
+def _tensor_desc(arr):
+    msg = _field(1, 0, _varint(_DT_ENUM[arr.dtype]))
+    for d in arr.shape:
+        msg += _field(2, 0, _varint(d))
+    return msg
+
+
+def _serialize_lod_tensor(arr, lod_levels=0):
+    out = struct.pack("<I", 0)                    # LoDTensor version
+    out += struct.pack("<Q", lod_levels)
+    for _ in range(lod_levels):
+        offs = np.asarray([0, 2], np.uint64)      # dummy level
+        out += struct.pack("<Q", offs.nbytes) + offs.tobytes()
+    out += struct.pack("<I", 0)                   # tensor version
+    desc = _tensor_desc(arr)
+    out += struct.pack("<i", len(desc)) + desc
+    out += np.ascontiguousarray(arr).tobytes()
+    return out
+
+
+def _var_desc(name, arr, persistable=True):
+    tensor = _tensor_desc(arr)
+    lod_desc = _field(1, 2, tensor)               # LoDTensorDesc.tensor
+    vtype = _field(1, 0, _varint(7))              # VarType.type=LOD_TENSOR
+    vtype += _field(3, 2, lod_desc)               # VarType.lod_tensor
+    msg = _field(1, 2, name.encode())
+    msg += _field(2, 2, vtype)
+    if persistable:
+        msg += _field(3, 0, _varint(1))
+    return msg
+
+
+def _program_desc(named_arrays, extra_nonpersistable=()):
+    block = _field(1, 0, _varint(0)) + _field(2, 0, _varint(0))
+    for name, arr in named_arrays:
+        block += _field(3, 2, _var_desc(name, arr))
+    for name, arr in extra_nonpersistable:
+        block += _field(3, 2, _var_desc(name, arr, persistable=False))
+    return _field(1, 2, block)                    # ProgramDesc.blocks[0]
+
+
+def _write_artifacts(tmp_path, named, prefix="model"):
+    named = list(named)
+    pdmodel = tmp_path / f"{prefix}.pdmodel"
+    pdiparams = tmp_path / f"{prefix}.pdiparams"
+    pdmodel.write_bytes(_program_desc(
+        named, extra_nonpersistable=[("x", np.zeros((1, 4), np.float32))]))
+    with open(pdiparams, "wb") as f:
+        for name, arr in sorted(named):           # io.py:408 sorted order
+            f.write(_serialize_lod_tensor(arr))
+    return str(tmp_path / prefix)
+
+
+def test_load_inference_params_roundtrip(tmp_path):
+    rs = np.random.RandomState(0)
+    named = [
+        ("fc_0.w_0", rs.randn(8, 16).astype(np.float32)),
+        ("fc_0.b_0", rs.randn(16).astype(np.float32)),
+        ("emb.w_0", rs.randint(-5, 5, (32, 8)).astype(np.int64)
+         .astype(np.float32)),
+        ("scale", rs.randn(1).astype(np.float32)),
+    ]
+    prefix = _write_artifacts(tmp_path, named)
+    got = inference.load_inference_params(prefix)
+    assert set(got) == {n for n, _ in named}
+    for name, arr in named:
+        np.testing.assert_array_equal(got[name], arr)
+        assert got[name].dtype == arr.dtype
+
+
+def test_lod_levels_and_int64(tmp_path):
+    arr = np.arange(12, dtype=np.int64).reshape(3, 4)
+    path = tmp_path / "t.bin"
+    path.write_bytes(_serialize_lod_tensor(arr, lod_levels=1))
+    (got,) = inference.read_tensors(str(path))
+    np.testing.assert_array_equal(got, arr)
+
+
+def test_program_persistables_skips_feed_fetch_and_nonpersistable(tmp_path):
+    named = [("w", np.zeros((2, 2), np.float32))]
+    prefix = _write_artifacts(tmp_path, named)
+    pers = inference.read_program_persistables(prefix + ".pdmodel")
+    assert set(pers) == {"w"}
+    assert pers["w"] == ([2, 2], np.dtype(np.float32))
+
+
+def test_mismatched_artifacts_raise(tmp_path):
+    named = [("a", np.zeros((2, 3), np.float32)),
+             ("b", np.zeros((4,), np.float32))]
+    prefix = _write_artifacts(tmp_path, named)
+    # count mismatch
+    with open(prefix + ".pdiparams", "wb") as f:
+        f.write(_serialize_lod_tensor(np.zeros((2, 3), np.float32)))
+    with pytest.raises(ValueError, match="declares 2 persistables"):
+        inference.load_inference_params(prefix)
+    # shape mismatch
+    with open(prefix + ".pdiparams", "wb") as f:
+        f.write(_serialize_lod_tensor(np.zeros((9, 9), np.float32)))
+        f.write(_serialize_lod_tensor(np.zeros((4,), np.float32)))
+    with pytest.raises(ValueError, match="shape mismatch"):
+        inference.load_inference_params(prefix)
+
+
+def test_loaded_weights_drive_a_model(tmp_path):
+    """End-to-end migration: imported reference weights populate an
+    equivalent paddle_tpu model and produce the expected output."""
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+
+    rs = np.random.RandomState(1)
+    w = rs.randn(4, 3).astype(np.float32)
+    b = rs.randn(3).astype(np.float32)
+    prefix = _write_artifacts(
+        tmp_path, [("linear_0.w_0", w), ("linear_0.b_0", b)])
+    params = inference.load_inference_params(prefix)
+
+    lin = nn.Linear(4, 3)
+    lin.weight.set_value(params["linear_0.w_0"])
+    lin.bias.set_value(params["linear_0.b_0"])
+    x = rs.randn(2, 4).astype(np.float32)
+    got = np.asarray(lin(paddle.to_tensor(x)).numpy())
+    np.testing.assert_allclose(got, x @ w + b, rtol=1e-5)
