@@ -234,5 +234,17 @@ def load_vars_dir(dirname, names=None):
         names = sorted(
             n for n in os.listdir(dirname)
             if os.path.isfile(os.path.join(dirname, n))
-            and n != "__model__" and not n.endswith(".pdmodel"))
-    return {n: read_tensors(os.path.join(dirname, n))[0] for n in names}
+            and n != "__model__"
+            and not n.endswith((".pdmodel", ".pdiparams",
+                                ".pdiparams.info", ".pdopt"))
+            and os.path.getsize(os.path.join(dirname, n)) > 0)
+    out = {}
+    for n in names:
+        tensors = read_tensors(os.path.join(dirname, n))
+        if len(tensors) != 1:
+            raise ValueError(
+                f"{n!r} holds {len(tensors)} tensors — not a "
+                "per-variable save_vars file (combined files go "
+                "through load_inference_params)")
+        out[n] = tensors[0]
+    return out

@@ -2701,7 +2701,45 @@ case("fused_bn_act", [_BNX, _BNS, _BNB, _BNM, _BNV],
      ref=lambda x, s, b, m, v, act, is_test: _np_fused_bn_act(
          x, s, b, m, v, act=act, is_test=is_test),
      grad=(0, 1, 2), rtol=1e-4, atol=1e-5)
-# fd-certify through the smooth identity-act case (relu kinks sit at
-# z=0 where standardized activations cluster — same curation rule that
-# keeps relu itself out of FD_OPS)
+# fd-certify through the smooth identity-act case: fused_bn_act's relu
+# kinks sit at z=0 where STANDARDIZED activations cluster, so no input
+# choice gives the fd probe a margin (unlike plain relu, whose case
+# inputs can be and are kept away from 0)
 FD_OPS["fused_bn_act"] = {"case": 2}
+
+
+# ---- round-5 fd-certification extension (VERDICT r4 item 9) ----
+#
+# The curation rule stays "smooth or C1" — but smoothness is a property
+# of the op AT THE CASE'S FIXED INPUTS: piecewise ops whose deterministic
+# case inputs sit away from every kink/tie fd-certify exactly (the fd
+# probe is +-eps*(1+|x|) with eps=1e-3; inputs here keep >=10x margin).
+# Excluded by design: the fake-quant trio (straight-through estimator —
+# the ANALYTIC grad intentionally differs from the true staircase
+# derivative fd measures) and ops with no dispatch grad case.
+for _op in """
+abs alltoall amax amin batch_fc bilateral_slice c_allgather
+c_allreduce_sum c_concat c_identity c_reducescatter c_split ceil celu
+center_loss clip conv3d_transpose correlation crop crop_tensor
+cross_entropy2 cvm deformable_conv deformable_conv_v1
+depthwise_conv2d_transpose elementwise_max elementwise_min
+elementwise_pow elu filter_by_instag floor fmax fmin
+frac fusion_repeated_fc_relu fusion_squared_mat_sub hardshrink
+hardsigmoid hardswish hardtanh hinge_loss increment inplace_abn
+kthvalue l1_loss l1_norm leaky_relu lstm_unit margin_rank_loss
+margin_ranking_loss max_pool2d_with_index max_pool3d_with_index maximum
+maxout minimum nce norm prelu prroi_pool psroi_pool reduce_max
+reduce_min reduce_prod relu relu6 round sample_logits segment_pool
+selu sequence_expand sequence_scatter sequence_slice shuffle_batch
+softplus_default softshrink sort_op tanh_shrink thresholded_relu
+top_k_v2 trunc unpool var_conv_2d yolov3_loss
+""".split():
+    FD_OPS.setdefault(_op, {})
+
+# elementwise_mod is discontinuous where a/b crosses an integer; the
+# generic case straddles those lines, so fd runs on a margin-safe case
+# (a in (0.1, 0.4), b in (1, 2): a/b stays inside (0, 0.4))
+case("elementwise_mod",
+     [f32((3, 4), 0.1, 0.4, seed=130), f32((3, 4), 1.0, 2.0, seed=131)],
+     ref=np.mod, grad=(0, 1))
+FD_OPS["elementwise_mod"] = {"case": 1}

@@ -261,3 +261,10 @@ def test_grad_fd(op):
             np.asarray(an_vals), np.asarray(fd_vals), rtol=rtol, atol=atol,
             err_msg=f"{op} analytic-vs-finite-difference mismatch "
                     f"(wrt input {j})")
+
+
+def test_fd_coverage_floor():
+    """VERDICT r4 item 9: independent finite-difference certification
+    must cover the smooth(-at-case-inputs) remainder — the floor only
+    ratchets up."""
+    assert len(FD_OPS) >= 290, len(FD_OPS)
