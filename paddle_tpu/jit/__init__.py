@@ -51,6 +51,8 @@ class StaticFunction:
         self._input_spec = input_spec
         self._layer = layer
         self._jitted = None
+        self._writeback = None
+        self._read_entry = None
 
     def _get_layer(self):
         if self._layer is not None:
@@ -70,6 +72,17 @@ class StaticFunction:
             # AST pass: tensor-dependent if/while/for lower to lax
             # control flow instead of failing at trace time
             fn = maybe_rewrite(fn)
+
+        # global/nonlocal cell passing: jit the INNER function (whose
+        # returns pack the cell finals as data), read the LIVE entry
+        # values per call (threaded as jit inputs, never baked into the
+        # cached program), and apply the write-back to the concrete
+        # outputs outside the trace — a traced store into a Python cell
+        # would leak tracers
+        self._writeback = getattr(fn, "__d2s_writeback__", None)
+        self._read_entry = getattr(fn, "__d2s_read_entry__", None)
+        if self._writeback is not None:
+            fn = fn.__d2s_inner__
 
         if layer is not None:
             # call the original forward, not layer() — when to_static
@@ -142,6 +155,17 @@ class StaticFunction:
                     static_idx.append(i + offset)
             else:
                 arrs.append(jnp.asarray(a))
+        if self._read_entry is not None:
+            # live cell/global entry values, traced so the cached
+            # program recomputes from the CURRENT state every call
+            for v in self._read_entry():
+                if isinstance(v, Tensor):
+                    arrs.append(v._value)
+                elif isinstance(v, (bool, int, float, _np.ndarray,
+                                    jax.Array)):
+                    arrs.append(jnp.asarray(v))
+                else:
+                    arrs.append(v)  # pytree (list/dict) or sentinel
         key = tuple(static_idx)
         if key not in self._jitted:
             self._jitted[key] = jax.jit(self._run, static_argnums=key)
@@ -150,6 +174,9 @@ class StaticFunction:
             out = self._jitted[key](state_values(layer), *arrs)
         else:
             out = self._jitted[key](*arrs)
+        if self._writeback is not None:
+            out, cvals, gvals = out
+            self._writeback(cvals, gvals)
         return jax.tree.map(Tensor, out)
 
     @property

@@ -31,9 +31,28 @@ Mechanics (mirrors the reference's UndefinedVar machinery):
   normalisation; the return-value slot starts as an AutoZero sentinel
   the runtime promotes to structure-matched zeros (never observable —
   every read is guarded by the flag);
-- functions using global/nonlocal, escapes inside try blocks, and
-  For loops over non-range iterables containing escapes fall back to
-  the trace-based path unchanged (documented gap);
+- `global`/`nonlocal` lower via cell passing (`_lower_cell_vars`, ref
+  variable_trans_func.py): declared names are entry-loaded into plain
+  locals (threading through lax control flow like any stored name) and
+  every exit packs the finals into the return (`_d2s_cpack`); the
+  caller-side wrapper stores them back OUTSIDE any jit trace
+  (to_static jits `__d2s_inner__` and applies `__d2s_writeback__` to
+  concrete outputs).  Documented divergence: stores become visible at
+  function exit, not per assignment;
+- escapes inside `try` BODIES / except handlers / else lower through
+  the same flag pre-pass (setting a flag never raises and never jumps,
+  so handler reachability and `finally` timing match Python exactly);
+- For over non-range iterables with escapes desugars to a counter over
+  an indexable view (`_d2s_seq`/`_d2s_getitem`): sequences index
+  live (Python's own list iterator is index-based, so mid-loop
+  mutation behaves identically), generic iterables materialise once,
+  and when a traced escape lowers the loop, python sequences densify
+  to arrays (tensor iterables index dynamically as-is);
+- REMAINING trace fallbacks, each with a written argument: escapes
+  inside `finally` (Python's finally-escape OVERRIDES an in-flight
+  try-body escape — an ordering the forward-only flag rewrite cannot
+  express, see _escape_inside_finally) and functions whose source is
+  unavailable (exec/REPL);
 - an in-loop `return x` in a function that can also fall off the end
   (implicit None) cannot trace — the structures differ; the cond join
   raises a TypeError explaining the fix (concrete inputs still run
@@ -325,6 +344,75 @@ def convert_logical_not(a):
     return not ab
 
 
+def _d2s_seq(it):
+    """Indexable view of a for-loop iterable: sequences/arrays/Tensors
+    pass through (index-based iteration, mutation-visible like Python's
+    list iterator); other iterables materialise once."""
+    if isinstance(it, (list, tuple, Tensor)) or hasattr(it, "shape"):
+        return it
+    return list(it)
+
+
+def _d2s_seq_len(s):
+    if isinstance(s, (list, tuple)):
+        return len(s)
+    return int(s.shape[0])
+
+
+def _d2s_getitem(seq, i):
+    """Loop-element fetch: plain indexing while the counter is
+    concrete; when the loop has lowered to lax.while (traced escape
+    predicate) a python sequence densifies to an array so the traced
+    counter can index it — non-uniform sequences cannot, with a clear
+    error."""
+    iv = _unwrap(i)
+    if isinstance(seq, (list, tuple)) and isinstance(iv, jax.core.Tracer):
+        try:
+            arr = jnp.asarray(
+                [_unwrap(v) for v in seq])
+        except (TypeError, ValueError) as e:
+            raise TypeError(
+                "dy2static: a for-loop with a tensor-dependent escape "
+                "over a non-uniform python sequence cannot lower to "
+                "compiled control flow; iterate a tensor or make the "
+                "escape predicate concrete") from e
+        return Tensor(arr[iv])
+    return seq[i]
+
+
+def _d2s_cget(cell):
+    try:
+        return cell.cell_contents
+    except ValueError:
+        return UNDEF
+
+
+def _d2s_gget(gdict, name):
+    try:
+        return gdict[name]
+    except KeyError:
+        import builtins
+
+        return getattr(builtins, name, UNDEF)
+
+
+def _d2s_cpack(val, cvals, gvals):
+    """Returns thread cell/global write-backs as data: the to_static
+    wrapper applies them OUTSIDE the jitted program (a traced store
+    into a Python cell would leak tracers), the eager wrapper applies
+    them immediately."""
+    return val, tuple(cvals), tuple(gvals)
+
+
+def _write_cells(cells, cvals, gdict, gnames, gvals):
+    for c, v in zip(cells, cvals):
+        if v is not UNDEF:
+            c.cell_contents = v
+    for n, v in zip(gnames, gvals):
+        if v is not UNDEF:
+            gdict[n] = v
+
+
 _HELPERS = {
     "_d2s_if": convert_ifelse,
     "_d2s_while": convert_while_loop,
@@ -333,6 +421,12 @@ _HELPERS = {
     "_d2s_not": convert_logical_not,
     "_d2s_ld": _d2s_ld,
     "_d2s_auto": AUTOZERO,
+    "_d2s_seq": _d2s_seq,
+    "_d2s_seq_len": _d2s_seq_len,
+    "_d2s_getitem": _d2s_getitem,
+    "_d2s_cget": _d2s_cget,
+    "_d2s_gget": _d2s_gget,
+    "_d2s_cpack": _d2s_cpack,
 }
 
 
@@ -526,14 +620,37 @@ def _loop_escapes(body):
     return has_ret, has_brk, has_cnt
 
 
-def _escape_inside_try(body):
-    """True if an escape this loop must handle sits inside a try block
-    (finally/except interplay with the flag rewrite is not modelled)."""
-    return _scan_scope(
-        body,
-        lambda n, nested, in_try: in_try and (
-            isinstance(n, ast.Return) or (not nested and isinstance(
-                n, (ast.Break, ast.Continue)))))
+def _escape_inside_finally(body, *, in_loop=False, in_finally=False):
+    """True if an escape this loop must handle sits inside a `finally`
+    block.  Escapes in try BODIES / except handlers / else lower fine
+    with the flag rewrite — setting a flag never raises, so handler
+    reachability is unchanged, and because the flag form never JUMPS,
+    the finally still runs at exactly the point Python would run it
+    before the escape.  A `finally`-resident escape is different: in
+    Python it OVERRIDES any in-flight return/break from the try body,
+    an ordering the forward-only flag rewrite cannot express — written
+    impossibility argument, kept as a documented trace fallback."""
+    for n in body:
+        if isinstance(n, _NESTED_SCOPES):
+            continue
+        if in_finally and (isinstance(n, ast.Return) or (
+                not in_loop and isinstance(n, (ast.Break, ast.Continue)))):
+            return True
+        if isinstance(n, ast.Try):
+            blocks = [(n.body, in_finally), (n.orelse, in_finally),
+                      (n.finalbody, True)]
+            blocks += [(h.body, in_finally) for h in n.handlers]
+            for blk, fin in blocks:
+                if _escape_inside_finally(blk, in_loop=in_loop,
+                                          in_finally=fin):
+                    return True
+        else:
+            if _escape_inside_finally(
+                    list(ast.iter_child_nodes(n)),
+                    in_loop=in_loop or isinstance(n, (ast.For, ast.While)),
+                    in_finally=in_finally):
+                return True
+    return False
 
 
 def _range_for_parts(node, ivar):
@@ -573,6 +690,34 @@ def _range_for_parts(node, ivar):
     return init, test, bind, bump
 
 
+def _seq_for_parts(node, ivar, seqvar):
+    """Decompose `for <target> in <iterable>` (non-range) into counter
+    form over an indexable sequence: lists/tuples/arrays/Tensors index
+    directly (and, like Python's index-based list iterator, observe
+    mutations mid-loop — the length is re-read per iteration); other
+    iterables are materialised once.  Tensor sequences stay Tensors, so
+    a traced escape predicate lowers the loop to lax.while with
+    dynamic row indexing (ref loop_transformer.py's for-iterable
+    desugar)."""
+    if isinstance(node.iter, (ast.Starred,)):
+        return None
+    init = [ast.Assign(targets=[_name(seqvar, ast.Store())],
+                       value=ast.Call(func=_name("_d2s_seq"),
+                                      args=[node.iter], keywords=[])),
+            _assign(ivar, ast.Constant(0))]
+    test = ast.Compare(
+        left=_name(ivar), ops=[ast.Lt()],
+        comparators=[ast.Call(func=_name("_d2s_seq_len"),
+                              args=[_name(seqvar)], keywords=[])])
+    bind = ast.Assign(
+        targets=[node.target],
+        value=ast.Call(func=_name("_d2s_getitem"),
+                       args=[_name(seqvar), _name(ivar)], keywords=[]))
+    bump = ast.AugAssign(target=_name(ivar, ast.Store()),
+                         op=ast.Add(), value=ast.Constant(1))
+    return init, test, bind, bump
+
+
 class _LoopEscapeLowerer(ast.NodeTransformer):
     """Pre-pass: lower return/break/continue INSIDE While/For(range)
     bodies into escape flags threaded through the loop (ref
@@ -608,7 +753,7 @@ class _LoopEscapeLowerer(ast.NodeTransformer):
         has_ret, has_brk, has_cnt = _loop_escapes(body)
         if not (has_ret or has_brk or has_cnt):
             return None
-        if _escape_inside_try(body) or _returns_inside_loops(body):
+        if _escape_inside_finally(body) or _returns_inside_loops(body):
             # nested loop kept its returns (it was itself unliftable):
             # rewriting them here would change the inner loop's meaning
             return None
@@ -630,6 +775,8 @@ class _LoopEscapeLowerer(ast.NodeTransformer):
         n = self._next()
         ivar = f"__d2s_fi{n}"
         parts = _range_for_parts(node, ivar)
+        if parts is None:
+            parts = _seq_for_parts(node, ivar, f"__d2s_fq{n}")
         if parts is None:
             return node
         init, test, bind, bump = parts
@@ -672,6 +819,27 @@ class _LoopEscapeLowerer(ast.NodeTransformer):
                     elif isinstance(s, ast.Match):
                         for c in s.cases:
                             c.body = xf(c.body)
+                    elif isinstance(s, ast.Try):
+                        # escapes in try BODIES/handlers/else lower: the
+                        # flag form never jumps, so the finally runs at
+                        # exactly Python's pre-escape point (escapes IN
+                        # finalbody were rejected by _liftable)
+                        s.body = xf(s.body)
+                        body_sets = any(
+                            isinstance(m, ast.Name)
+                            and isinstance(m.ctx, ast.Store)
+                            and m.id in flag_names
+                            for st in s.body for m in ast.walk(st))
+                        for h in s.handlers:
+                            h.body = xf(h.body)
+                        s.orelse = xf(s.orelse)
+                        if body_sets and s.orelse:
+                            # Python skips `else` when the try suite
+                            # exits via an escape; the flag form exits
+                            # normally, so gate the else on the flags
+                            s.orelse = [ast.If(test=guard_expr(),
+                                               body=s.orelse,
+                                               orelse=[])]
                     repl = [s]
                 out.extend(repl)
                 sets_flag = any(
@@ -895,6 +1063,76 @@ class _ControlFlowTransformer(ast.NodeTransformer):
 # ---------------------------------------------------------------------------
 
 
+def _lower_cell_vars(fdef):
+    """Lower `global`/`nonlocal` declarations to cell passing (ref
+    variable_trans_func.py's nonlocal/cell machinery): declarations are
+    stripped, each declared name is entry-loaded into a plain local (so
+    the lifting/loop machinery threads it like any stored name, incl.
+    through lax control flow), and every exit packs the finals into the
+    return value (`_d2s_cpack`) — the caller-side wrapper performs the
+    actual cell/global stores, OUTSIDE any jit trace.
+
+    Known divergence (documented): the store becomes visible at
+    function EXIT, not at each assignment — a nested call observing the
+    cell mid-execution sees the entry value.
+
+    Returns (nonlocal_names, global_names)."""
+    gnames, nnames = set(), set()
+    for n in _walk_scope(fdef.body):
+        if isinstance(n, ast.Global):
+            gnames.update(n.names)
+        elif isinstance(n, ast.Nonlocal):
+            nnames.update(n.names)
+    if not gnames and not nnames:
+        return (), ()
+    nnames, gnames = sorted(nnames), sorted(gnames)
+
+    class _Strip(ast.NodeTransformer):
+        def visit_FunctionDef(self, node):
+            return node
+
+        def visit_AsyncFunctionDef(self, node):
+            return node
+
+        def visit_Lambda(self, node):
+            return node
+
+        def visit_ClassDef(self, node):
+            return node
+
+        def visit_Global(self, node):
+            return ast.Pass()
+
+        def visit_Nonlocal(self, node):
+            return ast.Pass()
+
+    def pack_call(value):
+        return ast.Call(
+            func=_name("_d2s_cpack"),
+            args=[value if value is not None else ast.Constant(None),
+                  ast.Tuple(elts=[_name(x) for x in nnames],
+                            ctx=ast.Load()),
+                  ast.Tuple(elts=[_name(x) for x in gnames],
+                            ctx=ast.Load())],
+            keywords=[])
+
+    class _WrapReturns(_Strip):
+        def visit_Return(self, node):
+            return ast.Return(value=pack_call(node.value))
+
+    fdef.body = [_WrapReturns().visit(s) for s in fdef.body]
+    if not _definitely_returns(fdef.body):
+        fdef.body.append(ast.Return(value=pack_call(None)))
+    # entry values arrive as TRAILING PARAMETERS (declared names cannot
+    # collide with existing params — Python forbids global/nonlocal of a
+    # parameter), so each call threads the CURRENT cell/global values
+    # through jit as inputs instead of baking trace-time constants into
+    # the cached program
+    for x in list(nnames) + list(gnames):
+        fdef.args.args.append(ast.arg(arg=x))
+    return tuple(nnames), tuple(gnames)
+
+
 def rewrite(fn):
     """AST-rewrite `fn`'s control flow. Raises on untransformable input;
     use maybe_rewrite for the fall-back-to-trace behavior."""
@@ -906,6 +1144,14 @@ def rewrite(fn):
     if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
         raise ValueError("to_static target is not a function")
     fdef.decorator_list = []
+    nnames, gnames = _lower_cell_vars(fdef)
+    cells = ()
+    if nnames:
+        free = raw.__code__.co_freevars
+        if raw.__closure__ is None or any(x not in free for x in nnames):
+            raise ValueError(
+                f"nonlocal names {nnames} have no closure cells")
+        cells = tuple(raw.__closure__[free.index(x)] for x in nnames)
     # lower loop-body return/break/continue to escape flags first, so
     # the early-return normalisation below sees loop-free returns
     fdef.body = _lower_loop_escapes(fdef.body)
@@ -948,6 +1194,30 @@ def rewrite(fn):
                       [c.cell_contents for c in raw.__closure__]))
     exec(code, ns)
     new_fn = ns[raw.__name__]
+    if nnames or gnames:
+        inner = new_fn
+        gdict = raw.__globals__
+
+        def read_entry():
+            return tuple(_d2s_cget(c) for c in cells) + tuple(
+                _d2s_gget(gdict, n) for n in gnames)
+
+        def writeback(cvals, gvals):
+            _write_cells(cells, cvals, gdict, gnames, gvals)
+
+        def outer(*a, **k):
+            out, cvals, gvals = inner(*a, *read_entry(), **k)
+            writeback(cvals, gvals)
+            return out
+
+        # to_static jits __d2s_inner__ (packed returns), reads the
+        # LIVE entry values per call via __d2s_read_entry__ (threading
+        # them as jit inputs), and applies __d2s_writeback__ to the
+        # CONCRETE outputs outside the trace
+        outer.__d2s_inner__ = inner
+        outer.__d2s_read_entry__ = read_entry
+        outer.__d2s_writeback__ = writeback
+        new_fn = outer
     new_fn = functools.wraps(raw)(new_fn)
     if bound_self is not None:
         return types.MethodType(new_fn, bound_self)
