@@ -48,44 +48,11 @@ _DTYPES = {
 }
 
 
-# -- minimal protobuf wire parser -------------------------------------------
+# -- minimal protobuf wire parser (shared: utils/protowire.py) --------------
 
-
-def _read_varint(buf, pos):
-    result = 0
-    shift = 0
-    while True:
-        b = buf[pos]
-        pos += 1
-        result |= (b & 0x7F) << shift
-        if not b & 0x80:
-            return result, pos
-        shift += 7
-
-
-def _fields(buf):
-    """Yield (field_number, wire_type, value) over a protobuf message.
-    wire 0 -> int, wire 2 -> bytes, wire 1/5 -> raw fixed bytes."""
-    pos = 0
-    n = len(buf)
-    while pos < n:
-        key, pos = _read_varint(buf, pos)
-        field, wire = key >> 3, key & 0x7
-        if wire == 0:
-            val, pos = _read_varint(buf, pos)
-        elif wire == 2:
-            ln, pos = _read_varint(buf, pos)
-            val = buf[pos:pos + ln]
-            pos += ln
-        elif wire == 5:
-            val = buf[pos:pos + 4]
-            pos += 4
-        elif wire == 1:
-            val = buf[pos:pos + 8]
-            pos += 8
-        else:
-            raise ValueError(f"unsupported wire type {wire}")
-        yield field, wire, val
+from ..utils.protowire import (  # noqa: E402
+    fields as _fields, read_varint as _read_varint,
+)
 
 
 def _parse_tensor_desc(buf):

@@ -81,8 +81,74 @@ class StaticFunction:
         # would leak tracers
         self._writeback = getattr(fn, "__d2s_writeback__", None)
         self._read_entry = getattr(fn, "__d2s_read_entry__", None)
+        cell_names = getattr(fn, "__d2s_cell_names__", ())
+        self._cell_names = cell_names
+        self._cell_stash = {}
         if self._writeback is not None:
             fn = fn.__d2s_inner__
+        n_cells = len(cell_names)
+        stash = self._cell_stash
+        from .dy2static import UNDEF as _UNDEF
+
+        def _is_arrayish(u):
+            return isinstance(u, (bool, int, float, jax.Array)) or (
+                hasattr(u, "dtype") and hasattr(u, "shape"))
+
+        def _split_cells(arrs):
+            if not n_cells:
+                return arrs, {}
+            user, extra = arrs[:-n_cells], arrs[-n_cells:]
+            kw = {nm: (Tensor(v) if isinstance(v, jax.Array) else v)
+                  for nm, v in zip(cell_names, extra)}
+            return user, kw
+
+        def _cell_sig(extra_vals):
+            """Hashable signature of the NON-array cell inputs — keys
+            the stash so per-static-value retraces never serve another
+            value's stashed write-back."""
+            sig = []
+            for j, v in enumerate(extra_vals):
+                u = v._value if isinstance(v, Tensor) else v
+                if not _is_arrayish(u):
+                    try:
+                        hash(u)
+                        sig.append((j, u))
+                    except TypeError:
+                        sig.append((j, id(u)))
+            return tuple(sig)
+
+        def _sanitize(vals, kind, sig):
+            """Cell write-back values leaving the jitted program: arrays
+            pass through; non-array trace-time constants (str/objects)
+            are stashed under the static-input signature and replaced by
+            the UNDEF pytree node (valid jit output structure, no
+            leaves) — the caller substitutes the stash back."""
+            out = []
+            for j, v in enumerate(vals):
+                u = v._value if isinstance(v, Tensor) else v
+                if _is_arrayish(u):
+                    out.append(u)
+                else:
+                    if u is not _UNDEF:
+                        stash[(sig, kind, j)] = u
+                    out.append(_UNDEF)
+            return tuple(out)
+
+        def _pack_out(out, kw):
+            if self._writeback is None:
+                return jax.tree.map(
+                    lambda t: t._value if isinstance(t, Tensor) else t,
+                    out, is_leaf=lambda t: isinstance(t, Tensor))
+            o, cv, gv = out
+            o = jax.tree.map(
+                lambda t: t._value if isinstance(t, Tensor) else t, o,
+                is_leaf=lambda t: isinstance(t, Tensor))
+            sig = _cell_sig(tuple(kw.values()))
+            nn = len(cv)
+            both = _sanitize(tuple(cv) + tuple(gv), "cg", sig)
+            return o, both[:nn], both[nn:]
+
+        self._cell_sig = _cell_sig
 
         if layer is not None:
             # call the original forward, not layer() — when to_static
@@ -94,22 +160,24 @@ class StaticFunction:
             def run(values, *arrs):
                 from ..core.config import no_tape
 
+                user, kw = _split_cells(arrs)
                 wrapped = [Tensor(a) if isinstance(a, jax.Array) else a
-                           for a in arrs]
+                           for a in user]
                 with no_tape(), _swap_state(layer, values):
-                    out = orig_forward(*wrapped)
+                    out = orig_forward(*wrapped, **kw)
+                if self._writeback is not None:
+                    return _pack_out(out, kw)
                 return _unwrap(out)
 
             self._run = run
             self._with_values = True
         else:
             def run(*arrs):
+                user, kw = _split_cells(arrs)
                 wrapped = [Tensor(a) if isinstance(a, jax.Array) else a
-                           for a in arrs]
-                out = fn(*wrapped)
-                return jax.tree.map(
-                    lambda t: t._value if isinstance(t, Tensor) else t, out,
-                    is_leaf=lambda t: isinstance(t, Tensor))
+                           for a in user]
+                out = fn(*wrapped, **kw)
+                return _pack_out(out, kw)
 
             self._run = run
             self._with_values = False
@@ -155,17 +223,26 @@ class StaticFunction:
                     static_idx.append(i + offset)
             else:
                 arrs.append(jnp.asarray(a))
+        entry_vals = None
         if self._read_entry is not None:
-            # live cell/global entry values, traced so the cached
-            # program recomputes from the CURRENT state every call
-            for v in self._read_entry():
-                if isinstance(v, Tensor):
-                    arrs.append(v._value)
-                elif isinstance(v, (bool, int, float, _np.ndarray,
-                                    jax.Array)):
-                    arrs.append(jnp.asarray(v))
+            # live cell/global entry values, threaded so the cached
+            # program recomputes from the CURRENT state every call:
+            # numerics trace; hashable non-arrays (str/enums/objects)
+            # become STATIC args (value-keyed recompile — exact
+            # semantics per distinct value); list/dict pytrees trace
+            # their leaves
+            entry_vals = self._read_entry()
+            for v in entry_vals:
+                u = v._value if isinstance(v, Tensor) else v
+                if isinstance(u, jax.Array):
+                    arrs.append(u)
+                elif isinstance(u, (bool, int, float, _np.ndarray)):
+                    arrs.append(jnp.asarray(u))
+                elif isinstance(u, (list, dict)):
+                    arrs.append(u)          # pytree leaves trace
                 else:
-                    arrs.append(v)  # pytree (list/dict) or sentinel
+                    arrs.append(u)
+                    static_idx.append(offset + len(arrs) - 1)
         key = tuple(static_idx)
         if key not in self._jitted:
             self._jitted[key] = jax.jit(self._run, static_argnums=key)
@@ -176,6 +253,22 @@ class StaticFunction:
             out = self._jitted[key](*arrs)
         if self._writeback is not None:
             out, cvals, gvals = out
+            from .dy2static import UNDEF as _UNDEF
+
+            n_cells = len(self._cell_names)
+            sig = self._cell_sig(tuple(entry_vals)) \
+                if entry_vals is not None else ()
+            nn = len(cvals)
+
+            def resolve(kind_j, v):
+                if v is _UNDEF:
+                    return self._cell_stash.get((sig, "cg", kind_j),
+                                                _UNDEF)
+                return v
+
+            cvals = tuple(resolve(j, v) for j, v in enumerate(cvals))
+            gvals = tuple(resolve(nn + j, v)
+                          for j, v in enumerate(gvals))
             self._writeback(cvals, gvals)
         return jax.tree.map(Tensor, out)
 

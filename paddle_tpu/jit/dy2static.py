@@ -1123,13 +1123,15 @@ def _lower_cell_vars(fdef):
     fdef.body = [_WrapReturns().visit(s) for s in fdef.body]
     if not _definitely_returns(fdef.body):
         fdef.body.append(ast.Return(value=pack_call(None)))
-    # entry values arrive as TRAILING PARAMETERS (declared names cannot
-    # collide with existing params — Python forbids global/nonlocal of a
-    # parameter), so each call threads the CURRENT cell/global values
-    # through jit as inputs instead of baking trace-time constants into
-    # the cached program
+    # entry values arrive as KEYWORD-ONLY parameters (declared names
+    # cannot collide with existing params — Python forbids
+    # global/nonlocal of a parameter; keyword-only also cannot disturb
+    # positional binding of defaults or *args), so each call threads
+    # the CURRENT cell/global values through jit as inputs instead of
+    # baking trace-time constants into the cached program
     for x in list(nnames) + list(gnames):
-        fdef.args.args.append(ast.arg(arg=x))
+        fdef.args.kwonlyargs.append(ast.arg(arg=x))
+        fdef.args.kw_defaults.append(None)
     return tuple(nnames), tuple(gnames)
 
 
@@ -1198,6 +1200,8 @@ def rewrite(fn):
         inner = new_fn
         gdict = raw.__globals__
 
+        cell_names = tuple(nnames) + tuple(gnames)
+
         def read_entry():
             return tuple(_d2s_cget(c) for c in cells) + tuple(
                 _d2s_gget(gdict, n) for n in gnames)
@@ -1206,16 +1210,19 @@ def rewrite(fn):
             _write_cells(cells, cvals, gdict, gnames, gvals)
 
         def outer(*a, **k):
-            out, cvals, gvals = inner(*a, *read_entry(), **k)
+            entry = dict(zip(cell_names, read_entry()))
+            out, cvals, gvals = inner(*a, **k, **entry)
             writeback(cvals, gvals)
             return out
 
         # to_static jits __d2s_inner__ (packed returns), reads the
         # LIVE entry values per call via __d2s_read_entry__ (threading
-        # them as jit inputs), and applies __d2s_writeback__ to the
-        # CONCRETE outputs outside the trace
+        # them as keyword jit inputs named __d2s_cell_names__), and
+        # applies __d2s_writeback__ to the CONCRETE outputs outside
+        # the trace
         outer.__d2s_inner__ = inner
         outer.__d2s_read_entry__ = read_entry
+        outer.__d2s_cell_names__ = cell_names
         outer.__d2s_writeback__ = writeback
         new_fn = outer
     new_fn = functools.wraps(raw)(new_fn)

@@ -30,6 +30,7 @@ __all__ = [
     "disable_op_profiling", "is_op_profiling_enabled", "reset", "events",
     "mem_events", "record_device_memory", "summary",
     "export_chrome_tracing", "profile", "start_trace", "stop_trace",
+    "device_op_table",
 ]
 
 _lock = threading.Lock()
@@ -249,6 +250,93 @@ def export_chrome_tracing(path):
 
 
 # -- device (XProf) trace ----------------------------------------------------
+
+
+def device_op_table(logdir, top=None, sorted_by="total"):
+    """Per-op DEVICE-TIME table from an XProf capture (ref
+    platform/device_tracer.cc — the reference correlates CUPTI device
+    spans per op; here the xplane.pb the PJRT runtime wrote is parsed
+    directly with the wire-format reader, no tensorboard needed).
+
+    Aggregates every event on the device planes ("/device:..." when an
+    accelerator recorded; "/host:CPU" as the fallback on the host
+    backend) by op name: calls / total / avg / max (microseconds).
+    Returns (table_string, rows)."""
+    import glob as _glob
+
+    from ..utils.protowire import fields
+
+    paths = sorted(_glob.glob(
+        os.path.join(logdir, "**", "*.xplane.pb"), recursive=True))
+    if not paths:
+        raise FileNotFoundError(f"no *.xplane.pb under {logdir}")
+    agg: dict[str, list[float]] = {}
+
+    def plane_name(buf):
+        for f, w, v in fields(buf):
+            if f == 2 and w == 2:
+                return v.decode(errors="replace")
+        return ""
+
+    def walk_plane(buf):
+        meta = {}
+        for f, w, v in fields(buf):
+            if f == 4 and w == 2:          # event_metadata map entry
+                mid, name = None, None
+                for f2, w2, v2 in fields(v):
+                    if f2 == 1 and w2 == 0:
+                        mid = v2
+                    elif f2 == 2 and w2 == 2:  # XEventMetadata
+                        for f3, w3, v3 in fields(v2):
+                            if f3 == 1 and w3 == 0:
+                                mid = v3
+                            elif f3 == 2 and w3 == 2:
+                                name = v3.decode(errors="replace")
+                if mid is not None and name:
+                    meta[mid] = name
+        for f, w, v in fields(buf):
+            if f != 3 or w != 2:           # XLine
+                continue
+            for f2, w2, v2 in fields(v):
+                if f2 != 4 or w2 != 2:     # XEvent
+                    continue
+                mid, dur = None, 0
+                for f3, w3, v3 in fields(v2):
+                    if f3 == 1 and w3 == 0:
+                        mid = v3
+                    elif f3 == 3 and w3 == 0:
+                        dur = v3               # picoseconds
+                name = meta.get(mid)
+                if name and not name.startswith("$"):
+                    # "$file:line fn" entries are python-frame spans on
+                    # the host plane, not ops
+                    agg.setdefault(name, []).append(dur / 1e6)  # -> us
+
+    for path in paths:
+        with open(path, "rb") as f:
+            space = f.read()
+        planes = [v for fno, w, v in fields(space) if fno == 1 and w == 2]
+        device = [p for p in planes if plane_name(p).startswith("/device:")]
+        for p in device or [p for p in planes
+                            if plane_name(p) == "/host:CPU"]:
+            walk_plane(p)
+
+    rows = [{"name": n, "calls": len(d), "total": sum(d),
+             "avg": sum(d) / len(d), "max": max(d)}
+            for n, d in agg.items()]
+    key = {"total": "total", "calls": "calls", "avg": "avg",
+           "max": "max"}.get(sorted_by, "total")
+    rows.sort(key=lambda r: r[key], reverse=True)
+    if top:
+        rows = rows[:top]
+    lines = [f"{'Device op':<52}{'Calls':>8}{'Total(us)':>14}"
+             f"{'Avg(us)':>12}{'Max(us)':>12}"]
+    lines.append("-" * len(lines[0]))
+    for r in rows:
+        lines.append(
+            f"{r['name'][:51]:<52}{r['calls']:>8}{r['total']:>14.1f}"
+            f"{r['avg']:>12.1f}{r['max']:>12.1f}")
+    return "\n".join(lines), rows
 
 
 def start_trace(logdir):

@@ -1,0 +1,46 @@
+"""Minimal protobuf wire-format reader (no generated code, no protoc).
+
+Shared by the reference-artifact importer (framework.proto messages)
+and the profiler's XProf/xplane parser — both only need field-tagged
+traversal of length-delimited messages."""
+
+from __future__ import annotations
+
+__all__ = ["read_varint", "fields"]
+
+
+def read_varint(buf, pos):
+    result = 0
+    shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def fields(buf):
+    """Yield (field_number, wire_type, value) over a protobuf message.
+    wire 0 -> int, wire 2 -> bytes, wire 1/5 -> raw fixed bytes."""
+    pos = 0
+    n = len(buf)
+    while pos < n:
+        key, pos = read_varint(buf, pos)
+        field, wire = key >> 3, key & 0x7
+        if wire == 0:
+            val, pos = read_varint(buf, pos)
+        elif wire == 2:
+            ln, pos = read_varint(buf, pos)
+            val = buf[pos:pos + ln]
+            pos += ln
+        elif wire == 5:
+            val = buf[pos:pos + 4]
+            pos += 4
+        elif wire == 1:
+            val = buf[pos:pos + 8]
+            pos += 8
+        else:
+            raise ValueError(f"unsupported wire type {wire}")
+        yield field, wire, val

@@ -920,3 +920,54 @@ def test_try_else_skipped_on_escape_iteration():
     np.testing.assert_allclose(np.asarray(s_out.numpy()),
                                np.asarray(e_out.numpy()))
     assert int(np.asarray(s_hits)) == e_hits == 3
+
+
+def test_cell_params_with_defaults_and_varargs():
+    """Cell params are keyword-only (review r5): defaults and *args
+    bind exactly as in eager Python."""
+    import test_dy2static as mod
+
+    mod._G_DEF = 5.0
+
+    def fn(x, scale=10.0):
+        global _G_DEF
+        _G_DEF = _G_DEF + 1.0
+        return x * scale
+
+    out = to_static(fn)(_t([2.0]))
+    np.testing.assert_allclose(np.asarray(out.numpy()), [20.0])
+    assert abs(float(mod._G_DEF) - 6.0) < 1e-6
+
+    mod._G_VAR = 0.0
+
+    def fn2(*xs):
+        global _G_VAR
+        _G_VAR = _G_VAR + 1.0
+        return xs[0] + 1
+
+    out2 = to_static(fn2)(_t([3.0]))
+    np.testing.assert_allclose(np.asarray(out2.numpy()), [4.0])
+    assert abs(float(mod._G_VAR) - 1.0) < 1e-6
+
+
+def test_string_global_threads_as_static():
+    """Non-array cell values thread as STATIC jit args with the
+    write-back stash keyed by the static input value (review r5)."""
+    import test_dy2static as mod
+
+    mod._G_STR = "idle"
+
+    def fn(x):
+        global _G_STR
+        _G_STR = "ran:" + _G_STR
+        return x + 1
+
+    st = to_static(fn)
+    o = st(_t([1.0]))
+    np.testing.assert_allclose(np.asarray(o.numpy()), [2.0])
+    assert mod._G_STR == "ran:idle"
+    st(_t([1.0]))
+    assert mod._G_STR == "ran:ran:idle"
+    mod._G_STR = "idle"          # revisit a previously-traced value
+    st(_t([1.0]))
+    assert mod._G_STR == "ran:idle"

@@ -217,3 +217,23 @@ def test_max_pool_with_index_exact_on_chip():
                            np.asarray(i3.numpy()).reshape(1, 2, -1),
                            axis=2).ravel(),
         np.asarray(o3.numpy()).ravel())
+
+
+def test_device_op_table_on_chip(tmp_path):
+    """On the real chip the xplane device plane carries XLA op spans:
+    the per-op table must aggregate them (ref device_tracer.cc CUPTI
+    correlation — here PJRT records, we parse)."""
+    from paddle_tpu import profiler
+
+    d = str(tmp_path / "trace")
+    profiler.start_trace(d)
+    x = jnp.ones((512, 512), jnp.bfloat16)
+    for _ in range(3):
+        x = (x @ x) / jnp.bfloat16(512.0)
+    x.block_until_ready()
+    profiler.stop_trace()
+    table, rows = profiler.device_op_table(d, top=20)
+    assert rows
+    names = " ".join(r["name"] for r in rows)
+    assert ("fusion" in names or "dot" in names or "convert" in names
+            or "jit_" in names), names
