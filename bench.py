@@ -147,7 +147,36 @@ def _bench_resnet50(peak: float, on_tpu: bool) -> dict:
     remove (each pass needs the full reduction before any output
     element).  Closing the rest of the 31.5 -> 49.4 gap requires
     fusing stats/normalize into the conv epilogue itself (a Pallas
-    conv, out of scope this round).
+    conv).
+
+    Round 6 ships exactly that conv (ops/fused_conv.py): one stride-1
+    NHWC Mosaic kernel (stride 2 lowers by space-to-depth parity
+    decomposition; 1x1 flattens to a single matmul) whose epilogue
+    applies the BN affine + ReLU (+ residual) on the f32 accumulator in
+    VMEM and, in training, emits the per-channel sum/sum-sq moments
+    from the same accumulator — so the conv output is written to HBM
+    exactly once, already normalized (eval) or alongside its stats
+    (training).  The custom VJP rewrites the input-dilated strided-conv
+    backward (round-4 item (c)) as parity-decomposed stride-1
+    transposed convs through the same kernel, and the s2d lowering
+    kills the stem's C<=64 underfill (item (a)) — which is why the s2d
+    stem is now the bench DEFAULT (BENCH_RESNET_S2D=0 restores the
+    vanilla stem; fold_conv7_stem converts pretrained weights exactly).
+
+    Revised ceiling (written, no chip attached this round): the 49.4%
+    conv-skeleton figure assumed BN free; the fused epilogue makes BN's
+    forward cost ~1 accumulator pass (down from ~6.3 HBM traversals =
+    ~18.3 ms) but cannot remove the training two-pass dependency —
+    normalize needs the full batch stats, so the training path still
+    re-reads z once for normalize+act (z held in VMEM-sized tiles, not
+    re-read from HBM on the eval path).  Expected landing zone is
+    therefore between the 38% acceptance floor (conv time + one
+    residual BN traversal, ~41-42 ms) and the 49.4% skeleton bound,
+    with eval/inference close to the bound; the exact split needs the
+    on-chip probe (fused_conv._probe) to confirm Mosaic accepts every
+    ResNet-50 plan shape at batch 128 — any rejected shape falls back
+    to the round-5 XLA path and shows up as a missing _TRACE_COUNT in
+    the tpu-tier spy test, not a silent wrong number.
     """
     import paddle_tpu as paddle
     from paddle_tpu import amp, nn
@@ -164,12 +193,15 @@ def _bench_resnet50(peak: float, on_tpu: bool) -> dict:
         batch, hw, iters = 2, 32, 2
 
     paddle.seed(0)
-    # BENCH_RESNET_S2D=1: the MLPerf-style space-to-depth stem (exactly
-    # contains the 7x7 stem, ~11% faster on v5e); default stays the
-    # vanilla model-zoo network for honest out-of-the-box numbers
+    # the MLPerf-style space-to-depth stem is the DEFAULT as of round 6:
+    # it exactly contains the 7x7 stem (fold_conv7_stem maps pretrained
+    # weights losslessly), was ~11% faster on v5e even unfused, and is
+    # the shape the pallas fused-conv stem kernel targets (4x4/s1 over
+    # 12 channels instead of a C=3 MXU-underfilled 7x7/s2).
+    # BENCH_RESNET_S2D=0 restores the vanilla model-zoo stem.
     model = resnet50(num_classes=1000,
                      space_to_depth_stem=os.environ.get(
-                         "BENCH_RESNET_S2D", "") == "1")
+                         "BENCH_RESNET_S2D", "1") == "1")
     crit = nn.CrossEntropyLoss()
     opt = paddle.optimizer.Momentum(
         learning_rate=0.1, momentum=0.9,

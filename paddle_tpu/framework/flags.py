@@ -27,6 +27,9 @@ _DEFS = {
         1, int, "host threads for the native datafeed"),
     "FLAGS_use_pallas": (
         True, bool, "use Pallas kernels on TPU where available"),
+    "FLAGS_use_pallas_conv": (
+        True, bool, "route eligible convs through the Pallas fused-conv "
+        "kernels on TPU (PADDLE_TPU_CONV_FORCE=pallas|lax overrides)"),
     "FLAGS_eager_delete_tensor_gb": (
         0.0, float, "accepted for compatibility; PJRT manages memory"),
     "FLAGS_cudnn_deterministic": (

@@ -84,6 +84,10 @@ class StaticFunction:
         cell_names = getattr(fn, "__d2s_cell_names__", ())
         self._cell_names = cell_names
         self._cell_stash = {}
+        from collections import OrderedDict
+
+        self._sig_lru = OrderedDict()
+        self._cache_warned = False
         if self._writeback is not None:
             fn = fn.__d2s_inner__
         n_cells = len(cell_names)
@@ -102,6 +106,32 @@ class StaticFunction:
                   for nm, v in zip(cell_names, extra)}
             return user, kw
 
+        def _digest(u):
+            """Structural, hashable digest of one static entry value.
+            Must be identical for the same value at trace time and call
+            time (id() is not: the trace-time object dies and new equal
+            objects get fresh — or recycled — ids), and must match how
+            jax keys its own cache: hashable values by value, traced
+            pytrees by treedef + leaf shape/dtype."""
+            if _is_arrayish(u):
+                shp = tuple(getattr(u, "shape", ()) or ())
+                # canonicalized result_type so a python-float leaf at
+                # call time digests identically to the weak-f32 tracer
+                # it becomes under the trace
+                try:
+                    dt = str(jax.dtypes.canonicalize_dtype(
+                        jnp.result_type(u)))
+                except (TypeError, ValueError):
+                    dt = type(u).__name__
+                return ("a", shp, dt)
+            try:
+                hash(u)
+                return ("h", u)
+            except TypeError:
+                leaves, treedef = jax.tree_util.tree_flatten(u)
+                return ("t", type(u).__name__, str(treedef),
+                        tuple(_digest(l) for l in leaves))
+
         def _cell_sig(extra_vals):
             """Hashable signature of the NON-array cell inputs — keys
             the stash so per-static-value retraces never serve another
@@ -110,11 +140,7 @@ class StaticFunction:
             for j, v in enumerate(extra_vals):
                 u = v._value if isinstance(v, Tensor) else v
                 if not _is_arrayish(u):
-                    try:
-                        hash(u)
-                        sig.append((j, u))
-                    except TypeError:
-                        sig.append((j, id(u)))
+                    sig.append((j, _digest(u)))
             return tuple(sig)
 
         def _sanitize(vals, kind, sig):
@@ -130,6 +156,22 @@ class StaticFunction:
                     out.append(u)
                 else:
                     if u is not _UNDEF:
+                        leaves = jax.tree_util.tree_leaves(
+                            u, is_leaf=lambda t: isinstance(t, Tensor))
+                        if any(isinstance(
+                                l._value if isinstance(l, Tensor) else l,
+                                jax.core.Tracer) for l in leaves):
+                            raise TypeError(
+                                "dy2static: a cell/global written inside "
+                                "a to_static function holds traced "
+                                "tensors inside a plain Python container "
+                                f"({type(u).__name__}) — the values "
+                                "would leak out of the compiled program "
+                                "as tracers. Write back the tensors "
+                                "directly (or a list/dict jax can "
+                                "flatten is fine as a RETURN value); "
+                                "keep trace-time constants "
+                                "(str/int/objects) pure Python.")
                         stash[(sig, kind, j)] = u
                     out.append(_UNDEF)
             return tuple(out)
@@ -182,6 +224,42 @@ class StaticFunction:
             self._run = run
             self._with_values = False
         self._jitted = {}
+
+    def _note_sig(self, sig):
+        """LRU bookkeeping for the per-static-value caches. Each distinct
+        static cell/global value keys a stash entry AND a trace in the
+        jax.jit cache; code that cycles through unbounded distinct values
+        (f-strings, fresh objects per call) would grow both forever.
+        Beyond PADDLE_TPU_D2S_STATIC_CACHE distinct signatures (default
+        32) the oldest signature's stash entries are dropped and the jit
+        caches cleared (a later call with an evicted value retraces —
+        correct, just slower), with a one-time warning."""
+        lru = self._sig_lru
+        if sig in lru:
+            lru.move_to_end(sig)
+            return
+        lru[sig] = None
+        limit = int(os.environ.get("PADDLE_TPU_D2S_STATIC_CACHE", "32"))
+        if len(lru) <= max(limit, 1):
+            return
+        old, _ = lru.popitem(last=False)
+        for k in [k for k in self._cell_stash if k[0] == old]:
+            del self._cell_stash[k]
+        for j in self._jitted.values():
+            clear = getattr(j, "clear_cache", None)
+            if clear is not None:
+                clear()
+        if not self._cache_warned:
+            self._cache_warned = True
+            import warnings
+
+            warnings.warn(
+                "to_static: more than "
+                f"{limit} distinct static (non-array) cell/global values "
+                "seen by one compiled function — each forces its own "
+                "retrace. Evicting least-recently-used entries; raise "
+                "PADDLE_TPU_D2S_STATIC_CACHE or make the value a traced "
+                "array if this is hot-path.")
 
     def __call__(self, *args, **kwargs):
         if self._jitted is None:
@@ -258,6 +336,7 @@ class StaticFunction:
             n_cells = len(self._cell_names)
             sig = self._cell_sig(tuple(entry_vals)) \
                 if entry_vals is not None else ()
+            self._note_sig(sig)
             nn = len(cvals)
 
             def resolve(kind_j, v):
@@ -332,6 +411,7 @@ def save(layer, path, input_spec=None, **configs):
     exported_bytes = None
     if input_spec is not None and is_layer:
         try:
+            import jax.export  # noqa: F401 — not exposed by bare `import jax`
             specs = [s.to_shape_dtype() if isinstance(s, InputSpec) else
                      jax.ShapeDtypeStruct(tuple(s.shape),
                                           s._value.dtype)
@@ -388,6 +468,8 @@ def load(path, **configs):
         check_compatibility(saved_versions)
     model_path = path + ".pdmodel"
     if os.path.exists(model_path):
+        import jax.export  # noqa: F401 — not exposed by bare `import jax`
+
         with open(model_path, "rb") as f:
             exported = jax.export.deserialize(f.read())
         return TranslatedLayer(exported, state)

@@ -427,6 +427,30 @@ def fused_bn_act(x, running_mean, running_var, weight, bias,
     return y
 
 
+def fused_conv2d_bn_act(x, weight, running_mean, running_var, bn_weight,
+                        bn_bias, residual=None, act="relu", training=False,
+                        momentum=0.9, epsilon=1e-5, stride=1, padding=0,
+                        dilation=1, groups=1, data_format="NCHW",
+                        use_global_stats=None, name=None):
+    """act(batch_norm(conv2d(x, weight)) [+ residual]) through the
+    fused-epilogue conv op (ref conv_bn_fuse_pass.cc): eval folds BN
+    into the conv epilogue, training emits the BN moments from the conv
+    accumulator.  Same running-stat update contract as fused_bn_act."""
+    if use_global_stats is None:
+        use_global_stats = not training
+    y, new_mean, new_var = apply(
+        "fused_conv2d_bn_act", x, weight, bn_weight, bn_bias,
+        running_mean, running_var, residual, stride=stride,
+        padding=padding, dilation=dilation, groups=groups,
+        momentum=momentum, epsilon=epsilon, act=act,
+        is_test=not training, data_format=data_format,
+        use_global_stats=use_global_stats)
+    if training and not use_global_stats:
+        running_mean.set_value(new_mean)
+        running_var.set_value(new_var)
+    return y
+
+
 def instance_norm(x, running_mean=None, running_var=None, weight=None,
                   bias=None, use_input_stats=True, momentum=0.9, eps=1e-5,
                   data_format="NCHW", name=None):

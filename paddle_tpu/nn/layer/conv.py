@@ -75,6 +75,20 @@ class Conv2D(_ConvNd):
                         self._padding, self._dilation, self._groups,
                         self._data_format)
 
+    def _is_plain_for_fusion(self):
+        """True when this layer's forward is exactly the stock
+        bias-free F.conv2d above — the conv half of a fusable
+        Conv->BN->act chain (vision.models.resnet._conv_bn_act).
+        Subclass forwards, hooks, bias, groups, and dilation all keep
+        the composed path."""
+        return (type(self).forward is Conv2D.forward
+                and self.bias is None
+                and self._groups == 1
+                and self._data_format == "NCHW"
+                and _pair(self._dilation) == (1, 1)
+                and not self._forward_pre_hooks
+                and not self._forward_post_hooks)
+
 
 class Conv3D(_ConvNd):
     def __init__(self, in_channels, out_channels, kernel_size, stride=1,

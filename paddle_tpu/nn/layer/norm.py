@@ -35,6 +35,17 @@ class _BatchNormBase(Layer):
             epsilon=self._epsilon, data_format=self._data_format,
             use_global_stats=self._use_global_stats)
 
+    def _is_plain(self):
+        """True when this layer's forward is exactly the stock
+        F.batch_norm above, so model-level fusions (fused_bn_act /
+        fused_conv2d_bn_act) may bypass Layer.__call__; SyncBatchNorm,
+        subclass forwards, and hook-carrying layers keep the composed
+        path so hooks and overrides still fire."""
+        return (type(self).forward is _BatchNormBase.forward
+                and not isinstance(self, SyncBatchNorm)
+                and not self._forward_pre_hooks
+                and not self._forward_post_hooks)
+
     def extra_repr(self):
         return f"num_features={self._num_features}"
 

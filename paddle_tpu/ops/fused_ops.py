@@ -711,15 +711,29 @@ def _bwd_partition(causal, scale, dropout_p, mesh, arg_shapes,
             (qs, qs, qs, qs, ls, qs, repl))
 
 
+def _def_partition(cp, **kwargs):
+    """def_partition across jax versions: older releases don't take the
+    shardy kwargs (sharding_rule/need_replication_factors) — drop them
+    there; the GSPMD infer/partition callbacks carry the same info."""
+    try:
+        cp.def_partition(**kwargs)
+    except TypeError:
+        kwargs.pop("sharding_rule", None)
+        kwargs.pop("need_replication_factors", None)
+        cp.def_partition(**kwargs)
+
+
 _flash_fwd_cp = custom_partitioning(_fwd_impl4, static_argnums=(4, 5, 6))
-_flash_fwd_cp.def_partition(
+_def_partition(
+    _flash_fwd_cp,
     partition=_fwd_partition,
     infer_sharding_from_operands=_fwd_infer,
     sharding_rule="b h q d, b h k d, b h k d, -> b h q d, b h q",
     need_replication_factors=("q", "d", "k"))
 
 _flash_bwd_cp = custom_partitioning(_bwd_impl4, static_argnums=(7, 8, 9))
-_flash_bwd_cp.def_partition(
+_def_partition(
+    _flash_bwd_cp,
     partition=_bwd_partition,
     infer_sharding_from_operands=_bwd_infer,
     sharding_rule=("b h q d, b h k d, b h k d, b h q d, b h q, "
@@ -808,3 +822,10 @@ def flash_attention(q, k, v, seed=None, *, is_causal=False, scale=None,
         seed = jnp.asarray(seed).astype(jnp.int32).reshape(())
     return _flash_attention(q, k, v, seed, bool(is_causal), float(s),
                             float(dropout_p))
+
+
+# The fused-epilogue convolution kernels (conv + BN normalize + act
+# [+ residual] in one Mosaic kernel, with the transposed-conv custom
+# backward) live in fused_conv.py — same gating/interpret/testing idiom
+# as the attention kernels above; re-exported here for discoverability.
+from .fused_conv import fused_conv2d_bn_act  # noqa: E402,F401

@@ -58,6 +58,17 @@ def conv2d(x, weight, *, stride=1, padding=0, dilation=1, groups=1,
     kh, kw = weight.shape[-2], weight.shape[-1]
     pad = _conv_padding(padding, 2, strides, dilations, (kh, kw),
                         channel_last=(data_format != "NCHW"))
+    if data_format == "NCHW" and groups == 1:
+        # pallas stride-1 kernel + transposed-conv custom VJP; gated on
+        # FLAGS_use_pallas_conv / PADDLE_TPU_CONV_FORCE and plan
+        # eligibility — None keeps the XLA path below (lazy import:
+        # fused_conv imports this module for _conv_padding/_bn_act_core)
+        from . import fused_conv
+
+        z = fused_conv.conv2d_maybe_pallas(x, weight, strides, pad,
+                                           dilations, groups, data_format)
+        if z is not None:
+            return z
     dn = lax.conv_dimension_numbers(
         x.shape, weight.shape,
         ("NCHW", "OIHW", "NCHW") if data_format == "NCHW"
@@ -323,11 +334,32 @@ def max_pool_with_index_nd(x, ks, st, pd):
 def adaptive_max_pool_with_index_nd(x, os):
     """Shared N-D adaptive max pool with indices: per-cell windows
     [floor(i*S/oS), ceil((i+1)*S/oS)) from adaptive_bounds, indices
-    flat into the input spatial map."""
+    flat into the input spatial map.
+
+    Divisible extents (every dim a multiple of its output size) take the
+    uniform-window pool — identical bins, first-max argmax, same flat
+    indices — in O(1) ops instead of O(cells).  The non-divisible
+    fallback unrolls one slice+argmax per output cell, so its graph is
+    capped at PADDLE_TPU_ADAPTIVE_POOL_MAX_CELLS (default 4096) cells —
+    past that XLA compile time blows up (ADVICE r5 #4)."""
     import itertools
+    import os as _os
 
     n, c, *sp = x.shape
     nd = len(sp)
+    if all(sp[d] % os[d] == 0 for d in range(nd)):
+        ks = tuple(sp[d] // os[d] for d in range(nd))
+        return max_pool_with_index_nd(x, ks, ks, (0,) * nd)
+    cells = int(np.prod(os))
+    max_cells = int(_os.environ.get(
+        "PADDLE_TPU_ADAPTIVE_POOL_MAX_CELLS", "4096"))
+    if cells > max_cells:
+        raise ValueError(
+            f"adaptive max pool with indices: output {tuple(os)} needs "
+            f"{cells} per-cell reductions (non-divisible input "
+            f"{tuple(sp)} unrolls one slice per cell); cap is "
+            f"{max_cells}.  Pick a divisor output size or raise "
+            "PADDLE_TPU_ADAPTIVE_POOL_MAX_CELLS")
     vals, idxs = [], []
     for cell in itertools.product(*[range(o) for o in os]):
         bounds = [adaptive_bounds(cell[d], sp[d], os[d])

@@ -45,7 +45,10 @@ class SpaceToDepthStem(nn.Layer):
         self.conv = nn.Conv2D(in_channels * 4, out_channels, 4,
                               padding=0, bias_attr=False)
 
-    def forward(self, x):
+    def pre(self, x):
+        """The pad + space-to-depth half; the 4x4 conv half is applied
+        separately so the model-level Conv->BN->ReLU fusion can fold it
+        into the fused-epilogue conv op (forward == self.conv(pre(x)))."""
         # odd padded dims get one extra zero row/col on the bottom/right
         # so the 2x2 space-to-depth divides evenly; the extra zeros fall
         # on the (3,1) taps that are zero in the folded 7x7 weights, so
@@ -54,19 +57,21 @@ class SpaceToDepthStem(nn.Layer):
         h_in, w_in = x.shape[2], x.shape[3]
         x = F.pad(x, [3, 3 + (h_in % 2), 3, 3 + (w_in % 2)])
         n, c, h, w = x.shape
-        x = x.reshape([n, c, h // 2, 2, w // 2, 2]) \
-             .transpose([0, 1, 3, 5, 2, 4]) \
-             .reshape([n, c * 4, h // 2, w // 2])
-        return self.conv(x)
+        return x.reshape([n, c, h // 2, 2, w // 2, 2]) \
+                .transpose([0, 1, 3, 5, 2, 4]) \
+                .reshape([n, c * 4, h // 2, w // 2])
+
+    def forward(self, x):
+        return self.conv(self.pre(x))
 
 
 def _downsample(ds, x):
     """Fuse the shortcut's Conv->BN when it is the stock Sequential
-    (identity act, minimal-residual VJP); _bn_act's own dispatch keeps
-    non-plain norms on the composed path, which for an identity act
-    equals ds(x).  Any other downsample runs as-is."""
+    (identity act, minimal-residual VJP); _conv_bn_act's own dispatch
+    keeps non-plain layers on the composed path, which for an identity
+    act equals ds(x).  Any other downsample runs as-is."""
     if isinstance(ds, nn.Sequential) and len(ds) == 2:
-        return _bn_act(ds[1], ds[0](x), act="identity")
+        return _conv_bn_act(ds[0], ds[1], x, act="identity")
     return ds(x)
 
 
@@ -76,12 +81,9 @@ def _bn_act(bn, x, residual=None, act="relu"):
     layers (SyncBatchNorm, user norm_layer overrides) and BNs carrying
     forward hooks keep the composed Layer.__call__ path so hooks and
     overridden forwards still fire."""
-    from ...nn.layer.norm import SyncBatchNorm, _BatchNormBase
+    from ...nn.layer.norm import _BatchNormBase
 
-    if (not isinstance(bn, _BatchNormBase)
-            or isinstance(bn, SyncBatchNorm)
-            or type(bn).forward is not _BatchNormBase.forward
-            or bn._forward_pre_hooks or bn._forward_post_hooks):
+    if not isinstance(bn, _BatchNormBase) or not bn._is_plain():
         y = bn(x)
         if residual is not None:
             y = y + residual
@@ -92,6 +94,30 @@ def _bn_act(bn, x, residual=None, act="relu"):
         momentum=bn._momentum, epsilon=bn._epsilon,
         data_format=bn._data_format,
         use_global_stats=bn._use_global_stats)
+
+
+def _conv_bn_act(conv, bn, x, residual=None, act="relu"):
+    """Route a stock Conv2D -> BN -> act(+residual) chain through the
+    fused-epilogue conv op (ref conv_bn_fuse_pass.cc; the pallas kernel
+    applies normalize/act/residual on the conv accumulator in VMEM).
+    Anything non-stock — biased/grouped/dilated convs, subclass
+    forwards, hooks, mismatched layouts — composes conv(x) -> _bn_act,
+    which preserves the exact previous semantics."""
+    from ...nn.layer.conv import Conv2D
+    from ...nn.layer.norm import _BatchNormBase
+
+    if (isinstance(conv, Conv2D) and conv._is_plain_for_fusion()
+            and isinstance(bn, _BatchNormBase) and bn._is_plain()
+            and conv._data_format == bn._data_format):
+        return F.fused_conv2d_bn_act(
+            x, conv.weight, bn._mean, bn._variance, bn.weight, bn.bias,
+            residual=residual, act=act, training=bn.training,
+            momentum=bn._momentum, epsilon=bn._epsilon,
+            stride=conv._stride, padding=conv._padding,
+            dilation=conv._dilation, groups=conv._groups,
+            data_format=bn._data_format,
+            use_global_stats=bn._use_global_stats)
+    return _bn_act(bn, conv(x), residual=residual, act=act)
 
 
 class BasicBlock(nn.Layer):
@@ -115,8 +141,9 @@ class BasicBlock(nn.Layer):
     def forward(self, x):
         identity = x if self.downsample is None else _downsample(
             self.downsample, x)
-        out = _bn_act(self.bn1, self.conv1(x))
-        return _bn_act(self.bn2, self.conv2(out), residual=identity)
+        out = _conv_bn_act(self.conv1, self.bn1, x)
+        return _conv_bn_act(self.conv2, self.bn2, out,
+                            residual=identity)
 
 
 class BottleneckBlock(nn.Layer):
@@ -144,9 +171,10 @@ class BottleneckBlock(nn.Layer):
     def forward(self, x):
         identity = x if self.downsample is None else _downsample(
             self.downsample, x)
-        out = _bn_act(self.bn1, self.conv1(x))
-        out = _bn_act(self.bn2, self.conv2(out))
-        return _bn_act(self.bn3, self.conv3(out), residual=identity)
+        out = _conv_bn_act(self.conv1, self.bn1, x)
+        out = _conv_bn_act(self.conv2, self.bn2, out)
+        return _conv_bn_act(self.conv3, self.bn3, out,
+                            residual=identity)
 
 
 class ResNet(nn.Layer):
@@ -202,7 +230,14 @@ class ResNet(nn.Layer):
         return nn.Sequential(*layers)
 
     def forward(self, x):
-        x = _bn_act(self.bn1, self.conv1(x))
+        if (isinstance(self.conv1, SpaceToDepthStem)
+                and not self.conv1._forward_pre_hooks
+                and not self.conv1._forward_post_hooks):
+            # split the stem so its 4x4 conv fuses with bn1/relu too
+            x = _conv_bn_act(self.conv1.conv, self.bn1,
+                             self.conv1.pre(x))
+        else:
+            x = _conv_bn_act(self.conv1, self.bn1, x)
         x = self.maxpool(x)
         x = self.layer1(x)
         x = self.layer2(x)

@@ -2743,3 +2743,57 @@ case("elementwise_mod",
      [f32((3, 4), 0.1, 0.4, seed=130), f32((3, 4), 1.0, 2.0, seed=131)],
      ref=np.mod, grad=(0, 1))
 FD_OPS["elementwise_mod"] = {"case": 1}
+
+
+# ---- fused_conv2d_bn_act (round 6; ref conv_bn_fuse_pass.cc +
+# conv_elementwise_add_act_fuse_pass.cc) ----
+#
+# The sweep runs unforced on CPU, certifying the op's lax/composed
+# semantics; the interpret-mode pallas kernel parity is certified
+# separately in test_fused_conv.py.
+
+def _np_fused_conv_bn_act(x, w, scale, bias, mean, variance,
+                          residual=None, act="relu", is_test=False,
+                          stride=1, padding=0):
+    z = np_conv2d(x, w, stride=stride, padding=padding).astype(np.float32)
+    return _np_fused_bn_act(z, scale, bias, mean, variance, residual,
+                            act=act, is_test=is_test)
+
+
+_FCX = f32((2, 3, 6, 7), seed=140)
+_FCW = f32((4, 3, 3, 3), -0.3, 0.3, seed=141)
+_FCW1 = f32((4, 3, 1, 1), -0.3, 0.3, seed=147)
+_FCS = pos((4,), seed=142)
+_FCB = f32((4,), seed=143)
+_FCM = f32((4,), seed=144)
+_FCV = pos((4,), seed=145)
+_FCR = f32((2, 4, 6, 7), seed=146)
+_FCR2 = f32((2, 4, 3, 4), seed=148)
+
+case("fused_conv2d_bn_act", [_FCX, _FCW, _FCS, _FCB, _FCM, _FCV],
+     {"act": "relu", "padding": 1},
+     ref=lambda x, w, s, b, m, v, act, padding: _np_fused_conv_bn_act(
+         x, w, s, b, m, v, act=act, padding=padding),
+     grad=(0, 1, 2, 3), rtol=1e-4, atol=1e-5)
+# identity act + residual: the smooth case fd-certification runs on
+# (same reasoning as fused_bn_act — standardized relu kinks sit at 0)
+case("fused_conv2d_bn_act",
+     [_FCX, _FCW, _FCS, _FCB, _FCM, _FCV, _FCR],
+     {"act": "identity", "padding": 1},
+     ref=lambda x, w, s, b, m, v, r, act, padding:
+     _np_fused_conv_bn_act(x, w, s, b, m, v, r, act=act,
+                           padding=padding),
+     grad=(0, 1, 2, 3, 6), rtol=1e-4, atol=1e-5)
+case("fused_conv2d_bn_act",
+     [_FCX, _FCW, _FCS, _FCB, _FCM, _FCV, _FCR2],
+     {"act": "relu", "padding": 1, "stride": 2, "is_test": True},
+     ref=lambda x, w, s, b, m, v, r, act, padding, stride, is_test:
+     _np_fused_conv_bn_act(x, w, s, b, m, v, r, act=act, stride=stride,
+                           padding=padding, is_test=is_test),
+     grad=(0, 1, 2, 3, 6), rtol=1e-4, atol=1e-5)
+case("fused_conv2d_bn_act", [_FCX, _FCW1, _FCS, _FCB, _FCM, _FCV],
+     {"act": "relu", "is_test": True},
+     ref=lambda x, w, s, b, m, v, act, is_test: _np_fused_conv_bn_act(
+         x, w, s, b, m, v, act=act, is_test=is_test),
+     grad=(0, 1, 2, 3), rtol=1e-4, atol=1e-5)
+FD_OPS["fused_conv2d_bn_act"] = {"case": 1}

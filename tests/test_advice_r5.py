@@ -1,0 +1,195 @@
+"""Regression tests for round-5 advisor findings.
+
+1. dy2static: a cell/global write-back holding traced tensors inside a
+   plain Python container must raise a clear error, not silently stash
+   tracers that leak out of the compiled program (ADVICE r5,
+   jit/__init__.py _sanitize).
+2. dy2static: the write-back stash must be keyed by a STRUCTURAL
+   digest of the static cell values — the old id() fallback for
+   unhashables missed on every rebind of an equal value (and id reuse
+   could silently serve another value's stash) (ADVICE r5,
+   jit/__init__.py _cell_sig).
+3. dy2static: unbounded distinct static cell values must not grow the
+   stash/jit caches forever — LRU eviction past
+   PADDLE_TPU_D2S_STATIC_CACHE with a one-time warning (ADVICE r5).
+4. adaptive max pool with indices: divisible extents take the O(1)
+   uniform-window pool; non-divisible unrolls are capped at
+   PADDLE_TPU_ADAPTIVE_POOL_MAX_CELLS (ADVICE r5, ops/nn_ops.py).
+"""
+
+import os
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.jit import to_static
+
+
+def _t(x):
+    return Tensor(np.asarray(x, np.float32))
+
+
+# -- 1. tracer leak through container write-back ------------------------------
+
+_G_LEAK = None
+
+
+def test_tracer_container_writeback_raises():
+    """`g = [x + 1]` inside to_static: the list is not a jit output
+    (non-arrayish), so the old code stashed it — with live tracers
+    inside.  Must raise a dy2static error naming the problem."""
+
+    def fn(x):
+        global _G_LEAK
+        _G_LEAK = [x + 1]
+        return x * 2
+
+    with pytest.raises(TypeError, match="dy2static.*traced"):
+        to_static(fn)(_t([1.0]))
+
+
+_G_OK = None
+
+
+def test_plain_tensor_writeback_still_works():
+    """The raise is scoped to containers: a bare tensor write-back is a
+    valid jit output and must keep working."""
+
+    def fn(x):
+        global _G_OK
+        _G_OK = x + 1
+        return x * 2
+
+    out = to_static(fn)(_t([1.0]))
+    np.testing.assert_allclose(np.asarray(out.numpy()), [2.0])
+    # write-backs restore the raw concrete value (same convention as
+    # test_dy2static's global tests)
+    np.testing.assert_allclose(np.asarray(_G_OK), [2.0])
+
+
+# -- 2. structural digest keying ----------------------------------------------
+
+_G_CFG = [1.0]
+
+
+def test_rebound_equal_unhashable_global_hits_stash():
+    """A written numeric-list global: its entry value traces as pytree
+    leaves (so jax reuses the compiled program for any equal-structure
+    value), and its constant write-back list lands in the stash.  The
+    stash key must follow the same structural equivalence — the old
+    id()-keyed digest missed on every rebind to a fresh object and
+    wrote UNDEF back instead of the stashed value."""
+    global _G_CFG
+
+    def fn(x):
+        global _G_CFG
+        _G_CFG = [2.0, 3.0]
+        return x + _G_CFG[0]
+
+    st = to_static(fn)
+    _G_CFG = [1.0]
+    o = st(_t([1.0]))
+    assert _G_CFG == [2.0, 3.0], _G_CFG
+    np.testing.assert_allclose(np.asarray(o.numpy()), [3.0])
+
+    # rebind to a FRESH object with the traced structure: jax replays
+    # the cached program, and the write-back must hit the stash
+    _G_CFG = [5.0, 6.0]
+    o = st(_t([1.0]))
+    assert _G_CFG == [2.0, 3.0], \
+        f"stash miss on rebound equal-structure static value: {_G_CFG}"
+    np.testing.assert_allclose(np.asarray(o.numpy()), [3.0])
+
+
+# -- 3. bounded static-value caches -------------------------------------------
+
+_G_S = ""
+
+
+def test_static_value_cache_bounded_with_warning():
+    global _G_S
+
+    def fn(x):
+        global _G_S
+        _G_S = _G_S + "!"
+        return x + 1
+
+    st = to_static(fn)
+    os.environ["PADDLE_TPU_D2S_STATIC_CACHE"] = "4"
+    try:
+        with warnings.catch_warnings(record=True) as rec:
+            warnings.simplefilter("always")
+            for i in range(8):
+                _G_S = f"v{i}"
+                st(_t([1.0]))
+                assert _G_S == f"v{i}!"
+        msgs = [w for w in rec
+                if "distinct static" in str(w.message)]
+        assert len(msgs) == 1, "expected exactly one cache warning"
+        assert len(st._sig_lru) <= 4
+        # an evicted value must retrace correctly, not serve stale state
+        _G_S = "v0"
+        st(_t([1.0]))
+        assert _G_S == "v0!"
+    finally:
+        os.environ.pop("PADDLE_TPU_D2S_STATIC_CACHE", None)
+
+
+# -- 4. adaptive max pool: divisible fast path + cell cap ---------------------
+
+def test_adaptive_max_pool_divisible_uses_uniform_windows():
+    x = np.random.RandomState(0).randn(2, 3, 12, 8).astype(np.float32)
+    out, idx = F.adaptive_max_pool2d(Tensor(x), (3, 4),
+                                     return_mask=True)
+    o = np.asarray(out.numpy())
+    i = np.asarray(idx.numpy())
+    # uniform 4x2 windows; verify values AND flat indices vs numpy
+    for oy in range(3):
+        for ox in range(4):
+            win = x[:, :, oy * 4:(oy + 1) * 4, ox * 2:(ox + 1) * 2]
+            np.testing.assert_array_equal(
+                o[:, :, oy, ox], win.max(axis=(2, 3)))
+    flat = x.reshape(2, 3, -1)
+    np.testing.assert_array_equal(
+        np.take_along_axis(flat, i.reshape(2, 3, -1), axis=2).ravel(),
+        o.ravel())
+
+
+def test_adaptive_max_pool_nondivisible_matches_reference():
+    x = np.random.RandomState(1).randn(1, 2, 7, 5).astype(np.float32)
+    out, idx = F.adaptive_max_pool2d(Tensor(x), (3, 2),
+                                     return_mask=True)
+    o = np.asarray(out.numpy())
+    i = np.asarray(idx.numpy())
+    for oy in range(3):
+        y0, y1 = oy * 7 // 3, -(-(oy + 1) * 7 // 3)
+        for ox in range(2):
+            x0, x1 = ox * 5 // 2, -(-(ox + 1) * 5 // 2)
+            win = x[:, :, y0:y1, x0:x1]
+            np.testing.assert_array_equal(
+                o[:, :, oy, ox], win.max(axis=(2, 3)))
+    flat = x.reshape(1, 2, -1)
+    np.testing.assert_array_equal(
+        np.take_along_axis(flat, i.reshape(1, 2, -1), axis=2).ravel(),
+        o.ravel())
+
+
+def test_adaptive_max_pool_cell_cap_raises():
+    os.environ["PADDLE_TPU_ADAPTIVE_POOL_MAX_CELLS"] = "16"
+    try:
+        x = Tensor(np.random.RandomState(2)
+                   .randn(1, 1, 13, 13).astype(np.float32))
+        # divisible-free 5x5=25 cells > 16 -> capped
+        with pytest.raises(ValueError, match="cap is 16"):
+            F.adaptive_max_pool2d(x, (5, 5), return_mask=True)
+        # divisible sizes bypass the cap entirely (uniform pool path)
+        big = Tensor(np.random.RandomState(3)
+                     .randn(1, 1, 32, 32).astype(np.float32))
+        out, _ = F.adaptive_max_pool2d(big, (8, 8), return_mask=True)
+        assert tuple(out.shape) == (1, 1, 8, 8)
+    finally:
+        os.environ.pop("PADDLE_TPU_ADAPTIVE_POOL_MAX_CELLS", None)
