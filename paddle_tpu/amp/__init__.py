@@ -39,6 +39,18 @@ def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
 amp_guard = auto_cast  # legacy fluid name
 
 
+def all_finite(tree):
+    """Single finiteness bit over every leaf of a pytree, fused into ONE
+    reduction (in-graph analogue of check_finite_and_unscale_op's
+    FoundInfinite output; no per-leaf host sync). jit-safe: returns a
+    traced scalar bool. Shared by GradScaler and the engine's step-level
+    anomaly guard."""
+    leaves = jax.tree.leaves(tree)
+    if not leaves:
+        return jnp.asarray(True)
+    return jnp.all(jnp.stack([jnp.all(jnp.isfinite(g)) for g in leaves]))
+
+
 def decorate(models, optimizers=None, level="O1", dtype="bfloat16",
              master_weight=None, save_dtype=None):
     """O2: cast model params to the low-precision dtype (keeping fp32
@@ -86,9 +98,7 @@ class GradScaler:
         host sync per parameter)."""
         # keep each grad's own dtype (fp16 stays fp16; no f32 promotion)
         new = jax.tree.map(lambda g: (g * inv_scale).astype(g.dtype), grads)
-        finite = jnp.stack([jnp.all(jnp.isfinite(g))
-                            for g in jax.tree.leaves(new)])
-        return new, jnp.all(finite)
+        return new, all_finite(new)
 
     def unscale_(self, optimizer):
         if not self._enable:

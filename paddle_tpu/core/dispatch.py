@@ -62,13 +62,14 @@ def _amp_rewrite(op_name, arrs):
 # ---------------------------------------------------------------------------
 
 _flags_mod = None
+_nan_inf_jit_warned = False
 
 
 def _maybe_check_nan_inf(op_name, out):
     """FLAGS_check_nan_inf: post-op scan of every output (ref
     framework/details/nan_inf_utils_detail.cu; flag at
     platform/flags.cc:44). Eager-only — under tracing the values are
-    abstract and the check is skipped."""
+    abstract; a one-time warning points at the in-graph anomaly guard."""
     global _flags_mod
     if _flags_mod is None:
         from ..framework import flags as _f
@@ -82,9 +83,32 @@ def _maybe_check_nan_inf(op_name, out):
     if not _flags_mod.flag("FLAGS_check_nan_inf"):
         return
     for i, o in enumerate(outs):
-        if isinstance(o, jax.core.Tracer) or not hasattr(o, "dtype"):
+        if isinstance(o, jax.core.Tracer):
+            # under jit the values are abstract: a per-op host check is
+            # impossible (and would defeat compilation). Tell the user
+            # ONCE where the compiled-path equivalent lives instead of
+            # silently doing nothing.
+            global _nan_inf_jit_warned
+            if not _nan_inf_jit_warned:
+                _nan_inf_jit_warned = True
+                import warnings
+
+                warnings.warn(
+                    "FLAGS_check_nan_inf is inert under jit tracing (op "
+                    f"'{op_name}'): per-op values are abstract. For "
+                    "compiled training use the in-graph anomaly guard — "
+                    "Engine(..., anomaly_guard=True) with "
+                    "FLAGS_anomaly_max_bad_steps — which checks loss and "
+                    "gradients with one fused in-graph bit per step.")
+            continue
+        if not hasattr(o, "dtype"):
             continue
         if jnp.issubdtype(o.dtype, jnp.floating):
+            from ..framework import monitor as _monitor
+
+            # spy counter: proves the compiled path never falls back to
+            # per-op host finiteness syncs (tier-1 asserts it stays 0)
+            _monitor.stat_add("nan_inf_host_checks")
             if not bool(jnp.isfinite(o).all()):
                 from ..framework.errors import PreconditionNotMetError
 

@@ -18,6 +18,7 @@ from .topology import (  # noqa: F401
 )
 from . import fleet  # noqa: F401
 from . import checkpoint  # noqa: F401
+from . import preempt  # noqa: F401
 from . import ps  # noqa: F401
 
 

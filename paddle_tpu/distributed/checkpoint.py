@@ -7,6 +7,20 @@ states are pytrees of (possibly GSPMD-sharded) jax.Arrays; orbax writes
 each array as a tensorstore with its sharding layout, and restore can
 re-lay arrays out onto a different mesh (elastic resume).
 
+Fault-tolerance contract (the load-bearing part):
+- every save is ATOMIC: arrays + checksum manifest + metadata land in
+  ``<path>.tmp`` and a single directory rename commits them, so a crash
+  at any instant leaves either the previous checkpoint or the new one —
+  never a valid-looking torn dir;
+- every leaf carries a sha256 in ``paddle_manifest.json`` verified on
+  restore (FLAGS_ckpt_verify_checksums), so silent storage corruption is
+  a loud error the restore fallback can route around;
+- checkpoint I/O retries with exponential backoff
+  (framework.errors.retry_with_backoff) before giving up;
+- `AsyncCheckpointManager` moves serialization off the step thread: the
+  step loop pays only the device->host copy, the background writer owns
+  serialize + commit + retention.
+
 Entry points:
 - save_state / load_state          — any pytree of arrays
 - save_train_state / load_train_state    — engine.Engine (params, moments,
@@ -14,15 +28,25 @@ Entry points:
 - save_hybrid_state / load_hybrid_state  — HybridParallelEngine
 - CheckpointManager                — numbered checkpoints with retention,
   the auto_checkpoint analogue
+- AsyncCheckpointManager           — same contract, background writer
 """
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
+import shutil
 
 import jax
 import numpy as np
+
+from ..framework import faults as _faults
+from ..framework import monitor as _monitor
+from ..framework.errors import retry_with_backoff
+
+MANIFEST_NAME = "paddle_manifest.json"
+META_NAME = "paddle_meta.json"
 
 
 def _checkpointer():
@@ -47,41 +71,144 @@ def _abstract_like(tree, shardings=None):
     return jax.tree.unflatten(treedef, out)
 
 
+# ---------------------------------------------------------------------------
+# checksum manifest
+# ---------------------------------------------------------------------------
+
+
+def _leaf_digest(leaf):
+    a = np.ascontiguousarray(np.asarray(leaf))
+    return hashlib.sha256(a.tobytes()).hexdigest()
+
+
+def _manifest_of(state):
+    """Per-leaf sha256 over the GLOBAL array value (sharding-agnostic:
+    the same bytes hash the same whether saved replicated or sharded)."""
+    flat = jax.tree_util.tree_flatten_with_path(state)[0]
+    out = {}
+    for kp, leaf in flat:
+        a = np.asarray(leaf)
+        out[jax.tree_util.keystr(kp)] = {
+            "sha256": _leaf_digest(a),
+            "shape": list(a.shape),
+            "dtype": str(a.dtype),
+        }
+    return out
+
+
+def load_manifest(path):
+    p = os.path.join(os.path.abspath(path), MANIFEST_NAME)
+    if not os.path.exists(p):
+        return None
+    with open(p) as f:
+        return json.load(f)
+
+
+def verify_manifest(path, restored):
+    """Re-hash every restored leaf against the saved manifest; raises
+    ValueError on any mismatch (a truncated/corrupted leaf). Leaves
+    absent from the manifest (partial-template restore of a legacy
+    checkpoint) are skipped."""
+    manifest = load_manifest(path)
+    if manifest is None:
+        return  # pre-manifest checkpoint: nothing to verify against
+    bad = []
+    for kp, leaf in jax.tree_util.tree_flatten_with_path(restored)[0]:
+        key = jax.tree_util.keystr(kp)
+        want = manifest.get(key)
+        if want is None:
+            continue
+        if _leaf_digest(leaf) != want["sha256"]:
+            bad.append(key)
+    if bad:
+        raise ValueError(
+            f"checkpoint {path} failed checksum verification for leaves "
+            f"{bad} — the data on disk does not match what was saved")
+
+
+# ---------------------------------------------------------------------------
+# atomic save / verified load
+# ---------------------------------------------------------------------------
+
+
+def _as_saveable(leaf):
+    # host numpy arrays pass through untouched (the async writer must not
+    # bounce them back to device); python scalars become jnp 0-d arrays
+    if isinstance(leaf, (jax.Array, np.ndarray)):
+        return leaf
+    return jax.numpy.asarray(leaf)
+
+
 def save_state(path, state, *, metadata=None):
-    """Write a pytree of arrays to `path` (a directory). Scalars/ints are
-    stored as 0-d arrays; `metadata` (JSON-able dict) rides alongside."""
+    """Write a pytree of arrays to `path` (a directory), atomically.
+
+    The full checkpoint (arrays via orbax, per-leaf sha256 manifest,
+    optional JSON `metadata`) is staged in ``<path>.tmp`` and committed
+    by one directory rename — a crash can never leave a valid-looking
+    torn dir at `path`. Scalars/ints are stored as 0-d arrays.
+    """
     path = os.path.abspath(path)
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-    state = jax.tree.map(jax.numpy.asarray, state)
-    ckpt = _checkpointer()
-    ckpt.save(path, state, force=True)
-    ckpt.wait_until_finished()
-    if metadata is not None:
-        # atomic: a crash mid-write must not leave a valid-looking orbax
-        # dir with truncated/absent metadata that would silently reset
-        # step/RNG on resume
-        meta_path = os.path.join(path, "paddle_meta.json")
-        tmp = meta_path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(metadata, f)
-        os.replace(tmp, meta_path)
+    state = jax.tree.map(_as_saveable, state)
+    tmp = path + ".tmp"
+
+    def _stage():
+        _faults.fault_point("checkpoint.io")
+        if os.path.isdir(tmp):
+            shutil.rmtree(tmp)
+        ckpt = _checkpointer()
+        ckpt.save(tmp, state, force=True)
+        ckpt.wait_until_finished()
+        with open(os.path.join(tmp, MANIFEST_NAME), "w") as f:
+            json.dump(_manifest_of(state), f)
+        if metadata is not None:
+            with open(os.path.join(tmp, META_NAME), "w") as f:
+                json.dump(metadata, f)
+
+    # transient filesystem failures (NFS/GCS-fuse hiccups) retry with
+    # backoff; each retry restages from scratch into the tmp dir
+    retry_with_backoff(_stage, retries=3, stat="ckpt_retries",
+                       description=f"checkpoint write to {path}")
+
+    _faults.fault_point("checkpoint.before_commit")
+    old = None
+    if os.path.exists(path):
+        # replacing an existing dir: move it aside first so there is no
+        # instant where a half-deleted dir sits at the final path
+        old = f"{path}.old-{os.getpid()}"
+        shutil.rmtree(old, ignore_errors=True)
+        os.rename(path, old)
+    os.rename(tmp, path)  # THE commit point
+    if old is not None:
+        shutil.rmtree(old, ignore_errors=True)
+    _monitor.stat_add("ckpt_saves")
+    _faults.fault_point("checkpoint.after_commit", path)
 
 
-def load_state(path, template, *, shardings=None):
+def load_state(path, template, *, shardings=None, verify=None):
     """Restore a pytree saved by save_state.
 
     `template` supplies structure/shape/dtype (arrays or ShapeDtypeStruct).
     `shardings` (same structure, NamedSharding leaves) re-lays arrays onto
     a mesh — restoring a checkpoint written on a different topology works
-    as long as global shapes match.
+    as long as global shapes match. When `verify` (default: the
+    FLAGS_ckpt_verify_checksums flag), every restored leaf is re-hashed
+    against the saved manifest and a mismatch raises ValueError.
     """
+    from ..framework import flags as _flags
+
     path = os.path.abspath(path)
     target = _abstract_like(template, shardings)
-    return _checkpointer().restore(path, target)
+    restored = _checkpointer().restore(path, target)
+    if verify is None:
+        verify = _flags.flag("FLAGS_ckpt_verify_checksums")
+    if verify:
+        verify_manifest(path, restored)
+    return restored
 
 
 def load_metadata(path):
-    p = os.path.join(os.path.abspath(path), "paddle_meta.json")
+    p = os.path.join(os.path.abspath(path), META_NAME)
     if not os.path.exists(p):
         return None
     with open(p) as f:
@@ -108,9 +235,10 @@ def _restore_rng(meta):
             (meta["rng_seed"], meta["rng_counter"]))
 
 
-def save_train_state(path, engine):
-    """Checkpoint an engine.Engine: params, optimizer moments, buffers,
-    step count, LR-scheduler position, and the host RNG stream."""
+def _engine_payload(engine):
+    """(state pytree, metadata) capturing an engine.Engine with full
+    resume fidelity: params, optimizer moments, buffers, step count,
+    LR-scheduler position, and the host RNG stream."""
     from ..optimizer.lr import LRScheduler
 
     st = engine.state
@@ -118,8 +246,16 @@ def save_train_state(path, engine):
     lr = getattr(engine.optimizer, "_learning_rate", None)
     if isinstance(lr, LRScheduler):
         meta["lr_scheduler"] = lr.state_dict()
-    save_state(path, {"params": st.params, "opt_state": st.opt_state,
-                      "buffers": st.buffers}, metadata=meta)
+    state = {"params": st.params, "opt_state": st.opt_state,
+             "buffers": st.buffers}
+    return state, meta
+
+
+def save_train_state(path, engine):
+    """Checkpoint an engine.Engine: params, optimizer moments, buffers,
+    step count, LR-scheduler position, and the host RNG stream."""
+    state, meta = _engine_payload(engine)
+    save_state(path, state, metadata=meta)
 
 
 def _engine_shardings(engine):
@@ -152,7 +288,7 @@ def load_train_state(path, engine):
     meta = load_metadata(path)
     if meta is None:
         raise FileNotFoundError(
-            f"checkpoint {path} has no paddle_meta.json — it was written "
+            f"checkpoint {path} has no {META_NAME} — it was written "
             "by an interrupted save and cannot be resumed exactly")
     st = engine.state
     tpl = {"params": st.params, "opt_state": st.opt_state,
@@ -233,6 +369,8 @@ class CheckpointManager:
         for name in os.listdir(self.directory):
             if name.startswith("ckpt-"):
                 try:
+                    # 'ckpt-<n>.tmp' staging dirs and '.old-' remnants
+                    # fail the int() parse and are invisible here
                     out.append(int(name.split("-", 1)[1]))
                 except ValueError:
                     continue
@@ -242,6 +380,15 @@ class CheckpointManager:
         steps = self.all_steps()
         return steps[-1] if steps else None
 
+    def _is_readable(self, step):
+        """Cheap commit check: an atomically-committed dir always holds
+        the manifest (and metadata when one was supplied). Torn dirs
+        from legacy non-atomic saves or fabricated corruption lack both."""
+        p = self._path(step)
+        return os.path.isdir(p) and (
+            os.path.exists(os.path.join(p, MANIFEST_NAME))
+            or os.path.exists(os.path.join(p, META_NAME)))
+
     def save(self, step, state, *, metadata=None):
         meta = dict(metadata or {})
         meta.setdefault("step", int(step))
@@ -249,7 +396,12 @@ class CheckpointManager:
         save_state(self._path(step), state, metadata=meta)
         self._gc()
 
+    def save_engine(self, step, engine):
+        """Numbered full-fidelity engine.Engine snapshot."""
+        self.save_with(step, lambda p: save_train_state(p, engine))
+
     def restore(self, template, *, step=None, shardings=None):
+        self.wait_until_finished()
         if step is None:
             step = self.latest_step()
         if step is None:
@@ -261,12 +413,19 @@ class CheckpointManager:
         return state, meta
 
     def _gc(self):
-        import shutil
-
+        """Retention that can never GC the last good checkpoint: only
+        READABLE checkpoints count toward max_to_keep, and the newest
+        readable one is always kept. Unreadable/torn dirs (legacy crashed
+        saves — atomic commit can no longer produce them) are garbage and
+        removed regardless of age."""
         steps = self.all_steps()
-        while len(steps) > self.max_to_keep:
-            victim = steps.pop(0)
-            shutil.rmtree(self._path(victim), ignore_errors=True)
+        readable = [s for s in steps if self._is_readable(s)]
+        keep = set(readable[-max(self.max_to_keep, 1):])
+        for s in steps:
+            if s in keep:
+                continue
+            shutil.rmtree(self._path(s), ignore_errors=True)
+            _monitor.stat_add("ckpt_gc_removed")
 
     def save_with(self, step, writer_fn):
         """Numbered save through an external writer (e.g.
@@ -277,8 +436,10 @@ class CheckpointManager:
 
     def restore_with(self, reader_fn, *, step=None):
         """Numbered restore through an external reader, falling back to
-        OLDER checkpoints when the newest is unreadable (a crash between
-        the array write and the metadata write leaves a torn dir)."""
+        OLDER checkpoints when the newest is unreadable: a legacy torn
+        dir (arrays committed, metadata absent), a checksum mismatch
+        (ValueError from the manifest check), or any reader failure."""
+        self.wait_until_finished()
         candidates = [step] if step is not None else \
             list(reversed(self.all_steps()))
         if not candidates:
@@ -288,15 +449,94 @@ class CheckpointManager:
         for s in candidates:
             try:
                 return s, reader_fn(self._path(s))
-            except (FileNotFoundError, ValueError, KeyError) as e:
+            except Exception as e:  # noqa: BLE001 — any unreadable ckpt
+                # falls back; orbax/tensorstore raise their own types
                 last_err = e
                 import warnings
 
                 warnings.warn(
                     f"checkpoint ckpt-{s} unreadable ({e}); trying the "
                     "previous one")
+                _monitor.stat_add("ckpt_restore_fallbacks")
         raise FileNotFoundError(
             f"no readable checkpoint under {self.directory}") from last_err
+
+    def wait_until_finished(self):
+        """Synchronous manager: every save already committed."""
+
+
+class AsyncCheckpointManager(CheckpointManager):
+    """CheckpointManager with a background writer.
+
+    The step thread pays only the device->host copy (so the snapshot is
+    a consistent point-in-time view even while training continues);
+    serialization, the atomic commit, retries, and retention run on a
+    single worker thread. Failures surface on the next save() or on
+    wait_until_finished() — call the latter before relying on the latest
+    checkpoint (restore/restore_with do it automatically).
+    """
+
+    def __init__(self, directory, max_to_keep=3):
+        super().__init__(directory, max_to_keep=max_to_keep)
+        from concurrent.futures import ThreadPoolExecutor
+
+        self._executor = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="ckpt-writer")
+        self._pending = []
+
+    @staticmethod
+    def _to_host(state):
+        # device->host copy on the caller's (step) thread: the only part
+        # that must observe live device arrays before the next step
+        # mutates them (donated buffers reuse their memory)
+        return jax.tree.map(
+            lambda a: np.asarray(a) if hasattr(a, "shape") else a, state)
+
+    def save(self, step, state, *, metadata=None):
+        meta = dict(metadata or {})
+        meta.setdefault("step", int(step))
+        meta.update(_rng_metadata())
+        self._submit(step, self._to_host(state), meta)
+
+    def save_engine(self, step, engine):
+        state, meta = _engine_payload(engine)
+        meta.setdefault("ckpt_step", int(step))
+        self._submit(step, self._to_host(state), meta)
+
+    def save_with(self, step, writer_fn):
+        """writer_fn reads live state, so it cannot be deferred safely;
+        run it synchronously (use save/save_engine for async writes)."""
+        super().save_with(step, writer_fn)
+
+    def _submit(self, step, host_state, meta):
+        self._raise_failed()
+        fut = self._executor.submit(self._write, step, host_state, meta)
+        self._pending.append(fut)
+        _monitor.stat_add("ckpt_async_saves")
+        return fut
+
+    def _write(self, step, host_state, meta):
+        save_state(self._path(step), host_state, metadata=meta)
+        self._gc()
+
+    def _raise_failed(self):
+        done = [f for f in self._pending if f.done()]
+        self._pending = [f for f in self._pending if not f.done()]
+        for f in done:
+            exc = f.exception()
+            if exc is not None:
+                raise exc
+
+    def wait_until_finished(self):
+        """Block until every queued save committed; re-raises the first
+        background failure."""
+        pending, self._pending = self._pending, []
+        for f in pending:
+            f.result()
+
+    def close(self):
+        self.wait_until_finished()
+        self._executor.shutdown(wait=True)
 
 
 def save_persistables(engine_or_layer, dirname):
@@ -326,7 +566,8 @@ def load_persistables(engine_or_layer, dirname):
 
 
 def train_epoch_range(max_epoch, directory, engine, save_interval=1,
-                      max_to_keep=3):
+                      max_to_keep=3, async_save=False,
+                      handle_preemption=True):
     """Auto-checkpointed epoch loop (ref fluid/incubate/checkpoint/
     auto_checkpoint.py:71 train_epoch_range): yields epoch indices,
     snapshotting the engine's full TrainState after each `save_interval`
@@ -337,8 +578,16 @@ def train_epoch_range(max_epoch, directory, engine, save_interval=1,
 
         for epoch in checkpoint.train_epoch_range(10, ckpt_dir, engine):
             ... train one epoch ...
+
+    `async_save=True` routes snapshots through AsyncCheckpointManager so
+    the epoch loop overlaps serialization. `handle_preemption` (default)
+    installs the SIGTERM/SIGUSR1 handlers: a preemption triggers an
+    emergency snapshot at the next epoch boundary, writes a PREEMPTED
+    marker, and raises PreemptedError; the restarted job consumes the
+    marker and resumes the exact epoch/step/RNG state.
     """
     from ..engine import Engine
+    from . import preempt as _preempt
 
     if not isinstance(engine, Engine):
         raise TypeError("train_epoch_range drives a compiled Engine; for "
@@ -346,16 +595,36 @@ def train_epoch_range(max_epoch, directory, engine, save_interval=1,
     # compose the full-fidelity engine save/load (params, moments, step,
     # LR-scheduler position, RNG, target shardings, sync_to_layer) with
     # CheckpointManager's numbering + retention
-    mgr = CheckpointManager(os.path.join(directory, "auto_ckpt"),
-                            max_to_keep=max_to_keep)
+    mgr_cls = AsyncCheckpointManager if async_save else CheckpointManager
+    mgr = mgr_cls(os.path.join(directory, "auto_ckpt"),
+                  max_to_keep=max_to_keep)
+    if handle_preemption:
+        _preempt.install()
+        _preempt.consume_marker(mgr.directory)
+    # anomaly-guarded engines roll back to the newest snapshot here
+    engine.attach_checkpoint_manager(mgr)
     start = 0
     if mgr.all_steps():
         restored_step, _ = mgr.restore_with(
             lambda p: load_train_state(p, engine))
         start = restored_step + 1
 
-    for epoch in range(start, max_epoch):
-        yield epoch
-        if (epoch + 1) % save_interval == 0 or epoch == max_epoch - 1:
-            mgr.save_with(epoch,
-                          lambda p: save_train_state(p, engine))
+    try:
+        for epoch in range(start, max_epoch):
+            yield epoch
+            preempted = handle_preemption and _preempt.poll()
+            if preempted or (epoch + 1) % save_interval == 0 \
+                    or epoch == max_epoch - 1:
+                mgr.save_engine(epoch, engine)
+            if preempted:
+                mgr.wait_until_finished()
+                _preempt.write_marker(
+                    mgr.directory,
+                    {"epoch": epoch, "step": int(engine.state.step)})
+                _monitor.stat_add("preempt_emergency_saves")
+                raise _preempt.PreemptedError(
+                    f"preempted ({_preempt.reason()}); emergency "
+                    f"checkpoint committed at epoch {epoch} — exit and "
+                    "restart to resume")
+    finally:
+        mgr.wait_until_finished()

@@ -56,6 +56,10 @@ class ElasticManager:
         return self
 
     def beat(self):
+        from ..framework import faults as _faults
+
+        if _faults.fault_point("elastic.beat") is _faults.DROP:
+            return  # injected heartbeat loss: peers see this node die
         tmp = self._path(self.node_id) + ".tmp"
         with open(tmp, "w") as f:
             json.dump({"node": self.node_id, "ts": time.time()}, f)
@@ -80,12 +84,28 @@ class ElasticManager:
                     rec = json.load(f)
             except (OSError, ValueError):
                 continue
-            if now - rec.get("ts", 0) <= self.timeout:
+            age = now - rec.get("ts", 0)
+            if age <= self.timeout:
                 live.append(rec["node"])
+            elif age > 3 * self.timeout:
+                # sweep long-dead registrations so the registry dir does
+                # not grow forever across job generations (a revived node
+                # simply re-beats)
+                try:
+                    os.remove(p)
+                except OSError:
+                    pass
         return sorted(live)
 
     def watch(self):
         """One poll step -> ElasticStatus (ref watch loop elastic.py)."""
+        from . import preempt as _preempt
+
+        if _preempt.requested():
+            # this node is being preempted: leave the registry so peers
+            # observe a membership change and re-form without us
+            self.deregister()
+            return ElasticStatus.EXIT
         live = self.live_nodes()
         if len(live) < self.min_np:
             self._known = live
@@ -101,10 +121,13 @@ class ElasticManager:
         return ElasticStatus.HOLD
 
     def world(self):
-        """(rank, world_size) from the current stable membership (same
-        max_np truncation the watcher applies; nodes beyond the cutoff
-        get rank -1)."""
-        live = self.live_nodes()
+        """(rank, world_size) from the STABLE membership snapshotted by
+        the last watch() poll — not a live re-read, which could flap
+        rank/world between two polls mid-step while a peer's heartbeat
+        expires (same max_np truncation the watcher applies; nodes beyond
+        the cutoff get rank -1). Before the first poll, falls back to a
+        live read."""
+        live = self._known if self._known is not None else self.live_nodes()
         if self.max_np:
             live = live[: self.max_np]
         rank = live.index(self.node_id) if self.node_id in live else -1

@@ -179,12 +179,23 @@ def watch_local_trainers(procs, poll_interval=0.5):
 
 
 def launch(argv=None):
+    from ..framework.errors import retry_with_backoff
+
     args = parse_args(argv)
     attempts = 0
     while True:
-        endpoints, world = _build_endpoints(args)
-        procs, logs = start_local_trainers(args, endpoints, world,
-                                           append_logs=(attempts > 0))
+        # the bootstrap races the OS for ports and forks children; both
+        # fail transiently under load (EADDRINUSE between probe and bind,
+        # EAGAIN on fork) — retry with backoff instead of failing the job
+        endpoints, world = retry_with_backoff(
+            lambda: _build_endpoints(args), retries=3,
+            stat="launch_bootstrap_retries",
+            description="launch endpoint allocation")
+        procs, logs = retry_with_backoff(
+            lambda: start_local_trainers(args, endpoints, world,
+                                         append_logs=(attempts > 0)),
+            retries=3, stat="launch_bootstrap_retries",
+            description="launch trainer spawn")
 
         def _sig(signum, frame, procs=procs):
             _terminate_all(procs)

@@ -249,6 +249,12 @@ def build_shardings(layer, optimizer, mesh, *, dp_axis="dp",
 LOSS_SCALE_KEY = "__loss_scale__"
 GOOD_STEPS_KEY = "__loss_scale_good_steps__"
 BAD_STEPS_KEY = "__loss_scale_bad_steps__"
+# reserved buffer slot for the in-graph anomaly guard: consecutive
+# non-finite-step counter (int32, lives with the other step state so it
+# is donated/checkpointed like everything else)
+ANOMALY_BAD_STEPS_KEY = "__anomaly_bad_steps__"
+_RESERVED_BUFFER_KEYS = (LOSS_SCALE_KEY, GOOD_STEPS_KEY, BAD_STEPS_KEY,
+                         ANOMALY_BAD_STEPS_KEY)
 
 # paddle GradScaler defaults (ref python/paddle/amp/grad_scaler.py)
 DEFAULT_SCALE_CONFIG = dict(
@@ -258,13 +264,22 @@ DEFAULT_SCALE_CONFIG = dict(
 
 def make_train_step(layer, loss_fn, optimizer, *, grad_clip=None,
                     donate=True, mesh=None, batch_spec=None, zero_stage=0,
-                    sharding_axis=None, loss_scale=None, comm_dtype=None):
+                    sharding_axis=None, loss_scale=None, comm_dtype=None,
+                    anomaly_guard=False):
     """Build a jitted step:
     (params, buffers, opt_state, batch, lr, key) ->
         (loss, params, buffers, opt_state)
 
     batch: dict with 'inputs' (tuple of arrays) and optional 'labels'
     (tuple). loss_fn(outputs, *labels) -> scalar Tensor.
+
+    anomaly_guard: replaces FLAGS_check_nan_inf's per-op eager scan for
+    compiled training (ref nan_inf_utils_detail.cu). One fused in-graph
+    finiteness bit over loss + unscaled grads per step; a bad step skips
+    the parameter/optimizer/buffer update entirely (jnp.where select, no
+    host sync, no recompilation) and increments the
+    ANOMALY_BAD_STEPS_KEY buffer, which the Engine reads at step
+    boundaries to trigger checkpoint rollback.
 
     comm_dtype ('bfloat16'/'float16'): the fp16_allreduce strategy (ref
     fleet/meta_optimizers/fp16_allreduce_optimizer.py). Under GSPMD the
@@ -349,9 +364,9 @@ def make_train_step(layer, loss_fn, optimizer, *, grad_clip=None,
             bad = buffers[BAD_STEPS_KEY]
         elif static_scale is not None:
             scale = jnp.asarray(static_scale, jnp.float32)
+        anomaly_prev = buffers.get(ANOMALY_BAD_STEPS_KEY)
         model_buffers = {k: v for k, v in buffers.items()
-                         if k not in (LOSS_SCALE_KEY, GOOD_STEPS_KEY,
-                                      BAD_STEPS_KEY)}
+                         if k not in _RESERVED_BUFFER_KEYS}
 
         def scaled_loss(params, model_buffers, batch, key):
             loss, nb = loss_of(params, model_buffers, batch, key)
@@ -370,6 +385,16 @@ def make_train_step(layer, loss_fn, optimizer, *, grad_clip=None,
             finite = jnp.asarray(True)
             for g in jax.tree.leaves(grads):
                 finite = finite & jnp.isfinite(g).all()
+        if anomaly_guard:
+            from .amp import all_finite as _all_finite
+
+            # like the loss-scale check, judged on RAW grads before
+            # decay/clip (a value clipper would map inf -> finite and
+            # hide the anomaly), plus the loss itself (a NaN loss with
+            # zero grads — e.g. a poisoned masked branch — must count)
+            grads_finite = finite if loss_scale is not None \
+                else _all_finite(grads)
+            guard_ok = grads_finite & jnp.isfinite(loss)
         if grad_constraint is not None:
             grads = grad_constraint(grads)
         metas = optimizer.param_metas_for(params, _sd)
@@ -390,6 +415,19 @@ def make_train_step(layer, loss_fn, optimizer, *, grad_clip=None,
             new_params = pick(new_params, params)
             new_opt = pick(new_opt, opt_state)
             new_buffers = dict(new_buffers)
+        if anomaly_guard:
+            # skip the whole update on a bad step — params, moments AND
+            # captured buffer updates (BN running stats etc.) — and count
+            # consecutive bad steps in-graph; everything is a where()
+            # select on the one fused bit, so the compiled step stays a
+            # single program with no host round-trip
+            gpick = lambda new, old: jax.tree.map(  # noqa: E731
+                lambda n, o: jnp.where(guard_ok, n, o), new, old)
+            new_params = gpick(new_params, params)
+            new_opt = gpick(new_opt, opt_state)
+            new_buffers = dict(gpick(new_buffers, model_buffers))
+            new_buffers[ANOMALY_BAD_STEPS_KEY] = jnp.where(
+                guard_ok, 0, anomaly_prev + 1).astype(jnp.int32)
         if dynamic_scale:
             good_next = jnp.where(finite, good + 1, 0)
             bad_next = jnp.where(finite, 0, bad + 1)
@@ -428,6 +466,8 @@ def make_train_step(layer, loss_fn, optimizer, *, grad_clip=None,
             buf_sh[LOSS_SCALE_KEY] = NamedSharding(mesh, P())
             buf_sh[GOOD_STEPS_KEY] = NamedSharding(mesh, P())
             buf_sh[BAD_STEPS_KEY] = NamedSharding(mesh, P())
+        if anomaly_guard:
+            buf_sh[ANOMALY_BAD_STEPS_KEY] = NamedSharding(mesh, P())
         opt0 = {k: optimizer._init_state(v) for k, v in params0.items()}
         o_sh = {k: jax.tree.map(lambda a, kk=k: opt_sh(kk, a), st)
                 for k, st in opt0.items()}
@@ -475,7 +515,8 @@ class Engine:
 
     def __init__(self, layer, optimizer, loss_fn, grad_clip=None, mesh=None,
                  batch_spec=None, zero_stage=0, sharding_axis=None,
-                 loss_scale=None, offload=False, comm_dtype=None):
+                 loss_scale=None, offload=False, comm_dtype=None,
+                 anomaly_guard=False):
         self.layer = layer
         self.optimizer = optimizer
         self.loss_fn = loss_fn
@@ -486,6 +527,7 @@ class Engine:
         self.loss_scale = loss_scale
         self.offload = offload
         self.comm_dtype = comm_dtype
+        self.anomaly_guard = anomaly_guard
         self.state = init_train_state(
             layer, optimizer,
             opt_state_mesh_host=mesh if offload else None)
@@ -498,12 +540,16 @@ class Engine:
                 float(cfg["init_loss_scaling"]), jnp.float32)
             self.state.buffers[GOOD_STEPS_KEY] = jnp.asarray(0, jnp.int32)
             self.state.buffers[BAD_STEPS_KEY] = jnp.asarray(0, jnp.int32)
+        if anomaly_guard:
+            self.state.buffers[ANOMALY_BAD_STEPS_KEY] = \
+                jnp.asarray(0, jnp.int32)
         self._step_fn = None
         self._offload_sh = None
         self._grad_clip = grad_clip
         self._step_protos = None
         self._mem_analysis = None
         self._batch_sig = None
+        self._ckpt_manager = None
 
     def _build(self):
         self._step_fn = make_train_step(
@@ -511,7 +557,7 @@ class Engine:
             grad_clip=self._grad_clip, mesh=self.mesh,
             batch_spec=self.batch_spec, zero_stage=self.zero_stage,
             sharding_axis=self.sharding_axis, loss_scale=self.loss_scale,
-            comm_dtype=self.comm_dtype)
+            comm_dtype=self.comm_dtype, anomaly_guard=self.anomaly_guard)
         self._offload_sh = None
         if self.offload and self._step_fn._state_shardings is not None:
             # optimizer-state offload (ref sharding/offload_helper.py):
@@ -537,6 +583,12 @@ class Engine:
         if not isinstance(labels, (list, tuple)):
             labels = (labels,)
         batch = {"inputs": self._arrs(inputs), "labels": self._arrs(labels)}
+        from .framework import faults as _faults
+
+        # fault-injection point: a scheduled 'nan' action poisons the
+        # HOST batch (in-graph effect on loss/grads, no recompilation) —
+        # the deterministic way to exercise the anomaly guard
+        batch = _faults.fault_point("train.batch", batch)
         key = _random.default_generator.next_key()
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         opt_state = self.state.opt_state
@@ -561,6 +613,8 @@ class Engine:
             new_opt = jax.device_put(new_opt, self._offload_sh[1])
         self.state.opt_state = new_opt
         self.state.step += 1
+        if self.anomaly_guard:
+            self._check_anomaly()
         from . import profiler as _profiler
 
         if _profiler.is_op_profiling_enabled():
@@ -600,6 +654,53 @@ class Engine:
             monitor.stat_max("device_mem_step_peak_bytes",
                              self._mem_analysis["peak"])
         return dict(self._mem_analysis)
+
+    def attach_checkpoint_manager(self, manager):
+        """Give the anomaly guard a rollback target: when
+        FLAGS_anomaly_max_bad_steps consecutive steps go non-finite, the
+        engine restores the newest readable checkpoint from this
+        CheckpointManager (train_epoch_range attaches its own manager
+        automatically)."""
+        self._ckpt_manager = manager
+
+    def _check_anomaly(self):
+        """Step-boundary policy for the in-graph guard: ONE scalar read
+        of the consecutive-bad-step buffer (the only host sync the guard
+        adds — never per-op), then rollback once the budget is spent."""
+        from .framework import flags as _flags, monitor as _monitor
+
+        bad = int(self.state.buffers[ANOMALY_BAD_STEPS_KEY])
+        if bad == 0:
+            return
+        _monitor.stat_add("anomaly_bad_steps")
+        max_bad = _flags.flag("FLAGS_anomaly_max_bad_steps")
+        if not max_bad or bad < max_bad:
+            return  # skipped in-graph; give the run a chance to recover
+        if self._ckpt_manager is None:
+            from .framework.errors import PreconditionNotMetError
+
+            raise PreconditionNotMetError(
+                f"anomaly guard: {bad} consecutive non-finite steps and "
+                "no checkpoint manager attached for rollback — call "
+                "engine.attach_checkpoint_manager(...) or train via "
+                "checkpoint.train_epoch_range")
+        import warnings
+
+        from .distributed import checkpoint as _ckpt
+
+        self._ckpt_manager.wait_until_finished()
+        step, _ = self._ckpt_manager.restore_with(
+            lambda p: _ckpt.load_train_state(p, self))
+        # the restored snapshot predates the anomaly: clear the counter
+        # so the guard re-arms from zero
+        self.state.buffers = dict(self.state.buffers)
+        self.state.buffers[ANOMALY_BAD_STEPS_KEY] = \
+            jnp.asarray(0, jnp.int32)
+        _monitor.stat_add("anomaly_rollbacks")
+        warnings.warn(
+            f"anomaly guard: {bad} consecutive non-finite steps; rolled "
+            f"back to checkpoint ckpt-{step} (engine step "
+            f"{self.state.step})")
 
     def sync_to_layer(self):
         write_back(self.layer, self.state)

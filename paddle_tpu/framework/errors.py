@@ -13,6 +13,7 @@ __all__ = [
     "PreconditionNotMetError", "PermissionDeniedError", "UnavailableError",
     "FatalError", "UnimplementedError", "ExecutionTimeoutError",
     "enforce", "enforce_eq", "enforce_gt", "enforce_shape",
+    "retry_with_backoff",
 ]
 
 
@@ -62,6 +63,40 @@ class UnimplementedError(PaddleError, NotImplementedError):
 
 class ExecutionTimeoutError(PaddleError, TimeoutError):
     pass
+
+
+def retry_with_backoff(fn, *, retries=3, base_delay=0.1, max_delay=2.0,
+                       exceptions=(OSError,), stat=None, description=""):
+    """Run `fn()` retrying on `exceptions` with exponential backoff.
+
+    Shared by checkpoint I/O and the launch bootstrap (transient
+    filesystem / port / coordinator failures). `retries` is the number
+    of RE-tries after the first attempt; delays are base_delay * 2**k
+    capped at max_delay. Each retry bumps monitor counter ``retries``
+    (plus ``<stat>`` when given) and warns with `description`, so flaky
+    infrastructure is visible instead of silent.
+    """
+    import time
+    import warnings
+
+    from . import monitor
+
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except exceptions as e:
+            if attempt >= retries:
+                raise
+            delay = min(base_delay * (2 ** attempt), max_delay)
+            attempt += 1
+            monitor.stat_add("retries")
+            if stat:
+                monitor.stat_add(stat)
+            warnings.warn(
+                f"{description or 'operation'} failed ({e!r}); retry "
+                f"{attempt}/{retries} in {delay:.2f}s")
+            time.sleep(delay)
 
 
 def enforce(cond, message, error_cls=InvalidArgumentError):

@@ -1,0 +1,235 @@
+"""Deterministic fault-injection harness.
+
+Ref parity: the reference certified fault paths with shell-level chaos
+(test_fleet_launch_elastic.sh SIGKILLs a rank; nan_inf_utils tests feed
+poisoned tensors). Here the fault points are *in the runtime itself* and
+fire deterministically by occurrence index, so recovery tests can assert
+bitwise-identical loss trajectories instead of "it eventually restarts".
+
+A fault point is a named site the runtime passes through:
+
+    checkpoint.io             each checkpoint write attempt (retry target)
+    checkpoint.before_commit  after arrays+manifest land in ckpt-N.tmp,
+                              before the atomic directory rename
+    checkpoint.after_commit   after the rename; payload = committed dir
+    train.batch               each Engine.train_batch; payload = batch
+    elastic.beat              each heartbeat write (drop target)
+    preempt.poll              each preemption poll (step boundary)
+
+Faults are scheduled programmatically::
+
+    with faults.inject("checkpoint.before_commit@1:raise"):
+        ...   # first save attempt dies between write and commit
+
+or across process boundaries via the env var ``PADDLE_TPU_FAULTS``
+(semicolon-separated specs, read once at first use) — that is how the
+kill->restore tests schedule a crash inside a forked trainer.
+
+Spec grammar: ``site@occurrence:action[:arg]`` where occurrence is a
+1-based hit index (``3``), an inclusive range (``2-5``, open ``3-``), or
+``*``; actions:
+
+    crash        os._exit(137) — ungraceful death at the exact point
+    raise        raise FaultError (in-process tests)
+    ioerror      raise OSError (exercises retry_with_backoff paths)
+    delay:<s>    sleep s seconds (slow I/O)
+    nan          return the payload with float leaves poisoned to NaN
+    corrupt      truncate the largest file under payload (a ckpt dir)
+    drop         return the DROP sentinel (caller skips its action)
+
+Every fire bumps ``monitor`` counter ``faults.<site>``.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+from . import monitor
+
+__all__ = ["FaultError", "DROP", "fault_point", "inject", "reset",
+           "parse_spec", "corrupt_leaf"]
+
+
+class FaultError(RuntimeError):
+    """Raised by the 'raise' action (deliberately NOT an OSError so
+    checkpoint retry loops do not swallow injected crashes)."""
+
+
+#: sentinel returned by `fault_point` when a 'drop' fault fires
+DROP = object()
+
+_lock = threading.Lock()
+_specs: list = []            # active FaultSpec list (env + injected)
+_hits: dict = {}             # site -> number of times the point was hit
+_env_loaded = False
+
+
+class FaultSpec:
+    def __init__(self, site, lo, hi, action, arg=None):
+        self.site = site
+        self.lo = lo          # 1-based inclusive
+        self.hi = hi          # inclusive; None = open
+        self.action = action
+        self.arg = arg
+
+    def matches(self, site, hit):
+        if site != self.site:
+            return False
+        if self.lo is None:   # '*'
+            return True
+        return hit >= self.lo and (self.hi is None or hit <= self.hi)
+
+    def __repr__(self):
+        occ = "*" if self.lo is None else (
+            str(self.lo) if self.hi == self.lo else
+            f"{self.lo}-{'' if self.hi is None else self.hi}")
+        arg = f":{self.arg}" if self.arg is not None else ""
+        return f"{self.site}@{occ}:{self.action}{arg}"
+
+
+def parse_spec(text):
+    """``site@occ:action[:arg]`` -> FaultSpec."""
+    site, _, rest = text.strip().partition("@")
+    occ, _, act = rest.partition(":")
+    if not site or not occ or not act:
+        raise ValueError(f"bad fault spec {text!r} "
+                         "(want site@occurrence:action[:arg])")
+    action, _, arg = act.partition(":")
+    if occ == "*":
+        lo = hi = None
+    elif "-" in occ:
+        a, b = occ.split("-", 1)
+        lo, hi = int(a), (int(b) if b else None)
+    else:
+        lo = hi = int(occ)
+    return FaultSpec(site, lo, hi, action, arg or None)
+
+
+def _load_env():
+    global _env_loaded
+    if _env_loaded:
+        return
+    with _lock:
+        if _env_loaded:
+            return
+        raw = os.environ.get("PADDLE_TPU_FAULTS", "")
+        for part in raw.split(";"):
+            if part.strip():
+                _specs.append(parse_spec(part))
+        _env_loaded = True
+
+
+def reset(site=None):
+    """Zero hit counters (one site, or all). inject() does this for its
+    own sites so occurrence indices are test-local and deterministic."""
+    with _lock:
+        if site is None:
+            _hits.clear()
+        else:
+            _hits.pop(site, None)
+
+
+class inject:
+    """Context manager activating fault specs for its dynamic extent."""
+
+    def __init__(self, *specs, reset_counters=True):
+        self._specs = [parse_spec(s) if isinstance(s, str) else s
+                       for s in specs]
+        self._reset = reset_counters
+
+    def __enter__(self):
+        _load_env()
+        with _lock:
+            _specs.extend(self._specs)
+        if self._reset:
+            for s in self._specs:
+                reset(s.site)
+        return self
+
+    def __exit__(self, *exc):
+        with _lock:
+            for s in self._specs:
+                try:
+                    _specs.remove(s)
+                except ValueError:
+                    pass
+        return False
+
+
+def _poison_nan(payload):
+    import jax
+    import numpy as np
+
+    def leaf(a):
+        arr = np.asarray(a)
+        if np.issubdtype(arr.dtype, np.floating):
+            return np.full_like(arr, np.nan)
+        return a
+
+    return jax.tree.map(leaf, payload)
+
+
+def corrupt_leaf(path):
+    """Truncate the largest ARRAY-DATA file under `path` to half its
+    size (the 'truncate-a-leaf' checkpoint corruption). Tensorstore
+    parks array bytes in content-addressed files under `d/` directories;
+    preferring those over the JSON/metadata files makes the injected
+    damage exercise the checksum/restore path rather than a trivial
+    metadata parse error. Falls back to the largest file overall."""
+    victim, size = None, -1
+    any_victim, any_size = None, -1
+    for root, _dirs, files in os.walk(path):
+        in_data = os.path.basename(root) == "d"
+        for name in files:
+            p = os.path.join(root, name)
+            try:
+                s = os.path.getsize(p)
+            except OSError:
+                continue
+            if s > any_size:
+                any_victim, any_size = p, s
+            if in_data and s > size:
+                victim, size = p, s
+    if victim is None:
+        victim, size = any_victim, any_size
+    if victim is None:
+        raise FileNotFoundError(f"no files to corrupt under {path}")
+    with open(victim, "r+b") as f:
+        f.truncate(max(size // 2, 1))
+    return victim
+
+
+def fault_point(site, payload=None):
+    """Pass through a named fault site.
+
+    Returns `payload` (possibly transformed by a 'nan' fault), or the
+    DROP sentinel when a 'drop' fault fires. May raise, sleep, or exit
+    the process depending on the scheduled action.
+    """
+    _load_env()
+    with _lock:
+        if not _specs:
+            return payload  # zero-cost when nothing is scheduled
+        _hits[site] = hit = _hits.get(site, 0) + 1
+        matched = [s for s in _specs if s.matches(site, hit)]
+    for spec in matched:
+        monitor.stat_add(f"faults.{site}")
+        if spec.action == "crash":
+            os._exit(137)
+        elif spec.action == "raise":
+            raise FaultError(f"injected fault at {site} (hit {hit})")
+        elif spec.action == "ioerror":
+            raise OSError(f"injected I/O error at {site} (hit {hit})")
+        elif spec.action == "delay":
+            time.sleep(float(spec.arg or 0.1))
+        elif spec.action == "nan":
+            payload = _poison_nan(payload)
+        elif spec.action == "corrupt":
+            corrupt_leaf(payload)
+        elif spec.action == "drop":
+            return DROP
+        else:
+            raise ValueError(f"unknown fault action {spec.action!r}")
+    return payload

@@ -37,6 +37,20 @@ _DEFS = {
         "modulo collectives"),
     "FLAGS_max_inplace_grad_add": (
         0, int, "accepted for compatibility"),
+    "FLAGS_anomaly_max_bad_steps": (
+        3, int,
+        "compiled-path anomaly guard: after this many CONSECUTIVE "
+        "non-finite steps (loss or grads), roll the engine back to the "
+        "last good checkpoint (0 disables rollback; bad steps are still "
+        "skipped in-graph)"),
+    "FLAGS_ckpt_verify_checksums": (
+        True, bool,
+        "verify the per-leaf sha256 manifest when restoring a "
+        "checkpoint (detects truncated/corrupted leaves)"),
+    "FLAGS_simulate_preempt_at_step": (
+        0, int,
+        "testing: report a preemption at the Nth preemption poll "
+        "(step/epoch boundary); 0 disables"),
 }
 
 _values: dict = {}

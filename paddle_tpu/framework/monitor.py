@@ -32,9 +32,13 @@ def stat_max(name: str, value: int):
         _stats[name] = max(_stats.get(name, 0), int(value))
 
 
-def stats() -> dict:
+def stats(prefix: str = None) -> dict:
+    """All counters, or only those whose name starts with `prefix`
+    (e.g. stats("ckpt_") for the fault-tolerance runtime's counters)."""
     with _lock:
-        return dict(_stats)
+        if prefix is None:
+            return dict(_stats)
+        return {k: v for k, v in _stats.items() if k.startswith(prefix)}
 
 
 def reset():

@@ -7,6 +7,8 @@ functional engine compiles the step; eager fallback for debugging).
 
 from __future__ import annotations
 
+import os
+
 import numpy as np
 
 from ..core.tensor import Tensor
@@ -139,6 +141,25 @@ class Model:
         return [o.numpy() if isinstance(o, Tensor) else o
                 for o in (out if isinstance(out, (list, tuple)) else [out])]
 
+    def _emergency_save(self, save_dir, *, epoch, step):
+        """Preemption checkpoint: full engine state (params, moments,
+        step, RNG) to <save_dir>/preempt-ckpt plus a PREEMPTED marker so
+        the restarted job knows to resume rather than start fresh. With
+        no save_dir there is nowhere durable to write — training just
+        stops at the batch boundary."""
+        from ..distributed import checkpoint as _ckpt, preempt as _preempt
+        from ..framework import monitor as _monitor
+
+        if not save_dir:
+            return
+        if self._engine is not None:
+            _ckpt.save_train_state(
+                os.path.join(save_dir, "preempt-ckpt"), self._engine)
+        else:
+            self.save(os.path.join(save_dir, "preempt-ckpt", "model"))
+        _preempt.write_marker(save_dir, {"epoch": epoch, "step": step})
+        _monitor.stat_add("preempt_emergency_saves")
+
     # -- fit/evaluate/predict -----------------------------------------------
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1,
@@ -157,6 +178,12 @@ class Model:
                          "verbose": verbose, "save_dir": save_dir})
         cbks.on_train_begin()
         self.stop_training = False
+        # preemption-safe fit: SIGTERM/SIGUSR1 stop training at the next
+        # BATCH boundary with an emergency checkpoint instead of dying
+        # mid-step (ref: the reference elastic stack had no graceful path)
+        from ..distributed import preempt as _preempt
+
+        _preempt.install()
         it = 0
         for epoch in range(epochs):
             if self.stop_training:
@@ -173,6 +200,10 @@ class Model:
                 cbks.on_train_batch_end(step, logs)
                 it += 1
                 if num_iters is not None and it >= num_iters:
+                    self.stop_training = True
+                    break
+                if _preempt.poll():
+                    self._emergency_save(save_dir, epoch=epoch, step=step)
                     self.stop_training = True
                     break
             if eval_loader is not None and (epoch + 1) % eval_freq == 0:

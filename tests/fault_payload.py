@@ -1,0 +1,81 @@
+"""Fault-recovery payload: single-process trainer driven by the slow
+kill->restore tests (test_fault_recovery_slow.py).
+
+Trains `max_epoch` epochs through train_epoch_range with per-epoch
+checkpointing, logging "<attempt> <epoch> <loss>" lines. Faults arrive
+from OUTSIDE via either:
+
+  * PADDLE_TPU_FAULTS env (e.g. checkpoint.before_commit@2:crash) —
+    the deterministic in-runtime harness kills us at the exact point;
+  * a real SIGTERM from the parent test (mode 'preempt') — the handler
+    installed by train_epoch_range requests a graceful stop, the next
+    epoch boundary writes the emergency checkpoint + PREEMPTED marker
+    and PreemptedError unwinds; we exit 143 like a well-behaved pod.
+
+The parent asserts the concatenated per-attempt logs are
+bitwise-identical to one uninterrupted reference run.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(__file__)))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+import paddle_tpu.nn as nn  # noqa: E402
+from paddle_tpu.distributed import checkpoint as ckpt  # noqa: E402
+from paddle_tpu.distributed import preempt  # noqa: E402
+from paddle_tpu.engine import Engine  # noqa: E402
+
+out_dir = sys.argv[1]
+mode = sys.argv[2] if len(sys.argv) > 2 else "train"
+max_epoch = int(os.environ.get("FAULT_PAYLOAD_EPOCHS", "6"))
+
+attempt_marker = os.path.join(out_dir, "attempt")
+attempt = 1
+if os.path.exists(attempt_marker):
+    attempt = int(open(attempt_marker).read()) + 1
+with open(attempt_marker, "w") as f:
+    f.write(str(attempt))
+
+paddle.seed(11)
+# 64x64: big enough that tensorstore parks the weight bytes in a `d/`
+# data file (tiny leaves inline into the OCDBT b-tree, which would make
+# the truncation scenario corrupt nothing that restores actually read)
+model = nn.Linear(64, 64)
+opt = paddle.optimizer.Adam(learning_rate=0.05,
+                            parameters=model.parameters())
+eng = Engine(model, opt, lambda out, y: ((out - y) ** 2).mean())
+rng = np.random.RandomState(3)
+x = rng.randn(16, 64).astype(np.float32)
+y = rng.randn(16, 64).astype(np.float32)
+
+log = open(os.path.join(out_dir, "epochs.log"), "a")
+try:
+    for epoch in ckpt.train_epoch_range(max_epoch, out_dir, eng,
+                                        save_interval=1):
+        loss = float(np.asarray(eng.train_batch((x,), (y,)).item()))
+        log.write(f"{attempt} {epoch} {loss:.9e}\n")
+        log.flush()
+        if mode == "preempt" and attempt == 1 and epoch == 1:
+            # tell the parent we are mid-run so its SIGTERM races a real
+            # step loop, then linger long enough for it to land
+            with open(os.path.join(out_dir, "ready"), "w") as f:
+                f.write("1")
+            deadline = time.time() + 30
+            while not preempt.requested() and time.time() < deadline:
+                time.sleep(0.02)
+except preempt.PreemptedError:
+    log.close()
+    print(f"PREEMPTED attempt={attempt}", flush=True)
+    sys.exit(143)
+
+log.close()
+print(f"DONE attempt={attempt}", flush=True)

@@ -1,0 +1,159 @@
+"""Fork-based fault-recovery certification (slow tier).
+
+Each scenario here has a fast in-process equivalent in
+test_fault_tolerance.py; these versions use REAL process death — SIGKILL
+via the fault harness's `crash` action, real SIGTERM delivery, and
+restores in a fresh process (which is the only place physical file
+truncation reliably fails: tensorstore's in-process cache can serve the
+original bytes to the process that wrote them).
+
+The certification bar everywhere: the concatenated per-attempt loss
+logs, keyed by epoch, are bitwise-identical to one uninterrupted
+reference run.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PAYLOAD = os.path.join(REPO, "tests", "fault_payload.py")
+
+pytestmark = pytest.mark.slow
+
+
+def _clean_env(**extra):
+    env = dict(os.environ)
+    for k in list(env):
+        if k.startswith("PADDLE_"):
+            del env[k]
+    env.pop("XLA_FLAGS", None)
+    env.pop("JAX_PLATFORMS", None)
+    env.update(extra)
+    return env
+
+
+def _run_payload(out_dir, mode="train", timeout=180, **env):
+    os.makedirs(out_dir, exist_ok=True)
+    return subprocess.run(
+        [sys.executable, PAYLOAD, out_dir, mode],
+        cwd=REPO, env=_clean_env(**env), capture_output=True, text=True,
+        timeout=timeout)
+
+
+def _read_log(out_dir):
+    """-> list of (attempt, epoch, loss-string). Loss stays a STRING so
+    comparisons are bitwise, not approximate."""
+    rows = []
+    with open(os.path.join(out_dir, "epochs.log")) as f:
+        for line in f:
+            a, e, l = line.split()
+            rows.append((int(a), int(e), l))
+    return rows
+
+
+def _assert_matches_reference(rows, ref_rows):
+    """Every logged (epoch, loss) — including epochs replayed after a
+    restore — must equal the uninterrupted run's loss for that epoch."""
+    ref = {e: l for _a, e, l in ref_rows}
+    assert sorted(ref) == list(range(len(ref)))
+    for a, e, l in rows:
+        assert l == ref[e], (
+            f"attempt {a} epoch {e}: {l} != reference {ref[e]}")
+    # and the union of epochs covers the whole schedule
+    assert {e for _a, e, _l in rows} == set(ref)
+
+
+@pytest.fixture(scope="module")
+def reference(tmp_path_factory):
+    out = str(tmp_path_factory.mktemp("ref"))
+    proc = _run_payload(out)
+    assert proc.returncode == 0, proc.stderr
+    return _read_log(out)
+
+
+def test_crash_before_commit_restores_and_replays(tmp_path, reference):
+    """SIGKILL (os._exit) between the checkpoint write and the atomic
+    rename: no torn ckpt dir is visible, the rerun resumes from the last
+    COMMITTED snapshot, trajectory bitwise-identical."""
+    out = str(tmp_path / "run")
+    proc = _run_payload(
+        out, PADDLE_TPU_FAULTS="checkpoint.before_commit@3:crash")
+    assert proc.returncode == 137, (proc.returncode, proc.stderr)
+    # the interrupted save left only a staging dir, never a half commit
+    assert not os.path.isdir(os.path.join(out, "auto_ckpt", "ckpt-2"))
+    rows1 = _read_log(out)
+    assert [e for _a, e, _l in rows1] == [0, 1, 2]
+
+    proc = _run_payload(out)
+    assert proc.returncode == 0, proc.stderr
+    rows = _read_log(out)
+    # resumed from ckpt-1 -> epoch 2 replayed by attempt 2
+    assert [e for a, e, _l in rows if a == 2] == [2, 3, 4, 5]
+    _assert_matches_reference(rows, reference)
+
+
+def test_sigterm_preemption_graceful_handoff(tmp_path, reference):
+    """A real SIGTERM mid-training: the trainer finishes the epoch,
+    writes an emergency checkpoint + PREEMPTED marker, exits 143; the
+    restarted process consumes the marker and completes the schedule."""
+    out = str(tmp_path / "run")
+    os.makedirs(out)
+    proc = subprocess.Popen(
+        [sys.executable, PAYLOAD, out, "preempt"],
+        cwd=REPO, env=_clean_env(), stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True)
+    ready = os.path.join(out, "ready")
+    deadline = time.time() + 120
+    while not os.path.exists(ready) and time.time() < deadline:
+        time.sleep(0.05)
+    assert os.path.exists(ready), "payload never reached the step loop"
+    proc.send_signal(signal.SIGTERM)
+    stdout, stderr = proc.communicate(timeout=120)
+    assert proc.returncode == 143, (proc.returncode, stderr)
+    assert "PREEMPTED attempt=1" in stdout
+    marker = os.path.join(out, "auto_ckpt", "PREEMPTED")
+    assert os.path.exists(marker)
+    rows1 = _read_log(out)
+    assert [e for _a, e, _l in rows1] == [0, 1]
+
+    proc2 = _run_payload(out)
+    assert proc2.returncode == 0, proc2.stderr
+    assert not os.path.exists(marker)  # consumed on resume
+    rows = _read_log(out)
+    # epoch 1 was checkpointed before exit: attempt 2 starts at 2
+    assert [e for a, e, _l in rows if a == 2] == [2, 3, 4, 5]
+    _assert_matches_reference(rows, reference)
+
+
+def test_truncated_checkpoint_fails_in_fresh_process(tmp_path,
+                                                     reference):
+    """Physical truncation certified across a process boundary: the
+    writer process exits, the NEWEST checkpoint loses half of its
+    largest array-data file, and the restarted process (whose
+    tensorstore cache never saw the original bytes) must fall back to
+    the previous snapshot and replay to the same trajectory."""
+    from paddle_tpu.framework import faults
+
+    out = str(tmp_path / "run")
+    proc = _run_payload(out)
+    assert proc.returncode == 0, proc.stderr
+    newest = os.path.join(out, "auto_ckpt", "ckpt-5")
+    assert os.path.isdir(newest)
+    victim = faults.corrupt_leaf(newest)
+    assert os.sep + "d" + os.sep in victim
+
+    # one more epoch of budget so the rerun has work to do after resume
+    proc = _run_payload(out, FAULT_PAYLOAD_EPOCHS="7")
+    assert proc.returncode == 0, proc.stderr
+    rows = _read_log(out)
+    # ckpt-5 rejected -> resumed from ckpt-4 -> replayed epoch 5
+    assert [e for a, e, _l in rows if a == 2] == [5, 6]
+    ref = {e: l for _a, e, l in reference}
+    for a, e, l in rows:
+        if e in ref:
+            assert l == ref[e], (a, e)

@@ -1,0 +1,699 @@
+"""Fault-tolerant training runtime: in-process certification tier.
+
+Covers the four pillars with DETERMINISTIC fault injection
+(paddle_tpu.framework.faults) so every scenario runs in tier-1 without
+forking processes — the fork-based kill->restore equivalents live in
+test_fault_recovery_slow.py (@slow):
+
+1. async atomic checkpointing: crash-before-commit leaves no torn dir,
+   checksums catch corruption, restore falls back, saves retry, the
+   async writer never blocks the step loop;
+2. preemption: simulated preemption checkpoints + marker + exact resume;
+3. in-graph anomaly guard: bad steps skipped with NO recompilation and
+   NO per-op host sync, rollback restores the last good checkpoint and
+   the replayed trajectory is bitwise-identical;
+4. the fault harness itself (occurrence scheduling, retry interplay).
+"""
+
+import os
+import shutil
+import signal
+import warnings
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.distributed import checkpoint as ckpt, preempt
+from paddle_tpu.distributed.elastic import ElasticManager, ElasticStatus
+from paddle_tpu.engine import ANOMALY_BAD_STEPS_KEY, Engine
+from paddle_tpu.framework import faults, flags, monitor
+from paddle_tpu.framework.errors import (
+    PreconditionNotMetError, retry_with_backoff,
+)
+
+
+def _mk_engine(seed=5, lr=0.05, **kw):
+    paddle.seed(seed)
+    m = nn.Linear(6, 3)
+    opt = paddle.optimizer.Adam(learning_rate=lr,
+                                parameters=m.parameters())
+    return Engine(m, opt, lambda o, y: ((o - y) ** 2).mean(), **kw)
+
+
+def _batch():
+    rs = np.random.RandomState(0)
+    return (rs.randn(8, 6).astype(np.float32),
+            rs.randn(8, 3).astype(np.float32))
+
+
+def _stat(name):
+    return monitor.stats().get(name, 0)
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_state():
+    """Every test starts/ends with no scheduled faults, no preemption
+    state, and default flags for the knobs this suite touches."""
+    faults.reset()
+    preempt.clear()
+    yield
+    preempt.uninstall()
+    preempt.clear()
+    faults.reset()
+    flags.set_flags({"FLAGS_simulate_preempt_at_step": 0,
+                     "FLAGS_check_nan_inf": False,
+                     "FLAGS_anomaly_max_bad_steps": 3,
+                     "FLAGS_ckpt_verify_checksums": True})
+
+
+# ---------------------------------------------------------------------------
+# retry + fault harness
+# ---------------------------------------------------------------------------
+
+
+def test_retry_with_backoff_retries_then_succeeds():
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise OSError("transient")
+        return "ok"
+
+    before = _stat("retries")
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        assert retry_with_backoff(flaky, retries=3,
+                                  base_delay=0.001) == "ok"
+    assert calls["n"] == 3
+    assert _stat("retries") - before == 2
+
+
+def test_retry_gives_up_and_does_not_swallow_fault_errors():
+    def always_bad():
+        raise OSError("persistent")
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        with pytest.raises(OSError):
+            retry_with_backoff(always_bad, retries=2, base_delay=0.001)
+
+    # FaultError is deliberately NOT an OSError: an injected crash must
+    # escape the retry loop on the first attempt
+    calls = {"n": 0}
+
+    def injected():
+        calls["n"] += 1
+        raise faults.FaultError("boom")
+
+    with pytest.raises(faults.FaultError):
+        retry_with_backoff(injected, retries=5, base_delay=0.001)
+    assert calls["n"] == 1
+
+
+def test_fault_occurrence_scheduling():
+    spec = faults.parse_spec("x.y@2-3:raise")
+    assert not spec.matches("x.y", 1)
+    assert spec.matches("x.y", 2) and spec.matches("x.y", 3)
+    assert not spec.matches("x.y", 4)
+    assert not spec.matches("other", 2)
+    with faults.inject("site.a@2:raise"):
+        faults.fault_point("site.a")  # hit 1: clean
+        with pytest.raises(faults.FaultError):
+            faults.fault_point("site.a")  # hit 2: fires
+        faults.fault_point("site.a")  # hit 3: clean again
+    # specs removed on exit
+    faults.fault_point("site.a")
+
+
+# ---------------------------------------------------------------------------
+# atomic checkpointing
+# ---------------------------------------------------------------------------
+
+
+def test_crash_before_commit_leaves_no_torn_dir(tmp_path):
+    """The tentpole atomicity contract: a crash at the worst instant
+    (arrays + manifest staged, commit rename not yet issued) must leave
+    the previous checkpoint fully intact and the new step INVISIBLE."""
+    mgr = ckpt.CheckpointManager(str(tmp_path / "run"))
+    eng = _mk_engine()
+    x, y = _batch()
+    eng.train_batch((x,), (y,))
+    mgr.save_engine(1, eng)
+    eng.train_batch((x,), (y,))
+    with faults.inject("checkpoint.before_commit@1:raise"):
+        with pytest.raises(faults.FaultError):
+            mgr.save_engine(2, eng)
+    # the staged tmp dir exists but is invisible to step enumeration
+    assert os.path.isdir(str(tmp_path / "run" / "ckpt-2.tmp"))
+    assert not os.path.exists(str(tmp_path / "run" / "ckpt-2"))
+    assert mgr.all_steps() == [1]
+    # restore proceeds from the intact previous step
+    eng2 = _mk_engine(seed=777)
+    step, _ = mgr.restore_with(lambda p: ckpt.load_train_state(p, eng2))
+    assert step == 1 and eng2.state.step == 1
+    # the next save reuses/replaces the stale tmp dir cleanly
+    mgr.save_engine(2, eng)
+    assert mgr.all_steps() == [1, 2]
+
+
+def test_checkpoint_io_errors_are_retried(tmp_path):
+    before = _stat("ckpt_retries")
+    eng = _mk_engine()
+    x, y = _batch()
+    eng.train_batch((x,), (y,))
+    with faults.inject("checkpoint.io@1-2:ioerror"):
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            ckpt.save_train_state(str(tmp_path / "ck"), eng)
+    assert _stat("ckpt_retries") - before == 2
+    eng2 = _mk_engine(seed=42)
+    ckpt.load_train_state(str(tmp_path / "ck"), eng2)
+    assert eng2.state.step == 1
+
+
+def test_checksum_mismatch_raises_and_restore_falls_back(tmp_path):
+    """Satellite: restore_with fallback against a checksum-mismatch dir
+    — a committed checkpoint whose on-disk bytes no longer match the
+    manifest must fail LOUDLY, and the manager must route around it."""
+    mgr = ckpt.CheckpointManager(str(tmp_path / "run"))
+    eng = _mk_engine()
+    x, y = _batch()
+    eng.train_batch((x,), (y,))
+    mgr.save_engine(1, eng)
+    eng.train_batch((x,), (y,))
+    mgr.save_engine(2, eng)
+
+    # tamper the newest checkpoint's manifest so every leaf mismatches
+    import json
+
+    mpath = str(tmp_path / "run" / "ckpt-2" / ckpt.MANIFEST_NAME)
+    manifest = json.load(open(mpath))
+    for rec in manifest.values():
+        rec["sha256"] = "0" * 64
+    json.dump(manifest, open(mpath, "w"))
+
+    with pytest.raises(ValueError, match="checksum"):
+        ckpt.load_train_state(str(tmp_path / "run" / "ckpt-2"),
+                              _mk_engine(seed=9))
+
+    # verification is flag-gated (escape hatch for forensics)
+    flags.set_flags({"FLAGS_ckpt_verify_checksums": False})
+    try:
+        ckpt.load_train_state(str(tmp_path / "run" / "ckpt-2"),
+                              _mk_engine(seed=9))
+    finally:
+        flags.set_flags({"FLAGS_ckpt_verify_checksums": True})
+
+    eng3 = _mk_engine(seed=11)
+    before = _stat("ckpt_restore_fallbacks")
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        step, _ = mgr.restore_with(
+            lambda p: ckpt.load_train_state(p, eng3))
+    assert step == 1 and eng3.state.step == 1
+    assert _stat("ckpt_restore_fallbacks") - before == 1
+
+
+def test_truncated_leaf_detected_and_skipped(tmp_path):
+    """The 'truncate-a-leaf' corruption: physically damage the largest
+    array-data file of the newest checkpoint; restore must fall back.
+    (Needs a parameter big enough that tensorstore parks its bytes in a
+    data file rather than inline in the OCDBT b-tree.)"""
+    mgr = ckpt.CheckpointManager(str(tmp_path / "run"))
+    paddle.seed(5)
+    m = nn.Linear(64, 64)
+    opt = paddle.optimizer.Adam(learning_rate=0.05,
+                                parameters=m.parameters())
+    eng = Engine(m, opt, lambda o, y: ((o - y) ** 2).mean())
+    rs = np.random.RandomState(0)
+    x = rs.randn(8, 64).astype(np.float32)
+    y = rs.randn(8, 64).astype(np.float32)
+    eng.train_batch((x,), (y,))
+    mgr.save_engine(1, eng)
+    eng.train_batch((x,), (y,))
+    mgr.save_engine(2, eng)
+    victim = faults.corrupt_leaf(str(tmp_path / "run" / "ckpt-2"))
+    assert os.sep + "d" + os.sep in victim  # hit array data, not JSON
+    paddle.seed(13)
+    m2 = nn.Linear(64, 64)
+    opt2 = paddle.optimizer.Adam(learning_rate=0.05,
+                                 parameters=m2.parameters())
+    eng2 = Engine(m2, opt2, lambda o, y: ((o - y) ** 2).mean())
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        step, _ = mgr.restore_with(
+            lambda p: ckpt.load_train_state(p, eng2))
+    assert step == 1
+
+
+def test_restore_with_falls_back_on_real_torn_dir(tmp_path):
+    """Satellite: a REAL torn directory — arrays fully committed but
+    paddle_meta.json/manifest absent (the shape a legacy non-atomic save
+    left behind when killed between orbax write and metadata write)."""
+    mgr = ckpt.CheckpointManager(str(tmp_path / "run"))
+    eng = _mk_engine()
+    x, y = _batch()
+    eng.train_batch((x,), (y,))
+    mgr.save_engine(1, eng)
+    # fabricate the torn step-2 from a real committed checkpoint
+    shutil.copytree(str(tmp_path / "run" / "ckpt-1"),
+                    str(tmp_path / "run" / "ckpt-2"))
+    os.remove(str(tmp_path / "run" / "ckpt-2" / ckpt.META_NAME))
+    os.remove(str(tmp_path / "run" / "ckpt-2" / ckpt.MANIFEST_NAME))
+    assert mgr.all_steps() == [1, 2]
+
+    eng2 = _mk_engine(seed=21)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        step, _ = mgr.restore_with(
+            lambda p: ckpt.load_train_state(p, eng2))
+    assert step == 1 and eng2.state.step == 1
+
+
+def test_gc_never_deletes_newest_readable(tmp_path):
+    """Satellite: retention counts only READABLE checkpoints, so a burst
+    of crashed saves can no longer GC the last good snapshot while
+    keeping garbage dirs."""
+    mgr = ckpt.CheckpointManager(str(tmp_path / "run"), max_to_keep=2)
+    state = {"w": jnp.zeros((4,), jnp.float32)}
+    mgr.save(1, state)
+    mgr.save(2, state)
+    # two fabricated torn dirs, NEWER than every good checkpoint
+    for s in (3, 4):
+        os.makedirs(str(tmp_path / "run" / f"ckpt-{s}"))
+        with open(str(tmp_path / "run" / f"ckpt-{s}" / "junk"), "w") as f:
+            f.write("torn")
+    before = _stat("ckpt_gc_removed")
+    mgr.save(5, state)
+    # torn 3/4 are garbage-collected; readable 2 and 5 retained — the
+    # old behaviour would have counted 3/4 toward max_to_keep and
+    # deleted EVERY readable checkpoint but 5
+    assert mgr.all_steps() == [2, 5]
+    assert _stat("ckpt_gc_removed") - before >= 2
+    restored, meta = mgr.restore(state)
+    assert meta["step"] == 5
+
+
+# ---------------------------------------------------------------------------
+# async checkpointing
+# ---------------------------------------------------------------------------
+
+
+def test_async_save_does_not_block_step_thread(tmp_path):
+    """Acceptance: with slow I/O injected, save_engine returns
+    immediately and only wait_until_finished pays the write latency —
+    and the async-written checkpoint restores bitwise-identically."""
+    import time
+
+    eng = _mk_engine()
+    x, y = _batch()
+    for _ in range(3):
+        eng.train_batch((x,), (y,))
+    mgr = ckpt.AsyncCheckpointManager(str(tmp_path / "run"))
+    before = _stat("ckpt_async_saves")
+    with faults.inject("checkpoint.io@*:delay:0.8"):
+        t0 = time.monotonic()
+        mgr.save_engine(3, eng)
+        t_save = time.monotonic() - t0
+        t0 = time.monotonic()
+        mgr.wait_until_finished()
+        t_wait = time.monotonic() - t0
+    assert t_save < 0.4, f"async save blocked the caller for {t_save}s"
+    assert t_wait > 0.6, f"writer finished too fast ({t_wait}s) — did " \
+        "the delay fault fire on the worker thread?"
+    assert _stat("ckpt_async_saves") - before == 1
+
+    # the engine kept training while the writer ran; the snapshot must
+    # reflect save time, and resume must be bitwise-exact
+    ref_next = float(np.asarray(eng.train_batch((x,), (y,))))
+    eng2 = _mk_engine(seed=404)
+    step, _ = mgr.restore_with(lambda p: ckpt.load_train_state(p, eng2))
+    assert step == 3 and eng2.state.step == 3
+    got_next = float(np.asarray(eng2.train_batch((x,), (y,))))
+    assert got_next == ref_next
+
+
+def test_async_save_failure_surfaces_on_wait(tmp_path):
+    eng = _mk_engine()
+    x, y = _batch()
+    eng.train_batch((x,), (y,))
+    mgr = ckpt.AsyncCheckpointManager(str(tmp_path / "run"))
+    # every attempt fails: retries exhaust on the worker thread, the
+    # error must NOT vanish — it re-raises on wait_until_finished
+    with faults.inject("checkpoint.io@*:ioerror"):
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            mgr.save_engine(1, eng)
+            with pytest.raises(OSError):
+                mgr.wait_until_finished()
+    # manager stays usable after a failed save
+    mgr.save_engine(2, eng)
+    mgr.wait_until_finished()
+    assert mgr.all_steps() == [2]
+
+
+def test_train_epoch_range_async_resume(tmp_path):
+    """End-to-end: async_save=True overlaps epoch snapshots; a crashed
+    run resumes to the SAME trajectory as the sync path."""
+    x, y = _batch()
+
+    eng = _mk_engine(seed=3)
+    for epoch in ckpt.train_epoch_range(5, str(tmp_path), eng,
+                                        async_save=True):
+        eng.train_batch((x,), (y,))
+        if epoch == 2:
+            break  # abandon the generator: finally drains the writer
+
+    # break fires at epoch 2's yield, BEFORE its post-yield snapshot —
+    # the newest checkpoint is epoch 1, so resume re-runs epoch 2
+    eng2 = _mk_engine(seed=3)
+    resumed, losses = [], []
+    for epoch in ckpt.train_epoch_range(5, str(tmp_path), eng2,
+                                        async_save=True):
+        losses.append(float(np.asarray(eng2.train_batch((x,), (y,)))))
+        resumed.append(epoch)
+    assert resumed == [2, 3, 4], resumed
+
+    ref = _mk_engine(seed=3)
+    ref_losses = [float(np.asarray(ref.train_batch((x,), (y,))))
+                  for _ in range(5)]
+    np.testing.assert_allclose(losses, ref_losses[2:], rtol=0, atol=0)
+
+
+# ---------------------------------------------------------------------------
+# in-graph anomaly guard
+# ---------------------------------------------------------------------------
+
+
+def test_anomaly_guard_skips_bad_step_in_graph(tmp_path):
+    """A poisoned batch yields a NaN loss but params/moments/counter
+    recover IN-GRAPH: no second trace of the loss (same compiled
+    program handles good and bad steps) and zero per-op host checks."""
+    traces = {"n": 0}
+
+    def counting_loss(o, y):
+        traces["n"] += 1
+        return ((o - y) ** 2).mean()
+
+    paddle.seed(5)
+    m = nn.Linear(6, 3)
+    opt = paddle.optimizer.Adam(learning_rate=0.05,
+                                parameters=m.parameters())
+    eng = Engine(m, opt, counting_loss, anomaly_guard=True)
+    x, y = _batch()
+    host_checks_before = _stat("nan_inf_host_checks")
+
+    losses = []
+    with faults.inject("train.batch@3:nan"):
+        for _ in range(2):
+            losses.append(float(np.asarray(eng.train_batch((x,), (y,)))))
+        params_before = {k: np.asarray(v)
+                         for k, v in eng.state.params.items()}
+        losses.append(float(np.asarray(eng.train_batch((x,), (y,)))))
+        bad_after_poison = int(eng.state.buffers[ANOMALY_BAD_STEPS_KEY])
+        # the update was skipped wholesale on the bad step (checked
+        # BEFORE the next good step legitimately moves the params)
+        for k, v in eng.state.params.items():
+            np.testing.assert_array_equal(np.asarray(v),
+                                          params_before[k])
+        losses.append(float(np.asarray(eng.train_batch((x,), (y,)))))
+
+    assert np.isnan(losses[2])
+    assert all(np.isfinite(l) for i, l in enumerate(losses) if i != 2)
+    assert bad_after_poison == 1
+    # a good step re-arms the consecutive counter
+    assert int(eng.state.buffers[ANOMALY_BAD_STEPS_KEY]) == 0
+    # fully in-graph: ONE trace serves every step (no bad-step recompile)
+    assert traces["n"] == 1, traces["n"]
+    # and the eager per-op NaN scanner never ran
+    assert _stat("nan_inf_host_checks") - host_checks_before == 0
+
+
+def test_anomaly_rollback_replays_bitwise(tmp_path):
+    """Certification: after FLAGS_anomaly_max_bad_steps consecutive bad
+    steps the engine rolls back to the last good checkpoint, and the
+    replayed trajectory is bitwise-identical to a run that never saw the
+    anomaly (params, moments, RNG stream all restored)."""
+    x, y = _batch()
+
+    # reference runs the SAME guarded program (identical XLA fusion ->
+    # bitwise-comparable), it just never sees an anomaly
+    ref = _mk_engine(seed=8, anomaly_guard=True)
+    ref_losses = [float(np.asarray(ref.train_batch((x,), (y,))))
+                  for _ in range(6)]
+
+    flags.set_flags({"FLAGS_anomaly_max_bad_steps": 2})
+    rollbacks_before = _stat("anomaly_rollbacks")
+    try:
+        eng = _mk_engine(seed=8, anomaly_guard=True)
+        mgr = ckpt.CheckpointManager(str(tmp_path / "run"))
+        eng.attach_checkpoint_manager(mgr)
+        losses = []
+        with faults.inject("train.batch@3-4:nan"), \
+                warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            for i in range(2):
+                losses.append(
+                    float(np.asarray(eng.train_batch((x,), (y,)))))
+                mgr.save_engine(eng.state.step, eng)
+            # steps 3 and 4 are poisoned; the second one trips rollback
+            for i in range(2):
+                losses.append(
+                    float(np.asarray(eng.train_batch((x,), (y,)))))
+        assert np.isnan(losses[2]) and np.isnan(losses[3])
+        assert _stat("anomaly_rollbacks") - rollbacks_before == 1
+        # rolled back to the step-2 snapshot: step count AND RNG rewound
+        assert eng.state.step == 2
+        # replay unpoisoned: bitwise-identical to the clean reference
+        replay = [float(np.asarray(eng.train_batch((x,), (y,))))
+                  for _ in range(4)]
+        np.testing.assert_allclose(replay, ref_losses[2:], rtol=0,
+                                   atol=0)
+        np.testing.assert_allclose(losses[:2], ref_losses[:2], rtol=0,
+                                   atol=0)
+    finally:
+        flags.set_flags({"FLAGS_anomaly_max_bad_steps": 3})
+
+
+def test_anomaly_rollback_without_manager_raises():
+    flags.set_flags({"FLAGS_anomaly_max_bad_steps": 1})
+    try:
+        eng = _mk_engine(anomaly_guard=True)
+        x, y = _batch()
+        with faults.inject("train.batch@1:nan"):
+            with pytest.raises(PreconditionNotMetError,
+                               match="checkpoint manager"):
+                eng.train_batch((x,), (y,))
+    finally:
+        flags.set_flags({"FLAGS_anomaly_max_bad_steps": 3})
+
+
+def test_check_nan_inf_warns_once_under_jit():
+    """Satellite: FLAGS_check_nan_inf used to be SILENTLY inert on the
+    compiled path (the per-op scan skips Tracers); it must now say so
+    once and point at the anomaly guard."""
+    from paddle_tpu.core import dispatch
+
+    dispatch._nan_inf_jit_warned = False
+    flags.set_flags({"FLAGS_check_nan_inf": True})
+    x, y = _batch()
+    try:
+        with warnings.catch_warnings(record=True) as rec:
+            warnings.simplefilter("always")
+            eng = _mk_engine()
+            eng.train_batch((x,), (y,))  # first trace fires the warning
+            eng.train_batch((x,), (y,))
+        hits = [w for w in rec if "anomaly guard" in str(w.message)]
+        assert len(hits) == 1, [str(w.message) for w in rec]
+        assert "FLAGS_check_nan_inf" in str(hits[0].message)
+
+        # the warning is once-per-process, not once-per-trace
+        with warnings.catch_warnings(record=True) as rec2:
+            warnings.simplefilter("always")
+            eng2 = _mk_engine(seed=6)
+            eng2.train_batch((x,), (y,))
+        assert not [w for w in rec2
+                    if "anomaly guard" in str(w.message)]
+    finally:
+        flags.set_flags({"FLAGS_check_nan_inf": False})
+        dispatch._nan_inf_jit_warned = False
+
+
+def test_check_nan_inf_eager_still_raises():
+    """The eager path keeps the reference semantics (host-side scan,
+    PreconditionNotMetError) and bumps the spy counter the compiled
+    path must keep at zero."""
+    flags.set_flags({"FLAGS_check_nan_inf": True})
+    try:
+        before = _stat("nan_inf_host_checks")
+        t = paddle.to_tensor(np.ones((3,), np.float32))
+        _ = t + t  # clean eager op: checked, no raise
+        assert _stat("nan_inf_host_checks") - before > 0
+        bad = paddle.to_tensor(np.array([1.0, np.nan], np.float32))
+        with pytest.raises(PreconditionNotMetError):
+            _ = bad * 2.0
+    finally:
+        flags.set_flags({"FLAGS_check_nan_inf": False})
+
+
+# ---------------------------------------------------------------------------
+# preemption
+# ---------------------------------------------------------------------------
+
+
+def test_simulated_preemption_checkpoints_and_resumes_bitwise(tmp_path):
+    """Certification: preempt mid-run -> emergency checkpoint + marker;
+    the restarted run consumes the marker and replays the remaining
+    epochs bitwise-identically to an uninterrupted run."""
+    x, y = _batch()
+
+    ref = _mk_engine(seed=17)
+    ref_losses = [float(np.asarray(ref.train_batch((x,), (y,))))
+                  for _ in range(6)]
+
+    flags.set_flags({"FLAGS_simulate_preempt_at_step": 3})
+    eng = _mk_engine(seed=17)
+    losses = []
+    with pytest.raises(preempt.PreemptedError):
+        for epoch in ckpt.train_epoch_range(6, str(tmp_path), eng):
+            losses.append(float(np.asarray(eng.train_batch((x,), (y,)))))
+    # the 3rd boundary poll reported the preemption: epochs 0-2 ran
+    assert len(losses) == 3
+    marker = str(tmp_path / "auto_ckpt" / preempt.MARKER_NAME)
+    assert os.path.exists(marker)
+    assert _stat("preempt_emergency_saves") >= 1
+
+    # "restarted" process: fresh engine, wrong seed — everything must
+    # come from the emergency checkpoint
+    flags.set_flags({"FLAGS_simulate_preempt_at_step": 0})
+    preempt.clear()
+    eng2 = _mk_engine(seed=999)
+    resumed = []
+    for epoch in ckpt.train_epoch_range(6, str(tmp_path), eng2):
+        losses.append(float(np.asarray(eng2.train_batch((x,), (y,)))))
+        resumed.append(epoch)
+    assert resumed == [3, 4, 5], resumed
+    assert not os.path.exists(marker)  # consumed on resume
+    np.testing.assert_allclose(losses, ref_losses, rtol=0, atol=0)
+
+
+def test_preempt_signal_flag_in_process():
+    """A real signal (SIGUSR1 to ourselves) sets the flag without
+    killing the process; poll() reports it at the next boundary."""
+    preempt.install()
+    assert not preempt.requested()
+    os.kill(os.getpid(), signal.SIGUSR1)
+    assert preempt.requested()
+    assert "signal" in preempt.reason()
+    assert preempt.poll() is True
+    preempt.clear()
+    assert not preempt.requested()
+
+
+def test_preempt_marker_round_trip(tmp_path):
+    preempt.request("test")
+    p = preempt.write_marker(str(tmp_path), {"epoch": 4})
+    assert os.path.exists(p)
+    rec = preempt.consume_marker(str(tmp_path))
+    assert rec["epoch"] == 4 and rec["reason"] == "test"
+    assert not os.path.exists(p)
+    assert preempt.consume_marker(str(tmp_path)) is None
+
+
+def test_model_fit_stops_and_saves_on_preemption(tmp_path):
+    """hapi wiring: Model.fit polls at batch boundaries; a preemption
+    emergency-saves the full engine state under save_dir and stops
+    training cleanly instead of dying mid-epoch."""
+    rs = np.random.RandomState(0)
+    data = [(rs.randn(6).astype(np.float32),
+             rs.randn(3).astype(np.float32)) for _ in range(32)]
+
+    paddle.seed(2)
+    net = nn.Linear(6, 3)
+    model = paddle.Model(net)
+    opt = paddle.optimizer.Adam(learning_rate=0.01,
+                                parameters=net.parameters())
+    model.prepare(opt, lambda o, y: ((o - y) ** 2).mean())
+
+    flags.set_flags({"FLAGS_simulate_preempt_at_step": 3})
+    save_dir = str(tmp_path / "out")
+    model.fit(data, batch_size=4, epochs=4, verbose=0, shuffle=False,
+              save_dir=save_dir)
+    assert model.stop_training
+    assert os.path.exists(os.path.join(save_dir, preempt.MARKER_NAME))
+    # the emergency checkpoint is a full engine snapshot
+    eng2 = _mk_engine(seed=55)
+    # (shape-compatible template: same architecture)
+    ckpt.load_train_state(os.path.join(save_dir, "preempt-ckpt"), eng2)
+    assert eng2.state.step == 3
+
+
+# ---------------------------------------------------------------------------
+# elastic manager satellites
+# ---------------------------------------------------------------------------
+
+
+def test_elastic_world_is_stable_between_polls(tmp_path):
+    """Satellite: world() derives rank/world from the membership
+    snapshot of the last watch() poll — a peer heartbeat expiring
+    mid-step must not flap rank/world until the next poll."""
+    a = ElasticManager(str(tmp_path), node_id="node-a",
+                       timeout=5.0).register()
+    b = ElasticManager(str(tmp_path), node_id="node-b",
+                       timeout=5.0).register()
+    assert a.watch() == ElasticStatus.HOLD  # snapshot {a, b}
+    assert a.world() == (0, 2)
+    assert b.register() and True  # keep linters quiet about b
+    # peer b dies abruptly between polls
+    os.remove(os.path.join(str(tmp_path), "node-b.beat"))
+    assert a.world() == (0, 2), "world flapped before the next poll"
+    assert a.watch() == ElasticStatus.RESTART
+    assert a.world() == (0, 1)
+
+
+def test_elastic_sweeps_long_dead_beats(tmp_path):
+    import json
+    import time as _time
+
+    m = ElasticManager(str(tmp_path), node_id="live",
+                       timeout=2.0).register()
+    corpse = os.path.join(str(tmp_path), "corpse.beat")
+    with open(corpse, "w") as f:
+        json.dump({"node": "corpse", "ts": _time.time() - 100.0}, f)
+    recent = os.path.join(str(tmp_path), "recent.beat")
+    with open(recent, "w") as f:
+        # dead (> timeout) but NOT long-dead (< 3*timeout): kept on disk
+        json.dump({"node": "recent", "ts": _time.time() - 3.0}, f)
+    assert m.live_nodes() == ["live"]
+    assert not os.path.exists(corpse), "3*timeout corpse not swept"
+    assert os.path.exists(recent), "recently-dead beat swept too early"
+
+
+def test_elastic_watch_exits_on_preemption(tmp_path):
+    m = ElasticManager(str(tmp_path), node_id="me",
+                       timeout=5.0).register()
+    assert m.watch() == ElasticStatus.HOLD
+    preempt.request("maintenance")
+    assert m.watch() == ElasticStatus.EXIT
+    # deregistered so peers re-form without us
+    assert not os.path.exists(os.path.join(str(tmp_path), "me.beat"))
+
+
+def test_heartbeat_drop_fault(tmp_path):
+    """Injected heartbeat loss: the beat file goes stale and peers see
+    the node die, without the node actually crashing."""
+    m = ElasticManager(str(tmp_path), node_id="flaky",
+                       timeout=5.0).register()
+    beat = os.path.join(str(tmp_path), "flaky.beat")
+    mtime = os.path.getmtime(beat)
+    with faults.inject("elastic.beat@*:drop"):
+        m.beat()
+        m.beat()
+    assert os.path.getmtime(beat) == mtime, "dropped beat still wrote"
+    m.beat()  # back to normal after the window
+    assert os.path.getmtime(beat) >= mtime
