@@ -270,6 +270,10 @@ def main():
                           max_seq_len=seq, dropout=0.1, use_parallel=False)
 
     paddle.seed(0)
+    # FLAGS_use_fused_lm_loss (default True) routes the LM head through
+    # the fused chunked-vocab linear+CE (ops/fused_loss.py): the tied
+    # [b*s, 18000] logits and their gradient never reach HBM, which is
+    # this model's single largest transient (~2.4 GB fwd at b=32 s=512).
     model = ErnieForPretraining(cfg)
     criterion = ErniePretrainingCriterion(cfg)
     optimizer = paddle.optimizer.AdamW(
@@ -284,6 +288,16 @@ def main():
     n_params = sum(int(np.prod(v.shape)) for v in engine.state.params.values())
     # Training FLOPs per token: 6*P (fwd 2P + bwd 4P) plus the attention
     # score/value matmuls 12*L*H*S (fwd+bwd) not counted in P.
+    # Honest accounting with the fused LM-head loss: 6*P still counts
+    # the full head matmul and ONLY it — the fused kernel computes the
+    # identical x@W.T scores and the identical dh/dW contractions, so
+    # the useful math is unchanged; what fusion removes is the [N, V]
+    # HBM write/read. Like flash attention, its backward RE-DERIVES the
+    # score tiles from (x, W, lse) instead of reloading saved logits
+    # (2 extra head-matmul passes, ~+9% model FLOPs at V=18000/H=768);
+    # those recompute FLOPs are deliberately NOT added to the MFU
+    # denominator, so reported MFU understates raw MXU occupancy and
+    # any gain vs the unfused baseline is end-to-end real.
     flops_per_token = 6.0 * n_params + 12.0 * cfg.num_layers * \
         cfg.hidden_size * seq
     tokens_per_step = batch * seq

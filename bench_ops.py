@@ -72,6 +72,11 @@ def _specs():
             lambda x: f("dropout")(x, key, p=0.1, training=True), x_bsh),
         # carry the TABLE (float) so the scan chain stays data-dependent
         "lookup_table_v2": (lambda e: f("lookup_table_v2")(ids, e), emb),
+        # the fused LM-head loss at bench shape: hidden states against
+        # the full 18000-vocab tied table, no [N, V] logits materialised
+        "fused_linear_cross_entropy": (
+            lambda e: f("fused_linear_cross_entropy")(
+                x_bsh.reshape(-1, h), e, ids.reshape(-1)), emb),
         "conv2d": (lambda x: f("conv2d")(x, kconv, stride=1, padding=1),
                    img),
         "pool2d": (lambda x: f("pool2d")(x, ksize=2, stride=2,

@@ -24,7 +24,7 @@ from .op_registry import lookup
 AMP_WHITE_LIST = {
     "matmul_v2", "matmul", "mul", "conv2d", "conv2d_transpose", "conv1d",
     "conv3d", "depthwise_conv2d", "einsum", "fused_attention",
-    "flash_attention", "bmm", "addmm",
+    "flash_attention", "bmm", "addmm", "fused_linear_cross_entropy",
 }
 
 AMP_BLACK_LIST = {

@@ -572,8 +572,13 @@ class Engine:
 
     @staticmethod
     def _arrs(ts):
-        return tuple(t._value if isinstance(t, Tensor) else jnp.asarray(t)
-                     for t in ts)
+        # jax.Array passes through untouched: DataLoader device
+        # prefetch must not be undone by a jnp.asarray round-trip
+        return tuple(
+            t._value if isinstance(t, Tensor)
+            else t if isinstance(t, jax.Array)
+            else jnp.asarray(t)
+            for t in ts)
 
     def train_batch(self, inputs, labels=()):
         if self._step_fn is None:
@@ -595,8 +600,14 @@ class Engine:
         if self._offload_sh is not None:
             dev_sh, host_sh = self._offload_sh
             opt_state = jax.device_put(opt_state, dev_sh)
-        batch_sig = jax.tree.map(lambda a: (a.shape, str(a.dtype)),
-                                 batch)
+        # cheap per-step signature: plain tuple comprehension over the
+        # two known leaf tuples instead of a jax.tree.map traversal
+        # (tree.map rebuilds registry nodes + a dict every step; this is
+        # pure python on ~4 leaves)
+        batch_sig = (
+            tuple((a.shape, a.dtype.name) for a in batch["inputs"]),
+            tuple((a.shape, a.dtype.name) for a in batch["labels"]),
+        )
         if self._step_protos is None or batch_sig != self._batch_sig:
             # a new batch shape means a new compiled program: refresh
             # the protos so memory_analysis() reports the live program
@@ -614,7 +625,16 @@ class Engine:
         self.state.opt_state = new_opt
         self.state.step += 1
         if self.anomaly_guard:
-            self._check_anomaly()
+            # the counter readback is the guard's only host sync and it
+            # blocks dispatch, so amortise it: the in-graph guard skips
+            # every bad update immediately regardless, the host only
+            # decides ROLLBACK — which FLAGS_anomaly_check_interval may
+            # delay by up to interval-1 (bad, already-skipped) steps
+            from .framework import flags as _flags
+
+            interval = _flags.flag("FLAGS_anomaly_check_interval")
+            if interval <= 1 or self.state.step % interval == 0:
+                self._check_anomaly()
         from . import profiler as _profiler
 
         if _profiler.is_op_profiling_enabled():

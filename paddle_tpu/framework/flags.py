@@ -30,6 +30,19 @@ _DEFS = {
     "FLAGS_use_pallas_conv": (
         True, bool, "route eligible convs through the Pallas fused-conv "
         "kernels on TPU (PADDLE_TPU_CONV_FORCE=pallas|lax overrides)"),
+    "FLAGS_use_fused_lm_loss": (
+        True, bool,
+        "route the tied-decoder matmul + cross_entropy of the ERNIE/BERT "
+        "pretraining head through the fused chunked-vocab loss "
+        "(ops/fused_loss.py) that never materializes [N, V] logits "
+        "(PADDLE_TPU_LMLOSS_FORCE=pallas|lax picks the kernel path)"),
+    "FLAGS_anomaly_check_interval": (
+        16, int,
+        "anomaly guard: read the in-graph bad-step counter back to the "
+        "host only every N steps (1 = every step). The in-graph guard "
+        "still skips every bad update immediately; the interval only "
+        "delays the host-side rollback decision by up to N-1 steps in "
+        "exchange for not blocking dispatch on a device sync per step"),
     "FLAGS_eager_delete_tensor_gb": (
         0.0, float, "accepted for compatibility; PJRT manages memory"),
     "FLAGS_cudnn_deterministic": (

@@ -221,11 +221,30 @@ class ErniePretrainingHeads(nn.Layer):
         self.seq_relationship = nn.Linear(config.hidden_size, 2)
         self.config = config
 
+    def _fuse_lm_loss(self) -> bool:
+        """Plainness predicate for the fused LM-head loss (mirrors the
+        FLAGS_use_pallas_conv routing of ResNet): the head must be the
+        plain tied-matmul -> cross_entropy pattern — a tied [V, H] table
+        with no vocab sharding (ParallelCrossEntropy owns the TP path)."""
+        from ...framework.flags import flag
+
+        return (self._tied is not None
+                and not self.config.use_parallel
+                and flag("FLAGS_use_fused_lm_loss"))
+
     def forward(self, sequence_output, pooled_output):
         from ...core.dispatch import apply
 
         h = self.layer_norm(F.gelu(self.transform(sequence_output)))
-        if self._tied is not None:
+        if self._fuse_lm_loss():
+            # defer the tied matmul: the criterion consumes (h, W)
+            # through the fused chunked-vocab loss so [B, S, V] logits
+            # are never written (ops/fused_loss.py); .materialize()
+            # recovers plain logits for any other consumer
+            from ...ops.fused_loss import DeferredLMHead
+
+            logits = DeferredLMHead(h, self._tied)
+        elif self._tied is not None:
             logits = apply("matmul_v2", h, self._tied, trans_y=True)
             if self.config.use_parallel:
                 logits = shard_hint(logits, DP_AXIS, None, MP_AXIS)
@@ -263,7 +282,15 @@ class ErniePretrainingCriterion(nn.Layer):
 
     def forward(self, prediction_scores, seq_relationship_score,
                 masked_lm_labels, next_sentence_labels=None):
-        if self.parallel_ce is not None:
+        from ...ops.fused_loss import DeferredLMHead
+
+        if isinstance(prediction_scores, DeferredLMHead):
+            # fused path: the head handed us (hidden, tied W) instead of
+            # logits — one streaming linear+CE op, identical math
+            mlm = F.fused_linear_cross_entropy(
+                prediction_scores.hidden, prediction_scores.weight,
+                masked_lm_labels, ignore_index=self.ignore_index)
+        elif self.parallel_ce is not None:
             mlm = self.parallel_ce(prediction_scores, masked_lm_labels)
             mlm = mlm.squeeze(-1)
             mask = (masked_lm_labels != self.ignore_index)

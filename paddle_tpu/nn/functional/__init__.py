@@ -627,6 +627,18 @@ def cross_entropy(input, label, weight=None, ignore_index=-100,
                  use_softmax=use_softmax, weight=weight)
 
 
+def fused_linear_cross_entropy(hidden, weight, label, ignore_index=-100,
+                               reduction="mean", chunk_v=0, name=None):
+    """cross_entropy(hidden @ weight.T, label) as ONE streaming op that
+    never materializes the [N, V] logits (ops/fused_loss.py): vocab
+    chunks of the tied decoder table are scored against an online
+    logsumexp, and the backward rebuilds softmax-minus-onehot tiles
+    in-register. Numerically equal to the unfused pair at fp32."""
+    return apply("fused_linear_cross_entropy", hidden, weight, label,
+                 ignore_index=ignore_index, reduction=reduction,
+                 chunk_v=chunk_v)
+
+
 def softmax_with_cross_entropy(logits, label, soft_label=False,
                                ignore_index=-100, numeric_stable_mode=True,
                                return_softmax=False, axis=-1):

@@ -15,6 +15,7 @@ from . import random_ops  # noqa: F401
 from . import linalg_ops  # noqa: F401
 from . import fused_ops  # noqa: F401
 from . import fused_conv  # noqa: F401
+from . import fused_loss  # noqa: F401
 from . import rnn_ops  # noqa: F401
 from . import sequence_ops  # noqa: F401
 from . import detection_ops  # noqa: F401

@@ -2797,3 +2797,48 @@ case("fused_conv2d_bn_act", [_FCX, _FCW1, _FCS, _FCB, _FCM, _FCV],
          x, w, s, b, m, v, act=act, is_test=is_test),
      grad=(0, 1, 2, 3), rtol=1e-4, atol=1e-5)
 FD_OPS["fused_conv2d_bn_act"] = {"case": 1}
+
+
+# ---- fused_linear_cross_entropy (fused LM-head loss; ref: tied-decoder
+# matmul_v2 + softmax_with_cross_entropy as two ops) ----
+#
+# The sweep runs unforced on CPU, certifying the chunked lax.scan
+# semantics; interpret-mode pallas kernel parity (and the ERNIE routing)
+# is certified separately in test_fused_loss.py.
+
+def _np_fused_lce(x, w, lbl, ignore_index=-100, reduction="mean",
+                  chunk_v=0):
+    logits = x.astype(np.float64) @ w.astype(np.float64).T
+    m = logits.max(-1, keepdims=True)
+    lse = m[:, 0] + np.log(np.exp(logits - m).sum(-1))
+    picked = np.take_along_axis(
+        logits, np.maximum(lbl, 0)[:, None].astype(np.int64), 1)[:, 0]
+    valid = (lbl != ignore_index)
+    loss = (lse - picked) * valid
+    if reduction == "none":
+        return loss.astype(np.float32)
+    if reduction == "sum":
+        return np.float32(loss.sum())
+    return np.float32(loss.sum() / max(valid.sum(), 1.0))
+
+
+_LCX = f32((24, 32), seed=160)
+_LCW = f32((150, 32), -0.3, 0.3, seed=161)  # V=150: not chunk-aligned
+_LCL = ints((24,), 0, 150, seed=162)
+_LCL[::4] = -100  # ignore_index rows interleaved
+_LCL2 = ints((24,), 0, 150, seed=163)  # all in-range (ignore_index=-1)
+
+case("fused_linear_cross_entropy", [_LCX, _LCW, _LCL], {"chunk_v": 64},
+     ref=lambda x, w, l, chunk_v: _np_fused_lce(x, w, l),
+     grad=(0, 1), rtol=1e-5, atol=1e-6)
+case("fused_linear_cross_entropy", [_LCX, _LCW, _LCL],
+     {"reduction": "none", "chunk_v": 0},
+     ref=lambda x, w, l, reduction, chunk_v: _np_fused_lce(
+         x, w, l, reduction=reduction),
+     grad=(0, 1), rtol=1e-5, atol=1e-6)
+case("fused_linear_cross_entropy", [_LCX, _LCW, _LCL2],
+     {"reduction": "sum", "ignore_index": -1, "chunk_v": 32},
+     ref=lambda x, w, l, reduction, ignore_index, chunk_v: _np_fused_lce(
+         x, w, l, ignore_index=ignore_index, reduction=reduction),
+     grad=(0, 1), rtol=1e-5, atol=2e-6)
+FD_OPS["fused_linear_cross_entropy"] = {"case": 0}

@@ -60,6 +60,9 @@ def _clean_fault_state():
     state, and default flags for the knobs this suite touches."""
     faults.reset()
     preempt.clear()
+    # this suite asserts on per-step anomaly decisions: disable the
+    # host-sync amortisation so _check_anomaly runs every step
+    flags.set_flags({"FLAGS_anomaly_check_interval": 1})
     yield
     preempt.uninstall()
     preempt.clear()
@@ -67,6 +70,7 @@ def _clean_fault_state():
     flags.set_flags({"FLAGS_simulate_preempt_at_step": 0,
                      "FLAGS_check_nan_inf": False,
                      "FLAGS_anomaly_max_bad_steps": 3,
+                     "FLAGS_anomaly_check_interval": 16,
                      "FLAGS_ckpt_verify_checksums": True})
 
 

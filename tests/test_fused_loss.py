@@ -1,0 +1,353 @@
+"""Fused LM-head loss certification (ops/fused_loss.py).
+
+Parity of the chunked-vocab linear+cross-entropy against
+cross_entropy-on-materialized-logits — forward and dh/dW backward — on
+BOTH execution paths: the lax.scan fallback and the pallas kernels in
+interpreter mode (PADDLE_TPU_LMLOSS_FORCE=pallas off-TPU), across
+bf16/fp32, ignore_index masking, vocab sizes not divisible by chunk_v
+and non-tile-aligned row counts.  Plus the end-to-end ERNIE routing
+(DeferredLMHead) and the measured-memory regression: the fused step's
+XLA peak must be strictly below the unfused step's.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.core.op_registry import lookup
+from paddle_tpu.framework import flags
+from paddle_tpu.nn import functional as F
+from paddle_tpu.ops import fused_loss
+
+_OP = lookup("fused_linear_cross_entropy").fn
+
+
+def _ref(x, w, lbl, ignore_index=-100, reduction="mean"):
+    """cross_entropy(x @ w.T) with everything materialized (the exact
+    nn_ops.cross_entropy formulation: fp32 upcast, mean over the
+    non-ignored row count clamped to 1)."""
+    logits = jnp.matmul(x.astype(jnp.float32),
+                        w.astype(jnp.float32).T)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    picked = jnp.take_along_axis(
+        logp, jnp.maximum(lbl, 0)[:, None], 1)[:, 0]
+    valid = lbl != ignore_index
+    loss = -picked * valid.astype(jnp.float32)
+    if reduction == "none":
+        return loss
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return jnp.sum(loss) / jnp.maximum(
+        jnp.sum(valid.astype(jnp.float32)), 1.0)
+
+
+def _data(n=37, h=64, v=301, masked_frac=0.3, seed=0, dtype=np.float32):
+    rs = np.random.RandomState(seed)
+    x = jnp.asarray(rs.randn(n, h).astype(np.float32) * 0.5).astype(dtype)
+    w = jnp.asarray(rs.randn(v, h).astype(np.float32) * 0.1).astype(dtype)
+    lbl = rs.randint(0, v, n)
+    lbl[rs.rand(n) < masked_frac] = -100
+    return x, w, jnp.asarray(lbl.astype(np.int32))
+
+
+class _force:
+    def __init__(self, mode):
+        self.mode = mode
+
+    def __enter__(self):
+        self.prev = os.environ.get("PADDLE_TPU_LMLOSS_FORCE")
+        os.environ["PADDLE_TPU_LMLOSS_FORCE"] = self.mode
+
+    def __exit__(self, *a):
+        if self.prev is None:
+            os.environ.pop("PADDLE_TPU_LMLOSS_FORCE", None)
+        else:
+            os.environ["PADDLE_TPU_LMLOSS_FORCE"] = self.prev
+
+
+@pytest.mark.parametrize("mode", ["lax", "pallas"])
+@pytest.mark.parametrize("shape", [
+    (37, 64, 301),    # nothing aligned: N%8, V%128, V%chunk_v all != 0
+    (64, 64, 256),    # everything aligned
+    (8, 32, 130),     # vocab barely over one 128 lane-tile
+    (300, 64, 512),   # rows span multiple blocks, odd remainder
+])
+def test_forward_parity_fp32(mode, shape):
+    n, h, v = shape
+    x, w, lbl = _data(n, h, v)
+    with _force(mode):
+        out = _OP(x, w, lbl, chunk_v=128)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(_ref(x, w, lbl)),
+                               rtol=1e-6, atol=1e-6)
+
+
+@pytest.mark.parametrize("mode", ["lax", "pallas"])
+def test_forward_parity_bf16(mode):
+    x, w, lbl = _data(96, 64, 384, dtype=jnp.bfloat16)
+    with _force(mode):
+        out = _OP(x, w, lbl, chunk_v=128)
+    assert out.dtype == jnp.float32  # loss stays f32 under bf16 inputs
+    ref = _ref(x, w, lbl)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-2, atol=2e-2)
+
+
+@pytest.mark.parametrize("mode", ["lax", "pallas"])
+def test_heavy_masking_and_all_ignored(mode):
+    # the bench's MLM labels are ~85% ignore_index; also certify the
+    # degenerate all-ignored batch (mean denominator clamps to 1)
+    x, w, lbl = _data(64, 32, 200, masked_frac=0.85)
+    with _force(mode):
+        out = _OP(x, w, lbl, chunk_v=128)
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.asarray(_ref(x, w, lbl)),
+                                   rtol=1e-6, atol=1e-6)
+        all_ign = jnp.full_like(lbl, -100)
+        z = _OP(x, w, all_ign, chunk_v=128)
+        assert float(z) == 0.0
+
+
+@pytest.mark.parametrize("mode", ["lax", "pallas"])
+@pytest.mark.parametrize("reduction", ["none", "sum", "mean"])
+def test_reductions(mode, reduction):
+    x, w, lbl = _data(24, 32, 150)
+    with _force(mode):
+        out = _OP(x, w, lbl, chunk_v=64 if mode == "lax" else 128,
+                  reduction=reduction)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(_ref(x, w, lbl, reduction=reduction)),
+        rtol=1e-6, atol=1e-6)
+
+
+@pytest.mark.parametrize("mode", ["lax", "pallas"])
+@pytest.mark.parametrize("shape", [(37, 64, 301), (48, 32, 256)])
+def test_gradcheck_vs_reference(mode, shape):
+    n, h, v = shape
+    x, w, lbl = _data(n, h, v)
+    with _force(mode):
+        dx, dw = jax.grad(
+            lambda x_, w_: _OP(x_, w_, lbl, chunk_v=128),
+            argnums=(0, 1))(x, w)
+    rx, rw = jax.grad(lambda x_, w_: _ref(x_, w_, lbl),
+                      argnums=(0, 1))(x, w)
+    np.testing.assert_allclose(np.asarray(dx), np.asarray(rx),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(dw), np.asarray(rw),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_lax_and_pallas_agree_across_chunkings():
+    # chunk size is an implementation knob: any chunking must produce
+    # the same loss (online lse is chunking-invariant)
+    x, w, lbl = _data(40, 32, 333)
+    outs = []
+    for mode, cv in [("lax", 64), ("lax", 333), ("pallas", 128),
+                     ("pallas", 256)]:
+        with _force(mode):
+            outs.append(float(_OP(x, w, lbl, chunk_v=cv)))
+    for o in outs[1:]:
+        assert abs(o - outs[0]) < 1e-6, outs
+
+
+def test_forced_pallas_actually_traces_kernels():
+    before = fused_loss._TRACE_COUNT
+    x, w, lbl = _data(16, 32, 256)
+    with _force("pallas"):
+        _OP(x, w, lbl, chunk_v=128)
+    assert fused_loss._TRACE_COUNT > before
+    with _force("lax"):
+        after = fused_loss._TRACE_COUNT
+        _OP(x, w, lbl, chunk_v=128)
+    assert fused_loss._TRACE_COUNT == after  # lax path: no kernel trace
+
+
+def test_dispatch_tape_and_amp():
+    """Through apply(): the tape must deliver dh/dW, and under AMP the
+    op is white-listed (bf16 operands) while the loss output stays
+    f32 — same dtype contract as matmul(bf16) -> cross_entropy(f32)."""
+    x, w, lbl = _data(32, 32, 200)
+    xt = paddle.to_tensor(np.asarray(x))
+    xt.stop_gradient = False
+    wt = paddle.to_tensor(np.asarray(w))
+    wt.stop_gradient = False
+    loss = F.fused_linear_cross_entropy(xt, wt, paddle.to_tensor(
+        np.asarray(lbl)))
+    loss.backward()
+    rx, rw = jax.grad(lambda x_, w_: _ref(x_, w_, lbl),
+                      argnums=(0, 1))(x, w)
+    np.testing.assert_allclose(np.asarray(xt.grad.numpy()),
+                               np.asarray(rx), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(wt.grad.numpy()),
+                               np.asarray(rw), rtol=1e-5, atol=1e-6)
+    with paddle.amp.auto_cast(level="O1"):
+        amp_loss = F.fused_linear_cross_entropy(
+            paddle.to_tensor(np.asarray(x)),
+            paddle.to_tensor(np.asarray(w)),
+            paddle.to_tensor(np.asarray(lbl)))
+    assert str(amp_loss.dtype).endswith("float32")
+    np.testing.assert_allclose(float(amp_loss), float(_ref(x, w, lbl)),
+                               rtol=2e-2, atol=2e-2)
+
+
+# ---------------------------------------------------------------------------
+# ERNIE routing
+# ---------------------------------------------------------------------------
+
+
+def _tiny_ernie(vocab=211):
+    from paddle_tpu.nlp.transformers import (
+        ErnieConfig, ErnieForPretraining, ErniePretrainingCriterion,
+    )
+
+    cfg = ErnieConfig(vocab_size=vocab, hidden_size=32, num_layers=2,
+                      num_heads=2, ffn_hidden_size=64, max_seq_len=32,
+                      dropout=0.0, attn_dropout=0.0)
+    return ErnieForPretraining(cfg), ErniePretrainingCriterion(cfg), cfg
+
+
+def _mlm_batch(cfg, seed=0):
+    rs = np.random.RandomState(seed)
+    ids = rs.randint(0, cfg.vocab_size, (2, 16)).astype(np.int32)
+    lbl = rs.randint(0, cfg.vocab_size, (2, 16)).astype(np.int32)
+    lbl[rs.rand(2, 16) < 0.85] = -100  # bench-style MLM masking
+    return ids, lbl
+
+
+@pytest.fixture()
+def _fused_flag():
+    yield
+    flags.set_flags({"FLAGS_use_fused_lm_loss": True})
+
+
+def test_ernie_head_returns_deferred_handle(_fused_flag):
+    paddle.seed(0)
+    model, crit, cfg = _tiny_ernie()
+    model.eval()
+    ids, lbl = _mlm_batch(cfg)
+    out = model(paddle.to_tensor(ids))
+    assert isinstance(out[0], fused_loss.DeferredLMHead)
+    # materialize() recovers plain logits for non-criterion consumers
+    logits = out[0].materialize()
+    assert tuple(logits.shape) == (2, 16, cfg.vocab_size)
+    fused = crit(out[0], out[1], paddle.to_tensor(lbl))
+    unfused = crit(logits, out[1], paddle.to_tensor(lbl))
+    np.testing.assert_allclose(float(fused), float(unfused),
+                               rtol=1e-6, atol=1e-6)
+    # flag off -> the head materializes logits itself
+    flags.set_flags({"FLAGS_use_fused_lm_loss": False})
+    out2 = model(paddle.to_tensor(ids))
+    assert not isinstance(out2[0], fused_loss.DeferredLMHead)
+    np.testing.assert_allclose(float(crit(out2[0], out2[1],
+                                          paddle.to_tensor(lbl))),
+                               float(fused), rtol=1e-6, atol=1e-6)
+
+
+def test_ernie_engine_trajectory_parity(_fused_flag):
+    """Compiled-path acceptance lock: 3 engine steps fused vs unfused
+    must match at fp32 tolerance (same math, different HBM profile)."""
+    from paddle_tpu.engine import Engine
+
+    ids = lbl = None
+    traj = {}
+    for use in (True, False):
+        flags.set_flags({"FLAGS_use_fused_lm_loss": use})
+        paddle.seed(7)
+        model, crit, cfg = _tiny_ernie()
+        if ids is None:
+            ids, lbl = _mlm_batch(cfg, seed=3)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=model.parameters())
+        eng = Engine(model, opt, lambda o, l: crit(o[0], o[1], l))
+        traj[use] = [float(eng.train_batch((ids,), (lbl,)))
+                     for _ in range(3)]
+    np.testing.assert_allclose(traj[True], traj[False],
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_fused_step_peak_memory_strictly_lower(_fused_flag):
+    """MEASURED regression (style of test_memory_stats): the fused
+    LM-head step's XLA peak must be strictly below the unfused step's
+    on a proxy where the [N, V] logits dominate (V >> H)."""
+    from paddle_tpu.engine import Engine
+
+    peaks = {}
+    for use in (True, False):
+        flags.set_flags({"FLAGS_use_fused_lm_loss": use})
+        paddle.seed(0)
+        model, crit, cfg = _tiny_ernie(vocab=4096)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=model.parameters())
+        eng = Engine(model, opt, lambda o, l: crit(o[0], o[1], l))
+        rs = np.random.RandomState(0)
+        ids = rs.randint(0, cfg.vocab_size, (4, 32)).astype(np.int32)
+        lbl = rs.randint(0, cfg.vocab_size, (4, 32)).astype(np.int32)
+        lbl[rs.rand(4, 32) < 0.85] = -100
+        eng.train_batch((ids,), (lbl,))
+        peaks[use] = eng.memory_analysis()["peak"]
+    assert peaks[True] < peaks[False], peaks
+
+
+# ---------------------------------------------------------------------------
+# engine satellites (fast batch_sig + amortised anomaly readback)
+# ---------------------------------------------------------------------------
+
+
+def _linreg_engine(**kw):
+    from paddle_tpu.engine import Engine
+
+    paddle.seed(0)
+    model = paddle.nn.Linear(6, 3)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=model.parameters())
+    return Engine(model, opt,
+                  lambda o, y: paddle.nn.functional.mse_loss(o, y), **kw)
+
+
+def test_train_batch_accepts_device_arrays_no_recompile():
+    """_arrs must pass jax.Array batches through untouched (device
+    prefetch) and the tuple batch_sig must keep the compiled program
+    cached across steps."""
+    eng = _linreg_engine()
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.randn(8, 6).astype(np.float32))
+    y = jnp.asarray(rs.randn(8, 3).astype(np.float32))
+    assert eng._arrs((x,))[0] is x  # no asarray round-trip
+    eng.train_batch((x,), (y,))
+    protos = eng._step_protos
+    sig = eng._batch_sig
+    eng.train_batch((x,), (y,))
+    assert eng._step_protos is protos  # same shapes -> cached program
+    assert isinstance(sig, tuple)  # cheap tuple, not a mapped tree
+    # a new shape still refreshes the protos
+    eng.train_batch((x[:4],), (y[:4],))
+    assert eng._step_protos is not protos
+
+
+def test_anomaly_readback_amortised(monkeypatch):
+    """The host-side counter readback runs every
+    FLAGS_anomaly_check_interval steps, not every step."""
+    from paddle_tpu import engine as engine_mod
+
+    eng = _linreg_engine(anomaly_guard=True)
+    calls = []
+    monkeypatch.setattr(
+        engine_mod.Engine, "_check_anomaly",
+        lambda self: calls.append(self.state.step))
+    rs = np.random.RandomState(0)
+    x = rs.randn(8, 6).astype(np.float32)
+    y = rs.randn(8, 3).astype(np.float32)
+    flags.set_flags({"FLAGS_anomaly_check_interval": 4})
+    try:
+        for _ in range(8):
+            eng.train_batch((x,), (y,))
+        assert calls == [4, 8]
+        flags.set_flags({"FLAGS_anomaly_check_interval": 1})
+        eng.train_batch((x,), (y,))
+        assert calls[-1] == 9  # interval 1 -> every step again
+    finally:
+        flags.set_flags({"FLAGS_anomaly_check_interval": 16})
