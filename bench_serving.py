@@ -1,0 +1,139 @@
+"""Closed-loop serving benchmark: thread-based clients hammer the
+continuous-batching engine across an offered-load sweep.
+
+Each load level runs `--clients N` closed-loop clients (every client
+waits for its previous request before issuing the next — the classic
+closed-loop model, so offered load scales with N) for `--steps` requests
+each, then reports throughput, batch occupancy, and latency percentiles
+from the serving metrics registry. One JSON line per level plus a final
+``BENCH_SERVING`` object (written to --json when given), in the same
+family as bench_ops.py's BENCH_* records.
+
+CPU dry-run (the tier-1 smoke case):
+
+    JAX_PLATFORMS=cpu python bench_serving.py --steps 2 --clients 1,2 \
+        --max-new 3 --hidden 16 --layers 1 --heads 2 --vocab 31
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import threading
+import time
+
+import numpy as np
+
+
+def run_level(server, n_clients, steps, prompt_len, max_new, vocab):
+    """One offered-load level; returns its result row."""
+    errors = []
+    done = [0]
+    lock = threading.Lock()
+    barrier = threading.Barrier(n_clients)
+
+    def client(cid):
+        rng = np.random.RandomState(1000 + cid)
+        barrier.wait()
+        for _ in range(steps):
+            prompt = rng.randint(0, vocab, (prompt_len,)).astype(np.int32)
+            try:
+                out = server.generate(prompt, max_new_tokens=max_new,
+                                      timeout=120.0)
+                assert out.shape == (prompt_len + max_new,)
+                with lock:
+                    done[0] += 1
+            except Exception as e:  # noqa: BLE001 — report, keep load up
+                errors.append(repr(e)[:200])
+
+    threads = [threading.Thread(target=client, args=(c,))
+               for c in range(n_clients)]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.monotonic() - t0
+    snap = server.snapshot()
+    lat = snap["latency_s"].get("e2e", {})
+    row = {
+        "clients": n_clients,
+        "requests": done[0],
+        "errors": len(errors),
+        "wall_s": round(wall, 4),
+        "qps": round(done[0] / wall, 3),
+        "tokens_per_s": round(done[0] * max_new / wall, 2),
+        "occupancy_avg": round(snap["batch_occupancy"]["avg"], 4),
+        "occupancy_max": round(snap["batch_occupancy"]["max"], 4),
+        "p50_ms": round(lat.get("p50", 0.0) * 1e3, 3),
+        "p95_ms": round(lat.get("p95", 0.0) * 1e3, 3),
+        "p99_ms": round(lat.get("p99", 0.0) * 1e3, 3),
+    }
+    if errors:
+        row["first_error"] = errors[0]
+    return row
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--clients", default="1,4,8",
+                    help="comma-separated closed-loop client counts")
+    ap.add_argument("--steps", type=int, default=8,
+                    help="requests per client per level")
+    ap.add_argument("--prompt-len", type=int, default=12)
+    ap.add_argument("--max-new", type=int, default=8)
+    ap.add_argument("--max-slots", type=int, default=8)
+    ap.add_argument("--vocab", type=int, default=97)
+    ap.add_argument("--hidden", type=int, default=64)
+    ap.add_argument("--layers", type=int, default=2)
+    ap.add_argument("--heads", type=int, default=4)
+    ap.add_argument("--max-seq-len", type=int, default=128)
+    ap.add_argument("--json", default=None,
+                    help="write the final BENCH_SERVING object here")
+    args = ap.parse_args(argv)
+
+    import paddle_tpu as paddle
+    from paddle_tpu import serving
+    from paddle_tpu.nlp.transformers import GPTConfig, GPTForPretraining
+
+    paddle.seed(7)
+    cfg = GPTConfig(vocab_size=args.vocab, hidden_size=args.hidden,
+                    num_layers=args.layers, num_heads=args.heads,
+                    max_seq_len=args.max_seq_len, dropout=0.0,
+                    attn_dropout=0.0, use_parallel=False)
+    model = GPTForPretraining(cfg)
+
+    levels = []
+    for n_clients in [int(c) for c in args.clients.split(",") if c]:
+        # fresh server per level so occupancy/latency are per-level
+        server = serving.Server(model, max_slots=args.max_slots,
+                                prefill_buckets=(16, 32, 64)).start()
+        row = run_level(server, n_clients, args.steps, args.prompt_len,
+                        args.max_new, args.vocab)
+        row["compiles"] = {str(k): v
+                           for k, v in server.engine.compile_counts.items()}
+        server.shutdown(drain=True)
+        print(json.dumps(row))
+        levels.append(row)
+
+    result = {
+        "bench": "BENCH_SERVING",
+        "config": {
+            "steps": args.steps, "prompt_len": args.prompt_len,
+            "max_new": args.max_new, "max_slots": args.max_slots,
+            "model": {"vocab": args.vocab, "hidden": args.hidden,
+                      "layers": args.layers, "heads": args.heads},
+        },
+        "levels": levels,
+        "peak_tokens_per_s": max(r["tokens_per_s"] for r in levels),
+        "peak_qps": max(r["qps"] for r in levels),
+    }
+    print(json.dumps(result))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(result, f, indent=1, sort_keys=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

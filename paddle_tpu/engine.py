@@ -104,6 +104,20 @@ def functional_call(layer, values, *args, capture_buffers=False, **kwargs):
     return _unwrap(out)
 
 
+def functional_apply(layer, values, fn):
+    """Run an arbitrary `fn(layer)` with parameters/buffers taken from
+    `values` (dict name->array), tape off — the inference analogue of
+    functional_call for callers that need more than one plain forward
+    (e.g. the serving decode step: cached GPT forward + lm-head logits
+    inside one jitted function). Returns fn's result with Tensors
+    unwrapped to arrays."""
+    from .core.config import no_tape
+
+    with no_tape(), _swap_state(layer, values):
+        out = fn(layer)
+    return _unwrap(out)
+
+
 # ---------------------------------------------------------------------------
 # train step builder
 # ---------------------------------------------------------------------------

@@ -15,6 +15,16 @@ A fault point is a named site the runtime passes through:
     train.batch               each Engine.train_batch; payload = batch
     elastic.beat              each heartbeat write (drop target)
     preempt.poll              each preemption poll (step boundary)
+    serving.submit            each admission attempt (drop = shed the
+                              request exactly like a full queue — the
+                              deterministic-overload target)
+    serving.dequeue           each queue pop by the batch assembler or
+                              decode engine
+    serving.batch             each dynamic-batcher flush (delay = slow
+                              model; raise fails the member requests)
+    serving.step              each continuous-batching decode step
+                              (raise = deterministic mid-decode failure
+                              of all in-flight requests; engine stays up)
 
 Faults are scheduled programmatically::
 

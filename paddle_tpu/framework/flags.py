@@ -64,6 +64,25 @@ _DEFS = {
         0, int,
         "testing: report a preemption at the Nth preemption poll "
         "(step/epoch boundary); 0 disables"),
+    "FLAGS_serving_max_batch": (
+        8, int,
+        "serving: slot-pool size of the continuous-batching decode "
+        "engine and batch cap of the dynamic batcher (the bucket "
+        "ladder tops out here)"),
+    "FLAGS_serving_queue_cap": (
+        64, int,
+        "serving: bounded admission-queue capacity; submissions beyond "
+        "it are shed immediately with QueueFullError (429-style)"),
+    "FLAGS_serving_default_timeout_s": (
+        30.0, float,
+        "serving: default per-request deadline in seconds (0 = none); "
+        "expired requests fail with DeadlineExceededError whether "
+        "queued or mid-decode"),
+    "FLAGS_serving_prefill_buckets": (
+        "16,32,64,128,256,512", str,
+        "serving: comma-separated padded prefill-length ladder — each "
+        "rung compiles exactly once; prompts pad up to the next rung "
+        "(max_seq_len is always the top rung)"),
 }
 
 _values: dict = {}

@@ -145,8 +145,15 @@ class Predictor:
         return self._inputs[name]
 
     def run(self):
-        args = [h._value for h in self._inputs.values()
-                if h._value is not None]
+        unfilled = [n for n, h in self._inputs.items() if h._value is None]
+        if unfilled:
+            # silently dropping None handles would misalign the
+            # remaining args against the export's calling convention
+            raise ValueError(
+                f"input handle(s) {unfilled} not filled: call "
+                "copy_from_cpu/share_external_data on every input "
+                f"({list(self._inputs)}) before run()")
+        args = [h._value for h in self._inputs.values()]
         out = self._layer(*args)
         outs = out if isinstance(out, (list, tuple)) else [out]
         self._outputs = {}
@@ -199,6 +206,11 @@ class PredictorPool:
         self._preds = [main] + [main.clone() for _ in range(size - 1)]
 
     def retrieve(self, idx):
+        if not 0 <= idx < len(self._preds):
+            raise IndexError(
+                f"PredictorPool.retrieve({idx}): pool holds "
+                f"{len(self._preds)} predictor(s), valid indices are "
+                f"0..{len(self._preds) - 1}")
         return self._preds[idx]
 
     def __len__(self):

@@ -114,7 +114,14 @@ class GPTAttention(nn.Layer):
         (ref paddlenlp generation + fused multi_transformer decode
         caches): new keys/values land at `pos` via dynamic_update_slice;
         queries attend to all cached positions <= their own. Inference
-        only — jnp math, no tape."""
+        only — jnp math, no tape.
+
+        `pos` may be a scalar (whole batch at one position — generate())
+        or a [b] vector of PER-ROW positions (the serving slot engine,
+        where each batch row is an independent request mid-decode). The
+        per-row causal mask doubles as stale-KV masking: a recycled
+        slot's leftover keys live at positions > the new request's pos,
+        so they are never attended before being overwritten."""
         import jax
         import jax.numpy as jnp
         from jax import lax
@@ -124,18 +131,33 @@ class GPTAttention(nn.Layer):
         kv = k._value if isinstance(k, Tensor) else k
         vv = v._value if isinstance(v, Tensor) else v
         s_new = qv.shape[2]
-        k_cache = lax.dynamic_update_slice(
-            k_cache, kv.astype(k_cache.dtype), (0, 0, pos, 0))
-        v_cache = lax.dynamic_update_slice(
-            v_cache, vv.astype(v_cache.dtype), (0, 0, pos, 0))
+        s_max = k_cache.shape[2]
+        key_idx = jnp.arange(s_max)
+        pos_vec = getattr(pos, "ndim", 0) == 1
+        if pos_vec:
+            b = qv.shape[0]
+            row = jnp.arange(b)[:, None]              # [b, 1]
+            t_idx = pos[:, None] + jnp.arange(s_new)  # [b, s_new]
+            # advanced-index scatter: rows land at their own positions
+            k_cache = k_cache.at[row, :, t_idx, :].set(
+                jnp.swapaxes(kv, 1, 2).astype(k_cache.dtype))
+            v_cache = v_cache.at[row, :, t_idx, :].set(
+                jnp.swapaxes(vv, 1, 2).astype(v_cache.dtype))
+        else:
+            k_cache = lax.dynamic_update_slice(
+                k_cache, kv.astype(k_cache.dtype), (0, 0, pos, 0))
+            v_cache = lax.dynamic_update_slice(
+                v_cache, vv.astype(v_cache.dtype), (0, 0, pos, 0))
         scale = 1.0 / (self.head_dim ** 0.5)
         scores = jnp.einsum("bhqd,bhkd->bhqk", qv.astype(jnp.float32),
                             k_cache.astype(jnp.float32)) * scale
-        s_max = k_cache.shape[2]
-        key_idx = jnp.arange(s_max)
-        q_pos = pos + jnp.arange(s_new)
-        mask = key_idx[None, :] <= q_pos[:, None]     # [s_new, s_max]
-        scores = jnp.where(mask[None, None], scores, -1e30)
+        if pos_vec:
+            mask = key_idx[None, None, :] <= t_idx[:, :, None]
+            scores = jnp.where(mask[:, None], scores, -1e30)
+        else:
+            q_pos = pos + jnp.arange(s_new)
+            mask = key_idx[None, :] <= q_pos[:, None]  # [s_new, s_max]
+            scores = jnp.where(mask[None, None], scores, -1e30)
         p = jax.nn.softmax(scores, axis=-1)
         out = jnp.einsum("bhqk,bhkd->bhqd", p,
                          v_cache.astype(jnp.float32)).astype(qv.dtype)

@@ -28,7 +28,7 @@ import time
 __all__ = [
     "RecordEvent", "RecordMemEvent", "enable_op_profiling",
     "disable_op_profiling", "is_op_profiling_enabled", "reset", "events",
-    "mem_events", "record_device_memory", "summary",
+    "mem_events", "record_device_memory", "summary", "percentiles",
     "export_chrome_tracing", "profile", "start_trace", "stop_trace",
     "device_op_table",
 ]
@@ -227,6 +227,25 @@ def summary(sorted_by="total", limit=None):
             f"{'== high watermark ==':<32}{'':>10}{in_use_max:>16}"
             f"{peak_all:>16}{host_max:>14}")
     return "\n".join(lines)
+
+
+def percentiles(name, ps=(50, 95, 99)):
+    """Latency percentiles (microseconds) over the recorded host spans
+    named `name` — {p: duration_us} with linear interpolation (numpy's
+    'linear' method). The serving runtime computes its p50/p95/p99
+    through this over its per-request/per-step RecordEvent spans."""
+    durs = sorted(e["dur"] for e in events() if e["name"] == name)
+    if not durs:
+        raise ValueError(f"no recorded events named {name!r}")
+    out = {}
+    for p in ps:
+        if not 0 <= p <= 100:
+            raise ValueError(f"percentile must be in [0, 100], got {p}")
+        rank = (len(durs) - 1) * (p / 100.0)
+        lo = int(rank)
+        hi = min(lo + 1, len(durs) - 1)
+        out[p] = durs[lo] + (durs[hi] - durs[lo]) * (rank - lo)
+    return out
 
 
 def export_chrome_tracing(path):

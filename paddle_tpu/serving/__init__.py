@@ -1,0 +1,41 @@
+"""paddle_tpu.serving — request-level inference runtime.
+
+Ref parity: paddle/fluid/inference/api/ (AnalysisPredictor zero-copy
+run loop, paddle_infer::services::PredictorPool) plus the serving shell
+the reference deploys around it. The TPU-native redesign is
+iteration-level ("continuous") batching in the Orca lineage:
+
+- `AdmissionQueue` — bounded queue, per-request deadline, fast 429-style
+  shed on overload, graceful drain (queueing.py);
+- `DynamicBatcher` — coalesces concurrent requests into shape-bucketed,
+  padded batches; every bucket compiles exactly once (batcher.py);
+- `SlotEngine` — continuous-batching GPT decode over a pooled
+  static-shape KV cache with join-at-step admission and eviction on
+  EOS/max-len/deadline (engine.py);
+- `ServingMetrics` — QPS, queue depth, batch occupancy, latency
+  percentiles; JSON-exportable, spans mirrored into the profiler's
+  chrome trace (metrics.py);
+- `Server` / `http_front` — the user-facing shell (server.py).
+
+Everything runs and certifies on CPU (`JAX_PLATFORMS=cpu`) with
+thread-based clients; no network required.
+"""
+
+from .batcher import (  # noqa: F401
+    DynamicBatcher, bucket_for, bucket_ladder, pad_batch,
+)
+from .engine import SlotEngine, prefill_ladder  # noqa: F401
+from .metrics import ServingMetrics, percentile  # noqa: F401
+from .queueing import (  # noqa: F401
+    AdmissionQueue, DeadlineExceededError, QueueFullError, Request,
+    RequestCancelled, ServerClosedError, ServingError,
+)
+from .server import Server, http_front  # noqa: F401
+
+__all__ = [
+    "AdmissionQueue", "DeadlineExceededError", "DynamicBatcher",
+    "QueueFullError", "Request", "RequestCancelled", "Server",
+    "ServerClosedError", "ServingError", "ServingMetrics", "SlotEngine",
+    "bucket_for", "bucket_ladder", "http_front", "pad_batch",
+    "percentile", "prefill_ladder",
+]

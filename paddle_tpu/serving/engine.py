@@ -1,0 +1,356 @@
+"""Continuous-batching decode engine: a fixed pool of batch slots over
+the GPT static-shape KV cache.
+
+Orca-style iteration-level scheduling (PAPERS.md: continuous batching)
+mapped onto XLA's compile-per-shape reality:
+
+- ONE pooled KV cache per layer, shape [max_slots, nh, max_seq, hd].
+  Each slot row belongs to at most one in-flight request; `pos[slot]`
+  tracks how far that request has decoded. The whole pool steps through
+  a single jitted decode function with a PER-ROW position vector
+  (gpt.py `_attend_cached` vector-pos path), so the step shape never
+  changes and the decode program compiles exactly once.
+- Join-at-step admission: whenever a slot is free and the queue is
+  non-empty, the new request's prompt is prefilled into that slot's
+  rows (prompt padded up to a prefill bucket ladder — one compile per
+  rung) while every other slot keeps decoding. The step loop never
+  drains between requests.
+- Eviction on EOS / max_new_tokens / deadline / cancel frees the slot
+  at the next step boundary. Stale KV from the previous occupant is
+  harmless: the vector-pos causal mask only admits keys <= the new
+  request's position, all of which its own prefill/decode overwrote.
+
+Fault site: ``serving.step`` fires once per decode step; a `raise`
+action fails every in-flight request deterministically (mid-decode
+cancellation path) while the engine itself stays up.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+from .. import profiler
+from ..core.tensor import Tensor
+from ..engine import functional_apply, state_values
+from ..framework import faults
+from ..framework.flags import flag
+from .metrics import ServingMetrics
+from .queueing import (
+    AdmissionQueue, DeadlineExceededError, Request, RequestCancelled,
+)
+
+__all__ = ["SlotEngine", "prefill_ladder"]
+
+
+def prefill_ladder(max_seq_len, spec=None):
+    """Padded prompt-length rungs <= max_seq_len, from the
+    FLAGS_serving_prefill_buckets spec (comma-separated ints), always
+    topped by max_seq_len itself."""
+    spec = spec if spec is not None else flag("FLAGS_serving_prefill_buckets")
+    if isinstance(spec, str):
+        rungs = [int(tok) for tok in spec.split(",") if tok.strip()]
+    else:
+        rungs = [int(tok) for tok in spec]
+    rungs = sorted({r for r in rungs if 0 < r < max_seq_len})
+    rungs.append(max_seq_len)
+    return rungs
+
+
+class _Slot:
+    """One in-flight request's decode state (host side)."""
+
+    def __init__(self, req, tokens, next_logits):
+        self.req = req
+        self.tokens = tokens            # full sequence so far (list[int])
+        self.produced = 0
+        self.next_logits = next_logits  # np [V] feeding the next pick
+        self.rng = None
+        if req.gen.get("do_sample"):
+            self.rng = np.random.RandomState(req.gen.get("seed", 0))
+
+
+class SlotEngine:
+    """Continuous-batching greedy/sampling decode over a GPT model.
+
+    `model` is a `GPTForPretraining` (eval mode is forced). Requests
+    carry `max_new_tokens`, optional `eos_token_id`, and sampling
+    params; results are the full [prompt + generated] int32 id array,
+    token-identical to `generate()` / full re-forwarding for greedy.
+
+    Ownership contract (same as the reference's one-predictor-per-
+    thread rule): while the engine is serving, it owns the model —
+    tracing a new bucket temporarily swaps the model's parameter
+    handles (engine.functional_apply), so run eager forwards on it
+    only while the engine is idle, or on a separate instance.
+    """
+
+    def __init__(self, model, *, max_slots=None, max_seq_len=None,
+                 prefill_buckets=None, cache_dtype=None, metrics=None,
+                 queue=None):
+        import jax
+        import jax.numpy as jnp
+
+        model.eval()
+        self.model = model
+        self.max_slots = max_slots or flag("FLAGS_serving_max_batch")
+        self.max_seq_len = min(max_seq_len or model.config.max_seq_len,
+                               model.config.max_seq_len)
+        self.ladder = prefill_ladder(self.max_seq_len, prefill_buckets)
+        self.metrics = metrics if metrics is not None else ServingMetrics()
+        self.queue = queue if queue is not None else AdmissionQueue(
+            flag("FLAGS_serving_queue_cap"), metrics=self.metrics)
+        self._values = dict(state_values(model))
+        cfg = model.config
+        hd = cfg.hidden_size // cfg.num_heads
+        dtype = cache_dtype or jnp.float32
+        shape = (self.max_slots, cfg.num_heads, self.max_seq_len, hd)
+        self._ks = [jnp.zeros(shape, dtype) for _ in range(cfg.num_layers)]
+        self._vs = [jnp.zeros(shape, dtype) for _ in range(cfg.num_layers)]
+        self._pos = np.zeros((self.max_slots,), np.int32)
+        self._slots: list = [None] * self.max_slots
+        self._free = list(range(self.max_slots))
+        self._compiles: dict = {}
+        self._abort = threading.Event()
+        self._thread = None
+
+        def _count(key):
+            self._compiles[key] = self._compiles.get(key, 0) + 1
+
+        def decode_fn(values, tok, pos, ks, vs):
+            _count("decode")     # trace-time only: the compile counter
+            caches = [(k, v, pos) for k, v in zip(ks, vs)]
+
+            def run(m):
+                h, new_caches = m.gpt(Tensor(tok), Tensor(pos[:, None]),
+                                      caches=caches)
+                return m.logits(h), new_caches
+
+            logits, new_caches = functional_apply(self.model, values, run)
+            lv = jnp.asarray(logits)[:, -1, :].astype(jnp.float32)
+            return (lv, [c[0] for c in new_caches],
+                    [c[1] for c in new_caches])
+
+        def prefill_fn(values, ks, vs, tok_pad, slot, true_len):
+            from jax import lax
+
+            _count(("prefill", tok_pad.shape[1]))
+            rows = [(lax.dynamic_slice_in_dim(k, slot, 1, axis=0),
+                     lax.dynamic_slice_in_dim(v, slot, 1, axis=0), 0)
+                    for k, v in zip(ks, vs)]
+            length = tok_pad.shape[1]
+
+            def run(m):
+                h, new_rows = m.gpt(
+                    Tensor(tok_pad),
+                    Tensor(jnp.arange(length, dtype=jnp.int32)),
+                    caches=rows)
+                return m.logits(h), new_rows
+
+            logits, new_rows = functional_apply(self.model, values, run)
+            last = lax.dynamic_slice_in_dim(
+                jnp.asarray(logits), true_len - 1, 1, axis=1)
+            ks2 = [lax.dynamic_update_slice_in_dim(k, r[0], slot, axis=0)
+                   for k, r in zip(ks, new_rows)]
+            vs2 = [lax.dynamic_update_slice_in_dim(v, r[1], slot, axis=0)
+                   for v, r in zip(vs, new_rows)]
+            return last[:, 0, :].astype(jnp.float32)[0], ks2, vs2
+
+        self._decode = jax.jit(decode_fn)
+        self._prefill = jax.jit(prefill_fn)
+
+    # -- introspection ------------------------------------------------------
+
+    @property
+    def compile_counts(self):
+        """'decode' -> traces of the step fn; ('prefill', L) -> traces
+        of the prefill fn at padded length L. The slot-engine compile
+        invariant is every value == 1."""
+        return dict(self._compiles)
+
+    @property
+    def active(self):
+        return sum(1 for s in self._slots if s is not None)
+
+    # -- request lifecycle --------------------------------------------------
+
+    def submit(self, prompt_ids, *, max_new_tokens=16, eos_token_id=None,
+               timeout=None, do_sample=False, temperature=1.0, top_k=0,
+               seed=0):
+        """Admit one request (or shed); returns its `Request` future."""
+        if timeout is None:
+            timeout = flag("FLAGS_serving_default_timeout_s") or None
+        ids = np.asarray(prompt_ids, np.int32).reshape(-1)
+        if ids.size == 0:
+            raise ValueError("empty prompt")
+        if ids.size + max_new_tokens > self.max_seq_len:
+            raise ValueError(
+                f"prompt ({ids.size}) + max_new_tokens ({max_new_tokens}) "
+                f"exceeds engine max_seq_len {self.max_seq_len}")
+        return self.queue.submit(Request(
+            ids, timeout=timeout, max_new_tokens=max_new_tokens,
+            eos_token_id=eos_token_id, do_sample=do_sample,
+            temperature=temperature, top_k=top_k, seed=seed))
+
+    def _admit(self):
+        """Join-at-step: fill free slots from the queue (no waiting)."""
+        import jax.numpy as jnp
+
+        while self._free:
+            req = self.queue.pop(timeout=0.0)
+            if req is None:
+                return
+            slot = self._free.pop()
+            ids = req.payload
+            s0 = int(ids.size)
+            bucket = next(r for r in self.ladder if r >= s0)
+            tok_pad = np.zeros((1, bucket), np.int32)
+            tok_pad[0, :s0] = ids
+            try:
+                with profiler.RecordEvent("serving.prefill", cat="serving"):
+                    logits, self._ks, self._vs = self._prefill(
+                        self._values, self._ks, self._vs,
+                        jnp.asarray(tok_pad), jnp.int32(slot),
+                        jnp.int32(s0))
+            except Exception as e:  # noqa: BLE001 — fail req, keep slot
+                self._free.append(slot)
+                self.metrics.inc("failed")
+                req._fail(e)
+                continue
+            self._pos[slot] = s0
+            self._slots[slot] = _Slot(req, list(int(t) for t in ids),
+                                      np.asarray(logits))
+            self.metrics.inc("prefills")
+            self.metrics.observe_latency(
+                "queue", time.monotonic() - req.arrival)
+
+    def _pick(self, slot: _Slot):
+        """Next token from the slot's pending logits (host-side so each
+        request carries its own sampling config)."""
+        logits = slot.next_logits
+        gen = slot.req.gen
+        if not gen.get("do_sample"):
+            return int(logits.argmax())
+        scaled = logits / max(gen.get("temperature", 1.0), 1e-6)
+        top_k = gen.get("top_k", 0)
+        if top_k:
+            kth = np.sort(scaled)[-min(top_k, scaled.size)]
+            scaled = np.where(scaled < kth, -np.inf, scaled)
+        z = scaled - scaled.max()
+        p = np.exp(z)
+        p /= p.sum()
+        return int(slot.rng.choice(scaled.size, p=p))
+
+    def _evict(self, idx, error=None):
+        slot = self._slots[idx]
+        self._slots[idx] = None
+        self._free.append(idx)
+        if error is not None:
+            self.metrics.inc("failed")
+            slot.req._fail(error)
+        else:
+            self.metrics.inc("completed")
+            self.metrics.observe_latency(
+                "e2e", time.monotonic() - slot.req.arrival)
+            slot.req._complete(np.asarray(slot.tokens, np.int32))
+
+    def _fail_all_active(self, error):
+        for i, slot in enumerate(self._slots):
+            if slot is not None:
+                self._evict(i, error)
+
+    def _step(self):
+        """One continuous-batching iteration: consume each slot's
+        pending logits (finishing slots that hit EOS/max/deadline), then
+        one batched single-token decode for whatever remains."""
+        import jax.numpy as jnp
+
+        try:
+            faults.fault_point("serving.step")
+        except Exception as e:  # noqa: BLE001 — deterministic mid-decode
+            self._fail_all_active(e)
+            return
+        now = time.monotonic()
+        tok = np.zeros((self.max_slots,), np.int32)
+        live = []
+        for i, slot in enumerate(self._slots):
+            if slot is None:
+                continue
+            req = slot.req
+            if req.cancelled:
+                self.metrics.inc("cancelled")
+                self._evict(i, RequestCancelled(
+                    f"request {req.id} cancelled mid-decode"))
+                continue
+            if req.expired(now):
+                self.metrics.inc("timeouts")
+                self._evict(i, DeadlineExceededError(
+                    f"request {req.id} deadline exceeded mid-decode "
+                    f"after {slot.produced} tokens"))
+                continue
+            nxt = self._pick(slot)
+            slot.tokens.append(nxt)
+            slot.produced += 1
+            self.metrics.inc("tokens_out")
+            gen = req.gen
+            eos = gen.get("eos_token_id")
+            if (eos is not None and nxt == eos) or \
+                    slot.produced >= gen.get("max_new_tokens", 16):
+                self._evict(i)
+                continue
+            tok[i] = nxt
+            live.append(i)
+        if not live:
+            return
+        with profiler.RecordEvent("serving.step", cat="serving"):
+            logits, self._ks, self._vs = self._decode(
+                self._values, jnp.asarray(tok[:, None]),
+                jnp.asarray(self._pos), self._ks, self._vs)
+        logits = np.asarray(logits)
+        for i in live:
+            self._pos[i] += 1
+            self._slots[i].next_logits = logits[i]
+        self.metrics.inc("steps")
+        self.metrics.observe_occupancy(len(live), self.max_slots)
+
+    # -- serve loop ---------------------------------------------------------
+
+    def start(self):
+        if self._thread is not None:
+            return self
+        self._abort.clear()
+        self._thread = threading.Thread(target=self._loop,
+                                        name="serving-engine", daemon=True)
+        self._thread.start()
+        return self
+
+    def _loop(self):
+        while True:
+            if self._abort.is_set():
+                self._fail_all_active(RequestCancelled(
+                    "server aborted (non-drain shutdown)"))
+                return
+            self._admit()
+            if self.active == 0:
+                if self.queue.drained():
+                    return
+                self.queue.wait_nonempty(0.02)
+                continue
+            try:
+                self._step()
+            except Exception as e:  # noqa: BLE001 — engine must stay up
+                self.metrics.inc("step_errors")
+                self._fail_all_active(e)
+
+    def shutdown(self, drain=True, timeout=None):
+        """Stop. drain=True finishes queued + in-flight requests first;
+        drain=False sheds the queue and evicts in-flight requests at the
+        next step boundary."""
+        self.queue.close(drain=drain)
+        if not drain:
+            self._abort.set()
+        if self._thread is not None:
+            self._thread.join(timeout)
+            self._thread = None

@@ -1,0 +1,125 @@
+"""Serving metrics registry: counters, occupancy, latency percentiles.
+
+Ref parity: the reference's serving stack exports brpc/bvar counters
+(qps, latency quantiles, queue depth); here one registry aggregates the
+same signals host-side and exports them as JSON. Latency series are also
+recorded as `profiler.RecordEvent` spans by the engine/batcher, so the
+same numbers land in the chrome trace and `profiler.percentiles` agrees
+with `snapshot()`.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+from ..framework import monitor
+
+__all__ = ["ServingMetrics", "percentile"]
+
+# keep at most this many samples per latency series (fifo window) so a
+# long-lived server doesn't grow without bound
+_MAX_SAMPLES = 65536
+
+
+def percentile(samples, p):
+    """Linear-interpolation percentile (numpy 'linear' method) over an
+    unsorted sequence; p in [0, 100]."""
+    if not 0 <= p <= 100:
+        raise ValueError(f"percentile must be in [0, 100], got {p}")
+    data = sorted(samples)
+    if not data:
+        raise ValueError("no samples")
+    rank = (len(data) - 1) * (p / 100.0)
+    lo = int(rank)
+    hi = min(lo + 1, len(data) - 1)
+    return data[lo] + (data[hi] - data[lo]) * (rank - lo)
+
+
+class ServingMetrics:
+    """Thread-safe counters + occupancy + latency series.
+
+    Counter names mirror the admission queue's (`submitted`, `accepted`,
+    `rejected_queue_full`, `rejected_closed`, `timeouts`, `cancelled`)
+    plus engine-side `completed`, `failed`, `steps`, `batches`,
+    `tokens_out`, `prefills`. Every inc() also bumps the global
+    `framework.monitor` counter ``serving.<name>`` so serving shows up
+    in the same stat registry as the rest of the runtime.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: dict = {}
+        self._latency: dict = {}      # kind -> [seconds]
+        self._occ_sum = 0.0
+        self._occ_n = 0
+        self._occ_max = 0.0
+        self._started = time.monotonic()
+
+    def inc(self, name, n=1):
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + n
+        monitor.stat_add(f"serving.{name}", n)
+
+    def get(self, name):
+        with self._lock:
+            return self._counters.get(name, 0)
+
+    def observe_latency(self, kind, seconds):
+        with self._lock:
+            series = self._latency.setdefault(kind, [])
+            series.append(float(seconds))
+            if len(series) > _MAX_SAMPLES:
+                del series[:len(series) - _MAX_SAMPLES]
+
+    def observe_occupancy(self, active, capacity):
+        """One decode-step sample of slot utilisation (active/capacity)."""
+        frac = active / max(capacity, 1)
+        with self._lock:
+            self._occ_sum += frac
+            self._occ_n += 1
+            self._occ_max = max(self._occ_max, frac)
+
+    def latency_percentiles(self, kind, ps=(50, 95, 99)):
+        """{p: seconds} over the recorded `kind` series."""
+        with self._lock:
+            series = list(self._latency.get(kind, ()))
+        if not series:
+            return {p: None for p in ps}
+        return {p: percentile(series, p) for p in ps}
+
+    def snapshot(self, queue_depth=None):
+        """One JSON-able view: counters, QPS, tokens/s, occupancy,
+        p50/p95/p99 per latency series."""
+        with self._lock:
+            counters = dict(self._counters)
+            latency = {k: list(v) for k, v in self._latency.items()}
+            occ_avg = self._occ_sum / self._occ_n if self._occ_n else 0.0
+            occ_max = self._occ_max
+            elapsed = max(time.monotonic() - self._started, 1e-9)
+        snap = {
+            "counters": counters,
+            "uptime_s": elapsed,
+            "qps": counters.get("completed", 0) / elapsed,
+            "tokens_per_s": counters.get("tokens_out", 0) / elapsed,
+            "batch_occupancy": {"avg": occ_avg, "max": occ_max,
+                                "samples": self._occ_n},
+            "latency_s": {},
+        }
+        if queue_depth is not None:
+            snap["queue_depth"] = queue_depth
+        for kind, series in latency.items():
+            if series:
+                snap["latency_s"][kind] = {
+                    "count": len(series),
+                    "p50": percentile(series, 50),
+                    "p95": percentile(series, 95),
+                    "p99": percentile(series, 99),
+                    "max": max(series),
+                }
+        return snap
+
+    def to_json(self, queue_depth=None, **dump_kw):
+        return json.dumps(self.snapshot(queue_depth=queue_depth),
+                          **dump_kw)

@@ -1,0 +1,241 @@
+"""Bounded admission queue + request futures for the serving runtime.
+
+Ref parity: the reference serves through AnalysisPredictor behind
+paddle_serving's brpc front (bounded task queues, per-request deadlines,
+fast rejection on overload). Here the queue is the in-process contract:
+`submit` never blocks the engine — it either admits within capacity or
+sheds immediately (429-style `QueueFullError`), and every request
+carries an absolute deadline checked both while queued and mid-decode.
+
+Fault sites (framework/faults.py grammar): ``serving.submit`` fires on
+every admission attempt (a `drop` action sheds the request exactly as a
+full queue would — deterministic overload), ``serving.dequeue`` on every
+pop by the batch assembler / decode engine.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from collections import deque
+
+from ..framework import faults, monitor
+
+__all__ = [
+    "ServingError", "QueueFullError", "ServerClosedError",
+    "DeadlineExceededError", "RequestCancelled", "Request",
+    "AdmissionQueue",
+]
+
+
+class ServingError(RuntimeError):
+    """Base of the serving-side request failures; `status` carries the
+    HTTP status the optional front door maps it to."""
+
+    status = 500
+
+
+class QueueFullError(ServingError):
+    """Load shed: the bounded admission queue is at capacity."""
+
+    status = 429
+
+
+class ServerClosedError(ServingError):
+    """Submitted after shutdown began (or pending at a non-drain stop)."""
+
+    status = 503
+
+
+class DeadlineExceededError(ServingError):
+    """The request's deadline passed while queued or mid-decode."""
+
+    status = 504
+
+
+class RequestCancelled(ServingError):
+    """The client cancelled; the engine evicts at the next step."""
+
+    status = 499
+
+
+_ids = itertools.count(1)
+
+
+class Request:
+    """One unit of serving work + its future.
+
+    `payload` is mode-specific (a 1-D prompt id array for the decode
+    engine, one unbatched sample for the dynamic batcher); generation
+    parameters ride along in `gen`. The completing thread calls
+    `_complete`/`_fail`; clients block in `result()`.
+    """
+
+    def __init__(self, payload, *, timeout=None, **gen):
+        self.id = next(_ids)
+        self.payload = payload
+        self.gen = gen
+        self.arrival = time.monotonic()
+        self.deadline = self.arrival + timeout if timeout else None
+        self._event = threading.Event()
+        self._value = None
+        self._error = None
+        self._cancel = False
+
+    # -- client side --------------------------------------------------------
+
+    def cancel(self):
+        """Request eviction; honoured at the engine's next step
+        boundary (mid-decode cancellation)."""
+        self._cancel = True
+
+    @property
+    def cancelled(self):
+        return self._cancel
+
+    def done(self):
+        return self._event.is_set()
+
+    def result(self, timeout=None):
+        if not self._event.wait(timeout):
+            raise TimeoutError(
+                f"request {self.id} not done within {timeout}s")
+        if self._error is not None:
+            raise self._error
+        return self._value
+
+    def exception(self, timeout=None):
+        if not self._event.wait(timeout):
+            raise TimeoutError(
+                f"request {self.id} not done within {timeout}s")
+        return self._error
+
+    # -- engine side --------------------------------------------------------
+
+    def expired(self, now=None):
+        return self.deadline is not None and \
+            (now if now is not None else time.monotonic()) > self.deadline
+
+    def _complete(self, value):
+        self._value = value
+        self._event.set()
+
+    def _fail(self, error):
+        self._error = error
+        self._event.set()
+
+
+class AdmissionQueue:
+    """Bounded FIFO with deadline-aware pops and graceful drain.
+
+    submit() is the admission-control point: over-capacity submissions
+    raise `QueueFullError` immediately (the fast 429) instead of
+    blocking the client into an unbounded backlog; a closed queue raises
+    `ServerClosedError`. pop() silently fails+skips requests whose
+    deadline already passed — they never reach a slot.
+    """
+
+    def __init__(self, cap, *, metrics=None):
+        if cap < 1:
+            raise ValueError(f"queue cap must be >= 1, got {cap}")
+        self.cap = cap
+        self._items: deque = deque()
+        self._cond = threading.Condition()
+        self._closed = False
+        self._drain = True
+        self._metrics = metrics
+
+    def _count(self, name, n=1):
+        monitor.stat_add(f"serving.{name}", n)
+        if self._metrics is not None:
+            self._metrics.inc(name, n)
+
+    @property
+    def depth(self):
+        with self._cond:
+            return len(self._items)
+
+    @property
+    def closed(self):
+        return self._closed
+
+    def drained(self):
+        """True once closed and empty — the engine's exit condition."""
+        with self._cond:
+            return self._closed and not self._items
+
+    def submit(self, request: Request):
+        """Admit or shed. Returns `request` for chaining."""
+        self._count("submitted")
+        if faults.fault_point("serving.submit", request) is faults.DROP:
+            # deterministic overload: the drop action sheds exactly as a
+            # full queue would
+            self._count("rejected_queue_full")
+            raise QueueFullError(
+                f"request {request.id} shed (injected overload)")
+        with self._cond:
+            if self._closed:
+                self._count("rejected_closed")
+                raise ServerClosedError(
+                    f"request {request.id} rejected: server shutting down")
+            if len(self._items) >= self.cap:
+                self._count("rejected_queue_full")
+                raise QueueFullError(
+                    f"request {request.id} rejected: queue at capacity "
+                    f"{self.cap}")
+            self._items.append(request)
+            self._cond.notify_all()
+        self._count("accepted")
+        return request
+
+    def pop(self, timeout=0.0):
+        """Next live request, or None when nothing arrived within
+        `timeout` (or the queue is drained). Expired/cancelled requests
+        are failed in place and skipped."""
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            while True:
+                while self._items:
+                    req = self._items.popleft()
+                    if req.cancelled:
+                        self._count("cancelled")
+                        req._fail(RequestCancelled(
+                            f"request {req.id} cancelled while queued"))
+                        continue
+                    if req.expired():
+                        self._count("timeouts")
+                        req._fail(DeadlineExceededError(
+                            f"request {req.id} deadline exceeded after "
+                            f"{time.monotonic() - req.arrival:.3f}s in "
+                            "queue"))
+                        continue
+                    faults.fault_point("serving.dequeue", req)
+                    return req
+                if self._closed:
+                    return None
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return None
+                self._cond.wait(remaining)
+
+    def wait_nonempty(self, timeout):
+        """Park until something is queued (or close/timeout)."""
+        with self._cond:
+            if self._items or self._closed:
+                return
+            self._cond.wait(timeout)
+
+    def close(self, drain=True):
+        """Stop admissions. drain=True leaves queued requests for the
+        engine to finish; drain=False fails them all right now."""
+        with self._cond:
+            self._closed = True
+            self._drain = drain
+            if not drain:
+                while self._items:
+                    req = self._items.popleft()
+                    self._count("rejected_closed")
+                    req._fail(ServerClosedError(
+                        f"request {req.id} dropped: non-drain shutdown"))
+            self._cond.notify_all()

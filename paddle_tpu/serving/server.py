@@ -1,0 +1,188 @@
+"""In-process serving front: one object tying admission control, the
+continuous-batching engine (or the dynamic batcher), and metrics.
+
+Ref parity: paddle/fluid/inference/api + paddle_serving's server shell —
+`Server` plays the role of the predictor-pool-plus-brpc-service pair,
+collapsed to a thread-safe `submit()/result()` API so it runs anywhere
+(CPU tier-1 included) with no network dependency. `http_front` is the
+optional stdlib front door mapping the same API onto HTTP.
+
+    cfg = GPTConfig(..., use_parallel=False)
+    model = GPTForPretraining(cfg)
+    with serving.Server(model, max_slots=4) as srv:
+        fut = srv.submit([1, 2, 3], max_new_tokens=8)
+        ids = fut.result()              # np.int32 [prompt + generated]
+        print(srv.snapshot()["qps"])
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+
+import numpy as np
+
+from ..framework.flags import flag
+from .batcher import DynamicBatcher
+from .engine import SlotEngine
+from .metrics import ServingMetrics
+from .queueing import ServingError
+
+__all__ = ["Server", "http_front"]
+
+
+class Server:
+    """Serving front over a model.
+
+    mode="generate" (default): `model` is a GPTForPretraining; requests
+    are prompts and the backend is the continuous-batching `SlotEngine`.
+    mode="batch": `fn` is a batch function (or pass a jax-traceable
+    callable as `model`); requests are single samples coalesced by the
+    `DynamicBatcher`.
+    """
+
+    def __init__(self, model=None, *, mode="generate", fn=None,
+                 max_slots=None, max_seq_len=None, prefill_buckets=None,
+                 queue_cap=None, max_batch=None, max_wait_s=0.002,
+                 cache_dtype=None, jit=True):
+        self.mode = mode
+        self.metrics = ServingMetrics()
+        if mode == "generate":
+            if model is None:
+                raise ValueError("generate mode needs a GPT model")
+            from .queueing import AdmissionQueue
+
+            queue = AdmissionQueue(
+                queue_cap or flag("FLAGS_serving_queue_cap"),
+                metrics=self.metrics)
+            self.engine = SlotEngine(
+                model, max_slots=max_slots, max_seq_len=max_seq_len,
+                prefill_buckets=prefill_buckets, cache_dtype=cache_dtype,
+                metrics=self.metrics, queue=queue)
+            self.batcher = None
+        elif mode == "batch":
+            target = fn if fn is not None else model
+            if target is None or not callable(target):
+                raise ValueError("batch mode needs a callable fn")
+            self.batcher = DynamicBatcher(
+                target, max_batch=max_batch, max_wait_s=max_wait_s,
+                queue_cap=queue_cap, metrics=self.metrics, jit=jit)
+            self.engine = None
+        else:
+            raise ValueError(f"unknown serving mode {mode!r}")
+        self._started = False
+
+    @classmethod
+    def from_predictor(cls, predictor, **kw):
+        """Batch-mode server over an inference.Predictor's loaded
+        program (shares its weights; the exported program manages its
+        own compilation, so jit wrapping is off)."""
+        layer = predictor._layer
+
+        def fn(x):
+            out = layer(x)
+            return out._value if hasattr(out, "_value") else out
+
+        kw.setdefault("jit", False)
+        return cls(fn=fn, mode="batch", **kw)
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self):
+        if not self._started:
+            (self.engine or self.batcher).start()
+            self._started = True
+        return self
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.shutdown(drain=exc[0] is None)
+        return False
+
+    def shutdown(self, drain=True):
+        """Graceful drain (finish queued + in-flight work) or fast stop
+        (shed the queue, evict in-flight at the next step)."""
+        if self.engine is not None:
+            self.engine.shutdown(drain=drain)
+        else:
+            self.batcher.close(drain=drain)
+        self._started = False
+
+    # -- request API --------------------------------------------------------
+
+    @property
+    def queue(self):
+        return (self.engine or self.batcher).queue
+
+    def submit(self, payload, **kw):
+        """Admit one request; returns a `Request` future. Generate mode
+        takes a 1-D prompt + generation kwargs; batch mode one sample."""
+        if not self._started:
+            self.start()
+        if self.engine is not None:
+            return self.engine.submit(payload, **kw)
+        return self.batcher.submit(payload, **kw)
+
+    def generate(self, prompt_ids, timeout=None, **kw):
+        """Synchronous submit+wait."""
+        return self.submit(prompt_ids, **kw).result(timeout)
+
+    def snapshot(self):
+        return self.metrics.snapshot(queue_depth=self.queue.depth)
+
+    def metrics_json(self, **kw):
+        return self.metrics.to_json(queue_depth=self.queue.depth, **kw)
+
+
+def http_front(server: Server, host="127.0.0.1", port=0):
+    """Optional stdlib front door (bonus deliverable — the in-process
+    API above is the contract). POST /v1/generate with a JSON body
+    ``{"prompt": [ids...], "max_new_tokens": n, ...}`` returns
+    ``{"ids": [...]}``; GET /metrics returns the snapshot. Serving
+    errors map to their HTTP status (429 shed, 504 deadline, ...).
+
+    Returns the started `ThreadingHTTPServer`; its bound port is
+    ``httpd.server_address[1]``. Call ``httpd.shutdown()`` to stop."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):  # quiet
+            pass
+
+        def _reply(self, code, obj):
+            body = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path == "/metrics":
+                self._reply(200, server.snapshot())
+            else:
+                self._reply(404, {"error": "not found"})
+
+        def do_POST(self):
+            if self.path != "/v1/generate":
+                self._reply(404, {"error": "not found"})
+                return
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                req = json.loads(self.rfile.read(n) or b"{}")
+                prompt = req.pop("prompt")
+                timeout = req.pop("timeout", None)
+                out = server.generate(prompt, timeout=timeout, **req)
+                self._reply(200, {"ids": np.asarray(out).tolist()})
+            except ServingError as e:
+                self._reply(e.status, {"error": str(e)})
+            except Exception as e:  # noqa: BLE001 — bad request shape
+                self._reply(400, {"error": str(e)})
+
+    httpd = ThreadingHTTPServer((host, port), Handler)
+    thread = threading.Thread(target=httpd.serve_forever,
+                              name="serving-http", daemon=True)
+    thread.start()
+    return httpd

@@ -7,6 +7,7 @@ import json
 import time
 
 import numpy as np
+import pytest
 
 import paddle_tpu as paddle
 from paddle_tpu import profiler
@@ -41,6 +42,39 @@ def test_op_profiling_and_summary():
     before = len(profiler.events())
     _ = paddle.matmul(x, x)
     assert len(profiler.events()) == before
+
+
+def test_percentiles_over_host_spans():
+    """percentiles() computes linear-interpolation latency percentiles
+    over recorded spans of one name (the serving runtime's p50/p95/p99
+    source). Exactness checked against hand-computed values on synthetic
+    durations."""
+    profiler.reset()
+    with profiler._lock:
+        for d in (10.0, 20.0, 30.0, 40.0):
+            profiler._events.append({"name": "lat", "cat": "host",
+                                     "ts": 0.0, "dur": d, "tid": 0,
+                                     "depth": 0})
+        profiler._events.append({"name": "other", "cat": "host",
+                                 "ts": 0.0, "dur": 999.0, "tid": 0,
+                                 "depth": 0})
+    p = profiler.percentiles("lat", (0, 50, 95, 100))
+    assert p[0] == 10.0 and p[100] == 40.0
+    assert p[50] == 25.0                  # rank 1.5 between 20 and 30
+    assert abs(p[95] - 38.5) < 1e-9       # rank 2.85 between 30 and 40
+    # only the named series contributes
+    assert profiler.percentiles("other")[50] == 999.0
+    with pytest.raises(ValueError):
+        profiler.percentiles("missing")
+    with pytest.raises(ValueError):
+        profiler.percentiles("lat", (101,))
+    # real spans work end to end
+    profiler.reset()
+    for _ in range(3):
+        with profiler.RecordEvent("req"):
+            time.sleep(0.001)
+    q = profiler.percentiles("req")
+    assert 0 < q[50] <= q[95] <= q[99]
 
 
 def test_chrome_trace_export(tmp_path):

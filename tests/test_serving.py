@@ -1,0 +1,576 @@
+"""Serving subsystem: continuous-batching slot engine, dynamic batcher
+bucket ladder (one compile per bucket), admission control (queue-full
+shed, deadlines, graceful drain), deterministic fault injection, and the
+metrics/percentile registry.
+
+Ref parity: paddle/fluid/inference/api (AnalysisPredictor/PredictorPool)
++ the Orca-style continuous batching the reference's serving stack
+approximates with request-level batching. Everything here runs on CPU
+with thread-based clients — no network.
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import profiler, serving
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.framework import faults
+from paddle_tpu.nlp.transformers import GPTConfig, GPTForPretraining
+from paddle_tpu.serving import (
+    AdmissionQueue, DeadlineExceededError, DynamicBatcher, QueueFullError,
+    Request, RequestCancelled, ServerClosedError, ServingError,
+    ServingMetrics, bucket_for, bucket_ladder, pad_batch, prefill_ladder,
+)
+
+REPO = Path(__file__).resolve().parent.parent
+VOCAB = 97
+
+
+@pytest.fixture(scope="module")
+def gpt():
+    paddle.seed(11)
+    cfg = GPTConfig(vocab_size=VOCAB, hidden_size=32, num_layers=2,
+                    num_heads=4, max_seq_len=64, dropout=0.0,
+                    attn_dropout=0.0, use_parallel=False)
+    m = GPTForPretraining(cfg)
+    m.eval()
+    return m
+
+
+@pytest.fixture(scope="module")
+def server(gpt):
+    """Shared started server: parity/metrics tests reuse it so the
+    compile-once invariant is checked ACROSS many requests."""
+    srv = serving.Server(gpt, max_slots=2, prefill_buckets=(8, 16)).start()
+    yield srv
+    srv.shutdown(drain=True)
+
+
+def _full_logits(m, ids):
+    out = m(Tensor(jnp.asarray(ids, jnp.int32)))
+    return np.asarray(out._value, np.float32)
+
+
+def _ref_greedy(m, ids, n, eos=None):
+    """The no-cache reference decoder: argmax chain over full
+    re-forwarding, stopping early at eos."""
+    ref = np.asarray(ids, np.int32).reshape(1, -1)
+    for _ in range(n):
+        nxt = int(_full_logits(m, ref)[:, -1].argmax(-1)[0])
+        ref = np.concatenate([ref, [[nxt]]], axis=1).astype(np.int32)
+        if eos is not None and nxt == eos:
+            break
+    return ref[0]
+
+
+def _prompt(seed, n):
+    return np.random.RandomState(seed).randint(
+        0, VOCAB, (n,)).astype(np.int32)
+
+
+# ---------------------------------------------------------------------------
+# bucket ladders + padding
+# ---------------------------------------------------------------------------
+
+
+def test_bucket_ladder_shapes():
+    assert bucket_ladder(8) == [1, 2, 4, 8]
+    assert bucket_ladder(6) == [1, 2, 4, 6]   # top rung always included
+    assert bucket_ladder(1) == [1]
+    with pytest.raises(ValueError):
+        bucket_ladder(0)
+
+
+def test_bucket_for_selection():
+    ladder = [1, 2, 4, 8]
+    assert bucket_for(1, ladder) == 1
+    assert bucket_for(3, ladder) == 4
+    assert bucket_for(8, ladder) == 8
+    with pytest.raises(ValueError):
+        bucket_for(9, ladder)
+
+
+def test_pad_batch_repeats_last_sample():
+    a = [np.full((3,), i, np.float32) for i in range(3)]
+    x = pad_batch(a, 4)
+    assert x.shape == (4, 3)
+    np.testing.assert_array_equal(x[3], a[2])  # repeat, not zeros
+
+
+def test_prefill_ladder_caps_at_max_seq_len():
+    assert prefill_ladder(64, (8, 16, 128)) == [8, 16, 64]
+    assert prefill_ladder(64, "16,32") == [16, 32, 64]
+    # flag default parses and is topped by max_seq_len
+    assert prefill_ladder(1024)[-1] == 1024
+
+
+# ---------------------------------------------------------------------------
+# dynamic batcher: one compile per bucket, parity, threading
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def batch_fn():
+    w = jnp.asarray(np.random.RandomState(3).randn(6, 4), jnp.float32)
+    return lambda x: jnp.tanh(x @ w)
+
+
+def test_batcher_one_compile_per_bucket(batch_fn):
+    b = DynamicBatcher(batch_fn, max_batch=4)
+    samples = [np.random.RandomState(i).randn(6).astype(np.float32)
+               for i in range(8)]
+    b.run_batch(samples[:3])          # -> bucket 4: compile
+    b.run_batch(samples[:4])          # same bucket: cached
+    b.run_batch(samples[3:6])         # same bucket: cached
+    b.run_batch(samples[:1])          # -> bucket 1: compile
+    b.run_batch(samples[1:2])         # cached
+    assert b.compile_counts == {4: 1, 1: 1}
+
+
+def test_batcher_results_match_direct(batch_fn):
+    b = DynamicBatcher(batch_fn, max_batch=4)
+    samples = [np.random.RandomState(10 + i).randn(6).astype(np.float32)
+               for i in range(3)]
+    outs = b.run_batch(samples)
+    want = np.asarray(batch_fn(jnp.asarray(np.stack(samples))))
+    for got, exp in zip(outs, want):
+        np.testing.assert_allclose(got, exp, rtol=1e-6)
+
+
+def test_batcher_threaded_hot_path_never_recompiles(batch_fn):
+    metrics = ServingMetrics()
+    b = DynamicBatcher(batch_fn, max_batch=4, max_wait_s=0.01,
+                       metrics=metrics)
+    sample = np.zeros((6,), np.float32)
+    b.warmup(sample)                      # compile every rung up front
+    warm = b.compile_counts
+    assert warm == {1: 1, 2: 1, 4: 1}
+    b.start()
+    samples = [np.random.RandomState(20 + i).randn(6).astype(np.float32)
+               for i in range(16)]
+    futures = []
+    threads = [threading.Thread(
+        target=lambda s=s: futures.append((s, b.submit(s))))
+        for s in samples]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for s, fut in futures:
+        got = fut.result(30)
+        want = np.asarray(batch_fn(jnp.asarray(s[None])))[0]
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+    b.close()
+    # whatever flush sizes the race produced, every padded shape was a
+    # pre-compiled rung: the hot path never traced again
+    assert b.compile_counts == warm
+    assert metrics.get("completed") == 16
+    assert metrics.snapshot()["batch_occupancy"]["samples"] > 0
+
+
+def test_batcher_single_request_flushes_on_max_wait(batch_fn):
+    b = DynamicBatcher(batch_fn, max_batch=4, max_wait_s=0.005).start()
+    s = np.random.RandomState(30).randn(6).astype(np.float32)
+    got = b(s, timeout=30)
+    np.testing.assert_allclose(
+        got, np.asarray(batch_fn(jnp.asarray(s[None])))[0], rtol=1e-6)
+    b.close()
+
+
+def test_batcher_fault_fails_members_but_survives(batch_fn):
+    b = DynamicBatcher(batch_fn, max_batch=2, max_wait_s=0.005).start()
+    s = np.zeros((6,), np.float32)
+    with faults.inject("serving.batch@1:raise"):
+        with pytest.raises(faults.FaultError):
+            b(s, timeout=30)
+        got = b(s, timeout=30)   # batcher thread survived the fault
+        np.testing.assert_allclose(
+            got, np.asarray(batch_fn(jnp.asarray(s[None])))[0], rtol=1e-6)
+    b.close()
+
+
+# ---------------------------------------------------------------------------
+# admission queue: shed, deadline, drain
+# ---------------------------------------------------------------------------
+
+
+def test_queue_full_sheds_fast():
+    m = ServingMetrics()
+    q = AdmissionQueue(2, metrics=m)
+    q.submit(Request("a"))
+    q.submit(Request("b"))
+    t0 = time.monotonic()
+    with pytest.raises(QueueFullError):
+        q.submit(Request("c"))
+    assert time.monotonic() - t0 < 0.1   # 429-style: no blocking
+    assert m.get("rejected_queue_full") == 1
+    assert m.get("accepted") == 2
+    assert q.depth == 2
+
+
+def test_queue_deadline_expires_while_queued():
+    q = AdmissionQueue(4)
+    req = q.submit(Request("x", timeout=0.01))
+    time.sleep(0.03)
+    assert q.pop(timeout=0.0) is None    # expired request skipped
+    with pytest.raises(DeadlineExceededError):
+        req.result(1.0)
+
+
+def test_queue_fifo_and_cancelled_skip():
+    q = AdmissionQueue(4)
+    a, b, c = Request(1), Request(2), Request(3)
+    for r in (a, b, c):
+        q.submit(r)
+    b.cancel()
+    assert q.pop(timeout=0.0) is a
+    assert q.pop(timeout=0.0) is c       # b failed + skipped
+    with pytest.raises(RequestCancelled):
+        b.result(1.0)
+
+
+def test_queue_close_drain_semantics():
+    q = AdmissionQueue(4)
+    kept = q.submit(Request("kept"))
+    q.close(drain=True)
+    with pytest.raises(ServerClosedError):
+        q.submit(Request("late"))
+    assert q.pop(timeout=0.0) is kept    # drain leaves queued work
+    assert q.drained()
+
+    q2 = AdmissionQueue(4)
+    dropped = q2.submit(Request("dropped"))
+    q2.close(drain=False)
+    with pytest.raises(ServerClosedError):
+        dropped.result(1.0)
+
+
+def test_submit_drop_fault_is_deterministic_overload():
+    q = AdmissionQueue(8)
+    with faults.inject("serving.submit@2:drop"):
+        q.submit(Request(1))
+        with pytest.raises(QueueFullError):   # exactly the 2nd submit
+            q.submit(Request(2))
+        q.submit(Request(3))
+    assert q.depth == 2
+
+
+# ---------------------------------------------------------------------------
+# continuous-batching slot engine: token parity vs uncached decode
+# ---------------------------------------------------------------------------
+
+
+def test_slot_engine_greedy_parity_single(gpt, server):
+    p = _prompt(0, 5)
+    out = server.generate(p, max_new_tokens=6, timeout=120)
+    np.testing.assert_array_equal(out, _ref_greedy(gpt, p, 6))
+
+
+def test_slot_engine_concurrent_parity_and_midflight_join(gpt, server):
+    """3 requests of different prompt lengths on 2 slots: the third
+    joins at a step boundary in whichever slot frees first (a recycled
+    slot), while the survivor keeps decoding. Every output must be
+    token-identical to the uncached reference chain."""
+    prompts = [_prompt(1, 5), _prompt(2, 9), _prompt(3, 3)]
+    new = [7, 3, 6]
+    futs = [server.submit(p, max_new_tokens=n, timeout=120)
+            for p, n in zip(prompts, new)]
+    outs = [f.result(120) for f in futs]   # engine idle before refs
+    for p, n, out in zip(prompts, new, outs):
+        np.testing.assert_array_equal(out, _ref_greedy(gpt, p, n))
+
+
+def test_recycled_slot_stale_kv_masked(gpt):
+    """max_slots=1 forces B into the slot A just used, with A's longer
+    KV still in the pooled cache; B's parity proves the stale keys are
+    masked/overwritten, never attended."""
+    srv = serving.Server(gpt, max_slots=1, prefill_buckets=(8, 16)).start()
+    try:
+        a, b = _prompt(4, 12), _prompt(5, 4)
+        out_a = srv.generate(a, max_new_tokens=4, timeout=120)
+        out_b = srv.generate(b, max_new_tokens=6, timeout=120)
+        np.testing.assert_array_equal(out_a, _ref_greedy(gpt, a, 4))
+        np.testing.assert_array_equal(out_b, _ref_greedy(gpt, b, 6))
+        assert srv.engine.compile_counts["decode"] == 1
+    finally:
+        srv.shutdown(drain=True)
+
+
+def test_eos_eviction_frees_slot_early(gpt, server):
+    p = _prompt(6, 4)
+    eos = int(_full_logits(gpt, p.reshape(1, -1))[:, -1].argmax(-1)[0])
+    out = server.generate(p, max_new_tokens=5, eos_token_id=eos,
+                          timeout=120)
+    # stops AT the eos token — no padding, slot freed for the next join
+    np.testing.assert_array_equal(
+        out, np.concatenate([p, [eos]]).astype(np.int32))
+    assert server.engine.active == 0
+
+
+def test_sampling_topk1_degenerates_to_greedy(gpt, server):
+    p = _prompt(7, 5)
+    greedy = server.generate(p, max_new_tokens=4, timeout=120)
+    for seed in (0, 9):
+        sampled = server.generate(p, max_new_tokens=4, do_sample=True,
+                                  top_k=1, seed=seed, timeout=120)
+        np.testing.assert_array_equal(sampled, greedy)
+
+
+def test_slot_engine_compiles_exactly_once_per_bucket(server):
+    """After everything the shared server has decoded — many requests,
+    joins, evictions, both prefill buckets — every compiled program
+    traced exactly once."""
+    counts = server.engine.compile_counts
+    assert counts["decode"] == 1
+    assert ("prefill", 8) in counts
+    assert all(v == 1 for v in counts.values()), counts
+
+
+def test_submit_validates_lengths(server):
+    with pytest.raises(ValueError):
+        server.submit(np.arange(60), max_new_tokens=10)  # > max_seq_len
+    with pytest.raises(ValueError):
+        server.submit(np.zeros((0,), np.int32))
+
+
+# ---------------------------------------------------------------------------
+# robustness: mid-decode faults, deadlines, cancel, drain
+# ---------------------------------------------------------------------------
+
+
+def test_mid_decode_fault_fails_inflight_engine_survives(gpt):
+    srv = serving.Server(gpt, max_slots=2, prefill_buckets=(8,)).start()
+    try:
+        with faults.inject("serving.step@2:raise"):
+            fut = srv.submit(_prompt(8, 4), max_new_tokens=8, timeout=120)
+            with pytest.raises(faults.FaultError):
+                fut.result(120)
+        # engine thread survived: the next request completes with parity
+        p = _prompt(9, 4)
+        out = srv.generate(p, max_new_tokens=3, timeout=120)
+        np.testing.assert_array_equal(out, _ref_greedy(gpt, p, 3))
+        assert srv.metrics.get("failed") == 1
+    finally:
+        srv.shutdown(drain=True)
+
+
+def test_deadline_exceeded_mid_decode(gpt):
+    """A slow model (delay fault on every step) pushes a long request
+    past its deadline while decoding; it must fail with
+    DeadlineExceededError at a step boundary, not hang."""
+    srv = serving.Server(gpt, max_slots=1, prefill_buckets=(8,)).start()
+    try:
+        with faults.inject("serving.step@*:delay:0.05"):
+            fut = srv.submit(_prompt(10, 4), max_new_tokens=40,
+                             timeout=0.15)
+            with pytest.raises(DeadlineExceededError):
+                fut.result(120)
+        assert srv.metrics.get("timeouts") >= 1
+    finally:
+        srv.shutdown(drain=True)
+
+
+def test_cancel_mid_decode_frees_slot(gpt):
+    srv = serving.Server(gpt, max_slots=1, prefill_buckets=(8,)).start()
+    try:
+        with faults.inject("serving.step@*:delay:0.02"):
+            fut = srv.submit(_prompt(11, 4), max_new_tokens=50,
+                             timeout=120)
+            deadline = time.monotonic() + 30
+            while srv.engine.active == 0:   # wait until it holds a slot
+                assert time.monotonic() < deadline
+                time.sleep(0.005)
+            fut.cancel()
+            with pytest.raises(RequestCancelled):
+                fut.result(120)
+        # the slot is free again and serves the next request
+        p = _prompt(12, 4)
+        out = srv.generate(p, max_new_tokens=2, timeout=120)
+        np.testing.assert_array_equal(out, _ref_greedy(gpt, p, 2))
+    finally:
+        srv.shutdown(drain=True)
+
+
+def test_graceful_drain_completes_all_pending(gpt):
+    srv = serving.Server(gpt, max_slots=2, prefill_buckets=(8,)).start()
+    prompts = [_prompt(20 + i, 4) for i in range(5)]
+    futs = [srv.submit(p, max_new_tokens=2, timeout=120) for p in prompts]
+    srv.shutdown(drain=True)        # blocks until queue + slots drain
+    for p, f in zip(prompts, futs):
+        np.testing.assert_array_equal(f.result(1), _ref_greedy(gpt, p, 2))
+    with pytest.raises(ServerClosedError):
+        srv.submit(prompts[0], max_new_tokens=2)
+
+
+def test_non_drain_shutdown_sheds_and_evicts(gpt):
+    srv = serving.Server(gpt, max_slots=1, prefill_buckets=(8,)).start()
+    with faults.inject("serving.step@*:delay:0.05"):
+        futs = [srv.submit(_prompt(30 + i, 4), max_new_tokens=50,
+                           timeout=120) for i in range(3)]
+        deadline = time.monotonic() + 30
+        while srv.engine.active == 0:
+            assert time.monotonic() < deadline
+            time.sleep(0.005)
+        srv.shutdown(drain=False)
+    for f in futs:
+        with pytest.raises(ServingError):   # evicted or shed, never hung
+            f.result(5)
+
+
+# ---------------------------------------------------------------------------
+# metrics + percentiles + trace integration
+# ---------------------------------------------------------------------------
+
+
+def test_metrics_snapshot_after_traffic(server):
+    snap = server.snapshot()
+    c = snap["counters"]
+    assert c["completed"] >= 6
+    assert c["accepted"] >= c["completed"]
+    assert c["tokens_out"] >= 6
+    assert 0 < snap["batch_occupancy"]["avg"] <= 1.0
+    assert snap["qps"] > 0
+    lat = snap["latency_s"]["e2e"]
+    assert lat["p50"] <= lat["p95"] <= lat["p99"] <= lat["max"]
+    # JSON-exportable end to end
+    assert json.loads(server.metrics_json())["counters"] == c
+
+
+def test_percentile_linear_interpolation_exact():
+    samples = [10.0, 20.0, 30.0, 40.0]
+    assert serving.percentile(samples, 0) == 10.0
+    assert serving.percentile(samples, 50) == 25.0
+    assert serving.percentile(samples, 95) == pytest.approx(38.5)
+    assert serving.percentile(samples, 100) == 40.0
+    with pytest.raises(ValueError):
+        serving.percentile(samples, 101)
+    with pytest.raises(ValueError):
+        serving.percentile([], 50)
+
+
+def test_serving_spans_land_in_chrome_trace(server, tmp_path):
+    names = {e["name"] for e in profiler.events()}
+    assert {"serving.step", "serving.prefill"} <= names
+    path = profiler.export_chrome_tracing(str(tmp_path / "trace.json"))
+    with open(path) as f:
+        trace = json.load(f)
+    assert any(ev["name"] == "serving.step" and ev["cat"] == "serving"
+               for ev in trace["traceEvents"])
+    # the percentile helper reads the same spans
+    p = profiler.percentiles("serving.step", (50, 99))
+    assert 0 < p[50] <= p[99]
+
+
+# ---------------------------------------------------------------------------
+# predictor satellites: unfilled handles, pool bounds
+# ---------------------------------------------------------------------------
+
+
+def _export_linear(tmp_path):
+    from paddle_tpu.jit import InputSpec
+    import paddle_tpu.nn as nn
+
+    paddle.seed(5)
+    model = nn.Sequential(nn.Linear(8, 4))
+    model.eval()
+    prefix = str(tmp_path / "served")
+    paddle.jit.save(model, prefix,
+                    input_spec=[InputSpec([4, 8], "float32")])
+    return prefix
+
+
+def test_predictor_unfilled_handle_raises(tmp_path):
+    prefix = _export_linear(tmp_path)
+    pred = paddle.inference.create_predictor(
+        paddle.inference.Config(prefix))
+    with pytest.raises(ValueError, match="input_0"):
+        pred.run()    # nothing filled: must name the handle, not misalign
+    h = pred.get_input_handle("input_0")
+    h.copy_from_cpu(np.zeros((4, 8), np.float32))
+    assert pred.run()
+
+
+def test_predictor_pool_retrieve_bounds(tmp_path):
+    prefix = _export_linear(tmp_path)
+    pool = paddle.inference.PredictorPool(
+        paddle.inference.Config(prefix), 2)
+    assert pool.retrieve(1) is not None
+    with pytest.raises(IndexError, match="valid indices"):
+        pool.retrieve(2)
+    with pytest.raises(IndexError):
+        pool.retrieve(-1)
+
+
+# ---------------------------------------------------------------------------
+# bench smoke + optional http front
+# ---------------------------------------------------------------------------
+
+
+def test_bench_serving_smoke():
+    """--steps 2 dry run of the closed-loop benchmark emits the
+    BENCH_SERVING record."""
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "bench_serving.py"), "--steps", "2",
+         "--clients", "1,2", "--max-new", "2", "--prompt-len", "4",
+         "--hidden", "16", "--layers", "1", "--heads", "2",
+         "--vocab", "31", "--max-seq-len", "32"],
+        capture_output=True, text=True, timeout=420,
+        env={**__import__("os").environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    final = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert final["bench"] == "BENCH_SERVING"
+    assert len(final["levels"]) == 2
+    for row in final["levels"]:
+        assert row["errors"] == 0
+        assert row["qps"] > 0 and row["p99_ms"] > 0
+
+
+def test_http_front_door(gpt):
+    """Bonus stdlib front door: generate + metrics + status mapping."""
+    import urllib.error
+    import urllib.request
+
+    srv = serving.Server(gpt, max_slots=2, prefill_buckets=(8,)).start()
+    try:
+        try:
+            httpd = serving.http_front(srv, port=0)
+        except OSError as e:
+            pytest.skip(f"cannot bind loopback: {e}")
+        port = httpd.server_address[1]
+        p = _prompt(40, 4)
+        body = json.dumps({"prompt": p.tolist(),
+                           "max_new_tokens": 3}).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/generate", data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            out = json.loads(resp.read())["ids"]
+        np.testing.assert_array_equal(out, _ref_greedy(gpt, p, 3))
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=30) as resp:
+            snap = json.loads(resp.read())
+        assert snap["counters"]["completed"] >= 1
+        # length validation maps to a 4xx, not a hang
+        bad = json.dumps({"prompt": list(range(60)),
+                          "max_new_tokens": 30}).encode()
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(urllib.request.Request(
+                f"http://127.0.0.1:{port}/v1/generate", data=bad,
+                headers={"Content-Type": "application/json"}),
+                timeout=30)
+        assert ei.value.code == 400
+        httpd.shutdown()
+    finally:
+        srv.shutdown(drain=True)
