@@ -113,6 +113,7 @@ from . import text  # noqa: F401,E402
 from . import rec  # noqa: F401,E402
 from . import inference  # noqa: F401,E402
 from . import serving  # noqa: F401,E402
+from . import observe  # noqa: F401,E402
 from . import quantization  # noqa: F401,E402
 from . import utils  # noqa: F401,E402
 from .framework.flags import get_flags, set_flags  # noqa: F401,E402

@@ -44,6 +44,7 @@ import numpy as np
 from ..framework import faults as _faults
 from ..framework import monitor as _monitor
 from ..framework.errors import retry_with_backoff
+from ..observe import phase as _phase
 
 MANIFEST_NAME = "paddle_manifest.json"
 META_NAME = "paddle_meta.json"
@@ -198,12 +199,13 @@ def load_state(path, template, *, shardings=None, verify=None):
     from ..framework import flags as _flags
 
     path = os.path.abspath(path)
-    target = _abstract_like(template, shardings)
-    restored = _checkpointer().restore(path, target)
-    if verify is None:
-        verify = _flags.flag("FLAGS_ckpt_verify_checksums")
-    if verify:
-        verify_manifest(path, restored)
+    with _phase("checkpoint-restore", cat="checkpoint"):
+        target = _abstract_like(template, shardings)
+        restored = _checkpointer().restore(path, target)
+        if verify is None:
+            verify = _flags.flag("FLAGS_ckpt_verify_checksums")
+        if verify:
+            verify_manifest(path, restored)
     return restored
 
 
@@ -393,8 +395,9 @@ class CheckpointManager:
         meta = dict(metadata or {})
         meta.setdefault("step", int(step))
         meta.update(_rng_metadata())
-        save_state(self._path(step), state, metadata=meta)
-        self._gc()
+        with _phase("checkpoint-write", cat="checkpoint"):
+            save_state(self._path(step), state, metadata=meta)
+            self._gc()
 
     def save_engine(self, step, engine):
         """Numbered full-fidelity engine.Engine snapshot."""
@@ -431,8 +434,9 @@ class CheckpointManager:
         """Numbered save through an external writer (e.g.
         save_train_state): writer_fn(path) persists, then retention
         applies — keeps the numbering+gc contract in one place."""
-        writer_fn(self._path(step))
-        self._gc()
+        with _phase("checkpoint-write", cat="checkpoint"):
+            writer_fn(self._path(step))
+            self._gc()
 
     def restore_with(self, reader_fn, *, step=None):
         """Numbered restore through an external reader, falling back to
@@ -489,8 +493,10 @@ class AsyncCheckpointManager(CheckpointManager):
         # device->host copy on the caller's (step) thread: the only part
         # that must observe live device arrays before the next step
         # mutates them (donated buffers reuse their memory)
-        return jax.tree.map(
-            lambda a: np.asarray(a) if hasattr(a, "shape") else a, state)
+        with _phase("checkpoint-snapshot", cat="checkpoint"):
+            return jax.tree.map(
+                lambda a: np.asarray(a) if hasattr(a, "shape") else a,
+                state)
 
     def save(self, step, state, *, metadata=None):
         meta = dict(metadata or {})
@@ -516,8 +522,12 @@ class AsyncCheckpointManager(CheckpointManager):
         return fut
 
     def _write(self, step, host_state, meta):
-        save_state(self._path(step), host_state, metadata=meta)
-        self._gc()
+        # background-writer time: a separate phase name so goodput
+        # accounting can report it WITHOUT charging it to the step
+        # thread's denominator (it overlaps training)
+        with _phase("checkpoint-write-async", cat="checkpoint"):
+            save_state(self._path(step), host_state, metadata=meta)
+            self._gc()
 
     def _raise_failed(self):
         done = [f for f in self._pending if f.done()]

@@ -258,8 +258,11 @@ class PipelineEngine:
         }
 
         def step_fn(rows, shared, opt_state, bufs, x, y, lr, key):
+            from .. import observe as _observe
             from ..ops.fused_ops import gspmd_tracing
 
+            _observe.record_compile(
+                "pp.train_step", signature=_observe.signature_of(x, y))
             with gspmd_tracing():
                 def loss_of(rows, shared):
                     losses = run(rows, (shared, bufs), x, extra=y,
@@ -348,8 +351,11 @@ class PipelineEngine:
         metas = opt.param_metas_for(self.params, layer.state_dict())
 
         def step_fn(params, opt_state, buffers, x, y, lr, key):
+            from .. import observe as _observe
             from ..ops.fused_ops import gspmd_tracing
 
+            _observe.record_compile(
+                "pp.train_step", signature=_observe.signature_of(x, y))
             with gspmd_tracing():  # meshed: attention partitions via cp
                 return _step_impl(params, opt_state, buffers, x, y, lr,
                                   key)
@@ -392,22 +398,29 @@ class PipelineEngine:
         return arr.reshape((M, b // M) + arr.shape[1:])
 
     def train_batch(self, inputs, labels):
-        x = self._microbatch(inputs)
-        y = self._microbatch(labels)
-        if self._step_fn is None:
-            self._mb_protos = (
-                jax.ShapeDtypeStruct(x.shape[1:], x.dtype),
-                jax.ShapeDtypeStruct(y.shape[1:], y.dtype))
-            self._build()
-        key = _random.default_generator.next_key()
-        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
-        if self.schedule == "hetero":
-            loss, self._rows, self._shared, self._hopt = self._step_fn(
-                self._rows, self._shared, self._hopt, self.buffers,
-                x, y, lr, key)
-            return Tensor(loss)
-        loss, self.params, self.opt_state = self._step_fn(
-            self.params, self.opt_state, self.buffers, x, y, lr, key)
+        from .. import observe as _observe
+
+        with _observe.phase("host-prep"):
+            x = self._microbatch(inputs)
+            y = self._microbatch(labels)
+            compiling = self._step_fn is None
+            if compiling:
+                self._mb_protos = (
+                    jax.ShapeDtypeStruct(x.shape[1:], x.dtype),
+                    jax.ShapeDtypeStruct(y.shape[1:], y.dtype))
+                self._build()
+            key = _random.default_generator.next_key()
+            lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+        with _observe.phase("compile" if compiling else "device-step"):
+            if self.schedule == "hetero":
+                loss, self._rows, self._shared, self._hopt = \
+                    self._step_fn(
+                        self._rows, self._shared, self._hopt,
+                        self.buffers, x, y, lr, key)
+            else:
+                loss, self.params, self.opt_state = self._step_fn(
+                    self.params, self.opt_state, self.buffers,
+                    x, y, lr, key)
         return Tensor(loss)
 
     def sync_to_layer(self):

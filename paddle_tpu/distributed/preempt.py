@@ -59,6 +59,17 @@ def request(reason="signal"):
             _requested = True
             _reason = reason
             monitor.stat_add("preemptions")
+        else:
+            return
+    # black-box the last steps NOW: the grace window may not be long
+    # enough for the step loop's checkpoint, but this dump is cheap
+    try:
+        from .. import observe
+
+        observe.flight.note("preemption", reason=reason)
+        observe.flight.dump(f"preempt:{reason}")
+    except Exception:  # never let telemetry break the drain path
+        pass
 
 
 def _handler(signum, frame):

@@ -18,6 +18,7 @@ matching dygraph semantics.
 from __future__ import annotations
 
 import contextlib
+import time
 from collections import OrderedDict
 
 import jax
@@ -267,8 +268,12 @@ BAD_STEPS_KEY = "__loss_scale_bad_steps__"
 # non-finite-step counter (int32, lives with the other step state so it
 # is donated/checkpointed like everything else)
 ANOMALY_BAD_STEPS_KEY = "__anomaly_bad_steps__"
+# reserved buffer slot for FLAGS_record_grad_norm: global gradient norm
+# (pre-clip) computed inside the compiled step, read lazily by the
+# flight recorder — no extra device pass, no per-step host sync
+GRAD_NORM_KEY = "__grad_norm__"
 _RESERVED_BUFFER_KEYS = (LOSS_SCALE_KEY, GOOD_STEPS_KEY, BAD_STEPS_KEY,
-                         ANOMALY_BAD_STEPS_KEY)
+                         ANOMALY_BAD_STEPS_KEY, GRAD_NORM_KEY)
 
 # paddle GradScaler defaults (ref python/paddle/amp/grad_scaler.py)
 DEFAULT_SCALE_CONFIG = dict(
@@ -279,7 +284,7 @@ DEFAULT_SCALE_CONFIG = dict(
 def make_train_step(layer, loss_fn, optimizer, *, grad_clip=None,
                     donate=True, mesh=None, batch_spec=None, zero_stage=0,
                     sharding_axis=None, loss_scale=None, comm_dtype=None,
-                    anomaly_guard=False):
+                    anomaly_guard=False, record_grad_norm=None):
     """Build a jitted step:
     (params, buffers, opt_state, batch, lr, key) ->
         (loss, params, buffers, opt_state)
@@ -302,6 +307,10 @@ def make_train_step(layer, loss_fn, optimizer, *, grad_clip=None,
     dtype: the step runs under O2 autocast of `comm_dtype` while params
     and optimizer state stay fp32 (master weights).
     """
+    if record_grad_norm is None:
+        from .framework.flags import flag as _flag
+
+        record_grad_norm = _flag("FLAGS_record_grad_norm")
     grad_clip = grad_clip if grad_clip is not None else \
         getattr(optimizer, "_grad_clip", None)
     # per-param decay/lr-mult metadata baked in as compile-time constants
@@ -372,6 +381,12 @@ def make_train_step(layer, loss_fn, optimizer, *, grad_clip=None,
         and not isinstance(loss_scale, dict)) else None
 
     def _step_impl(params, buffers, opt_state, batch, lr, key):
+        # trace-time: this body runs exactly once per compilation, so
+        # one recorded event == one compile of the step program
+        from . import observe as _observe
+
+        _observe.record_compile(
+            "train_step", signature=_observe.signature_of(batch))
         if dynamic_scale:
             scale = buffers[LOSS_SCALE_KEY]
             good = buffers[GOOD_STEPS_KEY]
@@ -409,6 +424,12 @@ def make_train_step(layer, loss_fn, optimizer, *, grad_clip=None,
             grads_finite = finite if loss_scale is not None \
                 else _all_finite(grads)
             guard_ok = grads_finite & jnp.isfinite(loss)
+        if record_grad_norm:
+            # global l2 norm of the RAW grads (post-unscale, pre-
+            # decay/clip) — the number a clipper would have seen
+            gnorm = jnp.sqrt(sum(
+                jnp.sum(jnp.square(g.astype(jnp.float32)))
+                for g in jax.tree.leaves(grads)))
         if grad_constraint is not None:
             grads = grad_constraint(grads)
         metas = optimizer.param_metas_for(params, _sd)
@@ -442,6 +463,12 @@ def make_train_step(layer, loss_fn, optimizer, *, grad_clip=None,
             new_buffers = dict(gpick(new_buffers, model_buffers))
             new_buffers[ANOMALY_BAD_STEPS_KEY] = jnp.where(
                 guard_ok, 0, anomaly_prev + 1).astype(jnp.int32)
+        if record_grad_norm:
+            # written AFTER the guard's where()-select over the model
+            # buffers so the recorded norm is the step's actual raw
+            # norm even when the update itself was skipped
+            new_buffers = dict(new_buffers)
+            new_buffers[GRAD_NORM_KEY] = gnorm.astype(jnp.float32)
         if dynamic_scale:
             good_next = jnp.where(finite, good + 1, 0)
             bad_next = jnp.where(finite, 0, bad + 1)
@@ -482,6 +509,8 @@ def make_train_step(layer, loss_fn, optimizer, *, grad_clip=None,
             buf_sh[BAD_STEPS_KEY] = NamedSharding(mesh, P())
         if anomaly_guard:
             buf_sh[ANOMALY_BAD_STEPS_KEY] = NamedSharding(mesh, P())
+        if record_grad_norm:
+            buf_sh[GRAD_NORM_KEY] = NamedSharding(mesh, P())
         opt0 = {k: optimizer._init_state(v) for k, v in params0.items()}
         o_sh = {k: jax.tree.map(lambda a, kk=k: opt_sh(kk, a), st)
                 for k, st in opt0.items()}
@@ -557,6 +586,15 @@ class Engine:
         if anomaly_guard:
             self.state.buffers[ANOMALY_BAD_STEPS_KEY] = \
                 jnp.asarray(0, jnp.int32)
+        # FLAGS_record_grad_norm is latched at construction: the buffer
+        # tree (and so the compiled step's signature) must not change
+        # mid-run, or every later step would retrace
+        from .framework.flags import flag as _flag
+
+        self._record_grad_norm = _flag("FLAGS_record_grad_norm")
+        if self._record_grad_norm:
+            self.state.buffers[GRAD_NORM_KEY] = jnp.asarray(0.0,
+                                                            jnp.float32)
         self._step_fn = None
         self._offload_sh = None
         self._grad_clip = grad_clip
@@ -564,6 +602,7 @@ class Engine:
         self._mem_analysis = None
         self._batch_sig = None
         self._ckpt_manager = None
+        self._last_batch = None
 
     def _build(self):
         self._step_fn = make_train_step(
@@ -571,7 +610,8 @@ class Engine:
             grad_clip=self._grad_clip, mesh=self.mesh,
             batch_spec=self.batch_spec, zero_stage=self.zero_stage,
             sharding_axis=self.sharding_axis, loss_scale=self.loss_scale,
-            comm_dtype=self.comm_dtype, anomaly_guard=self.anomaly_guard)
+            comm_dtype=self.comm_dtype, anomaly_guard=self.anomaly_guard,
+            record_grad_norm=self._record_grad_norm)
         self._offload_sh = None
         if self.offload and self._step_fn._state_shardings is not None:
             # optimizer-state offload (ref sharding/offload_helper.py):
@@ -595,25 +635,35 @@ class Engine:
             for t in ts)
 
     def train_batch(self, inputs, labels=()):
+        from . import observe as _observe
+
+        t_step0 = time.perf_counter()
         if self._step_fn is None:
             self._build()
-        if not isinstance(inputs, (list, tuple)):
-            inputs = (inputs,)
-        if not isinstance(labels, (list, tuple)):
-            labels = (labels,)
-        batch = {"inputs": self._arrs(inputs), "labels": self._arrs(labels)}
-        from .framework import faults as _faults
+        with _observe.phase("host-prep"):
+            if not isinstance(inputs, (list, tuple)):
+                inputs = (inputs,)
+            if not isinstance(labels, (list, tuple)):
+                labels = (labels,)
+            # stashed (host-side references) so attribute_step can
+            # replay the live step shape under an xplane capture
+            self._last_batch = (inputs, labels)
+            batch = {"inputs": self._arrs(inputs),
+                     "labels": self._arrs(labels)}
+            from .framework import faults as _faults
 
-        # fault-injection point: a scheduled 'nan' action poisons the
-        # HOST batch (in-graph effect on loss/grads, no recompilation) —
-        # the deterministic way to exercise the anomaly guard
-        batch = _faults.fault_point("train.batch", batch)
-        key = _random.default_generator.next_key()
-        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+            # fault-injection point: a scheduled 'nan' action poisons
+            # the HOST batch (in-graph effect on loss/grads, no
+            # recompilation) — the deterministic way to exercise the
+            # anomaly guard
+            batch = _faults.fault_point("train.batch", batch)
+            key = _random.default_generator.next_key()
+            lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         opt_state = self.state.opt_state
         if self._offload_sh is not None:
             dev_sh, host_sh = self._offload_sh
-            opt_state = jax.device_put(opt_state, dev_sh)
+            with _observe.phase("h2d"):
+                opt_state = jax.device_put(opt_state, dev_sh)
         # cheap per-step signature: plain tuple comprehension over the
         # two known leaf tuples instead of a jax.tree.map traversal
         # (tree.map rebuilds registry nodes + a dict every step; this is
@@ -622,7 +672,9 @@ class Engine:
             tuple((a.shape, a.dtype.name) for a in batch["inputs"]),
             tuple((a.shape, a.dtype.name) for a in batch["labels"]),
         )
-        if self._step_protos is None or batch_sig != self._batch_sig:
+        compiling = (self._step_protos is None
+                     or batch_sig != self._batch_sig)
+        if compiling:
             # a new batch shape means a new compiled program: refresh
             # the protos so memory_analysis() reports the live program
             self._batch_sig = batch_sig
@@ -631,11 +683,19 @@ class Engine:
                 lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
                 (self.state.params, self.state.buffers, opt_state,
                  batch, lr, key))
-        loss, self.state.params, self.state.buffers, new_opt = \
-            self._step_fn(self.state.params, self.state.buffers,
-                          opt_state, batch, lr, key)
+        t_fn0 = time.perf_counter()
+        with _observe.phase("compile" if compiling else "device-step"):
+            loss, self.state.params, self.state.buffers, new_opt = \
+                self._step_fn(self.state.params, self.state.buffers,
+                              opt_state, batch, lr, key)
+        if compiling:
+            # the step body's trace-time record_compile logged the
+            # event; backfill how long trace+compile+first-dispatch took
+            _observe.annotate("train_step",
+                              wall_s=time.perf_counter() - t_fn0)
         if self._offload_sh is not None:
-            new_opt = jax.device_put(new_opt, self._offload_sh[1])
+            with _observe.phase("h2d"):
+                new_opt = jax.device_put(new_opt, self._offload_sh[1])
         self.state.opt_state = new_opt
         self.state.step += 1
         if self.anomaly_guard:
@@ -648,12 +708,70 @@ class Engine:
 
             interval = _flags.flag("FLAGS_anomaly_check_interval")
             if interval <= 1 or self.state.step % interval == 0:
-                self._check_anomaly()
+                with _observe.phase("anomaly-readback"):
+                    self._check_anomaly()
+        self._flight_record(loss, compiling,
+                            time.perf_counter() - t_step0)
         from . import profiler as _profiler
 
         if _profiler.is_op_profiling_enabled():
             _profiler.record_device_memory("train_batch")
         return Tensor(loss)
+
+    def _flight_record(self, loss, compiling, step_s):
+        """One flight-recorder entry per step. Loss / grad-norm /
+        anomaly counter stay as device arrays (no host sync here); the
+        recorder materializes them only when a black box is dumped."""
+        from . import observe as _observe
+        from .framework import flags as _flags
+
+        fields = {"loss": loss, "step_ms": step_s * 1e3,
+                  "compiled": compiling}
+        if self._record_grad_norm:
+            fields["grad_norm"] = self.state.buffers[GRAD_NORM_KEY]
+        if self.anomaly_guard:
+            fields["anomaly_bad_steps"] = \
+                self.state.buffers[ANOMALY_BAD_STEPS_KEY]
+        if _flags.flag("FLAGS_flight_record_memory"):
+            from . import device as _device
+
+            try:
+                fields["bytes_in_use"] = \
+                    _device.memory_stats()["bytes_in_use"]
+            except Exception:
+                pass
+        _observe.flight.record_step(self.state.step, **fields)
+
+    def attribute_step(self, logdir=None, steps=1, top=10):
+        """Where does the device time of a training step go?  Captures
+        an xplane trace of `steps` replays of the LAST train_batch shape
+        and classifies device time into matmul / attention / collective
+        / elementwise / other buckets (observe.attribute) — the
+        measurement ROADMAP item 4's overlap work starts from.
+
+        NOTE: state is donated through the compiled step, so the traced
+        steps are REAL steps — training advances by `steps`.  Returns
+        the attribution report dict (buckets, fractions, total_us,
+        top_ops); the raw capture stays under `logdir` for xprof."""
+        if self._last_batch is None:
+            raise RuntimeError("run train_batch() once first")
+        import tempfile
+
+        from . import observe as _observe, profiler as _profiler
+
+        if logdir is None:
+            logdir = tempfile.mkdtemp(prefix="paddle-attrib-")
+        inputs, labels = self._last_batch
+        _profiler.start_trace(logdir)
+        try:
+            for _ in range(steps):
+                self.train_batch(inputs, labels)
+            # drain async dispatch so every step's device work lands
+            # inside the capture window
+            jax.block_until_ready(self.state.params)
+        finally:
+            _profiler.stop_trace()
+        return _observe.attribute(logdir, top=top)
 
     def memory_analysis(self) -> dict:
         """MEASURED per-step device memory of the compiled train step
@@ -667,8 +785,13 @@ class Engine:
         if self._step_fn is None or self._step_protos is None:
             raise RuntimeError("run train_batch() once first")
         if self._mem_analysis is None:
-            ma = self._step_fn.lower(*self._step_protos) \
-                .compile().memory_analysis()
+            from . import observe as _observe
+
+            # deliberate re-lowering of the SAME program: keep it out
+            # of the compile-event registry (and any no_retrace guard)
+            with _observe.retrace.suppress():
+                ma = self._step_fn.lower(*self._step_protos) \
+                    .compile().memory_analysis()
             peak = getattr(ma, "peak_memory_in_bytes", 0) or (
                 ma.argument_size_in_bytes + ma.temp_size_in_bytes
                 + ma.output_size_in_bytes - ma.alias_size_in_bytes)
@@ -687,6 +810,9 @@ class Engine:
 
             monitor.stat_max("device_mem_step_peak_bytes",
                              self._mem_analysis["peak"])
+            # backfill the compile registry so a retrace audit shows
+            # peak memory next to each program's signature
+            _observe.annotate("train_step", peak_bytes=peak)
         return dict(self._mem_analysis)
 
     def attach_checkpoint_manager(self, manager):
@@ -720,8 +846,14 @@ class Engine:
                 "checkpoint.train_epoch_range")
         import warnings
 
+        from . import observe as _observe
         from .distributed import checkpoint as _ckpt
 
+        # rollback destroys the live (anomalous) state — preserve the
+        # black box first so the post-mortem still has the bad steps
+        _observe.flight.note("anomaly_rollback", bad_steps=bad,
+                             engine_step=self.state.step)
+        _observe.flight.dump("anomaly-rollback")
         self._ckpt_manager.wait_until_finished()
         step, _ = self._ckpt_manager.restore_with(
             lambda p: _ckpt.load_train_state(p, self))

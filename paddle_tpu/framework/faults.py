@@ -226,6 +226,17 @@ def fault_point(site, payload=None):
         matched = [s for s in _specs if s.matches(site, hit)]
     for spec in matched:
         monitor.stat_add(f"faults.{site}")
+        try:  # black-box the firing (lazy import: faults must stay leaf)
+            from .. import observe
+
+            observe.flight.note("fault", site=site, hit=hit,
+                                action=spec.action)
+            if spec.action == "crash":
+                # last chance to persist the ring: os._exit skips every
+                # atexit/finally a normal unwind would run
+                observe.flight.dump(f"fault-crash:{site}")
+        except Exception:
+            pass
         if spec.action == "crash":
             os._exit(137)
         elif spec.action == "raise":

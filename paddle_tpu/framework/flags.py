@@ -83,6 +83,23 @@ _DEFS = {
         "serving: comma-separated padded prefill-length ladder — each "
         "rung compiles exactly once; prompts pad up to the next rung "
         "(max_seq_len is always the top rung)"),
+    "FLAGS_flight_recorder_capacity": (
+        256, int,
+        "observe: ring-buffer size of the always-on flight recorder "
+        "(last N per-step records kept for the crash black box)"),
+    "FLAGS_flight_recorder_dir": (
+        "", str,
+        "observe: directory the flight recorder dumps its JSON black "
+        "box into on crash/preemption/SIGTERM (empty = system tempdir)"),
+    "FLAGS_record_grad_norm": (
+        False, bool,
+        "observe: have the compiled train step also return the global "
+        "gradient norm (pre-clip) via a reserved engine buffer so the "
+        "flight recorder can log it without an extra device pass"),
+    "FLAGS_flight_record_memory": (
+        True, bool,
+        "observe: include device bytes_in_use in each flight-recorder "
+        "step record (one host allocator-stats call per step)"),
 }
 
 _values: dict = {}

@@ -27,9 +27,22 @@ def stat_get(name: str) -> int:
 
 
 def stat_max(name: str, value: int):
-    """Record a high-watermark."""
+    """Record a high-watermark. A missing key is seeded with the
+    OBSERVED value (not 0) so the first negative or sub-zero watermark
+    is kept rather than silently clamped."""
+    v = int(value)
     with _lock:
-        _stats[name] = max(_stats.get(name, 0), int(value))
+        cur = _stats.get(name)
+        _stats[name] = v if cur is None else max(cur, v)
+
+
+def stat_min(name: str, value: int):
+    """Record a floor-watermark (the stat_max mirror; seeded with the
+    observed value on first sight)."""
+    v = int(value)
+    with _lock:
+        cur = _stats.get(name)
+        _stats[name] = v if cur is None else min(cur, v)
 
 
 def stats(prefix: str = None) -> dict:

@@ -1,0 +1,204 @@
+"""Unified telemetry export: one snapshot, two formats.
+
+Folds the four registries that grew up independently — the
+framework.monitor counters, per-server ServingMetrics, the step
+timeline's phase aggregates, and the retrace audit — into a single
+labeled view, exported either as a JSON snapshot (`snapshot()` /
+`dump()`) or as Prometheus text exposition (`prometheus_text()`, what
+the serving front serves on `GET /metrics` with an appropriate Accept
+header).
+
+Goodput accounting lives here because it is a pure fold over the
+timeline: productive device time over total accounted wall time, with
+checkpoint/restore/compile attributed and background (overlapped)
+checkpoint writes excluded from the denominator."""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import time
+
+from . import recorder, retrace
+from .timeline import timeline as _timeline
+
+__all__ = ["goodput", "snapshot", "dump", "prometheus_text"]
+
+
+# phase name -> goodput category; phases not listed count as "other"
+_GOODPUT_CATS = {
+    "device-step": "productive",
+    "compile": "compile",
+    "checkpoint-snapshot": "checkpoint",
+    "checkpoint-write": "checkpoint",
+    "checkpoint-restore": "restore",
+    "host-prep": "host",
+    "h2d": "host",
+    "sample": "host",
+    "anomaly-readback": "host",
+}
+# background writer time overlaps the step thread: report it, but keep
+# it out of the goodput denominator
+_OVERLAPPED = {"checkpoint-write-async"}
+
+
+def goodput(aggregates=None):
+    """Goodput fractions from the timeline's phase aggregates."""
+    if aggregates is None:
+        aggregates = _timeline.aggregates()
+    cats = {"productive": 0.0, "compile": 0.0, "checkpoint": 0.0,
+            "restore": 0.0, "host": 0.0, "other": 0.0}
+    overlapped = 0.0
+    for name, agg in aggregates.items():
+        if name in _OVERLAPPED:
+            overlapped += agg["total_s"]
+            continue
+        cats[_GOODPUT_CATS.get(name, "other")] += agg["total_s"]
+    total = sum(cats.values())
+    return {
+        "categories_s": cats,
+        "overlapped_s": overlapped,
+        "accounted_s": total,
+        "goodput": cats["productive"] / total if total else 0.0,
+    }
+
+
+def snapshot(serving=None):
+    """One JSON-able dict across every registry."""
+    from ..framework import monitor
+
+    aggs = _timeline.aggregates()
+    out = {
+        "time": time.time(),
+        "pid": os.getpid(),
+        "monitor": monitor.stats(),
+        "timeline": aggs,
+        "goodput": goodput(aggs),
+        "compiles": retrace.compile_events(),
+        "flight": {
+            "last": recorder.flight.snapshot()["records"][-1:],
+            "dumps": recorder.flight.dumps(),
+        },
+    }
+    if serving is not None:
+        out["serving"] = serving.snapshot()
+    return out
+
+
+def dump(path, serving=None):
+    """Write `snapshot()` to a JSON file; returns the path."""
+    snap = snapshot(serving=serving)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(snap, f, indent=1, default=repr)
+    os.replace(tmp, path)
+    return path
+
+
+# ---------------------------------------------------------------------------
+# Prometheus text exposition
+# ---------------------------------------------------------------------------
+
+_NAME_OK = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _pname(name):
+    """Sanitize into a legal Prometheus metric name."""
+    n = _NAME_OK.sub("_", name)
+    if not n or not (n[0].isalpha() or n[0] in "_:"):
+        n = "_" + n
+    return n
+
+
+def _fmt(v):
+    if isinstance(v, bool):
+        return "1" if v else "0"
+    if isinstance(v, float):
+        return repr(v)
+    return str(v)
+
+
+class _Lines:
+    def __init__(self):
+        self.out = []
+        self._typed = set()
+
+    def add(self, name, value, mtype="gauge", labels=None, help_=None):
+        name = _pname(name)
+        if name not in self._typed:
+            if help_:
+                self.out.append(f"# HELP {name} {help_}")
+            self.out.append(f"# TYPE {name} {mtype}")
+            self._typed.add(name)
+        lab = ""
+        if labels:
+            parts = ",".join(
+                f'{_pname(k)}="{str(v).replace(chr(92), chr(92) * 2).replace(chr(34), chr(92) + chr(34))}"'
+                for k, v in labels.items())
+            lab = "{" + parts + "}"
+        self.out.append(f"{name}{lab} {_fmt(value)}")
+
+    def text(self):
+        return "\n".join(self.out) + "\n"
+
+
+def prometheus_text(serving=None, queue_depth=None):
+    """Prometheus/OpenMetrics text across monitor + timeline + goodput
+    (+ one server's ServingMetrics when handling its /metrics)."""
+    from ..framework import monitor
+
+    L = _Lines()
+
+    for name, value in sorted(monitor.stats().items()):
+        if not isinstance(value, (int, float)):
+            continue
+        L.add(f"paddle_{name}", value, mtype="counter",
+              help_="framework.monitor stat")
+
+    aggs = _timeline.aggregates()
+    for phase, agg in sorted(aggs.items()):
+        L.add("paddle_phase_seconds_total", agg["total_s"], mtype="counter",
+              labels={"phase": phase}, help_="step timeline phase time")
+        L.add("paddle_phase_calls_total", agg["calls"], mtype="counter",
+              labels={"phase": phase})
+        L.add("paddle_phase_max_seconds", agg["max_s"],
+              labels={"phase": phase})
+
+    gp = goodput(aggs)
+    for cat, secs in sorted(gp["categories_s"].items()):
+        L.add("paddle_goodput_seconds_total", secs, mtype="counter",
+              labels={"category": cat},
+              help_="wall time by goodput category")
+    L.add("paddle_goodput_seconds_total", gp["overlapped_s"],
+          mtype="counter", labels={"category": "overlapped"})
+    L.add("paddle_goodput_ratio", gp["goodput"],
+          help_="productive fraction of accounted wall time")
+
+    L.add("paddle_compile_events_total", len(retrace.compile_events()),
+          mtype="counter", help_="jit compilations recorded")
+
+    if serving is not None:
+        snap = serving.snapshot(queue_depth=queue_depth)
+        for k, v in sorted(snap.get("counters", {}).items()):
+            L.add(f"paddle_serving_{k}_total", v, mtype="counter",
+                  help_="serving counter")
+        L.add("paddle_serving_uptime_seconds", snap["uptime_s"],
+              mtype="counter")
+        L.add("paddle_serving_qps", snap["qps"])
+        L.add("paddle_serving_tokens_per_second", snap["tokens_per_s"])
+        occ = snap["batch_occupancy"]
+        L.add("paddle_serving_batch_occupancy", occ["avg"],
+              labels={"stat": "avg"},
+              help_="decode slot utilisation (active/capacity)")
+        L.add("paddle_serving_batch_occupancy", occ["max"],
+              labels={"stat": "max"})
+        for kind, stats in sorted(snap.get("latency_s", {}).items()):
+            for q in ("p50", "p95", "p99", "max"):
+                L.add("paddle_serving_latency_seconds", stats[q],
+                      labels={"kind": kind, "quantile": q},
+                      help_="serving latency quantiles (seconds)")
+    if queue_depth is not None:
+        L.add("paddle_serving_queue_depth", queue_depth)
+
+    return L.text()

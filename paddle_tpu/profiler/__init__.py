@@ -19,6 +19,7 @@ tools/timeline.py (chrome-trace export). TPU-native mapping:
 
 from __future__ import annotations
 
+import collections
 import contextlib
 import json
 import os
@@ -33,8 +34,15 @@ __all__ = [
     "device_op_table",
 ]
 
+# rolling windows: the always-on step timeline (paddle_tpu.observe)
+# records a handful of spans per train/decode step, so an unbounded
+# list would leak over a long run — keep the newest spans only (same
+# policy as serving.metrics' latency windows)
+_MAX_EVENTS = 100_000
+_MAX_MEM_EVENTS = 10_000
+
 _lock = threading.Lock()
-_events: list[dict] = []  # {name, cat, ts, dur, tid}
+_events: collections.deque = collections.deque(maxlen=_MAX_EVENTS)
 _op_profiling = False
 _tls = threading.local()
 
@@ -82,7 +90,7 @@ class RecordEvent:
         return False
 
 
-_mem_events: list[dict] = []  # {annotation, place, bytes_in_use, ...}
+_mem_events: collections.deque = collections.deque(maxlen=_MAX_MEM_EVENTS)
 
 
 class RecordMemEvent:
@@ -234,34 +242,44 @@ def percentiles(name, ps=(50, 95, 99)):
     named `name` — {p: duration_us} with linear interpolation (numpy's
     'linear' method). The serving runtime computes its p50/p95/p99
     through this over its per-request/per-step RecordEvent spans."""
-    durs = sorted(e["dur"] for e in events() if e["name"] == name)
+    from ..utils import stats as _stats
+
+    durs = [e["dur"] for e in events() if e["name"] == name]
     if not durs:
         raise ValueError(f"no recorded events named {name!r}")
-    out = {}
-    for p in ps:
-        if not 0 <= p <= 100:
-            raise ValueError(f"percentile must be in [0, 100], got {p}")
-        rank = (len(durs) - 1) * (p / 100.0)
-        lo = int(rank)
-        hi = min(lo + 1, len(durs) - 1)
-        out[p] = durs[lo] + (durs[hi] - durs[lo]) * (rank - lo)
-    return out
+    return _stats.percentiles(durs, ps)
 
 
 def export_chrome_tracing(path):
     """Write host events as a chrome://tracing JSON file
-    (ref tools/timeline.py)."""
-    trace = {
-        "traceEvents": [
-            {
-                "name": e["name"], "cat": e["cat"], "ph": "X",
-                "ts": e["ts"], "dur": e["dur"], "pid": os.getpid(),
-                "tid": e["tid"],
-            }
-            for e in events()
-        ],
-        "displayTimeUnit": "ms",
-    }
+    (ref tools/timeline.py).
+
+    Spans are sorted by start time and carry their recorded nesting
+    `depth` (spans land in `_events` at EXIT, so inner spans precede
+    their parents in recording order — the sort restores enclosure
+    order so chrome stacks nested spans correctly). Memory events are
+    emitted as counter (``ph:"C"``) rows so the measured
+    bytes-in-use/peak series renders as a track under the spans."""
+    pid = os.getpid()
+    trace_events = [
+        {
+            "name": e["name"], "cat": e["cat"], "ph": "X",
+            "ts": e["ts"], "dur": e["dur"], "pid": pid,
+            "tid": e["tid"], "args": {"depth": e.get("depth", 0)},
+        }
+        for e in sorted(events(), key=lambda e: (e["tid"], e["ts"]))
+    ]
+    for m in mem_events():
+        args = {"bytes_in_use": m["bytes"]}
+        if "host_bytes_in_use" in m:
+            args["host_bytes_in_use"] = m["host_bytes_in_use"]
+        if m.get("peak_bytes_in_use", -1) >= 0:
+            args["peak_bytes_in_use"] = m["peak_bytes_in_use"]
+        trace_events.append({
+            "name": f"memory ({m['place']})", "cat": "memory", "ph": "C",
+            "ts": m["ts"], "pid": pid, "tid": 0, "args": args,
+        })
+    trace = {"traceEvents": trace_events, "displayTimeUnit": "ms"}
     os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
     with open(path, "w") as f:
         json.dump(trace, f)

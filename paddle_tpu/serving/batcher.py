@@ -90,6 +90,10 @@ class DynamicBatcher:
                 # trace-time side effect: bumps once per compilation
                 self._compiles[x.shape[0]] = \
                     self._compiles.get(x.shape[0], 0) + 1
+                from .. import observe
+
+                observe.record_compile(
+                    "serving.batch", signature=observe.signature_of(x))
                 return fn(x)
 
             self._run = jax.jit(traced)

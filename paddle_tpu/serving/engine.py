@@ -32,7 +32,7 @@ import time
 
 import numpy as np
 
-from .. import profiler
+from .. import observe, profiler
 from ..core.tensor import Tensor
 from ..engine import functional_apply, state_values
 from ..framework import faults
@@ -121,6 +121,8 @@ class SlotEngine:
 
         def decode_fn(values, tok, pos, ks, vs):
             _count("decode")     # trace-time only: the compile counter
+            observe.record_compile(
+                "serving.decode", signature=observe.signature_of(tok, pos))
             caches = [(k, v, pos) for k, v in zip(ks, vs)]
 
             def run(m):
@@ -137,6 +139,8 @@ class SlotEngine:
             from jax import lax
 
             _count(("prefill", tok_pad.shape[1]))
+            observe.record_compile(
+                "serving.prefill", signature=observe.signature_of(tok_pad))
             rows = [(lax.dynamic_slice_in_dim(k, slot, 1, axis=0),
                      lax.dynamic_slice_in_dim(v, slot, 1, axis=0), 0)
                     for k, v in zip(ks, vs)]
@@ -275,6 +279,26 @@ class SlotEngine:
         now = time.monotonic()
         tok = np.zeros((self.max_slots,), np.int32)
         live = []
+        with observe.phase("sample", cat="serving"):
+            self._consume_slots(now, tok, live)
+        if not live:
+            return
+        with profiler.RecordEvent("serving.step", cat="serving"):
+            with observe.phase("device-step", cat="serving"):
+                logits, self._ks, self._vs = self._decode(
+                    self._values, jnp.asarray(tok[:, None]),
+                    jnp.asarray(self._pos), self._ks, self._vs)
+        logits = np.asarray(logits)
+        for i in live:
+            self._pos[i] += 1
+            self._slots[i].next_logits = logits[i]
+        self.metrics.inc("steps")
+        self.metrics.observe_occupancy(len(live), self.max_slots)
+
+    def _consume_slots(self, now, tok, live):
+        """Host-side half of a step: sample each slot's pending logits,
+        finish/evict slots that hit EOS/max/deadline/cancel, and stage
+        the next-token batch for the decode dispatch."""
         for i, slot in enumerate(self._slots):
             if slot is None:
                 continue
@@ -302,18 +326,6 @@ class SlotEngine:
                 continue
             tok[i] = nxt
             live.append(i)
-        if not live:
-            return
-        with profiler.RecordEvent("serving.step", cat="serving"):
-            logits, self._ks, self._vs = self._decode(
-                self._values, jnp.asarray(tok[:, None]),
-                jnp.asarray(self._pos), self._ks, self._vs)
-        logits = np.asarray(logits)
-        for i in live:
-            self._pos[i] += 1
-            self._slots[i].next_logits = logits[i]
-        self.metrics.inc("steps")
-        self.metrics.observe_occupancy(len(live), self.max_slots)
 
     # -- serve loop ---------------------------------------------------------
 

@@ -15,26 +15,13 @@ import threading
 import time
 
 from ..framework import monitor
+from ..utils.stats import percentile  # noqa: F401  (shared quantile math)
 
 __all__ = ["ServingMetrics", "percentile"]
 
 # keep at most this many samples per latency series (fifo window) so a
 # long-lived server doesn't grow without bound
 _MAX_SAMPLES = 65536
-
-
-def percentile(samples, p):
-    """Linear-interpolation percentile (numpy 'linear' method) over an
-    unsorted sequence; p in [0, 100]."""
-    if not 0 <= p <= 100:
-        raise ValueError(f"percentile must be in [0, 100], got {p}")
-    data = sorted(samples)
-    if not data:
-        raise ValueError("no samples")
-    rank = (len(data) - 1) * (p / 100.0)
-    lo = int(rank)
-    hi = min(lo + 1, len(data) - 1)
-    return data[lo] + (data[hi] - data[lo]) * (rank - lo)
 
 
 class ServingMetrics:

@@ -135,6 +135,15 @@ class Server:
     def metrics_json(self, **kw):
         return self.metrics.to_json(queue_depth=self.queue.depth, **kw)
 
+    def metrics_prometheus(self):
+        """Prometheus text exposition of this server's metrics unified
+        with the global monitor/timeline/goodput registries
+        (observe.prometheus_text)."""
+        from .. import observe
+
+        return observe.prometheus_text(serving=self.metrics,
+                                       queue_depth=self.queue.depth)
+
 
 def http_front(server: Server, host="127.0.0.1", port=0):
     """Optional stdlib front door (bonus deliverable — the in-process
@@ -159,9 +168,29 @@ def http_front(server: Server, host="127.0.0.1", port=0):
             self.end_headers()
             self.wfile.write(body)
 
+        def _reply_text(self, code, text,
+                        ctype="text/plain; version=0.0.4; charset=utf-8"):
+            body = text.encode()
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
         def do_GET(self):
-            if self.path == "/metrics":
-                self._reply(200, server.snapshot())
+            path, _, query = self.path.partition("?")
+            if path == "/metrics":
+                # content negotiation: JSON snapshot by default (the
+                # original contract — a bare GET keeps working), the
+                # Prometheus exposition when a scraper asks for it via
+                # Accept: text/plain / openmetrics or ?format=prometheus
+                accept = self.headers.get("Accept", "")
+                if ("format=prometheus" in query
+                        or "text/plain" in accept
+                        or "openmetrics" in accept):
+                    self._reply_text(200, server.metrics_prometheus())
+                else:
+                    self._reply(200, server.snapshot())
             else:
                 self._reply(404, {"error": "not found"})
 

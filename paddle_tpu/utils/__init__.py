@@ -1,3 +1,4 @@
 """paddle.utils (ref python/paddle/utils/)."""
 
 from . import cpp_extension  # noqa: F401
+from . import stats  # noqa: F401

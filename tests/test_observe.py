@@ -1,0 +1,401 @@
+"""Unified runtime telemetry (paddle_tpu.observe): step timeline +
+device-time attribution, retrace audit, flight recorder, and the
+Prometheus/JSON unified export.
+
+Tier-1 contracts certified here:
+
+- steady-state training is ONE compile: 3 engine steps under
+  `no_retrace()` record exactly one train_step compile event, and a
+  changed batch shape inside the guard raises BEFORE the donated state
+  is consumed (training continues at the old shape afterwards);
+- `Engine.attribute_step()` produces a nonzero matmul bucket on the
+  CPU backend (the xplane capture -> classification loop end to end);
+- a fault-injected crash leaves a flight-recorder dump whose last
+  record matches the step the fault fired at;
+- `prometheus_text()` is valid text exposition covering serving +
+  monitor + goodput counters, also served by the HTTP front door via
+  content negotiation (bare GET stays JSON).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import observe, serving
+from paddle_tpu.engine import GRAD_NORM_KEY, Engine
+from paddle_tpu.framework import faults, flags, monitor
+from paddle_tpu.utils import stats as ustats
+
+
+def _mk_engine(seed=5, lr=0.05, **kw):
+    paddle.seed(seed)
+    m = nn.Linear(6, 3)
+    opt = paddle.optimizer.Adam(learning_rate=lr,
+                                parameters=m.parameters())
+    return Engine(m, opt, lambda o, y: ((o - y) ** 2).mean(), **kw)
+
+
+def _batch(n=8):
+    rs = np.random.RandomState(0)
+    return (rs.randn(n, 6).astype(np.float32),
+            rs.randn(n, 3).astype(np.float32))
+
+
+@pytest.fixture(autouse=True)
+def _clean_observe_state(tmp_path):
+    """Every test starts/ends with empty observe registries, no faults,
+    and black boxes routed into the test's tmp dir."""
+    faults.reset()
+    observe.reset()
+    flags.set_flags({"FLAGS_flight_recorder_dir": str(tmp_path / "bb")})
+    yield
+    faults.reset()
+    observe.reset()
+    flags.set_flags({"FLAGS_flight_recorder_dir": "",
+                     "FLAGS_record_grad_norm": False,
+                     "FLAGS_flight_record_memory": True})
+
+
+# ---------------------------------------------------------------------------
+# retrace audit
+# ---------------------------------------------------------------------------
+
+
+def test_steady_state_training_never_retraces():
+    """THE smoke contract: 3 steps, 1 compile — and no_retrace() stays
+    quiet the whole way."""
+    eng = _mk_engine()
+    x, y = _batch()
+    with observe.no_retrace(allow=("train_step",)):
+        eng.train_batch((x,), (y,))      # first step MAY compile
+    with observe.no_retrace():           # steady state: none allowed
+        for _ in range(3):
+            eng.train_batch((x,), (y,))
+    evs = observe.compile_events("train_step")
+    assert len(evs) == 1, [e["signature"] for e in evs]
+    assert "float32[8, 6]" in evs[0]["signature"]
+    assert evs[0].get("wall_s", 0) > 0   # engine backfilled compile time
+
+
+def test_no_retrace_trips_on_shape_drift_and_state_survives():
+    eng = _mk_engine()
+    x, y = _batch()
+    eng.train_batch((x,), (y,))
+    step_before = eng.state.step
+    x2, y2 = _batch(n=4)                 # different batch shape
+    with pytest.raises(observe.RetraceError, match="train_step"):
+        with observe.no_retrace():
+            eng.train_batch((x2,), (y2,))
+    # the guard fired at TRACE time, before execution could consume the
+    # donated state: the engine keeps training at the original shape
+    assert eng.state.step == step_before
+    loss = eng.train_batch((x,), (y,))
+    assert np.isfinite(float(loss))
+    # the registry kept the aborted attempt (that's the audit trail);
+    # resuming at the original shape hits the jit cache — no third event
+    evs = observe.compile_events("train_step")
+    assert [("8, 6" in e["signature"], "4, 6" in e["signature"])
+            for e in evs] == [(True, False), (False, True)]
+
+
+def test_memory_analysis_is_not_a_retrace():
+    """Engine.memory_analysis() deliberately re-lowers the live step;
+    suppress() keeps that out of the audit (and out of any guard)."""
+    eng = _mk_engine()
+    x, y = _batch()
+    eng.train_batch((x,), (y,))
+    with observe.no_retrace():
+        ma = eng.memory_analysis()
+    assert ma["peak"] > 0
+    evs = observe.compile_events("train_step")
+    assert len(evs) == 1
+    # ...and it annotated the one real compile with the measured peak
+    assert evs[0]["peak_bytes"] == ma["peak"]
+
+
+def test_serving_compile_registry_matches_slot_engine_counts():
+    """The SlotEngine's own per-bucket counters and the global audit
+    see the same compiles (decode once, prefill once per rung used)."""
+    from paddle_tpu.nlp.transformers import GPTConfig, GPTForPretraining
+
+    paddle.seed(7)
+    gpt = GPTForPretraining(GPTConfig(
+        vocab_size=64, hidden_size=32, num_heads=2, num_layers=2,
+        max_seq_len=32, dropout=0.0, attn_dropout=0.0,
+        use_parallel=False))
+    gpt.eval()
+    eng = serving.SlotEngine(gpt, max_slots=2, prefill_buckets=(8,))
+    reqs = [eng.submit(np.arange(1, 5), max_new_tokens=3)
+            for _ in range(2)]
+    eng.start()
+    for r in reqs:
+        r.result(timeout=120)
+    eng.shutdown()
+    assert len(observe.compile_events("serving.decode")) == \
+        eng.compile_counts["decode"] == 1
+    assert len(observe.compile_events("serving.prefill")) == 1
+
+
+# ---------------------------------------------------------------------------
+# device-time attribution
+# ---------------------------------------------------------------------------
+
+
+def test_attribute_step_buckets_on_cpu(tmp_path):
+    eng = _mk_engine()
+    x, y = _batch()
+    eng.train_batch((x,), (y,))
+    step_before = eng.state.step
+    report = eng.attribute_step(logdir=str(tmp_path / "attrib"), steps=2)
+    assert eng.state.step == step_before + 2   # real steps, documented
+    assert report["total_us"] > 0
+    # a Linear train step is dominated by dots: the matmul bucket must
+    # be nonzero even on the CPU backend's xplane
+    assert report["buckets"]["matmul"] > 0
+    assert abs(sum(report["fractions"].values()) - 1.0) < 1e-6
+    assert report["top_ops"] and all(
+        o["bucket"] in observe.BUCKETS for o in report["top_ops"])
+
+
+def test_classify_op_rules():
+    assert observe.classify_op("dot.5") == "matmul"
+    assert observe.classify_op("broadcast_maximum_fusion") == "elementwise"
+    assert observe.classify_op("convert.2") == "elementwise"   # NOT conv
+    assert observe.classify_op("all-reduce.1") == "collective"
+    assert observe.classify_op("flash_attention_fwd") == "attention"
+    # runtime-framework rows are excluded entirely, not "other"
+    assert observe.classify_op("TfrtCpuExecutable::Execute") is None
+    assert observe.classify_op("PjitFunction(f)") is None
+    assert observe.classify_op("shard_args") is None
+    assert observe.classify_op("$src.py:12 fn") is None
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+
+def test_flight_dump_on_injected_fault():
+    """The acceptance crash drill, in-process: a `raise` fault at step 3
+    (the `crash` action's dump runs the same code, then os._exit) must
+    leave a black box whose last record is the last completed step."""
+    eng = _mk_engine()
+    x, y = _batch()
+    with faults.inject("train.batch@3:raise"):
+        with pytest.raises(faults.FaultError):
+            with observe.flight_guard("train-loop"):
+                for _ in range(10):
+                    eng.train_batch((x,), (y,))
+    dumps = observe.flight.dumps()
+    assert len(dumps) == 1
+    with open(dumps[0]) as f:
+        box = json.load(f)
+    # the fault fired entering step 3: steps 1 and 2 completed, and the
+    # engine agrees with the black box
+    assert eng.state.step == 2
+    assert box["records"][-1]["step"] == 2
+    assert box["reason"].startswith("train-loop:")
+    kinds = [n["kind"] for n in box["notes"]]
+    assert "fault" in kinds and "exception" in kinds
+    fault_note = next(n for n in box["notes"] if n["kind"] == "fault")
+    assert fault_note["site"] == "train.batch" and fault_note["hit"] == 3
+    # loss was kept lazy on the hot path, materialized at dump time
+    assert isinstance(box["records"][-1]["loss"], float)
+
+
+def test_flight_ring_is_bounded():
+    rec = observe.FlightRecorder(capacity=4)
+    for s in range(10):
+        rec.record_step(s, loss=float(s))
+    snap = rec.snapshot()
+    assert [r["step"] for r in snap["records"]] == [6, 7, 8, 9]
+
+
+def test_grad_norm_recorded_in_flight(tmp_path):
+    flags.set_flags({"FLAGS_record_grad_norm": True})
+    try:
+        eng = _mk_engine()
+        x, y = _batch()
+        for _ in range(2):
+            eng.train_batch((x,), (y,))
+        assert GRAD_NORM_KEY in eng.state.buffers
+        gn = float(eng.state.buffers[GRAD_NORM_KEY])
+        assert np.isfinite(gn) and gn > 0
+        p = observe.flight.dump("test")
+        with open(p) as f:
+            last = json.load(f)["records"][-1]
+        assert last["grad_norm"] == pytest.approx(gn)
+    finally:
+        flags.set_flags({"FLAGS_record_grad_norm": False})
+
+
+# ---------------------------------------------------------------------------
+# unified export
+# ---------------------------------------------------------------------------
+
+_PROM_LINE = re.compile(
+    r"^(# (HELP|TYPE) [a-zA-Z_:][a-zA-Z0-9_:]* .+"
+    r"|[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? -?[0-9].*)$")
+
+
+def test_prometheus_text_is_valid_exposition():
+    monitor.reset()   # the global registry accumulates across tests
+    eng = _mk_engine()
+    x, y = _batch()
+    for _ in range(2):
+        eng.train_batch((x,), (y,))
+    monitor.stat_add("serving.completed", 3)
+    txt = observe.prometheus_text()
+    lines = [ln for ln in txt.splitlines() if ln]
+    assert lines and txt.endswith("\n")
+    for ln in lines:
+        assert _PROM_LINE.match(ln), f"invalid exposition line: {ln!r}"
+    # monitor counters, phase timeline, and goodput are all covered
+    assert "paddle_serving_completed 3" in txt
+    assert 'paddle_phase_seconds_total{phase="device-step"}' in txt
+    assert 'paddle_goodput_seconds_total{category="productive"}' in txt
+    assert "paddle_goodput_ratio" in txt
+    assert "paddle_compile_events_total 1" in txt
+
+
+def test_goodput_accounting_with_async_checkpoint(tmp_path):
+    from paddle_tpu.distributed import checkpoint as ckpt
+
+    eng = _mk_engine()
+    x, y = _batch()
+    for _ in range(3):
+        eng.train_batch((x,), (y,))
+    mgr = ckpt.AsyncCheckpointManager(str(tmp_path / "ck"))
+    mgr.save_engine(eng.state.step, eng)
+    mgr.close()
+    gp = observe.goodput()
+    assert gp["categories_s"]["productive"] > 0
+    assert gp["categories_s"]["compile"] > 0
+    assert gp["categories_s"]["checkpoint"] > 0      # snapshot (sync)
+    assert gp["overlapped_s"] > 0                    # async write
+    # the overlapped background write never lands in the denominator
+    assert gp["accounted_s"] == pytest.approx(
+        sum(gp["categories_s"].values()))
+    assert 0 < gp["goodput"] <= 1
+
+
+def test_observe_dump_snapshot(tmp_path):
+    eng = _mk_engine()
+    x, y = _batch()
+    eng.train_batch((x,), (y,))
+    p = observe.dump(str(tmp_path / "telemetry.json"))
+    with open(p) as f:
+        snap = json.load(f)
+    for key in ("monitor", "timeline", "goodput", "compiles", "flight"):
+        assert key in snap
+    assert snap["compiles"][0]["name"] == "train_step"
+    assert "device-step" not in snap["timeline"] or \
+        snap["timeline"]["device-step"]["calls"] >= 0
+    assert snap["flight"]["last"][0]["step"] == 1
+
+
+def test_http_metrics_content_negotiation():
+    """Bare GET /metrics stays JSON (the original contract); a scraper
+    Accept header switches to the Prometheus exposition."""
+    import urllib.request
+
+    import jax.numpy as jnp
+
+    srv = serving.Server(fn=lambda x: jnp.tanh(x), mode="batch",
+                         max_batch=4).start()
+    try:
+        srv.submit(np.ones((3,), np.float32)).result(timeout=60)
+        try:
+            httpd = serving.http_front(srv, port=0)
+        except OSError as e:
+            pytest.skip(f"cannot bind loopback: {e}")
+        port = httpd.server_address[1]
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=30) as resp:
+            assert "application/json" in resp.headers["Content-Type"]
+            snap = json.loads(resp.read())
+        assert "counters" in snap
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/metrics",
+            headers={"Accept": "text/plain"})
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            assert "text/plain" in resp.headers["Content-Type"]
+            txt = resp.read().decode()
+        for ln in [ln for ln in txt.splitlines() if ln]:
+            assert _PROM_LINE.match(ln), f"invalid exposition line: {ln!r}"
+        assert "paddle_serving_queue_depth" in txt
+        assert "paddle_serving_batches_total" in txt or \
+            "paddle_serving_completed_total" in txt
+        httpd.shutdown()
+    finally:
+        srv.shutdown(drain=True)
+
+
+# ---------------------------------------------------------------------------
+# satellites: monitor watermarks + shared percentile math
+# ---------------------------------------------------------------------------
+
+
+def test_stat_max_seeds_with_observed_value():
+    """A missing key seeded with 0 used to swallow the first negative
+    watermark (e.g. a -1 'unavailable' sentinel, or a delta series)."""
+    monitor.reset()
+    monitor.stat_max("wm", -7)
+    assert monitor.stat_get("wm") == -7      # not clamped to 0
+    monitor.stat_max("wm", -9)
+    assert monitor.stat_get("wm") == -7
+    monitor.stat_max("wm", 3)
+    assert monitor.stat_get("wm") == 3
+
+
+def test_stat_min_mirror():
+    monitor.reset()
+    monitor.stat_min("floor", 5)
+    assert monitor.stat_get("floor") == 5    # seeded, not min(0, 5)
+    monitor.stat_min("floor", 9)
+    assert monitor.stat_get("floor") == 5
+    monitor.stat_min("floor", -2)
+    assert monitor.stat_get("floor") == -2
+
+
+def test_percentile_single_shared_implementation():
+    from paddle_tpu.serving import metrics as smetrics
+
+    # the serving module re-exports the ONE shared implementation
+    assert smetrics.percentile is ustats.percentile
+    from paddle_tpu import profiler
+
+    profiler.reset()
+    with profiler._lock:
+        for d in (10.0, 20.0, 30.0, 40.0):
+            profiler._events.append({"name": "s", "cat": "host",
+                                     "ts": 0.0, "dur": d, "tid": 0,
+                                     "depth": 0})
+    assert profiler.percentiles("s", (50,))[50] == \
+        ustats.percentile([10.0, 20.0, 30.0, 40.0], 50) == 25.0
+
+
+def test_percentile_matches_numpy_property():
+    """Property check against numpy's 'linear' method over random data
+    and quantiles — the two registries can't drift from the reference
+    definition."""
+    rs = np.random.RandomState(42)
+    for n in (1, 2, 7, 100):
+        data = rs.randn(n).tolist()
+        for p in (0, 3, 25, 50, 77.5, 95, 99, 100):
+            want = float(np.percentile(np.asarray(data), p))
+            assert ustats.percentile(data, p) == pytest.approx(want)
+        ps = (5, 50, 95)
+        multi = ustats.percentiles(data, ps)
+        for p in ps:
+            assert multi[p] == pytest.approx(ustats.percentile(data, p))
+    with pytest.raises(ValueError):
+        ustats.percentile([], 50)
+    with pytest.raises(ValueError):
+        ustats.percentile([1.0], 101)

@@ -125,3 +125,107 @@ def test_device_op_table_from_xplane(tmp_path):
     assert "Device op" in table
     # python source-frame spans are filtered out
     assert not any(r["name"].startswith("$") for r in rows)
+
+
+def test_chrome_trace_mem_counters_and_depth(tmp_path):
+    """Memory events export as counter (ph:"C") rows and spans carry
+    their recorded nesting depth in args, so chrome stacks them and the
+    bytes-in-use series renders as a track under the spans."""
+    profiler.reset()
+    with profiler.RecordEvent("outer"):
+        with profiler.RecordEvent("inner"):
+            time.sleep(0.001)
+    profiler.RecordMemEvent("alloc", bytes=1024, place="device",
+                            extra={"peak_bytes_in_use": 4096,
+                                   "host_bytes_in_use": 512})
+    p = profiler.export_chrome_tracing(str(tmp_path / "trace.json"))
+    with open(p) as f:
+        trace = json.load(f)["traceEvents"]
+    spans = {e["name"]: e for e in trace if e["ph"] == "X"}
+    assert spans["outer"]["args"]["depth"] == 0
+    assert spans["inner"]["args"]["depth"] == 1
+    counters = [e for e in trace if e["ph"] == "C"]
+    assert len(counters) == 1
+    c = counters[0]
+    assert c["cat"] == "memory" and c["name"] == "memory (device)"
+    assert c["args"]["bytes_in_use"] == 1024
+    assert c["args"]["peak_bytes_in_use"] == 4096
+    assert c["args"]["host_bytes_in_use"] == 512
+
+
+def _varint(n):
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _tag(field, wire):
+    return _varint((field << 3) | wire)
+
+
+def _ld(field, payload):
+    """Length-delimited (wire 2) field."""
+    return _tag(field, 2) + _varint(len(payload)) + payload
+
+
+def _vint(field, n):
+    return _tag(field, 0) + _varint(n)
+
+
+def _xplane(name, ops, events):
+    """Encode an XPlane: name (f2), event_metadata map entries (f4,
+    entry {key=f1, value=f2 -> XEventMetadata{id=f1, name=f2}}), one
+    XLine (f3) whose XEvents (f4) carry metadata_id (f1) and
+    duration_ps (f3)."""
+    buf = _ld(2, name.encode())
+    for mid, opname in ops.items():
+        meta = _vint(1, mid) + _ld(2, opname.encode())
+        buf += _ld(4, _vint(1, mid) + _ld(2, meta))
+    line = b"".join(_ld(4, _vint(1, mid) + _vint(3, dur_ps))
+                    for mid, dur_ps in events)
+    buf += _ld(3, line)
+    return buf
+
+
+def test_device_op_table_wire_format(tmp_path):
+    """device_op_table parses hand-encoded xplane.pb bytes: device
+    planes win over the host plane, "$file:line" python-frame names are
+    filtered, durations aggregate from picoseconds to microseconds."""
+    device = _xplane(
+        "/device:TPU:0",
+        {1: "fusion.1", 2: "$train.py:42 step", 3: "copy.2"},
+        [(1, 3_000_000), (1, 5_000_000),      # 3us + 5us fusion.1
+         (2, 9_000_000),                      # python frame: filtered
+         (3, 1_500_000)])                     # 1.5us copy.2
+    host = _xplane("/host:CPU", {7: "hostop"}, [(7, 2_000_000)])
+    space = _ld(1, device) + _ld(1, host)
+    d = tmp_path / "cap" / "run"
+    d.mkdir(parents=True)
+    (d / "machine.xplane.pb").write_bytes(space)
+    table, rows = profiler.device_op_table(str(tmp_path / "cap"))
+    by_name = {r["name"]: r for r in rows}
+    # device plane selected; host plane and $-frames excluded
+    assert set(by_name) == {"fusion.1", "copy.2"}
+    assert by_name["fusion.1"]["calls"] == 2
+    assert abs(by_name["fusion.1"]["total"] - 8.0) < 1e-9
+    assert abs(by_name["fusion.1"]["max"] - 5.0) < 1e-9
+    assert abs(by_name["copy.2"]["total"] - 1.5) < 1e-9
+    assert rows[0]["name"] == "fusion.1"     # sorted by total desc
+    assert "fusion.1" in table
+
+    # no device plane -> /host:CPU fallback
+    d2 = tmp_path / "hostonly"
+    d2.mkdir()
+    (d2 / "h.xplane.pb").write_bytes(_ld(1, host))
+    _, rows2 = profiler.device_op_table(str(d2))
+    assert [r["name"] for r in rows2] == ["hostop"]
+    assert abs(rows2[0]["total"] - 2.0) < 1e-9
+
+    with pytest.raises(FileNotFoundError):
+        profiler.device_op_table(str(tmp_path / "nothing"))
