@@ -25,6 +25,13 @@ A fault point is a named site the runtime passes through:
     serving.step              each continuous-batching decode step
                               (raise = deterministic mid-decode failure
                               of all in-flight requests; engine stays up)
+    serving.alloc_block       each physical KV-block allocation (raise =
+                              deterministic block-pool exhaustion during
+                              admission; the request fails, already-
+                              reserved blocks roll back, engine stays up)
+    serving.cow_split         before each copy-on-write block copy when a
+                              prefix-cache hit diverges mid-block (raise
+                              = deterministic mid-CoW failure)
 
 Faults are scheduled programmatically::
 

@@ -78,11 +78,26 @@ _DEFS = {
         "serving: default per-request deadline in seconds (0 = none); "
         "expired requests fail with DeadlineExceededError whether "
         "queued or mid-decode"),
-    "FLAGS_serving_prefill_buckets": (
-        "16,32,64,128,256,512", str,
-        "serving: comma-separated padded prefill-length ladder — each "
-        "rung compiles exactly once; prompts pad up to the next rung "
-        "(max_seq_len is always the top rung)"),
+    "FLAGS_serving_kv_block_size": (
+        16, int,
+        "serving: tokens per physical KV block of the paged cache; a "
+        "request holds ceil((prompt+max_new)/block_size) blocks"),
+    "FLAGS_serving_kv_blocks": (
+        0, int,
+        "serving: physical KV blocks in the pool (incl. reserved null "
+        "block 0); 0 = auto-size to the dense-equivalent worst case "
+        "max_slots*ceil(max_seq/block_size)+1"),
+    "FLAGS_serving_prefill_chunk": (
+        16, int,
+        "serving: max prompt tokens a prefilling slot contributes to "
+        "one unified decode step (chunked prefill; replaces the "
+        "deleted FLAGS_serving_prefill_buckets trace ladder)"),
+    "FLAGS_serving_prefix_cache": (
+        True, bool,
+        "serving: index finished sequences' KV blocks by cumulative "
+        "token-prefix hash so later requests sharing a prefix (system "
+        "prompts) reuse physical blocks, with copy-on-write on "
+        "divergence"),
     "FLAGS_flight_recorder_capacity": (
         256, int,
         "observe: ring-buffer size of the always-on flight recorder "

@@ -121,7 +121,20 @@ class GPTAttention(nn.Layer):
         where each batch row is an independent request mid-decode). The
         per-row causal mask doubles as stale-KV masking: a recycled
         slot's leftover keys live at positions > the new request's pos,
-        so they are never attended before being overwritten."""
+        so they are never attended before being overwritten.
+
+        Paged mode (the serving block-paged pool): `pos` is a tuple
+        ``(pos_vec, block_tables)`` and k/v caches are physical block
+        pools ``[num_blocks, nh, block_size, hd]``. Row b's logical
+        position t lives at physical row ``(tables[b, t // bs],
+        t % bs)``; new KV scatters through the table and the logical
+        ``[b, nh, max_seq, hd]`` view is gathered back for the scores.
+        Padding rows (positions past the sequence / chunk) are routed
+        to reserved block 0, so the step shape never depends on how
+        many rows are real — the compile-once property survives
+        arbitrary chunked-prefill/decode mixes. The same overwrite-
+        before-attend invariant makes block recycling and whole-block
+        copy-on-write safe without zeroing."""
         import jax
         import jax.numpy as jnp
         from jax import lax
@@ -131,6 +144,9 @@ class GPTAttention(nn.Layer):
         kv = k._value if isinstance(k, Tensor) else k
         vv = v._value if isinstance(v, Tensor) else v
         s_new = qv.shape[2]
+        if isinstance(pos, tuple):
+            return self._attend_paged(qv, kv, vv, k_cache, v_cache,
+                                      pos[0], pos[1])
         s_max = k_cache.shape[2]
         key_idx = jnp.arange(s_max)
         pos_vec = getattr(pos, "ndim", 0) == 1
@@ -162,6 +178,51 @@ class GPTAttention(nn.Layer):
         out = jnp.einsum("bhqk,bhkd->bhqd", p,
                          v_cache.astype(jnp.float32)).astype(qv.dtype)
         return Tensor(out), (k_cache, v_cache, pos + s_new)
+
+    def _attend_paged(self, qv, kv, vv, k_pool, v_pool, pos, tables):
+        """Paged variant of the vector-pos branch: scatter the new KV
+        through per-row block tables into the physical pool, gather the
+        logical per-row view back, then the identical per-row causal
+        mask. Out-of-range rows (padding past max_seq) write into the
+        reserved null block 0; table entries past a slot's allocation
+        are 0 too, and both stay unattended because the mask only admits
+        keys <= each row's own position."""
+        import jax
+        import jax.numpy as jnp
+
+        b, nh = qv.shape[0], qv.shape[1]
+        s_new = qv.shape[2]
+        bs = k_pool.shape[2]
+        mb = tables.shape[1]
+        s_max = mb * bs
+        hd = k_pool.shape[3]
+        row = jnp.arange(b)[:, None]                  # [b, 1]
+        t_idx = pos[:, None] + jnp.arange(s_new)      # [b, s_new]
+        safe_t = jnp.minimum(t_idx, s_max - 1)
+        blk = jnp.where(t_idx >= s_max, 0,
+                        tables[row, safe_t // bs])    # [b, s_new]
+        off = safe_t % bs
+        # advanced-index scatter through the tables: value rows land at
+        # (physical block, in-block offset) of their logical position
+        k_pool = k_pool.at[blk, :, off, :].set(
+            jnp.swapaxes(kv, 1, 2).astype(k_pool.dtype))
+        v_pool = v_pool.at[blk, :, off, :].set(
+            jnp.swapaxes(vv, 1, 2).astype(v_pool.dtype))
+        # gather each row's logical [nh, s_max, hd] view for the scores
+        k_view = k_pool[tables].transpose(0, 2, 1, 3, 4).reshape(
+            b, nh, s_max, hd)
+        v_view = v_pool[tables].transpose(0, 2, 1, 3, 4).reshape(
+            b, nh, s_max, hd)
+        scale = 1.0 / (self.head_dim ** 0.5)
+        scores = jnp.einsum("bhqd,bhkd->bhqk", qv.astype(jnp.float32),
+                            k_view.astype(jnp.float32)) * scale
+        key_idx = jnp.arange(s_max)
+        mask = key_idx[None, None, :] <= t_idx[:, :, None]
+        scores = jnp.where(mask[:, None], scores, -1e30)
+        p = jax.nn.softmax(scores, axis=-1)
+        out = jnp.einsum("bhqk,bhkd->bhqd", p,
+                         v_view.astype(jnp.float32)).astype(qv.dtype)
+        return Tensor(out), (k_pool, v_pool, (pos + s_new, tables))
 
 
 class GPTMLP(nn.Layer):

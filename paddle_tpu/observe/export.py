@@ -198,6 +198,27 @@ def prometheus_text(serving=None, queue_depth=None):
                 L.add("paddle_serving_latency_seconds", stats[q],
                       labels={"kind": kind, "quantile": q},
                       help_="serving latency quantiles (seconds)")
+        blk = snap.get("kv_blocks")
+        if blk:
+            L.add("paddle_serving_kv_blocks_in_use", blk["in_use"],
+                  help_="physical KV blocks referenced at the last step")
+            L.add("paddle_serving_kv_blocks_total", blk["total"],
+                  help_="usable physical KV blocks in the paged pool")
+            L.add("paddle_serving_kv_block_occupancy", blk["occupancy"],
+                  labels={"stat": "avg"},
+                  help_="KV block-pool utilisation (in_use/total)")
+            L.add("paddle_serving_kv_block_occupancy",
+                  blk["occupancy_max"], labels={"stat": "max"})
+        pfx = snap.get("prefix_cache")
+        if pfx:
+            L.add("paddle_serving_prefix_cache_hit_rate",
+                  pfx["hit_rate"],
+                  help_="prompt tokens served from cached KV blocks")
+        cp = snap.get("chunked_prefill")
+        if cp:
+            L.add("paddle_serving_prefill_tokens_per_step",
+                  cp["tokens_per_step"],
+                  help_="prompt tokens folded into each decode step")
     if queue_depth is not None:
         L.add("paddle_serving_queue_depth", queue_depth)
 

@@ -9,8 +9,10 @@ iteration-level ("continuous") batching in the Orca lineage:
   shed on overload, graceful drain (queueing.py);
 - `DynamicBatcher` — coalesces concurrent requests into shape-bucketed,
   padded batches; every bucket compiles exactly once (batcher.py);
-- `SlotEngine` — continuous-batching GPT decode over a pooled
-  static-shape KV cache with join-at-step admission and eviction on
+- `SlotEngine` — continuous-batching GPT decode over a block-paged KV
+  cache (vLLM-style block tables + SGLang-style radix prefix sharing,
+  paging.py) with chunked prefill folded into one compiled step,
+  join-at-step admission by free blocks, and eviction on
   EOS/max-len/deadline (engine.py);
 - `ServingMetrics` — QPS, queue depth, batch occupancy, latency
   percentiles; JSON-exportable, spans mirrored into the profiler's
@@ -24,18 +26,23 @@ thread-based clients; no network required.
 from .batcher import (  # noqa: F401
     DynamicBatcher, bucket_for, bucket_ladder, pad_batch,
 )
-from .engine import SlotEngine, prefill_ladder  # noqa: F401
+from .engine import SlotEngine  # noqa: F401
 from .metrics import ServingMetrics, percentile  # noqa: F401
+from .paging import (  # noqa: F401
+    NULL_BLOCK, BlockAllocator, PoolExhausted, PrefixCache,
+)
 from .queueing import (  # noqa: F401
-    AdmissionQueue, DeadlineExceededError, QueueFullError, Request,
-    RequestCancelled, ServerClosedError, ServingError,
+    AdmissionQueue, CapacityExhaustedError, DeadlineExceededError,
+    QueueFullError, Request, RequestCancelled, ServerClosedError,
+    ServingError,
 )
 from .server import Server, http_front  # noqa: F401
 
 __all__ = [
-    "AdmissionQueue", "DeadlineExceededError", "DynamicBatcher",
-    "QueueFullError", "Request", "RequestCancelled", "Server",
-    "ServerClosedError", "ServingError", "ServingMetrics", "SlotEngine",
-    "bucket_for", "bucket_ladder", "http_front", "pad_batch",
-    "percentile", "prefill_ladder",
+    "AdmissionQueue", "BlockAllocator", "CapacityExhaustedError",
+    "DeadlineExceededError", "DynamicBatcher", "NULL_BLOCK",
+    "PoolExhausted", "PrefixCache", "QueueFullError", "Request",
+    "RequestCancelled", "Server", "ServerClosedError", "ServingError",
+    "ServingMetrics", "SlotEngine", "bucket_for", "bucket_ladder",
+    "http_front", "pad_batch", "percentile",
 ]

@@ -73,8 +73,14 @@ class DynamicBatcher:
     """
 
     def __init__(self, fn, *, max_batch=None, max_wait_s=0.002,
-                 queue_cap=None, metrics=None, jit=True):
+                 queue_cap=None, metrics=None, jit=True,
+                 strict_shapes=False):
         self._fn = fn
+        # strict_shapes: once warmup() has traced every rung, run each
+        # flush under observe.no_retrace() so shape drift fails loudly
+        # at trace time instead of silently recompiling
+        self._strict = strict_shapes
+        self._warmed = False
         self.max_batch = max_batch or flag("FLAGS_serving_max_batch")
         self.max_wait_s = max_wait_s
         self.ladder = bucket_ladder(self.max_batch)
@@ -114,12 +120,20 @@ class DynamicBatcher:
         """Pad `samples` to their bucket, run once, return the first
         len(samples) outputs. Deterministic (no queue/thread involved) —
         this is what warmup and the compile-count tests call."""
+        import contextlib
+
         bucket = bucket_for(len(samples), self.ladder)
         x = pad_batch(samples, bucket)
         if not self._jit and bucket not in self._seen_buckets:
             self._seen_buckets.add(bucket)
             self._compiles[bucket] = self._compiles.get(bucket, 0) + 1
-        with profiler.RecordEvent("serving.batch", cat="serving"):
+        if self._strict and self._warmed:
+            from .. import observe
+
+            guard = observe.no_retrace()
+        else:
+            guard = contextlib.nullcontext()
+        with profiler.RecordEvent("serving.batch", cat="serving"), guard:
             out = self._run(x)
         import jax
 
@@ -132,6 +146,7 @@ class DynamicBatcher:
         bucket shape) so the serving hot path never traces."""
         for bucket in self.ladder:
             self.run_batch([sample] * bucket)
+        self._warmed = True
         return dict(self._compiles)
 
     # -- threaded serving ---------------------------------------------------

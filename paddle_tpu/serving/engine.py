@@ -1,28 +1,46 @@
-"""Continuous-batching decode engine: a fixed pool of batch slots over
-the GPT static-shape KV cache.
+"""Continuous-batching decode engine over a block-paged KV cache.
 
 Orca-style iteration-level scheduling (PAPERS.md: continuous batching)
-mapped onto XLA's compile-per-shape reality:
+mapped onto XLA's compile-per-shape reality, with vLLM-style paged KV
+allocation and SGLang-style prefix sharing:
 
-- ONE pooled KV cache per layer, shape [max_slots, nh, max_seq, hd].
-  Each slot row belongs to at most one in-flight request; `pos[slot]`
-  tracks how far that request has decoded. The whole pool steps through
-  a single jitted decode function with a PER-ROW position vector
-  (gpt.py `_attend_cached` vector-pos path), so the step shape never
-  changes and the decode program compiles exactly once.
-- Join-at-step admission: whenever a slot is free and the queue is
-  non-empty, the new request's prompt is prefilled into that slot's
-  rows (prompt padded up to a prefill bucket ladder — one compile per
-  rung) while every other slot keeps decoding. The step loop never
-  drains between requests.
-- Eviction on EOS / max_new_tokens / deadline / cancel frees the slot
-  at the next step boundary. Stale KV from the previous occupant is
-  harmless: the vector-pos causal mask only admits keys <= the new
-  request's position, all of which its own prefill/decode overwrote.
+- ONE physical block pool per layer, shape
+  ``[num_blocks, nh, block_size, hd]``, plus a per-slot block table
+  ``[max_slots, blocks_per_slot]``. A request holds only the blocks its
+  actual length needs, so pool HBM caps *total tokens in flight*, not
+  ``max_slots * max_seq`` — short requests no longer pay for long ones
+  and concurrency scales with the pool, not the worst case.
+- ONE compiled step. Every iteration runs the whole pool through a
+  single jitted function over a fixed ``[max_slots, chunk]`` token
+  matrix: decoding slots occupy one column, *prefilling* slots up to
+  ``chunk`` prompt columns (chunked prefill), padding routes to the
+  reserved null block. The old per-rung prefill ladder — one compile
+  per padded prompt length, each stalling the decode loop — is gone;
+  the decode program compiles exactly once, certified by the trace-time
+  compile counters and `observe.no_retrace()`.
+- Prefix sharing: finished sequences index their fully written blocks
+  in a radix `PrefixCache` keyed on cumulative token-prefix hashes.
+  A new request reuses every matching block physically (refcounted),
+  prefills only the tail, and a divergence *inside* a cached block
+  triggers copy-on-write: the block is copied once (second compiled
+  helper, also traced exactly once) and the divergent rows overwritten.
+- Admission is by free blocks, not free slots: a request needing more
+  blocks than the whole pool sheds with the retriable 429
+  `CapacityExhaustedError`; one that merely has to wait for in-flight
+  frees stays queued and joins at a later step boundary.
 
-Fault site: ``serving.step`` fires once per decode step; a `raise`
-action fails every in-flight request deterministically (mid-decode
-cancellation path) while the engine itself stays up.
+Eviction on EOS / max_new_tokens / deadline / cancel frees the slot and
+releases its block references at the next step boundary. Stale KV from
+a previous occupant of a recycled block is harmless: the per-row causal
+mask only admits keys <= the request's own position, all of which its
+own prefill/decode overwrote first (same argument covers chunked-
+prefill padding rows and whole-block CoW copies).
+
+Fault sites: ``serving.step`` fires once per decode step (a `raise`
+action fails every in-flight request deterministically while the engine
+stays up); ``serving.alloc_block`` on every physical block allocation
+(deterministic pool exhaustion); ``serving.cow_split`` before every
+copy-on-write block copy.
 """
 
 from __future__ import annotations
@@ -38,35 +56,29 @@ from ..engine import functional_apply, state_values
 from ..framework import faults
 from ..framework.flags import flag
 from .metrics import ServingMetrics
+from .paging import NULL_BLOCK, BlockAllocator, PoolExhausted, PrefixCache
 from .queueing import (
-    AdmissionQueue, DeadlineExceededError, Request, RequestCancelled,
+    AdmissionQueue, CapacityExhaustedError, DeadlineExceededError, Request,
+    RequestCancelled,
 )
 
-__all__ = ["SlotEngine", "prefill_ladder"]
-
-
-def prefill_ladder(max_seq_len, spec=None):
-    """Padded prompt-length rungs <= max_seq_len, from the
-    FLAGS_serving_prefill_buckets spec (comma-separated ints), always
-    topped by max_seq_len itself."""
-    spec = spec if spec is not None else flag("FLAGS_serving_prefill_buckets")
-    if isinstance(spec, str):
-        rungs = [int(tok) for tok in spec.split(",") if tok.strip()]
-    else:
-        rungs = [int(tok) for tok in spec]
-    rungs = sorted({r for r in rungs if 0 < r < max_seq_len})
-    rungs.append(max_seq_len)
-    return rungs
+__all__ = ["SlotEngine"]
 
 
 class _Slot:
     """One in-flight request's decode state (host side)."""
 
-    def __init__(self, req, tokens, next_logits):
+    def __init__(self, req, ids, fill, blocks):
         self.req = req
-        self.tokens = tokens            # full sequence so far (list[int])
+        self.prompt = np.asarray(ids, np.int32)
+        self.prompt_len = int(self.prompt.size)
+        self.tokens = [int(t) for t in ids]  # full sequence so far
+        self.fill = fill        # prompt positions already in the cache
+        self.blocks = blocks    # physical block ids, table order
+        self.state = "prefill" if fill < self.prompt_len else "decode"
+        self.advance = 0        # positions this step will write
         self.produced = 0
-        self.next_logits = next_logits  # np [V] feeding the next pick
+        self.next_logits = None  # np [V] feeding the next pick
         self.rng = None
         if req.gen.get("do_sample"):
             self.rng = np.random.RandomState(req.gen.get("seed", 0))
@@ -82,14 +94,15 @@ class SlotEngine:
 
     Ownership contract (same as the reference's one-predictor-per-
     thread rule): while the engine is serving, it owns the model —
-    tracing a new bucket temporarily swaps the model's parameter
-    handles (engine.functional_apply), so run eager forwards on it
-    only while the engine is idle, or on a separate instance.
+    tracing temporarily swaps the model's parameter handles
+    (engine.functional_apply), so run eager forwards on it only while
+    the engine is idle, or on a separate instance.
     """
 
     def __init__(self, model, *, max_slots=None, max_seq_len=None,
-                 prefill_buckets=None, cache_dtype=None, metrics=None,
-                 queue=None):
+                 block_size=None, num_blocks=None, prefill_chunk=None,
+                 prefix_cache=None, cache_dtype=None, metrics=None,
+                 queue=None, strict_shapes=False):
         import jax
         import jax.numpy as jnp
 
@@ -98,7 +111,18 @@ class SlotEngine:
         self.max_slots = max_slots or flag("FLAGS_serving_max_batch")
         self.max_seq_len = min(max_seq_len or model.config.max_seq_len,
                                model.config.max_seq_len)
-        self.ladder = prefill_ladder(self.max_seq_len, prefill_buckets)
+        self.block_size = block_size or flag("FLAGS_serving_kv_block_size")
+        self.blocks_per_slot = -(-self.max_seq_len // self.block_size)
+        if num_blocks is None:
+            num_blocks = flag("FLAGS_serving_kv_blocks")
+        if not num_blocks:   # auto: dense-equivalent worst case + null
+            num_blocks = self.max_slots * self.blocks_per_slot + 1
+        if num_blocks < 2:
+            raise ValueError(f"num_blocks must be >= 2, got {num_blocks}")
+        self.num_blocks = num_blocks
+        self.prefill_chunk = min(
+            prefill_chunk or flag("FLAGS_serving_prefill_chunk"),
+            self.max_seq_len)
         self.metrics = metrics if metrics is not None else ServingMetrics()
         self.queue = queue if queue is not None else AdmissionQueue(
             flag("FLAGS_serving_queue_cap"), metrics=self.metrics)
@@ -106,84 +130,134 @@ class SlotEngine:
         cfg = model.config
         hd = cfg.hidden_size // cfg.num_heads
         dtype = cache_dtype or jnp.float32
-        shape = (self.max_slots, cfg.num_heads, self.max_seq_len, hd)
+        shape = (self.num_blocks, cfg.num_heads, self.block_size, hd)
         self._ks = [jnp.zeros(shape, dtype) for _ in range(cfg.num_layers)]
         self._vs = [jnp.zeros(shape, dtype) for _ in range(cfg.num_layers)]
+        self.kv_pool_bytes = int(
+            2 * cfg.num_layers * np.prod(shape) * jnp.zeros((), dtype).nbytes)
+        self._alloc = BlockAllocator(self.num_blocks)
+        if prefix_cache is None:
+            prefix_cache = flag("FLAGS_serving_prefix_cache")
+        self._cache = PrefixCache(self._alloc, self.block_size) \
+            if prefix_cache else None
         self._pos = np.zeros((self.max_slots,), np.int32)
+        self._bt = np.full((self.max_slots, self.blocks_per_slot),
+                           NULL_BLOCK, np.int32)
         self._slots: list = [None] * self.max_slots
         self._free = list(range(self.max_slots))
         self._compiles: dict = {}
+        self._strict = strict_shapes
+        self._warmed = False
         self._abort = threading.Event()
         self._thread = None
 
         def _count(key):
             self._compiles[key] = self._compiles.get(key, 0) + 1
 
-        def decode_fn(values, tok, pos, ks, vs):
-            _count("decode")     # trace-time only: the compile counter
+        def step_fn(values, tok, pos, nvalid, tables, ks, vs):
+            # trace-time only: the compile counter + retrace registry
+            _count("decode")
             observe.record_compile(
-                "serving.decode", signature=observe.signature_of(tok, pos))
-            caches = [(k, v, pos) for k, v in zip(ks, vs)]
+                "serving.step",
+                signature=observe.signature_of(tok, pos, tables))
+            caches = [(k, v, (pos, tables)) for k, v in zip(ks, vs)]
+            # clamp padding rows' position ids into the embedding table;
+            # their KV writes route to the null block regardless
+            posmat = jnp.minimum(
+                pos[:, None] + jnp.arange(tok.shape[1]),
+                self.max_seq_len - 1)
 
             def run(m):
-                h, new_caches = m.gpt(Tensor(tok), Tensor(pos[:, None]),
+                h, new_caches = m.gpt(Tensor(tok), Tensor(posmat),
                                       caches=caches)
-                return m.logits(h), new_caches
+                hv = h._value if isinstance(h, Tensor) else h
+                # only each slot's last valid position feeds sampling:
+                # skip the full-vocab projection of the rest of the chunk
+                last = hv[jnp.arange(hv.shape[0]), nvalid - 1]
+                return m.logits(Tensor(last[:, None, :])), new_caches
 
             logits, new_caches = functional_apply(self.model, values, run)
-            lv = jnp.asarray(logits)[:, -1, :].astype(jnp.float32)
+            lv = jnp.asarray(logits)[:, 0, :].astype(jnp.float32)
             return (lv, [c[0] for c in new_caches],
                     [c[1] for c in new_caches])
 
-        def prefill_fn(values, ks, vs, tok_pad, slot, true_len):
+        def cow_fn(ks, vs, src, dst):
             from jax import lax
 
-            _count(("prefill", tok_pad.shape[1]))
-            observe.record_compile(
-                "serving.prefill", signature=observe.signature_of(tok_pad))
-            rows = [(lax.dynamic_slice_in_dim(k, slot, 1, axis=0),
-                     lax.dynamic_slice_in_dim(v, slot, 1, axis=0), 0)
-                    for k, v in zip(ks, vs)]
-            length = tok_pad.shape[1]
+            _count("cow")
+            observe.record_compile("serving.cow", signature="(block, block)")
 
-            def run(m):
-                h, new_rows = m.gpt(
-                    Tensor(tok_pad),
-                    Tensor(jnp.arange(length, dtype=jnp.int32)),
-                    caches=rows)
-                return m.logits(h), new_rows
+            def copy(pool):
+                blk = lax.dynamic_slice_in_dim(pool, src, 1, axis=0)
+                return lax.dynamic_update_slice_in_dim(pool, blk, dst,
+                                                       axis=0)
 
-            logits, new_rows = functional_apply(self.model, values, run)
-            last = lax.dynamic_slice_in_dim(
-                jnp.asarray(logits), true_len - 1, 1, axis=1)
-            ks2 = [lax.dynamic_update_slice_in_dim(k, r[0], slot, axis=0)
-                   for k, r in zip(ks, new_rows)]
-            vs2 = [lax.dynamic_update_slice_in_dim(v, r[1], slot, axis=0)
-                   for v, r in zip(vs, new_rows)]
-            return last[:, 0, :].astype(jnp.float32)[0], ks2, vs2
+            return [copy(k) for k in ks], [copy(v) for v in vs]
 
-        self._decode = jax.jit(decode_fn)
-        self._prefill = jax.jit(prefill_fn)
+        self._decode = jax.jit(step_fn)
+        self._cow = jax.jit(cow_fn)
 
     # -- introspection ------------------------------------------------------
 
     @property
     def compile_counts(self):
-        """'decode' -> traces of the step fn; ('prefill', L) -> traces
-        of the prefill fn at padded length L. The slot-engine compile
-        invariant is every value == 1."""
+        """'decode' -> traces of the unified prefill+decode step,
+        'cow' -> traces of the copy-on-write block copy. The paged
+        engine's compile invariant is every value == 1 — there is no
+        prefill bucket ladder anymore."""
         return dict(self._compiles)
 
     @property
     def active(self):
         return sum(1 for s in self._slots if s is not None)
 
+    @property
+    def free_blocks(self):
+        """Currently unreferenced physical blocks."""
+        return self._alloc.free_blocks
+
+    @property
+    def blocks_in_use(self):
+        return self._alloc.blocks_in_use
+
+    @property
+    def prefix_cache_size(self):
+        return len(self._cache) if self._cache is not None else 0
+
+    def _blocks_needed(self, n_positions):
+        return -(-int(n_positions) // self.block_size)
+
+    # -- warmup -------------------------------------------------------------
+
+    def warmup(self):
+        """Trace the unified step and the CoW copy before traffic so the
+        hot path never compiles. All tables point at the null block, so
+        the dummy step's writes land in reserved scratch; outputs are
+        discarded. Returns `compile_counts`."""
+        import jax.numpy as jnp
+
+        tok = jnp.zeros((self.max_slots, self.prefill_chunk), jnp.int32)
+        pos = jnp.zeros((self.max_slots,), jnp.int32)
+        nvalid = jnp.ones((self.max_slots,), jnp.int32)
+        self._decode(self._values, tok, pos, nvalid,
+                     jnp.asarray(self._bt), self._ks, self._vs)
+        self._cow(self._ks, self._vs, jnp.int32(NULL_BLOCK),
+                  jnp.int32(NULL_BLOCK))
+        self._warmed = True
+        return self.compile_counts
+
     # -- request lifecycle --------------------------------------------------
 
     def submit(self, prompt_ids, *, max_new_tokens=16, eos_token_id=None,
                timeout=None, do_sample=False, temperature=1.0, top_k=0,
                seed=0):
-        """Admit one request (or shed); returns its `Request` future."""
+        """Admit one request (or shed); returns its `Request` future.
+
+        Length beyond the model's positional range is a hard
+        `ValueError` (client error); a request whose block demand
+        exceeds the whole physical pool sheds with the retriable
+        `CapacityExhaustedError` (HTTP 429) instead — paged capacity,
+        not slot count, is the admission limit."""
         if timeout is None:
             timeout = flag("FLAGS_serving_default_timeout_s") or None
         ids = np.asarray(prompt_ids, np.int32).reshape(-1)
@@ -193,40 +267,100 @@ class SlotEngine:
             raise ValueError(
                 f"prompt ({ids.size}) + max_new_tokens ({max_new_tokens}) "
                 f"exceeds engine max_seq_len {self.max_seq_len}")
+        need = self._blocks_needed(ids.size + max_new_tokens)
+        if need > self._alloc.usable:
+            self.metrics.inc("rejected_capacity")
+            raise CapacityExhaustedError(
+                f"request needs {need} KV blocks but the pool holds "
+                f"{self._alloc.usable} (block_size={self.block_size}); "
+                "retry with a smaller request or grow "
+                "FLAGS_serving_kv_blocks")
         return self.queue.submit(Request(
             ids, timeout=timeout, max_new_tokens=max_new_tokens,
             eos_token_id=eos_token_id, do_sample=do_sample,
             temperature=temperature, top_k=top_k, seed=seed))
 
-    def _admit(self):
-        """Join-at-step: fill free slots from the queue (no waiting)."""
+    def _stage_blocks(self, ids, need_total):
+        """Reserve the physical blocks for one admission: reuse every
+        prefix-cached block, allocate the rest, copy-on-write when the
+        divergence falls inside a cached block. Returns
+        ``(blocks, fill)`` or raises (`PoolExhausted` = wait and retry;
+        anything else = fail the request). All-or-nothing: partial
+        reservations are rolled back."""
         import jax.numpy as jnp
 
+        shared, n_shared, cow = [], 0, None
+        if self._cache is not None:
+            # always leave >= 1 prompt token to compute: the last
+            # token's logits seed decode
+            shared, n_shared, cow = self._cache.match(ids, ids.size - 1)
+            self.metrics.inc("prefix_lookups")
+            self.metrics.inc("prompt_tokens", int(ids.size))
+            hit_tokens = n_shared + (cow[1] if cow else 0)
+            if shared:
+                self.metrics.inc("prefix_hit_blocks", len(shared))
+            if hit_tokens:
+                self.metrics.inc("prefix_hit_tokens", hit_tokens)
+        n_new = need_total - len(shared)
+        if self._alloc.free_blocks < n_new and self._cache is not None:
+            self._cache.reclaim(n_new - self._alloc.free_blocks)
+        if self._alloc.free_blocks < n_new:
+            raise PoolExhausted(
+                f"need {n_new} free KV blocks, have "
+                f"{self._alloc.free_blocks}")
+        taken, new = [], []
+        try:
+            for bid in shared:
+                self._alloc.incref(bid)
+                taken.append(bid)
+            for _ in range(n_new):
+                new.append(self._alloc.alloc())
+            fill = n_shared
+            if cow is not None:
+                src, rows = cow
+                faults.fault_point("serving.cow_split")
+                with profiler.RecordEvent("serving.cow", cat="serving"):
+                    self._ks, self._vs = self._cow(
+                        self._ks, self._vs, jnp.int32(src),
+                        jnp.int32(new[0]))
+                self.metrics.inc("cow_splits")
+                fill += rows
+        except Exception:
+            for bid in taken:
+                self._alloc.decref(bid)
+            for bid in new:
+                self._alloc.decref(bid)
+            raise
+        return taken + new, fill
+
+    def _admit(self):
+        """Join-at-step: fill free slots from the queue while block
+        capacity lasts (no waiting). A request the pool cannot hold
+        *right now* is pushed back to the queue head and retried after
+        the next eviction frees blocks."""
         while self._free:
             req = self.queue.pop(timeout=0.0)
             if req is None:
                 return
-            slot = self._free.pop()
             ids = req.payload
-            s0 = int(ids.size)
-            bucket = next(r for r in self.ladder if r >= s0)
-            tok_pad = np.zeros((1, bucket), np.int32)
-            tok_pad[0, :s0] = ids
+            need = self._blocks_needed(
+                ids.size + req.gen.get("max_new_tokens", 16))
             try:
-                with profiler.RecordEvent("serving.prefill", cat="serving"):
-                    logits, self._ks, self._vs = self._prefill(
-                        self._values, self._ks, self._vs,
-                        jnp.asarray(tok_pad), jnp.int32(slot),
-                        jnp.int32(s0))
-            except Exception as e:  # noqa: BLE001 — fail req, keep slot
-                self._free.append(slot)
+                blocks, fill = self._stage_blocks(ids, need)
+            except PoolExhausted:
+                # FIFO head-of-line wait: blocks free at step boundaries
+                self.queue.requeue(req)
+                return
+            except Exception as e:  # noqa: BLE001 — fail req, stay up
                 self.metrics.inc("failed")
                 req._fail(e)
                 continue
-            self._pos[slot] = s0
-            self._slots[slot] = _Slot(req, list(int(t) for t in ids),
-                                      np.asarray(logits))
-            self.metrics.inc("prefills")
+            slot = self._free.pop()
+            self._bt[slot, :] = NULL_BLOCK
+            self._bt[slot, :len(blocks)] = blocks
+            self._pos[slot] = fill
+            self._slots[slot] = _Slot(req, ids, fill, blocks)
+            self.metrics.inc("admitted")
             self.metrics.observe_latency(
                 "queue", time.monotonic() - req.arrival)
 
@@ -251,6 +385,15 @@ class SlotEngine:
         slot = self._slots[idx]
         self._slots[idx] = None
         self._free.append(idx)
+        written = int(self._pos[idx])
+        if error is None and self._cache is not None:
+            # donate fully written blocks to the prefix index before
+            # releasing our references — shared system prompts survive
+            self._cache.insert(slot.tokens, slot.blocks, written)
+        for bid in slot.blocks:
+            self._alloc.decref(bid)
+        self._bt[idx, :] = NULL_BLOCK
+        self._pos[idx] = 0
         if error is not None:
             self.metrics.inc("failed")
             slot.req._fail(error)
@@ -266,9 +409,10 @@ class SlotEngine:
                 self._evict(i, error)
 
     def _step(self):
-        """One continuous-batching iteration: consume each slot's
-        pending logits (finishing slots that hit EOS/max/deadline), then
-        one batched single-token decode for whatever remains."""
+        """One continuous-batching iteration: consume each decoding
+        slot's pending logits (finishing slots that hit
+        EOS/max/deadline), stage the next chunk for prefilling slots,
+        then ONE batched step over the whole pool."""
         import jax.numpy as jnp
 
         try:
@@ -277,28 +421,45 @@ class SlotEngine:
             self._fail_all_active(e)
             return
         now = time.monotonic()
-        tok = np.zeros((self.max_slots,), np.int32)
-        live = []
+        tok = np.zeros((self.max_slots, self.prefill_chunk), np.int32)
+        nvalid = np.ones((self.max_slots,), np.int32)
+        live: list = []
         with observe.phase("sample", cat="serving"):
-            self._consume_slots(now, tok, live)
+            prefill_tokens = self._consume_slots(now, tok, nvalid, live)
         if not live:
             return
         with profiler.RecordEvent("serving.step", cat="serving"):
             with observe.phase("device-step", cat="serving"):
                 logits, self._ks, self._vs = self._decode(
-                    self._values, jnp.asarray(tok[:, None]),
-                    jnp.asarray(self._pos), self._ks, self._vs)
+                    self._values, jnp.asarray(tok),
+                    jnp.asarray(self._pos), jnp.asarray(nvalid),
+                    jnp.asarray(self._bt), self._ks, self._vs)
         logits = np.asarray(logits)
         for i in live:
-            self._pos[i] += 1
-            self._slots[i].next_logits = logits[i]
+            slot = self._slots[i]
+            self._pos[i] += slot.advance
+            if slot.state == "prefill":
+                slot.fill += slot.advance
+                if slot.fill >= slot.prompt_len:
+                    slot.state = "decode"
+                    slot.next_logits = logits[i]
+                    self.metrics.inc("prefills")
+            else:
+                slot.next_logits = logits[i]
         self.metrics.inc("steps")
+        if prefill_tokens:
+            self.metrics.inc("prefill_tokens", prefill_tokens)
         self.metrics.observe_occupancy(len(live), self.max_slots)
+        self.metrics.observe_blocks(self._alloc.blocks_in_use,
+                                    self._alloc.usable)
 
-    def _consume_slots(self, now, tok, live):
-        """Host-side half of a step: sample each slot's pending logits,
-        finish/evict slots that hit EOS/max/deadline/cancel, and stage
-        the next-token batch for the decode dispatch."""
+    def _consume_slots(self, now, tok, nvalid, live):
+        """Host-side half of a step: sample each decoding slot's pending
+        logits (finish/evict on EOS/max/deadline/cancel), stage the next
+        prompt chunk for prefilling slots, and fill the fixed
+        [max_slots, chunk] token matrix for the unified dispatch.
+        Returns the number of prompt tokens staged this step."""
+        prefill_tokens = 0
         for i, slot in enumerate(self._slots):
             if slot is None:
                 continue
@@ -314,6 +475,14 @@ class SlotEngine:
                     f"request {req.id} deadline exceeded mid-decode "
                     f"after {slot.produced} tokens"))
                 continue
+            if slot.state == "prefill":
+                n = min(self.prefill_chunk, slot.prompt_len - slot.fill)
+                tok[i, :n] = slot.prompt[slot.fill:slot.fill + n]
+                nvalid[i] = n
+                slot.advance = n
+                prefill_tokens += n
+                live.append(i)
+                continue
             nxt = self._pick(slot)
             slot.tokens.append(nxt)
             slot.produced += 1
@@ -324,8 +493,10 @@ class SlotEngine:
                     slot.produced >= gen.get("max_new_tokens", 16):
                 self._evict(i)
                 continue
-            tok[i] = nxt
+            tok[i, 0] = nxt
+            slot.advance = 1
             live.append(i)
+        return prefill_tokens
 
     # -- serve loop ---------------------------------------------------------
 
@@ -339,22 +510,27 @@ class SlotEngine:
         return self
 
     def _loop(self):
-        while True:
-            if self._abort.is_set():
-                self._fail_all_active(RequestCancelled(
-                    "server aborted (non-drain shutdown)"))
-                return
-            self._admit()
-            if self.active == 0:
-                if self.queue.drained():
+        import contextlib
+
+        guard = observe.no_retrace() if self._strict and self._warmed \
+            else contextlib.nullcontext()
+        with guard:
+            while True:
+                if self._abort.is_set():
+                    self._fail_all_active(RequestCancelled(
+                        "server aborted (non-drain shutdown)"))
                     return
-                self.queue.wait_nonempty(0.02)
-                continue
-            try:
-                self._step()
-            except Exception as e:  # noqa: BLE001 — engine must stay up
-                self.metrics.inc("step_errors")
-                self._fail_all_active(e)
+                self._admit()
+                if self.active == 0:
+                    if self.queue.drained():
+                        return
+                    self.queue.wait_nonempty(0.02)
+                    continue
+                try:
+                    self._step()
+                except Exception as e:  # noqa: BLE001 — engine stays up
+                    self.metrics.inc("step_errors")
+                    self._fail_all_active(e)
 
     def shutdown(self, drain=True, timeout=None):
         """Stop. drain=True finishes queued + in-flight requests first;

@@ -30,9 +30,13 @@ class ServingMetrics:
     Counter names mirror the admission queue's (`submitted`, `accepted`,
     `rejected_queue_full`, `rejected_closed`, `timeouts`, `cancelled`)
     plus engine-side `completed`, `failed`, `steps`, `batches`,
-    `tokens_out`, `prefills`. Every inc() also bumps the global
-    `framework.monitor` counter ``serving.<name>`` so serving shows up
-    in the same stat registry as the rest of the runtime.
+    `tokens_out`, `prefills`, and the paged-KV set: `prefill_tokens`
+    (prompt positions written by chunked prefill), `prompt_tokens` /
+    `prefix_lookups` / `prefix_hit_blocks` / `prefix_hit_tokens` /
+    `cow_splits` (prefix-cache traffic), `rejected_capacity` (429 sheds
+    whose block demand exceeds the pool). Every inc() also bumps the
+    global `framework.monitor` counter ``serving.<name>`` so serving
+    shows up in the same stat registry as the rest of the runtime.
     """
 
     def __init__(self):
@@ -42,6 +46,10 @@ class ServingMetrics:
         self._occ_sum = 0.0
         self._occ_n = 0
         self._occ_max = 0.0
+        self._blk_last = (0, 0)       # (in_use, total) at last step
+        self._blk_sum = 0.0
+        self._blk_n = 0
+        self._blk_max = 0.0
         self._started = time.monotonic()
 
     def inc(self, name, n=1):
@@ -68,6 +76,15 @@ class ServingMetrics:
             self._occ_n += 1
             self._occ_max = max(self._occ_max, frac)
 
+    def observe_blocks(self, in_use, total):
+        """One decode-step sample of KV block-pool utilisation."""
+        frac = in_use / max(total, 1)
+        with self._lock:
+            self._blk_last = (int(in_use), int(total))
+            self._blk_sum += frac
+            self._blk_n += 1
+            self._blk_max = max(self._blk_max, frac)
+
     def latency_percentiles(self, kind, ps=(50, 95, 99)):
         """{p: seconds} over the recorded `kind` series."""
         with self._lock:
@@ -84,6 +101,9 @@ class ServingMetrics:
             latency = {k: list(v) for k, v in self._latency.items()}
             occ_avg = self._occ_sum / self._occ_n if self._occ_n else 0.0
             occ_max = self._occ_max
+            blk_last, blk_n = self._blk_last, self._blk_n
+            blk_avg = self._blk_sum / self._blk_n if self._blk_n else 0.0
+            blk_max = self._blk_max
             elapsed = max(time.monotonic() - self._started, 1e-9)
         snap = {
             "counters": counters,
@@ -94,6 +114,29 @@ class ServingMetrics:
                                 "samples": self._occ_n},
             "latency_s": {},
         }
+        if blk_n:
+            snap["kv_blocks"] = {
+                "in_use": blk_last[0], "total": blk_last[1],
+                "occupancy": blk_avg, "occupancy_max": blk_max,
+                "samples": blk_n,
+            }
+        if counters.get("prefix_lookups"):
+            prompt = counters.get("prompt_tokens", 0)
+            hit = counters.get("prefix_hit_tokens", 0)
+            snap["prefix_cache"] = {
+                "lookups": counters["prefix_lookups"],
+                "hit_blocks": counters.get("prefix_hit_blocks", 0),
+                "hit_tokens": hit,
+                "prompt_tokens": prompt,
+                "hit_rate": hit / prompt if prompt else 0.0,
+            }
+        if counters.get("prefill_tokens"):
+            steps = counters.get("steps", 0)
+            snap["chunked_prefill"] = {
+                "tokens": counters["prefill_tokens"],
+                "tokens_per_step":
+                    counters["prefill_tokens"] / steps if steps else 0.0,
+            }
         if queue_depth is not None:
             snap["queue_depth"] = queue_depth
         for kind, series in latency.items():

@@ -23,9 +23,9 @@ from collections import deque
 from ..framework import faults, monitor
 
 __all__ = [
-    "ServingError", "QueueFullError", "ServerClosedError",
-    "DeadlineExceededError", "RequestCancelled", "Request",
-    "AdmissionQueue",
+    "ServingError", "QueueFullError", "CapacityExhaustedError",
+    "ServerClosedError", "DeadlineExceededError", "RequestCancelled",
+    "Request", "AdmissionQueue",
 ]
 
 
@@ -40,6 +40,15 @@ class QueueFullError(ServingError):
     """Load shed: the bounded admission queue is at capacity."""
 
     status = 429
+
+
+class CapacityExhaustedError(ServingError):
+    """The request's KV-block demand exceeds the whole physical pool —
+    retriable (429): a smaller request, or a bigger
+    FLAGS_serving_kv_blocks, would be admitted."""
+
+    status = 429
+    retriable = True
 
 
 class ServerClosedError(ServingError):
@@ -218,6 +227,17 @@ class AdmissionQueue:
                 if remaining <= 0:
                     return None
                 self._cond.wait(remaining)
+
+    def requeue(self, request: Request):
+        """Push an already-admitted request back to the queue *head*
+        (FIFO order preserved). Used by the paged engine when the block
+        pool can't hold the request right now — it waits for in-flight
+        evictions instead of being shed. Works on a closed queue so a
+        draining engine can still finish its backlog; no admission
+        counters fire (the request was already counted)."""
+        with self._cond:
+            self._items.appendleft(request)
+            self._cond.notify_all()
 
     def wait_nonempty(self, timeout):
         """Park until something is queued (or close/timeout)."""

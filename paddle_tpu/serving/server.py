@@ -42,11 +42,14 @@ class Server:
     """
 
     def __init__(self, model=None, *, mode="generate", fn=None,
-                 max_slots=None, max_seq_len=None, prefill_buckets=None,
+                 max_slots=None, max_seq_len=None, block_size=None,
+                 num_blocks=None, prefill_chunk=None, prefix_cache=None,
                  queue_cap=None, max_batch=None, max_wait_s=0.002,
-                 cache_dtype=None, jit=True):
+                 cache_dtype=None, jit=True, strict_shapes=False,
+                 warmup=True):
         self.mode = mode
         self.metrics = ServingMetrics()
+        self._warmup = warmup
         if mode == "generate":
             if model is None:
                 raise ValueError("generate mode needs a GPT model")
@@ -57,8 +60,10 @@ class Server:
                 metrics=self.metrics)
             self.engine = SlotEngine(
                 model, max_slots=max_slots, max_seq_len=max_seq_len,
-                prefill_buckets=prefill_buckets, cache_dtype=cache_dtype,
-                metrics=self.metrics, queue=queue)
+                block_size=block_size, num_blocks=num_blocks,
+                prefill_chunk=prefill_chunk, prefix_cache=prefix_cache,
+                cache_dtype=cache_dtype, metrics=self.metrics,
+                queue=queue, strict_shapes=strict_shapes)
             self.batcher = None
         elif mode == "batch":
             target = fn if fn is not None else model
@@ -90,6 +95,9 @@ class Server:
 
     def start(self):
         if not self._started:
+            if self.engine is not None and self._warmup \
+                    and not self.engine._warmed:
+                self.engine.warmup()
             (self.engine or self.batcher).start()
             self._started = True
         return self

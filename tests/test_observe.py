@@ -120,8 +120,9 @@ def test_memory_analysis_is_not_a_retrace():
 
 
 def test_serving_compile_registry_matches_slot_engine_counts():
-    """The SlotEngine's own per-bucket counters and the global audit
-    see the same compiles (decode once, prefill once per rung used)."""
+    """The SlotEngine's own counters and the global audit see the same
+    compiles: ONE unified prefill+decode step, ONE CoW copy, and no
+    per-rung prefill programs (the bucket ladder is gone)."""
     from paddle_tpu.nlp.transformers import GPTConfig, GPTForPretraining
 
     paddle.seed(7)
@@ -130,16 +131,16 @@ def test_serving_compile_registry_matches_slot_engine_counts():
         max_seq_len=32, dropout=0.0, attn_dropout=0.0,
         use_parallel=False))
     gpt.eval()
-    eng = serving.SlotEngine(gpt, max_slots=2, prefill_buckets=(8,))
+    eng = serving.SlotEngine(gpt, max_slots=2, block_size=8)
     reqs = [eng.submit(np.arange(1, 5), max_new_tokens=3)
             for _ in range(2)]
     eng.start()
     for r in reqs:
         r.result(timeout=120)
     eng.shutdown()
-    assert len(observe.compile_events("serving.decode")) == \
+    assert len(observe.compile_events("serving.step")) == \
         eng.compile_counts["decode"] == 1
-    assert len(observe.compile_events("serving.prefill")) == 1
+    assert not observe.compile_events("serving.prefill")
 
 
 # ---------------------------------------------------------------------------

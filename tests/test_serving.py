@@ -1,12 +1,15 @@
-"""Serving subsystem: continuous-batching slot engine, dynamic batcher
-bucket ladder (one compile per bucket), admission control (queue-full
-shed, deadlines, graceful drain), deterministic fault injection, and the
-metrics/percentile registry.
+"""Serving subsystem: continuous-batching slot engine over a block-paged
+KV cache (prefix sharing, copy-on-write, chunked prefill — ONE compiled
+step), dynamic batcher bucket ladder (one compile per bucket), admission
+control (queue-full shed, block-capacity 429, deadlines, graceful
+drain), deterministic fault injection, and the metrics/percentile
+registry.
 
 Ref parity: paddle/fluid/inference/api (AnalysisPredictor/PredictorPool)
 + the Orca-style continuous batching the reference's serving stack
-approximates with request-level batching. Everything here runs on CPU
-with thread-based clients — no network.
+approximates with request-level batching, paged along the
+vLLM/SGLang lineage. Everything here runs on CPU with thread-based
+clients — no network.
 """
 
 from __future__ import annotations
@@ -24,14 +27,16 @@ import pytest
 import jax.numpy as jnp
 
 import paddle_tpu as paddle
-from paddle_tpu import profiler, serving
+from paddle_tpu import observe, profiler, serving
 from paddle_tpu.core.tensor import Tensor
 from paddle_tpu.framework import faults
 from paddle_tpu.nlp.transformers import GPTConfig, GPTForPretraining
 from paddle_tpu.serving import (
-    AdmissionQueue, DeadlineExceededError, DynamicBatcher, QueueFullError,
-    Request, RequestCancelled, ServerClosedError, ServingError,
-    ServingMetrics, bucket_for, bucket_ladder, pad_batch, prefill_ladder,
+    AdmissionQueue, BlockAllocator, CapacityExhaustedError,
+    DeadlineExceededError, DynamicBatcher, NULL_BLOCK, PoolExhausted,
+    PrefixCache, QueueFullError, Request, RequestCancelled,
+    ServerClosedError, ServingError, ServingMetrics, bucket_for,
+    bucket_ladder, pad_batch,
 )
 
 REPO = Path(__file__).resolve().parent.parent
@@ -52,15 +57,25 @@ def gpt():
 @pytest.fixture(scope="module")
 def server(gpt):
     """Shared started server: parity/metrics tests reuse it so the
-    compile-once invariant is checked ACROSS many requests."""
-    srv = serving.Server(gpt, max_slots=2, prefill_buckets=(8, 16)).start()
+    compile-once invariant is checked ACROSS many requests (and the
+    prefix cache sees real repeat traffic)."""
+    srv = serving.Server(gpt, max_slots=2, block_size=8).start()
     yield srv
     srv.shutdown(drain=True)
 
 
+_REF_PAD = 64   # fixture max_seq_len: references always forward this
+                # one shape so the per-op dispatch caches hit (causal
+                # attention makes the padded tail invisible to real rows)
+
+
 def _full_logits(m, ids):
-    out = m(Tensor(jnp.asarray(ids, jnp.int32)))
-    return np.asarray(out._value, np.float32)
+    ids = np.asarray(ids, np.int32).reshape(1, -1)
+    n = ids.shape[1]
+    padded = np.zeros((1, _REF_PAD), np.int32)
+    padded[:, :n] = ids
+    out = m(Tensor(jnp.asarray(padded, jnp.int32)))
+    return np.asarray(out._value, np.float32)[:, :n]
 
 
 def _ref_greedy(m, ids, n, eos=None):
@@ -109,11 +124,52 @@ def test_pad_batch_repeats_last_sample():
     np.testing.assert_array_equal(x[3], a[2])  # repeat, not zeros
 
 
-def test_prefill_ladder_caps_at_max_seq_len():
-    assert prefill_ladder(64, (8, 16, 128)) == [8, 16, 64]
-    assert prefill_ladder(64, "16,32") == [16, 32, 64]
-    # flag default parses and is topped by max_seq_len
-    assert prefill_ladder(1024)[-1] == 1024
+# ---------------------------------------------------------------------------
+# paged-KV host bookkeeping: block allocator + radix prefix cache
+# ---------------------------------------------------------------------------
+
+
+def test_block_allocator_refcounts_and_exhaustion():
+    a = BlockAllocator(4)                 # 1 reserved null + 3 usable
+    assert a.usable == 3 and a.free_blocks == 3
+    b1, b2 = a.alloc(), a.alloc()
+    assert b1 != NULL_BLOCK and b2 != NULL_BLOCK
+    assert a.blocks_in_use == 2
+    a.incref(b1)                          # shared by a second holder
+    assert not a.decref(b1)               # still referenced
+    assert a.decref(b1)                   # now actually freed
+    assert a.free_blocks == 2
+    with pytest.raises(ValueError):
+        a.incref(b1)                      # freed: not refcountable
+    a.alloc(), a.alloc()
+    with pytest.raises(PoolExhausted):
+        a.alloc()
+    with pytest.raises(ValueError):       # the null block is untouchable
+        a.decref(NULL_BLOCK)
+
+
+def test_prefix_cache_match_insert_cow_reclaim():
+    a = BlockAllocator(8)
+    c = PrefixCache(a, block_size=4)
+    toks = np.arange(1, 13, dtype=np.int32)        # 12 tokens, 3 blocks
+    blocks = [a.alloc() for _ in range(3)]
+    # only 8 positions really written -> only 2 full blocks indexed
+    assert c.insert(toks, blocks, written=8) == 2
+    assert a.refcount(blocks[0]) == 2              # cache holds a ref
+    # exact-prefix hit walks the cumulative hashes
+    hit, n, cow = c.match(toks, limit=11)
+    assert hit == blocks[:2] and n == 8 and cow is None
+    # divergence INSIDE block 2 -> CoW candidate (src block, rows kept)
+    div = toks.copy()
+    div[6] = 88
+    hit, n, cow = c.match(div, limit=11)
+    assert hit == blocks[:1] and n == 4
+    assert cow == (blocks[1], 2)                   # 2 matching rows kept
+    # reclaim frees cache-only blocks; slot-held ones are not stealable
+    for b in blocks:
+        a.decref(b)                                # slots release theirs
+    assert c.reclaim(2) == 2 and len(c) == 0
+    assert a.free_blocks == a.usable
 
 
 # ---------------------------------------------------------------------------
@@ -293,13 +349,17 @@ def test_slot_engine_concurrent_parity_and_midflight_join(gpt, server):
 
 
 def test_recycled_slot_stale_kv_masked(gpt):
-    """max_slots=1 forces B into the slot A just used, with A's longer
-    KV still in the pooled cache; B's parity proves the stale keys are
-    masked/overwritten, never attended."""
-    srv = serving.Server(gpt, max_slots=1, prefill_buckets=(8, 16)).start()
+    """max_slots=1 forces B into the slot A just used — and with the
+    prefix cache off, into the very physical blocks A's eviction freed
+    (the allocator reissues them), with A's longer KV still in the
+    rows; B's parity proves stale keys are masked/overwritten, never
+    attended."""
+    srv = serving.Server(gpt, max_slots=1, block_size=8,
+                         prefix_cache=False).start()
     try:
         a, b = _prompt(4, 12), _prompt(5, 4)
         out_a = srv.generate(a, max_new_tokens=4, timeout=120)
+        assert srv.engine.blocks_in_use == 0     # A's blocks recycled
         out_b = srv.generate(b, max_new_tokens=6, timeout=120)
         np.testing.assert_array_equal(out_a, _ref_greedy(gpt, a, 4))
         np.testing.assert_array_equal(out_b, _ref_greedy(gpt, b, 6))
@@ -328,14 +388,14 @@ def test_sampling_topk1_degenerates_to_greedy(gpt, server):
         np.testing.assert_array_equal(sampled, greedy)
 
 
-def test_slot_engine_compiles_exactly_once_per_bucket(server):
+def test_slot_engine_compiles_exactly_once_total(server):
     """After everything the shared server has decoded — many requests,
-    joins, evictions, both prefill buckets — every compiled program
-    traced exactly once."""
+    short and long prompts, joins, evictions — there is exactly ONE
+    compiled step (prefill folded in; the per-rung ladder is gone) and
+    one CoW helper, both traced at warmup."""
     counts = server.engine.compile_counts
-    assert counts["decode"] == 1
-    assert ("prefill", 8) in counts
-    assert all(v == 1 for v in counts.values()), counts
+    assert counts == {"decode": 1, "cow": 1}
+    assert not any(isinstance(k, tuple) for k in counts)
 
 
 def test_submit_validates_lengths(server):
@@ -345,13 +405,174 @@ def test_submit_validates_lengths(server):
         server.submit(np.zeros((0,), np.int32))
 
 
+def test_submit_block_capacity_sheds_with_429(gpt):
+    """A request whose block demand exceeds the whole pool sheds with
+    the retriable CapacityExhaustedError (429), distinct from the hard
+    ValueError for out-of-range lengths."""
+    srv = serving.Server(gpt, max_slots=2, block_size=8,
+                         num_blocks=3, warmup=False)   # 2 usable blocks
+    try:
+        with pytest.raises(CapacityExhaustedError) as ei:
+            srv.submit(np.arange(1, 11), max_new_tokens=10)  # 3 blocks
+        assert ei.value.status == 429 and ei.value.retriable
+        assert srv.metrics.get("rejected_capacity") == 1
+        # a pool-sized request is still admissible
+        assert srv.engine._blocks_needed(16) <= srv.engine._alloc.usable
+    finally:
+        srv.shutdown(drain=True)
+
+
+# ---------------------------------------------------------------------------
+# paged decode paths: chunked prefill, prefix sharing, copy-on-write
+# ---------------------------------------------------------------------------
+
+
+def _drive(eng, prompt, max_new=6, snoop_first_logits=False):
+    """Synchronously admit + step one request on an idle engine (no
+    thread — deterministic scheduling). Optionally snoops the logits
+    that seeded decode (the prefill output)."""
+    fut = eng.submit(np.asarray(prompt, np.int32), max_new_tokens=max_new,
+                     timeout=None)
+    eng._admit()
+    first = None
+    while eng.active:
+        eng._step()
+        if snoop_first_logits and first is None:
+            for s in eng._slots:
+                if s is not None and s.state == "decode":
+                    first = np.asarray(s.next_logits).copy()
+    return fut.result(timeout=5), first
+
+
+@pytest.fixture()
+def eng(gpt):
+    e = serving.SlotEngine(gpt, max_slots=2, block_size=8,
+                           prefill_chunk=8)
+    e.warmup()
+    return e
+
+
+def test_chunked_prefill_long_prompt_parity(gpt, eng):
+    """A prompt much longer than the chunk prefills across several
+    steps of the SAME compiled program — token parity and no extra
+    traces."""
+    p = _prompt(50, 29)                       # 29 tokens, chunk 8
+    out, _ = _drive(eng, p, max_new=5)
+    np.testing.assert_array_equal(out, _ref_greedy(gpt, p, 5))
+    assert eng.compile_counts == {"decode": 1, "cow": 1}
+    assert eng.metrics.get("prefill_tokens") >= 28
+
+
+def test_prefix_cache_hit_bitwise_identical_logits(gpt, eng):
+    """Warm run re-serves a finished prompt's blocks from the prefix
+    cache: fewer prompt tokens computed, same tokens, and the logits
+    that seed decode are BITWISE identical to the cold run's."""
+    p = list(range(1, 21))
+    cold_out, cold_logits = _drive(eng, p, snoop_first_logits=True)
+    assert eng.metrics.get("prefix_hit_blocks") == 0
+    assert eng.prefix_cache_size > 0          # eviction donated blocks
+    warm_out, warm_logits = _drive(eng, p, snoop_first_logits=True)
+    assert eng.metrics.get("prefix_hit_blocks") > 0
+    np.testing.assert_array_equal(cold_out, warm_out)
+    assert np.array_equal(cold_logits, warm_logits)   # bitwise
+    assert eng.metrics.get("prefix_hit_tokens") >= 16
+
+
+def test_cow_divergence_parity(gpt, eng):
+    """A second prompt diverging INSIDE a cached block triggers
+    copy-on-write (block copied, tail overwritten); its tokens must
+    match the uncached reference exactly, and the original cached
+    sequence must be unaffected."""
+    a = list(range(1, 18))
+    out_a, _ = _drive(eng, a)
+    b = list(a)
+    b[11] = 77                                # diverge inside block 2
+    out_b, _ = _drive(eng, b)
+    assert eng.metrics.get("cow_splits") >= 1
+    np.testing.assert_array_equal(out_b, _ref_greedy(gpt, b, 6))
+    # the shared source block was copied, not mutated: a re-run of the
+    # original prompt still matches
+    out_a2, _ = _drive(eng, a)
+    np.testing.assert_array_equal(out_a, out_a2)
+
+
+def test_alloc_block_fault_fails_request_no_leak(gpt, eng):
+    """Deterministic pool exhaustion mid-admission: the request fails,
+    partially reserved blocks roll back, the engine keeps serving."""
+    free0 = eng.free_blocks
+    with faults.inject("serving.alloc_block@2:raise"):
+        fut = eng.submit(_prompt(60, 10), max_new_tokens=6, timeout=None)
+        eng._admit()
+        with pytest.raises(faults.FaultError):
+            fut.result(5)
+    assert eng.free_blocks == free0           # rollback: no leak
+    p = _prompt(61, 6)
+    out, _ = _drive(eng, p, max_new=3)        # engine still serves
+    np.testing.assert_array_equal(out, _ref_greedy(gpt, p, 3))
+
+
+def test_cow_split_fault_fails_request_no_leak(gpt, eng):
+    a = list(range(1, 18))
+    _drive(eng, a)                            # populate the cache
+    b = list(a)
+    b[11] = 77
+    free0 = eng.free_blocks
+    with faults.inject("serving.cow_split@1:raise"):
+        fut = eng.submit(np.asarray(b, np.int32), max_new_tokens=6,
+                         timeout=None)
+        eng._admit()
+        with pytest.raises(faults.FaultError):
+            fut.result(5)
+    assert eng.free_blocks == free0
+    out, _ = _drive(eng, b)                   # retry succeeds, parity
+    np.testing.assert_array_equal(out, _ref_greedy(gpt, b, 6))
+
+
+def test_admission_waits_for_freed_blocks(gpt):
+    """A pool too small for two concurrent requests serialises them via
+    requeue-at-head instead of shedding: all complete, with parity,
+    and the prefix cache yields its blocks back under pressure."""
+    srv = serving.Server(gpt, max_slots=2, block_size=8,
+                         num_blocks=4).start()   # 3 usable blocks
+    try:
+        prompts = [_prompt(70 + i, 10) for i in range(3)]   # 2 blocks ea
+        futs = [srv.submit(p, max_new_tokens=4, timeout=120)
+                for p in prompts]
+        for p, f in zip(prompts, futs):
+            np.testing.assert_array_equal(
+                f.result(120), _ref_greedy(gpt, p, 4))
+        assert srv.metrics.get("completed") == 3
+        assert srv.metrics.get("rejected_capacity") == 0
+    finally:
+        srv.shutdown(drain=True)
+
+
+def test_steady_state_runs_under_no_retrace(gpt):
+    """strict_shapes: after warmup the engine loop runs inside
+    observe.no_retrace() — the whole run proves the unified paged step
+    never traces again (shape drift would raise RetraceError)."""
+    srv = serving.Server(gpt, max_slots=2, block_size=8,
+                         strict_shapes=True).start()
+    try:
+        for i in range(3):
+            p = _prompt(80 + i, 5 + 7 * i)    # mixed lengths on purpose
+            out = srv.generate(p, max_new_tokens=4, timeout=120)
+            np.testing.assert_array_equal(out, _ref_greedy(gpt, p, 4))
+        assert srv.engine.compile_counts == {"decode": 1, "cow": 1}
+        # the global compile audit agrees: one unified step, traced at
+        # warmup, never again under traffic
+        assert len(observe.compile_events("serving.step")) >= 1
+    finally:
+        srv.shutdown(drain=True)
+
+
 # ---------------------------------------------------------------------------
 # robustness: mid-decode faults, deadlines, cancel, drain
 # ---------------------------------------------------------------------------
 
 
 def test_mid_decode_fault_fails_inflight_engine_survives(gpt):
-    srv = serving.Server(gpt, max_slots=2, prefill_buckets=(8,)).start()
+    srv = serving.Server(gpt, max_slots=2, block_size=8).start()
     try:
         with faults.inject("serving.step@2:raise"):
             fut = srv.submit(_prompt(8, 4), max_new_tokens=8, timeout=120)
@@ -370,7 +591,7 @@ def test_deadline_exceeded_mid_decode(gpt):
     """A slow model (delay fault on every step) pushes a long request
     past its deadline while decoding; it must fail with
     DeadlineExceededError at a step boundary, not hang."""
-    srv = serving.Server(gpt, max_slots=1, prefill_buckets=(8,)).start()
+    srv = serving.Server(gpt, max_slots=1, block_size=8).start()
     try:
         with faults.inject("serving.step@*:delay:0.05"):
             fut = srv.submit(_prompt(10, 4), max_new_tokens=40,
@@ -383,7 +604,7 @@ def test_deadline_exceeded_mid_decode(gpt):
 
 
 def test_cancel_mid_decode_frees_slot(gpt):
-    srv = serving.Server(gpt, max_slots=1, prefill_buckets=(8,)).start()
+    srv = serving.Server(gpt, max_slots=1, block_size=8).start()
     try:
         with faults.inject("serving.step@*:delay:0.02"):
             fut = srv.submit(_prompt(11, 4), max_new_tokens=50,
@@ -404,7 +625,7 @@ def test_cancel_mid_decode_frees_slot(gpt):
 
 
 def test_graceful_drain_completes_all_pending(gpt):
-    srv = serving.Server(gpt, max_slots=2, prefill_buckets=(8,)).start()
+    srv = serving.Server(gpt, max_slots=2, block_size=8).start()
     prompts = [_prompt(20 + i, 4) for i in range(5)]
     futs = [srv.submit(p, max_new_tokens=2, timeout=120) for p in prompts]
     srv.shutdown(drain=True)        # blocks until queue + slots drain
@@ -415,7 +636,7 @@ def test_graceful_drain_completes_all_pending(gpt):
 
 
 def test_non_drain_shutdown_sheds_and_evicts(gpt):
-    srv = serving.Server(gpt, max_slots=1, prefill_buckets=(8,)).start()
+    srv = serving.Server(gpt, max_slots=1, block_size=8).start()
     with faults.inject("serving.step@*:delay:0.05"):
         futs = [srv.submit(_prompt(30 + i, 4), max_new_tokens=50,
                            timeout=120) for i in range(3)]
@@ -444,8 +665,28 @@ def test_metrics_snapshot_after_traffic(server):
     assert snap["qps"] > 0
     lat = snap["latency_s"]["e2e"]
     assert lat["p50"] <= lat["p95"] <= lat["p99"] <= lat["max"]
+    # paged-KV sections: block occupancy, prefix traffic, chunked prefill
+    blk = snap["kv_blocks"]
+    assert blk["total"] == server.engine._alloc.usable
+    assert 0 <= blk["occupancy"] <= 1.0 and blk["samples"] > 0
+    pfx = snap["prefix_cache"]
+    assert pfx["lookups"] >= c["completed"]
+    assert 0 <= pfx["hit_rate"] <= 1.0
+    cp = snap["chunked_prefill"]
+    assert cp["tokens"] >= c["completed"] and cp["tokens_per_step"] > 0
     # JSON-exportable end to end
     assert json.loads(server.metrics_json())["counters"] == c
+
+
+def test_prometheus_text_exports_paged_kv_gauges(server):
+    text = server.metrics_prometheus()
+    for needle in ("paddle_serving_kv_blocks_in_use",
+                   "paddle_serving_kv_blocks_total",
+                   "paddle_serving_kv_block_occupancy",
+                   "paddle_serving_prefix_cache_hit_rate",
+                   "paddle_serving_prefill_tokens_per_step",
+                   "paddle_serving_queue_depth"):
+        assert needle in text, needle
 
 
 def test_percentile_linear_interpolation_exact():
@@ -462,7 +703,8 @@ def test_percentile_linear_interpolation_exact():
 
 def test_serving_spans_land_in_chrome_trace(server, tmp_path):
     names = {e["name"] for e in profiler.events()}
-    assert {"serving.step", "serving.prefill"} <= names
+    assert "serving.step" in names
+    assert "serving.prefill" not in names   # the ladder is gone
     path = profiler.export_chrome_tracing(str(tmp_path / "trace.json"))
     with open(path) as f:
         trace = json.load(f)
@@ -542,7 +784,7 @@ def test_http_front_door(gpt):
     import urllib.error
     import urllib.request
 
-    srv = serving.Server(gpt, max_slots=2, prefill_buckets=(8,)).start()
+    srv = serving.Server(gpt, max_slots=2, block_size=8).start()
     try:
         try:
             httpd = serving.http_front(srv, port=0)
