@@ -23,6 +23,15 @@ CPU dry-run (the tier-1 smoke case):
 
     JAX_PLATFORMS=cpu python bench_serving.py --steps 2 --clients 1,2 \
         --max-new 3 --hidden 16 --layers 1 --heads 2 --vocab 31
+
+``--chaos`` switches to the resilience benchmark: a clean fleet run
+(`--replicas` supervised engines behind the Router) followed by the
+same offered load under a scripted fault schedule (transient step
+failures on every replica + one mid-run replica kill), emitting a
+``BENCH_SERVING_CHAOS`` object — goodput fraction (requests resolved
+successfully over submitted), restart/retry/replay counters, and the
+degraded-vs-clean p99 delta — so future rounds can ratchet
+degraded-mode performance.
 """
 
 from __future__ import annotations
@@ -101,6 +110,140 @@ def run_level(server, n_clients, steps, prompt_len, max_new, vocab,
     return row
 
 
+def run_fleet_level(server, n_clients, steps, prompt_len, max_new, vocab,
+                    kill_replica=None, kill_after_s=None):
+    """One closed-loop level against a fleet server; optionally kills
+    one replica mid-run. Returns (row, ok, failed)."""
+    ok, failed, errors = [0], [0], []
+    lock = threading.Lock()
+    barrier = threading.Barrier(n_clients)
+
+    def client(cid):
+        rng = np.random.RandomState(2000 + cid)
+        barrier.wait()
+        for _ in range(steps):
+            prompt = rng.randint(0, vocab, (prompt_len,)).astype(np.int32)
+            try:
+                out = server.generate(prompt, max_new_tokens=max_new,
+                                      timeout=120.0)
+                assert out.shape[0] >= prompt.size
+                with lock:
+                    ok[0] += 1
+            except Exception as e:  # noqa: BLE001 — typed errors count
+                with lock:
+                    failed[0] += 1
+                    errors.append(repr(e)[:200])
+
+    threads = [threading.Thread(target=client, args=(c,))
+               for c in range(n_clients)]
+    killer = None
+    if kill_replica is not None:
+        killer = threading.Timer(
+            kill_after_s or 0.5,
+            lambda: server.router.kill(kill_replica, "bench chaos kill"))
+        killer.daemon = True
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    if killer is not None:
+        killer.start()
+    for t in threads:
+        t.join()
+    if killer is not None:
+        killer.cancel()
+    wall = time.monotonic() - t0
+    snap = server.snapshot()
+    lat = snap["latency_s"].get("e2e", {})
+    total = ok[0] + failed[0]
+    row = {
+        "clients": n_clients,
+        "requests_ok": ok[0],
+        "requests_failed": failed[0],
+        "goodput": round(ok[0] / total, 4) if total else 0.0,
+        "wall_s": round(wall, 4),
+        "qps": round(ok[0] / wall, 3),
+        "p50_ms": round(lat.get("p50", 0.0) * 1e3, 3),
+        "p99_ms": round(lat.get("p99", 0.0) * 1e3, 3),
+    }
+    if errors:
+        row["first_error"] = errors[0]
+    return row
+
+
+def run_chaos(args, model, serving):
+    """--chaos: clean fleet baseline, then the same load under a
+    scripted fault schedule + one mid-run replica kill."""
+    from paddle_tpu.framework import faults
+
+    n_clients = [int(c) for c in args.clients.split(",") if c][0]
+    blocks_per_seq = -(-args.max_seq_len // args.block_size)
+    num_blocks = args.kv_blocks or \
+        args.dense_equiv_slots * blocks_per_seq + 1
+
+    def make_server(name):
+        return serving.Server(
+            model, replicas=args.replicas, max_slots=args.max_slots,
+            max_seq_len=args.max_seq_len, block_size=args.block_size,
+            num_blocks=num_blocks, prefill_chunk=args.prefill_chunk,
+            queue_cap=max(64, 2 * n_clients),
+            fleet=dict(hedge=False, retry_budget=3,
+                       liveness_timeout_s=30.0, backoff_base_s=0.05,
+                       name=name)).start()
+
+    srv = make_server("bclean")
+    clean = run_fleet_level(srv, n_clients, args.steps, args.prompt_len,
+                            args.max_new, args.vocab)
+    srv.shutdown(drain=True)
+    print(json.dumps({"level": "clean", **clean}))
+
+    srv = make_server("bchaos")
+    # transient step failures on every replica + one replica killed
+    # mid-run: exercises retry, failover replay, and restart at once
+    specs = [f"serving.replica_step[bchaos.r{i}]@{4 + 3 * i}:raise"
+             for i in range(args.replicas)]
+    with faults.inject(*specs):
+        chaos = run_fleet_level(
+            srv, n_clients, args.steps, args.prompt_len, args.max_new,
+            args.vocab, kill_replica="bchaos.r0",
+            kill_after_s=min(0.3, clean["wall_s"] * 0.3))
+    m = srv.metrics
+    # let the supervised restart land before reading the counter
+    deadline = time.monotonic() + 30
+    while m.get("replica_restarts") < m.get("replica_deaths") and \
+            time.monotonic() < deadline:
+        time.sleep(0.05)
+    counters = {k: m.get(k) for k in (
+        "replica_deaths", "replica_restarts", "retries", "replays",
+        "hedges", "stale_attempts", "retry_budget_exhausted",
+        "fleet_submitted", "fleet_completed", "fleet_failed")}
+    srv.shutdown(drain=True)
+    print(json.dumps({"level": "chaos", **chaos}))
+
+    result = {
+        "bench": "BENCH_SERVING_CHAOS",
+        "config": {
+            "replicas": args.replicas, "clients": n_clients,
+            "steps": args.steps, "prompt_len": args.prompt_len,
+            "max_new": args.max_new, "max_slots": args.max_slots,
+            "model": {"vocab": args.vocab, "hidden": args.hidden,
+                      "layers": args.layers, "heads": args.heads},
+        },
+        "clean": clean,
+        "chaos": chaos,
+        "goodput": chaos["goodput"],
+        "restarts": counters["replica_restarts"],
+        "retries": counters["retries"],
+        "replays": counters["replays"],
+        "counters": counters,
+        "p99_delta_ms": round(chaos["p99_ms"] - clean["p99_ms"], 3),
+    }
+    print(json.dumps(result))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(result, f, indent=1, sort_keys=True)
+    return 0
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--clients", default="1,8,32",
@@ -132,6 +275,12 @@ def main(argv=None):
     ap.add_argument("--max-seq-len", type=int, default=128)
     ap.add_argument("--json", default=None,
                     help="write the final BENCH_SERVING object here")
+    ap.add_argument("--chaos", action="store_true",
+                    help="resilience mode: clean fleet baseline + the "
+                    "same load under a scripted fault schedule; emits "
+                    "BENCH_SERVING_CHAOS instead of BENCH_SERVING")
+    ap.add_argument("--replicas", type=int, default=2,
+                    help="fleet size for --chaos")
     args = ap.parse_args(argv)
 
     import paddle_tpu as paddle
@@ -144,6 +293,9 @@ def main(argv=None):
                     max_seq_len=args.max_seq_len, dropout=0.0,
                     attn_dropout=0.0, use_parallel=False)
     model = GPTForPretraining(cfg)
+
+    if args.chaos:
+        return run_chaos(args, model, serving)
 
     # match the dense pool's bytes exactly: a dense [slots, nh, max_seq,
     # hd] pool holds slots*max_seq token rows = that many block rows of

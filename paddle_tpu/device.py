@@ -75,13 +75,23 @@ def memory_stats(device=None) -> dict:
         device = jax.devices()[device]
     dev_bytes = 0
     host_bytes = 0
+    # an array "rests on the device" when it sits in the device's
+    # DEFAULT memory space; only non-default host kinds (pinned_host
+    # offload) count as host-resident.  Comparing against the default
+    # kind matters on CPU backends whose default space is itself named
+    # *_host — there every array would otherwise census as offloaded.
+    try:
+        default_kind = device.default_memory().kind
+    except Exception:  # older jax without the memories API
+        default_kind = None
     for arr in jax.live_arrays():
         try:
             kind = getattr(arr.sharding, "memory_kind", None)
             for sh in arr.addressable_shards:
                 if sh.device == device:
                     nb = int(sh.data.size) * sh.data.dtype.itemsize
-                    if kind and "host" in str(kind):
+                    if kind and kind != default_kind \
+                            and "host" in str(kind):
                         host_bytes += nb
                     else:
                         dev_bytes += nb

@@ -32,6 +32,25 @@ A fault point is a named site the runtime passes through:
     serving.cow_split         before each copy-on-write block copy when a
                               prefix-cache hit diverges mid-block (raise
                               = deterministic mid-CoW failure)
+    serving.replica_step      each supervised (fleet) replica's loop
+                              iteration before its decode step; tagged
+                              with the replica name (delay = hung
+                              replica → watchdog eviction + failover
+                              replay; raise = transient step failure the
+                              Router retries; crash = process death for
+                              the fork-based slow tier)
+    serving.replica_heartbeat each supervised replica's heartbeat, every
+                              loop iteration including idle; tagged with
+                              the replica name (delay = the replica
+                              stops beating and the watchdog declares it
+                              dead; raise = the replica THREAD dies —
+                              detected as a crash)
+    serving.route             each fleet Router dispatch attempt (drop /
+                              raise = transient routing failure, retried
+                              under the request's budget)
+    serving.replay            each failover replay of a dead replica's
+                              request (raise = replay path failure →
+                              typed error to the client)
 
 Faults are scheduled programmatically::
 
@@ -42,7 +61,10 @@ or across process boundaries via the env var ``PADDLE_TPU_FAULTS``
 (semicolon-separated specs, read once at first use) — that is how the
 kill->restore tests schedule a crash inside a forked trainer.
 
-Spec grammar: ``site@occurrence:action[:arg]`` where occurrence is a
+Spec grammar: ``site[tag]@occurrence:action[:arg]`` where the optional
+``[tag]`` pins the spec to one tagged firer of a shared site (e.g.
+``serving.replica_step[fleet.r0]`` hits only replica r0; tagged specs
+count occurrences per tag, untagged specs per site) and occurrence is a
 1-based hit index (``3``), an inclusive range (``2-5``, open ``3-``), or
 ``*``; actions:
 
@@ -66,7 +88,7 @@ import time
 from . import monitor
 
 __all__ = ["FaultError", "DROP", "fault_point", "inject", "reset",
-           "parse_spec", "corrupt_leaf"]
+           "parse_spec", "corrupt_leaf", "ChaosSchedule"]
 
 
 class FaultError(RuntimeError):
@@ -84,35 +106,41 @@ _env_loaded = False
 
 
 class FaultSpec:
-    def __init__(self, site, lo, hi, action, arg=None):
+    def __init__(self, site, lo, hi, action, arg=None, tag=None):
         self.site = site
         self.lo = lo          # 1-based inclusive
         self.hi = hi          # inclusive; None = open
         self.action = action
         self.arg = arg
+        self.tag = tag        # None = any firer of the site
 
-    def matches(self, site, hit):
-        if site != self.site:
-            return False
+    def matches_occ(self, hit):
         if self.lo is None:   # '*'
             return True
         return hit >= self.lo and (self.hi is None or hit <= self.hi)
+
+    def matches(self, site, hit):
+        return site == self.site and self.matches_occ(hit)
 
     def __repr__(self):
         occ = "*" if self.lo is None else (
             str(self.lo) if self.hi == self.lo else
             f"{self.lo}-{'' if self.hi is None else self.hi}")
         arg = f":{self.arg}" if self.arg is not None else ""
-        return f"{self.site}@{occ}:{self.action}{arg}"
+        tag = f"[{self.tag}]" if self.tag is not None else ""
+        return f"{self.site}{tag}@{occ}:{self.action}{arg}"
 
 
 def parse_spec(text):
-    """``site@occ:action[:arg]`` -> FaultSpec."""
+    """``site[tag]@occ:action[:arg]`` -> FaultSpec."""
     site, _, rest = text.strip().partition("@")
     occ, _, act = rest.partition(":")
     if not site or not occ or not act:
         raise ValueError(f"bad fault spec {text!r} "
-                         "(want site@occurrence:action[:arg])")
+                         "(want site[tag]@occurrence:action[:arg])")
+    tag = None
+    if site.endswith("]") and "[" in site:
+        site, _, tag = site[:-1].partition("[")
     action, _, arg = act.partition(":")
     if occ == "*":
         lo = hi = None
@@ -121,7 +149,7 @@ def parse_spec(text):
         lo, hi = int(a), (int(b) if b else None)
     else:
         lo = hi = int(occ)
-    return FaultSpec(site, lo, hi, action, arg or None)
+    return FaultSpec(site, lo, hi, action, arg or None, tag=tag)
 
 
 def _load_env():
@@ -139,13 +167,17 @@ def _load_env():
 
 
 def reset(site=None):
-    """Zero hit counters (one site, or all). inject() does this for its
-    own sites so occurrence indices are test-local and deterministic."""
+    """Zero hit counters (one site — including its per-tag counters —
+    or all). inject() does this for its own sites so occurrence indices
+    are test-local and deterministic."""
     with _lock:
         if site is None:
             _hits.clear()
         else:
-            _hits.pop(site, None)
+            for key in [k for k in _hits
+                        if k == site
+                        or (isinstance(k, tuple) and k[0] == site)]:
+                del _hits[key]
 
 
 class inject:
@@ -218,8 +250,14 @@ def corrupt_leaf(path):
     return victim
 
 
-def fault_point(site, payload=None):
+def fault_point(site, payload=None, tag=None):
     """Pass through a named fault site.
+
+    `tag` names this particular firer of a shared site (e.g. the
+    replica passing through ``serving.replica_step``): tagged specs
+    match only their tag's own occurrence count, untagged specs the
+    site-global count — so one replica can be hung deterministically
+    while its siblings run clean.
 
     Returns `payload` (possibly transformed by a 'nan' fault), or the
     DROP sentinel when a 'drop' fault fires. May raise, sleep, or exit
@@ -230,7 +268,19 @@ def fault_point(site, payload=None):
         if not _specs:
             return payload  # zero-cost when nothing is scheduled
         _hits[site] = hit = _hits.get(site, 0) + 1
-        matched = [s for s in _specs if s.matches(site, hit)]
+        thit = None
+        if tag is not None:
+            key = (site, tag)
+            _hits[key] = thit = _hits.get(key, 0) + 1
+        matched = []
+        for s in _specs:
+            if s.site != site:
+                continue
+            if s.tag is None:
+                if s.matches_occ(hit):
+                    matched.append(s)
+            elif tag is not None and s.tag == tag and s.matches_occ(thit):
+                matched.append(s)
     for spec in matched:
         monitor.stat_add(f"faults.{site}")
         try:  # black-box the firing (lazy import: faults must stay leaf)
@@ -261,3 +311,47 @@ def fault_point(site, payload=None):
         else:
             raise ValueError(f"unknown fault action {spec.action!r}")
     return payload
+
+
+class ChaosSchedule(inject):
+    """`inject` that can certify its own delivery.
+
+    A chaos test schedules a scripted fault sweep, runs the workload,
+    then calls `verify()` to assert every *finite* spec actually fired
+    exactly as many times as planned — catching the classic silent
+    failure where a fault point was renamed (or never reached) and the
+    "chaos" test quietly certified a clean run. Open-ended specs
+    (`@*`, `@3-`) are excluded from the plan; `fired()` still reports
+    their sites' totals.
+    """
+
+    def __enter__(self):
+        super().__enter__()
+        self._base = {site: monitor.stat_get(f"faults.{site}")
+                      for site in {s.site for s in self._specs}}
+        return self
+
+    def fired(self):
+        """{site: fires since __enter__} over this schedule's sites."""
+        return {site: monitor.stat_get(f"faults.{site}") - base
+                for site, base in self._base.items()}
+
+    def planned(self):
+        """{site: expected fires} summed over finite occurrence windows."""
+        plan: dict = {}
+        for s in self._specs:
+            if s.lo is None or s.hi is None:
+                continue          # open-ended: no finite plan
+            plan[s.site] = plan.get(s.site, 0) + (s.hi - s.lo + 1)
+        return plan
+
+    def verify(self):
+        """Assert fired == planned per site; returns the fired dict."""
+        fired = self.fired()
+        for site, want in self.planned().items():
+            got = fired.get(site, 0)
+            if got != want:
+                raise AssertionError(
+                    f"chaos schedule under-delivered at {site}: "
+                    f"planned {want} fires, observed {got}")
+        return fired

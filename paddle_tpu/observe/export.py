@@ -143,9 +143,11 @@ class _Lines:
         return "\n".join(self.out) + "\n"
 
 
-def prometheus_text(serving=None, queue_depth=None):
+def prometheus_text(serving=None, queue_depth=None, fleet=None):
     """Prometheus/OpenMetrics text across monitor + timeline + goodput
-    (+ one server's ServingMetrics when handling its /metrics)."""
+    (+ one server's ServingMetrics when handling its /metrics; `fleet`
+    takes a Router/ReplicaSet `snapshot()` and adds the per-replica
+    state, restart, heartbeat, and breaker gauges)."""
     from ..framework import monitor
 
     L = _Lines()
@@ -221,5 +223,39 @@ def prometheus_text(serving=None, queue_depth=None):
                   help_="prompt tokens folded into each decode step")
     if queue_depth is not None:
         L.add("paddle_serving_queue_depth", queue_depth)
+
+    if fleet is not None:
+        from ..serving.fleet import REPLICA_STATE_CODES
+
+        breaker_codes = {"closed": 0, "open": 1, "half-open": 2}
+        for rep in fleet.get("replicas", ()):
+            labels = {"replica": rep["name"]}
+            L.add("paddle_serving_replica_state",
+                  REPLICA_STATE_CODES.get(rep["state"], -1),
+                  labels={**labels, "state": rep["state"]},
+                  help_="replica lifecycle state "
+                        "(0=starting 1=healthy 2=dead 3=backoff 4=stopped)")
+            L.add("paddle_serving_replica_restarts", rep["restarts"],
+                  mtype="counter", labels=labels,
+                  help_="supervised restarts of this replica")
+            L.add("paddle_serving_replica_deaths", rep["deaths"],
+                  mtype="counter", labels=labels)
+            L.add("paddle_serving_replica_heartbeats", rep["heartbeats"],
+                  mtype="counter", labels=labels,
+                  help_="engine loop iterations (liveness beats)")
+            L.add("paddle_serving_replica_load", rep["load"],
+                  labels=labels,
+                  help_="router-visible in-flight attempts")
+            br = rep.get("breaker", {})
+            L.add("paddle_serving_replica_breaker_state",
+                  breaker_codes.get(br.get("state"), -1),
+                  labels={**labels, "state": br.get("state", "?")},
+                  help_="circuit breaker (0=closed 1=open 2=half-open)")
+        if "brownout" in fleet:
+            L.add("paddle_serving_brownout_active", fleet["brownout"],
+                  help_="fleet brownout (load shedding) engaged")
+        if "in_flight" in fleet:
+            L.add("paddle_serving_fleet_in_flight", fleet["in_flight"],
+                  help_="client requests the Router is tracking")
 
     return L.text()

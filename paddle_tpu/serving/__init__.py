@@ -17,7 +17,12 @@ iteration-level ("continuous") batching in the Orca lineage:
 - `ServingMetrics` — QPS, queue depth, batch occupancy, latency
   percentiles; JSON-exportable, spans mirrored into the profiler's
   chrome trace (metrics.py);
-- `Server` / `http_front` — the user-facing shell (server.py).
+- `ReplicaSet` / `Router` — the resilient fleet: N supervised engine
+  replicas with heartbeat watchdogs and backed-off restarts, fronted
+  by failover replay, budgeted retries, hedging, per-replica circuit
+  breakers, and brownout shedding (fleet.py);
+- `Server` / `http_front` — the user-facing shell (server.py);
+  ``Server(model, replicas=2)`` serves through the fleet.
 
 Everything runs and certifies on CPU (`JAX_PLATFORMS=cpu`) with
 thread-based clients; no network required.
@@ -27,22 +32,28 @@ from .batcher import (  # noqa: F401
     DynamicBatcher, bucket_for, bucket_ladder, pad_batch,
 )
 from .engine import SlotEngine  # noqa: F401
+from .fleet import (  # noqa: F401
+    CircuitBreaker, Replica, ReplicaSet, Router, retriable,
+)
 from .metrics import ServingMetrics, percentile  # noqa: F401
 from .paging import (  # noqa: F401
     NULL_BLOCK, BlockAllocator, PoolExhausted, PrefixCache,
 )
 from .queueing import (  # noqa: F401
-    AdmissionQueue, CapacityExhaustedError, DeadlineExceededError,
-    QueueFullError, Request, RequestCancelled, ServerClosedError,
+    AdmissionQueue, BrownoutShedError, CapacityExhaustedError,
+    DeadlineExceededError, QueueFullError, ReplicaDiedError, Request,
+    RequestCancelled, RetriesExhaustedError, ServerClosedError,
     ServingError,
 )
 from .server import Server, http_front  # noqa: F401
 
 __all__ = [
-    "AdmissionQueue", "BlockAllocator", "CapacityExhaustedError",
-    "DeadlineExceededError", "DynamicBatcher", "NULL_BLOCK",
-    "PoolExhausted", "PrefixCache", "QueueFullError", "Request",
-    "RequestCancelled", "Server", "ServerClosedError", "ServingError",
-    "ServingMetrics", "SlotEngine", "bucket_for", "bucket_ladder",
-    "http_front", "pad_batch", "percentile",
+    "AdmissionQueue", "BlockAllocator", "BrownoutShedError",
+    "CapacityExhaustedError", "CircuitBreaker", "DeadlineExceededError",
+    "DynamicBatcher", "NULL_BLOCK", "PoolExhausted", "PrefixCache",
+    "QueueFullError", "Replica", "ReplicaDiedError", "ReplicaSet",
+    "Request", "RequestCancelled", "RetriesExhaustedError", "Router",
+    "Server", "ServerClosedError", "ServingError", "ServingMetrics",
+    "SlotEngine", "bucket_for", "bucket_ladder", "http_front",
+    "pad_batch", "percentile", "retriable",
 ]

@@ -40,7 +40,10 @@ Fault sites: ``serving.step`` fires once per decode step (a `raise`
 action fails every in-flight request deterministically while the engine
 stays up); ``serving.alloc_block`` on every physical block allocation
 (deterministic pool exhaustion); ``serving.cow_split`` before every
-copy-on-write block copy.
+copy-on-write block copy. Supervised (fleet-owned) engines additionally
+fire ``serving.replica_heartbeat`` every loop iteration and
+``serving.replica_step`` before each decode step, both tagged with the
+replica name — the fleet chaos sites (see framework/faults.py).
 """
 
 from __future__ import annotations
@@ -102,12 +105,18 @@ class SlotEngine:
     def __init__(self, model, *, max_slots=None, max_seq_len=None,
                  block_size=None, num_blocks=None, prefill_chunk=None,
                  prefix_cache=None, cache_dtype=None, metrics=None,
-                 queue=None, strict_shapes=False):
+                 queue=None, strict_shapes=False, name=None,
+                 supervised=False):
         import jax
         import jax.numpy as jnp
 
         model.eval()
         self.model = model
+        self.name = name or "engine"
+        self.supervised = supervised
+        self.last_beat = time.monotonic()
+        self.heartbeats = 0
+        self._abort_error = None
         self.max_slots = max_slots or flag("FLAGS_serving_max_batch")
         self.max_seq_len = min(max_seq_len or model.config.max_seq_len,
                                model.config.max_seq_len)
@@ -249,8 +258,8 @@ class SlotEngine:
     # -- request lifecycle --------------------------------------------------
 
     def submit(self, prompt_ids, *, max_new_tokens=16, eos_token_id=None,
-               timeout=None, do_sample=False, temperature=1.0, top_k=0,
-               seed=0):
+               timeout=None, priority=0, do_sample=False, temperature=1.0,
+               top_k=0, seed=0):
         """Admit one request (or shed); returns its `Request` future.
 
         Length beyond the model's positional range is a hard
@@ -276,9 +285,10 @@ class SlotEngine:
                 "retry with a smaller request or grow "
                 "FLAGS_serving_kv_blocks")
         return self.queue.submit(Request(
-            ids, timeout=timeout, max_new_tokens=max_new_tokens,
-            eos_token_id=eos_token_id, do_sample=do_sample,
-            temperature=temperature, top_k=top_k, seed=seed))
+            ids, timeout=timeout, priority=priority,
+            max_new_tokens=max_new_tokens, eos_token_id=eos_token_id,
+            do_sample=do_sample, temperature=temperature, top_k=top_k,
+            seed=seed))
 
     def _stage_blocks(self, ids, need_total):
         """Reserve the physical blocks for one admission: reuse every
@@ -509,6 +519,17 @@ class SlotEngine:
         self._thread.start()
         return self
 
+    def _beat(self):
+        """One liveness heartbeat per loop iteration. The fault point
+        fires only for supervised (fleet-owned) engines so a standalone
+        engine's loop never consumes fleet fault occurrences; a `delay`
+        action here stalls the beat (watchdog declares the replica
+        dead), a `raise` kills the engine THREAD (detected as a crash)."""
+        if self.supervised:
+            faults.fault_point("serving.replica_heartbeat", tag=self.name)
+        self.heartbeats += 1
+        self.last_beat = time.monotonic()
+
     def _loop(self):
         import contextlib
 
@@ -516,9 +537,11 @@ class SlotEngine:
             else contextlib.nullcontext()
         with guard:
             while True:
+                self._beat()
                 if self._abort.is_set():
-                    self._fail_all_active(RequestCancelled(
-                        "server aborted (non-drain shutdown)"))
+                    self._fail_all_active(
+                        self._abort_error or RequestCancelled(
+                            "server aborted (non-drain shutdown)"))
                     return
                 self._admit()
                 if self.active == 0:
@@ -527,10 +550,29 @@ class SlotEngine:
                     self.queue.wait_nonempty(0.02)
                     continue
                 try:
+                    if self.supervised:
+                        faults.fault_point("serving.replica_step",
+                                           tag=self.name)
                     self._step()
                 except Exception as e:  # noqa: BLE001 — engine stays up
                     self.metrics.inc("step_errors")
                     self._fail_all_active(e)
+
+    def abandon(self, error):
+        """Supervisor-side takeover of a dead/hung replica: stop the
+        loop at its next boundary, fail every in-flight and queued
+        request with `error` (typically `ReplicaDiedError`, which the
+        fleet Router intercepts and replays elsewhere). Never joins the
+        thread — a hung replica's thread may be sleeping inside an
+        injected delay (or real stuck I/O) for a long time; the replica
+        object is simply discarded and rebuilt."""
+        self._abort_error = error
+        self._abort.set()
+        self.queue.close(drain=False)
+        # a thread already dead (crashed loop) never reaches the abort
+        # branch — sweep its stranded slots from the supervisor thread
+        if self._thread is not None and not self._thread.is_alive():
+            self._fail_all_active(error)
 
     def shutdown(self, drain=True, timeout=None):
         """Stop. drain=True finishes queued + in-flight requests first;

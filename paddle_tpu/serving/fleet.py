@@ -1,0 +1,873 @@
+"""Resilient serving fleet: replica supervision, failover, retry/hedge.
+
+Ref parity: the reference serves through paddle_serving's brpc front
+over a *pool* of predictors with health checks and fast rejection on
+overload; a sidecar supervisor restarts dead workers. Here the pool is
+in-process: a `ReplicaSet` supervises N thread-backed `SlotEngine`
+replicas (shared weights, private KV pools/queues) with step-heartbeat
+liveness watchdogs, and a `Router` fronts them with the full
+availability toolkit:
+
+- **Failover replay.** A replica that crashes or stops heartbeating is
+  declared dead, evicted, and rebuilt with exponential backoff +
+  deterministic jitter. Its in-flight requests are replayed *from the
+  original prompt* on a healthy replica. The client future is
+  first-wins (queueing.Request), so even if the "dead" replica was
+  merely hung and later completes, exactly one outcome is delivered —
+  dedup is on the client request id; greedy replay is bitwise
+  token-identical because decode is deterministic in the weights.
+- **Retries.** Retriable failures (`CapacityExhaustedError`, injected
+  `FaultError`, transient routing errors) are retried under a
+  per-request retry budget with deadline propagation: each attempt's
+  timeout is the *remaining* client deadline, never a fresh one.
+  Failover replays charge a separate replay budget, not the retry
+  budget — a replica dying is the fleet's fault, not the request's.
+- **Hedging.** A request whose single attempt outlives a p95-based
+  delay (2x observed e2e p95, floored at `hedge_min_s`; or a fixed
+  `hedge_after_s`) gets a second attempt on a *different* replica.
+  First completion wins, the loser is cancelled (its slot is
+  reclaimed at the next step boundary), and the late outcome is
+  suppressed by the first-wins future.
+- **Graceful degradation.** Per-replica circuit breakers open after
+  `breaker_threshold` consecutive failures, park the replica for
+  `breaker_cooloff_s`, then admit a single half-open probe whose
+  outcome closes or re-opens the breaker. Brownout mode — entered on
+  sustained load above `brownout_high` (fraction of total slot+queue
+  capacity), exited below `brownout_low`, or forced via
+  `set_brownout()` — clamps `max_new_tokens` and sheds requests whose
+  `priority` is below the floor with the retriable 429
+  `BrownoutShedError`.
+
+Chaos sites (framework/faults.py): ``serving.replica_step`` and
+``serving.replica_heartbeat`` fire inside supervised engine loops
+(tagged with the replica name, so ``serving.replica_step[fleet.r0]``
+hangs exactly one replica), ``serving.route`` on every Router dispatch,
+``serving.replay`` on every failover replay. `faults.ChaosSchedule`
+certifies a scripted sweep actually delivered every planned fire.
+
+Threading/locking: one re-entrant Router lock guards flight state;
+engine done-callbacks run on engine threads and re-enter the Router
+through it. The ReplicaSet's own lock covers only replica state
+transitions and is never held across Router calls; queue condition
+locks never run callbacks (queueing.py resolves futures outside its
+locks) — so the lock order Router -> queue is acyclic.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+
+from ..framework import faults
+from ..framework.flags import flag
+from .engine import SlotEngine
+from .metrics import ServingMetrics
+from .queueing import (
+    AdmissionQueue, BrownoutShedError, DeadlineExceededError, Request,
+    RequestCancelled, ReplicaDiedError, RetriesExhaustedError, ServerClosedError,
+    ServingError,
+)
+
+__all__ = ["CircuitBreaker", "Replica", "ReplicaSet", "Router", "retriable",
+           "REPLICA_STATE_CODES"]
+
+#: numeric encodings for the per-replica state gauge (observe/export.py)
+REPLICA_STATE_CODES = {"starting": 0, "healthy": 1, "dead": 2,
+                       "backoff": 3, "stopped": 4}
+
+
+def retriable(error):
+    """May the fleet transparently re-run the same request after this
+    failure? Client-caused outcomes (cancel, deadline) never are;
+    injected `FaultError`s model transient infrastructure errors and
+    are; everything else consults the error's own `retriable` attr
+    (see queueing.ServingError)."""
+    if isinstance(error, (RequestCancelled, DeadlineExceededError)):
+        return False
+    if isinstance(error, faults.FaultError):
+        return True
+    return bool(getattr(error, "retriable", False))
+
+
+class CircuitBreaker:
+    """Per-replica failure gate: closed -> open after `threshold`
+    consecutive failures -> (after `cooloff_s`) half-open admitting one
+    probe -> closed on probe success, re-open on probe failure.
+
+    `clock` is injectable so unit tests drive the cooloff without
+    sleeping. Thread-safe; `allow()` has the probe side effect (at most
+    one caller wins the half-open slot per cooloff window).
+    """
+
+    def __init__(self, threshold=5, cooloff_s=1.0, clock=time.monotonic):
+        if threshold < 1:
+            raise ValueError(f"threshold must be >= 1, got {threshold}")
+        self.threshold = threshold
+        self.cooloff_s = cooloff_s
+        self._clock = clock
+        self._lock = threading.Lock()
+        self.state = "closed"
+        self.failures = 0          # consecutive
+        self._opened_at = None
+        self._probing = False
+
+    def allow(self):
+        """May a request be routed here right now? In half-open state
+        only the first caller gets True (the probe)."""
+        with self._lock:
+            if self.state == "closed":
+                return True
+            if self.state == "open" and \
+                    self._clock() - self._opened_at >= self.cooloff_s:
+                self.state = "half-open"
+                self._probing = False
+            if self.state == "half-open" and not self._probing:
+                self._probing = True
+                return True
+            return False
+
+    def probe_ready(self):
+        """Would `allow()` grant a half-open probe? (No side effect.)"""
+        with self._lock:
+            if self.state == "open":
+                return self._clock() - self._opened_at >= self.cooloff_s
+            return self.state == "half-open" and not self._probing
+
+    def record_success(self):
+        with self._lock:
+            self.state = "closed"
+            self.failures = 0
+            self._probing = False
+
+    def record_failure(self):
+        with self._lock:
+            self.failures += 1
+            if self.state == "half-open" or self.failures >= self.threshold:
+                self.state = "open"
+                self._opened_at = self._clock()
+                self._probing = False
+
+    def snapshot(self):
+        with self._lock:
+            return {"state": self.state, "failures": self.failures}
+
+
+class Replica:
+    """One supervised engine slot: the engine itself (rebuilt across
+    generations), liveness/restart bookkeeping, and its breaker."""
+
+    def __init__(self, index, name, breaker):
+        self.index = index
+        self.name = name
+        self.engine: SlotEngine | None = None
+        self.generation = 0       # bumped per (re)build
+        self.state = "starting"   # REPLICA_STATE_CODES keys
+        self.deaths = 0
+        self.restarts = 0
+        self.load = 0             # router-visible in-flight attempts
+        self.breaker = breaker
+        self.restart_at = None    # monotonic time the backoff expires
+        # deterministic per-replica jitter stream (seeded on the name)
+        self._rng = random.Random(name)
+
+    @property
+    def alive(self):
+        """Is the engine thread actually running?"""
+        e = self.engine
+        return (e is not None and e._thread is not None
+                and e._thread.is_alive())
+
+    def beat_age(self, now):
+        e = self.engine
+        return 0.0 if e is None else now - e.last_beat
+
+    def snapshot(self):
+        e = self.engine
+        return {
+            "name": self.name, "state": self.state,
+            "generation": self.generation, "deaths": self.deaths,
+            "restarts": self.restarts, "load": self.load,
+            "heartbeats": 0 if e is None else e.heartbeats,
+            "breaker": self.breaker.snapshot(),
+        }
+
+
+class ReplicaSet:
+    """Supervises N thread-backed `SlotEngine` replicas over one model.
+
+    All replicas share the model weights (and the metrics registry) but
+    own private KV pools, admission queues, and compiled callables —
+    one fresh decode trace per (re)build, so the fleet's compile
+    invariant is one 'decode'/'cow' trace per engine generation.
+
+    Builds are serialized on an internal lock: tracing temporarily
+    swaps the model's parameter handles (engine.functional_apply), so
+    two replicas must never trace concurrently. Already-compiled
+    engines never touch the model object again (fixed shapes, no
+    retrace), so serving continues during a sibling's rebuild.
+
+    `poll()` is the watchdog: a healthy replica whose engine thread
+    died is a *crash*; one whose heartbeat is older than
+    `liveness_timeout_s` is a *hang*. Both are declared dead — the
+    `on_death(replica, error)` hook (the Router's failover entry) runs
+    first, then `engine.abandon(error)` fails everything still on the
+    dead engine, then a rebuild is scheduled after
+    ``backoff_base_s * 2^(deaths-1)`` (capped at `backoff_max_s`,
+    scaled by deterministic per-replica jitter in [0.5, 1.5)).
+    """
+
+    def __init__(self, model, n_replicas=2, *, engine_kw=None, metrics=None,
+                 liveness_timeout_s=2.0, backoff_base_s=0.05,
+                 backoff_max_s=2.0, breaker_threshold=5,
+                 breaker_cooloff_s=1.0, breaker_clock=time.monotonic,
+                 queue_cap=None, warmup=True, name="fleet", on_death=None):
+        if n_replicas < 1:
+            raise ValueError(f"n_replicas must be >= 1, got {n_replicas}")
+        self.model = model
+        self.name = name
+        self.metrics = metrics if metrics is not None else ServingMetrics()
+        self.engine_kw = dict(engine_kw or {})
+        self.queue_cap = queue_cap or flag("FLAGS_serving_queue_cap")
+        self.liveness_timeout_s = liveness_timeout_s
+        self.backoff_base_s = backoff_base_s
+        self.backoff_max_s = backoff_max_s
+        self._warmup = warmup
+        self.on_death = on_death
+        self.replicas = [
+            Replica(i, f"{name}.r{i}",
+                    CircuitBreaker(breaker_threshold, breaker_cooloff_s,
+                                   clock=breaker_clock))
+            for i in range(n_replicas)
+        ]
+        self._lock = threading.Lock()       # replica state transitions
+        self._build_lock = threading.Lock()  # serialize traces
+        self._started = False
+
+    def start(self):
+        if self._started:
+            return self
+        for r in self.replicas:
+            self._build(r)
+        self._started = True
+        return self
+
+    def _build(self, replica):
+        """(Re)build one replica: fresh queue, fresh engine, fresh
+        single trace. The replica turns healthy only once serving."""
+        with self._build_lock:
+            q = AdmissionQueue(self.queue_cap, metrics=self.metrics)
+            eng = SlotEngine(self.model, metrics=self.metrics, queue=q,
+                             name=replica.name, supervised=True,
+                             **self.engine_kw)
+            if self._warmup:
+                eng.warmup()
+            eng.start()
+            replica.engine = eng
+            replica.generation += 1
+            replica.state = "healthy"
+            replica.restart_at = None
+
+    def healthy(self):
+        return [r for r in self.replicas if r.state == "healthy"]
+
+    def poll(self, now=None):
+        """One watchdog pass: detect crashes/hangs, run due restarts."""
+        now = time.monotonic() if now is None else now
+        for r in self.replicas:
+            if r.state == "healthy":
+                if not r.alive:
+                    self.declare_dead(r, "engine thread died")
+                elif r.beat_age(now) > self.liveness_timeout_s:
+                    self.declare_dead(
+                        r, f"no heartbeat for {r.beat_age(now):.2f}s "
+                           f"(liveness timeout {self.liveness_timeout_s}s)")
+            elif r.state == "backoff" and now >= (r.restart_at or 0):
+                self.restart(r)
+
+    def declare_dead(self, replica, reason):
+        """Evict one replica: failover hook first (the Router replays
+        its in-flight requests while their old attempts are still
+        pending — first-wins futures make the race safe), then abandon
+        the engine, then schedule the backed-off rebuild."""
+        with self._lock:
+            if replica.state != "healthy":
+                return False
+            replica.state = "dead"
+            replica.deaths += 1
+        self.metrics.inc("replica_deaths")
+        err = ReplicaDiedError(f"replica {replica.name} declared dead: "
+                               f"{reason}")
+        if self.on_death is not None:
+            try:
+                self.on_death(replica, err)
+            except Exception:  # noqa: BLE001 — watchdog must survive
+                self.metrics.inc("supervisor_errors")
+        old = replica.engine
+        if old is not None:
+            old.abandon(err)
+        with self._lock:
+            backoff = min(self.backoff_base_s * (2 ** (replica.deaths - 1)),
+                          self.backoff_max_s)
+            backoff *= 0.5 + replica._rng.random()
+            replica.restart_at = time.monotonic() + backoff
+            replica.state = "backoff"
+        return True
+
+    def restart(self, replica):
+        self._build(replica)
+        replica.restarts += 1
+        self.metrics.inc("replica_restarts")
+        # a rebuilt replica starts with a clean slate
+        replica.breaker.record_success()
+
+    def kill(self, name, reason="killed (admin/chaos)"):
+        """Admin/chaos hook: declare one replica dead right now, ahead
+        of the watchdog. Returns the replica."""
+        for r in self.replicas:
+            if r.name == name:
+                self.declare_dead(r, reason)
+                return r
+        raise KeyError(f"no replica named {name!r}")
+
+    def compile_counts(self):
+        """{replica name: engine compile counters} — the fleet compile
+        invariant is every engine at one decode + one cow trace."""
+        return {r.name: (r.engine.compile_counts if r.engine else {})
+                for r in self.replicas}
+
+    def queue_depth(self):
+        return sum(r.engine.queue.depth for r in self.replicas
+                   if r.state == "healthy" and r.engine is not None)
+
+    def capacity(self):
+        """Total (slots + queue) headroom across healthy replicas."""
+        return sum(r.engine.max_slots + r.engine.queue.cap
+                   for r in self.healthy() if r.engine is not None)
+
+    def in_flight(self):
+        return sum(r.engine.active + r.engine.queue.depth
+                   for r in self.healthy() if r.engine is not None)
+
+    def snapshot(self):
+        return {"name": self.name,
+                "replicas": [r.snapshot() for r in self.replicas]}
+
+    def shutdown(self, drain=True, timeout=None):
+        for r in self.replicas:
+            e = r.engine
+            if e is not None:
+                try:
+                    e.shutdown(drain=drain, timeout=timeout)
+                except Exception:  # noqa: BLE001 — best-effort stop
+                    pass
+            r.state = "stopped"
+        self._started = False
+
+
+class _Flight:
+    """Router-side state of one client request across its attempts."""
+
+    __slots__ = ("client", "retries_left", "replays_left", "attempts",
+                 "live", "stale", "hedge_ids", "hedged", "parked",
+                 "first_dispatch", "last_dispatch", "retry_at",
+                 "retry_exclude")
+
+    def __init__(self, client, retries, replays):
+        self.client = client
+        self.retries_left = retries
+        self.replays_left = replays
+        self.attempts: dict = {}   # attempt id -> (replica, attempt req)
+        self.live: set = set()     # attempt ids not yet resolved
+        self.stale: set = set()    # live ids whose outcome is ignored
+        self.hedge_ids: set = set()
+        self.hedged = False
+        self.parked = False        # no dispatchable replica right now
+        self.first_dispatch = None
+        self.last_dispatch = None
+        self.retry_at = None       # deferred-retry due time
+        self.retry_exclude = None
+
+    def active(self):
+        return [aid for aid in self.live if aid not in self.stale]
+
+
+class Router:
+    """Fleet front: routes client requests over a `ReplicaSet` with
+    failover replay, budgeted retries, hedging, circuit breaking, and
+    brownout shedding. See the module docstring for semantics.
+
+    `submit()` mirrors `SlotEngine.submit` (plus `priority=`) and
+    returns the same first-wins `Request` future, so `Server` and
+    clients are agnostic to whether they talk to one engine or a fleet.
+    """
+
+    def __init__(self, model, replicas=2, *, engine_kw=None, metrics=None,
+                 retry_budget=2, replay_budget=None, retry_backoff_s=0.0,
+                 hedge=True, hedge_after_s=None, hedge_min_s=0.25,
+                 liveness_timeout_s=2.0, tick_s=0.005,
+                 brownout_high=0.95, brownout_low=0.5,
+                 brownout_max_new=8, brownout_priority=1,
+                 breaker_threshold=5, breaker_cooloff_s=1.0,
+                 breaker_clock=time.monotonic,
+                 backoff_base_s=0.05, backoff_max_s=2.0,
+                 queue_cap=None, warmup=True, name="fleet"):
+        self.metrics = metrics if metrics is not None else ServingMetrics()
+        self.replica_set = ReplicaSet(
+            model, replicas, engine_kw=engine_kw, metrics=self.metrics,
+            liveness_timeout_s=liveness_timeout_s,
+            backoff_base_s=backoff_base_s, backoff_max_s=backoff_max_s,
+            breaker_threshold=breaker_threshold,
+            breaker_cooloff_s=breaker_cooloff_s,
+            breaker_clock=breaker_clock, queue_cap=queue_cap,
+            warmup=warmup, name=name, on_death=self._on_replica_death)
+        self.name = name
+        self.retry_budget = retry_budget
+        self.replay_budget = replay_budget if replay_budget is not None \
+            else max(replicas, 2)
+        self.retry_backoff_s = retry_backoff_s
+        self._hedge_enabled = hedge and replicas > 1
+        self._hedge_after_s = hedge_after_s
+        self._hedge_min_s = hedge_min_s
+        self._tick_s = tick_s
+        self._brownout_high = brownout_high
+        self._brownout_low = brownout_low
+        self._brownout_max_new = brownout_max_new
+        self._brownout_priority = brownout_priority
+        self._lock = threading.RLock()
+        self._flights: dict = {}        # client req id -> _Flight
+        self._attempt_index: dict = {}  # attempt req id -> _Flight
+        self._brownout = False
+        self._brownout_force = None     # None = auto hysteresis
+        self._stop = threading.Event()
+        self._sup = None
+        self._max_seq_len = None
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self):
+        if self._sup is not None:
+            return self
+        self.replica_set.start()
+        self._max_seq_len = self.replica_set.replicas[0].engine.max_seq_len
+        self._stop.clear()
+        self._sup = threading.Thread(target=self._supervise,
+                                     name=f"{self.name}-supervisor",
+                                     daemon=True)
+        self._sup.start()
+        return self
+
+    def shutdown(self, drain=True, timeout=None):
+        """Stop the fleet. drain=True waits for in-flight flights to
+        settle (bounded by `timeout`, default 30s) before stopping the
+        supervisor and engines; drain=False fails every open flight."""
+        if drain:
+            deadline = time.monotonic() + (30.0 if timeout is None
+                                           else timeout)
+            while time.monotonic() < deadline:
+                with self._lock:
+                    if not self._flights:
+                        break
+                time.sleep(0.005)
+        self._stop.set()
+        if self._sup is not None:
+            self._sup.join(timeout)
+            self._sup = None
+        if not drain:
+            with self._lock:
+                for flight in list(self._flights.values()):
+                    self._finish_fail(flight, ServerClosedError(
+                        f"request {flight.client.id} aborted: "
+                        "fleet shutdown"))
+        self.replica_set.shutdown(drain=drain, timeout=timeout)
+
+    # -- client API ---------------------------------------------------------
+
+    def submit(self, prompt_ids, *, max_new_tokens=16, eos_token_id=None,
+               timeout=None, priority=0, do_sample=False, temperature=1.0,
+               top_k=0, seed=0):
+        """Route one request; returns its first-wins `Request` future.
+
+        Client errors (empty/over-long prompt) raise synchronously;
+        brownout sheds below-floor priorities with `BrownoutShedError`
+        (429, retriable). Everything downstream — replica choice,
+        retries, failover, hedging — is the Router's problem."""
+        import numpy as np
+
+        if self._sup is None:
+            self.start()
+        if timeout is None:
+            timeout = flag("FLAGS_serving_default_timeout_s") or None
+        ids = np.asarray(prompt_ids, np.int32).reshape(-1)
+        if ids.size == 0:
+            raise ValueError("empty prompt")
+        if ids.size + max_new_tokens > self._max_seq_len:
+            raise ValueError(
+                f"prompt ({ids.size}) + max_new_tokens ({max_new_tokens}) "
+                f"exceeds fleet max_seq_len {self._max_seq_len}")
+        if self.brownout_active and priority < self._brownout_priority:
+            self.metrics.inc("brownout_sheds")
+            raise BrownoutShedError(
+                f"request shed: fleet in brownout, priority {priority} "
+                f"below floor {self._brownout_priority}")
+        client = Request(ids, timeout=timeout, priority=priority,
+                         max_new_tokens=max_new_tokens,
+                         eos_token_id=eos_token_id, do_sample=do_sample,
+                         temperature=temperature, top_k=top_k, seed=seed)
+        self.metrics.inc("fleet_submitted")
+        flight = _Flight(client, self.retry_budget, self.replay_budget)
+        with self._lock:
+            self._flights[client.id] = flight
+            # single cleanup point: whatever resolves the client —
+            # success, typed failure, or client-side cancel — cancels
+            # every attempt still pending and drops the flight
+            client.add_done_callback(self._client_done_cb)
+            self._dispatch(flight)
+        return client
+
+    def generate(self, prompt_ids, timeout=None, **kw):
+        """Blocking convenience: submit + result."""
+        return self.submit(prompt_ids, **kw).result(timeout)
+
+    # -- introspection ------------------------------------------------------
+
+    @property
+    def brownout_active(self):
+        if self._brownout_force is not None:
+            return self._brownout_force
+        return self._brownout
+
+    def set_brownout(self, on):
+        """Force brownout on/off, or None to return to automatic
+        load-fraction hysteresis."""
+        self._brownout_force = on
+
+    @property
+    def queue_depth(self):
+        return self.replica_set.queue_depth()
+
+    @property
+    def in_flight(self):
+        with self._lock:
+            return len(self._flights)
+
+    def compile_counts(self):
+        return self.replica_set.compile_counts()
+
+    def kill(self, name, reason="killed (admin/chaos)"):
+        return self.replica_set.kill(name, reason)
+
+    def snapshot(self):
+        snap = self.replica_set.snapshot()
+        snap["brownout"] = self.brownout_active
+        with self._lock:
+            snap["in_flight"] = len(self._flights)
+        return snap
+
+    # -- flight machinery ---------------------------------------------------
+
+    def _dispatch(self, flight, exclude=frozenset(), hedge=False):
+        """Place one attempt. With `hedge` the exclusion is strict (no
+        point hedging onto the replica already working the request);
+        otherwise a lone excluded replica is better than parking."""
+        with self._lock:
+            client = flight.client
+            if client.done():
+                return
+            remaining = None
+            if client.deadline is not None:
+                remaining = client.deadline - time.monotonic()
+                if remaining <= 0:
+                    self._finish_fail(flight, DeadlineExceededError(
+                        f"request {client.id} deadline exceeded before "
+                        "dispatch"))
+                    return
+            try:
+                if faults.fault_point("serving.route") is faults.DROP:
+                    raise faults.FaultError(
+                        "injected fault at serving.route (drop)")
+            except Exception as e:  # noqa: BLE001 — routing failure
+                self._route_failed(flight, e)
+                return
+            replica = self._pick(exclude)
+            if replica is None:
+                if hedge:
+                    flight.hedged = False   # retry the hedge next tick
+                    return
+                if exclude:
+                    replica = self._pick(frozenset())
+                if replica is None:
+                    if not flight.active():
+                        flight.parked = True
+                        self.metrics.inc("parked")
+                    return
+            flight.parked = False
+            gen = dict(client.gen)
+            if self.brownout_active:
+                gen["max_new_tokens"] = min(
+                    gen.get("max_new_tokens", 16), self._brownout_max_new)
+            try:
+                attempt = replica.engine.submit(
+                    client.payload, timeout=remaining,
+                    priority=client.priority, **gen)
+            except ServingError as e:
+                replica.breaker.record_failure()
+                self._attempt_failed(flight, replica, e)
+                return
+            except Exception as e:  # noqa: BLE001 — client error
+                self._finish_fail(flight, e)
+                return
+            flight.attempts[attempt.id] = (replica, attempt)
+            flight.live.add(attempt.id)
+            if hedge:
+                flight.hedge_ids.add(attempt.id)
+                self.metrics.inc("hedges")
+            self._attempt_index[attempt.id] = flight
+            replica.load += 1
+            flight.last_dispatch = time.monotonic()
+            if flight.first_dispatch is None:
+                flight.first_dispatch = flight.last_dispatch
+            self.metrics.inc("routed")
+            attempt.add_done_callback(self._attempt_done_cb)
+
+    def _pick(self, exclude):
+        """Deterministic replica choice: a breaker awaiting its
+        half-open probe goes first (lowest index — otherwise an open
+        breaker could starve forever behind healthy siblings), else the
+        least-loaded replica with a closed breaker (ties to the lowest
+        index)."""
+        candidates = [r for r in self.replica_set.replicas
+                      if r.state == "healthy" and r not in exclude]
+        for r in candidates:
+            if r.breaker.state != "closed" and r.breaker.probe_ready() \
+                    and r.breaker.allow():
+                return r
+        best = None
+        for r in candidates:
+            if r.breaker.state != "closed":
+                continue
+            if best is None or (r.load, r.index) < (best.load, best.index):
+                best = r
+        return best
+
+    def _route_failed(self, flight, err):
+        if flight.retries_left > 0:
+            flight.retries_left -= 1
+            self.metrics.inc("retries")
+            self._defer(flight, frozenset())
+            return
+        self.metrics.inc("retry_budget_exhausted")
+        self._finish_fail(flight, RetriesExhaustedError(
+            f"request {flight.client.id} routing failed after exhausting "
+            f"its retry budget: {err}", last_error=err))
+
+    def _defer(self, flight, exclude):
+        if self.retry_backoff_s > 0:
+            flight.retry_at = time.monotonic() + self.retry_backoff_s
+            flight.retry_exclude = exclude
+        else:
+            self._dispatch(flight, exclude)
+
+    def _attempt_done_cb(self, attempt):
+        """Done-callback on every attempt future; runs on the engine
+        (or cancelling) thread. First-wins on the client request makes
+        duplicate outcomes — hedge losers, a hung replica's late
+        completion — harmless, but we count them for certification."""
+        with self._lock:
+            flight = self._attempt_index.pop(attempt.id, None)
+            if flight is None:
+                return
+            replica, _ = flight.attempts.get(attempt.id, (None, None))
+            if replica is not None:
+                replica.load = max(replica.load - 1, 0)
+            was_stale = attempt.id in flight.stale
+            flight.live.discard(attempt.id)
+            flight.stale.discard(attempt.id)
+            if was_stale:
+                self.metrics.inc("stale_attempts")
+                return
+            err = attempt._error
+            if err is None:
+                if replica is not None:
+                    replica.breaker.record_success()
+                if self._finish_ok(flight, attempt._value):
+                    if attempt.id in flight.hedge_ids:
+                        self.metrics.inc("hedge_wins")
+                else:
+                    self.metrics.inc("duplicates_suppressed")
+                return
+            if flight.client.done():
+                return
+            if replica is not None and not isinstance(
+                    err, (RequestCancelled, DeadlineExceededError)):
+                replica.breaker.record_failure()
+            self._attempt_failed(flight, replica, err)
+
+    def _attempt_failed(self, flight, replica, err):
+        if flight.client.done():
+            return
+        if flight.active():
+            # a sibling (hedge) attempt is still running — let it win
+            # rather than charging the request's budgets
+            return
+        if isinstance(err, ReplicaDiedError):
+            self._replay(flight, replica, err)
+            return
+        if retriable(err) and flight.retries_left > 0:
+            flight.retries_left -= 1
+            self.metrics.inc("retries")
+            exclude = frozenset() if replica is None \
+                else frozenset((replica,))
+            self._defer(flight, exclude)
+            return
+        if retriable(err):
+            self.metrics.inc("retry_budget_exhausted")
+            err = RetriesExhaustedError(
+                f"request {flight.client.id} failed after exhausting its "
+                f"retry budget: {err}", last_error=err)
+        self._finish_fail(flight, err)
+
+    def _replay(self, flight, replica, err):
+        """Failover: re-run a dead replica's request from its original
+        prompt on a healthy sibling. Charged to the replay budget, not
+        the retry budget."""
+        if flight.replays_left <= 0:
+            self._finish_fail(flight, err)
+            return
+        flight.replays_left -= 1
+        self.metrics.inc("replays")
+        try:
+            faults.fault_point("serving.replay")
+        except Exception as e:  # noqa: BLE001 — replay path failure
+            self._finish_fail(flight, ReplicaDiedError(
+                f"failover replay of request {flight.client.id} "
+                f"failed: {e}"))
+            return
+        exclude = frozenset() if replica is None else frozenset((replica,))
+        self._dispatch(flight, exclude)
+
+    def _on_replica_death(self, replica, err):
+        """ReplicaSet hook, called BEFORE the dead engine is abandoned:
+        stale-mark every live attempt on it (their late outcomes must
+        not reach clients or breakers) and replay each affected flight
+        elsewhere. Runs on the supervisor (or kill-caller) thread."""
+        with self._lock:
+            affected = []
+            for aid, flight in list(self._attempt_index.items()):
+                rep, _ = flight.attempts.get(aid, (None, None))
+                if rep is replica and aid in flight.live \
+                        and aid not in flight.stale:
+                    flight.stale.add(aid)
+                    if flight not in (f for f, _ in affected):
+                        affected.append((flight, aid))
+            seen = set()
+            for flight, _aid in affected:
+                if id(flight) in seen:
+                    continue
+                seen.add(id(flight))
+                if flight.client.done():
+                    continue
+                self._replay(flight, replica, err)
+
+    def _finish_ok(self, flight, value):
+        if flight.client._complete(value):
+            self.metrics.inc("fleet_completed")
+            return True
+        return False
+
+    def _finish_fail(self, flight, err):
+        if flight.client._fail(err):
+            self.metrics.inc("fleet_failed")
+            return True
+        return False
+
+    def _client_done_cb(self, client):
+        """Runs once per client request, on whatever thread resolved it
+        (engine success, router failure, or client cancel): cancel all
+        still-pending attempts and drop the flight."""
+        with self._lock:
+            flight = self._flights.pop(client.id, None)
+            if flight is None:
+                return
+            for aid in list(flight.live):
+                if aid in flight.stale:
+                    continue
+                flight.stale.add(aid)
+                _, att = flight.attempts[aid]
+                att.cancel()
+
+    # -- supervisor ---------------------------------------------------------
+
+    def _supervise(self):
+        while not self._stop.wait(self._tick_s):
+            try:
+                now = time.monotonic()
+                self.replica_set.poll(now)
+                self._brownout_tick()
+                self._hedge_tick(now)
+                self._flight_tick(now)
+            except Exception:  # noqa: BLE001 — the supervisor never dies
+                self.metrics.inc("supervisor_errors")
+
+    def _brownout_tick(self):
+        if self._brownout_force is not None:
+            return
+        cap = self.replica_set.capacity()
+        if cap == 0:
+            # nothing healthy: maximum degradation until a restart lands
+            self._brownout = True
+            return
+        frac = self.replica_set.in_flight() / cap
+        if not self._brownout and frac >= self._brownout_high:
+            self._brownout = True
+            self.metrics.inc("brownout_entries")
+        elif self._brownout and frac <= self._brownout_low:
+            self._brownout = False
+
+    def _hedge_delay(self):
+        if self._hedge_after_s is not None:
+            return self._hedge_after_s
+        p95 = self.metrics.latency_percentiles("e2e", (95,))[95]
+        if p95 is None:
+            return None   # no signal yet: don't hedge blind
+        return max(self._hedge_min_s, 2.0 * p95)
+
+    def _hedge_tick(self, now):
+        if not self._hedge_enabled:
+            return
+        delay = self._hedge_delay()
+        if delay is None:
+            return
+        with self._lock:
+            for flight in list(self._flights.values()):
+                if flight.hedged or flight.parked or flight.client.done():
+                    continue
+                active = flight.active()
+                if len(active) != 1 or flight.last_dispatch is None:
+                    continue
+                if now - flight.last_dispatch < delay:
+                    continue
+                flight.hedged = True
+                exclude = frozenset(flight.attempts[aid][0]
+                                    for aid in active)
+                self._dispatch(flight, exclude, hedge=True)
+
+    def _flight_tick(self, now):
+        """Deferred retries, parked re-dispatch, deadline sweep."""
+        with self._lock:
+            for flight in list(self._flights.values()):
+                client = flight.client
+                if client.done():
+                    continue
+                if client.deadline is not None and now > client.deadline \
+                        and not flight.active():
+                    self._finish_fail(flight, DeadlineExceededError(
+                        f"request {client.id} deadline exceeded while "
+                        "awaiting redispatch"))
+                    continue
+                if flight.retry_at is not None and now >= flight.retry_at:
+                    flight.retry_at, exclude = None, flight.retry_exclude
+                    flight.retry_exclude = None
+                    self._dispatch(flight, exclude or frozenset())
+                elif flight.parked:
+                    self._dispatch(flight)

@@ -34,9 +34,16 @@ class ServingMetrics:
     (prompt positions written by chunked prefill), `prompt_tokens` /
     `prefix_lookups` / `prefix_hit_blocks` / `prefix_hit_tokens` /
     `cow_splits` (prefix-cache traffic), `rejected_capacity` (429 sheds
-    whose block demand exceeds the pool). Every inc() also bumps the
-    global `framework.monitor` counter ``serving.<name>`` so serving
-    shows up in the same stat registry as the rest of the runtime.
+    whose block demand exceeds the pool). The fleet (fleet.py) adds its
+    own family over the same registry: `fleet_submitted` /
+    `fleet_completed` / `fleet_failed` (client-level, exactly-once),
+    `routed`, `retries`, `replays`, `hedges`, `hedge_wins`,
+    `duplicates_suppressed`, `stale_attempts`, `parked`,
+    `replica_deaths`, `replica_restarts`, `brownout_entries`,
+    `brownout_sheds`, `retry_budget_exhausted`, `supervisor_errors`.
+    Every inc() also bumps the global `framework.monitor` counter
+    ``serving.<name>`` so serving shows up in the same stat registry as
+    the rest of the runtime.
     """
 
     def __init__(self):

@@ -25,21 +25,29 @@ from ..framework import faults, monitor
 __all__ = [
     "ServingError", "QueueFullError", "CapacityExhaustedError",
     "ServerClosedError", "DeadlineExceededError", "RequestCancelled",
+    "ReplicaDiedError", "RetriesExhaustedError", "BrownoutShedError",
     "Request", "AdmissionQueue",
 ]
 
 
 class ServingError(RuntimeError):
     """Base of the serving-side request failures; `status` carries the
-    HTTP status the optional front door maps it to."""
+    HTTP status the optional front door maps it to, `retriable` whether
+    a client (or the in-process fleet Router) may transparently retry
+    the same request, and `retry_after_s` the backoff hint the HTTP
+    front surfaces as a ``Retry-After`` header on 429/503."""
 
     status = 500
+    retriable = False
+    retry_after_s = 1.0
 
 
 class QueueFullError(ServingError):
-    """Load shed: the bounded admission queue is at capacity."""
+    """Load shed: the bounded admission queue is at capacity.
+    Retriable — the overload is transient by construction."""
 
     status = 429
+    retriable = True
 
 
 class CapacityExhaustedError(ServingError):
@@ -52,9 +60,12 @@ class CapacityExhaustedError(ServingError):
 
 
 class ServerClosedError(ServingError):
-    """Submitted after shutdown began (or pending at a non-drain stop)."""
+    """Submitted after shutdown began (or pending at a non-drain stop).
+    Retriable: a fresh server (or a restarted fleet replica) would
+    accept the same request."""
 
     status = 503
+    retriable = True
 
 
 class DeadlineExceededError(ServingError):
@@ -69,6 +80,33 @@ class RequestCancelled(ServingError):
     status = 499
 
 
+class ReplicaDiedError(ServingError):
+    """The replica holding this request crashed or stopped heartbeating;
+    the fleet Router replays the request from its original prompt on a
+    healthy replica (failover), so a client normally never sees this —
+    it surfaces only when every replay avenue is exhausted."""
+
+    status = 503
+    retriable = True
+
+
+class RetriesExhaustedError(ServingError):
+    """A retriable failure outlived the request's retry budget; the
+    final underlying error rides along as ``last_error``."""
+
+    status = 503
+    retriable = True
+
+    def __init__(self, message, last_error=None):
+        super().__init__(message)
+        self.last_error = last_error
+
+
+class BrownoutShedError(QueueFullError):
+    """Shed by fleet brownout: under sustained overload, requests below
+    the priority floor are rejected first (429, retriable)."""
+
+
 _ids = itertools.count(1)
 
 
@@ -77,27 +115,46 @@ class Request:
 
     `payload` is mode-specific (a 1-D prompt id array for the decode
     engine, one unbatched sample for the dynamic batcher); generation
-    parameters ride along in `gen`. The completing thread calls
-    `_complete`/`_fail`; clients block in `result()`.
+    parameters ride along in `gen`, and `priority` (higher = more
+    important) steers fleet brownout shedding. The completing thread
+    calls `_complete`/`_fail`; clients block in `result()`.
+
+    Resolution is FIRST-WINS and exactly-once: `_complete`/`_fail`
+    return True only for the call that actually resolved the future, so
+    a fleet Router can race a failover replay against a hung replica's
+    late completion and deliver exactly one outcome to the client.
+    Done-callbacks registered via `add_done_callback` fire exactly once,
+    on the resolving thread, after the event is set.
     """
 
-    def __init__(self, payload, *, timeout=None, **gen):
+    def __init__(self, payload, *, timeout=None, priority=0, **gen):
         self.id = next(_ids)
         self.payload = payload
         self.gen = gen
+        self.priority = priority
         self.arrival = time.monotonic()
         self.deadline = self.arrival + timeout if timeout else None
         self._event = threading.Event()
         self._value = None
         self._error = None
         self._cancel = False
+        self._lock = threading.Lock()
+        self._callbacks: list = []
+        self._wake = None     # queue-side nudge, attached on admission
 
     # -- client side --------------------------------------------------------
 
     def cancel(self):
-        """Request eviction; honoured at the engine's next step
-        boundary (mid-decode cancellation)."""
+        """Cancel: fails the future PROMPTLY with `RequestCancelled` (a
+        client blocked in `result()` wakes immediately instead of at the
+        engine's next step boundary) and flags the request so the queue
+        sweeps it and the engine evicts its slot at the next boundary —
+        the work is reclaimed, not just the wait."""
         self._cancel = True
+        self._fail(RequestCancelled(f"request {self.id} cancelled"))
+        wake = self._wake
+        if wake is not None:
+            wake()
 
     @property
     def cancelled(self):
@@ -106,8 +163,27 @@ class Request:
     def done(self):
         return self._event.is_set()
 
-    def result(self, timeout=None):
+    def add_done_callback(self, fn):
+        """Run ``fn(self)`` once the future resolves (immediately if it
+        already has). Exceptions from ``fn`` are swallowed — a broken
+        observer must not corrupt the completing thread."""
+        with self._lock:
+            if not self._event.is_set():
+                self._callbacks.append(fn)
+                return
+        try:
+            fn(self)
+        except Exception:  # noqa: BLE001 — observer-only
+            pass
+
+    def result(self, timeout=None, cancel_on_timeout=False):
+        """Block for the outcome. With ``cancel_on_timeout`` a client
+        that gives up also cancels the request, so its queue slot /
+        decode slot is reclaimed instead of leaking until the deadline
+        (or forever, if it had none)."""
         if not self._event.wait(timeout):
+            if cancel_on_timeout:
+                self.cancel()
             raise TimeoutError(
                 f"request {self.id} not done within {timeout}s")
         if self._error is not None:
@@ -126,13 +202,26 @@ class Request:
         return self.deadline is not None and \
             (now if now is not None else time.monotonic()) > self.deadline
 
+    def _resolve(self, value, error):
+        with self._lock:
+            if self._event.is_set():
+                return False          # first resolution won; drop this one
+            self._value = value
+            self._error = error
+            self._event.set()
+            callbacks, self._callbacks = self._callbacks, []
+        for fn in callbacks:
+            try:
+                fn(self)
+            except Exception:  # noqa: BLE001 — observer-only
+                pass
+        return True
+
     def _complete(self, value):
-        self._value = value
-        self._event.set()
+        return self._resolve(value, None)
 
     def _fail(self, error):
-        self._error = error
-        self._event.set()
+        return self._resolve(None, error)
 
 
 class AdmissionQueue:
@@ -194,39 +283,61 @@ class AdmissionQueue:
                     f"request {request.id} rejected: queue at capacity "
                     f"{self.cap}")
             self._items.append(request)
+            request._wake = self._notify
             self._cond.notify_all()
         self._count("accepted")
         return request
 
+    def _notify(self):
+        """Nudge the queue condition (a cancelled request wakes a
+        blocked pop so its entry is swept promptly, not lazily)."""
+        with self._cond:
+            self._cond.notify_all()
+
     def pop(self, timeout=0.0):
         """Next live request, or None when nothing arrived within
         `timeout` (or the queue is drained). Expired/cancelled requests
-        are failed in place and skipped."""
+        are failed in place and skipped — their futures resolve OUTSIDE
+        the queue lock, so done-callbacks may safely touch queues."""
         deadline = time.monotonic() + timeout
-        with self._cond:
-            while True:
+        while True:
+            got = None
+            finished = False
+            to_fail: list = []
+            with self._cond:
                 while self._items:
                     req = self._items.popleft()
                     if req.cancelled:
-                        self._count("cancelled")
-                        req._fail(RequestCancelled(
-                            f"request {req.id} cancelled while queued"))
+                        to_fail.append(("cancelled", req, RequestCancelled(
+                            f"request {req.id} cancelled while queued")))
                         continue
                     if req.expired():
-                        self._count("timeouts")
-                        req._fail(DeadlineExceededError(
-                            f"request {req.id} deadline exceeded after "
-                            f"{time.monotonic() - req.arrival:.3f}s in "
-                            "queue"))
+                        to_fail.append((
+                            "timeouts", req, DeadlineExceededError(
+                                f"request {req.id} deadline exceeded "
+                                f"after "
+                                f"{time.monotonic() - req.arrival:.3f}s "
+                                "in queue")))
                         continue
-                    faults.fault_point("serving.dequeue", req)
-                    return req
-                if self._closed:
-                    return None
-                remaining = deadline - time.monotonic()
-                if remaining <= 0:
-                    return None
-                self._cond.wait(remaining)
+                    got = req
+                    break
+                if got is None:
+                    if self._closed:
+                        finished = True
+                    else:
+                        remaining = deadline - time.monotonic()
+                        if remaining <= 0:
+                            finished = True
+                        else:
+                            self._cond.wait(remaining)
+            for name, req, err in to_fail:
+                self._count(name)
+                req._fail(err)
+            if got is not None:
+                faults.fault_point("serving.dequeue", got)
+                return got
+            if finished:
+                return None
 
     def requeue(self, request: Request):
         """Push an already-admitted request back to the queue *head*
@@ -248,14 +359,17 @@ class AdmissionQueue:
 
     def close(self, drain=True):
         """Stop admissions. drain=True leaves queued requests for the
-        engine to finish; drain=False fails them all right now."""
+        engine to finish; drain=False fails them all right now (futures
+        resolve outside the queue lock)."""
+        dropped: list = []
         with self._cond:
             self._closed = True
             self._drain = drain
             if not drain:
                 while self._items:
-                    req = self._items.popleft()
-                    self._count("rejected_closed")
-                    req._fail(ServerClosedError(
-                        f"request {req.id} dropped: non-drain shutdown"))
+                    dropped.append(self._items.popleft())
             self._cond.notify_all()
+        for req in dropped:
+            self._count("rejected_closed")
+            req._fail(ServerClosedError(
+                f"request {req.id} dropped: non-drain shutdown"))

@@ -13,6 +13,12 @@ optional stdlib front door mapping the same API onto HTTP.
         fut = srv.submit([1, 2, 3], max_new_tokens=8)
         ids = fut.result()              # np.int32 [prompt + generated]
         print(srv.snapshot()["qps"])
+
+Pass ``replicas=N`` (N >= 2) to serve through the resilient fleet
+(fleet.Router): N supervised engine replicas with failover replay,
+retries, hedging, circuit breakers, and brownout shedding — same
+`submit()/generate()` API, plus `priority=` on submit. Extra Router
+knobs ride in ``fleet=dict(...)``.
 """
 
 from __future__ import annotations
@@ -46,11 +52,28 @@ class Server:
                  num_blocks=None, prefill_chunk=None, prefix_cache=None,
                  queue_cap=None, max_batch=None, max_wait_s=0.002,
                  cache_dtype=None, jit=True, strict_shapes=False,
-                 warmup=True):
+                 warmup=True, replicas=1, fleet=None):
         self.mode = mode
         self.metrics = ServingMetrics()
         self._warmup = warmup
-        if mode == "generate":
+        self.router = None
+        if mode == "generate" and (replicas > 1 or fleet is not None):
+            if model is None:
+                raise ValueError("generate mode needs a GPT model")
+            from .fleet import Router
+
+            engine_kw = dict(
+                max_slots=max_slots, max_seq_len=max_seq_len,
+                block_size=block_size, num_blocks=num_blocks,
+                prefill_chunk=prefill_chunk, prefix_cache=prefix_cache,
+                cache_dtype=cache_dtype, strict_shapes=strict_shapes)
+            self.router = Router(
+                model, max(replicas, 1), engine_kw=engine_kw,
+                metrics=self.metrics, queue_cap=queue_cap,
+                warmup=warmup, **dict(fleet or {}))
+            self.engine = None
+            self.batcher = None
+        elif mode == "generate":
             if model is None:
                 raise ValueError("generate mode needs a GPT model")
             from .queueing import AdmissionQueue
@@ -95,10 +118,13 @@ class Server:
 
     def start(self):
         if not self._started:
-            if self.engine is not None and self._warmup \
-                    and not self.engine._warmed:
-                self.engine.warmup()
-            (self.engine or self.batcher).start()
+            if self.router is not None:
+                self.router.start()
+            else:
+                if self.engine is not None and self._warmup \
+                        and not self.engine._warmed:
+                    self.engine.warmup()
+                (self.engine or self.batcher).start()
             self._started = True
         return self
 
@@ -111,24 +137,45 @@ class Server:
 
     def shutdown(self, drain=True):
         """Graceful drain (finish queued + in-flight work) or fast stop
-        (shed the queue, evict in-flight at the next step)."""
-        if self.engine is not None:
+        (shed the queue, evict in-flight at the next step). Idempotent:
+        a server never started — or already shut down — is a no-op, so
+        double-shutdown (e.g. an explicit call inside a `with` block)
+        never re-runs drain against stopped backends."""
+        if not self._started:
+            return
+        self._started = False
+        if self.router is not None:
+            self.router.shutdown(drain=drain)
+        elif self.engine is not None:
             self.engine.shutdown(drain=drain)
         else:
             self.batcher.close(drain=drain)
-        self._started = False
 
     # -- request API --------------------------------------------------------
 
     @property
     def queue(self):
-        return (self.engine or self.batcher).queue
+        """The single backend's admission queue (engine/batcher modes).
+        Fleet mode has one queue per replica — use `queue_depth()`."""
+        backend = self.engine or self.batcher
+        if backend is None:
+            raise AttributeError(
+                "fleet mode has a queue per replica; use queue_depth()")
+        return backend.queue
+
+    def queue_depth(self):
+        if self.router is not None:
+            return self.router.queue_depth
+        return (self.engine or self.batcher).queue.depth
 
     def submit(self, payload, **kw):
         """Admit one request; returns a `Request` future. Generate mode
-        takes a 1-D prompt + generation kwargs; batch mode one sample."""
+        takes a 1-D prompt + generation kwargs (plus `priority=` in
+        fleet mode); batch mode one sample."""
         if not self._started:
             self.start()
+        if self.router is not None:
+            return self.router.submit(payload, **kw)
         if self.engine is not None:
             return self.engine.submit(payload, **kw)
         return self.batcher.submit(payload, **kw)
@@ -138,19 +185,25 @@ class Server:
         return self.submit(prompt_ids, **kw).result(timeout)
 
     def snapshot(self):
-        return self.metrics.snapshot(queue_depth=self.queue.depth)
+        snap = self.metrics.snapshot(queue_depth=self.queue_depth())
+        if self.router is not None:
+            snap["fleet"] = self.router.snapshot()
+        return snap
 
     def metrics_json(self, **kw):
-        return self.metrics.to_json(queue_depth=self.queue.depth, **kw)
+        return json.dumps(self.snapshot(), **kw)
 
     def metrics_prometheus(self):
         """Prometheus text exposition of this server's metrics unified
         with the global monitor/timeline/goodput registries
-        (observe.prometheus_text)."""
+        (observe.prometheus_text); fleet mode adds the per-replica
+        state/restart/breaker gauges."""
         from .. import observe
 
+        fleet = self.router.snapshot() if self.router is not None else None
         return observe.prometheus_text(serving=self.metrics,
-                                       queue_depth=self.queue.depth)
+                                       queue_depth=self.queue_depth(),
+                                       fleet=fleet)
 
 
 def http_front(server: Server, host="127.0.0.1", port=0):
@@ -168,11 +221,13 @@ def http_front(server: Server, host="127.0.0.1", port=0):
         def log_message(self, *a):  # quiet
             pass
 
-        def _reply(self, code, obj):
+        def _reply(self, code, obj, headers=None):
             body = json.dumps(obj).encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
             self.end_headers()
             self.wfile.write(body)
 
@@ -214,9 +269,21 @@ def http_front(server: Server, host="127.0.0.1", port=0):
                 out = server.generate(prompt, timeout=timeout, **req)
                 self._reply(200, {"ids": np.asarray(out).tolist()})
             except ServingError as e:
-                self._reply(e.status, {"error": str(e)})
+                # clients get the same backoff contract the in-process
+                # Router uses: `retriable` says whether resubmitting the
+                # identical request can succeed, and overload/unavailable
+                # responses carry a Retry-After hint
+                headers = {}
+                if e.status in (429, 503):
+                    headers["Retry-After"] = \
+                        f"{type(e).retry_after_s:g}"
+                self._reply(e.status, {
+                    "error": str(e),
+                    "type": type(e).__name__,
+                    "retriable": bool(e.retriable),
+                }, headers=headers)
             except Exception as e:  # noqa: BLE001 — bad request shape
-                self._reply(400, {"error": str(e)})
+                self._reply(400, {"error": str(e), "retriable": False})
 
     httpd = ThreadingHTTPServer((host, port), Handler)
     thread = threading.Thread(target=httpd.serve_forever,

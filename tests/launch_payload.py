@@ -8,14 +8,25 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(__file__)))
 
+# --compiled-step builds a 2-host x 4-device global mesh (VERDICT r3
+# item 4); the plain collective payload keeps the original 2+2 layout.
+# Device count must be pinned BEFORE jax initialises: via XLA_FLAGS
+# (works on every jax) with the jax_num_cpu_devices option layered on
+# top where this jax knows it.
+_ndev = 4 if ("--compiled-step" in sys.argv
+              or "--compiled-pp-step" in sys.argv) else 2
+_flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
+          if not f.startswith("--xla_force_host_platform_device_count")]
+_flags.append(f"--xla_force_host_platform_device_count={_ndev}")
+os.environ["XLA_FLAGS"] = " ".join(_flags)
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-# --compiled-step builds a 2-host x 4-device global mesh (VERDICT r3
-# item 4); the plain collective payload keeps the original 2+2 layout
-jax.config.update("jax_num_cpu_devices",
-                  4 if ("--compiled-step" in sys.argv
-                        or "--compiled-pp-step" in sys.argv) else 2)
+try:
+    jax.config.update("jax_num_cpu_devices", _ndev)
+except AttributeError:  # older jax: the XLA_FLAGS pin above applies
+    pass
 
 from paddle_tpu.distributed.parallel import init_parallel_env  # noqa: E402
 

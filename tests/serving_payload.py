@@ -1,0 +1,66 @@
+"""Forked serving-fleet payload for the chaos tests.
+
+Runs a tiny fleet (or a single-engine server, for the clean reference)
+over a fixed prompt set and writes the results as JSON. Faults are
+injected by the parent through the PADDLE_TPU_FAULTS environment
+variable, so a `crash` action takes down this whole process — the
+parent asserts on the exit code, then on the JSON of a clean rerun.
+
+Usage: python serving_payload.py <fleet|single> <out.json>
+"""
+
+import json
+import sys
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import serving
+from paddle_tpu.nlp.transformers import GPTConfig, GPTForPretraining
+
+MODE = sys.argv[1]
+OUT = sys.argv[2]
+
+VOCAB = 61
+MAX_NEW = 5
+
+paddle.seed(23)
+cfg = GPTConfig(vocab_size=VOCAB, hidden_size=16, num_layers=1,
+                num_heads=2, max_seq_len=48, use_parallel=False)
+model = GPTForPretraining(cfg)
+
+rng = np.random.RandomState(7)
+prompts = [rng.randint(1, VOCAB, size=n).astype(np.int32)
+           for n in (4, 6, 3, 5, 7, 4)]
+
+if MODE == "fleet":
+    front = serving.Router(
+        model, replicas=2,
+        engine_kw=dict(max_slots=2, block_size=8),
+        hedge=False, retry_budget=3, liveness_timeout_s=0.2,
+        backoff_base_s=0.02, name="pf").start()
+else:
+    front = serving.Server(model, max_slots=2, block_size=8).start()
+
+futs = [front.submit(p, max_new_tokens=MAX_NEW) for p in prompts]
+outs = [np.asarray(f.result(120)).tolist() for f in futs]
+
+if MODE == "fleet":
+    # the supervisor restarts dead replicas asynchronously (backoff +
+    # rebuild); give it a bounded window to finish before snapshotting
+    import time
+    deadline = time.monotonic() + 60.0
+    while time.monotonic() < deadline:
+        snap = front.snapshot()
+        restarts = sum(r["restarts"] for r in snap["replicas"])
+        deaths = sum(r["deaths"] for r in snap["replicas"])
+        if restarts >= deaths:
+            break
+        time.sleep(0.05)
+else:
+    restarts = deaths = 0
+front.shutdown()
+
+with open(OUT, "w") as f:
+    json.dump({"outs": outs, "restarts": restarts, "deaths": deaths}, f)
+print("PAYLOAD_OK")

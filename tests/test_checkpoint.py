@@ -18,6 +18,10 @@ import paddle_tpu.nn as nn
 from paddle_tpu.distributed import checkpoint as ckpt
 from paddle_tpu.engine import Engine
 
+_OLD_JAX_SHARD_MAP = getattr(jax.shard_map, "__paddle_tpu_compat__",
+                            False) if hasattr(jax, "shard_map") else True
+
+
 
 class _MLP(nn.Layer):
     def __init__(self):
@@ -89,6 +93,8 @@ def test_sharded_round_trip_and_reshard(tmp_path):
 
 
 @pytest.mark.dist
+@pytest.mark.skipif(_OLD_JAX_SHARD_MAP, reason=
+    "partial-manual shard_map (pp manual + dp/mp auto) needs newer jax")
 def test_hybrid_engine_round_trip(tmp_path):
     from paddle_tpu.distributed import fleet
     from paddle_tpu.distributed.hybrid import make_gpt_hybrid_engine
@@ -250,6 +256,8 @@ def test_train_epoch_range_restores_lr_scheduler(tmp_path):
 
 
 @pytest.mark.dist
+@pytest.mark.skipif(_OLD_JAX_SHARD_MAP, reason=
+    "partial-manual shard_map (pp manual + dp/mp auto) needs newer jax")
 def test_hybrid_zero3_offload_round_trip(tmp_path):
     """VERDICT r2 #6: save/restore a HybridParallelEngine mid-run at
     ZeRO-3 (sharded params + opt state) with offload on; the resumed

@@ -78,6 +78,19 @@ def test_zero3_peak_below_zero1(sharding4_hcg):
         assert m["peak"] > 0 and m["temps"] >= 0
 
 
+def _has_pinned_host():
+    try:
+        return "pinned_host" in {
+            m.kind for m in jax.devices()[0].addressable_memories()}
+    except Exception:
+        return False
+
+
+@pytest.mark.skipif(
+    not _has_pinned_host(),
+    reason="backend has no pinned_host memory space; engine offload "
+           "degrades to device-resident state (with a warning), so "
+           "there is no host movement to measure")
 def test_offload_moves_state_off_device(sharding4_hcg):
     """MEASURED: with opt-state offload, the state rests in host memory
     (live-array census host_bytes > 0) and device-resident bytes drop

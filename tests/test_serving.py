@@ -32,11 +32,12 @@ from paddle_tpu.core.tensor import Tensor
 from paddle_tpu.framework import faults
 from paddle_tpu.nlp.transformers import GPTConfig, GPTForPretraining
 from paddle_tpu.serving import (
-    AdmissionQueue, BlockAllocator, CapacityExhaustedError,
-    DeadlineExceededError, DynamicBatcher, NULL_BLOCK, PoolExhausted,
-    PrefixCache, QueueFullError, Request, RequestCancelled,
-    ServerClosedError, ServingError, ServingMetrics, bucket_for,
-    bucket_ladder, pad_batch,
+    AdmissionQueue, BlockAllocator, BrownoutShedError,
+    CapacityExhaustedError, CircuitBreaker, DeadlineExceededError,
+    DynamicBatcher, NULL_BLOCK, PoolExhausted, PrefixCache,
+    QueueFullError, ReplicaDiedError, Request, RequestCancelled,
+    RetriesExhaustedError, Router, ServerClosedError, ServingError,
+    ServingMetrics, bucket_for, bucket_ladder, pad_batch, retriable,
 )
 
 REPO = Path(__file__).resolve().parent.parent
@@ -816,3 +817,501 @@ def test_http_front_door(gpt):
         httpd.shutdown()
     finally:
         srv.shutdown(drain=True)
+
+# ---------------------------------------------------------------------------
+# resilient fleet: supervision, failover, retry, hedge, breaker, brownout
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def fleet(gpt):
+    """Shared 2-replica Router: parity/sweep/brownout tests reuse it so
+    the per-replica compile-once invariant is certified across many
+    requests and injected fault rounds. Liveness is generous (no
+    watchdog false-positives under CPU load); death only via kill() in
+    dedicated fleets."""
+    router = Router(gpt, replicas=2,
+                    engine_kw=dict(max_slots=2, block_size=8),
+                    hedge=False, retry_budget=3, breaker_threshold=10,
+                    liveness_timeout_s=30.0, name="tf").start()
+    yield router
+    router.shutdown(drain=True)
+
+
+def test_fleet_greedy_parity_and_compile_once(gpt, fleet):
+    """Fleet-served greedy decode is bitwise the reference chain, and
+    each replica holds exactly one decode + one cow trace."""
+    prompts = [_prompt(60 + i, 4 + i) for i in range(4)]
+    futs = [fleet.submit(p, max_new_tokens=5) for p in prompts]
+    for p, f in zip(prompts, futs):
+        np.testing.assert_array_equal(f.result(120),
+                                      _ref_greedy(gpt, p, 5))
+    for name, counts in fleet.compile_counts().items():
+        assert counts == {"decode": 1, "cow": 1}, (name, counts)
+
+
+def test_fleet_failover_replay_bitwise(gpt):
+    """Kill the replica holding an in-flight request: the Router
+    replays it from the original prompt on the surviving replica and
+    the client sees bitwise-identical greedy tokens, exactly once. The
+    dead replica restarts with one fresh trace; a replay-path fault on
+    a second kill surfaces as a typed error, never a hang."""
+    router = Router(gpt, replicas=2,
+                    engine_kw=dict(max_slots=2, block_size=8),
+                    hedge=False, liveness_timeout_s=30.0,
+                    backoff_base_s=0.02, name="kf").start()
+    try:
+        p = _prompt(70, 6)
+        ref = router.submit(p, max_new_tokens=8).result(120)
+        np.testing.assert_array_equal(ref, _ref_greedy(gpt, p, 8))
+
+        resolved = []
+        with faults.inject("serving.replica_step[kf.r0]@*:delay:0.05"):
+            fut = router.submit(p, max_new_tokens=8)
+            fut.add_done_callback(lambda r: resolved.append(r.id))
+            time.sleep(0.12)            # in-flight on slowed r0
+            router.kill("kf.r0")
+            out = fut.result(120)
+        np.testing.assert_array_equal(out, ref)
+        assert len(resolved) == 1       # exactly-once delivery
+        m = router.metrics
+        assert m.get("replica_deaths") >= 1
+        assert m.get("replays") >= 1
+
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if all(r["state"] == "healthy"
+                   for r in router.snapshot()["replicas"]):
+                break
+            time.sleep(0.05)
+        assert m.get("replica_restarts") >= 1
+        # restart = ONE fresh trace per rebuilt engine, no extras
+        for name, counts in router.compile_counts().items():
+            assert counts == {"decode": 1, "cow": 1}, (name, counts)
+        np.testing.assert_array_equal(
+            router.submit(p, max_new_tokens=8).result(120), ref)
+
+        # failover whose replay path itself faults -> typed error
+        with faults.inject("serving.replica_step[kf.r0]@*:delay:0.05",
+                           "serving.replay@1:raise"):
+            fut = router.submit(p, max_new_tokens=8)
+            time.sleep(0.12)            # on r0 again (least loaded tie)
+            router.kill("kf.r0")
+            with pytest.raises(ServingError):
+                fut.result(120)
+    finally:
+        router.shutdown(drain=True)
+
+
+def test_fleet_retry_budget_exhaustion_typed_error(gpt, fleet):
+    """Persistent retriable faults burn the retry budget and surface as
+    RetriesExhaustedError carrying the last underlying error; the fleet
+    serves clean traffic immediately after."""
+    p = _prompt(71, 5)
+    ref = fleet.submit(p, max_new_tokens=4).result(120)
+    with faults.inject("serving.replica_step@*:raise"):
+        fut = fleet.submit(p, max_new_tokens=4)
+        with pytest.raises(RetriesExhaustedError) as ei:
+            fut.result(120)
+        assert isinstance(ei.value.last_error, faults.FaultError)
+        assert ei.value.retriable    # a later resubmission could work
+    assert fleet.metrics.get("retry_budget_exhausted") >= 1
+    np.testing.assert_array_equal(
+        fleet.submit(p, max_new_tokens=4).result(120), ref)
+
+
+def test_fleet_hedge_first_wins_loser_cancelled(gpt):
+    """A straggling attempt is hedged onto the other replica after the
+    configured delay; the fast attempt wins, the loser is cancelled and
+    its late outcome suppressed — the client sees one result."""
+    router = Router(gpt, replicas=2,
+                    engine_kw=dict(max_slots=2, block_size=8),
+                    hedge=True, hedge_after_s=0.05,
+                    liveness_timeout_s=30.0, name="hf").start()
+    try:
+        p = _prompt(72, 5)
+        ref = router.submit(p, max_new_tokens=6).result(120)
+        with faults.inject("serving.replica_step[hf.r0]@*:delay:0.08"):
+            out = router.submit(p, max_new_tokens=6).result(120)
+        np.testing.assert_array_equal(out, ref)
+        m = router.metrics
+        assert m.get("hedges") == 1
+        assert m.get("hedge_wins") == 1
+        assert m.get("stale_attempts") >= 1   # the cancelled loser
+        assert m.get("fleet_completed") == m.get("fleet_submitted")
+    finally:
+        router.shutdown(drain=True)
+
+
+def test_circuit_breaker_state_machine():
+    """Unit cycle under an injected clock: closed -> open on threshold
+    consecutive failures -> half-open single probe after cooloff ->
+    closed on success / re-open on probe failure."""
+    now = [0.0]
+    br = CircuitBreaker(threshold=2, cooloff_s=1.0, clock=lambda: now[0])
+    assert br.state == "closed" and br.allow()
+    br.record_failure()
+    assert br.state == "closed"      # below threshold
+    br.record_failure()
+    assert br.state == "open"
+    assert not br.allow()            # cooloff not elapsed
+    now[0] = 1.5
+    assert br.allow()                # the half-open probe
+    assert br.state == "half-open"
+    assert not br.allow()            # single probe only
+    br.record_failure()              # probe failed -> re-open
+    assert br.state == "open"
+    now[0] = 3.0
+    assert br.allow()
+    br.record_success()              # probe succeeded -> closed
+    assert br.state == "closed" and br.failures == 0
+    # success resets the consecutive-failure count
+    br.record_failure()
+    br.record_success()
+    br.record_failure()
+    assert br.state == "closed"
+
+
+def test_fleet_breaker_opens_and_recovers(gpt):
+    """Integration: consecutive failures on one replica open its
+    breaker (traffic routes around it); after cooloff the half-open
+    probe closes it again."""
+    router = Router(gpt, replicas=2,
+                    engine_kw=dict(max_slots=2, block_size=8),
+                    hedge=False, breaker_threshold=2,
+                    breaker_cooloff_s=0.4, retry_budget=3,
+                    liveness_timeout_s=30.0, name="bf").start()
+    try:
+        p = _prompt(73, 5)
+        r0 = router.replica_set.replicas[0]
+        with faults.inject("serving.replica_step[bf.r0]@1-2:raise"):
+            # two sequential requests: each lands on r0 first (least
+            # loaded, lowest index), fails there, retries onto r1
+            for _ in range(2):
+                router.submit(p, max_new_tokens=3).result(120)
+        assert r0.breaker.state == "open"
+        # while open, traffic keeps flowing (routed around r0, or
+        # through its half-open probe once the cooloff elapses)
+        router.submit(p, max_new_tokens=3).result(120)
+        time.sleep(0.5)              # cooloff elapses
+        for _ in range(3):           # probe lands on r0 and closes it
+            router.submit(p, max_new_tokens=3).result(120)
+        assert r0.breaker.state == "closed"
+    finally:
+        router.shutdown(drain=True)
+
+
+def test_fleet_brownout_sheds_by_priority_and_clamps(gpt, fleet):
+    """Forced brownout: below-floor priorities shed with the retriable
+    429 BrownoutShedError, admitted requests get max_new_tokens
+    clamped; clearing the override restores full service."""
+    p = _prompt(74, 5)
+    fleet.set_brownout(True)
+    try:
+        with pytest.raises(BrownoutShedError) as ei:
+            fleet.submit(p, max_new_tokens=12, priority=0)
+        assert ei.value.status == 429 and ei.value.retriable
+        assert fleet.metrics.get("brownout_sheds") >= 1
+        out = fleet.submit(p, max_new_tokens=12, priority=2).result(120)
+        assert out.size == p.size + fleet._brownout_max_new  # clamped
+    finally:
+        fleet.set_brownout(None)
+    out = fleet.submit(p, max_new_tokens=12, priority=0).result(120)
+    assert out.size == p.size + 12   # full service restored
+
+
+def test_fleet_brownout_auto_enters_and_exits(gpt):
+    """Hysteresis: load above brownout_high trips brownout
+    automatically; drained load below brownout_low clears it."""
+    router = Router(gpt, replicas=2,
+                    engine_kw=dict(max_slots=1, block_size=8),
+                    hedge=False, queue_cap=1, tick_s=0.002,
+                    brownout_high=0.4, brownout_low=0.1,
+                    liveness_timeout_s=30.0, name="bo").start()
+    try:
+        with faults.inject("serving.replica_step@*:delay:0.03"):
+            futs = [router.submit(_prompt(75 + i, 4), max_new_tokens=6,
+                                  priority=5)
+                    for i in range(4)]
+            deadline = time.monotonic() + 10
+            while not router.brownout_active \
+                    and time.monotonic() < deadline:
+                time.sleep(0.005)
+            assert router.brownout_active
+            assert router.metrics.get("brownout_entries") >= 1
+            for f in futs:
+                f.result(120)
+        deadline = time.monotonic() + 10
+        while router.brownout_active and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert not router.brownout_active
+    finally:
+        router.shutdown(drain=True)
+
+
+def test_fleet_route_fault_retried_transparently(gpt, fleet):
+    """A transient routing failure is retried under the budget and the
+    client still gets correct tokens."""
+    p = _prompt(76, 5)
+    ref = _ref_greedy(gpt, p, 4)
+    before = fleet.metrics.get("retries")
+    with faults.inject("serving.route@1:raise"):
+        out = fleet.submit(p, max_new_tokens=4).result(120)
+    np.testing.assert_array_equal(out, ref)
+    assert fleet.metrics.get("retries") > before
+
+
+def test_fleet_zero_lost_zero_duplicate_sweep(gpt, fleet):
+    """The chaos certification: under a scripted error sweep across
+    both replicas and the routing path, every submitted request
+    resolves exactly once — bitwise-correct greedy tokens or a typed
+    ServingError — the schedule verifiably fired in full, and the
+    per-replica compile counts never move."""
+    prompts = [_prompt(80 + i, 4 + (i % 3)) for i in range(6)]
+    refs = [_ref_greedy(gpt, p, 5) for p in prompts]
+
+    resolutions = []
+    lock = threading.Lock()
+
+    def on_done(req):
+        with lock:
+            resolutions.append(req.id)
+
+    with faults.ChaosSchedule(
+            "serving.replica_step[tf.r0]@2:raise",
+            "serving.replica_step[tf.r1]@3:raise",
+            "serving.route@4:raise") as sched:
+        futs = []
+        for p in prompts:
+            f = fleet.submit(p, max_new_tokens=5)
+            f.add_done_callback(on_done)
+            futs.append(f)
+        outcomes = {"ok": 0, "typed": 0}
+        for p, ref, f in zip(prompts, refs, futs):
+            try:
+                out = f.result(120)
+                np.testing.assert_array_equal(out, ref)
+                outcomes["ok"] += 1
+            except ServingError:
+                outcomes["typed"] += 1
+        fired = sched.verify()       # every planned fault fired
+
+    assert outcomes["ok"] + outcomes["typed"] == len(prompts)
+    assert fired["serving.replica_step"] == 2
+    assert fired["serving.route"] == 1
+    # exactly-once: one done-callback per request, no duplicates
+    assert sorted(resolutions) == sorted({f.id for f in futs})
+    m = fleet.metrics
+    assert m.get("fleet_submitted") == \
+        m.get("fleet_completed") + m.get("fleet_failed")
+    for name, counts in fleet.compile_counts().items():
+        assert counts == {"decode": 1, "cow": 1}, (name, counts)
+
+
+def test_fleet_watchdog_restarts_hung_replica(gpt):
+    """Liveness: a replica whose heartbeat stalls (injected delay) is
+    declared dead by the watchdog, its requests fail over bitwise, and
+    it restarts with exactly one fresh trace."""
+    router = Router(gpt, replicas=2,
+                    engine_kw=dict(max_slots=2, block_size=8),
+                    hedge=False, liveness_timeout_s=0.15,
+                    backoff_base_s=0.02, name="wd").start()
+    try:
+        p = _prompt(77, 5)
+        ref = router.submit(p, max_new_tokens=5).result(120)
+        with faults.inject(
+                "serving.replica_heartbeat[wd.r0]@5:delay:1.0"):
+            futs = [router.submit(p, max_new_tokens=5)
+                    for _ in range(3)]
+            for f in futs:
+                np.testing.assert_array_equal(f.result(120), ref)
+        m = router.metrics
+        assert m.get("replica_deaths") >= 1
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if all(r["state"] == "healthy"
+                   for r in router.snapshot()["replicas"]):
+                break
+            time.sleep(0.05)
+        assert m.get("replica_restarts") >= 1
+        for name, counts in router.compile_counts().items():
+            assert counts == {"decode": 1, "cow": 1}, (name, counts)
+        np.testing.assert_array_equal(
+            router.submit(p, max_new_tokens=5).result(120), ref)
+    finally:
+        router.shutdown(drain=True)
+
+
+def test_retriable_classifier():
+    assert retriable(CapacityExhaustedError("x"))
+    assert retriable(QueueFullError("x"))
+    assert retriable(ServerClosedError("x"))
+    assert retriable(ReplicaDiedError("x"))
+    assert retriable(faults.FaultError("x"))
+    assert not retriable(RequestCancelled("x"))
+    assert not retriable(DeadlineExceededError("x"))
+    assert not retriable(ValueError("x"))
+
+
+# ---------------------------------------------------------------------------
+# request cancellation satellites
+# ---------------------------------------------------------------------------
+
+
+def test_cancel_wakes_blocked_result_promptly():
+    """cancel() fails the future immediately: a client blocked in
+    result() wakes with RequestCancelled without waiting for the engine
+    to reach a step boundary (or forever, if nothing ever ran it)."""
+    req = Request(np.array([1, 2, 3], np.int32))
+    woke = []
+
+    def waiter():
+        t0 = time.monotonic()
+        with pytest.raises(RequestCancelled):
+            req.result(timeout=30)
+        woke.append(time.monotonic() - t0)
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.05)
+    req.cancel()
+    t.join(10)
+    assert woke and woke[0] < 5     # promptly, not at the 30s timeout
+
+
+def test_result_cancel_on_timeout_reclaims_queue_slot():
+    """A client that gives up with cancel_on_timeout=True also cancels
+    the request, so its queue entry is swept instead of leaking."""
+    q = AdmissionQueue(2)
+    req = q.submit(Request(np.array([1], np.int32)))
+    with pytest.raises(TimeoutError):
+        req.result(timeout=0.05, cancel_on_timeout=True)
+    assert req.cancelled
+    # the queue sweeps it on the next pop instead of handing it out
+    assert q.pop(timeout=0.05) is None
+    assert isinstance(req.exception(1), RequestCancelled)
+    # without the opt-in, timeout leaves the request live
+    q2 = AdmissionQueue(2)
+    req2 = q2.submit(Request(np.array([1], np.int32)))
+    with pytest.raises(TimeoutError):
+        req2.result(timeout=0.05)
+    assert not req2.cancelled
+    assert q2.pop(timeout=0.05) is req2
+
+
+def test_request_first_wins_and_done_callbacks():
+    """The future is exactly-once: the first resolution wins, later
+    ones report False; done-callbacks fire exactly once each, and one
+    registered after resolution fires immediately."""
+    req = Request(np.array([1], np.int32))
+    calls = []
+    req.add_done_callback(lambda r: calls.append("a"))
+    assert req._complete(np.array([7], np.int32))
+    assert not req._fail(RuntimeError("late"))     # suppressed
+    assert not req._complete(np.array([9], np.int32))
+    np.testing.assert_array_equal(req.result(1), [7])
+    req.add_done_callback(lambda r: calls.append("b"))
+    assert calls == ["a", "b"]
+
+
+# ---------------------------------------------------------------------------
+# server satellites: idempotent shutdown, fleet mode, Retry-After
+# ---------------------------------------------------------------------------
+
+
+def test_server_shutdown_idempotent(gpt):
+    """shutdown() on a never-started server is a no-op, and double
+    shutdown never re-runs drain against stopped backends."""
+    srv = serving.Server(gpt, max_slots=2, block_size=8, warmup=False)
+    srv.shutdown()                   # never started: no-op, no error
+    srv.shutdown(drain=False)
+    srv.start()
+    out = srv.generate(_prompt(78, 4), max_new_tokens=2, timeout=120)
+    assert out.size == 6
+    srv.shutdown(drain=True)
+    srv.shutdown(drain=True)         # second call: no-op
+    srv.shutdown(drain=False)
+
+
+def test_server_fleet_mode(gpt):
+    """Server(replicas=2) serves through the Router: same API, fleet
+    snapshot + per-replica prometheus gauges."""
+    with serving.Server(gpt, replicas=2, max_slots=2, block_size=8,
+                        fleet=dict(hedge=False, liveness_timeout_s=30.0,
+                                   name="sv")) as srv:
+        p = _prompt(79, 5)
+        np.testing.assert_array_equal(
+            srv.generate(p, max_new_tokens=4, timeout=120),
+            _ref_greedy(gpt, p, 4))
+        fut = srv.submit(p, max_new_tokens=4, priority=3)
+        fut.result(120)
+        snap = srv.snapshot()
+        assert len(snap["fleet"]["replicas"]) == 2
+        assert snap["counters"]["fleet_completed"] >= 2
+        text = srv.metrics_prometheus()
+        assert "paddle_serving_replica_state" in text
+        assert "paddle_serving_replica_breaker_state" in text
+        assert "paddle_serving_brownout_active" in text
+        assert "paddle_serving_fleet_in_flight" in text
+
+
+def test_http_front_retry_after_and_retriable_body(gpt):
+    """429 responses carry Retry-After and every error body says
+    whether the client may retry — the external mirror of the
+    in-process Router's backoff contract."""
+    import urllib.error
+    import urllib.request
+
+    srv = serving.Server(gpt, max_slots=1, block_size=8, queue_cap=1,
+                         num_blocks=2).start()
+    try:
+        try:
+            httpd = serving.http_front(srv, port=0)
+        except OSError as e:
+            pytest.skip(f"cannot bind loopback: {e}")
+        port = httpd.server_address[1]
+        # block demand beyond the whole pool -> CapacityExhausted 429
+        body = json.dumps({"prompt": list(range(1, 6)),
+                           "max_new_tokens": 40}).encode()
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(urllib.request.Request(
+                f"http://127.0.0.1:{port}/v1/generate", data=body,
+                headers={"Content-Type": "application/json"}),
+                timeout=30)
+        assert ei.value.code == 429
+        assert float(ei.value.headers["Retry-After"]) > 0
+        err = json.loads(ei.value.read())
+        assert err["retriable"] is True
+        assert err["type"] == "CapacityExhaustedError"
+        # client errors are non-retriable
+        bad = json.dumps({"prompt": []}).encode()
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(urllib.request.Request(
+                f"http://127.0.0.1:{port}/v1/generate", data=bad,
+                headers={"Content-Type": "application/json"}),
+                timeout=30)
+        assert ei.value.code == 400
+        assert json.loads(ei.value.read())["retriable"] is False
+        httpd.shutdown()
+    finally:
+        srv.shutdown(drain=True)
+
+
+def test_bench_serving_chaos_smoke():
+    """--chaos dry run emits the BENCH_SERVING_CHAOS record with full
+    goodput under the scripted schedule."""
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "bench_serving.py"), "--chaos",
+         "--steps", "4", "--clients", "3", "--max-new", "3",
+         "--prompt-len", "5", "--hidden", "16", "--layers", "1",
+         "--heads", "2", "--vocab", "31", "--max-seq-len", "48",
+         "--max-slots", "4", "--block-size", "8"],
+        capture_output=True, text=True, timeout=420,
+        env={**__import__("os").environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    final = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert final["bench"] == "BENCH_SERVING_CHAOS"
+    assert final["goodput"] == 1.0       # retries/replays absorb it all
+    assert final["counters"]["fleet_submitted"] == \
+        final["counters"]["fleet_completed"]
+    assert "p99_delta_ms" in final and "restarts" in final

@@ -1,0 +1,78 @@
+"""Fork-based chaos certification for the serving fleet (slow tier).
+
+Each test launches tests/serving_payload.py in a subprocess with a
+fault schedule injected through PADDLE_TPU_FAULTS, then asserts on the
+exit code and on the JSON the payload writes: a hung replica must be
+restarted by the watchdog with every request still resolving to the
+bitwise-identical greedy tokens, and a hard `crash` action must take
+the process down with the scripted exit code while a clean rerun
+reproduces the reference outputs exactly.
+
+The in-process (tier-1) equivalents live in tests/test_serving.py; this
+file spends real subprocess start-ups for the end-to-end guarantees.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PAYLOAD = os.path.join(REPO, "tests", "serving_payload.py")
+
+
+def _run(mode, out_path, faults=None, timeout=240):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("PADDLE_TPU_FAULTS", None)
+    if faults:
+        env["PADDLE_TPU_FAULTS"] = faults
+    return subprocess.run(
+        [sys.executable, PAYLOAD, mode, out_path],
+        cwd=REPO, env=env, capture_output=True, text=True,
+        timeout=timeout)
+
+
+@pytest.fixture(scope="module")
+def reference(tmp_path_factory):
+    """Clean single-engine run: the bitwise greedy ground truth."""
+    out = tmp_path_factory.mktemp("chaos") / "ref.json"
+    r = _run("single", str(out))
+    assert r.returncode == 0, r.stderr
+    return json.loads(out.read_text())["outs"]
+
+
+def test_hung_replica_restarted_outputs_bitwise(reference, tmp_path):
+    """A heartbeat stall past the liveness timeout gets the replica
+    declared dead and restarted; every in-flight request replays onto a
+    healthy replica and resolves to the reference tokens bitwise."""
+    out = tmp_path / "fleet.json"
+    r = _run("fleet", str(out),
+             faults="serving.replica_heartbeat[pf.r0]@10:delay:1.0")
+    assert r.returncode == 0, r.stderr
+    got = json.loads(out.read_text())
+    assert got["outs"] == reference
+    assert got["deaths"] >= 1
+    assert got["restarts"] >= 1
+
+
+def test_crash_action_kills_process_then_clean_run_matches(
+        reference, tmp_path):
+    """The `crash` action is a real os._exit(137) — the whole process
+    dies mid-decode. A clean rerun of the same fleet reproduces the
+    reference outputs, proving the fault env var (not state leakage)
+    was the only difference."""
+    out = tmp_path / "crash.json"
+    r = _run("fleet", str(out), faults="serving.replica_step@2:crash")
+    assert r.returncode == 137, (r.returncode, r.stderr)
+    assert not out.exists()
+
+    r = _run("fleet", str(out))
+    assert r.returncode == 0, r.stderr
+    got = json.loads(out.read_text())
+    assert got["outs"] == reference
+    assert got["deaths"] == 0 and got["restarts"] == 0
