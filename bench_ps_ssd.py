@@ -1,17 +1,28 @@
-"""Micro-bench: native C++ SSD spill table vs the Python reference.
+"""Micro-bench: native C++ SSD spill table vs the Python reference,
+plus the durable-PS chaos certification bench (``--chaos``).
 
-VERDICT r4 item 8 done-criterion: the native spill hot path (hash ->
-on-disk record, read-merge, LRU) must beat the Python implementation by
-a large factor under eviction churn.  Prints ONE JSON line.
+Default mode (VERDICT r4 item 8 done-criterion): the native spill hot
+path (hash -> on-disk record, read-merge, LRU) must beat the Python
+implementation by a large factor under eviction churn.  Prints ONE
+JSON line.
 
 Workload: Zipf-ish id stream over a table 10x the LRU capacity (every
 batch faults spilled rows back and evicts hot ones — the spill path IS
 the hot path), pull + push_sgd per batch.
+
+``--chaos`` (ISSUE 10 satellite 5): the same push workload over the RPC
+service with a WAL + replica, injected mid-push faults and a primary
+kill mid-stream. Emits one ``BENCH_PS_CHAOS`` JSON line: failover
+recovery time, goodput clean vs chaos, WAL records replayed by a fresh
+recovery, dedup hits, and the ChaosSchedule fired==planned verdict —
+with the final state certified bitwise-equal to the clean run (zero
+lost, zero double-applied).
 """
 
 from __future__ import annotations
 
 import json
+import sys
 import tempfile
 import time
 
@@ -50,7 +61,101 @@ def _run(native: bool) -> float:
     return dt
 
 
+def _chaos_workload(client, n_pushes, dim):
+    rng = np.random.RandomState(1)
+    grads = [rng.randn(dim).astype(np.float32) for _ in range(n_pushes)]
+    t0 = time.perf_counter()
+    for g in grads:
+        client.push_dense_grad("w", g)
+    return time.perf_counter() - t0
+
+
+def run_chaos(n_pushes=200, dim=256):
+    from paddle_tpu.distributed import ps
+    from paddle_tpu.framework import faults, monitor
+
+    with tempfile.TemporaryDirectory() as d, \
+            tempfile.TemporaryDirectory() as d_ref:
+        # clean reference: identical durability config (WAL + sync
+        # replica), same stream, no faults, no kill — so the goodput
+        # ratio isolates the chaos cost, not the durability cost
+        ref_backup = ps.PSServer("127.0.0.1:0").start()
+        ref_srv = ps.PSServer("127.0.0.1:0", wal_dir=d_ref,
+                              backup=ref_backup.endpoint).start()
+        ref = ps.PSClient([ref_srv.endpoint])
+        ref.create_dense_table("w", [dim], optimizer="adagrad", lr=0.1)
+        clean_s = _chaos_workload(ref, n_pushes, dim)
+        want = ref.pull_dense("w")
+
+        backup = ps.PSServer("127.0.0.1:0").start()
+        primary = ps.PSServer("127.0.0.1:0", wal_dir=d,
+                              backup=backup.endpoint).start()
+        client = ps.PSClient([primary.endpoint],
+                             backups=[backup.endpoint],
+                             retry_backoff_s=0.01, op_deadline_s=30.0)
+        dedup0 = monitor.stat_get("ps.dedup_hits")
+        fo0 = monitor.stat_get("ps.failovers")
+        half = n_pushes // 2
+        rng = np.random.RandomState(1)
+        grads = [rng.randn(dim).astype(np.float32)
+                 for _ in range(n_pushes)]
+        specs = ["ps.push@10:raise", "ps.push@40:raise",
+                 "ps.pull@1:delay:0.001"]
+        t0 = time.perf_counter()
+        with faults.ChaosSchedule(*specs) as chaos:
+            client.create_dense_table("w", [dim], optimizer="adagrad",
+                                      lr=0.1)
+            for g in grads[:half]:
+                client.push_dense_grad("w", g)
+            client.pull_dense("w")
+            # primary dies mid-stream; the next push rides the failover
+            primary.kill_transport()
+            t_kill = time.perf_counter()
+            client.push_dense_grad("w", grads[half])
+            recovery_s = time.perf_counter() - t_kill
+            for g in grads[half + 1:]:
+                client.push_dense_grad("w", g)
+            fired = chaos.verify()   # fired == planned or AssertionError
+        chaos_s = time.perf_counter() - t0
+
+        got = client.pull_dense("w")
+        bitwise_equal = got.tobytes() == want.tobytes()
+
+        # a fresh recovery over the primary's WAL replays every record
+        # it had applied before death (creates + the first-half pushes)
+        rec = ps.PSServer("127.0.0.1:0", wal_dir=d).start()
+        wal_replayed = rec.recovered_records
+        rec.stop()
+
+        out = {
+            "metric": "ps_chaos_certification",
+            "value": round(clean_s / chaos_s, 3) if chaos_s else 0.0,
+            "unit": "goodput_chaos_over_clean",
+            "bitwise_equal": bitwise_equal,
+            "recovery_s": round(recovery_s, 4),
+            "clean_rows_per_s": round(n_pushes / clean_s, 1),
+            "chaos_rows_per_s": round(n_pushes / chaos_s, 1),
+            "wal_replayed_records": wal_replayed,
+            "dedup_hits": monitor.stat_get("ps.dedup_hits") - dedup0,
+            "failovers": monitor.stat_get("ps.failovers") - fo0,
+            "chaos_fired": fired,
+            "n_pushes": n_pushes, "dim": dim,
+        }
+        print("BENCH_PS_CHAOS " + json.dumps(out))
+        client.stop_servers()
+        backup.stop()
+        primary.stop()
+        ref.stop_servers()
+        ref_srv.stop()
+        ref_backup.stop()
+        if not bitwise_equal:
+            raise SystemExit("chaos run diverged from the clean run")
+
+
 def main():
+    if "--chaos" in sys.argv:
+        run_chaos()
+        return
     py = _run(False)
     nat = _run(True)
     rows_per_sec_nat = STEPS * BATCH * 2 / nat

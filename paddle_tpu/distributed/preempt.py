@@ -108,10 +108,18 @@ def reason():
 
 def poll():
     """One step/epoch-boundary check. Advances the simulated-preemption
-    schedule (FLAGS_simulate_preempt_at_step) and returns requested()."""
+    schedule (FLAGS_simulate_preempt_at_step) and returns requested().
+
+    Passes the ``preempt.poll`` fault site: ``drop`` suppresses this
+    boundary's check (a missed poll — the loop keeps training and the
+    preemption is noticed one boundary late), ``crash`` models death at
+    the boundary itself."""
     global _poll_count
+    from ..framework import faults as _faults
     from ..framework import flags as _flags
 
+    if _faults.fault_point("preempt.poll") is _faults.DROP:
+        return False
     with _lock:
         _poll_count += 1
         n = _poll_count

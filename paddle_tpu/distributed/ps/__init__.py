@@ -21,12 +21,18 @@ from .runtime import (  # noqa: F401
     init_runtime,
 )
 from .heter import TPUEmbeddingCache  # noqa: F401
-from .service import Communicator, PSClient, PSServer  # noqa: F401
-from .tables import DenseTable, SparseTable  # noqa: F401
+from .replica import FencedError, ReplicaLink  # noqa: F401
+from .service import (  # noqa: F401
+    Communicator, PSClient, PSServer, PSUnavailableError,
+)
+from .tables import DenseTable, SparseTable, SSDSparseTable  # noqa: F401
+from .wal import DurableStore, WalCorruptError, WriteAheadLog  # noqa: F401
 
 __all__ = [
     "PSRoleMaker", "PSRuntime", "PSServer", "PSClient", "Communicator",
-    "DenseTable", "SparseTable", "DistributedEmbedding", "PSOptimizer",
-    "TPUEmbeddingCache",
+    "PSUnavailableError", "DenseTable", "SparseTable", "SSDSparseTable",
+    "DistributedEmbedding", "PSOptimizer", "TPUEmbeddingCache",
+    "WriteAheadLog", "DurableStore", "WalCorruptError",
+    "ReplicaLink", "FencedError",
     "get_runtime", "init_runtime",
 ]

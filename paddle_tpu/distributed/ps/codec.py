@@ -1,0 +1,144 @@
+"""Typed binary codec shared by the PS wire protocol and the WAL.
+
+This is the schema role sendrecv.proto plays in the reference — a typed
+tag codec that can round-trip the PS value universe (None/bool/int/
+float/str/bytes/ndarray/list/tuple/dict) without ever touching pickle,
+so neither a hostile peer nor a corrupted log record can execute code.
+Extracted from service.py so wal.py can persist records in the exact
+format the wire speaks (service re-exports `_dumps`/`_loads` for
+compatibility).
+
+tags: N none, T true, F false, i int64, I big-int(str), f float64,
+      s str, b bytes, l list, t tuple, d dict, a ndarray
+"""
+
+from __future__ import annotations
+
+import struct
+
+import numpy as np
+
+_MAX_DEPTH = 32               # nesting bound for the decoder
+_I64_MIN, _I64_MAX = -(1 << 63), (1 << 63) - 1
+
+__all__ = ["dumps", "loads"]
+
+
+def _enc(obj, out: bytearray):
+    if obj is None:
+        out += b"N"
+    elif isinstance(obj, (bool, np.bool_)):
+        out += b"T" if obj else b"F"
+    elif isinstance(obj, (int, np.integer)):
+        v = int(obj)
+        if _I64_MIN <= v <= _I64_MAX:
+            out += b"i" + struct.pack("<q", v)
+        else:
+            s = str(v).encode()
+            out += b"I" + struct.pack("<I", len(s)) + s
+    elif isinstance(obj, (float, np.floating)):
+        out += b"f" + struct.pack("<d", float(obj))
+    elif isinstance(obj, str):
+        raw = obj.encode()
+        out += b"s" + struct.pack("<I", len(raw)) + raw
+    elif isinstance(obj, bytes):
+        out += b"b" + struct.pack("<Q", len(obj)) + obj
+    elif isinstance(obj, np.ndarray):
+        if obj.dtype.hasobject:
+            raise TypeError("PS wire codec cannot serialize object arrays")
+        dt = obj.dtype.str.encode()     # e.g. b'<f4' — endian-explicit
+        raw = np.ascontiguousarray(obj).tobytes()
+        out += (b"a" + struct.pack("<B", len(dt)) + dt
+                + struct.pack("<B", obj.ndim)
+                + struct.pack(f"<{obj.ndim}q", *obj.shape)
+                + struct.pack("<Q", len(raw)) + raw)
+    elif isinstance(obj, (list, tuple)):
+        out += (b"l" if isinstance(obj, list) else b"t")
+        out += struct.pack("<I", len(obj))
+        for x in obj:
+            _enc(x, out)
+    elif isinstance(obj, dict):
+        out += b"d" + struct.pack("<I", len(obj))
+        for k, v in obj.items():
+            _enc(k, out)
+            _enc(v, out)
+    else:
+        raise TypeError(
+            f"PS wire codec cannot serialize {type(obj).__name__}")
+
+
+class _Dec:
+    def __init__(self, buf: bytes):
+        self.buf = buf
+        self.pos = 0
+
+    def _take(self, n):
+        if self.pos + n > len(self.buf):
+            raise ConnectionError("truncated PS frame")
+        v = self.buf[self.pos:self.pos + n]
+        self.pos += n
+        return v
+
+    def value(self, depth=0):
+        if depth > _MAX_DEPTH:
+            raise ConnectionError("PS frame nests too deep")
+        tag = self._take(1)
+        if tag == b"N":
+            return None
+        if tag == b"T":
+            return True
+        if tag == b"F":
+            return False
+        if tag == b"i":
+            return struct.unpack("<q", self._take(8))[0]
+        if tag == b"I":
+            (n,) = struct.unpack("<I", self._take(4))
+            return int(self._take(n).decode())
+        if tag == b"f":
+            return struct.unpack("<d", self._take(8))[0]
+        if tag == b"s":
+            (n,) = struct.unpack("<I", self._take(4))
+            return self._take(n).decode()
+        if tag == b"b":
+            (n,) = struct.unpack("<Q", self._take(8))
+            return self._take(n)
+        if tag == b"a":
+            (dtn,) = struct.unpack("<B", self._take(1))
+            dt = np.dtype(self._take(dtn).decode())
+            if dt.hasobject:
+                raise ConnectionError("object arrays not allowed on wire")
+            (ndim,) = struct.unpack("<B", self._take(1))
+            shape = struct.unpack(f"<{ndim}q", self._take(8 * ndim))
+            (nbytes,) = struct.unpack("<Q", self._take(8))
+            arr = np.frombuffer(self._take(nbytes), dtype=dt)
+            return arr.reshape(shape).copy()
+        if tag in (b"l", b"t"):
+            (n,) = struct.unpack("<I", self._take(4))
+            items = [self.value(depth + 1) for _ in range(n)]
+            return items if tag == b"l" else tuple(items)
+        if tag == b"d":
+            (n,) = struct.unpack("<I", self._take(4))
+            return {self.value(depth + 1): self.value(depth + 1)
+                    for _ in range(n)}
+        raise ConnectionError(f"bad PS wire tag {tag!r}")
+
+
+def dumps(obj) -> bytes:
+    out = bytearray()
+    _enc(obj, out)
+    return bytes(out)
+
+
+def loads(buf: bytes):
+    try:
+        dec = _Dec(buf)
+        val = dec.value()
+        if dec.pos != len(buf):
+            raise ConnectionError("trailing bytes in PS frame")
+        return val
+    except ConnectionError:
+        raise
+    except (ValueError, TypeError, UnicodeDecodeError, struct.error) as e:
+        # bad utf-8, dtype strings, buffer-size mismatches, unhashable
+        # dict keys — normalise so the server's drop path handles them
+        raise ConnectionError(f"malformed PS frame: {e!r}") from e

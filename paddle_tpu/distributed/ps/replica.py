@@ -1,0 +1,205 @@
+"""Primary/backup replication for the PS tier, with zombie fencing.
+
+Each key shard (one `PSServer` in the client's endpoint list) may have a
+standby twin. The primary forwards every *applied* mutation to its
+backup as a ``replicate`` command carrying a **fencing epoch**; the
+backup applies it through the same dedup + WAL path as a client push, so
+after a failover it already holds (almost all of) the primary's state
+and the client's retry of the one in-flight push lands exactly once.
+
+Failover is client-driven (there is no coordinator to lose): when the
+client exhausts its reconnect budget against a primary it sends
+``promote(epoch+1)`` to the backup and swaps the pair. The epoch is the
+fence — a restarted *old* primary still forwarding at the stale epoch is
+rejected with `FencedError` by the promoted backup, learns it has been
+superseded, and refuses further client mutations instead of splitting
+the brain.
+
+Forwarding modes:
+
+* ``sync`` (default) — forward inline before the push is acknowledged.
+  Replication lag is zero; an acknowledged push can never be lost to a
+  primary death (this is what the exactly-once certification runs).
+* async — forwards queue and a drain thread ships them; the
+  ``ps.replication_lag_updates`` gauge tracks the queue depth. A
+  primary death can lose the queued tail, which the backup's dedup +
+  client retry bounds to the *unacknowledged* pushes only if callers
+  also run the WAL — documented trade, off by default.
+
+Fault site ``ps.replicate`` fires on every forward (``raise`` = link
+hiccup: the primary drops the link, counts it, and keeps serving —
+availability over replication; ``delay`` = slow backup).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import socket
+import threading
+
+from ...framework import faults, monitor
+
+__all__ = ["FencedError", "ReplicaLink"]
+
+
+class FencedError(RuntimeError):
+    """A mutation arrived under a stale fencing epoch (zombie primary),
+    or at a server that has learned it was superseded. Deliberately NOT
+    retriable: retrying cannot make an old epoch new again."""
+
+
+class ReplicaLink:
+    """Primary-side connection that mirrors applied mutations to the
+    backup endpoint. One link per server; the server calls `forward()`
+    under its mutation lock, so records arrive at the backup in apply
+    order."""
+
+    def __init__(self, endpoint, sync=True, on_fenced=None):
+        self.endpoint = endpoint
+        self.sync = sync
+        self.on_fenced = on_fenced    # primary's "I am a zombie" hook
+        self.lost = False             # backup unreachable — link dropped
+        self.fenced = False
+        self._sock = None
+        self._lock = threading.Lock()
+        self._queue: list = []
+        self._cv = threading.Condition(self._lock)
+        self._thread = None
+        if not sync:
+            self._thread = threading.Thread(target=self._drain,
+                                            daemon=True)
+            self._thread.start()
+
+    # -- transport (the client handshake, inlined to avoid a cycle) ----------
+    def _connect(self):
+        from .service import _MAGIC, _auth_key, _recv_exact
+
+        host, port = self.endpoint.rsplit(":", 1)
+        s = socket.create_connection((host, int(port)), timeout=10.0)
+        s.settimeout(30.0)
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        try:
+            head = _recv_exact(s, 20)
+            if head[:4] != _MAGIC:
+                raise ConnectionError("bad PS handshake magic")
+            s.sendall(hmac.new(_auth_key(), head[4:],
+                               hashlib.sha256).digest())
+            if _recv_exact(s, 2) != b"OK":
+                raise ConnectionError("replica link authentication failed")
+        except BaseException:
+            s.close()
+            raise
+        return s
+
+    def _ship(self, msg):
+        """One RPC to the backup; raises on transport error/rejection."""
+        from .service import _recv_msg, _send_msg
+
+        faults.fault_point("ps.replicate", msg)
+        if self._sock is None:
+            self._sock = self._connect()
+        try:
+            _send_msg(self._sock, msg)
+            status, result = _recv_msg(self._sock)
+        except (ConnectionError, OSError):
+            try:
+                self._sock.close()
+            finally:
+                self._sock = None
+            raise
+        if status == "ok":
+            return
+        if status == "errR":
+            # transient backup-side error (e.g. an injected fault at its
+            # own ps.push site): a link hiccup, not a verdict — let the
+            # forward loop retry or drop the link, socket stays good
+            raise ConnectionError(
+                f"transient backup error from {self.endpoint}: {result}")
+        if "FencedError" in str(result):
+            self.fenced = True
+            monitor.stat_add("ps.replication_fenced")
+            if self.on_fenced is not None:
+                self.on_fenced()
+            raise FencedError(str(result))
+        raise RuntimeError(f"replicate rejected by {self.endpoint}: "
+                           f"{result}")
+
+    # -- public --------------------------------------------------------------
+    def forward(self, epoch, table, client_id, seq, cmd, args):
+        """Mirror one applied mutation. Sync mode ships inline (one
+        reconnect attempt on a broken cached socket); async mode
+        enqueues. A dead backup marks the link lost and stops costing
+        anything; a fencing rejection marks the *primary* fenced."""
+        record = (int(epoch), table, client_id, seq, cmd, args)
+        return self._forward_msg(("replicate", record))
+
+    def forward_command(self, cmd, args):
+        """Mirror a control command (table create/delete) verbatim, so
+        the backup holds the table a later replicated push mutates.
+        Creates are idempotent at the receiver, so no epoch is needed."""
+        return self._forward_msg((cmd, args))
+
+    def _forward_msg(self, msg):
+        if self.lost or self.fenced:
+            return False
+        if self.sync:
+            for attempt in (0, 1):
+                try:
+                    self._ship(msg)
+                    monitor.stat_add("ps.replicated_updates")
+                    return True
+                except FencedError:
+                    raise
+                except (faults.FaultError, ConnectionError, OSError):
+                    if attempt:       # second strike: give the link up
+                        self.lost = True
+                        monitor.stat_add("ps.replication_lost")
+                        return False
+            return False
+        with self._cv:
+            self._queue.append(msg)
+            monitor.stat_set("ps.replication_lag_updates",
+                             len(self._queue))
+            self._cv.notify()
+        return True
+
+    def _drain(self):
+        while True:
+            with self._cv:
+                while not self._queue and not self.lost:
+                    self._cv.wait(timeout=0.5)
+                if self.lost and not self._queue:
+                    return
+                msg = self._queue.pop(0)
+                monitor.stat_set("ps.replication_lag_updates",
+                                 len(self._queue))
+                self._cv.notify_all()   # wake a blocked flush()
+            try:
+                self._ship(msg)
+                monitor.stat_add("ps.replicated_updates")
+            except FencedError:
+                return
+            except (ConnectionError, OSError, RuntimeError):
+                self.lost = True
+                monitor.stat_add("ps.replication_lost")
+                return
+
+    def flush(self, timeout=10.0):
+        """Async mode: block until the queue drains (tests/benches)."""
+        if self.sync:
+            return True
+        with self._cv:
+            return self._cv.wait_for(
+                lambda: not self._queue or self.lost, timeout=timeout)
+
+    def close(self):
+        with self._lock:
+            self.lost = True
+            self._cv.notify_all()
+            if self._sock is not None:
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+                self._sock = None

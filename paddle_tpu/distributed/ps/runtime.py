@@ -13,6 +13,21 @@ Env contract (same variable names as the reference):
   PADDLE_PORT + POD_IP         this server's bind endpoint (server role)
   PADDLE_TRAINERS_NUM          number of trainers
   PADDLE_TRAINER_ID            this trainer's rank
+
+Durability/replication extensions (this runtime's additions):
+  PADDLE_PS_WAL_DIR            per-server write-ahead-log directory; set
+                               it and the server recovers bitwise after
+                               kill -9 (service.py / wal.py)
+  PADDLE_PS_BACKUP_ENDPOINT    this server's standby twin — applied
+                               mutations forward there under a fencing
+                               epoch (replica.py)
+  PADDLE_PS_BACKUP_LIST        comma-separated backup endpoint per entry
+                               of PADDLE_PSERVERS_IP_PORT_LIST ('' for
+                               none); workers fail over to these
+  PADDLE_PS_EPOCH              starting fencing epoch of a (re)started
+                               server (a relaunched old primary at a
+                               stale epoch is rejected by its promoted
+                               backup)
 """
 
 from __future__ import annotations
@@ -70,7 +85,12 @@ class PSRuntime:
 
     # -- server side ---------------------------------------------------------
     def init_server(self):
-        self._server = PSServer(self.role.my_server_endpoint())
+        env = os.environ
+        self._server = PSServer(
+            self.role.my_server_endpoint(),
+            wal_dir=env.get("PADDLE_PS_WAL_DIR") or None,
+            backup=env.get("PADDLE_PS_BACKUP_ENDPOINT") or None,
+            epoch=int(env.get("PADDLE_PS_EPOCH", "0")))
         return self._server
 
     def run_server(self):
@@ -80,7 +100,16 @@ class PSRuntime:
 
     # -- worker side ---------------------------------------------------------
     def init_worker(self):
-        self._client = PSClient(self.role.server_endpoints)
+        raw = os.environ.get("PADDLE_PS_BACKUP_LIST", "")
+        backups = None
+        if raw.strip():
+            backups = [b.strip() or None for b in raw.split(",")]
+            if len(backups) != len(self.role.server_endpoints):
+                raise ValueError(
+                    "PADDLE_PS_BACKUP_LIST must pair 1:1 with "
+                    "PADDLE_PSERVERS_IP_PORT_LIST")
+        self._client = PSClient(self.role.server_endpoints,
+                                backups=backups)
         self._communicator = Communicator(
             self._client, mode=self.mode, geo_step=self.geo_step).start()
         return self._client

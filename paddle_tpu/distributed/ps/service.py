@@ -4,15 +4,40 @@ Ref parity: paddle/fluid/distributed/service/ — BrpcPsServer/BrpcPsClient
 (brpc RPC with sendrecv.proto) and Communicator (trainer-side async
 send queues, sync/async/geo modes, communicator.h:197). TPU-native
 redesign: the transport is a length-prefixed binary protocol over TCP
-with a typed tag codec (the wire schema role sendrecv.proto plays in
-the reference) — never pickle, so a hostile peer cannot execute code —
-plus an HMAC shared-secret handshake per connection. Servers are a
-thread pool holding the tables of §tables.py, and sparse rows are
+with a typed tag codec (codec.py — the wire schema role sendrecv.proto
+plays in the reference) — never pickle, so a hostile peer cannot execute
+code — plus an HMAC shared-secret handshake per connection. Servers are
+a thread pool holding the tables of §tables.py, and sparse rows are
 partitioned across servers by `id % n_servers` (the reference shards by
 id range per table — modulo keeps shard balance without a shard map).
 Trainers talk through PSClient; Communicator batches pushes in a
 background thread (async), pushes inline (sync), or accumulates local
-deltas pushed every k steps (geo, ref SparseGeoTable).
+deltas pushed every k steps (geo, ref SparseGeoTable) under the
+`FLAGS_ps_geo_staleness` bound.
+
+Durability & failure transparency (the robustness layer serving/fleet.py
+gave replicas, grown here for the PS tier):
+
+* every mutating command carries ``(client_id, seq)``; servers dedupe by
+  the per-(table, client) watermark, so a push retried across a
+  reconnect — or across a primary->backup failover — applies exactly
+  once (``ps.dedup_hits`` counts the suppressions);
+* with ``wal_dir`` set, mutations append to a per-table write-ahead log
+  (wal.py) *before* they apply, and a restarted server replays
+  snapshot + WAL back to bitwise-identical table state;
+* with ``backup`` set, applied mutations forward to a standby under a
+  fencing epoch (replica.py); `PSClient` promotes the backup when the
+  primary stops answering, and a zombie primary that comes back is
+  rejected by epoch;
+* `PSClient` calls retry transparently: dead cached sockets (broken
+  pipe / ECONNRESET after a server restart) are dropped and redialed
+  under exponential backoff, each attempt's socket timeout clipped to
+  the call's remaining deadline.
+
+Fault sites (framework/faults.py): ``ps.push`` between WAL append and
+apply, ``ps.pull`` per lookup, ``ps.wal_append`` before the log write,
+``ps.replicate`` per forward, ``ps.failover`` per client promotion,
+``ps.spill`` per SSD spill batch.
 """
 
 from __future__ import annotations
@@ -21,6 +46,7 @@ import hashlib
 import hmac
 import os
 import socket
+import uuid
 import zlib
 import socketserver
 import struct
@@ -29,136 +55,14 @@ import time
 
 import numpy as np
 
+from ...framework import faults, monitor
+from ...framework.flags import flag
+from .codec import dumps as _dumps, loads as _loads  # noqa: F401 — re-export
+from .replica import FencedError, ReplicaLink
 from .tables import DenseTable, SparseTable
 
 _MAGIC = b"PTPS"
 _MAX_FRAME = 1 << 34          # 16 GiB — sanity bound on frame length
-_MAX_DEPTH = 32               # nesting bound for the decoder
-
-# -- typed wire codec (replaces sendrecv.proto; no pickle anywhere) ----------
-# tags: N none, T true, F false, i int64, I big-int(str), f float64,
-#       s str, b bytes, l list, t tuple, d dict, a ndarray
-_I64_MIN, _I64_MAX = -(1 << 63), (1 << 63) - 1
-
-
-def _enc(obj, out: bytearray):
-    if obj is None:
-        out += b"N"
-    elif isinstance(obj, (bool, np.bool_)):
-        out += b"T" if obj else b"F"
-    elif isinstance(obj, (int, np.integer)):
-        v = int(obj)
-        if _I64_MIN <= v <= _I64_MAX:
-            out += b"i" + struct.pack("<q", v)
-        else:
-            s = str(v).encode()
-            out += b"I" + struct.pack("<I", len(s)) + s
-    elif isinstance(obj, (float, np.floating)):
-        out += b"f" + struct.pack("<d", float(obj))
-    elif isinstance(obj, str):
-        raw = obj.encode()
-        out += b"s" + struct.pack("<I", len(raw)) + raw
-    elif isinstance(obj, bytes):
-        out += b"b" + struct.pack("<Q", len(obj)) + obj
-    elif isinstance(obj, np.ndarray):
-        if obj.dtype.hasobject:
-            raise TypeError("PS wire codec cannot serialize object arrays")
-        dt = obj.dtype.str.encode()     # e.g. b'<f4' — endian-explicit
-        raw = np.ascontiguousarray(obj).tobytes()
-        out += (b"a" + struct.pack("<B", len(dt)) + dt
-                + struct.pack("<B", obj.ndim)
-                + struct.pack(f"<{obj.ndim}q", *obj.shape)
-                + struct.pack("<Q", len(raw)) + raw)
-    elif isinstance(obj, (list, tuple)):
-        out += (b"l" if isinstance(obj, list) else b"t")
-        out += struct.pack("<I", len(obj))
-        for x in obj:
-            _enc(x, out)
-    elif isinstance(obj, dict):
-        out += b"d" + struct.pack("<I", len(obj))
-        for k, v in obj.items():
-            _enc(k, out)
-            _enc(v, out)
-    else:
-        raise TypeError(
-            f"PS wire codec cannot serialize {type(obj).__name__}")
-
-
-class _Dec:
-    def __init__(self, buf: bytes):
-        self.buf = buf
-        self.pos = 0
-
-    def _take(self, n):
-        if self.pos + n > len(self.buf):
-            raise ConnectionError("truncated PS frame")
-        v = self.buf[self.pos:self.pos + n]
-        self.pos += n
-        return v
-
-    def value(self, depth=0):
-        if depth > _MAX_DEPTH:
-            raise ConnectionError("PS frame nests too deep")
-        tag = self._take(1)
-        if tag == b"N":
-            return None
-        if tag == b"T":
-            return True
-        if tag == b"F":
-            return False
-        if tag == b"i":
-            return struct.unpack("<q", self._take(8))[0]
-        if tag == b"I":
-            (n,) = struct.unpack("<I", self._take(4))
-            return int(self._take(n).decode())
-        if tag == b"f":
-            return struct.unpack("<d", self._take(8))[0]
-        if tag == b"s":
-            (n,) = struct.unpack("<I", self._take(4))
-            return self._take(n).decode()
-        if tag == b"b":
-            (n,) = struct.unpack("<Q", self._take(8))
-            return self._take(n)
-        if tag == b"a":
-            (dtn,) = struct.unpack("<B", self._take(1))
-            dt = np.dtype(self._take(dtn).decode())
-            if dt.hasobject:
-                raise ConnectionError("object arrays not allowed on wire")
-            (ndim,) = struct.unpack("<B", self._take(1))
-            shape = struct.unpack(f"<{ndim}q", self._take(8 * ndim))
-            (nbytes,) = struct.unpack("<Q", self._take(8))
-            arr = np.frombuffer(self._take(nbytes), dtype=dt)
-            return arr.reshape(shape).copy()
-        if tag in (b"l", b"t"):
-            (n,) = struct.unpack("<I", self._take(4))
-            items = [self.value(depth + 1) for _ in range(n)]
-            return items if tag == b"l" else tuple(items)
-        if tag == b"d":
-            (n,) = struct.unpack("<I", self._take(4))
-            return {self.value(depth + 1): self.value(depth + 1)
-                    for _ in range(n)}
-        raise ConnectionError(f"bad PS wire tag {tag!r}")
-
-
-def _dumps(obj) -> bytes:
-    out = bytearray()
-    _enc(obj, out)
-    return bytes(out)
-
-
-def _loads(buf: bytes):
-    try:
-        dec = _Dec(buf)
-        val = dec.value()
-        if dec.pos != len(buf):
-            raise ConnectionError("trailing bytes in PS frame")
-        return val
-    except ConnectionError:
-        raise
-    except (ValueError, TypeError, UnicodeDecodeError, struct.error) as e:
-        # bad utf-8, dtype strings, buffer-size mismatches, unhashable
-        # dict keys — normalise so the server's drop path handles them
-        raise ConnectionError(f"malformed PS frame: {e!r}") from e
 
 
 _warned_default_token = False
@@ -213,11 +117,32 @@ def _recv_msg(sock):
     return _loads(_recv_exact(sock, size))
 
 
+class PSUnavailableError(ConnectionError):
+    """A PS call exhausted its retry deadline (server down and no
+    promotable backup). ConnectionError subclass so bootstrap loops
+    that poll for a server coming up keep working."""
+
+
+class _RetriableServerError(RuntimeError):
+    """Server answered with a transient ('errR') failure — safe to
+    retry because every mutating command is idempotent under its
+    (client_id, seq)."""
+
+
 class _Handler(socketserver.BaseRequestHandler):
     def handle(self):
         server: PSServer = self.server.ps  # type: ignore[attr-defined]
         sock = self.request
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        with server._conns_lock:
+            server._conns.add(sock)
+        try:
+            self._serve(server, sock)
+        finally:
+            with server._conns_lock:
+                server._conns.discard(sock)
+
+    def _serve(self, server, sock):
         try:
             # challenge-response handshake before any command is accepted;
             # a short pre-auth timeout keeps a silent stranger from
@@ -241,6 +166,14 @@ class _Handler(socketserver.BaseRequestHandler):
                 try:
                     result = server._dispatch(cmd, args)
                     _send_msg(sock, ("ok", result))
+                except faults.FaultError as e:
+                    # injected transient infrastructure error: the
+                    # client may retry (idempotent under (cid, seq))
+                    _send_msg(sock, ("errR", repr(e)))
+                except FencedError as e:
+                    _send_msg(sock, ("err", repr(e)))
+                except (ConnectionError, OSError) as e:
+                    _send_msg(sock, ("errR", repr(e)))
                 except Exception as e:  # noqa: BLE001 — report to client
                     _send_msg(sock, ("err", repr(e)))
         except (ConnectionError, OSError):
@@ -252,25 +185,87 @@ class _TCP(socketserver.ThreadingTCPServer):
     daemon_threads = True
 
 
-class PSServer:
-    """One parameter-server rank (ref BrpcPsServer, server.h:64)."""
+#: commands that mutate table state and therefore carry (cid, seq),
+#: WAL-append before apply, and forward to the backup replica
+_MUTATIONS = ("push_dense_grad", "push_sparse_grad", "set_dense")
 
-    def __init__(self, endpoint):
+
+class PSServer:
+    """One parameter-server rank (ref BrpcPsServer, server.h:64).
+
+    `wal_dir` makes the rank crash-durable (write-ahead log + snapshot,
+    recovery happens in __init__ before the first request is served);
+    `backup` mirrors applied mutations to a standby endpoint under the
+    fencing `epoch`.
+    """
+
+    def __init__(self, endpoint, wal_dir=None, backup=None, epoch=0,
+                 replica_sync=True):
         host, port = endpoint.rsplit(":", 1)
         self.endpoint = endpoint
         self._tables: dict[str, object] = {}
         self._tables_lock = threading.Lock()
+        # one lock serializes dedup-check + WAL append + apply + forward
+        # so a retry racing its original attempt can never double-apply
+        self._mutate_lock = threading.RLock()
+        self._applied: dict[tuple, int] = {}   # (table, cid) -> last seq
+        self._epoch = int(epoch)
+        self._fenced = False
+        self._store = None
+        self.recovered_records = 0
+        self._replica = None
+        if backup:
+            self._replica = ReplicaLink(backup, sync=replica_sync,
+                                        on_fenced=self._on_fenced)
+        if wal_dir:
+            from .wal import DurableStore
+
+            self._store = DurableStore(wal_dir)
+            self._recover()
         self._barrier_count = 0
         self._barrier_gen = 0
         self._barrier_cv = threading.Condition()
+        self._conns: set = set()       # live client sockets
+        self._conns_lock = threading.Lock()
         self._shutdown_flag = threading.Event()
         self._tcp = _TCP((host, int(port)), _Handler)
         self._tcp.ps = self  # type: ignore[attr-defined]
+        self.endpoint = f"{host}:{self._tcp.server_address[1]}"
         self._thread = None
 
     @property
     def port(self):
         return self._tcp.server_address[1]
+
+    @property
+    def epoch(self):
+        return self._epoch
+
+    def _on_fenced(self):
+        """The backup rejected our replication stream: a newer epoch
+        exists, so this server is a zombie — stop taking mutations."""
+        self._fenced = True
+        monitor.stat_add("ps.zombies_fenced")
+
+    # -- recovery ------------------------------------------------------------
+    def _recover(self):
+        def create(cmd, args):
+            if cmd == "delete_table":
+                self._tables.pop(args, None)
+            else:
+                self._create(cmd, args, durable=False)
+
+        def load(name, sd):
+            t = self._tables.get(name)
+            if t is not None:
+                t.load_state_dict(sd)
+
+        def apply(table, cid, seq, cmd, args):
+            if table in self._tables:
+                self._apply_mutation(cmd, args)
+
+        self._applied, self.recovered_records = self._store.recover(
+            create, load, apply)
 
     # -- lifecycle -----------------------------------------------------------
     def start(self):
@@ -286,48 +281,163 @@ class PSServer:
         t.start()
         self._shutdown_flag.wait()
         self._tcp.shutdown()
+        self._close_durable()
 
     def stop(self):
         self._shutdown_flag.set()
         self._tcp.shutdown()
         self._tcp.server_close()
+        self._close_durable()
 
-    # -- request dispatch ----------------------------------------------------
-    def _dispatch(self, cmd, args):
-        if cmd == "create_dense":
-            name, shape, opt, lr, initial = args
-            with self._tables_lock:  # racing trainers must not replace a
-                if name not in self._tables:  # table that has taken pushes
+    def _close_durable(self):
+        if self._store is not None:
+            self._store.close()
+        if self._replica is not None:
+            self._replica.close()
+
+    def kill_transport(self):
+        """Ungraceful death for in-process chaos tests/benches: the TCP
+        front vanishes mid-conversation — listener closed AND every live
+        client connection severed — tables and WAL buffers abandoned
+        exactly as `kill -9` would leave them (no checkpoint, no close,
+        no final fsync beyond what already landed)."""
+        self._tcp.shutdown()
+        self._tcp.server_close()
+        with self._conns_lock:
+            conns, self._conns = list(self._conns), set()
+        for s in conns:
+            try:
+                s.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                s.close()
+            except OSError:
+                pass
+
+    # -- table creation (meta-logged) ----------------------------------------
+    def _create(self, cmd, args, durable=True):
+        name = args[0]
+        created = False
+        with self._tables_lock:  # racing trainers must not replace a
+            if name not in self._tables:  # table that has taken pushes
+                if cmd == "create_dense":
+                    _n, shape, opt, lr, initial = args
                     self._tables[name] = DenseTable(
                         name, shape, optimizer=opt, lr=lr, initial=initial)
-            return None
-        if cmd == "create_sparse":
-            name, dim, opt, lr, init_range, seed = args
-            with self._tables_lock:
-                if name not in self._tables:
+                elif cmd == "create_sparse":
+                    _n, dim, opt, lr, init_range, seed = args
                     self._tables[name] = SparseTable(
                         name, dim, optimizer=opt, lr=lr,
                         init_range=init_range, seed=seed)
-            return None
-        if cmd == "create_ssd_sparse":
-            name, dim, opt, lr, init_range, seed, mem_rows = args
-            from .tables import SSDSparseTable
+                elif cmd == "create_ssd_sparse":
+                    from .tables import SSDSparseTable
 
-            with self._tables_lock:
-                if name not in self._tables:
+                    _n, dim, opt, lr, init_range, seed, mem_rows = args
                     self._tables[name] = SSDSparseTable(
                         name, dim, optimizer=opt, lr=lr,
                         init_range=init_range, seed=seed,
                         mem_rows=mem_rows)
-            return None
-        if cmd == "create_graph":
-            name, seed = args
-            from .tables import GraphTable
+                elif cmd == "create_graph":
+                    from .tables import GraphTable
 
-            with self._tables_lock:
-                if name not in self._tables:
+                    _n, seed = args
                     self._tables[name] = GraphTable(name, seed=seed)
-            return None
+                else:
+                    raise ValueError(f"unknown create command {cmd!r}")
+                created = True
+        if created and durable:
+            if self._store is not None and cmd != "create_graph":
+                # graph tables are not WAL'd
+                self._store.log_meta(cmd, args)
+            if self._replica is not None:
+                # the backup must hold the table a replicated push will
+                # mutate; creates are idempotent there
+                self._replica.forward_command(cmd, args)
+        return None
+
+    # -- mutation path: dedup + WAL + apply + replicate ----------------------
+    def _apply_mutation(self, cmd, args):
+        if cmd == "push_dense_grad":
+            self._tables[args[0]].push_grad(args[1])
+        elif cmd == "push_sparse_grad":
+            self._tables[args[0]].push_grad(args[1], args[2])
+        elif cmd == "set_dense":
+            self._tables[args[0]].set(args[1])
+        else:
+            raise ValueError(f"unknown mutation {cmd!r}")
+
+    def _mutate(self, cmd, args, cid, seq, epoch=None, replicate=True):
+        table = args[0]
+        with self._mutate_lock:
+            if epoch is not None:
+                if epoch < self._epoch:
+                    raise FencedError(
+                        f"replicate at epoch {epoch} rejected by "
+                        f"{self.endpoint} (fencing epoch {self._epoch})")
+            elif self._fenced:
+                raise FencedError(
+                    f"server {self.endpoint} was superseded at epoch "
+                    f"{self._epoch}; refusing client mutations")
+            has_seq = bool(cid) and seq is not None and seq >= 0
+            key = (table, cid)
+            if has_seq and seq <= self._applied.get(key, -1):
+                monitor.stat_add("ps.dedup_hits")
+                return "dup"
+            if self._store is not None:
+                self._store.log_push(table, cid, seq, cmd, args)
+            # THE mid-push fault site: after the record is durable,
+            # before the table mutates (crash here = recovery replays
+            # the WAL; the retried push dedupes)
+            faults.fault_point("ps.push", tag=table)
+            self._apply_mutation(cmd, args)
+            if has_seq:
+                self._applied[key] = seq
+            if replicate and self._replica is not None:
+                self._replica.forward(self._epoch, table, cid, seq,
+                                      cmd, args)
+        return None
+
+    # -- request dispatch ----------------------------------------------------
+    def _dispatch(self, cmd, args):
+        if cmd.startswith("create_"):
+            return self._create(cmd, args)
+        if cmd in _MUTATIONS:
+            *core, cid, seq = args
+            return self._mutate(cmd, tuple(core), cid, seq)
+        if cmd == "replicate":
+            epoch, _table, cid, seq, mcmd, margs = args
+            return self._mutate(mcmd, tuple(margs), cid, seq,
+                                epoch=epoch, replicate=False)
+        if cmd == "promote":
+            new_epoch = int(args)
+            with self._mutate_lock:
+                if new_epoch <= self._epoch and self._fenced:
+                    raise FencedError(
+                        f"promote to epoch {new_epoch} rejected: "
+                        f"{self.endpoint} already fenced at "
+                        f"{self._epoch}")
+                self._epoch = max(self._epoch, new_epoch)
+                self._fenced = False
+                monitor.stat_add("ps.promotions")
+                return self._epoch
+        if cmd == "epoch":
+            return (self._epoch, self._fenced)
+        if cmd == "ps_checkpoint":
+            if self._store is None:
+                return None
+            with self._mutate_lock:
+                states = {n: t.state_dict()
+                          for n, t in self._tables.items()
+                          if hasattr(t, "state_dict")
+                          and not type(t).__name__ == "GraphTable"}
+                return self._store.checkpoint(states, dict(self._applied))
+        if cmd == "ps_wal_stats":
+            if self._store is None:
+                return None
+            return {"generation": self._store.generation,
+                    "nbytes": self._store.nbytes,
+                    "replayed": self.recovered_records}
         if cmd == "graph_add_edges":
             name, src, dst, weight = args
             return self._tables[name].add_edges(src, dst, weight)
@@ -344,22 +454,12 @@ class PSServer:
             name, ids, dim = args
             return self._tables[name].get_node_feat(ids, dim)
         if cmd == "pull_dense":
+            faults.fault_point("ps.pull", tag=args)
             return self._tables[args].pull()
-        if cmd == "push_dense_grad":
-            name, grad = args
-            self._tables[name].push_grad(grad)
-            return None
-        if cmd == "set_dense":
-            name, value = args
-            self._tables[name].set(value)
-            return None
         if cmd == "pull_sparse":
             name, ids = args
+            faults.fault_point("ps.pull", tag=name)
             return self._tables[name].pull(ids)
-        if cmd == "push_sparse_grad":
-            name, ids, grads = args
-            self._tables[name].push_grad(ids, grads)
-            return None
         if cmd == "barrier":
             n_trainers = args
             with self._barrier_cv:
@@ -385,13 +485,26 @@ class PSServer:
         if cmd == "save":
             return {n: t.state_dict() for n, t in self._tables.items()}
         if cmd == "load":
-            for n, sd in args.items():
-                if n in self._tables:
-                    self._tables[n].load_state_dict(sd)
+            with self._mutate_lock:
+                for n, sd in args.items():
+                    if n in self._tables:
+                        self._tables[n].load_state_dict(sd)
+                if self._store is not None:
+                    # fold the loaded state into a snapshot so recovery
+                    # does not replay pre-load WAL records over it
+                    states = {n: t.state_dict()
+                              for n, t in self._tables.items()
+                              if not type(t).__name__ == "GraphTable"}
+                    self._store.checkpoint(states, dict(self._applied))
             return None
         if cmd == "delete_table":
             with self._tables_lock:
                 t = self._tables.pop(args, None)
+            if self._store is not None:
+                self._store.log_meta("delete_table", args)
+                self._store.drop_table(args)
+            if self._replica is not None:
+                self._replica.forward_command("delete_table", args)
             if t is not None and hasattr(t, "close"):
                 t.close()  # SSD tables reclaim their spill directory
             return None
@@ -405,19 +518,47 @@ class PSClient:
     """Trainer-side connection pool (ref BrpcPsClient, ps_client.h:55).
 
     Sparse rows are partitioned id % n_servers; dense tables live on
-    server hash(name) % n_servers.
+    server hash(name) % n_servers. Every call retries transparently
+    (reconnect + exponential backoff, socket timeout clipped to the
+    call's remaining `op_deadline_s`); mutations are made idempotent by
+    a per-(shard, table) monotone `seq` the servers dedupe on, so a
+    retry — including one that lands on a freshly promoted backup —
+    applies exactly once. `backups[i]` names the standby for shard i:
+    after `failover_after` consecutive connection failures the client
+    promotes it with a bumped fencing epoch and swaps the pair.
     """
 
-    def __init__(self, endpoints):
+    def __init__(self, endpoints, backups=None, client_id=None,
+                 op_deadline_s=30.0, retry_backoff_s=0.05,
+                 max_backoff_s=2.0, failover_after=2):
         self.endpoints = list(endpoints)
-        self._socks = [None] * len(self.endpoints)
-        self._locks = [threading.Lock() for _ in self.endpoints]
+        n = len(self.endpoints)
+        self.backups = list(backups) if backups else [None] * n
+        if len(self.backups) != n:
+            raise ValueError("backups must pair 1:1 with endpoints")
+        self.client_id = client_id or uuid.uuid4().hex
+        self.op_deadline_s = float(op_deadline_s)
+        self.retry_backoff_s = float(retry_backoff_s)
+        self.max_backoff_s = float(max_backoff_s)
+        self.failover_after = int(failover_after)
+        self._socks = [None] * n
+        self._locks = [threading.Lock() for _ in range(n)]
+        self._seq_lock = threading.Lock()
+        self._seqs: dict[tuple, int] = {}     # (shard, table) -> last seq
+        self._epochs = [0] * n
         self._sparse_dims: dict[str, int] = {}
+
+    def _next_seq(self, i, name):
+        with self._seq_lock:
+            key = (i, name)
+            nxt = self._seqs.get(key, -1) + 1
+            self._seqs[key] = nxt
+            return nxt
 
     def _sock(self, i):
         if self._socks[i] is None:
             host, port = self.endpoints[i].rsplit(":", 1)
-            s = socket.create_connection((host, int(port)), timeout=30.0)
+            s = socket.create_connection((host, int(port)), timeout=10.0)
             # per-call timeout must exceed the server's 60s barrier wait,
             # or a blocked barrier desyncs the RPC framing (the late
             # reply would be read as the NEXT call's response)
@@ -440,15 +581,128 @@ class PSClient:
             self._socks[i] = s
         return self._socks[i]
 
-    def _call(self, server_idx, cmd, args):
-        with self._locks[server_idx]:
-            sock = self._sock(server_idx)
+    def _drop_sock(self, i):
+        """A server restart leaves a dead cached socket behind (broken
+        pipe / ECONNRESET on next use): close and forget it so the next
+        attempt redials instead of failing forever."""
+        with self._locks[i]:
+            s = self._socks[i]
+            if s is not None:
+                try:
+                    s.close()
+                except OSError:
+                    pass
+                self._socks[i] = None
+
+    def _attempt(self, i, cmd, args, deadline, min_timeout):
+        with self._locks[i]:
+            sock = self._sock(i)
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise PSUnavailableError(
+                    f"PS call {cmd!r} deadline expired before the "
+                    f"attempt to {self.endpoints[i]}")
+            # deadline propagation: no attempt may outlive the call
+            sock.settimeout(max(min_timeout, min(120.0, remaining)))
             _send_msg(sock, (cmd, args))
             status, result = _recv_msg(sock)
+        if status == "ok":
+            return result
+        if status == "errR":
+            raise _RetriableServerError(
+                f"transient PS error from {self.endpoints[i]}: {result}")
+        raise RuntimeError(f"PS error from "
+                           f"{self.endpoints[i]}: {result}")
+
+    def _call(self, server_idx, cmd, args, retriable=True,
+              deadline_s=None, min_timeout=0.05):
+        deadline = time.monotonic() + (deadline_s or self.op_deadline_s)
+        backoff = self.retry_backoff_s
+        conn_failures = 0
+        last_err = None
+        while True:
+            try:
+                return self._attempt(server_idx, cmd, args, deadline,
+                                     min_timeout)
+            except _RetriableServerError as e:
+                # server-side transient: framing is intact, keep socket
+                last_err = e
+            except (ConnectionError, OSError) as e:
+                last_err = e
+                conn_failures += 1
+                self._drop_sock(server_idx)
+            if not retriable:
+                raise last_err
+            if conn_failures >= self.failover_after \
+                    and self.backups[server_idx]:
+                if self._failover(server_idx):
+                    conn_failures = 0
+                    continue          # fresh primary: retry right away
+            now = time.monotonic()
+            if now + backoff > deadline:
+                raise PSUnavailableError(
+                    f"PS call {cmd!r} to {self.endpoints[server_idx]} "
+                    f"failed for {self.op_deadline_s:.0f}s "
+                    f"({last_err!r})") from last_err
+            time.sleep(backoff)
+            backoff = min(backoff * 2, self.max_backoff_s)
+
+    # -- failover ------------------------------------------------------------
+    def _raw_call(self, endpoint, cmd, args, timeout=10.0):
+        """One-shot handshake + call against an arbitrary endpoint."""
+        host, port = endpoint.rsplit(":", 1)
+        s = socket.create_connection((host, int(port)), timeout=timeout)
+        try:
+            s.settimeout(timeout)
+            head = _recv_exact(s, 20)
+            if head[:4] != _MAGIC:
+                raise ConnectionError("bad PS handshake magic")
+            s.sendall(hmac.new(_auth_key(), head[4:],
+                               hashlib.sha256).digest())
+            if _recv_exact(s, 2) != b"OK":
+                raise ConnectionError("PS authentication failed")
+            _send_msg(s, (cmd, args))
+            status, result = _recv_msg(s)
+        finally:
+            s.close()
         if status != "ok":
-            raise RuntimeError(f"PS error from "
-                               f"{self.endpoints[server_idx]}: {result}")
+            raise RuntimeError(f"PS error from {endpoint}: {result}")
         return result
+
+    def _failover(self, i):
+        """Promote shard i's backup with a bumped fencing epoch and swap
+        the pair. Returns True when the backup accepted (or was already
+        at) the new epoch."""
+        backup = self.backups[i]
+        faults.fault_point("ps.failover", tag=self.endpoints[i])
+        new_epoch = self._epochs[i] + 1
+        try:
+            granted = int(self._raw_call(backup, "promote", new_epoch))
+        except (ConnectionError, OSError):
+            return False              # backup unreachable too — backoff
+        except RuntimeError:
+            # another client may have promoted already: accept the
+            # backup as primary iff its epoch has moved past ours
+            try:
+                granted, fenced = self._raw_call(backup, "epoch", None)
+                if fenced or granted < new_epoch:
+                    return False
+            except (ConnectionError, OSError, RuntimeError):
+                return False
+        with self._locks[i]:
+            old = self.endpoints[i]
+            self.endpoints[i] = backup
+            self.backups[i] = old
+            s = self._socks[i]
+            if s is not None:
+                try:
+                    s.close()
+                except OSError:
+                    pass
+                self._socks[i] = None
+        self._epochs[i] = int(granted)
+        monitor.stat_add("ps.failovers")
+        return True
 
     def _dense_server(self, name):
         # stable across processes (builtin hash is randomized per run)
@@ -540,12 +794,16 @@ class PSClient:
         return self._call(self._dense_server(name), "pull_dense", name)
 
     def push_dense_grad(self, name, grad):
-        self._call(self._dense_server(name), "push_dense_grad",
-                   (name, np.asarray(grad, np.float32)))
+        i = self._dense_server(name)
+        self._call(i, "push_dense_grad",
+                   (name, np.asarray(grad, np.float32),
+                    self.client_id, self._next_seq(i, name)))
 
     def set_dense(self, name, value):
-        self._call(self._dense_server(name), "set_dense",
-                   (name, np.asarray(value, np.float32)))
+        i = self._dense_server(name)
+        self._call(i, "set_dense",
+                   (name, np.asarray(value, np.float32),
+                    self.client_id, self._next_seq(i, name)))
 
     # -- sparse (partitioned) ------------------------------------------------
     def pull_sparse(self, name, ids):
@@ -575,16 +833,35 @@ class PSClient:
             pos = np.nonzero(ids % n == i)[0]
             if pos.size:
                 self._call(i, "push_sparse_grad",
-                           (name, ids[pos], grads[pos]))
+                           (name, ids[pos], grads[pos],
+                            self.client_id, self._next_seq(i, name)))
 
     def delete_table(self, name):
         for i in range(len(self.endpoints)):
             self._call(i, "delete_table", name)
         self._sparse_dims.pop(name, None)
 
+    # -- durability / replication control ------------------------------------
+    def checkpoint(self):
+        """Snapshot + WAL rotation on every durable server; -> [gen]."""
+        return [self._call(i, "ps_checkpoint", None)
+                for i in range(len(self.endpoints))]
+
+    def wal_stats(self):
+        return [self._call(i, "ps_wal_stats", None)
+                for i in range(len(self.endpoints))]
+
+    def server_epoch(self, i=0):
+        """-> (fencing epoch, fenced?) of shard i's current primary."""
+        return tuple(self._call(i, "epoch", None))
+
     # -- control -------------------------------------------------------------
     def barrier(self, n_trainers):
-        self._call(0, "barrier", n_trainers)
+        # barriers are NOT idempotent (a blind retry would double-count
+        # this trainer) and legitimately block up to the server's 60s
+        # window — no transparent retry, generous deadline
+        self._call(0, "barrier", n_trainers, retriable=False,
+                   min_timeout=130.0)
 
     def save(self):
         return [self._call(i, "save", None)
@@ -597,7 +874,7 @@ class PSClient:
     def stop_servers(self):
         for i in range(len(self.endpoints)):
             try:
-                self._call(i, "stop", None)
+                self._call(i, "stop", None, retriable=False)
             except (RuntimeError, ConnectionError, OSError):
                 pass
 
@@ -618,7 +895,11 @@ class Communicator:
       sync  — push_* forwards immediately; callers barrier per step
       async — pushes enqueue; a background thread drains (Hogwild-style)
       geo   — sparse pushes accumulate locally as deltas; every
-              `geo_step` flushes merged deltas (optimizer='sum' tables)
+              `geo_step` flushes merged deltas (optimizer='sum' tables).
+              `FLAGS_ps_geo_staleness` bounds the accumulation: once
+              that many update rows are pending the flush happens NOW,
+              so a reader's staleness is capped in updates, not steps
+              (SURVEY.md geo semantics).
     """
 
     def __init__(self, client: PSClient, mode="async", geo_step=4):
@@ -636,6 +917,7 @@ class Communicator:
         self._error: Exception | None = None
         self._geo_acc: dict[str, dict[int, np.ndarray]] = {}
         self._geo_count = 0
+        self._geo_pending = 0     # update rows accumulated since flush
 
     def set_geo_scale(self, table_name, scale):
         self.geo_scales[table_name] = float(scale)
@@ -692,6 +974,12 @@ class Communicator:
                     acc[i] = acc[i] + g
                 else:
                     acc[i] = g.copy()
+            self._geo_pending += int(ids.size)
+            bound = flag("FLAGS_ps_geo_staleness")
+            if bound and self._geo_pending >= bound:
+                # staleness bound hit: force the sync flush early
+                monitor.stat_add("ps.geo_forced_flushes")
+                self.flush()
             return
         if self.mode == "sync":
             self.client.push_sparse_grad(name, ids, grads)
@@ -731,6 +1019,7 @@ class Communicator:
                 scale = self.geo_scales.get(name, 1.0)
                 self.client.push_sparse_grad(name, ids, scale * grads)
             self._geo_acc = {}
+            self._geo_pending = 0
             return
         if self.mode == "async":
             # wait until queued AND in-flight pushes have landed

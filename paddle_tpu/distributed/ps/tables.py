@@ -13,9 +13,13 @@ PS-managed parameters, exactly the reference's split.
 from __future__ import annotations
 
 import ctypes
+import struct
 import threading
+import zlib
 
 import numpy as np
+
+from ...framework import faults
 
 _I64P = ctypes.POINTER(ctypes.c_int64)
 _F32P = ctypes.POINTER(ctypes.c_float)
@@ -64,12 +68,21 @@ class DenseTable:
             self.value = np.asarray(value, self.value.dtype).copy()
 
     def state_dict(self):
+        # optimizer state rides along: a snapshot that dropped the
+        # adagrad accumulator would make post-recovery pushes diverge
+        # from the uninterrupted trajectory (WAL bitwise contract)
         with self._lock:
-            return {"value": self.value.copy()}
+            sd = {"value": self.value.copy()}
+            if self._accum is not None:
+                sd["accum"] = self._accum.copy()
+            return sd
 
     def load_state_dict(self, sd):
         with self._lock:
             self.value = np.asarray(sd["value"]).copy()
+            acc = sd.get("accum")
+            self._accum = None if acc is None else \
+                np.asarray(acc, self.value.dtype).copy()
 
 
 class SparseTable:
@@ -182,6 +195,12 @@ class SparseTable:
             return len(self._rows)
 
     def state_dict(self):
+        # NOTE native limitation: the C++ table exports rows only (no
+        # pst_export_accum entry point), so a snapshot of a *native*
+        # adagrad table loses the accumulator — replay-from-genesis
+        # recovery stays bitwise-exact, snapshot-based recovery of a
+        # native adagrad table is value-only. The python fallback
+        # exports "accums" alongside "rows" and is fully exact.
         with self._lock:
             if self._handle is not None:
                 n = int(self._lib.pst_size(self._handle))
@@ -199,7 +218,13 @@ class SparseTable:
             ids = np.array(sorted(self._rows), np.int64)
             rows = (np.stack([self._rows[int(i)] for i in ids])
                     if len(ids) else np.empty((0, self.dim), np.float32))
-            return {"ids": ids, "rows": rows}
+            sd = {"ids": ids, "rows": rows}
+            if self.optimizer == "adagrad":
+                zero = np.zeros(self.dim, np.float32)
+                sd["accums"] = (
+                    np.stack([self._accum.get(int(i), zero) for i in ids])
+                    if len(ids) else np.empty((0, self.dim), np.float32))
+            return sd
 
     def load_state_dict(self, sd):
         ids = np.ascontiguousarray(np.asarray(sd["ids"], np.int64))
@@ -213,6 +238,10 @@ class SparseTable:
             else:
                 for i, r in zip(ids, rows):
                     self._rows[int(i)] = r.copy()
+                accs = sd.get("accums")
+                if accs is not None:
+                    for i, a in zip(ids, np.asarray(accs, np.float32)):
+                        self._accum[int(i)] = a.copy()
 
 
 class SSDSparseTable(SparseTable):
@@ -251,9 +280,13 @@ class SSDSparseTable(SparseTable):
         os.makedirs(self._spill_dir, exist_ok=True)
         self._has_accum = optimizer == "adagrad"
         self._rec_dim = self.dim * (2 if self._has_accum else 1)
-        self._rec_bytes = 8 + 4 * self._rec_dim  # i64 id + f32 payload
+        # i64 id + f32 payload + trailing crc32 — a torn or bit-rotted
+        # spill record fails its checksum at read instead of handing a
+        # corrupt embedding row back to training
+        self._rec_bytes = 8 + 4 * self._rec_dim + 4
         self._ssd_handle = None
         self._spill_f = None
+        self._closed = False
         if use_native:
             from ...native import ps_table_lib
 
@@ -276,6 +309,13 @@ class SSDSparseTable(SparseTable):
             # native tables would otherwise hold a dead fd + file each
             self._rows = OrderedDict()  # LRU: oldest first
             self._spill_path = os.path.join(self._spill_dir, "rows.bin")
+            try:
+                # a crash mid-_compact can strand the tmp file; the
+                # replace never happened so rows.bin is intact — just
+                # clear the leftover
+                os.unlink(self._spill_path + ".compact")
+            except OSError:
+                pass
             self._spill_f = open(self._spill_path, "w+b")
             self._index: dict[int, int] = {}  # id -> file offset
             self._dead_records = 0
@@ -290,9 +330,29 @@ class SSDSparseTable(SparseTable):
             payload = np.concatenate([row, acc])
         else:
             payload = row
-        return np.int64(i).tobytes() + payload.astype(np.float32).tobytes()
+        body = np.int64(i).tobytes() + \
+            payload.astype(np.float32).tobytes()
+        return body + struct.pack("<I", zlib.crc32(body))
+
+    def _check_rec(self, rec, i):
+        """Verify one spill record's frame + checksum; -> f32 payload."""
+        if len(rec) != self._rec_bytes:
+            raise RuntimeError(
+                f"SSD table {self.name!r}: torn spill record for id "
+                f"{i} ({len(rec)}/{self._rec_bytes} bytes)")
+        (crc,) = struct.unpack("<I", rec[-4:])
+        if zlib.crc32(rec[:-4]) != crc:
+            raise RuntimeError(
+                f"SSD table {self.name!r}: spill record for id {i} "
+                "failed its checksum (torn write or bit rot)")
+        return np.frombuffer(rec[8:-4], np.float32)
 
     def _evict_lru(self):
+        if len(self._rows) > self.mem_rows:
+            # the mid-spill fault site: a crash here loses only cache
+            # state (the WAL is the durability story); an ioerror here
+            # models a full/failing spill disk
+            faults.fault_point("ps.spill", tag=self.name)
         while len(self._rows) > self.mem_rows:
             i, _ = next(iter(self._rows.items()))
             if i in self._index:
@@ -310,8 +370,8 @@ class SSDSparseTable(SparseTable):
         if off is None:
             return False
         self._spill_f.seek(off)
-        rec = self._spill_f.read(self._rec_bytes)
-        payload = np.frombuffer(rec[8:], np.float32)
+        payload = self._check_rec(
+            self._spill_f.read(self._rec_bytes), i)
         self._rows[i] = payload[:self.dim].copy()
         if self._has_accum:
             self._accum[i] = payload[self.dim:].copy()
@@ -322,14 +382,26 @@ class SSDSparseTable(SparseTable):
     def _compact(self):
         import os
 
+        faults.fault_point("ps.spill", tag=self.name)
         new_path = self._spill_path + ".compact"
-        with open(new_path, "w+b") as nf:
-            new_index = {}
-            for i, off in self._index.items():
-                self._spill_f.seek(off)
-                rec = self._spill_f.read(self._rec_bytes)
-                new_index[i] = nf.tell()
-                nf.write(rec)
+        try:
+            with open(new_path, "w+b") as nf:
+                new_index = {}
+                for i, off in self._index.items():
+                    self._spill_f.seek(off)
+                    rec = self._spill_f.read(self._rec_bytes)
+                    self._check_rec(rec, i)  # never propagate torn data
+                    new_index[i] = nf.tell()
+                    nf.write(rec)
+                nf.flush()
+                os.fsync(nf.fileno())
+        except BaseException:
+            # crash-safe: the live file is untouched until the replace
+            try:
+                os.unlink(new_path)
+            except OSError:
+                pass
+            raise
         self._spill_f.close()
         os.replace(new_path, self._spill_path)
         self._spill_f = open(self._spill_path, "r+b")
@@ -354,6 +426,7 @@ class SSDSparseTable(SparseTable):
         return self._spill_f is None
 
     def pull(self, ids):
+        self._check_open()
         if self._native_mode:
             ids = np.ascontiguousarray(
                 np.asarray(ids, np.int64).reshape(-1))
@@ -370,6 +443,7 @@ class SSDSparseTable(SparseTable):
         return out
 
     def push_grad(self, ids, grads):
+        self._check_open()
         if self._native_mode:
             ids = np.ascontiguousarray(
                 np.asarray(ids, np.int64).reshape(-1))
@@ -386,18 +460,21 @@ class SSDSparseTable(SparseTable):
 
     def resident_rows(self):
         """In-memory (hot) row count — observability for the LRU bound."""
+        self._check_open()
         with self._lock:
             if self._native_mode:
                 return int(self._lib.pst_ssd_resident(self._native_handle()))
             return len(self._rows)
 
     def spilled_rows(self):
+        self._check_open()
         with self._lock:
             if self._native_mode:
                 return int(self._lib.pst_ssd_spilled(self._native_handle()))
             return len(self._index)
 
     def __len__(self):
+        self._check_open()
         with self._lock:
             if self._native_mode:
                 return int(self._lib.pst_ssd_size(self._native_handle()))
@@ -408,6 +485,7 @@ class SSDSparseTable(SparseTable):
         # must be an atomic snapshot, never interleaved with pushes);
         # spilled rows are peeked read-only so the export causes no LRU
         # churn
+        self._check_open()
         with self._lock:
             if self._native_mode:
                 h = self._native_handle()
@@ -426,17 +504,28 @@ class SSDSparseTable(SparseTable):
                 return {"ids": ids, "rows": rows}
             ids = sorted(set(self._rows) | set(self._index))
             rows = np.empty((len(ids), self.dim), np.float32)
+            accs = (np.zeros((len(ids), self.dim), np.float32)
+                    if self._has_accum else None)
             for k, i in enumerate(ids):
                 i = int(i)
                 r = self._rows.get(i)
                 if r is None:
                     self._spill_f.seek(self._index[i])
-                    rec = self._spill_f.read(self._rec_bytes)
-                    r = np.frombuffer(rec[8:], np.float32)[:self.dim]
+                    payload = self._check_rec(
+                        self._spill_f.read(self._rec_bytes), i)
+                    r = payload[:self.dim]
+                    if accs is not None:
+                        accs[k] = payload[self.dim:]
+                elif accs is not None and i in self._accum:
+                    accs[k] = self._accum[i]
                 rows[k] = r
-            return {"ids": np.asarray(ids, np.int64), "rows": rows}
+            sd = {"ids": np.asarray(ids, np.int64), "rows": rows}
+            if accs is not None:
+                sd["accums"] = accs
+            return sd
 
     def load_state_dict(self, sd):
+        self._check_open()
         if self._native_mode:
             ids = np.ascontiguousarray(np.asarray(sd["ids"], np.int64))
             rows = np.ascontiguousarray(
@@ -453,13 +542,19 @@ class SSDSparseTable(SparseTable):
 
     def close(self):
         """Release the spill file/handle and delete a self-created spill
-        dir (delete_table / server shutdown path).  Takes the table lock
-        so an in-flight pull/push finishes before the native object is
-        freed (the PS server is a thread pool)."""
+        dir (delete_table / server shutdown path).  Idempotent — a
+        second close (or `__del__` after an explicit close) is a no-op.
+        Takes the table lock so an in-flight pull/push finishes before
+        the native object is freed (the PS server is a thread pool)."""
         import os
         import shutil
 
+        if getattr(self, "_closed", True):
+            return          # already closed, or __init__ never finished
         with self._lock:
+            if self._closed:
+                return
+            self._closed = True
             if self._ssd_handle is not None:
                 self._lib.pst_ssd_free(self._ssd_handle)
                 self._ssd_handle = None
@@ -468,9 +563,14 @@ class SSDSparseTable(SparseTable):
                     self._spill_f.close()
                 except Exception:  # noqa: BLE001 — already closed
                     pass
+                self._spill_f = None
         if getattr(self, "_owns_spill_dir", False) and \
                 os.path.isdir(self._spill_dir):
             shutil.rmtree(self._spill_dir, ignore_errors=True)
+
+    def _check_open(self):
+        if self._closed:
+            raise RuntimeError(f"SSD table {self.name!r} is closed")
 
     def _native_handle(self):
         """Handle re-read UNDER the lock: a concurrent close() nulls it,

@@ -1,0 +1,366 @@
+"""Write-ahead log + snapshot store for the durable PS tier.
+
+Ref intent: the reference PS persists sparse shards through rocksdb's
+WAL + memtable flush; here durability is first-class in the service
+layer instead. Every mutating command a `PSServer` accepts is appended
+to a per-table append-only log *before* it is applied, so a `kill -9`
+at any instant loses at most the in-flight (unacknowledged) push — which
+the client retries, and the server dedupes by ``(client_id, seq)``.
+Recovery = newest readable snapshot + replay of each table's log, and is
+bitwise-exact because table optimizers are deterministic functions of
+(state, ordered grads).
+
+On-disk layout under the server's ``wal_dir``::
+
+    meta.wal            create/delete table control records (never rotated)
+    t-<name>-<crc>.wal  one push log per table
+    snapshot-<gen>.bin  checksummed codec blob {tables, applied, gen}
+
+Record framing is ``<I crc32> <I len> payload`` with the payload in the
+typed wire codec (codec.py) — a torn tail (the partial record a crash
+can leave) fails its checksum and cleanly ends replay; anything *after*
+a bad record is unreachable, which is exactly the WAL contract (records
+are acknowledged only once written, and writes are sequential).
+
+Generation protocol (how snapshot + logs stay consistent without a
+truncate race): every log file begins with a header record carrying its
+``generation``. `checkpoint()` runs under the server's mutation lock
+(quiesced), writes ``snapshot-<g+1>`` via tmp+fsync+rename, then rotates
+every table log to a fresh file with header generation ``g+1``. At
+recovery, a table log whose generation is *older* than the snapshot's
+holds only records already folded into the snapshot (the quiesce
+guarantees nothing landed between the state capture and the rotation) —
+it is skipped wholesale and re-rotated; a log at the snapshot's
+generation is replayed in full.
+
+Batched durability: appends are buffered and fsync'd every
+``FLAGS_ps_wal_sync_interval`` records (1 = every record). A larger
+interval trades a bounded post-crash window — at most interval-1
+acknowledged-but-unsynced records, which the client-side retry would
+*not* replay — for append throughput; the default keeps the
+exactly-once certification strict.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import struct
+import threading
+import zlib
+
+from ...framework import faults, monitor
+from ...framework.flags import flag
+from . import codec
+
+__all__ = ["WriteAheadLog", "DurableStore", "WalCorruptError"]
+
+_HDR = struct.Struct("<II")           # crc32(payload), len(payload)
+_HEADER_KIND = "__wal__"              # first record of every log file
+
+
+class WalCorruptError(RuntimeError):
+    """A log or snapshot failed its checksum somewhere other than the
+    tolerated torn tail."""
+
+
+def _frame(payload: bytes) -> bytes:
+    return _HDR.pack(zlib.crc32(payload), len(payload)) + payload
+
+
+def _iter_frames(raw: bytes):
+    """Yield decoded records; stop silently at a torn/corrupt tail."""
+    pos = 0
+    while pos + _HDR.size <= len(raw):
+        crc, n = _HDR.unpack_from(raw, pos)
+        body = raw[pos + _HDR.size:pos + _HDR.size + n]
+        if len(body) < n or zlib.crc32(body) != crc:
+            return                      # torn tail — end of durable data
+        try:
+            yield codec.loads(body)
+        except ConnectionError:
+            return                      # undecodable == torn
+        pos += _HDR.size + n
+
+
+class WriteAheadLog:
+    """One append-only record log with a generation header.
+
+    Thread-safety: append/sync/close take an internal lock; the server
+    additionally serializes all mutations, so the lock is belt and
+    braces for direct users (bench, tests).
+    """
+
+    def __init__(self, path, generation=0):
+        self.path = path
+        self._lock = threading.Lock()
+        self._unsynced = 0
+        fresh = not os.path.exists(path) or os.path.getsize(path) == 0
+        self._f = open(path, "ab")
+        self.generation = generation
+        if fresh:
+            self._append_raw((_HEADER_KIND, int(generation)))
+            self.sync()
+        else:
+            got = read_header(path)
+            self.generation = generation if got is None else got
+
+    # -- append side ---------------------------------------------------------
+    def _append_raw(self, record):
+        buf = _frame(codec.dumps(record))
+        self._f.write(buf)
+        monitor.stat_add("ps.wal_bytes", len(buf))
+        monitor.stat_add("ps.wal_records")
+        self._unsynced += 1
+
+    def append(self, record, sync_interval=None):
+        """Append one record; fsync once `sync_interval` records are
+        pending (None = FLAGS_ps_wal_sync_interval). Passes the
+        ``ps.wal_append`` fault site *before* the write lands — a
+        ``crash`` there models death with the record lost, which the
+        client-side retry must absorb."""
+        if sync_interval is None:
+            sync_interval = flag("FLAGS_ps_wal_sync_interval")
+        with self._lock:
+            faults.fault_point("ps.wal_append", record)
+            self._append_raw(record)
+            if self._unsynced >= max(1, int(sync_interval)):
+                self._sync_locked()
+
+    def _sync_locked(self):
+        self._f.flush()
+        os.fsync(self._f.fileno())
+        self._unsynced = 0
+
+    def sync(self):
+        with self._lock:
+            self._sync_locked()
+
+    @property
+    def nbytes(self):
+        with self._lock:
+            return self._f.tell()
+
+    def close(self):
+        with self._lock:
+            if self._f is not None and not self._f.closed:
+                self._sync_locked()
+                self._f.close()
+
+    # -- replay side ---------------------------------------------------------
+    @staticmethod
+    def replay(path):
+        """-> (generation, [records]) — records after the header, torn
+        tail tolerated. A file without a valid header replays empty."""
+        with open(path, "rb") as f:
+            raw = f.read()
+        it = _iter_frames(raw)
+        head = next(it, None)
+        if (not isinstance(head, tuple) or len(head) != 2
+                or head[0] != _HEADER_KIND):
+            return 0, []
+        return int(head[1]), list(it)
+
+    @classmethod
+    def rotate(cls, path, generation):
+        """Atomically replace `path` with a fresh log at `generation`
+        (tmp + fsync + rename, so a crash leaves either the old or the
+        new complete file, never a torn one)."""
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(_frame(codec.dumps((_HEADER_KIND, int(generation)))))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        return cls(path, generation=generation)
+
+
+def read_header(path):
+    """Generation of an existing log file, or None if unreadable."""
+    try:
+        with open(path, "rb") as f:
+            raw = f.read(4096)
+    except OSError:
+        return None
+    head = next(_iter_frames(raw), None)
+    if (isinstance(head, tuple) and len(head) == 2
+            and head[0] == _HEADER_KIND):
+        return int(head[1])
+    return None
+
+
+def _table_file(name):
+    safe = re.sub(r"[^A-Za-z0-9_.-]", "_", name)[:80]
+    return f"t-{safe}-{zlib.crc32(name.encode()):08x}.wal"
+
+
+class DurableStore:
+    """Everything a `PSServer` needs to survive `kill -9`:
+
+    * `log_meta` — create/delete control records (meta.wal)
+    * `log_push` — per-table mutation records ``(client_id, seq, cmd,
+      args)`` appended before apply
+    * `checkpoint` — quiesced snapshot + log rotation (generation bump)
+    * `recover` — meta replay -> snapshot load -> per-table log replay,
+      driven through caller-supplied hooks so the store never imports
+      the table classes
+    """
+
+    def __init__(self, directory):
+        self.dir = directory
+        os.makedirs(directory, exist_ok=True)
+        self.generation = self._latest_snapshot_gen()
+        self._meta = WriteAheadLog(os.path.join(directory, "meta.wal"),
+                                   generation=0)
+        self._logs: dict[str, WriteAheadLog] = {}
+        self.replayed_records = 0
+
+    # -- logging -------------------------------------------------------------
+    def _log(self, table):
+        wal = self._logs.get(table)
+        if wal is None:
+            wal = self._logs[table] = WriteAheadLog(
+                os.path.join(self.dir, _table_file(table)),
+                generation=self.generation)
+            if wal.generation < self.generation:
+                # stale pre-snapshot log (crash between snapshot rename
+                # and rotation): its records are already folded in
+                wal.close()
+                wal = self._logs[table] = WriteAheadLog.rotate(
+                    os.path.join(self.dir, _table_file(table)),
+                    self.generation)
+        return wal
+
+    def log_meta(self, cmd, args):
+        self._meta.append((cmd, args), sync_interval=1)
+
+    def log_push(self, table, client_id, seq, cmd, args):
+        self._log(table).append((client_id, seq, cmd, args))
+
+    def drop_table(self, table):
+        wal = self._logs.pop(table, None)
+        if wal is not None:
+            wal.close()
+        try:
+            os.unlink(os.path.join(self.dir, _table_file(table)))
+        except OSError:
+            pass
+
+    def sync(self):
+        for wal in self._logs.values():
+            wal.sync()
+
+    @property
+    def nbytes(self):
+        return sum(w.nbytes for w in self._logs.values()) + \
+            self._meta.nbytes
+
+    # -- snapshot ------------------------------------------------------------
+    def _snap_path(self, gen):
+        return os.path.join(self.dir, f"snapshot-{gen}.bin")
+
+    def _latest_snapshot_gen(self):
+        best = 0
+        for name in os.listdir(self.dir):
+            m = re.fullmatch(r"snapshot-(\d+)\.bin", name)
+            if m:
+                best = max(best, int(m.group(1)))
+        return best
+
+    def checkpoint(self, table_states, applied):
+        """Write snapshot generation+1 and rotate every table log.
+
+        MUST be called with the server's mutation lock held (the
+        quiesce is what makes 'log generation == snapshot generation
+        <=> records are post-snapshot' true)."""
+        gen = self.generation + 1
+        payload = codec.dumps({
+            "gen": gen,
+            "tables": table_states,
+            "applied": [(t, c, s) for (t, c), s in applied.items()],
+        })
+        tmp = self._snap_path(gen) + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(_frame(payload))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._snap_path(gen))
+        self.generation = gen
+        for table, wal in list(self._logs.items()):
+            wal.close()
+            self._logs[table] = WriteAheadLog.rotate(wal.path, gen)
+        # GC superseded snapshots (newest one is all recovery reads)
+        for name in os.listdir(self.dir):
+            m = re.fullmatch(r"snapshot-(\d+)\.bin", name)
+            if m and int(m.group(1)) < gen:
+                try:
+                    os.unlink(os.path.join(self.dir, name))
+                except OSError:
+                    pass
+        return gen
+
+    def _load_snapshot(self):
+        gen = self._latest_snapshot_gen()
+        if gen == 0:
+            return 0, None
+        with open(self._snap_path(gen), "rb") as f:
+            raw = f.read()
+        rec = next(_iter_frames(raw), None)
+        if rec is None:
+            raise WalCorruptError(
+                f"snapshot-{gen} failed its checksum; refusing to "
+                "recover from corrupt state")
+        return gen, rec
+
+    # -- recovery ------------------------------------------------------------
+    def recover(self, create, load, apply):
+        """Rebuild server state through three hooks:
+
+        create(cmd, args)                — meta record (create_*/delete)
+        load(table_name, state_dict)     — snapshot state
+        apply(table, cid, seq, cmd, args)— one logged mutation, in order
+
+        -> (applied watermarks {(table, cid): seq}, replayed records).
+        """
+        for cmd, args in WriteAheadLog.replay(self._meta.path)[1]:
+            create(cmd, args)
+        gen, snap = self._load_snapshot()
+        applied: dict = {}
+        if snap is not None:
+            self.generation = gen
+            for name, sd in snap["tables"].items():
+                load(name, sd)
+            for t, c, s in snap["applied"]:
+                applied[(t, c)] = s
+        replayed = 0
+        for fname in sorted(os.listdir(self.dir)):
+            if not fname.startswith("t-") or not fname.endswith(".wal"):
+                continue
+            path = os.path.join(self.dir, fname)
+            g, records = WriteAheadLog.replay(path)
+            if g < self.generation:
+                continue          # pre-snapshot: already folded in
+            for cid, seq, cmd, args in records:
+                table = args[0]
+                has_seq = bool(cid) and seq is not None and seq >= 0
+                key = (table, cid)
+                if has_seq and seq <= applied.get(key, -1):
+                    # a retry of an already-logged push (raise fired
+                    # between WAL append and ack) left a duplicate
+                    # record — replay must dedupe exactly like the
+                    # live server did
+                    monitor.stat_add("ps.dedup_hits")
+                    continue
+                apply(table, cid, seq, cmd, args)
+                if has_seq:
+                    applied[key] = seq
+                replayed += 1
+        self.replayed_records = replayed
+        monitor.stat_add("ps.wal_replayed_records", replayed)
+        return applied, replayed
+
+    def close(self):
+        self._meta.close()
+        for wal in self._logs.values():
+            wal.close()
+        self._logs = {}

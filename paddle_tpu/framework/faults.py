@@ -51,6 +51,30 @@ A fault point is a named site the runtime passes through:
     serving.replay            each failover replay of a dead replica's
                               request (raise = replay path failure →
                               typed error to the client)
+    ps.push                   each PS mutation between WAL append and
+                              table apply, tagged with the table name
+                              (crash = kill mid-push: recovery replays
+                              the WAL and the client's retry dedupes;
+                              raise = acked-after-logging retry path)
+    ps.pull                   each PS pull_dense/pull_sparse lookup,
+                              tagged with the table name
+    ps.wal_append             before each WAL record write (crash =
+                              death with the record lost — the client
+                              retry must absorb it)
+    ps.spill                  each SSDSparseTable eviction batch or
+                              compaction, tagged with the table name
+                              (ioerror = full/failing spill disk)
+    ps.replicate              each primary->backup forward (raise =
+                              replication link hiccup; delay = slow
+                              backup)
+    ps.failover               each PSClient promotion of a backup after
+                              the primary stopped answering, tagged
+                              with the failing endpoint
+
+The authoritative site list is the `SITES` registry below;
+`fault_point` refuses to fire for an unregistered site, and the
+fault-site audit test asserts every registered site is exercised by at
+least one tier-1 test.
 
 Faults are scheduled programmatically::
 
@@ -87,8 +111,39 @@ import time
 
 from . import monitor
 
-__all__ = ["FaultError", "DROP", "fault_point", "inject", "reset",
-           "parse_spec", "corrupt_leaf", "ChaosSchedule"]
+__all__ = ["FaultError", "DROP", "SITES", "fault_point", "inject",
+           "reset", "parse_spec", "corrupt_leaf", "ChaosSchedule"]
+
+#: every fault site in the runtime (site -> where it fires). Keeping
+#: this registry authoritative is what makes chaos certification
+#: honest: `fault_point` raises on an unregistered site, so a renamed
+#: site cannot silently turn a chaos test into a clean run, and the
+#: audit test (tests/test_fault_sites.py) fails when a registered site
+#: loses its tier-1 coverage.
+SITES = {
+    "checkpoint.io": "each checkpoint write attempt",
+    "checkpoint.before_commit": "after tmp write, before atomic rename",
+    "checkpoint.after_commit": "after the rename; payload = ckpt dir",
+    "train.batch": "each Engine.train_batch",
+    "elastic.beat": "each elastic heartbeat write",
+    "preempt.poll": "each preemption poll (step boundary)",
+    "serving.submit": "each admission attempt",
+    "serving.dequeue": "each queue pop by assembler/decode engine",
+    "serving.batch": "each dynamic-batcher flush",
+    "serving.step": "each continuous-batching decode step",
+    "serving.alloc_block": "each physical KV-block allocation",
+    "serving.cow_split": "before each copy-on-write block copy",
+    "serving.replica_step": "each fleet replica loop iteration",
+    "serving.replica_heartbeat": "each fleet replica heartbeat",
+    "serving.route": "each fleet Router dispatch attempt",
+    "serving.replay": "each failover replay of a dead replica request",
+    "ps.push": "each PS mutation between WAL append and apply",
+    "ps.pull": "each PS pull_dense/pull_sparse lookup",
+    "ps.wal_append": "before each PS WAL record write",
+    "ps.spill": "each SSD sparse-table spill batch / compaction",
+    "ps.replicate": "each PS primary->backup forward",
+    "ps.failover": "each PSClient promotion of a backup",
+}
 
 
 class FaultError(RuntimeError):
@@ -267,6 +322,15 @@ def fault_point(site, payload=None, tag=None):
     with _lock:
         if not _specs:
             return payload  # zero-cost when nothing is scheduled
+        if site not in SITES and not any(s.site == site for s in _specs):
+            # A spec that names the site explicitly is its own audit
+            # trail (tests exercise the scheduling machinery through
+            # ad-hoc sites); an unregistered site nobody asked for is
+            # a typo'd or undeclared production fault point.
+            raise ValueError(
+                f"fault_point fired for unregistered site {site!r} — "
+                "add it to faults.SITES (and a tier-1 test) so chaos "
+                "schedules stay auditable")
         _hits[site] = hit = _hits.get(site, 0) + 1
         thit = None
         if tag is not None:

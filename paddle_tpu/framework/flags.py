@@ -64,6 +64,20 @@ _DEFS = {
         0, int,
         "testing: report a preemption at the Nth preemption poll "
         "(step/epoch boundary); 0 disables"),
+    "FLAGS_ps_wal_sync_interval": (
+        1, int,
+        "parameter server: fsync the write-ahead log every N appended "
+        "records (1 = every record). Larger values trade a bounded "
+        "post-crash loss window (at most N-1 acknowledged-but-unsynced "
+        "records) for push throughput; the default keeps the "
+        "exactly-once recovery certification strict"),
+    "FLAGS_ps_geo_staleness": (
+        64, int,
+        "parameter server geo-async mode: maximum update rows a "
+        "trainer may accumulate locally before the Communicator forces "
+        "a synchronous flush (0 disables the bound; the geo_step "
+        "cadence still flushes). Bounds reader staleness in updates "
+        "rather than steps per SURVEY.md's geo semantics"),
     "FLAGS_serving_max_batch": (
         8, int,
         "serving: slot-pool size of the continuous-batching decode "

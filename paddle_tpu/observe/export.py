@@ -80,6 +80,9 @@ def snapshot(serving=None):
             "last": recorder.flight.snapshot()["records"][-1:],
             "dumps": recorder.flight.dumps(),
         },
+        # durable-PS view mirrors the paddle_ps_* Prometheus family
+        "ps": {stat.split(".", 1)[1]: monitor.stat_get(stat)
+               for stat in _PS_METRICS},
     }
     if serving is not None:
         out["serving"] = serving.snapshot()
@@ -101,6 +104,24 @@ def dump(path, serving=None):
 # ---------------------------------------------------------------------------
 
 _NAME_OK = re.compile(r"[^a-zA-Z0-9_:]")
+
+#: monitor stat -> (prometheus name, type, help) for the durable-PS
+#: family; emitted explicitly (ahead of the generic monitor dump, which
+#: would mistype the gauges as counters) and mirrored in snapshot()["ps"]
+_PS_METRICS = {
+    "ps.wal_bytes": (
+        "paddle_ps_wal_bytes", "gauge",
+        "bytes appended to the PS write-ahead logs"),
+    "ps.replication_lag_updates": (
+        "paddle_ps_replication_lag_updates", "gauge",
+        "updates queued on the async primary->backup replica link"),
+    "ps.failovers": (
+        "paddle_ps_failovers_total", "counter",
+        "primary->backup promotions performed by PS clients"),
+    "ps.dedup_hits": (
+        "paddle_ps_dedup_hits_total", "counter",
+        "retried PS pushes suppressed by (client_id, seq) dedup"),
+}
 
 
 def _pname(name):
@@ -152,8 +173,16 @@ def prometheus_text(serving=None, queue_depth=None, fleet=None):
 
     L = _Lines()
 
+    # durable-PS family first: stable names + correct types (the generic
+    # monitor dump below would publish the gauges as counters), always
+    # present even at zero so dashboards see the series from boot
+    for stat, (pname, mtype, help_) in _PS_METRICS.items():
+        L.add(pname, monitor.stat_get(stat), mtype=mtype, help_=help_)
+
     for name, value in sorted(monitor.stats().items()):
         if not isinstance(value, (int, float)):
+            continue
+        if name in _PS_METRICS:
             continue
         L.add(f"paddle_{name}", value, mtype="counter",
               help_="framework.monitor stat")

@@ -30,7 +30,11 @@ def _scalar(v):
     try:
         return float(v)
     except Exception:
-        return repr(v)
+        try:
+            return repr(v)
+        except Exception:
+            # e.g. a donated/deleted jax array: even repr() raises
+            return f"<unreadable {type(v).__name__}>"
 
 
 class FlightRecorder:

@@ -1,0 +1,113 @@
+"""Durable-PS payload: one PS server or one pushing client, driven by
+the slow kill->recover tests (test_ps_chaos_slow.py).
+
+Modes (argv[2]):
+
+  server  — run a PSServer on 127.0.0.1:$PADDLE_PORT with the WAL dir
+            from $PADDLE_PS_WAL_DIR. Faults arrive from OUTSIDE: either
+            PADDLE_TPU_FAULTS (e.g. ps.push@4:crash — the harness kills
+            the process at the exact mid-push point, after the WAL
+            append, before the apply) or a real SIGKILL from the parent.
+  push    — run the deterministic push workload against $PS_ENDPOINT:
+            dense + sparse + SSD-sparse tables (all adagrad, so
+            optimizer state is part of the certification), N pushes
+            each, a mid-stream checkpoint() to exercise WAL rotation,
+            then write a pull-based state digest to out_dir/digest.
+            Progress is journalled to out_dir/progress so the parent
+            can time its kill; retries ride the client's own
+            reconnect/backoff — a server death is invisible here.
+
+The digest is the certification bar: sha256 over every table's pulled
+values BEFORE and AFTER one extra probe push (the probe makes the
+adagrad accumulators observable — two trajectories that pulled equal
+values but held different accumulators diverge on the probe). The
+parent asserts chaos-run digest == uninterrupted-run digest, bitwise.
+"""
+
+import hashlib
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(__file__)))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+from paddle_tpu.distributed import ps  # noqa: E402
+
+out_dir = sys.argv[1]
+mode = sys.argv[2]
+N_PUSHES = int(os.environ.get("PS_PAYLOAD_PUSHES", "12"))
+
+
+def run_server():
+    rt = ps.PSRuntime(ps.PSRoleMaker())
+    rt.run_server()
+
+
+def _digest(h, arr):
+    h.update(np.ascontiguousarray(np.asarray(arr, np.float32)).tobytes())
+
+
+def _pull_all(client, ids):
+    h = hashlib.sha256()
+    _digest(h, client.pull_dense("w"))
+    _digest(h, client.pull_sparse("emb", ids))
+    _digest(h, client.pull_sparse("ssd", ids))
+    return h
+
+
+def run_push():
+    client = ps.PSClient([os.environ["PS_ENDPOINT"]], op_deadline_s=60.0,
+                         retry_backoff_s=0.05)
+    progress = os.path.join(out_dir, "progress")
+
+    def note(step):
+        with open(progress + ".tmp", "w") as f:
+            f.write(str(step))
+        os.replace(progress + ".tmp", progress)
+
+    client.create_dense_table("w", [8], optimizer="adagrad", lr=0.1)
+    client.create_sparse_table("emb", 4, optimizer="adagrad", lr=0.1,
+                               init_range=0.05, seed=7)
+    client.create_ssd_sparse_table("ssd", 4, optimizer="adagrad", lr=0.1,
+                                   init_range=0.05, seed=9, mem_rows=4)
+    ids = np.arange(10, dtype=np.int64)
+    rng = np.random.RandomState(5)
+    for i in range(N_PUSHES):
+        client.push_dense_grad("w", rng.randn(8).astype(np.float32))
+        client.push_sparse_grad("emb", ids,
+                                rng.randn(10, 4).astype(np.float32))
+        client.push_sparse_grad("ssd", ids,
+                                rng.randn(10, 4).astype(np.float32))
+        if i == N_PUSHES // 2:
+            client.checkpoint()   # snapshot + WAL rotation mid-stream
+        note(i + 1)
+        # pacing knob so the parent's asynchronous SIGKILL lands
+        # mid-stream instead of after the workload already finished
+        time.sleep(float(os.environ.get("PS_PAYLOAD_SLEEP", "0")))
+
+    h1 = _pull_all(client, ids)
+    # probe push: equal pulls with unequal accumulators diverge here
+    client.push_dense_grad("w", np.ones(8, np.float32))
+    client.push_sparse_grad("emb", ids, np.ones((10, 4), np.float32))
+    client.push_sparse_grad("ssd", ids, np.ones((10, 4), np.float32))
+    h2 = _pull_all(client, ids)
+    stats = client.wal_stats()[0]
+    with open(os.path.join(out_dir, "digest"), "w") as f:
+        f.write(f"{h1.hexdigest()} {h2.hexdigest()}\n")
+        f.write(f"generation={stats['generation']} "
+                f"replayed={stats['replayed']}\n")
+    client.close()
+
+
+if mode == "server":
+    run_server()
+elif mode == "push":
+    run_push()
+else:
+    raise SystemExit(f"unknown ps_payload mode {mode!r}")

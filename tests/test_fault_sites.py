@@ -1,0 +1,124 @@
+"""Fault-site registry audit + coverage for the sites nothing else
+exercises (ISSUE 10 satellite 5).
+
+The registry contract (framework/faults.py SITES) is only honest if it
+is closed in both directions: every `fault_point(...)` literal in the
+tree must be registered, and every registered site must be exercised by
+at least one tier-1 (non-slow) test — otherwise a renamed or orphaned
+site silently turns chaos coverage into a clean run.
+"""
+
+import glob
+import os
+import re
+
+import numpy as np
+import pytest
+
+from paddle_tpu.framework import faults, monitor
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# fault_point("site", ...) / fault_point('site', ...) source literals
+_CALL_RE = re.compile(r"""fault_point\(\s*["']([a-z_.]+)["']""")
+
+
+def _source_files():
+    return glob.glob(os.path.join(_REPO, "paddle_tpu", "**", "*.py"),
+                     recursive=True)
+
+
+def test_every_fault_point_literal_is_registered():
+    """A fault_point() call on an unregistered site would raise at
+    runtime (only once faults are active) — catch it statically too."""
+    called = {}
+    for path in _source_files():
+        with open(path) as f:
+            for site in _CALL_RE.findall(f.read()):
+                called.setdefault(site, path)
+    unregistered = {s: p for s, p in called.items()
+                    if s not in faults.SITES}
+    assert not unregistered, (
+        f"fault_point() sites missing from faults.SITES: {unregistered}")
+
+
+def test_every_registered_site_has_a_call_site():
+    """A SITES entry with no fault_point() left in the tree is dead
+    weight — chaos specs naming it can never fire."""
+    called = set()
+    for path in _source_files():
+        with open(path) as f:
+            called.update(_CALL_RE.findall(f.read()))
+    orphaned = set(faults.SITES) - called
+    assert not orphaned, f"registered sites never fired: {orphaned}"
+
+
+def test_every_registered_site_is_exercised_by_tier1_tests():
+    """Every registered site must appear in at least one non-slow test
+    file, so `pytest -m 'not slow'` drives every chaos surface."""
+    text = ""
+    for path in glob.glob(os.path.join(_REPO, "tests", "*.py")):
+        if "slow" in os.path.basename(path):
+            continue
+        with open(path) as f:
+            text += f.read()
+    uncovered = {s for s in faults.SITES if s not in text}
+    assert not uncovered, (
+        f"fault sites with no tier-1 test coverage: {uncovered}")
+
+
+# ---------------------------------------------------------------------------
+# direct coverage for the sites no other tier-1 test drives
+# ---------------------------------------------------------------------------
+
+
+def test_checkpoint_after_commit_crash_is_post_commit(tmp_path):
+    """A crash at checkpoint.after_commit happens AFTER the atomic
+    rename: the checkpoint must already be durable and loadable."""
+    from paddle_tpu.distributed import checkpoint as ckpt
+
+    state = {"w": np.arange(8, dtype=np.float32)}
+    path = str(tmp_path / "c")
+    with faults.ChaosSchedule("checkpoint.after_commit@1:raise") as ch:
+        with pytest.raises(faults.FaultError):
+            ckpt.save_state(path, state)
+        ch.verify()
+    restored = ckpt.load_state(path, state)
+    np.testing.assert_array_equal(np.asarray(restored["w"]), state["w"])
+
+
+def test_serving_dequeue_fault_site_fires():
+    """serving.dequeue fires on every queue pop; a delay there models a
+    slow batch assembler and must not lose the request."""
+    from paddle_tpu.serving.queueing import AdmissionQueue, Request
+
+    q = AdmissionQueue(4)
+    with faults.ChaosSchedule("serving.dequeue@1:delay:0.01") as ch:
+        req = q.submit(Request("hello", max_new_tokens=1))
+        got = q.pop(timeout=1.0)
+        ch.verify()
+    assert got is req
+
+
+def test_ps_replicate_fault_drops_link_keeps_serving():
+    """A raise at ps.replicate is a replica-link hiccup: after the
+    link's retry budget the primary drops the link (availability over
+    replication) and keeps applying client pushes."""
+    from paddle_tpu.distributed import ps
+
+    backup = ps.PSServer("127.0.0.1:0").start()
+    primary = ps.PSServer("127.0.0.1:0", backup=backup.endpoint).start()
+    c = ps.PSClient([primary.endpoint])
+    lost = monitor.stat_get("ps.replication_lost")
+    # both forward attempts of one push fault -> second strike drops it
+    with faults.ChaosSchedule("ps.replicate@1:raise",
+                              "ps.replicate@2:raise") as ch:
+        c.create_dense_table("w", [2], optimizer="sgd", lr=1.0)
+        ch.verify()
+    assert primary._replica.lost
+    assert monitor.stat_get("ps.replication_lost") == lost + 1
+    c.push_dense_grad("w", np.ones(2, np.float32))  # still serving
+    np.testing.assert_allclose(c.pull_dense("w"), -1.0)
+    c.stop_servers()
+    primary.stop()
+    backup.stop()

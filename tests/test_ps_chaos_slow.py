@@ -1,0 +1,165 @@
+"""Fork-based durable-PS certification (slow tier).
+
+The fast in-process equivalents live in test_parameter_server.py
+(kill_transport + WAL replay). These versions use REAL process death —
+SIGKILL delivered by the parent at an arbitrary moment, and the fault
+harness's `crash` action (os._exit(137)) at the exact mid-push point:
+after the WAL append, before the table apply. A supervisor loop
+restarts the server on the same port + WAL dir; the pushing client
+retries transparently through every death.
+
+The certification bar: the pull-based state digest (dense + sparse +
+SSD tables, adagrad accumulators made observable by a probe push) is
+bitwise-identical to one uninterrupted reference run — zero lost, zero
+double-applied updates.
+"""
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PAYLOAD = os.path.join(REPO, "tests", "ps_payload.py")
+
+pytestmark = pytest.mark.slow
+
+
+def _clean_env(**extra):
+    env = dict(os.environ)
+    for k in list(env):
+        if k.startswith("PADDLE_"):
+            del env[k]
+    env.pop("XLA_FLAGS", None)
+    env.pop("JAX_PLATFORMS", None)
+    env.update(extra)
+    return env
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _server_env(port, wal_dir, **extra):
+    return _clean_env(TRAINING_ROLE="PSERVER", POD_IP="127.0.0.1",
+                      PADDLE_PORT=str(port), PADDLE_PS_WAL_DIR=wal_dir,
+                      **extra)
+
+
+def _spawn_server(port, wal_dir, **extra):
+    return subprocess.Popen(
+        [sys.executable, PAYLOAD, wal_dir, "server"],
+        cwd=REPO, env=_server_env(port, wal_dir, **extra),
+        stdout=subprocess.DEVNULL, stderr=subprocess.PIPE, text=True)
+
+
+def _run_pusher(out_dir, port, timeout=180):
+    os.makedirs(out_dir, exist_ok=True)
+    return subprocess.run(
+        [sys.executable, PAYLOAD, out_dir, "push"],
+        cwd=REPO, env=_clean_env(PS_ENDPOINT=f"127.0.0.1:{port}"),
+        capture_output=True, text=True, timeout=timeout)
+
+
+def _wait_progress(out_dir, at_least, timeout=90):
+    path = os.path.join(out_dir, "progress")
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            with open(path) as f:
+                if int(f.read()) >= at_least:
+                    return
+        except (OSError, ValueError):
+            pass
+        time.sleep(0.05)
+    raise AssertionError(f"pusher never reached step {at_least}")
+
+
+def _read_digest(out_dir):
+    with open(os.path.join(out_dir, "digest")) as f:
+        return f.read().splitlines()
+
+
+@pytest.fixture(scope="module")
+def reference(tmp_path_factory):
+    """One uninterrupted run -> the bitwise digest every chaos run must
+    reproduce."""
+    out = str(tmp_path_factory.mktemp("ref"))
+    port = _free_port()
+    srv = _spawn_server(port, out)
+    try:
+        proc = _run_pusher(out, port)
+        assert proc.returncode == 0, proc.stderr
+    finally:
+        srv.kill()
+        srv.wait(timeout=20)
+    return _read_digest(out)
+
+
+def test_sigkill_mid_stream_recovers_bitwise(tmp_path, reference):
+    """A real `kill -9` at an arbitrary mid-stream moment: the restarted
+    server replays its WAL, the client's retry dedupes, digest matches
+    the uninterrupted run bitwise."""
+    out = str(tmp_path / "run")
+    os.makedirs(out)
+    port = _free_port()
+    srv = _spawn_server(port, out)
+    pusher = subprocess.Popen(
+        [sys.executable, PAYLOAD, out, "push"],
+        cwd=REPO, env=_clean_env(PS_ENDPOINT=f"127.0.0.1:{port}",
+                                 PS_PAYLOAD_SLEEP="0.15"),
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+    srv2 = None
+    try:
+        _wait_progress(out, 3)
+        srv.send_signal(signal.SIGKILL)
+        assert srv.wait(timeout=20) == -signal.SIGKILL
+        srv2 = _spawn_server(port, out)
+        stdout, stderr = pusher.communicate(timeout=180)
+        assert pusher.returncode == 0, stderr
+    finally:
+        for p in (pusher, srv, srv2):
+            if p is not None and p.poll() is None:
+                p.kill()
+    if srv2 is not None:
+        srv2.wait(timeout=20)
+    lines = _read_digest(out)
+    assert lines[0] == reference[0], "state diverged after kill -9"
+    # the replacement server genuinely replayed WAL records
+    assert "replayed=0" not in lines[1]
+
+
+def test_crash_action_mid_push_recovers_bitwise(tmp_path, reference):
+    """The deterministic variant: ps.push@K:crash makes the server
+    os._exit(137) at the exact mid-push point — record logged, apply
+    never ran. Recovery replays it; the client's in-flight retry of the
+    SAME (client_id, seq) dedupes instead of double-applying."""
+    out = str(tmp_path / "run")
+    os.makedirs(out)
+    port = _free_port()
+    srv = _spawn_server(port, out, PADDLE_TPU_FAULTS="ps.push@7:crash")
+    pusher = subprocess.Popen(
+        [sys.executable, PAYLOAD, out, "push"],
+        cwd=REPO, env=_clean_env(PS_ENDPOINT=f"127.0.0.1:{port}"),
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+    srv2 = None
+    try:
+        assert srv.wait(timeout=120) == 137  # the harness crash action
+        srv2 = _spawn_server(port, out)
+        stdout, stderr = pusher.communicate(timeout=180)
+        assert pusher.returncode == 0, stderr
+    finally:
+        for p in (pusher, srv, srv2):
+            if p is not None and p.poll() is None:
+                p.kill()
+    if srv2 is not None:
+        srv2.wait(timeout=20)
+    lines = _read_digest(out)
+    assert lines[0] == reference[0], "state diverged after mid-push crash"
+    assert "replayed=0" not in lines[1]

@@ -4,6 +4,8 @@ Ref parity: paddle/fluid/distributed/table/ssd_sparse_table.h (beyond-RAM
 embeddings), common_graph_table.h (neighbour sampling for GNN workers).
 """
 
+import os
+
 import numpy as np
 import pytest
 
@@ -240,3 +242,89 @@ def test_native_ssd_state_roundtrips_into_python(tmp_path):
     sd2 = py.state_dict()
     np.testing.assert_array_equal(sd["ids"], sd2["ids"])
     np.testing.assert_allclose(sd["rows"], sd2["rows"], rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# crash-safety satellites (ISSUE 10): idempotent close, torn-spill
+# detection, compaction atomicity
+# ---------------------------------------------------------------------------
+
+
+def test_ssd_close_idempotent_and_del_safe(tmp_path):
+    t = SSDSparseTable("emb", dim=4, optimizer="sgd", lr=0.1,
+                       mem_rows=4, spill_dir=str(tmp_path),
+                       use_native=False)
+    t.pull(np.arange(20, dtype=np.int64))
+    t.close()
+    t.close()                      # second close is a no-op, not a crash
+    t.__del__()                    # finalizer after close must not raise
+    with pytest.raises(RuntimeError, match="closed"):
+        t.pull(np.arange(2, dtype=np.int64))
+    with pytest.raises(RuntimeError, match="closed"):
+        t.push_grad(np.arange(2, dtype=np.int64),
+                    np.zeros((2, 4), np.float32))
+
+
+def test_ssd_spill_checksum_detects_corruption(tmp_path):
+    """Every spill record carries a trailing crc32; bit-rot (or a torn
+    write) in a spilled row is detected on read, not silently served."""
+    t = SSDSparseTable("emb", dim=4, optimizer="sgd", lr=0.1,
+                       mem_rows=2, spill_dir=str(tmp_path),
+                       use_native=False)
+    ids = np.arange(16, dtype=np.int64)
+    t.pull(ids)
+    assert t.spilled_rows() >= 14
+    # flip one payload byte of the first spill record on disk
+    path = t._spill_path
+    with open(path, "r+b") as f:
+        f.seek(10)
+        b = f.read(1)
+        f.seek(10)
+        f.write(bytes([b[0] ^ 0xFF]))
+    t._rows.clear()                # forget hot rows: force disk reads
+    with pytest.raises(RuntimeError, match="checksum|torn"):
+        t.pull(ids)                # whichever spilled row was hit
+    t.close()
+
+
+def test_ssd_compact_crash_leaves_no_torn_file(tmp_path):
+    """_compact() stages into a tmp file and renames: a fault mid-copy
+    (ps.spill site) must leave the original spill intact and no .compact
+    litter; a later clean compaction still works."""
+    from paddle_tpu.framework import faults
+
+    t = SSDSparseTable("emb", dim=4, optimizer="sgd", lr=0.1,
+                       mem_rows=2, spill_dir=str(tmp_path),
+                       use_native=False)
+    ids = np.arange(12, dtype=np.int64)
+    want = t.pull(ids).copy()
+    with faults.inject("ps.spill@1:raise"):
+        with pytest.raises(faults.FaultError):
+            t._compact()
+    assert not [f for f in os.listdir(tmp_path)
+                if f.endswith(".compact")]
+    np.testing.assert_array_equal(t.pull(ids), want)  # original intact
+    t._compact()                   # clean retry compacts fine
+    np.testing.assert_array_equal(t.pull(ids), want)
+    t.close()
+
+
+def test_ssd_stale_compact_tmp_cleaned_at_init(tmp_path):
+    """A crash between tmp write and rename leaves `<spill>.compact`;
+    the next open must discard it (it may be torn) and keep serving
+    from the real spill file."""
+    t = SSDSparseTable("emb", dim=4, optimizer="sgd", lr=0.1,
+                       mem_rows=2, spill_dir=str(tmp_path),
+                       use_native=False)
+    ids = np.arange(8, dtype=np.int64)
+    want = t.pull(ids).copy()
+    stale = t._spill_path + ".compact"
+    with open(stale, "wb") as f:
+        f.write(b"torn-half-written-compaction")
+    t.close()
+    t2 = SSDSparseTable("emb", dim=4, optimizer="sgd", lr=0.1,
+                        mem_rows=2, spill_dir=str(tmp_path),
+                        use_native=False)
+    assert not os.path.exists(stale)
+    np.testing.assert_array_equal(t2.pull(ids), want)
+    t2.close()
