@@ -1,0 +1,328 @@
+"""Recommender-serving bench: zipfian CTR ranking over the durable PS
+(ISSUE 11 / ROADMAP item 4).
+
+Two phases, ONE ``BENCH_REC`` JSON line:
+
+* **Load** — a `rec.RankingService` (wide&deep, PS-cached embeddings,
+  SSD sparse tables holding many times the cache-resident rows) serves
+  zipfian-keyed ranking waves while an `rec.OnlineTrainer` streams
+  click batches through the Communicator's geo mode underneath.
+  Reports QPS, p50/p99, cache hit rate, and the staleness histogram of
+  served reads (every bucket must sit within `FLAGS_ps_geo_staleness`).
+
+* **Chaos** — the same serve-while-training workload over a WAL +
+  replica stack, with scripted mid-push faults, and the PS primary's
+  transport killed mid-stream WHILE ranking futures are in flight.
+  Certification: ``chaos_goodput == 1.0`` (every submitted ranking
+  request completes exactly once — futures are first-wins), the
+  ChaosSchedule delivered exactly its plan, and the post-failover pull
+  digests of both embedding tables are BITWISE equal to an
+  uninterrupted clean run with identical durability config (exactly-
+  once pushes across retries and failover).
+
+Small-footprint smoke: ``python bench_rec.py --smoke`` shrinks every
+knob (used by the tier-1 subprocess test).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+DIM = 16
+SLOTS = 8
+ZIPF_A = 1.2
+
+# load phase
+N_IDS = 20_000          # logical id space (SSD table rows)
+CACHE_ROWS = 1_024      # device-cache capacity: ~20x fewer than the table
+WAVES = 30
+WAVE = 64
+MAX_BATCH = 16
+
+# chaos phase
+CHAOS_IDS = 600
+CHAOS_CACHE = 256
+CHAOS_FEEDS = 12
+CHAOS_WAVE = 16
+CHAOS_BATCH = 16
+CHAOS_SLOTS = 4
+
+
+def _zipf_ids(rng, n, size):
+    return ((rng.zipf(ZIPF_A, size) - 1) % n).astype(np.int64)
+
+
+def _mk_runtime(eps, mode, *, backups=None, geo_step=4, **client_kw):
+    from paddle_tpu.distributed import ps
+    from paddle_tpu.distributed.ps.service import Communicator
+
+    rm = ps.PSRoleMaker(server_endpoints=eps, role="TRAINER",
+                        trainer_id=0, n_trainers=1)
+    rt = ps.PSRuntime(rm, mode=mode)
+    rt._client = ps.PSClient(eps, backups=backups, **client_kw)
+    rt._communicator = Communicator(rt._client, mode=mode,
+                                    geo_step=geo_step).start()
+    return rt
+
+
+def _close_runtime(rt):
+    try:
+        rt._communicator.stop()
+    except Exception:  # noqa: BLE001 — a dead primary can fail the drain
+        pass
+    rt._client.close()
+
+
+def _build_rec_stack(serve_rt, train_rt, *, n_ids, cache_rows, slots,
+                     dnn_dims=(32, 16), max_batch=MAX_BATCH,
+                     queue_cap=512, max_wait_s=0.001):
+    """Serving service + online trainer over shared SSD-backed tables.
+
+    Separate PS clients/runtimes on purpose: serving pulls must ride
+    their own failover without perturbing the trainer's deterministic
+    push order (the bitwise-digest certification depends on it)."""
+    from paddle_tpu import rec
+    from paddle_tpu.distributed import ps
+    from paddle_tpu.serving.metrics import ServingMetrics
+
+    def caches(rt):
+        deep = ps.TPUEmbeddingCache("rec_deep", DIM, capacity=cache_rows,
+                                    init_range=0.01, runtime=rt,
+                                    storage="ssd", mem_rows=cache_rows)
+        wide = ps.TPUEmbeddingCache("rec_wide", 1, capacity=cache_rows,
+                                    init_range=0.01, runtime=rt,
+                                    storage="ssd", mem_rows=cache_rows)
+        return deep, wide
+
+    s_deep, s_wide = caches(serve_rt)
+    model = rec.WideDeepCTR(n_ids, n_ids, embed_dim=DIM,
+                            dnn_dims=dnn_dims, deep_embedding=s_deep,
+                            wide_embedding=s_wide)
+    svc = rec.RankingService(model, max_batch=max_batch,
+                             max_wait_s=max_wait_s, queue_cap=queue_cap,
+                             metrics=ServingMetrics())
+    zero = np.zeros(slots, np.int64)
+    svc.warmup(zero, zero)
+    svc.start()
+
+    t_deep, t_wide = caches(train_rt)
+    tmodel = rec.WideDeepCTR(n_ids, n_ids, embed_dim=DIM,
+                             dnn_dims=dnn_dims, deep_embedding=t_deep,
+                             wide_embedding=t_wide)
+    trainer = rec.OnlineTrainer(tmodel, runtime=train_rt,
+                                invalidate=[s_deep, s_wide])
+    return svc, trainer, s_deep, s_wide
+
+
+def run_load(waves=WAVES, wave=WAVE, n_ids=N_IDS, cache_rows=CACHE_ROWS,
+             batch_size=32):
+    """Zipfian serving + online learning against one plain PS."""
+    from paddle_tpu import rec
+    from paddle_tpu.distributed import ps
+
+    srv = ps.PSServer("127.0.0.1:0").start()
+    eps = [srv.endpoint]
+    serve_rt = _mk_runtime(eps, "sync")
+    train_rt = _mk_runtime(eps, "geo", geo_step=2)
+    svc, trainer, s_deep, s_wide = _build_rec_stack(
+        serve_rt, train_rt, n_ids=n_ids, cache_rows=cache_rows,
+        slots=SLOTS)
+
+    rng = np.random.RandomState(11)
+    feed = rec.synthetic_ctr_reader(waves, batch_size=batch_size,
+                                    dnn_dim=n_ids, lr_dim=n_ids,
+                                    slots=SLOTS, seed=12)
+    n_requests = 0
+    t0 = time.perf_counter()
+    for clicks in feed:
+        dq = _zipf_ids(rng, n_ids, (wave, SLOTS))
+        lq = _zipf_ids(rng, n_ids, (wave, SLOTS))
+        futs = [svc.submit(dq[i], lq[i]) for i in range(wave)]
+        trainer.feed(*clicks)     # embeddings move under the in-flight wave
+        for f in futs:
+            f.result(60)
+        n_requests += wave
+    trainer.flush()
+    elapsed = time.perf_counter() - t0
+
+    lat = svc.metrics.snapshot().get("latency_s", {}).get("e2e", {})
+    snap = svc.snapshot()
+    hist = snap["caches"]["deep"]["staleness_hist"]
+    out = {
+        "qps": round(n_requests / elapsed, 1),
+        "p50_ms": round(lat.get("p50", 0.0) * 1e3, 3),
+        "p99_ms": round(lat.get("p99", 0.0) * 1e3, 3),
+        "cache_hit_rate": round(s_deep.hit_rate, 4),
+        "cache_rows": cache_rows,
+        "table_rows": n_ids,
+        "ssd_over_cache_x": round(n_ids / cache_rows, 1),
+        "requests": n_requests,
+        "score_compiles": snap["score_compiles"],
+        "staleness_hist": {str(k): v for k, v in sorted(hist.items())},
+        "max_served_staleness": s_deep.max_served_staleness,
+        "invalidations": s_deep.invalidations + s_wide.invalidations,
+        "refreshes": s_deep.refreshes + s_wide.refreshes,
+    }
+    svc.close()
+    _close_runtime(serve_rt)
+    _close_runtime(train_rt)
+    srv.stop()
+    return out
+
+
+def _chaos_workload(svc, trainer, feeds, *, n_ids, slots, wave,
+                    batch_size, kill_at=None, primary=None):
+    """Deterministic serve-while-training stream; returns goodput.
+
+    Requests are submitted BEFORE the feed each round, so when the
+    primary dies at round `kill_at` there are ranking futures in flight
+    riding the failover alongside the trainer's pushes."""
+    from paddle_tpu import rec
+
+    rng = np.random.RandomState(21)
+    submitted = completed = 0
+    stream = rec.synthetic_ctr_reader(feeds, batch_size=batch_size,
+                                      dnn_dim=n_ids, lr_dim=n_ids,
+                                      slots=slots, seed=22)
+    recovery_s = None
+    for k, clicks in enumerate(stream):
+        dq = _zipf_ids(rng, n_ids, (wave, slots))
+        lq = _zipf_ids(rng, n_ids, (wave, slots))
+        futs = [svc.submit(dq[i], lq[i]) for i in range(wave)]
+        submitted += wave
+        if kill_at is not None and k == kill_at:
+            # transport vanishes mid-stream: the in-flight ranking wave
+            # AND this round's pushes must ride the failover
+            t_kill = time.perf_counter()
+            primary.kill_transport()
+        trainer.feed(*clicks)
+        if kill_at is not None and k == kill_at:
+            recovery_s = time.perf_counter() - t_kill
+        for f in futs:
+            f.result(120)
+            completed += 1
+    trainer.flush()
+    return submitted, completed, recovery_s
+
+
+def _pull_digest(client, n_ids):
+    probe = np.arange(n_ids, dtype=np.int64)
+    h = hashlib.sha256()
+    for table in ("rec_deep", "rec_wide"):
+        h.update(client.pull_sparse(table, probe).tobytes())
+    return h.hexdigest()
+
+
+def run_chaos(feeds=CHAOS_FEEDS, n_ids=CHAOS_IDS,
+              cache_rows=CHAOS_CACHE):
+    """Mid-push primary kill WHILE serving, certified against a clean
+    run: exactly-once pushes, zero lost/dup requests, bitwise digests."""
+    import paddle_tpu
+    from paddle_tpu.distributed import ps
+    from paddle_tpu.framework import faults, monitor
+
+    def stack(wal_dir):
+        # identical dense towers in the clean and chaos stacks: the
+        # sparse deltas certified below are d(loss)/d(rows) THROUGH the
+        # dense net, so its init must match bitwise across both runs
+        paddle_tpu.seed(777)
+        backup = ps.PSServer("127.0.0.1:0").start()
+        primary = ps.PSServer("127.0.0.1:0", wal_dir=wal_dir,
+                              backup=backup.endpoint).start()
+        eps = [primary.endpoint]
+        kw = dict(backups=[backup.endpoint], retry_backoff_s=0.01,
+                  op_deadline_s=60.0)
+        serve_rt = _mk_runtime(eps, "sync", **kw)
+        train_rt = _mk_runtime(eps, "geo", geo_step=2, **kw)
+        svc, trainer, s_deep, s_wide = _build_rec_stack(
+            serve_rt, train_rt, n_ids=n_ids, cache_rows=cache_rows,
+            slots=CHAOS_SLOTS, dnn_dims=(16,), max_batch=8,
+            queue_cap=256)
+        return backup, primary, serve_rt, train_rt, svc, trainer
+
+    wl = dict(n_ids=n_ids, slots=CHAOS_SLOTS, wave=CHAOS_WAVE,
+              batch_size=CHAOS_BATCH)
+
+    with tempfile.TemporaryDirectory() as d_ref, \
+            tempfile.TemporaryDirectory() as d:
+        # clean reference: identical durability config (WAL + replica),
+        # identical streams, no faults, no kill
+        backup, primary, serve_rt, train_rt, svc, trainer = stack(d_ref)
+        t0 = time.perf_counter()
+        n, c, _ = _chaos_workload(svc, trainer, feeds, **wl)
+        clean_s = time.perf_counter() - t0
+        assert n == c, f"clean run lost requests: {c}/{n}"
+        want = _pull_digest(serve_rt._client, n_ids)
+        svc.close()
+        _close_runtime(serve_rt)
+        _close_runtime(train_rt)
+        primary.stop()
+        backup.stop()
+
+        dedup0 = monitor.stat_get("ps.dedup_hits")
+        fo0 = monitor.stat_get("ps.failovers")
+        specs = ["ps.push@6:raise", "ps.push@10:raise",
+                 "rec.score@2:delay:0.001", "rec.embed_pull@3:delay:0.001",
+                 "rec.online_push@1:delay:0.001"]
+        t0 = time.perf_counter()
+        with faults.ChaosSchedule(*specs) as chaos:
+            backup, primary, serve_rt, train_rt, svc, trainer = stack(d)
+            n, c, recovery_s = _chaos_workload(
+                svc, trainer, feeds, kill_at=feeds // 2, primary=primary,
+                **wl)
+            fired = chaos.verify()   # fired == planned or AssertionError
+        chaos_s = time.perf_counter() - t0
+        got = _pull_digest(serve_rt._client, n_ids)
+        svc.close()
+        _close_runtime(serve_rt)
+        _close_runtime(train_rt)
+        try:
+            primary.stop()
+        except Exception:  # noqa: BLE001 — transport already dead
+            pass
+        backup.stop()
+
+        out = {
+            "chaos_goodput": round(c / n, 4),
+            "chaos_submitted": n,
+            "chaos_completed": c,
+            "digest_bitwise_equal": got == want,
+            "pull_digest": got[:16],
+            "recovery_s": round(recovery_s, 4),
+            "clean_s": round(clean_s, 3),
+            "chaos_s": round(chaos_s, 3),
+            "dedup_hits": monitor.stat_get("ps.dedup_hits") - dedup0,
+            "failovers": monitor.stat_get("ps.failovers") - fo0,
+            "chaos_fired": fired,
+        }
+        if not out["digest_bitwise_equal"]:
+            print("BENCH_REC " + json.dumps({"error": "digest", **out}))
+            raise SystemExit("chaos run diverged from the clean run")
+        if out["chaos_goodput"] != 1.0:
+            print("BENCH_REC " + json.dumps({"error": "goodput", **out}))
+            raise SystemExit("ranking requests lost under chaos")
+        return out
+
+
+def main():
+    smoke = "--smoke" in sys.argv
+    if smoke:
+        load = run_load(waves=4, wave=8, n_ids=400, cache_rows=128,
+                        batch_size=8)
+        chaos = run_chaos(feeds=6, n_ids=200, cache_rows=96)
+    else:
+        load = run_load()
+        chaos = run_chaos()
+    out = {"metric": "rec_serving", "unit": "qps",
+           "value": load["qps"], **load, **chaos}
+    print("BENCH_REC " + json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -11,15 +11,60 @@ a single batched pull_sparse, the SGD update applies on device from the
 lookup's gradient, and `flush()` pushes per-row DELTAS merged by an
 optimizer='sum' server table, so multiple trainers compose exactly like
 the reference's pass-end sync.
+
+Serving additions (rec.serving): a **staleness-bounded read protocol**.
+Every cache keeps a per-table applied-push watermark (`push_version`,
+bumped by `invalidate()` — wired to the online trainer's communicator
+flushes) and remembers the watermark each resident row was pulled at.
+`prepare()` refreshes any row that was explicitly invalidated or whose
+pulled version lags the watermark by more than the staleness bound
+(`FLAGS_ps_geo_staleness` by default), so no served read observes an
+embedding older than the bound in applied pushes. Refresh reuses the
+eviction path, which pushes a dirty row's local delta FIRST — refreshing
+never loses a local update.
 """
 
 from __future__ import annotations
+
+import weakref
 
 import numpy as np
 
 import jax.numpy as jnp
 
+from ...framework import monitor
+from ...framework.flags import flag
 from .runtime import get_runtime
+
+# live caches, for aggregate gauges in observe.export (weak: a dropped
+# cache must not be kept alive — or counted — by the metrics path)
+_CACHES: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def cache_stats() -> dict:
+    """Aggregate gauges over every live TPUEmbeddingCache in the
+    process (observe.export reads this for the paddle_rec_* family)."""
+    hits = misses = size = capacity = 0
+    evictions = invalidations = refreshes = 0
+    max_staleness = 0
+    for c in list(_CACHES):
+        hits += c.hits
+        misses += c.misses
+        size += c.size
+        capacity += c.capacity
+        evictions += c.evictions
+        invalidations += c.invalidations
+        refreshes += c.refreshes
+        max_staleness = max(max_staleness, c.max_served_staleness)
+    total = hits + misses
+    return {
+        "hits": hits, "misses": misses,
+        "hit_rate": hits / total if total else 0.0,
+        "size": size, "capacity": capacity,
+        "evictions": evictions, "invalidations": invalidations,
+        "refreshes": refreshes,
+        "max_served_staleness": max_staleness,
+    }
 
 
 class TPUEmbeddingCache:
@@ -28,18 +73,26 @@ class TPUEmbeddingCache:
     lookup ids -> device gather; gradients update the cache ON DEVICE
     (local SGD, ref heter_ps optimizer.cuh); `flush()` (= the
     reference's end_pass) ships accumulated row deltas to the servers.
+    `serve()` is the read-only inference path: staleness-checked
+    residency, device gather, no gradient hook.
     """
 
     def __init__(self, name, dim, capacity, *, lr=0.01, init_range=0.05,
-                 runtime=None):
+                 runtime=None, staleness_bound=None, storage="mem",
+                 mem_rows=None):
         self.name = name
         self.dim = int(dim)
         self.capacity = int(capacity)
         self.lr = float(lr)
         self.runtime = runtime or get_runtime()
         # deltas merge server-side: multiple trainers' pass-end syncs sum
-        self.runtime.client.create_sparse_table(
-            name, dim, optimizer="sum", init_range=init_range)
+        if storage == "ssd":
+            self.runtime.client.create_ssd_sparse_table(
+                name, dim, optimizer="sum", init_range=init_range,
+                mem_rows=self.capacity if mem_rows is None else mem_rows)
+        else:
+            self.runtime.client.create_sparse_table(
+                name, dim, optimizer="sum", init_range=init_range)
         self.cache = jnp.zeros((self.capacity, self.dim), jnp.float32)
         self._base = np.zeros((self.capacity, self.dim), np.float32)
         self._ids = np.full(self.capacity, -1, np.int64)   # slot -> id
@@ -49,14 +102,58 @@ class TPUEmbeddingCache:
         self._clock = 0
         self.hits = 0
         self.misses = 0
+        # staleness-bounded read protocol (None = FLAGS_ps_geo_staleness)
+        self.staleness_bound = staleness_bound
+        self.push_version = 0   # applied-push watermark for this table
+        self._row_version = np.zeros(self.capacity, np.int64)
+        self._invalid = np.zeros(self.capacity, bool)
+        self.evictions = 0
+        self.invalidations = 0
+        self.refreshes = 0
+        self.max_served_staleness = 0
+        self.staleness_hist: dict[int, int] = {}
+        _CACHES.add(self)
+
+    def _bound(self) -> int:
+        b = self.staleness_bound
+        return int(flag("FLAGS_ps_geo_staleness") if b is None else b)
+
+    def _observe_staleness(self, lags) -> None:
+        lags = np.asarray(lags, np.int64)
+        for v in lags.tolist():
+            self.staleness_hist[v] = self.staleness_hist.get(v, 0) + 1
+        if lags.size:
+            m = int(lags.max())
+            if m > self.max_served_staleness:
+                self.max_served_staleness = m
+            monitor.stat_max("rec.max_served_staleness", m)
 
     # -- cache management ----------------------------------------------------
     def prepare(self, ids) -> None:
         """Ensure every id is resident (the reference's BuildPull /
         pass-begin): one batched pull for all misses; LRU slots not used
-        by THIS batch are evicted, dirty ones flushed first."""
+        by THIS batch are evicted, dirty ones flushed first. Resident
+        rows that were invalidated by an applied push, or whose pulled
+        version lags the watermark beyond the staleness bound, are
+        refreshed here (evict -> re-pull) before they can be served."""
         uniq = np.unique(np.asarray(ids, np.int64).reshape(-1))
         self._clock += 1
+        # staleness-bounded read protocol: refresh BEFORE the hit/miss
+        # split so a refreshed row simply re-pulls as a miss below
+        res = np.fromiter((self._slot_of.get(int(i), -1) for i in uniq),
+                          np.int64, uniq.size)
+        have = res[res >= 0]
+        if have.size:
+            lag = self.push_version - self._row_version[have]
+            stale = self._invalid[have] | (lag > self._bound())
+            n_stale = int(stale.sum())
+            if n_stale:
+                self.refreshes += n_stale
+                monitor.stat_add("rec.cache_refreshes", n_stale)
+                self._evict(have[stale])
+            # hits that survive the check are served at this lag;
+            # refreshed/missed rows re-pull at the current watermark
+            self._observe_staleness(lag[~stale])
         resident = np.fromiter(
             (i in self._slot_of for i in uniq), bool, len(uniq))
         hit_slots = np.fromiter(
@@ -66,6 +163,8 @@ class TPUEmbeddingCache:
         miss_ids = uniq[~resident]
         self.hits += int(resident.sum())
         self.misses += miss_ids.size
+        monitor.stat_add("rec.cache_hits", int(resident.sum()))
+        monitor.stat_add("rec.cache_misses", int(miss_ids.size))
         if miss_ids.size == 0:
             return
         if uniq.size > self.capacity:
@@ -88,6 +187,8 @@ class TPUEmbeddingCache:
             cand = np.nonzero(~used_now & (self._ids >= 0))[0]
             order = np.argsort(self._last_used[cand], kind="stable")
             victims = cand[order[:need]]
+            self.evictions += int(victims.size)
+            monitor.stat_add("rec.cache_evictions", int(victims.size))
             self._evict(victims)
         slots = np.concatenate([free[:miss_ids.size], victims])[
             :miss_ids.size]
@@ -98,6 +199,10 @@ class TPUEmbeddingCache:
         self._ids[slots] = miss_ids
         self._dirty[slots] = False
         self._last_used[slots] = self._clock
+        # pulled after the flush above, so fresh at the CURRENT watermark
+        self._row_version[slots] = self.push_version
+        self._invalid[slots] = False
+        self._observe_staleness(np.zeros(miss_ids.size, np.int64))
         for i, s in zip(miss_ids.tolist(), slots.tolist()):
             self._slot_of[i] = s
 
@@ -109,6 +214,7 @@ class TPUEmbeddingCache:
             self._slot_of.pop(int(self._ids[s]), None)
         self._ids[slots] = -1
         self._dirty[slots] = False
+        self._invalid[slots] = False
 
     def _push_deltas(self, slots) -> None:
         vals = np.asarray(self.cache[jnp.asarray(slots)])
@@ -125,6 +231,39 @@ class TPUEmbeddingCache:
             self._push_deltas(dirty)
             self._dirty[dirty] = False
         self.runtime.communicator.flush()
+
+    # -- invalidation-on-push ------------------------------------------------
+    def invalidate(self, ids) -> int:
+        """Applied-push hook (wire to `Communicator.on_flush`): advance
+        the table's watermark and mark resident rows among `ids` stale.
+        The next prepare() re-pulls marked rows; a dirty row's local
+        delta is pushed before the re-pull, so nothing local is lost.
+        Returns how many resident rows were marked."""
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        self.push_version += 1
+        n = 0
+        for i in ids.tolist():
+            s = self._slot_of.get(int(i))
+            if s is not None:
+                self._invalid[s] = True
+                n += 1
+        if n:
+            self.invalidations += n
+            monitor.stat_add("rec.cache_invalidations", n)
+        return n
+
+    # -- serving-path lookup -------------------------------------------------
+    def serve(self, ids):
+        """Read-only inference lookup: staleness-checked residency +
+        device gather. No gradient hook, no dirty marking — safe to call
+        concurrently with a trainer pushing to the same table (the
+        invalidate/refresh protocol supplies freshness)."""
+        ids_arr = np.asarray(ids, np.int64)
+        self.prepare(ids_arr)
+        slots = np.fromiter(
+            (self._slot_of[i] for i in ids_arr.reshape(-1).tolist()),
+            np.int64, ids_arr.size).reshape(ids_arr.shape)
+        return self.cache[jnp.asarray(slots)]
 
     # -- training-path lookup ------------------------------------------------
     def __call__(self, ids):
@@ -151,6 +290,10 @@ class TPUEmbeddingCache:
         return apply("lookup_table_v2",
                      jnp.asarray(slots, jnp.int32), table,
                      padding_idx=-1)
+
+    @property
+    def size(self) -> int:
+        return len(self._slot_of)
 
     @property
     def hit_rate(self) -> float:

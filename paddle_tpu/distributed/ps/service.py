@@ -902,10 +902,16 @@ class Communicator:
               (SURVEY.md geo semantics).
     """
 
-    def __init__(self, client: PSClient, mode="async", geo_step=4):
+    def __init__(self, client: PSClient, mode="async", geo_step=4,
+                 on_flush=None):
         self.client = client
         self.mode = mode
         self.geo_step = int(geo_step)
+        # applied-push hook: on_flush(table_name, ids) fires AFTER a
+        # sparse push has landed on the servers — rec.serving chains
+        # TPUEmbeddingCache.invalidate here so serving caches observe
+        # the online trainer's updates (invalidation-on-push)
+        self.on_flush = on_flush
         # per-table geo delta scale at flush (e.g. -lr turns summed grads
         # into the SGD parameter delta merged by an optimizer='sum' table)
         self.geo_scales: dict[str, float] = {}
@@ -921,6 +927,10 @@ class Communicator:
 
     def set_geo_scale(self, table_name, scale):
         self.geo_scales[table_name] = float(scale)
+
+    def _notify_flush(self, name, ids):
+        if self.on_flush is not None:
+            self.on_flush(name, np.asarray(ids, np.int64).reshape(-1))
 
     # -- lifecycle -----------------------------------------------------------
     def start(self):
@@ -951,6 +961,7 @@ class Communicator:
                 for kind, name, a, b in batch:
                     if kind == "sparse":
                         self.client.push_sparse_grad(name, a, b)
+                        self._notify_flush(name, a)
                     else:
                         self.client.push_dense_grad(name, a)
                     with self._cv:
@@ -983,6 +994,7 @@ class Communicator:
             return
         if self.mode == "sync":
             self.client.push_sparse_grad(name, ids, grads)
+            self._notify_flush(name, ids)
             return
         with self._cv:
             self._queue.append(("sparse", name, np.asarray(ids, np.int64),
@@ -1018,6 +1030,7 @@ class Communicator:
                 grads = np.stack([acc[int(i)] for i in ids])
                 scale = self.geo_scales.get(name, 1.0)
                 self.client.push_sparse_grad(name, ids, scale * grads)
+                self._notify_flush(name, ids)
             self._geo_acc = {}
             self._geo_pending = 0
             return

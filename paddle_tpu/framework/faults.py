@@ -70,6 +70,16 @@ A fault point is a named site the runtime passes through:
     ps.failover               each PSClient promotion of a backup after
                               the primary stopped answering, tagged
                               with the failing endpoint
+    rec.score                 each RankingService batch flush before the
+                              dense tower runs (raise = batch-level
+                              scoring failure propagated to every
+                              member ranking request)
+    rec.embed_pull            each serving-side embedding-provider pull,
+                              tagged with the provider label (deep /
+                              wide / first_order / embedding)
+    rec.online_push           each OnlineTrainer.feed click batch,
+                              before forward/backward (raise = dropped
+                              feedback batch; serving must be unaffected)
 
 The authoritative site list is the `SITES` registry below;
 `fault_point` refuses to fire for an unregistered site, and the
@@ -143,6 +153,9 @@ SITES = {
     "ps.spill": "each SSD sparse-table spill batch / compaction",
     "ps.replicate": "each PS primary->backup forward",
     "ps.failover": "each PSClient promotion of a backup",
+    "rec.score": "each RankingService batch flush before the tower",
+    "rec.embed_pull": "each serving embedding pull (tag = provider)",
+    "rec.online_push": "each OnlineTrainer click batch",
 }
 
 

@@ -83,6 +83,13 @@ def snapshot(serving=None):
         # durable-PS view mirrors the paddle_ps_* Prometheus family
         "ps": {stat.split(".", 1)[1]: monitor.stat_get(stat)
                for stat in _PS_METRICS},
+        # recommender-serving view mirrors paddle_rec_*: lifetime
+        # counters from monitor + computed gauges over the live caches
+        "rec": dict(
+            {stat.split(".", 1)[1]: monitor.stat_get(stat)
+             for stat in _REC_METRICS},
+            **{name.replace("paddle_rec_", ""): value
+               for name, (value, _h) in _rec_gauges().items()}),
     }
     if serving is not None:
         out["serving"] = serving.snapshot()
@@ -122,6 +129,51 @@ _PS_METRICS = {
         "paddle_ps_dedup_hits_total", "counter",
         "retried PS pushes suppressed by (client_id, seq) dedup"),
 }
+
+#: monitor stat -> (prometheus name, type, help) for the recommender-
+#: serving family (TPUEmbeddingCache + OnlineTrainer); same contract as
+#: _PS_METRICS, mirrored in snapshot()["rec"] alongside the live-cache
+#: gauges of _rec_gauges()
+_REC_METRICS = {
+    "rec.cache_hits": (
+        "paddle_rec_cache_hits_total", "counter",
+        "embedding-cache lookups served from resident rows"),
+    "rec.cache_misses": (
+        "paddle_rec_cache_misses_total", "counter",
+        "embedding-cache lookups that pulled rows from the PS"),
+    "rec.cache_evictions": (
+        "paddle_rec_cache_evictions_total", "counter",
+        "LRU evictions from embedding caches"),
+    "rec.cache_invalidations": (
+        "paddle_rec_cache_invalidations_total", "counter",
+        "resident cache rows marked stale by applied pushes"),
+    "rec.cache_refreshes": (
+        "paddle_rec_cache_refreshes_total", "counter",
+        "stale resident rows re-pulled before being served"),
+    "rec.max_served_staleness": (
+        "paddle_rec_max_served_staleness", "gauge",
+        "max applied-push lag observed by any served embedding read"),
+    "rec.online_steps": (
+        "paddle_rec_online_steps_total", "counter",
+        "click batches fed by online trainers"),
+}
+
+
+def _rec_gauges():
+    """Live-cache gauges (computed, not monotonic — they track the
+    caches currently alive, unlike the process-lifetime counters)."""
+    from ..distributed.ps.heter import cache_stats
+
+    s = cache_stats()
+    return {
+        "paddle_rec_cache_hit_rate": (
+            s["hit_rate"],
+            "lookup fraction served from resident rows (live caches)"),
+        "paddle_rec_cache_size": (
+            s["size"], "resident rows across live embedding caches"),
+        "paddle_rec_cache_capacity": (
+            s["capacity"], "total slots across live embedding caches"),
+    }
 
 
 def _pname(name):
@@ -179,10 +231,16 @@ def prometheus_text(serving=None, queue_depth=None, fleet=None):
     for stat, (pname, mtype, help_) in _PS_METRICS.items():
         L.add(pname, monitor.stat_get(stat), mtype=mtype, help_=help_)
 
+    # recommender-serving family: lifetime counters + live-cache gauges
+    for stat, (pname, mtype, help_) in _REC_METRICS.items():
+        L.add(pname, monitor.stat_get(stat), mtype=mtype, help_=help_)
+    for pname, (value, help_) in _rec_gauges().items():
+        L.add(pname, value, help_=help_)
+
     for name, value in sorted(monitor.stats().items()):
         if not isinstance(value, (int, float)):
             continue
-        if name in _PS_METRICS:
+        if name in _PS_METRICS or name in _REC_METRICS:
             continue
         L.add(f"paddle_{name}", value, mtype="counter",
               help_="framework.monitor stat")

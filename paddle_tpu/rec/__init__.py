@@ -17,7 +17,8 @@ import numpy as np
 from .. import nn
 from ..core.tensor import Tensor
 
-__all__ = ["DeepFM", "WideDeepCTR", "synthetic_ctr_reader"]
+__all__ = ["DeepFM", "WideDeepCTR", "synthetic_ctr_reader",
+           "RankingService", "OnlineTrainer"]
 
 
 class DeepFM(nn.Layer):
@@ -100,14 +101,21 @@ class WideDeepCTR(nn.Layer):
 
 
 def synthetic_ctr_reader(n_batches=20, batch_size=64, dnn_dim=1000,
-                         lr_dim=1000, slots=8, seed=0):
+                         lr_dim=1000, slots=8, seed=0, hot_seed=1234):
     """Synthetic avazu-shaped stream (ref ctr_dataset_reader.py; the
     real download has no meaning off-network). Clicks correlate with a
-    planted subset of ids so a working model separates them."""
+    planted subset of ids so a working model separates them.
+
+    Determinism contract (bench/chaos replay): every sampled value
+    derives from `seed` (the stream) and `hot_seed` (the planted
+    click-signal subsets) — the same pair yields bitwise-identical
+    batches, so a chaos run and its clean reference see the same ids.
+    """
     rng = np.random.RandomState(seed)
-    # the planted hot subsets are FIXED (independent of `seed`) so a
-    # model trained on one stream generalises to another
-    hot_rng = np.random.RandomState(1234)
+    # the planted hot subsets are seeded SEPARATELY from `seed` so a
+    # model trained on one stream generalises to another drawn with a
+    # different `seed` but the same `hot_seed`
+    hot_rng = np.random.RandomState(hot_seed)
     hot_dnn = hot_rng.choice(dnn_dim, dnn_dim // 10, replace=False)
     hot_lr = hot_rng.choice(lr_dim, lr_dim // 10, replace=False)
     for _ in range(n_batches):
@@ -118,3 +126,7 @@ def synthetic_ctr_reader(n_batches=20, batch_size=64, dnn_dim=1000,
         click = (signal + 0.1 * rng.randn(batch_size) > 0.2)
         yield (dnn_ids.astype(np.int64), lr_ids.astype(np.int64),
                click.astype(np.float32).reshape(-1, 1))
+
+
+from .online import OnlineTrainer     # noqa: E402 — after model defs
+from .serving import RankingService   # noqa: E402

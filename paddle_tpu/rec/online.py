@@ -1,0 +1,101 @@
+"""OnlineTrainer: stream click feedback into the PS while serving.
+
+Ref parity: the reference's online-learning CTR loop (fleet geo-async
+training against SparseGeoTable) — trainers accumulate local sparse
+deltas and ship them every geo_step, so serving replicas read slightly
+stale but monotonically fresh embeddings. Here the trainer rides the
+same Communicator geo mode and closes the freshness loop: the
+communicator's ``on_flush`` hook (fired AFTER a sparse push has landed
+on the servers) is chained to ``TPUEmbeddingCache.invalidate`` on every
+serving cache registered via ``invalidate=``, so a served row can never
+silently outlive the staleness bound once its update applied
+(invalidation-on-push + the cache's own version-lag refresh).
+
+The dense tower is FROZEN online: only the sparse side moves (the
+reference's geo semantics apply to sparse tables only), which is also
+what lets RankingService close its score trace over one immutable dense
+value set. Pass ``optimizer=`` to move the dense side too — but then
+the serving service must be rebuilt to see it.
+
+Fault site: ``rec.online_push`` fires once per ``feed`` (one click
+batch), before the forward/backward runs.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..framework import faults, monitor
+from ..nn import functional as F
+
+__all__ = ["OnlineTrainer"]
+
+
+class OnlineTrainer:
+    """Asynchronous sparse updates from click feedback.
+
+    `model` is a CTR model whose embedding providers push through a PS
+    runtime (TPUEmbeddingCache / DistributedEmbedding); `invalidate`
+    lists the SERVING-side TPUEmbeddingCaches to notify when this
+    trainer's pushes land (matched by table name).
+    """
+
+    def __init__(self, model, *, runtime=None, invalidate=(),
+                 optimizer=None):
+        from ..distributed.ps.runtime import get_runtime
+
+        self.model = model
+        self.runtime = runtime or get_runtime()
+        self.optimizer = optimizer
+        self.steps = 0
+        caches = {c.name: c for c in invalidate}
+        comm = self.runtime.communicator
+        prev = comm.on_flush
+
+        def applied(name, ids):
+            if prev is not None:
+                prev(name, ids)
+            cache = caches.get(name)
+            if cache is not None:
+                cache.invalidate(ids)
+
+        comm.on_flush = applied
+
+    def feed(self, *batch):
+        """One click batch: ``feed(dnn_ids, lr_ids, clicks)`` for
+        wide&deep, ``feed(fields, clicks)`` for DeepFM. Forward + BCE +
+        backward; the embedding providers' hooks route row updates into
+        the communicator (geo: accumulated, flushed on cadence/bound).
+        Returns the batch loss."""
+        faults.fault_point("rec.online_push")
+        *id_arrays, clicks = batch
+        logits = self.model(
+            *[Tensor(np.asarray(a, np.int64)) for a in id_arrays])
+        loss = F.binary_cross_entropy_with_logits(
+            logits, Tensor(np.asarray(clicks, np.float32)))
+        loss.backward()
+        if self.optimizer is not None:
+            self.optimizer.step()
+            self.optimizer.clear_grad()
+        else:
+            # dense tower frozen online: sparse hooks already pushed,
+            # the dense grads this backward produced are dropped
+            for p in self.model.parameters():
+                p.clear_grad()
+        self.runtime.communicator.step_end()
+        self.steps += 1
+        monitor.stat_add("rec.online_steps")
+        return float(loss.numpy())
+
+    def flush(self):
+        """Force every pending update onto the servers NOW: dirty cache
+        rows push their deltas, then the communicator drains (geo
+        accumulator included) — after this returns, on_flush has fired
+        and serving caches are invalidated up to here."""
+        for attr in ("deep_embedding", "wide_embedding",
+                     "first_order", "embedding"):
+            provider = getattr(self.model, attr, None)
+            if provider is not None and hasattr(provider, "invalidate"):
+                provider.flush()            # TPUEmbeddingCache pass-end
+        self.runtime.communicator.flush()

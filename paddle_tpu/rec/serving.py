@@ -1,0 +1,265 @@
+"""RankingService: batched CTR inference over the PS embedding stack.
+
+Ref parity: the reference serves CTR fleets through paddle_serving's
+general_dist_kv infer op — the dense net runs in the predictor while
+sparse parameters stay on the parameter servers and every request pulls
+its rows through a cube/PS lookup. TPU-native redesign: requests enter
+the SAME admission queue + dynamic batcher the LLM path uses
+(serving.queueing / serving.batcher), each flush splits into
+
+  host side   — sparse rows pulled per provider (`rec.embed_pull`):
+                a `ps.TPUEmbeddingCache` answers through `serve()`
+                under the staleness-bounded read protocol, a local
+                `nn.Embedding` gathers its weight, a
+                `ps.DistributedEmbedding` pulls unique rows; then
+  device side — ONE jitted dense-tower trace per batch bucket
+                (`rec.score` in the retrace registry) scoring the
+                pulled rows through the model's MLP/FM stack via
+                `engine.functional_apply`.
+
+The split is what makes compile-once possible: ids and row counts vary
+wildly per request, but after bucket padding the tower only ever sees
+`len(ladder)` distinct shapes — certified by running steady-state
+flushes under `observe.no_retrace()` (strict_shapes=True).
+
+Fault sites: ``rec.score`` per batch flush before the tower runs,
+``rec.embed_pull`` per provider pull (tagged with the provider label).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .. import observe
+from ..core.tensor import Tensor
+from ..engine import functional_apply, state_values
+from ..framework import faults
+from ..serving.batcher import DynamicBatcher
+
+__all__ = ["RankingService"]
+
+
+def _pull_rows(provider, ids, label):
+    """[n, S] int64 ids -> [n, S, dim] rows from any embedding provider."""
+    faults.fault_point("rec.embed_pull", tag=label)
+    if hasattr(provider, "serve"):              # ps.TPUEmbeddingCache
+        return provider.serve(ids)
+    if hasattr(provider, "weight"):             # local nn.Embedding
+        return provider.weight._value[jnp.asarray(ids)]
+    # ps.DistributedEmbedding: pull unique rows, scatter back
+    flat = np.asarray(ids, np.int64).reshape(-1)
+    uniq, inverse = np.unique(flat, return_inverse=True)
+    rows = provider.runtime.client.pull_sparse(provider.name, uniq)
+    return jnp.asarray(rows)[jnp.asarray(
+        inverse.reshape(np.asarray(ids).shape))]
+
+
+class RankingService:
+    """Batched ranking front over a CTR model (DeepFM / WideDeepCTR).
+
+    One request = one user's feature ids; `submit` returns the request
+    future, `rank` blocks for the score. Requests coalesce in the
+    dynamic batcher (powers-of-2 bucket ladder), so the dense tower
+    compiles once per bucket for the life of the service.
+
+    The model's embedding providers decide the sparse side: local
+    `nn.Embedding` tables serve from the model itself; a
+    `ps.TPUEmbeddingCache` serves device-cached PS rows with
+    staleness-bounded freshness while an `OnlineTrainer` pushes updates
+    underneath (rec/online.py).
+    """
+
+    def __init__(self, model, *, max_batch=None, max_wait_s=0.002,
+                 queue_cap=None, metrics=None, strict_shapes=True):
+        self.model = model
+        self.kind = ("widedeep" if hasattr(model, "deep_embedding")
+                     else "deepfm")
+        self.metrics = metrics
+        self._sample_shape = None
+        # the dense tower is frozen at service build: online learning
+        # moves ONLY the sparse side (geo semantics), so the score trace
+        # can close over one immutable value set per service
+        self._values = dict(state_values(model))
+        if self.kind == "deepfm":
+            self._offsets = np.asarray(model._offsets, np.int64)
+        self._tower = jax.jit(self._build_tower())
+        self.batcher = DynamicBatcher(
+            self._score_batch, max_batch=max_batch, max_wait_s=max_wait_s,
+            queue_cap=queue_cap, metrics=metrics, jit=False,
+            strict_shapes=strict_shapes)
+
+    # -- dense tower (the one compiled trace per bucket) ---------------------
+    def _build_tower(self):
+        model = self.model
+        if self.kind == "widedeep":
+            def tower(values, deep_rows, wide_rows):
+                # trace-time only: the retrace registry is the
+                # compile-once certificate (observe.compile_events)
+                observe.record_compile(
+                    "rec.score",
+                    signature=observe.signature_of(deep_rows, wide_rows))
+
+                def run(m):
+                    deep = Tensor(deep_rows).sum(axis=1)   # [n, k]
+                    wide = Tensor(wide_rows).sum(axis=1)   # [n, 1]
+                    return m.dnn(deep) + wide
+
+                return functional_apply(model, values, run)
+            return tower
+
+        def tower(values, first_rows, embed_rows):
+            observe.record_compile(
+                "rec.score",
+                signature=observe.signature_of(first_rows, embed_rows))
+
+            def run(m):
+                wide = Tensor(first_rows).sum(axis=1)      # [n, 1]
+                v = Tensor(embed_rows)                     # [n, F, k]
+                sum_v = v.sum(axis=1)
+                fm = 0.5 * ((sum_v * sum_v)
+                            - (v * v).sum(axis=1)).sum(axis=1,
+                                                       keepdim=True)
+                deep = m.mlp(v.reshape([v.shape[0], -1]))
+                return wide + fm + deep + m.bias
+
+            return functional_apply(model, values, run)
+        return tower
+
+    # -- batch scoring (what the batcher flushes into) -----------------------
+    def _score_batch(self, x):
+        x = np.asarray(x, np.int64)
+        faults.fault_point("rec.score", x)
+        if self.kind == "widedeep":
+            dnn_ids, lr_ids = x[:, 0, :], x[:, 1, :]
+            deep = _pull_rows(self.model.deep_embedding, dnn_ids, "deep")
+            wide = _pull_rows(self.model.wide_embedding, lr_ids, "wide")
+            return self._tower(self._values, jnp.asarray(deep),
+                               jnp.asarray(wide))
+        flat = x + self._offsets                           # [n, F]
+        first = _pull_rows(self.model.first_order, flat, "first_order")
+        emb = _pull_rows(self.model.embedding, flat, "embedding")
+        return self._tower(self._values, jnp.asarray(first),
+                           jnp.asarray(emb))
+
+    # -- request plumbing ----------------------------------------------------
+    def _payload(self, *ids):
+        """Normalise one request's ids to a single fixed-shape int64
+        array ([2, S] stacked dnn/lr rows for wide&deep, [F] fields for
+        DeepFM) — the batcher stacks payloads, so shape drift would mean
+        retraces; it is rejected at admission instead."""
+        if self.kind == "widedeep":
+            if len(ids) != 2:
+                raise ValueError("wide&deep ranking takes (dnn_ids, "
+                                 f"lr_ids), got {len(ids)} arrays")
+            d = np.asarray(ids[0], np.int64).reshape(-1)
+            l = np.asarray(ids[1], np.int64).reshape(-1)
+            if d.size != l.size:
+                raise ValueError(
+                    f"dnn_ids ({d.size}) and lr_ids ({l.size}) must "
+                    "have the same slot count (sum pooling pads cannot "
+                    "be invented per side)")
+            sample = np.stack([d, l])
+        else:
+            if len(ids) != 1:
+                raise ValueError("DeepFM ranking takes one fields "
+                                 f"array, got {len(ids)}")
+            sample = np.asarray(ids[0], np.int64).reshape(-1)
+            if sample.size != self.model.num_fields:
+                raise ValueError(
+                    f"expected {self.model.num_fields} fields, got "
+                    f"{sample.size}")
+        if self._sample_shape is None:
+            self._sample_shape = sample.shape
+        elif sample.shape != self._sample_shape:
+            raise ValueError(
+                f"request shape {sample.shape} != service shape "
+                f"{self._sample_shape} (fixed at first request so the "
+                "score trace never re-specialises)")
+        return sample
+
+    def warmup(self, *ids):
+        """Trace every bucket rung up front (one tower compile per
+        rung); afterwards the hot path runs under no_retrace()."""
+        return self.batcher.warmup(self._payload(*ids))
+
+    def start(self):
+        self.batcher.start()
+        return self
+
+    def submit(self, *ids, timeout=None):
+        """Enqueue one ranking request; returns its `Request` future
+        (resolves to the [1] score row)."""
+        return self.batcher.submit(self._payload(*ids), timeout=timeout)
+
+    def rank(self, *ids, timeout=None):
+        """Synchronous score for one request."""
+        out = self.submit(*ids, timeout=timeout).result(timeout)
+        return float(np.asarray(out).reshape(-1)[0])
+
+    def close(self, drain=True):
+        self.batcher.close(drain=drain)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    # -- introspection -------------------------------------------------------
+    @property
+    def queue_depth(self):
+        return self.batcher.queue.depth
+
+    @property
+    def compile_counts(self):
+        """bucket -> first-use count (batcher view); the trace-level
+        certificate is observe.compile_events('rec.score')."""
+        return self.batcher.compile_counts
+
+    def _providers(self):
+        if self.kind == "widedeep":
+            return [("deep", self.model.deep_embedding),
+                    ("wide", self.model.wide_embedding)]
+        return [("first_order", self.model.first_order),
+                ("embedding", self.model.embedding)]
+
+    def snapshot(self):
+        """Service state incl. per-cache freshness/staleness stats."""
+        out = {
+            "kind": self.kind,
+            "queue_depth": self.queue_depth,
+            "compile_counts": dict(self.compile_counts),
+            "score_compiles": len(observe.compile_events("rec.score")),
+        }
+        caches = {}
+        for label, p in self._providers():
+            if hasattr(p, "invalidate"):        # TPUEmbeddingCache
+                caches[label] = {
+                    "table": p.name,
+                    "hit_rate": p.hit_rate,
+                    "size": p.size,
+                    "capacity": p.capacity,
+                    "evictions": p.evictions,
+                    "invalidations": p.invalidations,
+                    "refreshes": p.refreshes,
+                    "push_version": p.push_version,
+                    "max_served_staleness": p.max_served_staleness,
+                    "staleness_hist": dict(p.staleness_hist),
+                }
+        if caches:
+            out["caches"] = caches
+        if self.metrics is not None:
+            out["metrics"] = self.metrics.snapshot()
+        return out
+
+    def metrics_prometheus(self):
+        """Prometheus exposition incl. the paddle_rec_* cache family
+        (what http_front serves on GET /metrics for a ranker)."""
+        from .. import observe
+
+        return observe.prometheus_text(serving=self.metrics,
+                                       queue_depth=self.queue_depth)

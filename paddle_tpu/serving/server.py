@@ -206,16 +206,43 @@ class Server:
                                        fleet=fleet)
 
 
-def http_front(server: Server, host="127.0.0.1", port=0):
+def http_front(server: Server = None, host="127.0.0.1", port=0, *,
+               ranker=None):
     """Optional stdlib front door (bonus deliverable — the in-process
     API above is the contract). POST /v1/generate with a JSON body
     ``{"prompt": [ids...], "max_new_tokens": n, ...}`` returns
     ``{"ids": [...]}``; GET /metrics returns the snapshot. Serving
-    errors map to their HTTP status (429 shed, 504 deadline, ...).
+    errors map to their HTTP status (429 shed, 504 deadline, ...), with
+    a ``Retry-After`` backoff hint on 429/503.
+
+    Pass ``ranker=`` (a `rec.RankingService`) to also serve
+    POST /v1/rank: ``{"dnn_ids": [...], "lr_ids": [...]}`` (wide&deep)
+    or ``{"fields": [...]}`` (DeepFM) returns ``{"scores": [...]}``;
+    2-D id arrays rank a whole candidate list in one call (the rows
+    coalesce in the dynamic batcher). A front may serve both a `server`
+    and a `ranker`; at least one is required.
 
     Returns the started `ThreadingHTTPServer`; its bound port is
     ``httpd.server_address[1]``. Call ``httpd.shutdown()`` to stop."""
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    if server is None and ranker is None:
+        raise ValueError("http_front needs a server and/or a ranker")
+    metrics_src = server if server is not None else ranker
+
+    def rank_scores(req):
+        timeout = req.pop("timeout", None)
+        if "fields" in req:
+            arrs = [np.asarray(req.pop("fields"), np.int64)]
+        else:
+            arrs = [np.asarray(req.pop("dnn_ids"), np.int64),
+                    np.asarray(req.pop("lr_ids"), np.int64)]
+        if arrs[0].ndim == 2:
+            futs = [ranker.submit(*[a[i] for a in arrs], timeout=timeout)
+                    for i in range(arrs[0].shape[0])]
+            return [float(np.asarray(f.result(timeout)).reshape(-1)[0])
+                    for f in futs]
+        return [ranker.rank(*arrs, timeout=timeout)]
 
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, *a):  # quiet
@@ -251,23 +278,25 @@ def http_front(server: Server, host="127.0.0.1", port=0):
                 if ("format=prometheus" in query
                         or "text/plain" in accept
                         or "openmetrics" in accept):
-                    self._reply_text(200, server.metrics_prometheus())
+                    self._reply_text(200, metrics_src.metrics_prometheus())
                 else:
-                    self._reply(200, server.snapshot())
+                    self._reply(200, metrics_src.snapshot())
             else:
                 self._reply(404, {"error": "not found"})
 
         def do_POST(self):
-            if self.path != "/v1/generate":
-                self._reply(404, {"error": "not found"})
-                return
             try:
                 n = int(self.headers.get("Content-Length", 0))
                 req = json.loads(self.rfile.read(n) or b"{}")
-                prompt = req.pop("prompt")
-                timeout = req.pop("timeout", None)
-                out = server.generate(prompt, timeout=timeout, **req)
-                self._reply(200, {"ids": np.asarray(out).tolist()})
+                if self.path == "/v1/generate" and server is not None:
+                    prompt = req.pop("prompt")
+                    timeout = req.pop("timeout", None)
+                    out = server.generate(prompt, timeout=timeout, **req)
+                    self._reply(200, {"ids": np.asarray(out).tolist()})
+                elif self.path == "/v1/rank" and ranker is not None:
+                    self._reply(200, {"scores": rank_scores(req)})
+                else:
+                    self._reply(404, {"error": "not found"})
             except ServingError as e:
                 # clients get the same backoff contract the in-process
                 # Router uses: `retriable` says whether resubmitting the

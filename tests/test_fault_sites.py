@@ -22,10 +22,18 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 # fault_point("site", ...) / fault_point('site', ...) source literals
 _CALL_RE = re.compile(r"""fault_point\(\s*["']([a-z_.]+)["']""")
 
+# chaos-spec literals ("site@occ:action" / "site[tag]@occ:action") as the
+# repo-root benches write them
+_SPEC_RE = re.compile(r"""["']([a-z_.]+)(?:\[[^\]]*\])?@\d+:""")
+
 
 def _source_files():
     return glob.glob(os.path.join(_REPO, "paddle_tpu", "**", "*.py"),
                      recursive=True)
+
+
+def _bench_files():
+    return glob.glob(os.path.join(_REPO, "bench_*.py"))
 
 
 def test_every_fault_point_literal_is_registered():
@@ -40,6 +48,23 @@ def test_every_fault_point_literal_is_registered():
                     if s not in faults.SITES}
     assert not unregistered, (
         f"fault_point() sites missing from faults.SITES: {unregistered}")
+
+
+def test_bench_chaos_specs_name_registered_sites():
+    """The repo-root benches schedule chaos by spec literal; a spec
+    naming an unregistered (e.g. renamed) site would fire nothing and
+    silently certify a clean run."""
+    assert _bench_files(), "no bench_*.py at the repo root?"
+    specs = {}
+    for path in _bench_files():
+        with open(path) as f:
+            for site in _SPEC_RE.findall(f.read()):
+                specs.setdefault(site, path)
+    assert specs, "benches define no chaos specs?"
+    unregistered = {s: p for s, p in specs.items()
+                    if s not in faults.SITES}
+    assert not unregistered, (
+        f"bench chaos specs naming unknown sites: {unregistered}")
 
 
 def test_every_registered_site_has_a_call_site():

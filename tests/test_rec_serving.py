@@ -1,0 +1,396 @@
+"""Recommender serving over the durable PS (ISSUE 11): RankingService
+parity + compile-once, staleness-bounded reads, invalidation-on-push,
+rec.* fault sites, the /v1/rank HTTP front, the paddle_rec_* metric
+family, and the bench_rec chaos certification subprocess smoke.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import observe, rec
+from paddle_tpu.framework import faults
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+
+def _mk_runtime(eps, mode, geo_step=1):
+    from paddle_tpu.distributed import ps
+    from paddle_tpu.distributed.ps.service import Communicator
+
+    rm = ps.PSRoleMaker(server_endpoints=eps, role="TRAINER",
+                        trainer_id=0, n_trainers=1)
+    rt = ps.PSRuntime(rm, mode=mode)
+    rt._client = ps.PSClient(eps)
+    rt._communicator = Communicator(rt._client, mode=mode,
+                                    geo_step=geo_step).start()
+    return rt
+
+
+def _close_runtime(rt):
+    rt._communicator.stop()
+    rt._client.close()
+
+
+@pytest.fixture()
+def ps_pair():
+    """One PS + (sync serving runtime, geo training runtime) — the
+    serve-while-learning topology rec.serving is built for."""
+    from paddle_tpu.distributed import ps
+
+    srv = ps.PSServer("127.0.0.1:0").start()
+    eps = [srv.endpoint]
+    serve_rt = _mk_runtime(eps, "sync")
+    train_rt = _mk_runtime(eps, "geo", geo_step=1)
+    yield serve_rt, train_rt
+    _close_runtime(serve_rt)
+    _close_runtime(train_rt)
+    srv.stop()
+
+
+def _serving_stack(serve_rt, train_rt, n_ids=64, dim=4, cap=32, slots=3,
+                   prefix="t"):
+    """RankingService over PS caches + OnlineTrainer invalidating them."""
+    from paddle_tpu.distributed import ps
+
+    s_deep = ps.TPUEmbeddingCache(f"{prefix}_deep", dim, capacity=cap,
+                                  init_range=0.1, runtime=serve_rt)
+    s_wide = ps.TPUEmbeddingCache(f"{prefix}_wide", 1, capacity=cap,
+                                  init_range=0.1, runtime=serve_rt)
+    model = rec.WideDeepCTR(n_ids, n_ids, embed_dim=dim, dnn_dims=(8,),
+                            deep_embedding=s_deep, wide_embedding=s_wide)
+    svc = rec.RankingService(model, max_batch=4, max_wait_s=0.001)
+    zero = np.zeros(slots, np.int64)
+    svc.warmup(zero, zero)
+    svc.start()
+
+    t_deep = ps.TPUEmbeddingCache(f"{prefix}_deep", dim, capacity=cap,
+                                  init_range=0.1, runtime=train_rt)
+    t_wide = ps.TPUEmbeddingCache(f"{prefix}_wide", 1, capacity=cap,
+                                  init_range=0.1, runtime=train_rt)
+    tmodel = rec.WideDeepCTR(n_ids, n_ids, embed_dim=dim, dnn_dims=(8,),
+                             deep_embedding=t_deep, wide_embedding=t_wide)
+    trainer = rec.OnlineTrainer(tmodel, runtime=train_rt,
+                                invalidate=[s_deep, s_wide])
+    return svc, trainer, s_deep, s_wide
+
+
+# ---------------------------------------------------------------------------
+# synthetic reader determinism (bench/chaos replay contract)
+# ---------------------------------------------------------------------------
+
+
+def test_synthetic_reader_is_bitwise_deterministic():
+    a = list(rec.synthetic_ctr_reader(3, batch_size=8, dnn_dim=50,
+                                      lr_dim=50, slots=4, seed=7))
+    b = list(rec.synthetic_ctr_reader(3, batch_size=8, dnn_dim=50,
+                                      lr_dim=50, slots=4, seed=7))
+    assert len(a) == len(b) == 3
+    for (d1, l1, c1), (d2, l2, c2) in zip(a, b):
+        assert d1.tobytes() == d2.tobytes()
+        assert l1.tobytes() == l2.tobytes()
+        assert c1.tobytes() == c2.tobytes()
+
+
+def test_synthetic_reader_seed_changes_stream_not_signal():
+    (d1, l1, _), = rec.synthetic_ctr_reader(1, batch_size=8, dnn_dim=50,
+                                            lr_dim=50, slots=4, seed=7)
+    (d2, l2, _), = rec.synthetic_ctr_reader(1, batch_size=8, dnn_dim=50,
+                                            lr_dim=50, slots=4, seed=8)
+    assert d1.tobytes() != d2.tobytes() or l1.tobytes() != l2.tobytes()
+
+
+# ---------------------------------------------------------------------------
+# service parity + compile-once (local embeddings)
+# ---------------------------------------------------------------------------
+
+
+def test_deepfm_service_matches_direct_forward():
+    model = rec.DeepFM([10, 12, 9], embed_dim=4, mlp_dims=(8,))
+    fields = np.array([3, 5, 1], np.int64)
+    want = float(np.asarray(
+        model(paddle.to_tensor(fields.reshape(1, -1)))._value
+    ).reshape(-1)[0])
+    with rec.RankingService(model, max_batch=4,
+                            max_wait_s=0.001) as svc:
+        got = svc.rank(fields, timeout=30)
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_widedeep_service_matches_direct_forward():
+    model = rec.WideDeepCTR(30, 30, embed_dim=4, dnn_dims=(8,))
+    dnn = np.array([1, 4, 7], np.int64)
+    lr = np.array([2, 5, 8], np.int64)
+    want = float(np.asarray(
+        model(paddle.to_tensor(dnn.reshape(1, -1)),
+              paddle.to_tensor(lr.reshape(1, -1)))._value
+    ).reshape(-1)[0])
+    with rec.RankingService(model, max_batch=4,
+                            max_wait_s=0.001) as svc:
+        got = svc.rank(dnn, lr, timeout=30)
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_score_tower_compiles_once_per_bucket():
+    """warmup traces each ladder rung exactly once; the steady state
+    then runs under no_retrace() (strict_shapes) without ever tracing
+    again — the retrace registry is the certificate."""
+    model = rec.DeepFM([16, 16], embed_dim=4, mlp_dims=(8,))
+    svc = rec.RankingService(model, max_batch=4, max_wait_s=0.001)
+    f = np.array([2, 9], np.int64)
+    n0 = len(observe.compile_events("rec.score"))
+    svc.warmup(f)
+    n_warm = len(observe.compile_events("rec.score"))
+    assert n_warm - n0 == len(svc.batcher.ladder)
+    assert svc.compile_counts == {b: 1 for b in svc.batcher.ladder}
+    svc.start()
+    futs = [svc.submit(np.array([i % 16, (3 * i) % 16], np.int64),
+                       timeout=30) for i in range(11)]
+    for fut in futs:
+        fut.result(30)
+    svc.close()
+    # varying occupancies hit several rungs — zero new traces
+    assert len(observe.compile_events("rec.score")) == n_warm
+
+
+def test_request_shape_is_locked_at_first_request():
+    model = rec.WideDeepCTR(30, 30, embed_dim=4, dnn_dims=(8,))
+    svc = rec.RankingService(model, max_batch=2)
+    svc._payload(np.arange(3), np.arange(3))
+    with pytest.raises(ValueError, match="service shape"):
+        svc._payload(np.arange(4), np.arange(4))
+    with pytest.raises(ValueError, match="slot count"):
+        svc._payload(np.arange(3), np.arange(2))
+
+
+# ---------------------------------------------------------------------------
+# staleness-bounded reads + invalidation-on-push (the tentpole protocol)
+# ---------------------------------------------------------------------------
+
+
+def test_staleness_bound_violation_forces_refresh(ps_runtime):
+    """Scripted geo lag: applied pushes elsewhere advance the table
+    watermark; a resident row may be served while its lag is within the
+    bound, and MUST be refreshed the moment the lag exceeds it."""
+    from paddle_tpu.distributed import ps
+
+    cache = ps.TPUEmbeddingCache("stale_t", 4, capacity=8,
+                                 runtime=ps_runtime, staleness_bound=2)
+    ids = np.array([1, 2, 3], np.int64)
+    cache.serve(ids)                    # resident at watermark 0
+    for _ in range(2):
+        cache.invalidate([7])           # geo lag: pushes to OTHER rows
+    r0 = cache.refreshes
+    cache.serve(ids)                    # lag 2 == bound -> still legal
+    assert cache.refreshes == r0
+    assert cache.max_served_staleness == 2
+    cache.invalidate([7])
+    cache.serve(ids)                    # lag 3 > bound -> refresh all 3
+    assert cache.refreshes == r0 + 3
+    # the refreshed read re-pulled at the current watermark: no read
+    # ever observed a row older than the bound
+    assert cache.max_served_staleness <= 2
+
+
+def test_explicit_invalidation_refreshes_next_read(ps_runtime):
+    from paddle_tpu.distributed import ps
+
+    cache = ps.TPUEmbeddingCache("inv_t", 4, capacity=8,
+                                 runtime=ps_runtime, staleness_bound=64)
+    ids = np.array([5, 6], np.int64)
+    cache.serve(ids)
+    assert cache.invalidate([5]) == 1   # resident -> marked
+    r0 = cache.refreshes
+    cache.serve(ids)                    # id 5 refreshes despite lag 1
+    assert cache.refreshes == r0 + 1
+
+
+def test_online_push_invalidates_serving_cache(ps_pair):
+    """Serve a key, push a click batch touching it through the geo
+    communicator, and the NEXT score must reflect the new rows — the
+    on_flush -> invalidate wiring certified end to end."""
+    serve_rt, train_rt = ps_pair
+    svc, trainer, s_deep, s_wide = _serving_stack(serve_rt, train_rt,
+                                                  prefix="inv")
+    ids = np.array([3, 4, 5], np.int64)
+    before = svc.rank(ids, ids, timeout=30)
+    dnn = np.tile(ids, (4, 1))
+    clicks = np.ones((4, 1), np.float32)
+    with faults.ChaosSchedule("rec.online_push@1:delay:0.001") as ch:
+        loss = trainer.feed(dnn, dnn, clicks)
+        ch.verify()
+    assert np.isfinite(loss)
+    trainer.flush()
+    assert s_deep.push_version > 0
+    assert s_deep.invalidations + s_wide.invalidations > 0
+    after = svc.rank(ids, ids, timeout=30)
+    assert after != before
+    assert s_deep.refreshes > 0         # the re-pull actually happened
+    snap = svc.snapshot()
+    assert snap["caches"]["deep"]["invalidations"] == s_deep.invalidations
+    svc.close()
+
+
+# ---------------------------------------------------------------------------
+# rec.* fault sites
+# ---------------------------------------------------------------------------
+
+
+def test_rec_score_fault_fails_batch_members():
+    model = rec.DeepFM([8, 8], embed_dim=2, mlp_dims=(4,))
+    svc = rec.RankingService(model, max_batch=2, max_wait_s=0.001)
+    f = np.array([1, 2], np.int64)
+    svc.warmup(f)
+    with faults.ChaosSchedule("rec.score@1:raise",
+                              "rec.embed_pull@1:delay:0.001") as ch:
+        svc.start()
+        with pytest.raises(faults.FaultError):
+            svc.rank(f, timeout=30)
+        # the batcher fails the members and lives on
+        assert np.isfinite(svc.rank(f, timeout=30))
+        ch.verify()
+    svc.close()
+
+
+# ---------------------------------------------------------------------------
+# HTTP front: POST /v1/rank
+# ---------------------------------------------------------------------------
+
+
+def _post(port, path, obj):
+    import http.client
+
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    conn.request("POST", path, json.dumps(obj),
+                 {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    body = json.loads(resp.read())
+    headers = dict(resp.getheaders())
+    conn.close()
+    return resp.status, body, headers
+
+
+def test_http_rank_endpoint_single_and_batch():
+    from paddle_tpu.serving.server import http_front
+
+    model = rec.DeepFM([10, 10], embed_dim=2, mlp_dims=(4,))
+    svc = rec.RankingService(model, max_batch=4, max_wait_s=0.001)
+    f = np.array([1, 2], np.int64)
+    svc.warmup(f)
+    svc.start()
+    want = svc.rank(f, timeout=30)
+    httpd = http_front(ranker=svc)
+    try:
+        port = httpd.server_address[1]
+        status, body, _ = _post(port, "/v1/rank", {"fields": [1, 2]})
+        assert status == 200
+        np.testing.assert_allclose(body["scores"], [want], rtol=1e-5)
+        status, body, _ = _post(port, "/v1/rank",
+                                {"fields": [[1, 2], [3, 4], [1, 2]]})
+        assert status == 200
+        assert len(body["scores"]) == 3
+        np.testing.assert_allclose(body["scores"][0], body["scores"][2],
+                                   rtol=1e-6)
+        # bad shape -> 400, not a wedged front
+        status, body, _ = _post(port, "/v1/rank", {"fields": [1, 2, 3]})
+        assert status == 400
+        # a generate-only route is 404 on a rank-only front
+        status, _, _ = _post(port, "/v1/generate", {"prompt": [1]})
+        assert status == 404
+    finally:
+        httpd.shutdown()
+        svc.close()
+
+
+def test_http_rank_429_carries_retry_after():
+    from paddle_tpu.serving.server import http_front
+
+    model = rec.DeepFM([10, 10], embed_dim=2, mlp_dims=(4,))
+    # not started + cap 1: the first submit fills the queue, the HTTP
+    # request is shed at admission exactly like a real overload
+    svc = rec.RankingService(model, max_batch=2, queue_cap=1)
+    svc.submit(np.array([1, 2], np.int64))
+    httpd = http_front(ranker=svc)
+    try:
+        port = httpd.server_address[1]
+        status, body, headers = _post(port, "/v1/rank",
+                                      {"fields": [3, 4]})
+        assert status == 429
+        assert body["type"] == "QueueFullError"
+        assert body["retriable"] is True
+        assert float(headers["Retry-After"]) > 0
+    finally:
+        httpd.shutdown()
+        svc.close(drain=False)
+
+
+# ---------------------------------------------------------------------------
+# paddle_rec_* metric family
+# ---------------------------------------------------------------------------
+
+
+def test_prometheus_and_snapshot_expose_rec_family(ps_runtime):
+    from paddle_tpu.distributed import ps
+
+    cache = ps.TPUEmbeddingCache("prom_t", 4, capacity=8,
+                                 runtime=ps_runtime)
+    cache.serve(np.array([1, 2], np.int64))
+    cache.serve(np.array([1, 2], np.int64))   # hits
+    text = observe.prometheus_text()
+    for family in ("paddle_rec_cache_hits_total",
+                   "paddle_rec_cache_misses_total",
+                   "paddle_rec_cache_hit_rate",
+                   "paddle_rec_cache_size",
+                   "paddle_rec_cache_capacity",
+                   "paddle_rec_max_served_staleness"):
+        assert f"# TYPE {family}" in text, family
+        assert f"\n{family} " in text, family
+    snap = observe.snapshot()["rec"]
+    assert snap["cache_hits"] >= 2
+    assert 0.0 < snap["cache_hit_rate"] <= 1.0
+    assert snap["cache_capacity"] >= 8
+    # the ranker front serves the same exposition on GET /metrics
+    model = rec.DeepFM([10, 10], embed_dim=2, mlp_dims=(4,))
+    svc = rec.RankingService(model, max_batch=2)
+    assert "paddle_rec_cache_hit_rate" in svc.metrics_prometheus()
+
+
+# ---------------------------------------------------------------------------
+# bench subprocess smoke: the full chaos certification at tiny scale
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.dist
+def test_bench_rec_smoke_certifies_chaos():
+    """bench_rec --smoke runs both phases end to end: zipfian load with
+    online learning underneath, then the mid-push primary-kill chaos
+    run certified bitwise against a clean reference."""
+    out = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "bench_rec.py"), "--smoke"],
+        capture_output=True, text=True, timeout=240,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert out.returncode == 0, out.stderr[-2000:]
+    line = [ln for ln in out.stdout.splitlines()
+            if ln.startswith("BENCH_REC ")]
+    assert line, out.stdout[-2000:]
+    rep = json.loads(line[0][len("BENCH_REC "):])
+    assert rep["chaos_goodput"] == 1.0
+    assert rep["digest_bitwise_equal"] is True
+    assert rep["failovers"] >= 1
+    assert rep["chaos_fired"]["ps.push"] == 2
+    assert rep["qps"] > 0 and rep["p99_ms"] > 0
+    assert 0.0 < rep["cache_hit_rate"] <= 1.0
+    # compile-once at the bench scale: ladder 1,2,4,8,16 -> 5 traces
+    assert rep["score_compiles"] == 5
+    bound = rep.get("staleness_bound", 64)
+    assert rep["max_served_staleness"] <= bound
