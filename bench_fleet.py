@@ -1,0 +1,390 @@
+"""Elastic-fleet benchmark: a replayable 10x traffic swing, autoscaled
+vs static, with chaos fired during the scale events.
+
+Three legs over the SAME seeded open-loop trace (serving/workload.py —
+bit-deterministic in the scenario seed, so every leg sees identical
+arrivals):
+
+1. **static-peak** — a fixed fleet provisioned for the peak
+   (`--max-replicas` engines up the whole run): the goodput ceiling and
+   the chip-hours ceiling.
+2. **autoscaled** — the fleet starts at `--min-replicas` and the
+   `Autoscaler` grows/shrinks it from the SLO error budget (windowed
+   p99 vs `--slo-ms`, utilisation watermarks, brownout) with
+   hysteresis + cooldown. Scale-up warmup hides behind the
+   single-trace restart path; the leg asserts every member compiled
+   exactly once (`{"decode": 1, "cow": 1}`).
+3. **chaos** — the autoscaled leg re-run under a `ChaosSchedule` that
+   fires *during* the scale events: delay on the first
+   ``serving.scale_up`` and ``serving.scale_down``, a raise on the
+   first ``serving.drain`` eviction attempt (retried at the next
+   watchdog poll), and a replica crash mid-swing via
+   ``serving.replica_step`` — then certifies ``fired == planned`` and
+   exactly-once delivery (every arrival's future resolved exactly
+   once: zero lost, zero duplicated).
+
+Each leg reports goodput, SLO-violation-minutes (1-second buckets of
+submit time whose bucket p99 exceeds `--slo-ms`), and chip-hours
+(`ReplicaSet.replica_seconds()` — a replica costs its chip whether
+busy or idle; measured from replay start to last completion, so the
+post-trace drain wait is not charged). One JSON line per leg plus a
+final ``BENCH_FLEET`` object. ``--smoke`` shrinks the model/trace and
+asserts the acceptance bar: both clean legs at goodput 1.0, autoscaled
+strictly cheaper in chip-hours than static-peak, chaos goodput 1.0
+with the full schedule delivered.
+
+CPU smoke (the tier-1 case):
+
+    JAX_PLATFORMS=cpu python bench_fleet.py --smoke
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import threading
+import time
+
+import numpy as np
+
+
+def percentile(xs, p):
+    ys = sorted(xs)
+    if not ys:
+        return 0.0
+    i = min(int(round((p / 100.0) * (len(ys) - 1))), len(ys) - 1)
+    return ys[i]
+
+
+class _MemberSampler:
+    """Background membership/chip sampler: peak size + (t, members)
+    timeline for the report."""
+
+    def __init__(self, replica_set, period_s=0.05):
+        self.rs = replica_set
+        self.period_s = period_s
+        self.samples = []
+        self._stop = threading.Event()
+        self._t0 = None
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self):
+        while not self._stop.wait(self.period_s):
+            self.samples.append(
+                (round(time.monotonic() - self._t0, 3),
+                 self.rs.member_replicas(), self.rs.live_replicas()))
+
+    def start(self):
+        self._t0 = time.monotonic()
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(2.0)
+        return self.samples
+
+
+def make_router(serving, model, args, name, autoscaled):
+    kw = dict(
+        engine_kw=dict(max_slots=args.max_slots,
+                       max_seq_len=args.max_seq_len,
+                       block_size=args.block_size),
+        queue_cap=args.queue_cap, hedge=False, retry_budget=3,
+        liveness_timeout_s=30.0, backoff_base_s=0.05,
+        # never shed: the certification is exactly-once over EVERY
+        # arrival, and a transient zero-capacity window (mid-kill)
+        # must queue, not brownout-shed
+        brownout_priority=0, name=name)
+    if autoscaled:
+        kw["autoscale"] = dict(
+            min_replicas=args.min_replicas,
+            max_replicas=args.max_replicas, slo_p99_ms=args.slo_ms,
+            cooldown_s=args.cooldown_s, window=args.slo_window)
+        n = args.min_replicas
+    else:
+        n = args.max_replicas
+    return serving.Router(model, n, **kw).start()
+
+
+def run_leg(router, scenario, args, label):
+    """Replay the scenario open-loop against one fleet; returns the
+    result row. Exactly-once is certified per arrival: its future must
+    resolve exactly one time (zero lost, zero duplicated)."""
+    from paddle_tpu.serving import workload
+
+    trace = scenario.trace()
+    rs = router.replica_set
+    lock = threading.Lock()
+    reqs = {}                 # future id -> bookkeeping
+    t0 = time.monotonic()
+
+    def submit(a):
+        fut = router.submit(a.prompt, max_new_tokens=a.max_new,
+                            priority=a.priority, timeout=120.0)
+        info = {"t_submit": time.monotonic() - t0, "done": 0,
+                "lat_s": None, "ok": False}
+        with lock:
+            reqs[fut.id] = info
+
+        def cb(f, info=info):
+            with lock:
+                info["done"] += 1
+                info["lat_s"] = time.monotonic() - t0 - info["t_submit"]
+                info["ok"] = f._error is None
+        fut.add_done_callback(cb)
+        return fut
+
+    sampler = _MemberSampler(rs).start()
+    chip0 = rs.replica_seconds()
+    records = workload.replay(submit, trace,
+                              time_scale=args.time_scale)
+    shed = sum(1 for r in records if r["error"] is not None)
+    for r in records:
+        if r["future"] is not None:
+            try:
+                r["future"].result(120.0)
+            except Exception:  # noqa: BLE001 — typed failures count
+                pass
+    chip_s = rs.replica_seconds() - chip0
+    wall = time.monotonic() - t0
+    samples = sampler.stop()
+    # an autoscale build may still be in flight (the trace ended while
+    # a replica was tracing): let it land so scale_ups/compile counts
+    # describe the whole leg
+    asc = getattr(router, "autoscaler", None)
+    if asc is not None:
+        for _ in range(600):
+            t = asc._scale_thread
+            if t is None or not t.is_alive():
+                break
+            t.join(0.1)
+    compiles = router.compile_counts()
+
+    with lock:
+        rows = list(reqs.values())
+    ok = sum(1 for r in rows if r["ok"])
+    failed = len(rows) - ok + shed
+    lost = sum(1 for r in rows if r["done"] == 0)
+    dup = sum(1 for r in rows if r["done"] > 1)
+    # SLO-violation time: 1-second submit buckets whose p99 e2e
+    # latency exceeds the SLO
+    buckets: dict = {}
+    for r in rows:
+        if r["lat_s"] is not None:
+            buckets.setdefault(int(r["t_submit"]), []).append(r["lat_s"])
+    violation_s = sum(
+        1.0 for lats in buckets.values()
+        if percentile(lats, 99) * 1e3 > args.slo_ms)
+    lats = [r["lat_s"] for r in rows if r["ok"] and r["lat_s"] is not None]
+    total = len(rows) + shed
+    row = {
+        "leg": label,
+        "arrivals": len(trace),
+        "requests_ok": ok,
+        "requests_failed": failed,
+        "lost": lost,
+        "duplicated": dup,
+        "goodput": round(ok / total, 4) if total else 0.0,
+        "wall_s": round(wall, 4),
+        "chip_s": round(chip_s, 3),
+        "chip_hours": round(chip_s / 3600.0, 6),
+        "slo_violation_s": violation_s,
+        "slo_violation_min": round(violation_s / 60.0, 4),
+        "p50_ms": round(percentile(lats, 50) * 1e3, 3),
+        "p99_ms": round(percentile(lats, 99) * 1e3, 3),
+        "peak_members": max((m for _, m, _ in samples), default=0),
+        "min_members": min((m for _, m, _ in samples), default=0),
+        "compiles_once": all(c == {"decode": 1, "cow": 1}
+                             for c in compiles.values()),
+        "scale_ups": router.metrics.get("replicas_added"),
+        "scale_downs": router.metrics.get("replicas_removed"),
+        "replays": router.metrics.get("replays"),
+        "restarts": router.metrics.get("replica_restarts"),
+    }
+    if args.timeline:
+        row["members_timeline"] = samples
+    return row
+
+
+def wait_scaled_down(router, args, timeout=20.0):
+    """Post-trace: wait for the autoscaler to drain back to the floor
+    (drives the serving.scale_down/serving.drain chaos sites)."""
+    deadline = time.monotonic() + timeout
+    rs = router.replica_set
+    while time.monotonic() < deadline:
+        if rs.member_replicas() <= args.min_replicas \
+                and not any(r.state == "draining" for r in rs.replicas):
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--trace", default=None,
+                    help="scenario JSON (path or inline); default = "
+                    "the canonical 10x swing built from --low/high-rps")
+    ap.add_argument("--low-rps", type=float, default=6.0)
+    ap.add_argument("--high-rps", type=float, default=60.0,
+                    help="peak offered load (default 10x the base)")
+    ap.add_argument("--low-s", type=float, default=3.0)
+    ap.add_argument("--high-s", type=float, default=4.0)
+    ap.add_argument("--arrival", default="poisson",
+                    choices=("poisson", "burst", "heavy_tail"))
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--time-scale", type=float, default=1.0)
+    ap.add_argument("--min-replicas", type=int, default=1)
+    ap.add_argument("--max-replicas", type=int, default=4)
+    ap.add_argument("--slo-ms", type=float, default=250.0,
+                    help="e2e p99 SLO (autoscaler signal + violation "
+                    "accounting)")
+    ap.add_argument("--cooldown-s", type=float, default=0.5)
+    ap.add_argument("--slo-window", type=int, default=64,
+                    help="autoscaler p99 window (most recent samples)")
+    ap.add_argument("--max-slots", type=int, default=2)
+    ap.add_argument("--block-size", type=int, default=16)
+    ap.add_argument("--queue-cap", type=int, default=256)
+    ap.add_argument("--prompt-len", default="4,10")
+    ap.add_argument("--max-new", default="12,16")
+    ap.add_argument("--vocab", type=int, default=97)
+    ap.add_argument("--hidden", type=int, default=64)
+    ap.add_argument("--layers", type=int, default=2)
+    ap.add_argument("--heads", type=int, default=4)
+    ap.add_argument("--max-seq-len", type=int, default=64)
+    ap.add_argument("--timeline", action="store_true",
+                    help="include the (t, members, live) timeline per leg")
+    ap.add_argument("--json", default=None,
+                    help="write the final BENCH_FLEET object here")
+    ap.add_argument("--no-chaos", action="store_true",
+                    help="skip the chaos leg")
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny model + short trace + assert the "
+                    "acceptance bar (tier-1 CPU case)")
+    args = ap.parse_args(argv)
+
+    if args.smoke:
+        # heavy-enough decodes that the 10x+ swing actually saturates
+        # one single-slot replica (queueing -> p99 over SLO -> scale-up)
+        args.hidden, args.layers, args.heads = 64, 2, 4
+        args.vocab, args.max_seq_len = 31, 64
+        args.low_rps, args.high_rps = 2.5, 60.0
+        args.low_s, args.high_s = 1.5, 2.5
+        args.max_new = "12,16"
+        args.max_slots, args.max_replicas = 1, 3
+        args.slo_ms, args.cooldown_s = 150.0, 0.4
+
+    import paddle_tpu as paddle
+    from paddle_tpu import serving
+    from paddle_tpu.framework import faults
+    from paddle_tpu.nlp.transformers import GPTConfig, GPTForPretraining
+    from paddle_tpu.serving import workload
+
+    paddle.seed(7)
+    cfg = GPTConfig(vocab_size=args.vocab, hidden_size=args.hidden,
+                    num_layers=args.layers, num_heads=args.heads,
+                    max_seq_len=args.max_seq_len, dropout=0.0,
+                    attn_dropout=0.0, use_parallel=False)
+    model = GPTForPretraining(cfg)
+    model.eval()
+
+    plen = tuple(int(x) for x in args.prompt_len.split(","))
+    mnew = tuple(int(x) for x in args.max_new.split(","))
+    if args.trace:
+        scenario = workload.Scenario.from_json(args.trace)
+    else:
+        scenario = workload.Scenario.swing(
+            low_rps=args.low_rps, high_rps=args.high_rps,
+            low_s=args.low_s, high_s=args.high_s, arrival=args.arrival,
+            seed=args.seed, vocab=args.vocab, prompt_len=plen,
+            max_new=mnew)
+
+    # -- leg 1: static fleet provisioned for the peak -----------------------
+    router = make_router(serving, model, args, "fstatic",
+                         autoscaled=False)
+    static = run_leg(router, scenario, args, "static-peak")
+    router.shutdown(drain=True)
+    print(json.dumps(static))
+
+    # -- leg 2: autoscaled --------------------------------------------------
+    router = make_router(serving, model, args, "fauto", autoscaled=True)
+    auto = run_leg(router, scenario, args, "autoscaled")
+    auto["scaled_down_after"] = wait_scaled_down(router, args)
+    router.shutdown(drain=True)
+    print(json.dumps(auto))
+
+    # -- leg 3: the autoscaled fleet under chaos fired DURING scale events --
+    chaos_row = chaos_fired = None
+    chaos_specs = [
+        "serving.scale_up@1:delay:0.05",       # slow first grow
+        "serving.scale_down@1:delay:0.02",     # slow first shrink
+        "serving.drain@1:raise",               # first eviction attempt
+                                               # fails; watchdog retries
+        "serving.replica_step[fchaos.r0]@150:raise",  # crash a replica
+                                               # mid-swing (failover)
+    ]
+    if not args.no_chaos:
+        router = make_router(serving, model, args, "fchaos",
+                             autoscaled=True)
+        with faults.ChaosSchedule(*chaos_specs) as sched:
+            chaos_row = run_leg(router, scenario, args, "chaos")
+            chaos_row["scaled_down_after"] = wait_scaled_down(
+                router, args)
+            chaos_fired = sched.verify()   # fired == planned, per site
+        chaos_row["chaos_fired"] = chaos_fired
+        router.shutdown(drain=True)
+        print(json.dumps(chaos_row))
+
+    result = {
+        "bench": "BENCH_FLEET",
+        "scenario": scenario.to_dict(),
+        "config": {
+            "min_replicas": args.min_replicas,
+            "max_replicas": args.max_replicas,
+            "slo_ms": args.slo_ms, "cooldown_s": args.cooldown_s,
+            "max_slots": args.max_slots, "queue_cap": args.queue_cap,
+            "time_scale": args.time_scale,
+            "model": {"vocab": args.vocab, "hidden": args.hidden,
+                      "layers": args.layers, "heads": args.heads},
+            "chaos_specs": None if args.no_chaos else chaos_specs,
+        },
+        "static": static,
+        "autoscaled": auto,
+        "chaos": chaos_row,
+        "chip_hours_saved": round(
+            static["chip_hours"] - auto["chip_hours"], 6),
+        "chip_fraction_vs_static": round(
+            auto["chip_s"] / static["chip_s"], 4) if static["chip_s"]
+            else None,
+        "chaos_goodput": None if chaos_row is None
+            else chaos_row["goodput"],
+    }
+    print(json.dumps(result))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(result, f, indent=1, sort_keys=True)
+
+    if args.smoke:
+        for leg in filter(None, (static, auto, chaos_row)):
+            assert leg["lost"] == 0, f"{leg['leg']}: lost futures"
+            assert leg["duplicated"] == 0, \
+                f"{leg['leg']}: duplicated outcomes"
+        assert static["goodput"] == 1.0, static
+        assert auto["goodput"] == 1.0, auto
+        assert auto["compiles_once"], "a scale-up retraced"
+        assert auto["scale_ups"] >= 1, "autoscaler never grew the fleet"
+        assert auto["scaled_down_after"], \
+            "autoscaler never drained back to the floor"
+        assert auto["chip_s"] < static["chip_s"], \
+            (auto["chip_s"], static["chip_s"])
+        if chaos_row is not None:
+            assert chaos_row["goodput"] == 1.0, chaos_row
+            for site in ("serving.scale_up", "serving.scale_down",
+                         "serving.drain", "serving.replica_step"):
+                assert chaos_fired.get(site, 0) >= 1, (site, chaos_fired)
+        print("SMOKE OK")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -32,6 +32,12 @@ failures on every replica + one mid-run replica kill), emitting a
 successfully over submitted), restart/retry/replay counters, and the
 degraded-vs-clean p99 delta — so future rounds can ratchet
 degraded-mode performance.
+
+``--trace scenario.json`` replays a seeded open-loop trace from the
+workload simulator (serving/workload.py) instead of closed-loop
+clients, emitting ``BENCH_SERVING_TRACE`` — the same scenario language
+bench_fleet.py sweeps, so the LLM bench and the elasticity bench grade
+against identical offered load.
 """
 
 from __future__ import annotations
@@ -170,6 +176,71 @@ def run_fleet_level(server, n_clients, steps, prompt_len, max_new, vocab,
     return row
 
 
+def run_trace(args, model, serving):
+    """--trace: open-loop replay of a workload-simulator scenario
+    (serving/workload.py) — the shared scenario language with
+    bench_fleet.py. Arrivals are issued on the trace's schedule
+    regardless of completions, so overload shows up as queueing and
+    shed, not hidden client back-pressure."""
+    from paddle_tpu.serving import workload
+
+    scenario = workload.Scenario.from_json(args.trace)
+    trace = scenario.trace()
+    blocks_per_seq = -(-args.max_seq_len // args.block_size)
+    num_blocks = args.kv_blocks or \
+        args.dense_equiv_slots * blocks_per_seq + 1
+    fleet = dict(hedge=False, liveness_timeout_s=30.0,
+                 name="btrace") if args.replicas > 1 else None
+    server = serving.Server(
+        model, replicas=args.replicas, max_slots=args.max_slots,
+        max_seq_len=args.max_seq_len, block_size=args.block_size,
+        num_blocks=num_blocks, prefill_chunk=args.prefill_chunk,
+        queue_cap=max(64, 4 * args.max_slots), fleet=fleet).start()
+
+    def submit(a):
+        return server.submit(a.prompt, max_new_tokens=a.max_new,
+                             priority=a.priority, timeout=120.0)
+
+    t0 = time.monotonic()
+    records = workload.replay(submit, trace,
+                              time_scale=args.time_scale)
+    ok = failed = 0
+    for rec in records:
+        if rec["error"] is not None:
+            failed += 1
+            continue
+        try:
+            rec["future"].result(120.0)
+            ok += 1
+        except Exception:  # noqa: BLE001 — typed failures count
+            failed += 1
+    wall = time.monotonic() - t0
+    snap = server.snapshot()
+    lat = snap["latency_s"].get("e2e", {})
+    pfx = snap.get("prefix_cache", {})
+    server.shutdown(drain=True)
+    total = ok + failed
+    result = {
+        "bench": "BENCH_SERVING_TRACE",
+        "scenario": scenario.to_dict(),
+        "time_scale": args.time_scale,
+        "arrivals": len(trace),
+        "requests_ok": ok,
+        "requests_failed": failed,
+        "goodput": round(ok / total, 4) if total else 0.0,
+        "wall_s": round(wall, 4),
+        "qps": round(ok / wall, 3),
+        "prefix_hit_rate": round(pfx.get("hit_rate", 0.0), 4),
+        "p50_ms": round(lat.get("p50", 0.0) * 1e3, 3),
+        "p99_ms": round(lat.get("p99", 0.0) * 1e3, 3),
+    }
+    print(json.dumps(result))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(result, f, indent=1, sort_keys=True)
+    return 0
+
+
 def run_chaos(args, model, serving):
     """--chaos: clean fleet baseline, then the same load under a
     scripted fault schedule + one mid-run replica kill."""
@@ -280,7 +351,15 @@ def main(argv=None):
                     "same load under a scripted fault schedule; emits "
                     "BENCH_SERVING_CHAOS instead of BENCH_SERVING")
     ap.add_argument("--replicas", type=int, default=2,
-                    help="fleet size for --chaos")
+                    help="fleet size for --chaos / --trace")
+    ap.add_argument("--trace", default=None,
+                    help="workload-scenario JSON (path or inline) to "
+                    "replay open-loop instead of closed-loop clients; "
+                    "emits BENCH_SERVING_TRACE (see serving/workload.py "
+                    "and bench_fleet.py for the shared language)")
+    ap.add_argument("--time-scale", type=float, default=1.0,
+                    help="--trace: multiply every arrival time (0.5 = "
+                    "replay twice as fast)")
     args = ap.parse_args(argv)
 
     import paddle_tpu as paddle
@@ -296,6 +375,8 @@ def main(argv=None):
 
     if args.chaos:
         return run_chaos(args, model, serving)
+    if args.trace:
+        return run_trace(args, model, serving)
 
     # match the dense pool's bytes exactly: a dense [slots, nh, max_seq,
     # hd] pool holds slots*max_seq token rows = that many block rows of

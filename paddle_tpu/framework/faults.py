@@ -147,6 +147,9 @@ SITES = {
     "serving.replica_heartbeat": "each fleet replica heartbeat",
     "serving.route": "each fleet Router dispatch attempt",
     "serving.replay": "each failover replay of a dead replica request",
+    "serving.scale_up": "each ReplicaSet.add_replica before the build",
+    "serving.scale_down": "each ReplicaSet.remove_replica before drain",
+    "serving.drain": "each drained-victim eviction attempt",
     "ps.push": "each PS mutation between WAL append and apply",
     "ps.pull": "each PS pull_dense/pull_sparse lookup",
     "ps.wal_append": "before each PS WAL record write",

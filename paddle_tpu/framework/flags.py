@@ -112,6 +112,24 @@ _DEFS = {
         "token-prefix hash so later requests sharing a prefix (system "
         "prompts) reuse physical blocks, with copy-on-write on "
         "divergence"),
+    "FLAGS_fleet_min_replicas": (
+        1, int,
+        "fleet: autoscaler floor — the Autoscaler never drains the "
+        "membership below this many replicas"),
+    "FLAGS_fleet_max_replicas": (
+        8, int,
+        "fleet: autoscaler ceiling — add_replica stops here even if "
+        "the SLO error budget is still burning"),
+    "FLAGS_fleet_scale_cooldown_s": (
+        5.0, float,
+        "fleet: hysteresis cooldown between autoscaler actions; an "
+        "overload must also persist this long before a scale-up, and "
+        "idleness before a scale-down (prevents flapping)"),
+    "FLAGS_fleet_slo_p99_ms": (
+        500.0, float,
+        "fleet: the e2e latency SLO in milliseconds; the autoscaler "
+        "treats windowed p99 above this as error-budget burn and "
+        "accrues fleet.slo_violation_ms while it lasts"),
     "FLAGS_flight_recorder_capacity": (
         256, int,
         "observe: ring-buffer size of the always-on flight recorder "

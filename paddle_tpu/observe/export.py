@@ -90,6 +90,13 @@ def snapshot(serving=None):
              for stat in _REC_METRICS},
             **{name.replace("paddle_rec_", ""): value
                for name, (value, _h) in _rec_gauges().items()}),
+        # elastic-fleet view mirrors paddle_fleet_*: autoscaler gauges
+        # + scale-event counters + SLO error-budget burn (in seconds)
+        "fleet": dict(
+            {stat.split(".", 1)[1]: monitor.stat_get(stat)
+             for stat in _FLEET_METRICS},
+            slo_violation_seconds=(
+                monitor.stat_get("fleet.slo_violation_ms") / 1e3)),
     }
     if serving is not None:
         out["serving"] = serving.snapshot()
@@ -157,6 +164,28 @@ _REC_METRICS = {
         "paddle_rec_online_steps_total", "counter",
         "click batches fed by online trainers"),
 }
+
+#: monitor stat -> (prometheus name, type, help) for the elastic-fleet
+#: family (ReplicaSet membership + Autoscaler); same contract as
+#: _PS_METRICS, mirrored in snapshot()["fleet"]. Scale-event counters
+#: get a direction label; slo_violation_ms is converted to seconds
+_FLEET_METRICS = {
+    "fleet.target_replicas": (
+        "paddle_fleet_target_replicas", "gauge",
+        "fleet size the autoscaler is steering toward"),
+    "fleet.live_replicas": (
+        "paddle_fleet_live_replicas", "gauge",
+        "replicas currently healthy (able to take new routes)"),
+    "fleet.scale_events_up": (
+        "paddle_fleet_scale_events_total", "counter",
+        "fleet membership changes (labelled by direction)"),
+    "fleet.scale_events_down": (
+        "paddle_fleet_scale_events_total", "counter",
+        "fleet membership changes (labelled by direction)"),
+}
+#: fleet stats consumed by _FLEET_METRICS or converted inline — kept
+#: out of the generic (counter-typed) monitor dump
+_FLEET_STATS = set(_FLEET_METRICS) | {"fleet.slo_violation_ms"}
 
 
 def _rec_gauges():
@@ -237,10 +266,25 @@ def prometheus_text(serving=None, queue_depth=None, fleet=None):
     for pname, (value, help_) in _rec_gauges().items():
         L.add(pname, value, help_=help_)
 
+    # elastic-fleet family: autoscaler gauges + direction-labelled
+    # scale-event counters + SLO error-budget burn
+    for stat, (pname, mtype, help_) in _FLEET_METRICS.items():
+        labels = None
+        if stat.startswith("fleet.scale_events_"):
+            labels = {"direction": stat.rsplit("_", 1)[1]}
+        L.add(pname, monitor.stat_get(stat), mtype=mtype, labels=labels,
+              help_=help_)
+    L.add("paddle_fleet_slo_violation_seconds_total",
+          monitor.stat_get("fleet.slo_violation_ms") / 1e3,
+          mtype="counter",
+          help_="cumulative seconds the windowed e2e p99 spent over "
+                "FLAGS_fleet_slo_p99_ms")
+
     for name, value in sorted(monitor.stats().items()):
         if not isinstance(value, (int, float)):
             continue
-        if name in _PS_METRICS or name in _REC_METRICS:
+        if name in _PS_METRICS or name in _REC_METRICS \
+                or name in _FLEET_STATS:
             continue
         L.add(f"paddle_{name}", value, mtype="counter",
               help_="framework.monitor stat")
@@ -320,8 +364,8 @@ def prometheus_text(serving=None, queue_depth=None, fleet=None):
             L.add("paddle_serving_replica_state",
                   REPLICA_STATE_CODES.get(rep["state"], -1),
                   labels={**labels, "state": rep["state"]},
-                  help_="replica lifecycle state "
-                        "(0=starting 1=healthy 2=dead 3=backoff 4=stopped)")
+                  help_="replica lifecycle state (0=starting 1=healthy "
+                        "2=dead 3=backoff 4=stopped 5=draining)")
             L.add("paddle_serving_replica_restarts", rep["restarts"],
                   mtype="counter", labels=labels,
                   help_="supervised restarts of this replica")
@@ -333,6 +377,14 @@ def prometheus_text(serving=None, queue_depth=None, fleet=None):
             L.add("paddle_serving_replica_load", rep["load"],
                   labels=labels,
                   help_="router-visible in-flight attempts")
+            if "uptime_s" in rep:
+                L.add("paddle_serving_replica_uptime_seconds",
+                      rep["uptime_s"], labels=labels,
+                      help_="seconds since this replica's engine built")
+            if "beat_age_s" in rep:
+                L.add("paddle_serving_replica_beat_age_seconds",
+                      rep["beat_age_s"], labels=labels,
+                      help_="age of the replica's last liveness beat")
             br = rep.get("breaker", {})
             L.add("paddle_serving_replica_breaker_state",
                   breaker_codes.get(br.get("state"), -1),

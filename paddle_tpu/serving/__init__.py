@@ -20,7 +20,14 @@ iteration-level ("continuous") batching in the Orca lineage:
 - `ReplicaSet` / `Router` — the resilient fleet: N supervised engine
   replicas with heartbeat watchdogs and backed-off restarts, fronted
   by failover replay, budgeted retries, hedging, per-replica circuit
-  breakers, and brownout shedding (fleet.py);
+  breakers, and brownout shedding (fleet.py) — now elastic:
+  `add_replica`/`remove_replica(drain=True)` scale membership under
+  load with zero lost/duplicated requests;
+- `Autoscaler` — grows/shrinks the fleet from the SLO error budget
+  (windowed p99 vs FLAGS_fleet_slo_p99_ms, utilisation watermarks,
+  brownout) with hysteresis + cooldown (autoscale.py);
+- `Scenario` / `Arrival` / `replay` — the seeded open-loop traffic
+  simulator every serving bench replays (workload.py);
 - `Server` / `http_front` — the user-facing shell (server.py);
   ``Server(model, replicas=2)`` serves through the fleet.
 
@@ -28,6 +35,7 @@ Everything runs and certifies on CPU (`JAX_PLATFORMS=cpu`) with
 thread-based clients; no network required.
 """
 
+from .autoscale import Autoscaler  # noqa: F401
 from .batcher import (  # noqa: F401
     DynamicBatcher, bucket_for, bucket_ladder, pad_batch,
 )
@@ -46,14 +54,16 @@ from .queueing import (  # noqa: F401
     ServingError,
 )
 from .server import Server, http_front  # noqa: F401
+from .workload import Arrival, Scenario, replay  # noqa: F401
 
 __all__ = [
-    "AdmissionQueue", "BlockAllocator", "BrownoutShedError",
+    "AdmissionQueue", "Arrival", "Autoscaler", "BlockAllocator",
+    "BrownoutShedError",
     "CapacityExhaustedError", "CircuitBreaker", "DeadlineExceededError",
     "DynamicBatcher", "NULL_BLOCK", "PoolExhausted", "PrefixCache",
     "QueueFullError", "Replica", "ReplicaDiedError", "ReplicaSet",
     "Request", "RequestCancelled", "RetriesExhaustedError", "Router",
-    "Server", "ServerClosedError", "ServingError", "ServingMetrics",
-    "SlotEngine", "bucket_for", "bucket_ladder", "http_front",
-    "pad_batch", "percentile", "retriable",
+    "Scenario", "Server", "ServerClosedError", "ServingError",
+    "ServingMetrics", "SlotEngine", "bucket_for", "bucket_ladder",
+    "http_front", "pad_batch", "percentile", "replay", "retriable",
 ]

@@ -38,12 +38,30 @@ availability toolkit:
   `priority` is below the floor with the retriable 429
   `BrownoutShedError`.
 
+- **Elastic membership.** `ReplicaSet.add_replica()` grows the fleet
+  under load: the new replica is visible in `starting` state (never
+  routed to) while its engine builds behind the same single-trace
+  restart path every rebuild uses, then turns healthy with
+  ``compile_counts == {"decode": 1, "cow": 1}``.
+  `remove_replica(name, drain=True)` shrinks it as drain-then-evict:
+  the victim turns `draining` (the Router stops picking it
+  immediately), finishes its in-flight and queued requests, and is
+  evicted by the watchdog once idle — so a scale-down loses and
+  duplicates nothing, certified by the same first-wins futures that
+  cover failover. A draining replica that dies mid-drain takes the
+  normal failover-replay path and is then dropped instead of
+  restarted. `serving/autoscale.py` drives both ends from the SLO
+  error budget.
+
 Chaos sites (framework/faults.py): ``serving.replica_step`` and
 ``serving.replica_heartbeat`` fire inside supervised engine loops
 (tagged with the replica name, so ``serving.replica_step[fleet.r0]``
 hangs exactly one replica), ``serving.route`` on every Router dispatch,
-``serving.replay`` on every failover replay. `faults.ChaosSchedule`
-certifies a scripted sweep actually delivered every planned fire.
+``serving.replay`` on every failover replay, ``serving.scale_up`` /
+``serving.scale_down`` on every membership change and ``serving.drain``
+on every drained-victim eviction attempt (all three tagged with the
+replica name). `faults.ChaosSchedule` certifies a scripted sweep
+actually delivered every planned fire.
 
 Threading/locking: one re-entrant Router lock guards flight state;
 engine done-callbacks run on engine threads and re-enter the Router
@@ -59,7 +77,7 @@ import random
 import threading
 import time
 
-from ..framework import faults
+from ..framework import faults, monitor
 from ..framework.flags import flag
 from .engine import SlotEngine
 from .metrics import ServingMetrics
@@ -72,9 +90,11 @@ from .queueing import (
 __all__ = ["CircuitBreaker", "Replica", "ReplicaSet", "Router", "retriable",
            "REPLICA_STATE_CODES"]
 
-#: numeric encodings for the per-replica state gauge (observe/export.py)
+#: numeric encodings for the per-replica state gauge (observe/export.py);
+#: "healthy" is the serving state, "draining" a scale-down victim
+#: finishing its in-flight work before eviction
 REPLICA_STATE_CODES = {"starting": 0, "healthy": 1, "dead": 2,
-                       "backoff": 3, "stopped": 4}
+                       "backoff": 3, "stopped": 4, "draining": 5}
 
 
 def retriable(error):
@@ -168,6 +188,8 @@ class Replica:
         self.load = 0             # router-visible in-flight attempts
         self.breaker = breaker
         self.restart_at = None    # monotonic time the backoff expires
+        self.built_at = None      # monotonic time the engine last built
+        self.drain_started = None  # monotonic time draining began
         # deterministic per-replica jitter stream (seeded on the name)
         self._rng = random.Random(name)
 
@@ -182,13 +204,28 @@ class Replica:
         e = self.engine
         return 0.0 if e is None else now - e.last_beat
 
+    def uptime(self, now):
+        return 0.0 if self.built_at is None else now - self.built_at
+
+    def idle(self):
+        """No router-visible attempts, no occupied slots, empty queue —
+        the drain-complete condition for a scale-down victim."""
+        e = self.engine
+        return (self.load == 0 and e is not None
+                and e.active == 0 and e.queue.depth == 0)
+
     def snapshot(self):
         e = self.engine
+        now = time.monotonic()
         return {
             "name": self.name, "state": self.state,
             "generation": self.generation, "deaths": self.deaths,
             "restarts": self.restarts, "load": self.load,
             "heartbeats": 0 if e is None else e.heartbeats,
+            "uptime_s": self.uptime(now),
+            "beat_age_s": self.beat_age(now),
+            "draining_s": (0.0 if self.drain_started is None
+                           else now - self.drain_started),
             "breaker": self.breaker.snapshot(),
         }
 
@@ -232,17 +269,27 @@ class ReplicaSet:
         self.liveness_timeout_s = liveness_timeout_s
         self.backoff_base_s = backoff_base_s
         self.backoff_max_s = backoff_max_s
+        self._breaker_kw = (breaker_threshold, breaker_cooloff_s,
+                            breaker_clock)
         self._warmup = warmup
         self.on_death = on_death
-        self.replicas = [
-            Replica(i, f"{name}.r{i}",
-                    CircuitBreaker(breaker_threshold, breaker_cooloff_s,
-                                   clock=breaker_clock))
-            for i in range(n_replicas)
-        ]
+        self.replicas = [self._new_replica() for _ in range(n_replicas)]
+        # chip-time ledger (chip-hours = replica-seconds / 3600): time
+        # already banked by evicted/removed engines; live engines add
+        # their current uptime in replica_seconds()
+        self._banked_replica_s = 0.0
         self._lock = threading.Lock()       # replica state transitions
         self._build_lock = threading.Lock()  # serialize traces
         self._started = False
+
+    def _new_replica(self):
+        """Allocate the next replica slot (monotonic index: names never
+        recycle across scale-downs, so per-replica tagged fault specs
+        and metrics labels stay unambiguous)."""
+        i = self._next_index = getattr(self, "_next_index", -1) + 1
+        threshold, cooloff_s, clock = self._breaker_kw
+        return Replica(i, f"{self.name}.r{i}",
+                       CircuitBreaker(threshold, cooloff_s, clock=clock))
 
     def start(self):
         if self._started:
@@ -265,14 +312,28 @@ class ReplicaSet:
             eng.start()
             replica.engine = eng
             replica.generation += 1
+            replica.built_at = time.monotonic()
             replica.state = "healthy"
             replica.restart_at = None
 
     def healthy(self):
         return [r for r in self.replicas if r.state == "healthy"]
 
+    def live_replicas(self):
+        """Replicas currently able to serve traffic (healthy; draining
+        ones still *hold* work but take no new routes)."""
+        return len(self.healthy())
+
+    def member_replicas(self):
+        """Fleet membership the autoscaler sizes against: every replica
+        that is serving or will serve again (starting/backoff/dead are
+        on their way back; draining/stopped are on their way out)."""
+        return sum(1 for r in self.replicas
+                   if r.state in ("starting", "healthy", "dead", "backoff"))
+
     def poll(self, now=None):
-        """One watchdog pass: detect crashes/hangs, run due restarts."""
+        """One watchdog pass: detect crashes/hangs, run due restarts,
+        evict scale-down victims that finished draining."""
         now = time.monotonic() if now is None else now
         for r in self.replicas:
             if r.state == "healthy":
@@ -284,17 +345,27 @@ class ReplicaSet:
                            f"(liveness timeout {self.liveness_timeout_s}s)")
             elif r.state == "backoff" and now >= (r.restart_at or 0):
                 self.restart(r)
+            elif r.state == "draining":
+                if not r.alive or r.beat_age(now) > self.liveness_timeout_s:
+                    # a victim dying mid-drain takes the normal failover
+                    # path (its in-flight work replays) and is dropped
+                    self.declare_dead(r, "died while draining")
+                elif r.idle():
+                    self._finish_drain(r)
 
     def declare_dead(self, replica, reason):
         """Evict one replica: failover hook first (the Router replays
         its in-flight requests while their old attempts are still
         pending — first-wins futures make the race safe), then abandon
-        the engine, then schedule the backed-off rebuild."""
+        the engine, then schedule the backed-off rebuild — or, for a
+        scale-down victim that died mid-drain, drop it for good."""
         with self._lock:
-            if replica.state != "healthy":
+            if replica.state not in ("healthy", "draining"):
                 return False
+            was_draining = replica.state == "draining"
             replica.state = "dead"
             replica.deaths += 1
+            self._bank_uptime(replica)
         self.metrics.inc("replica_deaths")
         err = ReplicaDiedError(f"replica {replica.name} declared dead: "
                                f"{reason}")
@@ -306,6 +377,9 @@ class ReplicaSet:
         old = replica.engine
         if old is not None:
             old.abandon(err)
+        if was_draining:
+            self._drop(replica)   # it was leaving anyway: no restart
+            return True
         with self._lock:
             backoff = min(self.backoff_base_s * (2 ** (replica.deaths - 1)),
                           self.backoff_max_s)
@@ -330,6 +404,115 @@ class ReplicaSet:
                 return r
         raise KeyError(f"no replica named {name!r}")
 
+    # -- elastic membership (scale events) ----------------------------------
+
+    def add_replica(self):
+        """Scale up by one replica. The newcomer is appended in
+        `starting` state — visible to snapshots but never to the
+        Router's `_pick` — then built behind the same single-trace
+        restart path every rebuild uses (serialized on `_build_lock`,
+        one fresh decode+cow trace), and only then turns healthy.
+        Blocking (the build traces); run it off the supervisor thread.
+        Fault site ``serving.scale_up`` fires before the build."""
+        with self._lock:
+            replica = self._new_replica()
+            self.replicas = self.replicas + [replica]
+        try:
+            faults.fault_point("serving.scale_up", tag=replica.name)
+            self._build(replica)
+        except Exception:
+            with self._lock:   # roll the membership change back
+                replica.state = "stopped"
+                self.replicas = [r for r in self.replicas
+                                 if r is not replica]
+            raise
+        self.metrics.inc("replicas_added")
+        monitor.stat_add("fleet.scale_events_up")
+        return replica
+
+    def remove_replica(self, name, drain=True):
+        """Scale down by one replica: drain-then-evict. The victim
+        turns `draining` immediately (the Router stops routing to it;
+        its queued + in-flight requests keep running) and the watchdog
+        evicts it once idle — zero requests lost, zero duplicated,
+        certified by the first-wins future machinery. ``drain=False``
+        evicts right now instead: in-flight requests take the failover
+        replay path. Fault site ``serving.scale_down`` fires before the
+        state flips. Returns the replica."""
+        victim = None
+        with self._lock:
+            for r in self.replicas:
+                if r.name == name:
+                    victim = r
+                    break
+            if victim is None:
+                raise KeyError(f"no replica named {name!r}")
+            if victim.state not in ("healthy", "starting"):
+                raise ValueError(
+                    f"cannot remove replica {name!r} in state "
+                    f"{victim.state!r}")
+            if self.live_replicas() <= 1 and victim.state == "healthy":
+                raise ValueError(
+                    "cannot remove the last healthy replica")
+        faults.fault_point("serving.scale_down", tag=victim.name)
+        with self._lock:
+            victim.state = "draining"
+            victim.drain_started = time.monotonic()
+        self.metrics.inc("drains_started")
+        monitor.stat_add("fleet.scale_events_down")
+        if not drain:
+            self.declare_dead(victim, "evicted (non-drain scale-down)")
+        return victim
+
+    def _finish_drain(self, replica):
+        """Evict one fully drained scale-down victim. The
+        ``serving.drain`` fault site fires per eviction attempt: a
+        `raise` leaves the replica draining (retried at the next poll),
+        a `delay` models slow teardown."""
+        try:
+            faults.fault_point("serving.drain", tag=replica.name)
+        except Exception:  # noqa: BLE001 — retry at the next poll
+            self.metrics.inc("drain_errors")
+            return False
+        with self._lock:
+            if replica.state != "draining":
+                return False
+            replica.state = "stopped"
+            self._bank_uptime(replica)
+        e = replica.engine
+        if e is not None:
+            try:
+                e.shutdown(drain=True, timeout=5.0)
+            except Exception:  # noqa: BLE001 — best-effort stop
+                pass
+        self._drop(replica)
+        self.metrics.inc("replicas_removed")
+        return True
+
+    def _drop(self, replica):
+        """Remove one replica from the membership list (atomic list
+        swap: concurrent iterations keep walking the old snapshot)."""
+        with self._lock:
+            replica.state = "stopped"
+            self.replicas = [r for r in self.replicas if r is not replica]
+
+    def _bank_uptime(self, replica):
+        """Move a replica's current engine uptime into the chip-time
+        ledger (caller holds `_lock`)."""
+        if replica.built_at is not None:
+            self._banked_replica_s += time.monotonic() - replica.built_at
+            replica.built_at = None
+
+    def replica_seconds(self, now=None):
+        """Cumulative engine-alive seconds across the fleet's life —
+        the chip-hours denominator bench_fleet.py reports (a replica
+        costs its chip whether busy or idle)."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            live = sum(now - r.built_at for r in self.replicas
+                       if r.built_at is not None)
+            return self._banked_replica_s + live
+
     def compile_counts(self):
         """{replica name: engine compile counters} — the fleet compile
         invariant is every engine at one decode + one cow trace."""
@@ -345,12 +528,22 @@ class ReplicaSet:
         return sum(r.engine.max_slots + r.engine.queue.cap
                    for r in self.healthy() if r.engine is not None)
 
+    def slot_capacity(self):
+        """Decode slots across healthy replicas — how many requests the
+        fleet can *run* right now, as opposed to merely queue."""
+        return sum(r.engine.max_slots
+                   for r in self.healthy() if r.engine is not None)
+
     def in_flight(self):
         return sum(r.engine.active + r.engine.queue.depth
                    for r in self.healthy() if r.engine is not None)
 
     def snapshot(self):
+        now = time.monotonic()
         return {"name": self.name,
+                "live_replicas": self.live_replicas(),
+                "member_replicas": self.member_replicas(),
+                "replica_seconds": self.replica_seconds(now),
                 "replicas": [r.snapshot() for r in self.replicas]}
 
     def shutdown(self, drain=True, timeout=None):
@@ -411,7 +604,8 @@ class Router:
                  breaker_threshold=5, breaker_cooloff_s=1.0,
                  breaker_clock=time.monotonic,
                  backoff_base_s=0.05, backoff_max_s=2.0,
-                 queue_cap=None, warmup=True, name="fleet"):
+                 queue_cap=None, warmup=True, name="fleet",
+                 autoscale=None):
         self.metrics = metrics if metrics is not None else ServingMetrics()
         self.replica_set = ReplicaSet(
             model, replicas, engine_kw=engine_kw, metrics=self.metrics,
@@ -442,6 +636,11 @@ class Router:
         self._stop = threading.Event()
         self._sup = None
         self._max_seq_len = None
+        # autoscale=None/False: fixed fleet. autoscale=True: defaults
+        # (flags). autoscale=dict: Autoscaler kwargs. Built in start()
+        # so tests can also attach one by hand before starting.
+        self._autoscale_spec = autoscale
+        self.autoscaler = None
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -450,6 +649,11 @@ class Router:
             return self
         self.replica_set.start()
         self._max_seq_len = self.replica_set.replicas[0].engine.max_seq_len
+        if self._autoscale_spec and self.autoscaler is None:
+            from .autoscale import Autoscaler
+            kw = (dict(self._autoscale_spec)
+                  if isinstance(self._autoscale_spec, dict) else {})
+            self.autoscaler = Autoscaler(self, **kw)
         self._stop.clear()
         self._sup = threading.Thread(target=self._supervise,
                                      name=f"{self.name}-supervisor",
@@ -469,6 +673,8 @@ class Router:
                     if not self._flights:
                         break
                 time.sleep(0.005)
+        if self.autoscaler is not None:
+            self.autoscaler.close()
         self._stop.set()
         if self._sup is not None:
             self._sup.join(timeout)
@@ -557,11 +763,19 @@ class Router:
     def kill(self, name, reason="killed (admin/chaos)"):
         return self.replica_set.kill(name, reason)
 
+    def add_replica(self):
+        return self.replica_set.add_replica()
+
+    def remove_replica(self, name, drain=True):
+        return self.replica_set.remove_replica(name, drain=drain)
+
     def snapshot(self):
         snap = self.replica_set.snapshot()
         snap["brownout"] = self.brownout_active
         with self._lock:
             snap["in_flight"] = len(self._flights)
+        if self.autoscaler is not None:
+            snap["autoscaler"] = self.autoscaler.snapshot()
         return snap
 
     # -- flight machinery ---------------------------------------------------
@@ -806,6 +1020,8 @@ class Router:
                 self._brownout_tick()
                 self._hedge_tick(now)
                 self._flight_tick(now)
+                if self.autoscaler is not None:
+                    self.autoscaler.tick(now)
             except Exception:  # noqa: BLE001 — the supervisor never dies
                 self.metrics.inc("supervisor_errors")
 

@@ -40,7 +40,10 @@ class ServingMetrics:
     `routed`, `retries`, `replays`, `hedges`, `hedge_wins`,
     `duplicates_suppressed`, `stale_attempts`, `parked`,
     `replica_deaths`, `replica_restarts`, `brownout_entries`,
-    `brownout_sheds`, `retry_budget_exhausted`, `supervisor_errors`.
+    `brownout_sheds`, `retry_budget_exhausted`, `supervisor_errors`,
+    and the elastic set: `replicas_added` / `replicas_removed` (scale
+    events that landed), `drains_started`, `drain_errors`,
+    `scale_failures` (autoscaler actions that raised).
     Every inc() also bumps the global `framework.monitor` counter
     ``serving.<name>`` so serving shows up in the same stat registry as
     the rest of the runtime.
@@ -92,10 +95,15 @@ class ServingMetrics:
             self._blk_n += 1
             self._blk_max = max(self._blk_max, frac)
 
-    def latency_percentiles(self, kind, ps=(50, 95, 99)):
-        """{p: seconds} over the recorded `kind` series."""
+    def latency_percentiles(self, kind, ps=(50, 95, 99), last=None):
+        """{p: seconds} over the recorded `kind` series. ``last``
+        restricts to the most recent N samples — the autoscaler's
+        sliding SLO window, so old congestion doesn't pin the signal
+        high after the fleet recovers."""
         with self._lock:
             series = list(self._latency.get(kind, ()))
+        if last is not None:
+            series = series[-int(last):]
         if not series:
             return {p: None for p in ps}
         return {p: percentile(series, p) for p in ps}

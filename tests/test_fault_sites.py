@@ -92,6 +92,17 @@ def test_every_registered_site_is_exercised_by_tier1_tests():
         f"fault sites with no tier-1 test coverage: {uncovered}")
 
 
+def test_scale_event_sites_are_registered():
+    """ISSUE 12: the elastic-fleet sites bench_fleet.py schedules chaos
+    against must stay registered, or its certification sweep degrades
+    to a clean run. (Behavioral coverage: test_fleet_scale.py.)"""
+    for site in ("serving.scale_up", "serving.scale_down",
+                 "serving.drain"):
+        assert site in faults.SITES, site
+        assert "replica" in faults.SITES[site] or \
+            "drain" in faults.SITES[site]
+
+
 # ---------------------------------------------------------------------------
 # direct coverage for the sites no other tier-1 test drives
 # ---------------------------------------------------------------------------
