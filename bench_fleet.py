@@ -33,9 +33,25 @@ asserts the acceptance bar: both clean legs at goodput 1.0, autoscaled
 strictly cheaper in chip-hours than static-peak, chaos goodput 1.0
 with the full schedule delivered.
 
+``--rollout`` swaps the legs for the zero-downtime rollout story
+(serving/rollout.py) over the same seeded surge against a fixed
+2-replica fleet: **rollout-upgrade** (a clean canary → wave → commit
+from a real checkpoint dir lands at goodput 1.0 with one fleet-wide
+version and compile-once rebuilds), **rollout-rollback** (weights
+corrupted after their golden digests freeze are caught bitwise by the
+canary gate and auto-rolled-back to a fleet bitwise-identical to
+pre-rollout, with chaos delaying the registry load —
+``serving.rollout_load`` — and failing the first rollback attempt —
+``serving.rollback``), and **rollout-chaos** (a replica killed
+mid-rollout — ``serving.canary`` dwell + a ``serving.replica_heartbeat``
+stall past the liveness timeout — replays its in-flight requests
+pinned to the weight version they were decoding on, and the rollout
+still commits).
+
 CPU smoke (the tier-1 case):
 
     JAX_PLATFORMS=cpu python bench_fleet.py --smoke
+    JAX_PLATFORMS=cpu python bench_fleet.py --rollout --smoke
 """
 
 from __future__ import annotations
@@ -107,10 +123,13 @@ def make_router(serving, model, args, name, autoscaled):
     return serving.Router(model, n, **kw).start()
 
 
-def run_leg(router, scenario, args, label):
+def run_leg(router, scenario, args, label, during=None):
     """Replay the scenario open-loop against one fleet; returns the
     result row. Exactly-once is certified per arrival: its future must
-    resolve exactly one time (zero lost, zero duplicated)."""
+    resolve exactly one time (zero lost, zero duplicated). `during` is
+    an optional thunk started alongside the replay (the rollout legs
+    drive a live upgrade through it) and joined before the row is cut;
+    its outcome lands in ``row["during"]``."""
     from paddle_tpu.serving import workload
 
     trace = scenario.trace()
@@ -137,6 +156,15 @@ def run_leg(router, scenario, args, label):
 
     sampler = _MemberSampler(rs).start()
     chip0 = rs.replica_seconds()
+    during_out, dthread = {}, None
+    if during is not None:
+        def _during():
+            try:
+                during_out["result"] = during()
+            except Exception as e:  # noqa: BLE001 — reported in the row
+                during_out["error"] = f"{type(e).__name__}: {e}"
+        dthread = threading.Thread(target=_during, daemon=True)
+        dthread.start()
     records = workload.replay(submit, trace,
                               time_scale=args.time_scale)
     shed = sum(1 for r in records if r["error"] is not None)
@@ -146,6 +174,10 @@ def run_leg(router, scenario, args, label):
                 r["future"].result(120.0)
             except Exception:  # noqa: BLE001 — typed failures count
                 pass
+    if dthread is not None:
+        dthread.join(240.0)
+        if dthread.is_alive():
+            during_out["error"] = "during-thunk still running"
     chip_s = rs.replica_seconds() - chip0
     wall = time.monotonic() - t0
     samples = sampler.stop()
@@ -202,6 +234,8 @@ def run_leg(router, scenario, args, label):
         "replays": router.metrics.get("replays"),
         "restarts": router.metrics.get("replica_restarts"),
     }
+    if during is not None:
+        row["during"] = during_out
     if args.timeline:
         row["members_timeline"] = samples
     return row
@@ -218,6 +252,201 @@ def wait_scaled_down(router, args, timeout=20.0):
             return True
         time.sleep(0.02)
     return False
+
+
+# ---------------------------------------------------------------------------
+# --rollout: zero-downtime model rollout under the traffic swing
+# ---------------------------------------------------------------------------
+
+
+def _perturbed_values(model, seed=13):
+    """v1 weights: every v0 leaf nudged by a deterministic gaussian —
+    same shapes/dtypes (no retrace), different greedy decodes."""
+    import jax.numpy as jnp
+    from paddle_tpu.engine import state_values
+
+    rng = np.random.RandomState(seed)
+    out = {}
+    for k, v in state_values(model).items():
+        a = np.asarray(v)
+        out[k] = jnp.asarray(a + rng.normal(0.0, 0.02, a.shape)
+                             .astype(a.dtype))
+    return out
+
+
+def rollout_legs(args, serving, faults, model, scenario):
+    """Three legs, each a rolling upgrade driven DURING the same seeded
+    surge (fixed 2-replica fleet, no autoscaler):
+
+    - **rollout-upgrade** — clean canary → wave → commit from a real
+      checkpoint dir; must land at goodput 1.0, zero lost/dup, one
+      fleet-wide version, compile-once after every rebuild.
+    - **rollout-rollback** — the new version's weights are corrupted
+      AFTER its golden digests freeze; the canary's bitwise gate
+      catches it and auto-rollback restores a single-version fleet
+      bitwise-identical to pre-rollout. Chaos delays the registry load
+      and fails the first rollback attempt (retried).
+    - **rollout-chaos** — a replica is killed mid-rollout; its
+      in-flight requests replay pinned to the weight version they were
+      decoding on, and the rollout still converges and commits.
+    """
+    import os
+    import tempfile
+
+    from paddle_tpu.distributed import checkpoint as ckpt
+    from paddle_tpu.serving.rollout import (
+        RolloutController, WeightRegistry)
+
+    tmpdir = tempfile.mkdtemp(prefix="bench-rollout-")
+    ckpt.CheckpointManager(tmpdir, max_to_keep=10).save(
+        1, _perturbed_values(model))
+    ckpt_dir = os.path.join(tmpdir, "ckpt-1")
+    probe = np.random.RandomState(5).randint(
+        0, args.vocab, (6,)).astype(np.int32)
+
+    def fleet(name, n=2, liveness_timeout_s=30.0):
+        return serving.Router(
+            model, n,
+            engine_kw=dict(max_slots=args.max_slots,
+                           max_seq_len=args.max_seq_len,
+                           block_size=args.block_size),
+            queue_cap=args.queue_cap, hedge=False, retry_budget=3,
+            liveness_timeout_s=liveness_timeout_s, backoff_base_s=0.05,
+            brownout_priority=0, name=name).start()
+
+    def controller(router, reg):
+        # generous SLO for the burn gate: these legs certify the
+        # bitwise/convergence story under surge; the SLO-gate teeth
+        # are unit-tested where latency is controllable
+        return RolloutController(router, reg, canary_secs=0.1,
+                                 wave_size=1, poll_s=0.005,
+                                 replica_timeout_s=120.0,
+                                 slo_p99_ms=60000.0)
+
+    def versions_after(router):
+        return sorted({r.engine.weight_version
+                       for r in router.replica_set.replicas
+                       if r.state == "healthy"})
+
+    # -- leg A: clean rollout mid-surge -------------------------------------
+    router = fleet("frollA")
+    reg = WeightRegistry(model)
+    wv1 = reg.load_dir(ckpt_dir)
+    ro = controller(router, reg)
+    ro.ensure_golden(wv1)
+    legA = run_leg(router, scenario, args, "rollout-upgrade",
+                   during=lambda: ro.roll_to(wv1.version))
+    legA["rollout_state"] = ro.state
+    legA["rollout_error"] = ro.error
+    legA["versions"] = versions_after(router)
+    router.shutdown(drain=True)
+    print(json.dumps(legA))
+
+    # -- leg B: corrupt canary -> bitwise auto-rollback under chaos ---------
+    router = fleet("frollB")
+    reg = WeightRegistry(model)
+    ro = controller(router, reg)
+    specs_b = [
+        "serving.rollout_load@1:delay:0.01",   # slow the registry load
+        "serving.rollback@1:raise",            # first rollback attempt
+    ]                                          # fails; it is retried
+    with faults.ChaosSchedule(*specs_b) as sched:
+        wv_bad = reg.load_dir(ckpt_dir)
+        ro.ensure_golden(wv_bad)               # digests freeze here...
+        emb = "gpt.embeddings.word_embeddings.weight"
+        import jax.numpy as jnp
+        # ...then the weights rot: roll the tied embedding's vocab rows
+        # (uniform shifts cancel in the tied head; a roll never does)
+        wv_bad.values[emb] = jnp.roll(wv_bad.values[emb], 1, axis=0)
+        pre = np.asarray(router.generate(probe, max_new_tokens=6,
+                                         timeout=60.0))
+        legB = run_leg(router, scenario, args, "rollout-rollback",
+                       during=lambda: ro.roll_to(wv_bad.version))
+        post = np.asarray(router.generate(probe, max_new_tokens=6,
+                                          timeout=60.0))
+        fired_b = sched.verify()
+    legB["chaos_fired"] = fired_b
+    legB["rollout_state"] = ro.state
+    legB["rollout_error"] = ro.error
+    legB["versions"] = versions_after(router)
+    legB["bitwise_restored"] = bool(pre.shape == post.shape
+                                    and (pre == post).all())
+    legB["rollback_retries"] = router.metrics.get("rollback_retries")
+    router.shutdown(drain=True)
+    print(json.dumps(legB))
+
+    # -- leg C: kill a replica mid-rollout (version-pinned replay) ----------
+    # 3 replicas so the pinned version stays reachable whatever the
+    # kill's timing: r1's in-flight replay onto a sibling still serving
+    # the SAME weight version (bitwise), while r1 itself backoff-
+    # restarts pinned to whatever the rollout had assigned it
+    router = fleet("frollC", n=3, liveness_timeout_s=0.5)
+    reg = WeightRegistry(model)
+    wv1c = reg.load_dir(ckpt_dir)
+    ro = controller(router, reg)
+    ro.ensure_golden(wv1c)
+    specs_c = [
+        "serving.canary@1:delay:0.02",         # dwell in the canary
+        "serving.replica_heartbeat[frollC.r1]@100:delay:1.0",
+    ]            # heartbeat stall past the liveness timeout = a kill
+    with faults.ChaosSchedule(*specs_c) as sched:
+        legC = run_leg(router, scenario, args, "rollout-chaos",
+                       during=lambda: ro.roll_to(wv1c.version))
+        fired_c = sched.verify()
+    legC["chaos_fired"] = fired_c
+    legC["rollout_state"] = ro.state
+    legC["rollout_error"] = ro.error
+    legC["versions"] = versions_after(router)
+    legC["replays_pinned"] = router.metrics.get("replays_pinned")
+    legC["deaths"] = router.metrics.get("replica_deaths")
+    router.shutdown(drain=True)
+    print(json.dumps(legC))
+
+    result = {
+        "bench": "BENCH_FLEET_ROLLOUT",
+        "scenario": scenario.to_dict(),
+        "config": {"replicas": 2, "max_slots": args.max_slots,
+                   "queue_cap": args.queue_cap,
+                   "time_scale": args.time_scale,
+                   "model": {"vocab": args.vocab, "hidden": args.hidden,
+                             "layers": args.layers, "heads": args.heads},
+                   "chaos_specs": {"rollback": specs_b,
+                                   "chaos": specs_c}},
+        "upgrade": legA, "rollback": legB, "chaos": legC,
+    }
+    print(json.dumps(result))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(result, f, indent=1, sort_keys=True)
+
+    if args.smoke:
+        for leg in (legA, legB, legC):
+            assert leg["lost"] == 0, f"{leg['leg']}: lost futures"
+            assert leg["duplicated"] == 0, \
+                f"{leg['leg']}: duplicated outcomes"
+            assert leg["goodput"] == 1.0, leg
+            assert leg["compiles_once"], \
+                f"{leg['leg']}: a rebuild retraced"
+            assert "error" not in leg["during"], leg["during"]
+        assert legA["rollout_state"] == "committed", legA
+        assert legA["during"].get("result") is True, legA["during"]
+        assert legA["versions"] == [1], legA
+        assert legB["rollout_state"] == "rolled_back", legB
+        assert legB["versions"] == [0], legB
+        assert legB["bitwise_restored"], \
+            "post-rollback decode is not bitwise pre-rollout"
+        assert legB["rollback_retries"] >= 1, legB
+        assert legB["chaos_fired"] == {"serving.rollout_load": 1,
+                                       "serving.rollback": 1}, legB
+        assert legC["rollout_state"] == "committed", legC
+        assert legC["versions"] == [1], legC
+        assert legC["chaos_fired"] == {
+            "serving.canary": 1, "serving.replica_heartbeat": 1}, legC
+        assert legC["deaths"] >= 1, "the stall never killed a replica"
+        assert legC["replays"] >= 1, "the kill never forced a replay"
+        assert legC["replays_pinned"] == legC["replays"], legC
+        print("SMOKE OK")
+    return 0
 
 
 def main(argv=None):
@@ -258,6 +487,11 @@ def main(argv=None):
                     help="write the final BENCH_FLEET object here")
     ap.add_argument("--no-chaos", action="store_true",
                     help="skip the chaos leg")
+    ap.add_argument("--rollout", action="store_true",
+                    help="run the zero-downtime rollout legs instead "
+                    "of the autoscale legs: a rolling weight upgrade, "
+                    "a bitwise auto-rollback, and a kill-mid-rollout "
+                    "driven during the same surge")
     ap.add_argument("--smoke", action="store_true",
                     help="tiny model + short trace + assert the "
                     "acceptance bar (tier-1 CPU case)")
@@ -273,6 +507,11 @@ def main(argv=None):
         args.max_new = "12,16"
         args.max_slots, args.max_replicas = 1, 3
         args.slo_ms, args.cooldown_s = 150.0, 0.4
+        if args.rollout:
+            # two slots per replica: the fleet dips to one serving
+            # replica while the other drains/rebuilds, and the surge
+            # must queue (never shed) through that window
+            args.max_slots = 2
 
     import paddle_tpu as paddle
     from paddle_tpu import serving
@@ -298,6 +537,9 @@ def main(argv=None):
             low_s=args.low_s, high_s=args.high_s, arrival=args.arrival,
             seed=args.seed, vocab=args.vocab, prompt_len=plen,
             max_new=mnew)
+
+    if args.rollout:
+        return rollout_legs(args, serving, faults, model, scenario)
 
     # -- leg 1: static fleet provisioned for the peak -----------------------
     router = make_router(serving, model, args, "fstatic",

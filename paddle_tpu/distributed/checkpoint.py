@@ -97,6 +97,12 @@ def _manifest_of(state):
     return out
 
 
+def leaf_digests(state):
+    """Flat ``name -> sha256`` view of the manifest for callers that
+    only want the checksums (serving.rollout's `WeightVersion`)."""
+    return {k: v["sha256"] for k, v in _manifest_of(state).items()}
+
+
 def load_manifest(path):
     p = os.path.join(os.path.abspath(path), MANIFEST_NAME)
     if not os.path.exists(p):
@@ -390,6 +396,16 @@ class CheckpointManager:
         return os.path.isdir(p) and (
             os.path.exists(os.path.join(p, MANIFEST_NAME))
             or os.path.exists(os.path.join(p, META_NAME)))
+
+    def is_readable(self, step):
+        """Public READABLE gate (serving.rollout's WeightRegistry and
+        its watch_dir poller key off this): True only for a committed
+        `ckpt-<step>` dir — staging `.tmp` dirs and torn writes never
+        qualify."""
+        return self._is_readable(step)
+
+    def readable_steps(self):
+        return [s for s in self.all_steps() if self._is_readable(s)]
 
     def save(self, step, state, *, metadata=None):
         meta = dict(metadata or {})

@@ -150,6 +150,9 @@ SITES = {
     "serving.scale_up": "each ReplicaSet.add_replica before the build",
     "serving.scale_down": "each ReplicaSet.remove_replica before drain",
     "serving.drain": "each drained-victim eviction attempt",
+    "serving.rollout_load": "each weight-registry checkpoint-dir load",
+    "serving.canary": "before the canary replica's gate evaluation",
+    "serving.rollback": "each rollout rollback attempt (tag = version)",
     "ps.push": "each PS mutation between WAL append and apply",
     "ps.pull": "each PS pull_dense/pull_sparse lookup",
     "ps.wal_append": "before each PS WAL record write",

@@ -130,6 +130,21 @@ _DEFS = {
         "fleet: the e2e latency SLO in milliseconds; the autoscaler "
         "treats windowed p99 above this as error-budget burn and "
         "accrues fleet.slo_violation_ms while it lasts"),
+    "FLAGS_rollout_canary_secs": (
+        2.0, float,
+        "rollout: how long the canary replica must hold the SLO burn "
+        "gate (windowed e2e p99 under FLAGS_fleet_slo_p99_ms) before "
+        "the staged waves start; also the default wave sustain period"),
+    "FLAGS_rollout_wave_size": (
+        1, int,
+        "rollout: replicas upgraded per wave after the canary passes; "
+        "within a wave replicas still drain->rebuild one at a time so "
+        "serving capacity never drops by more than one replica"),
+    "FLAGS_rollout_golden_prompts": (
+        4, int,
+        "rollout: number of pinned golden prompts synthesized (seeded, "
+        "deterministic) for the canary bitwise gate when the caller "
+        "does not supply an explicit prompt set"),
     "FLAGS_flight_recorder_capacity": (
         256, int,
         "observe: ring-buffer size of the always-on flight recorder "

@@ -182,6 +182,15 @@ _FLEET_METRICS = {
     "fleet.scale_events_down": (
         "paddle_fleet_scale_events_total", "counter",
         "fleet membership changes (labelled by direction)"),
+    "fleet.weight_version": (
+        "paddle_fleet_weight_version", "gauge",
+        "committed model weight version serving the fleet"),
+    "fleet.rollouts": (
+        "paddle_fleet_rollouts_total", "counter",
+        "rolling weight upgrades committed fleet-wide"),
+    "fleet.rollbacks": (
+        "paddle_fleet_rollbacks_total", "counter",
+        "rollouts auto-rolled-back (gate failure or operator abort)"),
 }
 #: fleet stats consumed by _FLEET_METRICS or converted inline — kept
 #: out of the generic (counter-typed) monitor dump
@@ -360,7 +369,14 @@ def prometheus_text(serving=None, queue_depth=None, fleet=None):
 
         breaker_codes = {"closed": 0, "open": 1, "half-open": 2}
         for rep in fleet.get("replicas", ()):
-            labels = {"replica": rep["name"]}
+            # model_version labels every per-replica series so a
+            # mid-rollout scrape shows exactly which replicas moved
+            labels = {"replica": rep["name"],
+                      "model_version": str(rep.get("weight_version", 0))}
+            L.add("paddle_serving_replica_model_version",
+                  rep.get("weight_version", 0), labels=labels,
+                  help_="weight version this replica serves (or is "
+                        "rebuilding toward)")
             L.add("paddle_serving_replica_state",
                   REPLICA_STATE_CODES.get(rep["state"], -1),
                   labels={**labels, "state": rep["state"]},

@@ -79,10 +79,13 @@ class RankingService:
                      else "deepfm")
         self.metrics = metrics
         self._sample_shape = None
-        # the dense tower is frozen at service build: online learning
-        # moves ONLY the sparse side (geo semantics), so the score trace
-        # can close over one immutable value set per service
+        # the dense tower's weights ride as a jit ARGUMENT of `_tower`
+        # (one immutable dict per version): online learning moves the
+        # sparse side in place, while `refresh_dense()` swaps the whole
+        # dict atomically at a version boundary — same shapes, same
+        # traces, no recompile
         self._values = dict(state_values(model))
+        self.dense_version = 0
         if self.kind == "deepfm":
             self._offsets = np.asarray(model._offsets, np.int64)
         self._tower = jax.jit(self._build_tower())
@@ -132,17 +135,53 @@ class RankingService:
     def _score_batch(self, x):
         x = np.asarray(x, np.int64)
         faults.fault_point("rec.score", x)
+        # read the dense dict ONCE: a concurrent refresh_dense swaps the
+        # reference, so every row of this flush scores on one version
+        values = self._values
         if self.kind == "widedeep":
             dnn_ids, lr_ids = x[:, 0, :], x[:, 1, :]
             deep = _pull_rows(self.model.deep_embedding, dnn_ids, "deep")
             wide = _pull_rows(self.model.wide_embedding, lr_ids, "wide")
-            return self._tower(self._values, jnp.asarray(deep),
+            return self._tower(values, jnp.asarray(deep),
                                jnp.asarray(wide))
         flat = x + self._offsets                           # [n, F]
         first = _pull_rows(self.model.first_order, flat, "first_order")
         emb = _pull_rows(self.model.embedding, flat, "embedding")
-        return self._tower(self._values, jnp.asarray(first),
+        return self._tower(values, jnp.asarray(first),
                            jnp.asarray(emb))
+
+    # -- live dense refresh --------------------------------------------------
+    def refresh_dense(self, state_dict, *, version=None):
+        """Swap the dense tower onto new weights at a version boundary.
+
+        `state_dict` maps parameter name -> array with the SAME keys,
+        shapes, and dtypes as the service's current values (extra sparse
+        / embedding entries from a full `state_values` dump are ignored)
+        — same shapes means the bucketed `rec.score` traces are reused
+        verbatim, so a refresh never recompiles. The swap is one dict
+        reference assignment: in-flight flushes finish on the version
+        they started with, the next flush scores on the new one.
+
+        Wire-up: ``registry.subscribe(lambda wv:
+        service.refresh_dense(wv.values, version=wv.version))`` refreshes
+        the tower at every rollout commit."""
+        current = self._values
+        fresh = {}
+        for k, old in current.items():
+            if k not in state_dict:
+                raise ValueError(f"refresh_dense missing parameter {k!r}")
+            v = state_dict[k]
+            v = v._value if hasattr(v, "_value") else jnp.asarray(v)
+            if tuple(v.shape) != tuple(old.shape) or v.dtype != old.dtype:
+                raise ValueError(
+                    f"refresh_dense shape/dtype drift on {k!r}: "
+                    f"{v.shape}/{v.dtype} != {old.shape}/{old.dtype} "
+                    "(a refresh must never retrace the tower)")
+            fresh[k] = v
+        self._values = fresh                    # the atomic boundary
+        self.dense_version = (int(version) if version is not None
+                              else self.dense_version + 1)
+        return self.dense_version
 
     # -- request plumbing ----------------------------------------------------
     def _payload(self, *ids):
@@ -232,6 +271,7 @@ class RankingService:
         out = {
             "kind": self.kind,
             "queue_depth": self.queue_depth,
+            "dense_version": self.dense_version,
             "compile_counts": dict(self.compile_counts),
             "score_compiles": len(observe.compile_events("rec.score")),
         }

@@ -26,6 +26,11 @@ iteration-level ("continuous") batching in the Orca lineage:
 - `Autoscaler` — grows/shrinks the fleet from the SLO error budget
   (windowed p99 vs FLAGS_fleet_slo_p99_ms, utilisation watermarks,
   brownout) with hysteresis + cooldown (autoscale.py);
+- `WeightRegistry` / `RolloutController` — zero-downtime model
+  rollout: versioned checkpoint ingestion with READABLE/checksum
+  gates, rolling canary upgrades through drain→rebuild, golden-prompt
+  bitwise + SLO burn gates, and auto-rollback to the pinned previous
+  version (rollout.py);
 - `Scenario` / `Arrival` / `replay` — the seeded open-loop traffic
   simulator every serving bench replays (workload.py);
 - `Server` / `http_front` — the user-facing shell (server.py);
@@ -51,8 +56,13 @@ from .queueing import (  # noqa: F401
     AdmissionQueue, BrownoutShedError, CapacityExhaustedError,
     DeadlineExceededError, QueueFullError, ReplicaDiedError, Request,
     RequestCancelled, RetriesExhaustedError, ServerClosedError,
-    ServingError,
+    ServingError, VersionRetiredError,
 )
+from .rollout import (  # noqa: F401
+    RolloutController, RolloutError, RolloutGateError, WeightRegistry,
+    WeightVersion, golden_digests,
+)
+from .autoscale import SLOWindow  # noqa: F401
 from .server import Server, http_front  # noqa: F401
 from .workload import Arrival, Scenario, replay  # noqa: F401
 
@@ -62,8 +72,11 @@ __all__ = [
     "CapacityExhaustedError", "CircuitBreaker", "DeadlineExceededError",
     "DynamicBatcher", "NULL_BLOCK", "PoolExhausted", "PrefixCache",
     "QueueFullError", "Replica", "ReplicaDiedError", "ReplicaSet",
-    "Request", "RequestCancelled", "RetriesExhaustedError", "Router",
-    "Scenario", "Server", "ServerClosedError", "ServingError",
-    "ServingMetrics", "SlotEngine", "bucket_for", "bucket_ladder",
-    "http_front", "pad_batch", "percentile", "replay", "retriable",
+    "Request", "RequestCancelled", "RetriesExhaustedError",
+    "RolloutController", "RolloutError", "RolloutGateError", "Router",
+    "SLOWindow", "Scenario", "Server", "ServerClosedError",
+    "ServingError", "ServingMetrics", "SlotEngine",
+    "VersionRetiredError", "WeightRegistry", "WeightVersion",
+    "bucket_for", "bucket_ladder", "golden_digests", "http_front",
+    "pad_batch", "percentile", "replay", "retriable",
 ]

@@ -47,7 +47,44 @@ import time
 from ..framework import monitor
 from ..framework.flags import flag
 
-__all__ = ["Autoscaler"]
+__all__ = ["Autoscaler", "SLOWindow"]
+
+
+class SLOWindow:
+    """Freshness-gated windowed e2e p99 — the autoscaler's staleness
+    rule factored out so the rollout canary/sustain SLO burn gate
+    reads the IDENTICAL signal the autoscaler scales on.
+
+    The percentile window is samples, not time: once traffic stops,
+    old congested samples would pin p99 high forever. A window with no
+    `fleet_completed` progress for `freshness_s` is stale — `p99_s()`
+    returns None (no traffic means no SLO burn).
+    """
+
+    def __init__(self, metrics, *, kind="e2e", window=64,
+                 freshness_s=5.0, counter="fleet_completed",
+                 clock=time.monotonic):
+        self.metrics = metrics
+        self.kind = kind
+        self.window = int(window)
+        self.freshness_s = float(freshness_s)
+        self.counter = counter
+        self._clock = clock
+        self._last = -1
+        self._last_t = None
+
+    def p99_s(self, now=None):
+        """Windowed p99 in seconds, or None while the window is stale
+        (no completions for `freshness_s`) or still empty."""
+        now = self._clock() if now is None else now
+        completed = self.metrics.get(self.counter)
+        if completed != self._last:
+            self._last = completed
+            self._last_t = now
+        if self._last_t is None or now - self._last_t >= self.freshness_s:
+            return None
+        return self.metrics.latency_percentiles(
+            self.kind, (99,), last=self.window)[99]
 
 
 class Autoscaler:
@@ -97,8 +134,9 @@ class Autoscaler:
         self._over_since = None       # overload onset (sustain gate)
         self._idle_since = None       # idleness onset (sustain gate)
         self._last_tick = None
-        self._last_completed = -1     # freshness of the p99 window
-        self._last_completed_t = None
+        # freshness-gated windowed p99 (shared with the rollout gate)
+        self._slo = SLOWindow(router.metrics, window=self.window,
+                              freshness_s=self.cooldown_s, clock=clock)
         self.target = None            # desired membership; set lazily
         self.violation_s = 0.0        # cumulative time over SLO
         self.decisions = {"up": 0, "down": 0}
@@ -108,20 +146,12 @@ class Autoscaler:
 
     def _signals(self, now):
         rs = self.router.replica_set
-        p99 = self.router.metrics.latency_percentiles(
-            "e2e", (99,), last=self.window)[99]
-        # the window is samples, not time: once traffic stops (or goes
-        # quiet) old congested samples would pin p99 high forever and
-        # wedge the fleet at peak size. A window with no completion for
-        # a full cooldown is stale — no traffic means no SLO burn.
-        completed = self.router.metrics.get("fleet_completed")
-        if completed != self._last_completed:
-            self._last_completed = completed
-            self._last_completed_t = now
-        fresh = (self._last_completed_t is not None
-                 and now - self._last_completed_t < self.cooldown_s)
-        over_slo = (fresh and p99 is not None
-                    and p99 * 1e3 > self.slo_p99_ms)
+        # freshness-gated windowed p99 (SLOWindow): a window with no
+        # completion for a full cooldown is stale and reads None — no
+        # traffic means no SLO burn, so a quiet fleet never wedges at
+        # peak size on old congested samples.
+        p99 = self._slo.p99_s(now)
+        over_slo = p99 is not None and p99 * 1e3 > self.slo_p99_ms
         util = rs.in_flight() / max(rs.capacity(), 1)
         # backlog pressure: outstanding Router futures per decode slot.
         # Unlike p99 (needs fresh completions) and util (diluted by the

@@ -106,7 +106,7 @@ class SlotEngine:
                  block_size=None, num_blocks=None, prefill_chunk=None,
                  prefix_cache=None, cache_dtype=None, metrics=None,
                  queue=None, strict_shapes=False, name=None,
-                 supervised=False):
+                 supervised=False, values=None, weight_version=0):
         import jax
         import jax.numpy as jnp
 
@@ -135,7 +135,13 @@ class SlotEngine:
         self.metrics = metrics if metrics is not None else ServingMetrics()
         self.queue = queue if queue is not None else AdmissionQueue(
             flag("FLAGS_serving_queue_cap"), metrics=self.metrics)
-        self._values = dict(state_values(model))
+        # weights are a jit ARGUMENT of the compiled step, not a trace
+        # constant: an engine rebuilt with same-shape `values` from a
+        # different weight version re-traces nothing beyond its own
+        # fresh compile-once warmup
+        self._values = dict(values) if values is not None \
+            else dict(state_values(model))
+        self.weight_version = int(weight_version)
         cfg = model.config
         hd = cfg.hidden_size // cfg.num_heads
         dtype = cache_dtype or jnp.float32

@@ -84,7 +84,7 @@ from .metrics import ServingMetrics
 from .queueing import (
     AdmissionQueue, BrownoutShedError, DeadlineExceededError, Request,
     RequestCancelled, ReplicaDiedError, RetriesExhaustedError, ServerClosedError,
-    ServingError,
+    ServingError, VersionRetiredError,
 )
 
 __all__ = ["CircuitBreaker", "Replica", "ReplicaSet", "Router", "retriable",
@@ -190,6 +190,12 @@ class Replica:
         self.restart_at = None    # monotonic time the backoff expires
         self.built_at = None      # monotonic time the engine last built
         self.drain_started = None  # monotonic time draining began
+        # rollout pinning: target_weights is the WeightVersion every
+        # (re)build of this replica must load (None = the model's own
+        # values, version 0); rebuild_to is set while the replica drains
+        # toward an upgrade/downgrade and survives a mid-drain crash
+        self.target_weights = None
+        self.rebuild_to = None
         # deterministic per-replica jitter stream (seeded on the name)
         self._rng = random.Random(name)
 
@@ -214,6 +220,19 @@ class Replica:
         return (self.load == 0 and e is not None
                 and e.active == 0 and e.queue.depth == 0)
 
+    @property
+    def weight_version(self):
+        """The weight version this replica serves (its live engine's)
+        or — with no live engine — the one its next build targets."""
+        if self.state in ("starting", "dead", "backoff"):
+            wv = self.rebuild_to or self.target_weights
+            if wv is not None:
+                return wv.version
+        if self.engine is not None:
+            return self.engine.weight_version
+        wv = self.rebuild_to or self.target_weights
+        return wv.version if wv is not None else 0
+
     def snapshot(self):
         e = self.engine
         now = time.monotonic()
@@ -221,6 +240,7 @@ class Replica:
             "name": self.name, "state": self.state,
             "generation": self.generation, "deaths": self.deaths,
             "restarts": self.restarts, "load": self.load,
+            "weight_version": self.weight_version,
             "heartbeats": 0 if e is None else e.heartbeats,
             "uptime_s": self.uptime(now),
             "beat_age_s": self.beat_age(now),
@@ -273,6 +293,10 @@ class ReplicaSet:
                             breaker_clock)
         self._warmup = warmup
         self.on_death = on_death
+        # committed WeightVersion newcomers build with (None = the
+        # model's own values, version 0); RolloutController.commit sets
+        # it via retarget() so scale-ups never resurrect an old version
+        self.default_weights = None
         self.replicas = [self._new_replica() for _ in range(n_replicas)]
         # chip-time ledger (chip-hours = replica-seconds / 3600): time
         # already banked by evicted/removed engines; live engines add
@@ -303,9 +327,16 @@ class ReplicaSet:
         """(Re)build one replica: fresh queue, fresh engine, fresh
         single trace. The replica turns healthy only once serving."""
         with self._build_lock:
+            wv = replica.rebuild_to or replica.target_weights \
+                or self.default_weights
+            if wv is not None:
+                replica.target_weights = wv
+                replica.rebuild_to = None
             q = AdmissionQueue(self.queue_cap, metrics=self.metrics)
             eng = SlotEngine(self.model, metrics=self.metrics, queue=q,
                              name=replica.name, supervised=True,
+                             values=None if wv is None else wv.values,
+                             weight_version=0 if wv is None else wv.version,
                              **self.engine_kw)
             if self._warmup:
                 eng.warmup()
@@ -348,10 +379,15 @@ class ReplicaSet:
             elif r.state == "draining":
                 if not r.alive or r.beat_age(now) > self.liveness_timeout_s:
                     # a victim dying mid-drain takes the normal failover
-                    # path (its in-flight work replays) and is dropped
+                    # path (its in-flight work replays) and is dropped —
+                    # unless it was draining toward a rebuild, in which
+                    # case declare_dead keeps it pinned to its target
                     self.declare_dead(r, "died while draining")
                 elif r.idle():
-                    self._finish_drain(r)
+                    if r.rebuild_to is not None:
+                        self._start_rebuild(r)
+                    else:
+                        self._finish_drain(r)
 
     def declare_dead(self, replica, reason):
         """Evict one replica: failover hook first (the Router replays
@@ -378,8 +414,15 @@ class ReplicaSet:
         if old is not None:
             old.abandon(err)
         if was_draining:
-            self._drop(replica)   # it was leaving anyway: no restart
-            return True
+            if replica.rebuild_to is None:
+                self._drop(replica)   # it was leaving anyway: no restart
+                return True
+            # died mid drain->rebuild: NOT a scale-down victim — keep
+            # it, pin the restart to the version the rollout assigned
+            # (a mid-wave crash must not drift the fleet's version map)
+            with self._lock:
+                replica.target_weights = replica.rebuild_to
+                replica.rebuild_to = None
         with self._lock:
             backoff = min(self.backoff_base_s * (2 ** (replica.deaths - 1)),
                           self.backoff_max_s)
@@ -489,6 +532,97 @@ class ReplicaSet:
         self.metrics.inc("replicas_removed")
         return True
 
+    # -- rolling upgrades (serving.rollout) ----------------------------------
+
+    def rebuild_replica(self, name, weights):
+        """Rolling-upgrade entry: mark one healthy replica draining
+        with a rebuild target. The Router stops routing to it, its
+        in-flight requests FINISH ON THE OLD WEIGHTS (no mid-sequence
+        version tear), and once idle the watchdog swaps in a fresh
+        engine built on `weights` (a `rollout.WeightVersion`) behind
+        the same single-trace `_build` path every restart uses."""
+        with self._lock:
+            victim = None
+            for r in self.replicas:
+                if r.name == name:
+                    victim = r
+                    break
+            if victim is None:
+                raise KeyError(f"no replica named {name!r}")
+            if victim.state != "healthy":
+                raise ValueError(
+                    f"cannot rebuild replica {name!r} in state "
+                    f"{victim.state!r}")
+            victim.state = "draining"
+            victim.drain_started = time.monotonic()
+            victim.rebuild_to = weights
+        self.metrics.inc("rollout_rebuilds")
+        return victim
+
+    def _start_rebuild(self, replica):
+        """A drained upgrade victim: retire its old engine and build
+        the replacement on the target weights, off the supervisor
+        thread (the build traces — blocking the watchdog would blind
+        the rest of the fleet's liveness checks)."""
+        with self._lock:
+            wv = replica.rebuild_to
+            if replica.state != "draining" or wv is None:
+                return False
+            replica.state = "starting"
+            replica.target_weights = wv
+            replica.rebuild_to = None
+            self._bank_uptime(replica)
+        old = replica.engine
+
+        def _swap():
+            if old is not None:
+                try:
+                    old.shutdown(drain=True, timeout=5.0)
+                except Exception:  # noqa: BLE001 — best-effort stop
+                    pass
+            try:
+                self._build(replica)
+                replica.breaker.record_success()
+                self.metrics.inc("rollout_rebuilds_done")
+            except Exception:  # noqa: BLE001 — retry via backoff
+                self.metrics.inc("supervisor_errors")
+                with self._lock:
+                    replica.deaths += 1
+                    replica.restart_at = (time.monotonic()
+                                          + self.backoff_base_s)
+                    replica.state = "backoff"
+
+        threading.Thread(target=_swap, name=f"{replica.name}-rollout",
+                         daemon=True).start()
+        return True
+
+    def versions_live(self):
+        """Every weight version some replica serves or will serve after
+        its pending (re)build — the Router's pinned-replay oracle: a
+        pin outside this set can never be satisfied again."""
+        out = set()
+        for r in self.replicas:
+            if r.state == "stopped":
+                continue
+            out.add(r.weight_version)
+            if r.rebuild_to is not None:
+                out.add(r.rebuild_to.version)
+        return out
+
+    def retarget(self, weights):
+        """Pin the whole membership (and every future member) to one
+        WeightVersion: rollout commit/abort calls this so backoff
+        restarts and scale-ups land on the surviving version, never on
+        one the registry retired."""
+        with self._lock:
+            self.default_weights = weights
+            for r in self.replicas:
+                if r.state == "stopped":
+                    continue
+                r.target_weights = weights
+                if r.rebuild_to is not None:
+                    r.rebuild_to = weights
+
     def _drop(self, replica):
         """Remove one replica from the membership list (atomic list
         swap: concurrent iterations keep walking the old snapshot)."""
@@ -564,7 +698,7 @@ class _Flight:
     __slots__ = ("client", "retries_left", "replays_left", "attempts",
                  "live", "stale", "hedge_ids", "hedged", "parked",
                  "first_dispatch", "last_dispatch", "retry_at",
-                 "retry_exclude")
+                 "retry_exclude", "versions", "pin")
 
     def __init__(self, client, retries, replays):
         self.client = client
@@ -580,6 +714,8 @@ class _Flight:
         self.last_dispatch = None
         self.retry_at = None       # deferred-retry due time
         self.retry_exclude = None
+        self.versions: dict = {}   # attempt id -> engine weight version
+        self.pin = None            # replay weight-version pin
 
     def active(self):
         return [aid for aid in self.live if aid not in self.stale]
@@ -641,6 +777,9 @@ class Router:
         # so tests can also attach one by hand before starting.
         self._autoscale_spec = autoscale
         self.autoscaler = None
+        # RolloutController attaches itself here (rollout.py), the same
+        # way the Autoscaler does; /v1/version reads through it
+        self.rollout = None
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -776,14 +915,40 @@ class Router:
             snap["in_flight"] = len(self._flights)
         if self.autoscaler is not None:
             snap["autoscaler"] = self.autoscaler.snapshot()
+        if self.rollout is not None:
+            snap["rollout"] = self.rollout.snapshot()
         return snap
+
+    def version_info(self):
+        """Rollout-facing view (GET /v1/version): per-replica weight
+        versions, the versions still live in the fleet, and — when a
+        RolloutController is attached — registry current/previous plus
+        the rollout state machine."""
+        rs = self.replica_set
+        per = {r.name: r.weight_version for r in rs.replicas
+               if r.state != "stopped"}
+        live = sorted(rs.versions_live())
+        info = {"replicas": per, "versions_live": live,
+                "state": "static", "target": None, "previous": None,
+                "error": None, "current": max(live) if live else 0}
+        ro = self.rollout
+        if ro is not None:
+            info.update(current=ro.registry.current,
+                        previous=ro.registry.previous,
+                        state=ro.state, target=ro.target, error=ro.error)
+        return info
 
     # -- flight machinery ---------------------------------------------------
 
-    def _dispatch(self, flight, exclude=frozenset(), hedge=False):
+    def _dispatch(self, flight, exclude=frozenset(), hedge=False,
+                  version=None):
         """Place one attempt. With `hedge` the exclusion is strict (no
         point hedging onto the replica already working the request);
-        otherwise a lone excluded replica is better than parking."""
+        otherwise a lone excluded replica is better than parking.
+        `version` (or the flight's replay pin) restricts placement to
+        replicas serving that exact weight version — a replay or hedge
+        must stay bitwise against its original attempt, never silently
+        decode on different weights mid-rollout."""
         with self._lock:
             client = flight.client
             if client.done():
@@ -803,14 +968,27 @@ class Router:
             except Exception as e:  # noqa: BLE001 — routing failure
                 self._route_failed(flight, e)
                 return
-            replica = self._pick(exclude)
+            pin = version if version is not None else flight.pin
+            replica = self._pick(exclude, version=pin)
             if replica is None:
                 if hedge:
                     flight.hedged = False   # retry the hedge next tick
                     return
                 if exclude:
-                    replica = self._pick(frozenset())
+                    replica = self._pick(frozenset(), version=pin)
                 if replica is None:
+                    if pin is not None and \
+                            pin not in self.replica_set.versions_live():
+                        # the pinned version is gone for good (rollout
+                        # retired it): replaying on different weights
+                        # would break bitwise semantics — fail retriable
+                        self.metrics.inc("version_retired_failures")
+                        self._finish_fail(flight, VersionRetiredError(
+                            f"request {client.id} is pinned to weight "
+                            f"version {pin}, which no replica serves or "
+                            "targets any more (retired by rollout); "
+                            "resubmit to decode on the current version"))
+                        return
                     if not flight.active():
                         flight.parked = True
                         self.metrics.inc("parked")
@@ -832,6 +1010,7 @@ class Router:
                 self._finish_fail(flight, e)
                 return
             flight.attempts[attempt.id] = (replica, attempt)
+            flight.versions[attempt.id] = replica.engine.weight_version
             flight.live.add(attempt.id)
             if hedge:
                 flight.hedge_ids.add(attempt.id)
@@ -844,14 +1023,18 @@ class Router:
             self.metrics.inc("routed")
             attempt.add_done_callback(self._attempt_done_cb)
 
-    def _pick(self, exclude):
+    def _pick(self, exclude, version=None):
         """Deterministic replica choice: a breaker awaiting its
         half-open probe goes first (lowest index — otherwise an open
         breaker could starve forever behind healthy siblings), else the
         least-loaded replica with a closed breaker (ties to the lowest
-        index)."""
+        index). `version` restricts to replicas serving that exact
+        weight version (pinned replays/hedges mid-rollout)."""
         candidates = [r for r in self.replica_set.replicas
-                      if r.state == "healthy" and r not in exclude]
+                      if r.state == "healthy" and r not in exclude
+                      and (version is None or (
+                          r.engine is not None
+                          and r.engine.weight_version == version))]
         for r in candidates:
             if r.breaker.state != "closed" and r.breaker.probe_ready() \
                     and r.breaker.allow():
@@ -892,6 +1075,7 @@ class Router:
             if flight is None:
                 return
             replica, _ = flight.attempts.get(attempt.id, (None, None))
+            att_version = flight.versions.get(attempt.id)
             if replica is not None:
                 replica.load = max(replica.load - 1, 0)
             was_stale = attempt.id in flight.stale
@@ -915,9 +1099,9 @@ class Router:
             if replica is not None and not isinstance(
                     err, (RequestCancelled, DeadlineExceededError)):
                 replica.breaker.record_failure()
-            self._attempt_failed(flight, replica, err)
+            self._attempt_failed(flight, replica, err, version=att_version)
 
-    def _attempt_failed(self, flight, replica, err):
+    def _attempt_failed(self, flight, replica, err, version=None):
         if flight.client.done():
             return
         if flight.active():
@@ -925,7 +1109,7 @@ class Router:
             # rather than charging the request's budgets
             return
         if isinstance(err, ReplicaDiedError):
-            self._replay(flight, replica, err)
+            self._replay(flight, replica, err, version=version)
             return
         if retriable(err) and flight.retries_left > 0:
             flight.retries_left -= 1
@@ -941,15 +1125,22 @@ class Router:
                 f"retry budget: {err}", last_error=err)
         self._finish_fail(flight, err)
 
-    def _replay(self, flight, replica, err):
+    def _replay(self, flight, replica, err, version=None):
         """Failover: re-run a dead replica's request from its original
         prompt on a healthy sibling. Charged to the replay budget, not
-        the retry budget."""
+        the retry budget. The replay is PINNED to the weight version
+        the dead attempt decoded on: same version stays bitwise; a
+        retired version fails retriable (`VersionRetiredError`) rather
+        than silently re-decoding on different weights."""
         if flight.replays_left <= 0:
             self._finish_fail(flight, err)
             return
         flight.replays_left -= 1
         self.metrics.inc("replays")
+        if flight.pin is None and version is not None:
+            flight.pin = version
+        if flight.pin is not None:
+            self.metrics.inc("replays_pinned")
         try:
             faults.fault_point("serving.replay")
         except Exception as e:  # noqa: BLE001 — replay path failure
@@ -975,13 +1166,14 @@ class Router:
                     if flight not in (f for f, _ in affected):
                         affected.append((flight, aid))
             seen = set()
-            for flight, _aid in affected:
+            for flight, aid in affected:
                 if id(flight) in seen:
                     continue
                 seen.add(id(flight))
                 if flight.client.done():
                     continue
-                self._replay(flight, replica, err)
+                self._replay(flight, replica, err,
+                             version=flight.versions.get(aid))
 
     def _finish_ok(self, flight, value):
         if flight.client._complete(value):
@@ -1066,7 +1258,10 @@ class Router:
                 flight.hedged = True
                 exclude = frozenset(flight.attempts[aid][0]
                                     for aid in active)
-                self._dispatch(flight, exclude, hedge=True)
+                # hedge on the SAME weight version as the active
+                # attempt: first-wins between the pair stays bitwise
+                self._dispatch(flight, exclude, hedge=True,
+                               version=flight.versions.get(active[0]))
 
     def _flight_tick(self, now):
         """Deferred retries, parked re-dispatch, deadline sweep."""

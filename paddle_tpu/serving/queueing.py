@@ -90,6 +90,18 @@ class ReplicaDiedError(ServingError):
     retriable = True
 
 
+class VersionRetiredError(ServingError):
+    """A failover replay was pinned to the weight version its original
+    attempt decoded on, but no replica serves (or will rebuild to) that
+    version any more — the rollout retired it. Replaying on different
+    weights would silently break bitwise first-wins semantics, so the
+    request fails retriable instead: the client resubmits and decodes
+    cleanly on the current version."""
+
+    status = 503
+    retriable = True
+
+
 class RetriesExhaustedError(ServingError):
     """A retriable failure outlived the request's retry budget; the
     final underlying error rides along as ``last_error``."""

@@ -101,6 +101,23 @@ class Server:
         self._started = False
 
     @classmethod
+    def from_router(cls, router):
+        """Wrap an already-built (and possibly already-started) fleet
+        Router so `http_front` / `version_info()` / `snapshot()` serve
+        it — the rollout tests drive a Router directly and still want
+        the HTTP surface. The wrapper shares the Router's metrics and
+        never owns lifecycle beyond forwarding start/shutdown."""
+        srv = cls.__new__(cls)
+        srv.mode = "generate"
+        srv.metrics = router.metrics
+        srv._warmup = False
+        srv.router = router
+        srv.engine = None
+        srv.batcher = None
+        srv._started = router._sup is not None
+        return srv
+
+    @classmethod
     def from_predictor(cls, predictor, **kw):
         """Batch-mode server over an inference.Predictor's loaded
         program (shares its weights; the exported program manages its
@@ -190,6 +207,25 @@ class Server:
             snap["fleet"] = self.router.snapshot()
         return snap
 
+    def version_info(self):
+        """Model-version view: current/previous version ids, rollout
+        state, and the per-replica version map (`GET /v1/version` over
+        `http_front` returns exactly this). Fleet mode delegates to the
+        Router (which folds in an attached `RolloutController`); a
+        single-engine server is always `static` on its build version."""
+        if self.router is not None:
+            return self.router.version_info()
+        if self.engine is not None:
+            return {"current": self.engine.weight_version,
+                    "previous": None, "target": None,
+                    "state": "static", "error": None,
+                    "versions_live": [self.engine.weight_version],
+                    "replicas": {self.engine.name:
+                                 self.engine.weight_version}}
+        return {"current": 0, "previous": None, "target": None,
+                "state": "static", "error": None,
+                "versions_live": [], "replicas": {}}
+
     def metrics_json(self, **kw):
         return json.dumps(self.snapshot(), **kw)
 
@@ -211,9 +247,11 @@ def http_front(server: Server = None, host="127.0.0.1", port=0, *,
     """Optional stdlib front door (bonus deliverable — the in-process
     API above is the contract). POST /v1/generate with a JSON body
     ``{"prompt": [ids...], "max_new_tokens": n, ...}`` returns
-    ``{"ids": [...]}``; GET /metrics returns the snapshot. Serving
-    errors map to their HTTP status (429 shed, 504 deadline, ...), with
-    a ``Retry-After`` backoff hint on 429/503.
+    ``{"ids": [...]}``; GET /metrics returns the snapshot and
+    GET /v1/version the model-version view (current/previous ids,
+    rollout state, per-replica version map). Serving errors map to
+    their HTTP status (429 shed, 504 deadline, 503 version retired,
+    ...), with a ``Retry-After`` backoff hint on 429/503.
 
     Pass ``ranker=`` (a `rec.RankingService`) to also serve
     POST /v1/rank: ``{"dnn_ids": [...], "lr_ids": [...]}`` (wide&deep)
@@ -281,6 +319,8 @@ def http_front(server: Server = None, host="127.0.0.1", port=0, *,
                     self._reply_text(200, metrics_src.metrics_prometheus())
                 else:
                     self._reply(200, metrics_src.snapshot())
+            elif path == "/v1/version" and server is not None:
+                self._reply(200, server.version_info())
             else:
                 self._reply(404, {"error": "not found"})
 

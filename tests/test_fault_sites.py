@@ -103,6 +103,17 @@ def test_scale_event_sites_are_registered():
             "drain" in faults.SITES[site]
 
 
+def test_rollout_sites_are_registered():
+    """ISSUE 13: the model-rollout sites bench_fleet.py --rollout
+    schedules chaos against must stay registered, or its certification
+    legs degrade to clean runs. (Behavioral coverage: test_rollout.py.)"""
+    for site, hint in (("serving.rollout_load", "load"),
+                       ("serving.canary", "canary"),
+                       ("serving.rollback", "rollback")):
+        assert site in faults.SITES, site
+        assert hint in faults.SITES[site]
+
+
 # ---------------------------------------------------------------------------
 # direct coverage for the sites no other tier-1 test drives
 # ---------------------------------------------------------------------------

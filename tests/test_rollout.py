@@ -1,0 +1,464 @@
+"""Zero-downtime model rollout (ISSUE 13): the versioned weight
+registry (READABLE/checksum ingestion gates, monotonic ids, watch-dir
+pickup), rolling canary upgrades with the bitwise golden gate and
+auto-rollback, version-pinned failover replay (same version stays
+bitwise; a retired pin fails retriable with a 503), the recommender
+dense-tower refresh at a commit boundary, and the /v1/version surface.
+"""
+
+import json
+import os
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import observe, rec
+from paddle_tpu.distributed import checkpoint as ckpt
+from paddle_tpu.engine import state_values
+from paddle_tpu.framework import faults, monitor
+from paddle_tpu.nlp.transformers import GPTConfig, GPTForPretraining
+from paddle_tpu.serving import Router, Server, http_front
+from paddle_tpu.serving.autoscale import SLOWindow
+from paddle_tpu.serving.queueing import VersionRetiredError
+from paddle_tpu.serving.rollout import (
+    RolloutController, RolloutError, WeightRegistry, WeightVersion,
+    _digest_ids,
+)
+
+VOCAB = 61
+
+
+@pytest.fixture(scope="module")
+def gpt():
+    paddle.seed(23)
+    cfg = GPTConfig(vocab_size=VOCAB, hidden_size=32, num_layers=2,
+                    num_heads=4, max_seq_len=64, dropout=0.0,
+                    attn_dropout=0.0, use_parallel=False)
+    m = GPTForPretraining(cfg)
+    m.eval()
+    return m
+
+
+def _perturbed(model, seed, scale=0.05):
+    """Same shapes/dtypes, different greedy decodes."""
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(seed)
+    return {k: jnp.asarray(np.asarray(v)
+                           + rng.normal(0.0, scale, np.shape(v))
+                           .astype(np.asarray(v).dtype))
+            for k, v in state_values(model).items()}
+
+
+def _prompt(seed, n=6):
+    return np.random.RandomState(seed).randint(
+        1, VOCAB, (n,)).astype(np.int32)
+
+
+# ---------------------------------------------------------------------------
+# WeightRegistry: checkpoint ingestion, integrity gates, watch-dir
+# ---------------------------------------------------------------------------
+
+
+def test_registry_ingests_committed_checkpoint(tmp_path, gpt):
+    vals = _perturbed(gpt, 1)
+    mgr = ckpt.CheckpointManager(str(tmp_path), max_to_keep=10)
+    mgr.save(2, vals)
+
+    reg = WeightRegistry(gpt)
+    assert reg.current == 0 and reg.latest() == 0
+    wv = reg.load_dir(str(tmp_path / "ckpt-2"), version=2)
+    assert wv.version == 2 and reg.latest() == 2
+    assert reg.current == 0            # ingestion is not activation
+    # bitwise roundtrip: the restored leaves hash exactly like the
+    # saved ones, and the manifest carries the on-disk digests
+    assert wv.manifest == ckpt.leaf_digests(vals)
+    assert wv.manifest == ckpt.leaf_digests(wv.values)
+
+    # version ids only ever grow — from load_dir and from add() alike
+    with pytest.raises(ValueError, match="monotonic"):
+        reg.load_dir(str(tmp_path / "ckpt-2"), version=2)
+    with pytest.raises(ValueError, match="monotonic"):
+        reg.load_dir(str(tmp_path / "ckpt-2"), version=1)
+    with pytest.raises(ValueError, match="monotonic"):
+        reg.add(WeightVersion(2, vals))
+    # without an explicit id the next one is allocated past high-water
+    assert reg.load_dir(str(tmp_path / "ckpt-2")).version == 3
+
+
+def test_registry_rejects_torn_and_tampered_dirs(tmp_path, gpt):
+    """ISSUE 13 satellite 4: a torn (uncommitted) dir and a
+    checksum-tampered dir are both rejected AT THE REGISTRY — the
+    fleet-visible version set never changes."""
+    reg = WeightRegistry(gpt)
+    before = reg.snapshot()
+    fails0 = monitor.stat_get("fleet.rollout_load_failures")
+
+    # torn write: a directory that never got its manifest/metadata
+    torn = tmp_path / "ckpt-3"
+    torn.mkdir()
+    (torn / "array_data").write_bytes(b"\x00" * 64)
+    with pytest.raises(ValueError, match="not a committed checkpoint"):
+        reg.load_dir(str(torn))
+
+    # checksum tamper: flip one leaf's recorded sha256
+    mgr = ckpt.CheckpointManager(str(tmp_path), max_to_keep=10)
+    mgr.save(4, _perturbed(gpt, 2))
+    man_path = tmp_path / "ckpt-4" / ckpt.MANIFEST_NAME
+    man = json.loads(man_path.read_text())
+    leaf = sorted(man)[0]
+    man[leaf]["sha256"] = "0" * 64
+    man_path.write_text(json.dumps(man))
+    with pytest.raises(ValueError):
+        reg.load_dir(str(tmp_path / "ckpt-4"))
+
+    # chaos at the load itself (serving.rollout_load) — same guarantee
+    mgr.save(5, _perturbed(gpt, 3))
+    with faults.ChaosSchedule("serving.rollout_load@1:raise") as ch:
+        with pytest.raises(faults.FaultError):
+            reg.load_dir(str(tmp_path / "ckpt-5"))
+        ch.verify()
+
+    assert reg.snapshot() == before
+    assert monitor.stat_get("fleet.rollout_load_failures") >= fails0 + 2
+    # the dir itself was fine: once the fault clears it loads
+    assert reg.load_dir(str(tmp_path / "ckpt-5"), version=5).version == 5
+
+
+def test_registry_watch_picks_up_committed_dirs_only(tmp_path, gpt):
+    mgr = ckpt.CheckpointManager(str(tmp_path), max_to_keep=10)
+    mgr.save(1, _perturbed(gpt, 4))
+    # a staging dir (torn/in-flight write) must be invisible
+    staging = tmp_path / "ckpt-2.tmp"
+    staging.mkdir()
+    (staging / "junk").write_bytes(b"x")
+    # a committed-looking dir with a tampered checksum is skipped for
+    # good (never re-tried, never registered)
+    mgr.save(3, _perturbed(gpt, 5))
+    man_path = tmp_path / "ckpt-3" / ckpt.MANIFEST_NAME
+    man = json.loads(man_path.read_text())
+    man[sorted(man)[0]]["sha256"] = "f" * 64
+    man_path.write_text(json.dumps(man))
+
+    reg = WeightRegistry(gpt)
+    seen = []
+    found = reg.poll_dir(mgr, on_version=lambda wv: seen.append(wv.version))
+    assert [wv.version for wv in found] == [1]
+    assert seen == [1]
+    assert reg.poll_dir(mgr) == []       # bad dir is not re-tried
+    assert sorted(reg.versions) == [0, 1]
+
+    # the background watcher picks up the next commit
+    reg.watch(str(tmp_path), poll_s=0.01)
+    try:
+        mgr.save(6, _perturbed(gpt, 6))
+        deadline = time.monotonic() + 10.0
+        while reg.latest() != 6 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert reg.latest() == 6
+    finally:
+        reg.stop_watch()
+
+
+def test_slo_window_freshness_gating():
+    """The rollout SLO gate reads the autoscaler's exact signal: a
+    window with no completion progress for freshness_s is stale and
+    reports no burn."""
+    class _M:
+        completed = 0
+        def get(self, name):
+            return self.completed
+        def latency_percentiles(self, kind, ps, last=None):
+            return {p: 0.5 for p in ps}
+
+    m = _M()
+    now = [100.0]
+    w = SLOWindow(m, freshness_s=2.0, clock=lambda: now[0])
+    assert w.p99_s() == 0.5              # first observation is fresh
+    now[0] += 1.9
+    assert w.p99_s() == 0.5              # within the freshness window
+    now[0] += 0.2                        # stale: no progress for 2.1s
+    assert w.p99_s() is None
+    m.completed = 4                      # progress again -> fresh
+    assert w.p99_s() == 0.5
+
+
+# ---------------------------------------------------------------------------
+# the live fleet: rolling upgrade, bitwise rollback, pinned replay
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def fleet(gpt):
+    router = Router(gpt, replicas=2,
+                    engine_kw=dict(max_slots=2, block_size=8),
+                    hedge=False, retry_budget=3, liveness_timeout_s=30.0,
+                    backoff_base_s=0.02, name="ro").start()
+    yield router
+    router.shutdown(drain=True)
+
+
+@pytest.fixture(scope="module")
+def rollout(fleet, gpt):
+    reg = WeightRegistry(gpt)
+    ro = RolloutController(fleet, reg, canary_secs=0.05, wave_size=1,
+                           poll_s=0.005, replica_timeout_s=120.0,
+                           slo_p99_ms=60000.0)
+    return reg, ro
+
+
+def _healthy_versions(router):
+    return {r.engine.weight_version for r in router.replica_set.replicas
+            if r.state == "healthy"}
+
+
+def test_rolling_upgrade_commits_under_traffic(fleet, gpt, rollout):
+    reg, ro = rollout
+    wv1 = reg.add(WeightVersion(1, _perturbed(gpt, 7)))
+
+    stop = threading.Event()
+    errs = []
+
+    def traffic():
+        i = 0
+        while not stop.is_set():
+            try:
+                fleet.generate(_prompt(100 + i % 5), max_new_tokens=4,
+                               timeout=60.0)
+            except Exception as e:  # noqa: BLE001 — certified below
+                errs.append(e)
+            i += 1
+
+    t = threading.Thread(target=traffic, daemon=True)
+    t.start()
+    try:
+        assert ro.roll_to(1) is True, ro.error
+    finally:
+        stop.set()
+        t.join(30.0)
+
+    assert not errs, errs[:3]
+    assert ro.state == "committed"
+    assert _healthy_versions(fleet) == {1}
+    assert reg.current == 1 and reg.previous is None
+    assert 0 in reg.retired
+    assert monitor.stat_get("fleet.weight_version") == 1
+    # every rebuilt engine re-certified compile-once
+    for r in fleet.replica_set.replicas:
+        assert r.engine.compile_counts == {"decode": 1, "cow": 1}
+    # the committed fleet serves the new weights BITWISE: a golden
+    # prompt decoded through the router hashes to the precomputed
+    # eager-reference digest of the new checkpoint
+    p0 = ro._prompts()[0]
+    out = fleet.generate(list(p0), max_new_tokens=ro.golden_max_new,
+                         timeout=60.0)
+    assert _digest_ids(out) == wv1.golden["p0"]
+    info = fleet.version_info()
+    assert info["current"] == 1 and info["state"] == "committed"
+    assert set(info["replicas"].values()) == {1}
+
+
+def test_canary_gate_failure_rolls_back_bitwise(fleet, gpt, rollout):
+    """A fault at the canary gate (serving.canary) auto-rolls-back; the
+    first rollback attempt itself faults (serving.rollback) and is
+    retried; the fleet ends single-version and bitwise-identical to
+    pre-rollout."""
+    reg, ro = rollout
+    rollbacks0 = monitor.stat_get("fleet.rollbacks")
+    wv2 = reg.add(WeightVersion(2, _perturbed(gpt, 8)))
+
+    probe = _prompt(42)
+    pre = np.asarray(fleet.generate(probe, max_new_tokens=6, timeout=60.0))
+    with faults.ChaosSchedule("serving.canary@1:raise",
+                              "serving.rollback@1:raise") as ch:
+        assert ro.roll_to(2) is False
+        ch.verify()
+
+    assert ro.state == "rolled_back"
+    assert "FaultError" in ro.error
+    assert fleet.metrics.get("rollback_retries") >= 1
+    assert monitor.stat_get("fleet.rollbacks") == rollbacks0 + 1
+    assert _healthy_versions(fleet) == {1}
+    assert reg.current == 1
+    assert 2 in reg.retired              # a failed target never returns
+    post = np.asarray(fleet.generate(probe, max_new_tokens=6,
+                                     timeout=60.0))
+    np.testing.assert_array_equal(pre, post)
+    with pytest.raises(KeyError):
+        reg.get(2)
+    # rollback() without a rollout in progress is a typed error
+    with pytest.raises(RolloutError, match="no rollout in progress"):
+        ro.rollback()
+
+
+def test_replay_is_version_pinned_and_retired_pin_fails(fleet, rollout):
+    """ISSUE 13 satellite 2 + tentpole correctness: a dead replica's
+    in-flight requests replay pinned to the weight version the original
+    attempt decoded on — a sibling on the same version serves them
+    bitwise; a pin nobody serves any more fails retriable (503)."""
+    reg, ro = rollout
+    rs = fleet.replica_set
+    assert _healthy_versions(fleet) == {1}
+
+    # pin positive: kill a replica with in-flight work; the survivor
+    # serves the same version, so the replay completes on v1
+    pinned0 = fleet.metrics.get("replays_pinned")
+    futs = [fleet.submit(_prompt(200 + i), max_new_tokens=12,
+                         timeout=60.0) for i in range(6)]
+    victim = next(r for r in rs.replicas if r.load > 0)
+    fleet.kill(victim.name)
+    outs = [np.asarray(f.result(60.0)) for f in futs]
+    assert len(outs) == 6
+    assert fleet.metrics.get("replays_pinned") > pinned0
+    assert fleet.metrics.get("replays_pinned") == \
+        fleet.metrics.get("replays")
+    # the restarted replica comes back ON THE COMMITTED VERSION (its
+    # rebuild target was pinned by the rollout's retarget at commit)
+    deadline = time.monotonic() + 60.0
+    while time.monotonic() < deadline:
+        if victim.state == "healthy" \
+                and victim.engine.weight_version == 1:
+            break
+        time.sleep(0.01)
+    assert victim.state == "healthy"
+    assert victim.engine.weight_version == 1
+
+    # retired pin: a replay pinned to a version no replica serves (or
+    # will rebuild to) fails with the typed retriable 503
+    retired0 = fleet.metrics.get("version_retired_failures")
+    fut = fleet.submit(_prompt(300), max_new_tokens=40, timeout=60.0)
+    with fleet._lock:
+        flight = fleet._flights[fut.id]
+        flight.pin = 0                   # v0 was retired at commit
+        victim = next(rep for rep, _ in flight.attempts.values())
+    assert 0 not in rs.versions_live()
+    fleet.kill(victim.name)
+    with pytest.raises(VersionRetiredError) as ei:
+        fut.result(60.0)
+    assert ei.value.status == 503
+    assert ei.value.retriable is True
+    assert fleet.metrics.get("version_retired_failures") == retired0 + 1
+
+    # let the fleet settle for the tests behind us
+    deadline = time.monotonic() + 60.0
+    while time.monotonic() < deadline:
+        if len(rs.healthy()) == 2:
+            break
+        time.sleep(0.01)
+    assert len(rs.healthy()) == 2
+
+
+def test_version_endpoint_and_model_version_metrics(fleet, rollout):
+    """ISSUE 13 satellite 3: GET /v1/version over http_front and the
+    model_version label on the per-replica Prometheus gauges."""
+    srv = Server.from_router(fleet)
+    snap = srv.snapshot()
+    assert all(rep["weight_version"] == 1
+               for rep in snap["fleet"]["replicas"])
+    assert snap["fleet"]["rollout"]["registry"]["current"] == 1
+
+    text = srv.metrics_prometheus()
+    assert "paddle_serving_replica_model_version" in text
+    assert 'model_version="1"' in text
+    assert "paddle_fleet_weight_version 1" in text
+    assert "paddle_fleet_rollouts_total" in text
+    assert "paddle_fleet_rollbacks_total" in text
+
+    try:
+        httpd = http_front(srv, port=0)
+    except OSError as e:
+        pytest.skip(f"cannot bind loopback: {e}")
+    try:
+        port = httpd.server_address[1]
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/v1/version", timeout=10) as r:
+            info = json.loads(r.read())
+        assert info["current"] == 1
+        assert info["state"] in ("committed", "rolled_back")
+        assert set(info["replicas"]) == {"ro.r0", "ro.r1"}
+        assert set(info["replicas"].values()) == {1}
+        assert info["versions_live"] == [1]
+    finally:
+        httpd.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# rec: dense-tower refresh at the commit boundary (no recompile)
+# ---------------------------------------------------------------------------
+
+
+def test_rec_refresh_dense_at_version_boundary_no_recompile():
+    """ISSUE 13 satellite 1: the RankingService dense tower refreshes
+    from a registry commit — scores move, `rec.score` never retraces,
+    and shape/key drift is rejected."""
+    paddle.seed(31)
+    model = rec.WideDeepCTR(64, 64, embed_dim=4, dnn_dims=(8,))
+    svc = rec.RankingService(model, max_batch=4, max_wait_s=0.001)
+    zero = np.zeros(3, np.int64)
+    svc.warmup(zero, zero)
+    svc.start()
+    try:
+        ids = np.arange(3, dtype=np.int64)
+        s0 = svc.rank(ids, ids, timeout=30.0)
+        compiles0 = len(observe.compile_events("rec.score"))
+        assert svc.dense_version == 0
+
+        # the rollout wiring: refresh on every registry commit
+        reg = WeightRegistry(template=state_values(model))
+        reg.subscribe(lambda wv: svc.refresh_dense(wv.values,
+                                                   version=wv.version))
+        fresh = {k: np.asarray(v) * 1.5
+                 for k, v in state_values(model).items()}
+        reg.add(WeightVersion(7, fresh))
+        reg.begin(7)
+        reg.commit(7)
+
+        assert svc.dense_version == 7
+        assert svc.snapshot()["dense_version"] == 7
+        s1 = svc.rank(ids, ids, timeout=30.0)
+        assert s1 != s0                  # the tower moved...
+        assert len(observe.compile_events("rec.score")) == compiles0
+        # ...and a same-shape re-refresh is bitwise deterministic
+        svc.refresh_dense(fresh)
+        assert svc.dense_version == 8    # version=None -> monotonic bump
+        assert svc.rank(ids, ids, timeout=30.0) == s1
+
+        # drift is rejected before the swap (the trace must never
+        # re-specialise)
+        bad = dict(fresh)
+        bad.pop(sorted(bad)[0])
+        with pytest.raises(ValueError, match="missing parameter"):
+            svc.refresh_dense(bad)
+        wrong = {k: (np.zeros((2, 2), np.float32)
+                     if k == sorted(fresh)[0] else v)
+                 for k, v in fresh.items()}
+        with pytest.raises(ValueError, match="drift"):
+            svc.refresh_dense(wrong)
+        assert svc.dense_version == 8    # failed refreshes change nothing
+    finally:
+        svc.close()
+
+
+# ---------------------------------------------------------------------------
+# bench subprocess smoke (slow tier)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_bench_fleet_rollout_smoke():
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PADDLE_TPU_FAULTS", None)
+    r = subprocess.run(
+        [sys.executable, os.path.join(repo, "bench_fleet.py"),
+         "--rollout", "--smoke"],
+        cwd=repo, env=env, capture_output=True, text=True, timeout=570)
+    assert r.returncode == 0, r.stderr[-4000:]
+    assert "SMOKE OK" in r.stdout
