@@ -1,0 +1,286 @@
+"""Gang-supervised training chaos certification bench (ISSUE 14).
+
+Runs a real N-process CPU gang (separate python processes under a
+GangSupervisor, gradients averaged cross-rank over the p2p mailbox,
+checkpoints globally committed through GangCheckpointManager's commit
+barrier) through four legs:
+
+  clean   uninterrupted run -> the reference loss trajectory
+  kill    SIGKILL one rank MID-COLLECTIVE (its peer is blocked inside
+          the all-reduce); the survivor unblocks via its
+          FLAGS_dist_timeout_s deadline with a typed retriable error,
+          the supervisor tears the gang down and restarts it from the
+          newest globally committed step
+  hang    one rank goes silent (alive, no heartbeat/step progress); the
+          supervisor's watermark stall detector restarts the gang
+  chaos   scripted fault sweep inside every rank (delayed collectives /
+          barriers / p2p, a dropped heartbeat) over a clean completion;
+          each rank certifies fired == planned from its own counters
+
+Every recovering leg must reproduce the clean run's per-step loss
+trajectory BITWISE (last execution of each step wins), and every leg
+must complete every planned step (goodput 1.0). Prints one BENCH_GANG
+JSON line; ``--smoke`` shrinks the step counts and asserts the gates.
+
+Worker mode (internal): ``python bench_gang.py --worker <out_dir>`` is
+what the supervisor spawns per rank.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+import tempfile
+import time
+
+WORLD = 2
+LR = 0.05
+CKPT_EVERY = 3
+
+#: per-rank chaos sweep for the chaos leg (PADDLE_TPU_FAULTS in every
+#: worker) and the per-rank fired plan it must deliver exactly
+CHAOS_SPECS = ("dist.allreduce@3:delay:0.05;"
+               "dist.barrier@2:delay:0.02;"
+               "dist.p2p_send@4:delay:0.02;"
+               "dist.p2p_recv@6:delay:0.02;"
+               "gang.heartbeat@2:drop")
+CHAOS_PLAN = {"faults.dist.allreduce": 1, "faults.dist.barrier": 1,
+              "faults.dist.p2p_send": 1, "faults.dist.p2p_recv": 1,
+              "faults.gang.heartbeat": 1}
+
+
+# ---------------------------------------------------------------------------
+# worker (one rank)
+# ---------------------------------------------------------------------------
+
+
+def _batch(rank, step):
+    import numpy as np
+
+    rng = np.random.RandomState(1000 + 97 * step + rank)
+    return rng.randn(8, 4), rng.randn(8)
+
+
+def worker(out_dir):
+    import numpy as np
+
+    from paddle_tpu.distributed import preempt
+    from paddle_tpu.distributed.checkpoint import GangCheckpointManager
+    from paddle_tpu.distributed.gang import (
+        CollectiveTimeoutError, GangWorker, PeerGoneError, allreduce_host)
+    from paddle_tpu.framework import monitor
+
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+    world = int(os.environ["PADDLE_TRAINERS_NUM"])
+    attempt = int(os.environ.get("PADDLE_GANG_ATTEMPT", "1"))
+    steps = int(os.environ.get("GANG_BENCH_STEPS", "12"))
+    kill_rank = int(os.environ.get("GANG_BENCH_KILL_RANK", "-1"))
+    kill_step = int(os.environ.get("GANG_BENCH_KILL_STEP", "-1"))
+    hang_rank = int(os.environ.get("GANG_BENCH_HANG_RANK", "-1"))
+    hang_step = int(os.environ.get("GANG_BENCH_HANG_STEP", "-1"))
+
+    preempt.install()  # SIGTERM defers: blocked collectives hit their
+    # deadline and exit typed instead of dying silent mid-teardown
+    gw = GangWorker()
+    mgr = GangCheckpointManager(os.path.join(out_dir, "ckpt"), rank,
+                                world)
+    w = np.linspace(0.1, 0.4, 4)
+    start = 0
+    if mgr.latest_committed_step() is not None:
+        got_step, st = mgr.restore({"w": w})
+        w, start = np.asarray(st["w"]), got_step + 1
+    lossf = open(os.path.join(out_dir, f"losses.r{rank}.log"), "a")
+    try:
+        for step in range(start, steps):
+            gw.beat(step=step)
+            if rank == hang_rank and step == hang_step and attempt == 1:
+                while True:  # alive but silent: the stall-detector leg
+                    time.sleep(0.5)
+            if rank == kill_rank and step == kill_step and attempt == 1:
+                time.sleep(0.3)  # let the peer block inside the
+                os.kill(os.getpid(), signal.SIGKILL)  # collective first
+            x, y = _batch(rank, step)
+            err = x @ w - y
+            g = (2.0 / len(y)) * (x.T @ err)
+            g = allreduce_host(g, "mean", rank=rank, world=world)
+            w = w - LR * g
+            loss = allreduce_host(np.asarray(np.mean(err * err)),
+                                  "mean", rank=rank, world=world)
+            if rank == 0:
+                lossf.write(f"{step} {float(loss).hex()}\n")
+                lossf.flush()
+            if (step + 1) % CKPT_EVERY == 0:
+                mgr.save(step, {"w": w})
+    except (CollectiveTimeoutError, PeerGoneError) as e:
+        # the acceptance-criterion moment: a peer died mid-collective
+        # and this rank UNBLOCKED via its deadline with a typed error
+        with open(os.path.join(out_dir, f"typed.r{rank}.log"), "a") as f:
+            f.write(f"{type(e).__name__}\n")
+        sys.exit(13)
+    with open(os.path.join(out_dir, f"faults.r{rank}.a{attempt}.json"),
+              "w") as f:
+        json.dump(monitor.stats("faults."), f)
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# legs (supervisor side)
+# ---------------------------------------------------------------------------
+
+
+def _losses(out_dir):
+    """step -> loss hex, LAST execution of each step wins (re-executed
+    steps after a restore must overwrite identically for bitwise)."""
+    out = {}
+    path = os.path.join(out_dir, "losses.r0.log")
+    if os.path.exists(path):
+        for line in open(path):
+            step, hexval = line.split()
+            out[int(step)] = hexval
+    return out
+
+
+def run_leg(name, steps, *, kill=None, hang=None, chaos=False):
+    from paddle_tpu.distributed.gang import GangSupervisor
+    from paddle_tpu.framework import monitor
+
+    out = tempfile.mkdtemp(prefix=f"paddle-gang-{name}-")
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "GANG_BENCH_STEPS": str(steps),
+        # hang leg: worker deadlines far ABOVE the supervisor's stall
+        # threshold so the restart is attributed by the watermark
+        # detector, not a collective timeout racing it
+        "FLAGS_dist_timeout_s": "30.0" if hang else "1.0",
+    })
+    if kill:
+        env["GANG_BENCH_KILL_RANK"] = str(kill[0])
+        env["GANG_BENCH_KILL_STEP"] = str(kill[1])
+    if hang:
+        env["GANG_BENCH_HANG_RANK"] = str(hang[0])
+        env["GANG_BENCH_HANG_STEP"] = str(hang[1])
+    if chaos:
+        env["PADDLE_TPU_FAULTS"] = CHAOS_SPECS
+    sup = GangSupervisor(
+        [sys.executable, "-u", os.path.abspath(__file__), "--worker",
+         out],
+        WORLD, gang_dir=os.path.join(out, "gang"),
+        max_restarts=2, hang_secs=2.0 if hang else 0.0,
+        grace_s=6.0, poll_interval=0.05, backoff_base_s=0.05,
+        backoff_max_s=0.1, base_env=env,
+        log_dir=os.path.join(out, "logs"))
+    lost0 = monitor.stat_get("gang.restart_lost_ms")
+    t0 = time.perf_counter()
+    code = sup.run()
+    wall_s = time.perf_counter() - t0
+    if code != 0:
+        for slot in range(WORLD):
+            p = os.path.join(out, "logs", f"workerlog.{slot}")
+            if os.path.exists(p):
+                sys.stderr.write(open(p).read()[-2000:])
+        raise SystemExit(f"gang leg {name!r} failed with code {code}")
+    return {
+        "out": out,
+        "losses": _losses(out),
+        "wall_s": wall_s,
+        "restarts": sup.restarts,
+        "restart_lost_s":
+            (monitor.stat_get("gang.restart_lost_ms") - lost0) / 1e3,
+    }
+
+
+def _typed_errors(out_dir):
+    names = []
+    for slot in range(WORLD):
+        p = os.path.join(out_dir, f"typed.r{slot}.log")
+        if os.path.exists(p):
+            names += open(p).read().split()
+    return names
+
+
+def _chaos_fired(out_dir):
+    """Per-rank fired counters from the workers' exit dumps."""
+    fired = {}
+    for slot in range(WORLD):
+        p = os.path.join(out_dir, f"faults.r{slot}.a1.json")
+        with open(p) as f:
+            fired[slot] = {k: v for k, v in json.load(f).items()
+                           if k in CHAOS_PLAN}
+    return fired
+
+
+def main():
+    smoke = "--smoke" in sys.argv
+    if "--worker" in sys.argv:
+        sys.exit(worker(sys.argv[sys.argv.index("--worker") + 1]))
+
+    from paddle_tpu.framework import faults
+
+    steps = 8 if smoke else 12
+    kill_at, hang_at = (4, 3) if smoke else (7, 4)
+
+    clean = run_leg("clean", steps)
+    assert len(clean["losses"]) == steps, clean["losses"]
+
+    # the SIGKILL-mid-collective leg also certifies the supervisor-side
+    # gang.restart site fired exactly as planned
+    with faults.ChaosSchedule("gang.restart@1:delay:0.01") as ch:
+        kill = run_leg("kill", steps, kill=(1, kill_at))
+        restart_fired = ch.verify()
+    hang = run_leg("hang", steps, hang=(1, hang_at))
+    chaos = run_leg("chaos", steps, chaos=True)
+
+    bitwise_kill = kill["losses"] == clean["losses"]
+    bitwise_hang = hang["losses"] == clean["losses"]
+    bitwise_chaos = chaos["losses"] == clean["losses"]
+    typed = _typed_errors(kill["out"])
+    chaos_fired = _chaos_fired(chaos["out"])
+    fired_equals_planned = all(
+        rankfired.get(k, 0) == want
+        for rankfired in chaos_fired.values()
+        for k, want in CHAOS_PLAN.items()) and \
+        restart_fired.get("gang.restart") == 1
+    # goodput: every planned step completed on every leg despite chaos
+    goodput = min(len(leg["losses"]) for leg in
+                  (clean, kill, hang, chaos)) / steps
+
+    out = {
+        "metric": "gang_chaos_certification",
+        "value": goodput,
+        "unit": "goodput_steps_completed",
+        "bitwise_equal_kill": bitwise_kill,
+        "bitwise_equal_hang": bitwise_hang,
+        "bitwise_equal_chaos": bitwise_chaos,
+        "typed_errors_kill": typed,
+        "restarts": {"kill": kill["restarts"], "hang": hang["restarts"]},
+        "recovery_s": {"kill": round(kill["restart_lost_s"], 3),
+                       "hang": round(hang["restart_lost_s"], 3)},
+        "fired_equals_planned": fired_equals_planned,
+        "chaos_fired_per_rank": {str(k): v
+                                 for k, v in chaos_fired.items()},
+        "clean_wall_s": round(clean["wall_s"], 3),
+        "world": WORLD, "steps": steps,
+    }
+    print("BENCH_GANG " + json.dumps(out))
+
+    failures = []
+    if not (bitwise_kill and bitwise_hang and bitwise_chaos):
+        failures.append("loss trajectory diverged from the clean run")
+    if goodput != 1.0:
+        failures.append(f"steps lost: goodput {goodput}")
+    if not fired_equals_planned:
+        failures.append(f"chaos under-delivered: {chaos_fired}")
+    if kill["restarts"] != 1 or hang["restarts"] != 1:
+        failures.append(f"unexpected restart counts {out['restarts']}")
+    if not any(n in ("PeerGoneError", "CollectiveTimeoutError")
+               for n in typed):
+        failures.append("survivor never raised a typed deadline error")
+    if failures:
+        raise SystemExit("; ".join(failures))
+
+
+if __name__ == "__main__":
+    main()

@@ -37,6 +37,7 @@ import hashlib
 import json
 import os
 import shutil
+import time
 
 import jax
 import numpy as np
@@ -563,6 +564,236 @@ class AsyncCheckpointManager(CheckpointManager):
     def close(self):
         self.wait_until_finished()
         self._executor.shutdown(wait=True)
+
+
+# ---------------------------------------------------------------------------
+# gang-consistent checkpoints (commit barrier + cross-rank digest)
+# ---------------------------------------------------------------------------
+
+
+def _combined_digest(digests):
+    """One sha256 over sorted per-leaf (or per-rank) digests — the
+    cross-rank state fingerprint the GANG marker records."""
+    h = hashlib.sha256()
+    for k in sorted(digests):
+        h.update(f"{k}:{digests[k]}\n".encode())
+    return h.hexdigest()
+
+
+class GangCheckpointManager:
+    """Numbered checkpoints with a GLOBAL commit barrier.
+
+    Per-rank CheckpointManagers alone are not enough for gang restart:
+    rank 0 may have committed step 40 while rank 1 died at step 39, and
+    restoring 'everyone's newest local step' silently resumes a world
+    that never existed. This manager makes the commit gang-atomic:
+
+    - each rank saves into ``<dir>/rank-<r>/ckpt-<step>`` (the usual
+      atomic per-rank commit) and then drops a per-rank commit marker
+      ``<dir>/commits/s<step>.r<rank>.json`` recording its state digest;
+    - rank 0 waits for every rank's marker and atomically writes
+      ``s<step>.GANG.json`` with the full ``{rank: digest}`` map and a
+      combined cross-rank digest; non-zero ranks wait for that marker —
+      this wait is the **commit barrier**, deadline-scoped via the
+      ``dist.barrier`` fault site (FLAGS_dist_timeout_s);
+    - a step is READABLE for resume only when the GANG marker exists
+      *and* the local shard is readable; `restore_engine` restores the
+      newest such step, remaps ranks when the world re-formed within
+      [min_np, max_np] (``src = rank % marker_world``), and cross-checks
+      the restored state's digest against what the marker recorded.
+
+    A rank SIGKILLed between its local commit and the barrier leaves no
+    GANG marker, so every survivor resumes from the previous committed
+    step — globally consistent by construction.
+    """
+
+    def __init__(self, directory, rank, world, *, max_to_keep=3,
+                 barrier_timeout_s=None, poll_interval=0.02):
+        self.directory = os.path.abspath(directory)
+        self.rank = int(rank)
+        self.world = int(world)
+        self.local = CheckpointManager(
+            os.path.join(self.directory, f"rank-{self.rank}"),
+            max_to_keep=max_to_keep)
+        self.commits = os.path.join(self.directory, "commits")
+        os.makedirs(self.commits, exist_ok=True)
+        self.barrier_timeout_s = barrier_timeout_s
+        self.poll_interval = poll_interval
+
+    # -- marker paths -------------------------------------------------------
+
+    def _rank_marker(self, step, rank):
+        return os.path.join(self.commits, f"s{step}.r{rank}.json")
+
+    def _gang_marker(self, step):
+        return os.path.join(self.commits, f"s{step}.GANG.json")
+
+    @staticmethod
+    def _read_json(path):
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None  # absent or torn mid-write: not committed
+
+    @staticmethod
+    def _write_json(path, rec):
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(rec, f)
+        os.replace(tmp, path)
+
+    # -- save + commit barrier ----------------------------------------------
+
+    def save(self, step, state, *, metadata=None):
+        """Atomic local save, then the gang commit barrier. Returns only
+        once the step is GLOBALLY committed (every rank wrote and rank 0
+        published the GANG marker) — or raises CollectiveTimeoutError,
+        leaving the step uncommitted everywhere."""
+        self.local.save(step, state, metadata=metadata)
+        self._commit(step, _combined_digest(leaf_digests(state)))
+
+    def save_engine(self, step, engine):
+        self.local.save_engine(step, engine)
+        state, _ = _engine_payload(engine)
+        self._commit(step, _combined_digest(leaf_digests(state)))
+
+    def _commit(self, step, digest):
+        from .gang import CollectiveTimeoutError, deadline_guard
+
+        self._write_json(self._rank_marker(step, self.rank),
+                         {"rank": self.rank, "digest": digest,
+                          "ts": time.time()})
+        remaining = deadline_guard("dist.barrier", self.barrier_timeout_s,
+                                   tag="gang-commit")
+        end = None if remaining is None \
+            else time.monotonic() + remaining
+
+        def _expired(what):
+            if end is not None and time.monotonic() > end:
+                _monitor.stat_add("gang.collective_timeouts")
+                raise CollectiveTimeoutError(
+                    f"gang checkpoint commit barrier for step {step} "
+                    f"timed out waiting for {what} (deadline "
+                    f"{remaining:.3f}s) — a peer died before commit; "
+                    "the step stays uncommitted and resume falls back "
+                    "to the previous GANG-committed step")
+
+        if self.rank == 0:
+            digests = {}
+            for r in range(self.world):
+                while True:
+                    rec = self._read_json(self._rank_marker(step, r))
+                    if rec is not None:
+                        digests[str(r)] = rec["digest"]
+                        break
+                    _expired(f"rank {r}'s commit marker")
+                    time.sleep(self.poll_interval)
+            self._write_json(self._gang_marker(step), {
+                "step": int(step), "world": self.world,
+                "digests": digests,
+                "digest": _combined_digest(digests),
+                "ts": time.time()})
+        else:
+            while self._read_json(self._gang_marker(step)) is None:
+                _expired("rank 0's GANG marker")
+                time.sleep(self.poll_interval)
+        _monitor.stat_add("gang.commits")
+
+    # -- globally committed view --------------------------------------------
+
+    def _shard_readable(self, step, marker):
+        """Is the shard THIS rank would restore from readable? For a
+        rank of the committing world that is its own local shard; a
+        rank joining a re-formed (grown) world has no local shard and
+        checks its cyclically-mapped source rank's instead."""
+        src = self._src_rank(marker)
+        if src == self.rank:
+            return self.local.is_readable(step)
+        shard = os.path.join(self.directory, f"rank-{src}",
+                             f"ckpt-{step}")
+        return os.path.isdir(shard) and (
+            os.path.exists(os.path.join(shard, MANIFEST_NAME))
+            or os.path.exists(os.path.join(shard, META_NAME)))
+
+    def committed_steps(self):
+        """Steps with a GANG marker AND a readable source shard for
+        this rank — the only steps resume may use."""
+        out = []
+        for name in os.listdir(self.commits):
+            if name.endswith(".GANG.json") and name.startswith("s"):
+                try:
+                    step = int(name[1:].split(".", 1)[0])
+                except ValueError:
+                    continue
+                marker = self._read_json(
+                    os.path.join(self.commits, name))
+                if marker is not None and \
+                        self._shard_readable(step, marker):
+                    out.append(step)
+        return sorted(out)
+
+    def latest_committed_step(self):
+        steps = self.committed_steps()
+        return steps[-1] if steps else None
+
+    def marker(self, step):
+        return self._read_json(self._gang_marker(step))
+
+    # -- resume -------------------------------------------------------------
+
+    def _src_rank(self, marker):
+        """When the world re-formed (elastic shrink/grow within
+        [min_np, max_np]) the restored world may differ from the one
+        that wrote the marker; ranks map onto the writers cyclically."""
+        return self.rank % int(marker["world"])
+
+    def _resolve(self, step):
+        """(step, marker, src rank, src checkpoint path) for a resume,
+        defaulting to the newest globally committed step."""
+        if step is None:
+            step = self.latest_committed_step()
+        if step is None:
+            raise FileNotFoundError(
+                f"no globally committed checkpoint under "
+                f"{self.directory}")
+        marker = self.marker(step)
+        if marker is None:
+            raise FileNotFoundError(
+                f"step {step} has no GANG commit marker under "
+                f"{self.commits}")
+        src = self._src_rank(marker)
+        return step, marker, src, os.path.join(
+            self.directory, f"rank-{src}", f"ckpt-{step}")
+
+    def _check_digest(self, step, marker, src, state):
+        got = _combined_digest(leaf_digests(state))
+        want = marker["digests"][str(src)]
+        if got != want:
+            raise ValueError(
+                f"gang restore digest mismatch at step {step}: rank "
+                f"{self.rank} restored rank {src}'s shard but its "
+                f"digest {got[:12]} != committed {want[:12]} — the "
+                "bytes on disk are not what the gang committed")
+        _monitor.stat_add("gang.restores")
+
+    def restore(self, template, *, step=None):
+        """Restore a plain pytree from the newest (or given) globally
+        committed step, digest-checked. Returns (step, state)."""
+        step, marker, src, path = self._resolve(step)
+        state = load_state(path, template)
+        self._check_digest(step, marker, src, state)
+        return step, state
+
+    def restore_engine(self, engine, *, step=None):
+        """Restore this rank's engine from the newest (or given)
+        globally committed step, verifying the restored state digest
+        against the GANG marker. Returns the restored step."""
+        step, marker, src, path = self._resolve(step)
+        load_train_state(path, engine)
+        state, _ = _engine_payload(engine)
+        self._check_digest(step, marker, src, state)
+        return step
 
 
 def save_persistables(engine_or_layer, dirname):

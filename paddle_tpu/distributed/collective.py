@@ -152,7 +152,17 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
         else:
             out = jnp.exp(jax.lax.psum(jnp.log(v), axis))
         return _wrap_like(tensor, out)
-    gathered = _eager_allgather(v, group)
+    # eager path: deadline-scoped (FLAGS_dist_timeout_s) so a dead peer
+    # raises retriable CollectiveTimeoutError instead of hanging forever
+    if not _in_trace(v):
+        from .gang import call_with_deadline, deadline_guard
+
+        remaining = deadline_guard("dist.allreduce")
+        gathered = call_with_deadline(
+            lambda: _eager_allgather(v, group), remaining,
+            "dist.allreduce")
+    else:
+        gathered = _eager_allgather(v, group)
     if gathered is not None:
         import numpy as np
 
@@ -270,11 +280,19 @@ def recv(tensor, src=0, group=None, sync_op=True):
 
 
 def barrier(group=None):
+    from .gang import call_with_deadline, deadline_guard
+
+    # every barrier is deadline-scoped: a gang where one rank died must
+    # unblock the survivors with a typed retriable error, not hang them
+    remaining = deadline_guard("dist.barrier")
     if jax.process_count() > 1:
         _require_whole_world(group)
         from jax.experimental import multihost_utils
 
-        multihost_utils.sync_global_devices("paddle_tpu.barrier")
+        call_with_deadline(
+            lambda: multihost_utils.sync_global_devices(
+                "paddle_tpu.barrier"),
+            remaining, "dist.barrier")
         return
     # eager single-process: nothing to synchronise; jax.block_until_ready on
     # a trivial computation stands in for a device barrier

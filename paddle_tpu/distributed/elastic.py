@@ -55,14 +55,20 @@ class ElasticManager:
         self.beat()
         return self
 
-    def beat(self):
+    def beat(self, step=None):
         from ..framework import faults as _faults
 
         if _faults.fault_point("elastic.beat") is _faults.DROP:
             return  # injected heartbeat loss: peers see this node die
+        rec = {"node": self.node_id, "ts": time.time()}
+        if step is not None:
+            # step-progress watermark: the gang supervisor's hang
+            # detection reads this to tell "alive but stuck" from
+            # "alive and advancing"
+            rec["step"] = int(step)
         tmp = self._path(self.node_id) + ".tmp"
         with open(tmp, "w") as f:
-            json.dump({"node": self.node_id, "ts": time.time()}, f)
+            json.dump(rec, f)
         os.replace(tmp, self._path(self.node_id))
 
     def deregister(self):
@@ -96,6 +102,22 @@ class ElasticManager:
                 except OSError:
                     pass
         return sorted(live)
+
+    def records(self):
+        """{node_id: beat record} for every parseable registration —
+        the gang supervisor's raw view (liveness judgement is the
+        caller's; torn/half-written files are simply skipped)."""
+        out = {}
+        for name in os.listdir(self.registry):
+            if not name.endswith(".beat"):
+                continue
+            try:
+                with open(os.path.join(self.registry, name)) as f:
+                    rec = json.load(f)
+            except (OSError, ValueError):
+                continue
+            out[rec.get("node", name[:-5])] = rec
+        return out
 
     def watch(self):
         """One poll step -> ElasticStatus (ref watch loop elastic.py)."""

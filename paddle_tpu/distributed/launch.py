@@ -8,12 +8,25 @@ is only raised for CPU-simulated multi-host tests; the NCCL-id TCP
 broadcast is replaced by `jax.distributed.initialize` against a coordinator
 address every rank derives from the same env contract.
 
+Since ISSUE 14 the single-node path is gang-supervised
+(distributed/gang.GangSupervisor): per-rank heartbeat files + step
+watermarks detect hangs (not just exits), any rank dying or stalling
+tears down ALL ranks (SIGTERM -> SIGKILL, reaped), and the gang restarts
+under exponential backoff with flaky-rank quarantine — recovery is
+checkpoint-based via GangCheckpointManager's globally committed steps.
+The multi-node (nnodes > 1) path keeps the classic per-node watchdog:
+cross-node supervision needs a shared registry filesystem, which the
+training script opts into by pointing PADDLE_GANG_DIR at one.
+
 Env contract written for each child (read by parallel.init_parallel_env):
   PADDLE_TRAINER_ID         global rank of the process
   PADDLE_TRAINERS_NUM       world size (total processes)
   PADDLE_CURRENT_ENDPOINT   this process's endpoint host:port
   PADDLE_TRAINER_ENDPOINTS  comma list of all endpoints (rank order)
   PADDLE_MASTER             coordinator address (= endpoint of rank 0)
+  PADDLE_GANG_DIR           gang heartbeat registry (supervised runs)
+  PADDLE_GANG_SLOT          stable slot id across world re-formations
+  PADDLE_GANG_ATTEMPT       1-based spawn generation
 """
 
 from __future__ import annotations
@@ -21,22 +34,12 @@ from __future__ import annotations
 import argparse
 import os
 import signal
-import socket
 import subprocess
 import sys
+import tempfile
 import time
 
-
-def _free_ports(n, host="127.0.0.1"):
-    socks, ports = [], []
-    for _ in range(n):
-        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        s.bind((host, 0))
-        socks.append(s)
-        ports.append(s.getsockname()[1])
-    for s in socks:
-        s.close()
-    return ports
+from .gang import GangSupervisor, _free_ports, terminate_all
 
 
 def parse_args(argv=None):
@@ -65,6 +68,17 @@ def parse_args(argv=None):
                         help="restart the whole local pod up to N times "
                              "after a failure (ref fleet/elastic.py; "
                              "state recovery is checkpoint-based)")
+    parser.add_argument("--gang_dir", type=str, default=None,
+                        help="gang heartbeat registry directory (default: "
+                             "a fresh tempdir); training scripts beat into "
+                             "it via distributed.gang.GangWorker")
+    parser.add_argument("--gang_hang_secs", type=float, default=None,
+                        help="declare a beating-but-stalled rank hung "
+                             "after this long (default: "
+                             "FLAGS_gang_hang_secs; 0 disables)")
+    parser.add_argument("--min_np", type=int, default=None,
+                        help="smallest world the gang may re-form to when "
+                             "ranks are quarantined (default: nproc)")
     parser.add_argument("--poll_interval", type=float, default=0.5)
     parser.add_argument("training_script", type=str)
     parser.add_argument("training_script_args", nargs=argparse.REMAINDER)
@@ -103,7 +117,8 @@ def start_local_trainers(args, endpoints, world, append_logs=False):
     """ref launch_utils.py:453 — one Popen per local rank with the env
     contract; stdout/stderr tee'd to workerlog.N when --log_dir given.
     append_logs: elastic retries must not truncate the failed attempt's
-    traceback."""
+    traceback. (Multi-node path; single-node spawning lives in
+    GangSupervisor._spawn_all.)"""
     procs = []
     logs = []
     master = args.master or endpoints[0]
@@ -142,21 +157,15 @@ def start_local_trainers(args, endpoints, world, append_logs=False):
 
 
 def _terminate_all(procs, grace=10.0):
-    for p in procs:
-        if p.poll() is None:
-            p.terminate()
-    deadline = time.time() + grace
-    for p in procs:
-        if p.poll() is None:
-            try:
-                p.wait(timeout=max(0.1, deadline - time.time()))
-            except subprocess.TimeoutExpired:
-                p.kill()
+    """Coordinated SIGTERM -> grace -> SIGKILL teardown, every exit
+    reaped (gang.terminate_all is the one implementation)."""
+    terminate_all(procs, grace=grace)
 
 
 def watch_local_trainers(procs, poll_interval=0.5):
     """ref launch_utils.py:565 — poll children; any non-zero exit kills
-    the whole local pod and propagates the code."""
+    the whole local pod and propagates the code. (Multi-node path; the
+    single-node watch loop with hang detection is GangSupervisor.run.)"""
     try:
         while True:
             alive = False
@@ -178,15 +187,42 @@ def watch_local_trainers(procs, poll_interval=0.5):
         return 130
 
 
-def launch(argv=None):
+def _launch_supervised(args):
+    """Single-node path: the gang supervisor owns spawn, watch, hang
+    detection, coordinated teardown, and backoff restarts."""
     from ..framework.errors import retry_with_backoff
 
-    args = parse_args(argv)
+    gang_dir = args.gang_dir or tempfile.mkdtemp(prefix="paddle-gang-")
+    cmd = [sys.executable, "-u", args.training_script] + \
+        args.training_script_args
+    sup = GangSupervisor(
+        cmd, args.nproc_per_node, gang_dir=gang_dir,
+        min_np=args.min_np or args.nproc_per_node,
+        max_np=args.nproc_per_node,
+        max_restarts=args.elastic_retries,
+        hang_secs=args.gang_hang_secs,
+        poll_interval=args.poll_interval, log_dir=args.log_dir)
+
+    def _sig(signum, frame):
+        sup.terminate()
+        sys.exit(128 + signum)
+
+    signal.signal(signal.SIGTERM, _sig)
+    # the bootstrap races the OS for ports and forks children; both fail
+    # transiently under load (EADDRINUSE between probe and bind, EAGAIN
+    # on fork) — retry with backoff instead of failing the job
+    retry_with_backoff(sup._spawn_all, retries=3,
+                       stat="launch_bootstrap_retries",
+                       description="launch trainer spawn")
+    return sup.run()
+
+
+def _launch_legacy(args):
+    """Multi-node per-node watchdog (no shared registry assumed)."""
+    from ..framework.errors import retry_with_backoff
+
     attempts = 0
     while True:
-        # the bootstrap races the OS for ports and forks children; both
-        # fail transiently under load (EADDRINUSE between probe and bind,
-        # EAGAIN on fork) — retry with backoff instead of failing the job
         endpoints, world = retry_with_backoff(
             lambda: _build_endpoints(args), retries=3,
             stat="launch_bootstrap_retries",
@@ -211,6 +247,13 @@ def launch(argv=None):
         sys.stderr.write(
             f"[launch] elastic restart {attempts}/"
             f"{args.elastic_retries} after exit code {code}\n")
+
+
+def launch(argv=None):
+    args = parse_args(argv)
+    if args.nnodes == 1:
+        return _launch_supervised(args)
+    return _launch_legacy(args)
 
 
 if __name__ == "__main__":

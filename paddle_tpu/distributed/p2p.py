@@ -95,25 +95,34 @@ class _Mailbox:
 
     @staticmethod
     def _connect_with_retry(host, port, deadline_s=60.0):
-        """The peer's mailbox starts lazily; retry until it listens."""
+        """The peer's mailbox starts lazily; retry until it listens,
+        under jittered exponential backoff so the N-1 survivors of a
+        coordinated gang restart do not thundering-herd rank 0's
+        endpoint in lockstep."""
+        import random
         import time
 
         end = time.monotonic() + deadline_s
+        delay = 0.05
         while True:
             try:
                 return socket.create_connection((host, port),
                                                 timeout=10.0)
             except OSError:
-                if time.monotonic() > end:
+                left = end - time.monotonic()
+                if left <= 0:
                     raise
-                time.sleep(0.2)
+                time.sleep(min(delay * random.uniform(0.5, 1.5), left))
+                delay = min(delay * 2, 2.0)
 
-    def _sock_to(self, dst: int) -> socket.socket:
+    def _sock_to(self, dst: int, deadline_s=None) -> socket.socket:
         with self._slock:
             s = self._socks.get(dst)
             if s is None:
                 host, port = _p2p_addr(self.env.trainer_endpoints[dst])
-                s = self._connect_with_retry(host, port)
+                s = self._connect_with_retry(
+                    host, port,
+                    deadline_s=60.0 if deadline_s is None else deadline_s)
                 s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
                 head = _svc._recv_exact(s, 20)
                 if head[:4] != _svc._MAGIC:
@@ -135,7 +144,12 @@ class _Mailbox:
                 self._dst_locks[dst] = threading.Lock()
             return self._dst_locks[dst]
 
-    def send(self, arr: np.ndarray, dst: int) -> None:
+    def send(self, arr: np.ndarray, dst: int,
+             deadline_s: float | None = None) -> None:
+        from ..framework import monitor as _monitor
+        from .gang import PeerGoneError, deadline_guard
+
+        remaining = deadline_guard("dist.p2p_send", deadline_s)
         if dst == self.env.rank:
             self._enqueue(dst, np.asarray(arr))
             return
@@ -145,7 +159,15 @@ class _Mailbox:
         # supported path) drop the cached socket and reconnect once
         with self._dst_lock(dst):
             for attempt in (0, 1):
-                sock = self._sock_to(dst)
+                try:
+                    sock = self._sock_to(dst, deadline_s=remaining)
+                except OSError:
+                    _monitor.stat_add("gang.peer_gone")
+                    raise PeerGoneError(
+                        f"p2p peer rank {dst} unreachable within the "
+                        f"{remaining}s deadline — its process is gone "
+                        "or never started; retriable after the gang "
+                        "re-forms") from None
                 try:
                     _svc._send_msg(sock,
                                    (self.env.rank, np.asarray(arr)))
@@ -158,14 +180,32 @@ class _Mailbox:
                     except OSError:
                         pass
                     if attempt:
-                        raise
+                        _monitor.stat_add("gang.peer_gone")
+                        raise PeerGoneError(
+                            f"p2p send to rank {dst} failed twice "
+                            "(connection reset) — the peer died "
+                            "mid-stream; retriable after the gang "
+                            "re-forms") from None
 
-    def recv(self, src: int, timeout: float | None = 300.0):
+    def recv(self, src: int, timeout: float | None = None):
+        """Blocking receive from `src`'s queue. `timeout=None` uses the
+        gang deadline (FLAGS_dist_timeout_s); a peer that does not
+        deliver in time raises typed retriable PeerGoneError naming the
+        src rank and the deadline — never an anonymous hang."""
+        from ..framework import monitor as _monitor
+        from .gang import PeerGoneError, deadline_guard
+
+        remaining = deadline_guard("dist.p2p_recv", timeout,
+                                   tag=str(src))
         try:
-            return self._queue_for(src).get(timeout=timeout)
+            return self._queue_for(src).get(timeout=remaining)
         except queue.Empty:
-            raise TimeoutError(
-                f"p2p recv from rank {src} timed out") from None
+            _monitor.stat_add("gang.peer_gone")
+            raise PeerGoneError(
+                f"p2p recv from rank {src} got nothing within its "
+                f"{remaining:.3f}s deadline — the peer is gone or "
+                "wedged mid-collective; retriable after the gang "
+                "re-forms") from None
 
 
 _mailbox: _Mailbox | None = None

@@ -34,8 +34,8 @@ from ..framework import monitor
 from ..framework.errors import UnavailableError
 
 __all__ = ["PreemptedError", "install", "uninstall", "requested",
-           "request", "poll", "clear", "write_marker", "consume_marker",
-           "MARKER_NAME"]
+           "request", "poll", "clear", "on_preempt", "write_marker",
+           "consume_marker", "MARKER_NAME"]
 
 MARKER_NAME = "PREEMPTED"
 
@@ -44,6 +44,25 @@ _requested = False
 _reason = None
 _poll_count = 0
 _prev_handlers: dict = {}
+_callbacks: list = []
+
+
+def on_preempt(callback):
+    """Register a callback fired exactly once, at the moment the FIRST
+    preemption request lands (signal or simulated) — e.g. a GangWorker
+    deregistering its heartbeat so peers and the supervisor observe the
+    membership change without waiting for the beat to expire. Callbacks
+    must be signal-safe-ish (no locks shared with the main loop) and
+    must not raise into the drain path (exceptions are swallowed)."""
+    with _lock:
+        already = _requested
+        if not already:
+            _callbacks.append(callback)
+    if already:  # late registration during an active preemption
+        try:
+            callback()
+        except Exception:
+            pass
 
 
 class PreemptedError(UnavailableError):
@@ -61,6 +80,11 @@ def request(reason="signal"):
             monitor.stat_add("preemptions")
         else:
             return
+    for cb in list(_callbacks):
+        try:
+            cb()
+        except Exception:  # never let a hook break the drain path
+            pass
     # black-box the last steps NOW: the grace window may not be long
     # enough for the step loop's checkpoint, but this dump is cheap
     try:
@@ -136,6 +160,7 @@ def clear():
         _requested = False
         _reason = None
         _poll_count = 0
+        del _callbacks[:]
 
 
 # ---------------------------------------------------------------------------

@@ -80,6 +80,20 @@ A fault point is a named site the runtime passes through:
     rec.online_push           each OnlineTrainer.feed click batch,
                               before forward/backward (raise = dropped
                               feedback batch; serving must be unaffected)
+    dist.allreduce            each eager all-reduce, before the transport
+                              (delay past FLAGS_dist_timeout_s = the
+                              deterministic CollectiveTimeoutError path)
+    dist.barrier              each eager barrier, including the gang
+                              checkpoint commit barrier
+    dist.p2p_send             each p2p mailbox send, before the socket
+    dist.p2p_recv             each p2p mailbox recv, before the queue
+                              wait (delay eats the per-call deadline)
+    gang.heartbeat            each gang worker heartbeat+watermark write
+                              (drop = supervisor sees the rank stall)
+    gang.restart              each coordinated gang restart, after the
+                              teardown and before the respawn (delay =
+                              slow re-formation, charged to restart-lost
+                              time; crash = supervisor death)
 
 The authoritative site list is the `SITES` registry below;
 `fault_point` refuses to fire for an unregistered site, and the
@@ -153,6 +167,15 @@ SITES = {
     "serving.rollout_load": "each weight-registry checkpoint-dir load",
     "serving.canary": "before the canary replica's gate evaluation",
     "serving.rollback": "each rollout rollback attempt (tag = version)",
+    "dist.allreduce": "each eager all-reduce before the transport "
+                      "(delay eats the FLAGS_dist_timeout_s budget)",
+    "dist.barrier": "each eager barrier / gang ckpt commit barrier",
+    "dist.p2p_send": "each p2p mailbox send before the socket write",
+    "dist.p2p_recv": "each p2p mailbox recv before the queue wait",
+    "gang.heartbeat": "each gang worker heartbeat+watermark write "
+                      "(drop = the supervisor sees this rank stall)",
+    "gang.restart": "each coordinated gang restart, after teardown "
+                    "and before the respawn",
     "ps.push": "each PS mutation between WAL append and apply",
     "ps.pull": "each PS pull_dense/pull_sparse lookup",
     "ps.wal_append": "before each PS WAL record write",

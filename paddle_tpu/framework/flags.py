@@ -145,6 +145,28 @@ _DEFS = {
         "rollout: number of pinned golden prompts synthesized (seeded, "
         "deterministic) for the canary bitwise gate when the caller "
         "does not supply an explicit prompt set"),
+    "FLAGS_dist_timeout_s": (
+        60.0, float,
+        "distributed: per-call deadline (seconds) for eager collectives, "
+        "barriers, p2p send/recv, and the gang checkpoint commit "
+        "barrier. A peer that does not answer within the deadline "
+        "raises typed retriable CollectiveTimeoutError/PeerGoneError "
+        "instead of blocking the rank forever (0 disables — the "
+        "pre-gang hang-forever behaviour)"),
+    "FLAGS_gang_max_restarts": (
+        3, int,
+        "gang supervisor: coordinated gang restarts allowed before the "
+        "job fails with the last rank's exit code (each restart tears "
+        "down ALL ranks and re-forms the world from the newest "
+        "globally committed checkpoint)"),
+    "FLAGS_gang_hang_secs": (
+        30.0, float,
+        "gang supervisor: a rank whose heartbeat or step-progress "
+        "watermark stalls this long (while its process is still alive) "
+        "is declared hung and the whole gang is restarted (0 disables "
+        "hang detection; keep this above FLAGS_dist_timeout_s so "
+        "collective-blocked victims unblock via their deadline and the "
+        "stall is attributed to the rank that actually died)"),
     "FLAGS_flight_recorder_capacity": (
         256, int,
         "observe: ring-buffer size of the always-on flight recorder "

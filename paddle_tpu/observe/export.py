@@ -37,6 +37,9 @@ _GOODPUT_CATS = {
     "h2d": "host",
     "sample": "host",
     "anomaly-readback": "host",
+    # gang supervisor: teardown + backoff + respawn after a rank died
+    # or stalled — wall time lost to the coordinated restart
+    "gang-restart": "restart",
 }
 # background writer time overlaps the step thread: report it, but keep
 # it out of the goodput denominator
@@ -48,7 +51,7 @@ def goodput(aggregates=None):
     if aggregates is None:
         aggregates = _timeline.aggregates()
     cats = {"productive": 0.0, "compile": 0.0, "checkpoint": 0.0,
-            "restore": 0.0, "host": 0.0, "other": 0.0}
+            "restore": 0.0, "restart": 0.0, "host": 0.0, "other": 0.0}
     overlapped = 0.0
     for name, agg in aggregates.items():
         if name in _OVERLAPPED:
@@ -97,6 +100,15 @@ def snapshot(serving=None):
              for stat in _FLEET_METRICS},
             slo_violation_seconds=(
                 monitor.stat_get("fleet.slo_violation_ms") / 1e3)),
+        # gang-supervised training view mirrors paddle_gang_*: restart/
+        # timeout counters + wall time lost to coordinated restarts +
+        # live per-rank heartbeat ages from the supervisor registry
+        "gang": dict(
+            {stat.split(".", 1)[1]: monitor.stat_get(stat)
+             for stat in _GANG_METRICS},
+            restart_lost_seconds=(
+                monitor.stat_get("gang.restart_lost_ms") / 1e3),
+            heartbeat_ages=_gang_heartbeat_ages()),
     }
     if serving is not None:
         out["serving"] = serving.snapshot()
@@ -196,6 +208,50 @@ _FLEET_METRICS = {
 #: out of the generic (counter-typed) monitor dump
 _FLEET_STATS = set(_FLEET_METRICS) | {"fleet.slo_violation_ms"}
 
+#: monitor stat -> (prometheus name, type, help) for the gang-supervised
+#: training family (distributed/gang.py); same contract as _PS_METRICS,
+#: mirrored in snapshot()["gang"]. restart_lost_ms is converted to
+#: seconds; per-rank heartbeat ages are live gauges from the supervisor
+_GANG_METRICS = {
+    "gang.restarts": (
+        "paddle_gang_restarts_total", "counter",
+        "coordinated whole-gang restarts (a rank died or stalled)"),
+    "gang.collective_timeouts": (
+        "paddle_gang_collective_timeouts_total", "counter",
+        "eager collectives/barriers that hit their "
+        "FLAGS_dist_timeout_s deadline"),
+    "gang.peer_gone": (
+        "paddle_gang_peer_gone_total", "counter",
+        "p2p sends/recvs that raised PeerGoneError (peer dead or "
+        "unreachable within the deadline)"),
+    "gang.quarantined": (
+        "paddle_gang_quarantined_total", "counter",
+        "flaky rank slots excluded from world re-formation"),
+    "gang.commits": (
+        "paddle_gang_commits_total", "counter",
+        "checkpoint steps that passed the gang commit barrier "
+        "(globally committed on every rank)"),
+    "gang.restores": (
+        "paddle_gang_restores_total", "counter",
+        "rank restores from a globally committed step"),
+    "gang.heartbeats": (
+        "paddle_gang_heartbeats_total", "counter",
+        "worker heartbeat+watermark writes into the gang registry"),
+}
+#: gang stats consumed by _GANG_METRICS or converted inline
+_GANG_STATS = set(_GANG_METRICS) | {"gang.restart_lost_ms"}
+
+
+def _gang_heartbeat_ages():
+    """{rank slot: seconds since its last heartbeat} across live
+    supervisors (empty outside a supervisor process)."""
+    try:
+        from ..distributed.gang import heartbeat_ages
+
+        return heartbeat_ages()
+    except Exception:  # telemetry must never break the exporter
+        return {}
+
 
 def _rec_gauges():
     """Live-cache gauges (computed, not monotonic — they track the
@@ -289,11 +345,25 @@ def prometheus_text(serving=None, queue_depth=None, fleet=None):
           help_="cumulative seconds the windowed e2e p99 spent over "
                 "FLAGS_fleet_slo_p99_ms")
 
+    # gang-supervised training family: restart/timeout counters,
+    # restart-lost seconds, and live per-rank heartbeat-age gauges
+    for stat, (pname, mtype, help_) in _GANG_METRICS.items():
+        L.add(pname, monitor.stat_get(stat), mtype=mtype, help_=help_)
+    L.add("paddle_gang_restart_lost_seconds_total",
+          monitor.stat_get("gang.restart_lost_ms") / 1e3,
+          mtype="counter",
+          help_="wall time lost to coordinated gang restarts "
+                "(detection -> teardown -> backoff -> respawn)")
+    for slot, age in sorted(_gang_heartbeat_ages().items()):
+        L.add("paddle_gang_rank_heartbeat_age_seconds", age,
+              labels={"rank": slot},
+              help_="age of this rank's last gang heartbeat")
+
     for name, value in sorted(monitor.stats().items()):
         if not isinstance(value, (int, float)):
             continue
         if name in _PS_METRICS or name in _REC_METRICS \
-                or name in _FLEET_STATS:
+                or name in _FLEET_STATS or name in _GANG_STATS:
             continue
         L.add(f"paddle_{name}", value, mtype="counter",
               help_="framework.monitor stat")

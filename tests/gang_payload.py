@@ -1,0 +1,83 @@
+"""Training payload for the fork-based gang chaos tests
+(test_gang_slow.py): a tiny deterministic SGD loop whose gradients are
+averaged cross-rank over the p2p mailbox (so a SIGKILLed peer leaves
+the survivor blocked inside a real collective), checkpointed through
+the GANG commit barrier, killable/hangable at a scripted step.
+
+Env contract (set by the test, plus the launcher's PADDLE_* vars):
+  GANG_OUT         output dir (losses / typed-error / checkpoint files)
+  GANG_STEPS       total steps to complete
+  GANG_KILL_RANK / GANG_KILL_STEP   SIGKILL self mid-collective there
+                                    (first attempt only)
+  GANG_HANG_RANK / GANG_HANG_STEP   go silent there (first attempt only)
+"""
+
+import os
+import signal
+import sys
+import time
+
+import numpy as np
+
+# run as `python tests/gang_payload.py`: the script dir (tests/) is on
+# sys.path, the repo root is not
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from paddle_tpu.distributed import preempt  # noqa: E402
+from paddle_tpu.distributed.checkpoint import (  # noqa: E402
+    GangCheckpointManager)
+from paddle_tpu.distributed.gang import (  # noqa: E402
+    CollectiveTimeoutError, GangWorker, PeerGoneError, allreduce_host)
+
+
+def main():
+    out = os.environ["GANG_OUT"]
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+    world = int(os.environ["PADDLE_TRAINERS_NUM"])
+    attempt = int(os.environ.get("PADDLE_GANG_ATTEMPT", "1"))
+    steps = int(os.environ.get("GANG_STEPS", "8"))
+    kill_rank = int(os.environ.get("GANG_KILL_RANK", "-1"))
+    kill_step = int(os.environ.get("GANG_KILL_STEP", "-1"))
+    hang_rank = int(os.environ.get("GANG_HANG_RANK", "-1"))
+    hang_step = int(os.environ.get("GANG_HANG_STEP", "-1"))
+
+    preempt.install()
+    gw = GangWorker()
+    mgr = GangCheckpointManager(os.path.join(out, "ckpt"), rank, world)
+    w = np.linspace(-0.5, 0.5, 4)
+    start = 0
+    if mgr.latest_committed_step() is not None:
+        got, st = mgr.restore({"w": w})
+        w, start = np.asarray(st["w"]), got + 1
+    lossf = open(os.path.join(out, f"losses.r{rank}.log"), "a")
+    try:
+        for step in range(start, steps):
+            gw.beat(step=step)
+            if rank == hang_rank and step == hang_step and attempt == 1:
+                while True:
+                    time.sleep(0.5)
+            if rank == kill_rank and step == kill_step and attempt == 1:
+                time.sleep(0.3)  # ensure the peer is already blocked
+                os.kill(os.getpid(), signal.SIGKILL)
+            rng = np.random.RandomState(31 * step + rank)
+            x, y = rng.randn(8, 4), rng.randn(8)
+            err = x @ w - y
+            g = allreduce_host((2.0 / len(y)) * (x.T @ err), "mean",
+                               rank=rank, world=world)
+            w = w - 0.05 * g
+            if rank == 0:
+                loss = float(np.mean(err * err))
+                lossf.write(f"{step} {loss.hex()}\n")
+                lossf.flush()
+            if (step + 1) % 2 == 0:
+                mgr.save(step, {"w": w})
+    except (CollectiveTimeoutError, PeerGoneError) as e:
+        with open(os.path.join(out, f"typed.r{rank}.log"), "a") as f:
+            f.write(f"{type(e).__name__}: {e}\n")
+        sys.exit(13)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -19,12 +19,15 @@ from paddle_tpu.framework import faults, monitor
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-# fault_point("site", ...) / fault_point('site', ...) source literals
-_CALL_RE = re.compile(r"""fault_point\(\s*["']([a-z_.]+)["']""")
+# fault_point("site", ...) source literals; deadline_guard("site", ...)
+# is the gang module's deadline-scoped wrapper around fault_point and
+# counts as a call site for the same reason
+_CALL_RE = re.compile(
+    r"""(?:fault_point|deadline_guard)\(\s*["']([a-z0-9_.]+)["']""")
 
 # chaos-spec literals ("site@occ:action" / "site[tag]@occ:action") as the
 # repo-root benches write them
-_SPEC_RE = re.compile(r"""["']([a-z_.]+)(?:\[[^\]]*\])?@\d+:""")
+_SPEC_RE = re.compile(r"""["']?([a-z0-9_.]+)(?:\[[^\]]*\])?@\d+:""")
 
 
 def _source_files():
@@ -101,6 +104,21 @@ def test_scale_event_sites_are_registered():
         assert site in faults.SITES, site
         assert "replica" in faults.SITES[site] or \
             "drain" in faults.SITES[site]
+
+
+def test_gang_sites_are_registered():
+    """ISSUE 14: the collective-deadline and gang-supervision sites
+    bench_gang.py schedules chaos against must stay registered, or its
+    certification legs degrade to clean runs. (Behavioral coverage:
+    test_gang.py; real-SIGKILL coverage: test_gang_slow.py.)"""
+    for site, hint in (("dist.allreduce", "reduce"),
+                       ("dist.barrier", "barrier"),
+                       ("dist.p2p_send", "p2p"),
+                       ("dist.p2p_recv", "p2p"),
+                       ("gang.heartbeat", "heartbeat"),
+                       ("gang.restart", "restart")):
+        assert site in faults.SITES, site
+        assert hint in faults.SITES[site].lower(), site
 
 
 def test_rollout_sites_are_registered():

@@ -1,0 +1,538 @@
+"""Gang supervision tier-1 suite (ISSUE 14): collective deadlines,
+typed retriable peer errors, the gang commit barrier, and the
+GangSupervisor state machine — all in-process or with trivial non-jax
+child processes, so every scenario the slow fork tests
+(test_gang_slow.py) certify with real SIGKILLs has a fast equivalent
+here."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import types
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed import preempt
+from paddle_tpu.distributed.checkpoint import GangCheckpointManager
+from paddle_tpu.distributed.gang import (
+    CollectiveTimeoutError, GangSupervisor, GangWorker, PeerGoneError,
+    allreduce_host, barrier_host, call_with_deadline, deadline_guard,
+    terminate_all, _free_ports)
+from paddle_tpu.distributed.p2p import _Mailbox
+from paddle_tpu.framework import faults, monitor
+from paddle_tpu.framework.errors import (ExecutionTimeoutError,
+                                         UnavailableError)
+
+
+def _fake_env(rank, endpoints):
+    return types.SimpleNamespace(rank=rank,
+                                 world_size=len(endpoints),
+                                 current_endpoint=endpoints[rank],
+                                 trainer_endpoints=endpoints)
+
+
+@pytest.fixture()
+def boxes():
+    """Two live in-process mailboxes wired to each other (ranks 0/1)."""
+    eps = ["127.0.0.1:%d" % p for p in _free_ports(2)]
+    pair = [_Mailbox(_fake_env(0, eps)), _Mailbox(_fake_env(1, eps))]
+    yield pair
+    for b in pair:
+        b._tcp.shutdown()
+        b._tcp.server_close()
+
+
+# ---------------------------------------------------------------------------
+# typed deadline errors
+# ---------------------------------------------------------------------------
+
+
+def test_recv_deadline_raises_typed_peer_gone(boxes):
+    """Satellite 1: a recv from a gone peer raises PeerGoneError naming
+    the src rank AND the deadline — never an anonymous hang."""
+    t0 = time.monotonic()
+    with pytest.raises(PeerGoneError) as ei:
+        boxes[0].recv(1, timeout=0.2)
+    assert time.monotonic() - t0 < 5.0
+    assert "rank 1" in str(ei.value)
+    assert "deadline" in str(ei.value)
+    assert ei.value.retriable is True
+    assert isinstance(ei.value, UnavailableError)
+
+
+def test_collective_timeout_error_is_typed_retriable():
+    """An injected delay past the per-call deadline surfaces as
+    CollectiveTimeoutError (an ExecutionTimeoutError, retriable)."""
+    before = monitor.stat_get("gang.collective_timeouts")
+    with faults.ChaosSchedule("dist.allreduce@1:delay:0.2") as ch:
+        with pytest.raises(CollectiveTimeoutError) as ei:
+            deadline_guard("dist.allreduce", 0.05)
+        ch.verify()
+    assert ei.value.retriable is True
+    assert isinstance(ei.value, ExecutionTimeoutError)
+    assert monitor.stat_get("gang.collective_timeouts") == before + 1
+
+
+def test_deadline_guard_disabled_and_remaining():
+    assert deadline_guard("dist.allreduce", 0) is None
+    left = deadline_guard("dist.allreduce", 5.0)
+    assert 0 < left <= 5.0
+
+
+def test_call_with_deadline_inline_result_error_and_timeout():
+    assert call_with_deadline(lambda: 7, None, "x") == 7
+    assert call_with_deadline(lambda: 7, 1.0, "x") == 7
+    with pytest.raises(ValueError):
+        call_with_deadline(lambda: (_ for _ in ()).throw(ValueError("b")),
+                           1.0, "x")
+    ev = threading.Event()
+    with pytest.raises(CollectiveTimeoutError):
+        call_with_deadline(ev.wait, 0.05, "stuck-op")
+    ev.set()
+
+
+def test_connect_retry_backoff_is_jittered_exponential(monkeypatch):
+    """Satellite 1: reconnects back off exponentially WITH jitter so a
+    restarted gang's survivors don't thundering-herd rank 0."""
+    slept = []
+
+    def _record(dt):
+        slept.append(dt)
+        if len(slept) >= 4:
+            raise InterruptedError  # stop the retry loop
+
+    monkeypatch.setattr(time, "sleep", _record)
+    port = _free_ports(1)[0]  # nothing listens here
+    with pytest.raises(InterruptedError):
+        _Mailbox._connect_with_retry("127.0.0.1", port, deadline_s=30.0)
+    for i, dt in enumerate(slept):
+        base = 0.05 * 2 ** i
+        assert 0.5 * base <= dt <= 1.5 * base, (i, dt)
+    assert len(set(slept)) >= 2  # jittered, not a fixed ladder
+
+
+# ---------------------------------------------------------------------------
+# host collectives over the mailbox
+# ---------------------------------------------------------------------------
+
+
+def test_allreduce_host_matches_numpy_bitwise(boxes):
+    a0 = np.arange(6, dtype=np.float64).reshape(2, 3) * 0.3
+    a1 = np.linspace(-1, 1, 6).reshape(2, 3)
+    for op, ref in [("sum", a0 + a1), ("mean", (a0 + a1) / 2.0),
+                    ("max", np.maximum(a0, a1)),
+                    ("min", np.minimum(a0, a1))]:
+        out = [None, None]
+
+        def _run(r, a, op=op):
+            out[r] = allreduce_host(a, op, rank=r, world=2,
+                                    deadline_s=10.0, box=boxes[r])
+
+        ts = [threading.Thread(target=_run, args=(r, a))
+              for r, a in ((0, a0), (1, a1))]
+        [t.start() for t in ts]
+        [t.join(10.0) for t in ts]
+        np.testing.assert_array_equal(out[0], ref)
+        np.testing.assert_array_equal(out[0], out[1])  # bitwise agree
+
+
+def test_barrier_deadline_unblocks_every_live_rank(boxes):
+    """Satellite 3 (dead-peer mode): a 3-rank barrier with rank 2
+    missing must raise a typed error on BOTH live ranks within the
+    deadline — no rank left blocked inside the collective."""
+    errs = {}
+
+    def _run(r):
+        try:
+            barrier_host(rank=r, world=3, deadline_s=0.4, box=boxes[r])
+        except (CollectiveTimeoutError, PeerGoneError) as e:
+            errs[r] = e
+
+    ts = [threading.Thread(target=_run, args=(r,)) for r in (0, 1)]
+    t0 = time.monotonic()
+    [t.start() for t in ts]
+    [t.join(8.0) for t in ts]
+    assert not any(t.is_alive() for t in ts), "a rank is still blocked"
+    assert time.monotonic() - t0 < 8.0
+    assert set(errs) == {0, 1}
+    assert all(e.retriable for e in errs.values())
+
+
+def test_barrier_injected_delay_past_flag_raises_on_every_rank(boxes):
+    """Satellite 3 (injected mode): dist.barrier@N:delay past
+    FLAGS_dist_timeout_s raises CollectiveTimeoutError on every rank
+    that hits it — deterministic, no transport involved."""
+    from paddle_tpu.framework import flags as _flags
+
+    prev = _flags.flag("FLAGS_dist_timeout_s")
+    _flags.set_flags({"FLAGS_dist_timeout_s": 0.05})
+    try:
+        errs = {}
+        with faults.ChaosSchedule("dist.barrier@1:delay:0.2",
+                                  "dist.barrier@2:delay:0.2") as ch:
+            for r in (0, 1):
+                with pytest.raises(CollectiveTimeoutError) as ei:
+                    barrier_host(rank=r, world=2, box=boxes[r])
+                errs[r] = ei.value
+            ch.verify()
+        assert all(e.retriable for e in errs.values())
+    finally:
+        _flags.set_flags({"FLAGS_dist_timeout_s": prev})
+
+
+def test_allreduce_fault_is_retriable_at_step_boundary():
+    """Satellite 3: a fault-injected dist.allreduce surfaces as a
+    retriable error AT the step boundary; retrying the step yields a
+    bitwise-identical trajectory to the un-faulted run."""
+
+    def train():
+        w = np.linspace(0.0, 1.0, 4)
+        for step in range(4):
+            for attempt in range(3):
+                try:
+                    g = allreduce_host(w * 0.25 + step, "sum",
+                                       rank=0, world=1,
+                                       deadline_s=0.05)
+                    break
+                except CollectiveTimeoutError as e:
+                    assert e.retriable  # retry the whole step
+            else:
+                raise AssertionError("step never succeeded")
+            w = w - 0.1 * g
+        return w
+
+    clean = train()
+    with faults.ChaosSchedule("dist.allreduce@2:delay:0.2") as ch:
+        faulted = train()
+        ch.verify()
+    np.testing.assert_array_equal(clean, faulted)
+
+
+# ---------------------------------------------------------------------------
+# gang worker heartbeats
+# ---------------------------------------------------------------------------
+
+
+def test_gang_worker_beat_writes_watermark_and_drop_site(tmp_path):
+    try:
+        gw = GangWorker(gang_dir=str(tmp_path), rank=0)
+        beat = tmp_path / "rank-0.beat"
+        with faults.ChaosSchedule("gang.heartbeat@1:drop") as ch:
+            gw.beat(step=3)   # dropped: the supervisor sees a stall
+            assert not beat.exists()
+            gw.beat(step=4)
+            ch.verify()
+        rec = json.loads(beat.read_text())
+        assert rec["step"] == 4 and rec["node"] == "rank-0"
+    finally:
+        preempt.clear()
+
+
+def test_gang_worker_deregisters_on_preemption(tmp_path):
+    try:
+        gw = GangWorker(gang_dir=str(tmp_path), rank=0)
+        gw.beat(step=1)
+        assert (tmp_path / "rank-0.beat").exists()
+        preempt.request(reason="test")
+        assert not (tmp_path / "rank-0.beat").exists()
+    finally:
+        preempt.clear()
+
+
+# ---------------------------------------------------------------------------
+# coordinated teardown
+# ---------------------------------------------------------------------------
+
+
+def test_terminate_all_sigkills_sigterm_ignorer_and_reaps():
+    """Satellite 2: a child that ignores SIGTERM is SIGKILLed within the
+    grace window and reaped — no zombie outlives the pod."""
+    p = subprocess.Popen([
+        sys.executable, "-c",
+        "import signal, time; "
+        "signal.signal(signal.SIGTERM, signal.SIG_IGN); "
+        "print('armed', flush=True); time.sleep(600)"],
+        stdout=subprocess.PIPE)
+    assert p.stdout.readline().strip() == b"armed"
+    t0 = time.monotonic()
+    terminate_all([p], grace=0.5)
+    assert time.monotonic() - t0 < 10.0
+    assert p.returncode == -signal.SIGKILL
+    # reaped: waitpid has nothing left for this pid (no zombie)
+    with pytest.raises(ChildProcessError):
+        os.waitpid(p.pid, os.WNOHANG)
+
+
+# ---------------------------------------------------------------------------
+# gang commit barrier + globally consistent resume
+# ---------------------------------------------------------------------------
+
+
+def _both_save(mgrs, step, states):
+    errs = []
+
+    def _s(m, st):
+        try:
+            m.save(step, st)
+        except Exception as e:  # noqa: BLE001 — surfaced below
+            errs.append(e)
+
+    ts = [threading.Thread(target=_s, args=(m, st))
+          for m, st in zip(mgrs, states)]
+    [t.start() for t in ts]
+    [t.join(20.0) for t in ts]
+    assert not errs, errs
+
+
+def test_commit_barrier_makes_step_globally_readable(tmp_path):
+    mgrs = [GangCheckpointManager(str(tmp_path), r, 2,
+                                  barrier_timeout_s=10.0)
+            for r in (0, 1)]
+    states = [{"w": np.full(4, float(r + 1))} for r in (0, 1)]
+    before = monitor.stat_get("gang.commits")
+    _both_save(mgrs, 5, states)
+    assert monitor.stat_get("gang.commits") == before + 2
+    for m in mgrs:
+        assert m.latest_committed_step() == 5
+    marker = mgrs[0].marker(5)
+    assert marker["world"] == 2 and set(marker["digests"]) == {"0", "1"}
+
+
+def test_commit_barrier_times_out_when_a_rank_never_writes(tmp_path):
+    """A rank dying between its local save and the barrier leaves the
+    step UNCOMMITTED for everyone (rank 1 never saves here)."""
+    m0 = GangCheckpointManager(str(tmp_path), 0, 2,
+                               barrier_timeout_s=0.3)
+    with pytest.raises(CollectiveTimeoutError) as ei:
+        m0.save(2, {"w": np.ones(3)})
+    assert ei.value.retriable
+    assert m0.latest_committed_step() is None  # no GANG marker
+    assert m0.local.is_readable(2)  # the local shard itself is fine
+
+
+def test_restore_uses_newest_globally_committed_step(tmp_path):
+    """Rank 1 has a NEWER local-only step (died pre-barrier): resume
+    must come from the newest step the whole gang committed."""
+    mgrs = [GangCheckpointManager(str(tmp_path), r, 2,
+                                  barrier_timeout_s=10.0)
+            for r in (0, 1)]
+    committed = [{"w": np.arange(4) * 1.0}, {"w": np.arange(4) * 2.0}]
+    _both_save(mgrs, 3, committed)
+    # rank 1 gets further alone, then dies before the barrier
+    mgrs[1].local.save(4, {"w": np.arange(4) * 9.0})
+    mgrs[1]._write_json(mgrs[1]._rank_marker(4, 1),
+                        {"rank": 1, "digest": "dead", "ts": 0})
+    for r in (0, 1):
+        step, st = mgrs[r].restore({"w": np.zeros(4)})
+        assert step == 3
+        np.testing.assert_array_equal(st["w"], committed[r]["w"])
+    before = monitor.stat_get("gang.restores")
+    mgrs[0].restore({"w": np.zeros(4)})
+    assert monitor.stat_get("gang.restores") == before + 1
+
+
+def test_restore_remaps_ranks_onto_smaller_writer_world(tmp_path):
+    mgrs = [GangCheckpointManager(str(tmp_path), r, 2,
+                                  barrier_timeout_s=10.0)
+            for r in (0, 1)]
+    _both_save(mgrs, 1, [{"w": np.full(2, 10.0)}, {"w": np.full(2, 20.0)}])
+    # the world re-formed to 3 ranks: rank 2 maps onto writer 2 % 2 = 0
+    m2 = GangCheckpointManager(str(tmp_path), 2, 3)
+    step, st = m2.restore({"w": np.zeros(2)})
+    assert step == 1
+    np.testing.assert_array_equal(st["w"], np.full(2, 10.0))
+
+
+def test_restore_digest_mismatch_is_detected(tmp_path):
+    mgrs = [GangCheckpointManager(str(tmp_path), r, 2,
+                                  barrier_timeout_s=10.0)
+            for r in (0, 1)]
+    _both_save(mgrs, 7, [{"w": np.ones(3)}, {"w": np.ones(3) * 2}])
+    marker = mgrs[0].marker(7)
+    marker["digests"]["0"] = "0" * 64  # bytes-on-disk vs commit mismatch
+    mgrs[0]._write_json(mgrs[0]._gang_marker(7), marker)
+    with pytest.raises(ValueError, match="digest mismatch"):
+        mgrs[0].restore({"w": np.zeros(3)})
+
+
+# ---------------------------------------------------------------------------
+# supervisor state machine (children are plain python -c, no jax import)
+# ---------------------------------------------------------------------------
+
+# a child that beats its slot's heartbeat+step watermark like a real
+# GangWorker, then follows a per-test script
+_BEATER = r"""
+import json, os, sys, time
+slot = os.environ["PADDLE_GANG_SLOT"]
+gang = os.environ["PADDLE_GANG_DIR"]
+attempt = int(os.environ.get("PADDLE_GANG_ATTEMPT", "1"))
+def beat(step):
+    rec = {"node": "rank-" + slot, "ts": time.time(), "step": step}
+    tmp = os.path.join(gang, "rank-" + slot + ".beat.tmp")
+    with open(tmp, "w") as f:
+        json.dump(rec, f)
+    os.replace(tmp, os.path.join(gang, "rank-" + slot + ".beat"))
+"""
+
+
+def _sup(tmp_path, script, nranks=2, **kw):
+    import io
+
+    kw.setdefault("max_restarts", 2)
+    kw.setdefault("hang_secs", 0.0)
+    kw.setdefault("grace_s", 2.0)
+    kw.setdefault("poll_interval", 0.05)
+    kw.setdefault("backoff_base_s", 0.01)
+    kw.setdefault("backoff_max_s", 0.02)
+    kw.setdefault("stderr", io.StringIO())
+    return GangSupervisor([sys.executable, "-c", _BEATER + script],
+                          nranks, gang_dir=str(tmp_path / "gang"), **kw)
+
+
+def test_supervisor_restart_then_success(tmp_path):
+    """Rank 1 dies on attempt 1; the WHOLE gang is torn down, restarted
+    with backoff, and attempt 2 completes — exit 0, one restart."""
+    before = monitor.stat_get("gang.restarts")
+    sup = _sup(tmp_path, """
+beat(0)
+if slot == "1" and attempt == 1:
+    sys.exit(9)
+time.sleep(0.4)  # outlive the victim: prove peers get torn down too
+beat(1)
+""")
+    assert sup.run() == 0
+    assert sup.restarts == 1 and sup.generation == 2
+    assert monitor.stat_get("gang.restarts") == before + 1
+    err = sup.stderr.getvalue()
+    assert "exited with code 9; terminating the pod" in err
+    assert "elastic restart 1/2 after exit code 9" in err
+
+
+def test_supervisor_budget_exhaustion_propagates_code(tmp_path):
+    sup = _sup(tmp_path, """
+beat(0)
+if slot == "1":
+    sys.exit(7)
+time.sleep(5)
+""", max_restarts=1)
+    assert sup.run() == 7
+    assert sup.restarts == 1
+    assert "restart budget exhausted" in sup.stderr.getvalue()
+
+
+def test_supervisor_hang_detection_via_step_watermark(tmp_path):
+    """A rank that keeps BEATING but stops advancing its step watermark
+    is hung, not healthy: the supervisor restarts the gang."""
+    sup = _sup(tmp_path, """
+if attempt > 1:
+    beat(0); sys.exit(0)
+step = 0
+for i in range(200):
+    beat(step)            # liveness stays fresh ...
+    if not (slot == "1" and i >= 3):
+        step += 1         # ... but rank 1's step watermark freezes
+    time.sleep(0.05)
+""", hang_secs=0.6, max_restarts=2)
+    assert sup.run() == 0
+    assert sup.restarts == 1
+    assert "stalled" in sup.stderr.getvalue()
+
+
+def test_supervisor_quarantines_flaky_slot_and_shrinks_world(tmp_path):
+    """A slot that keeps killing the gang is quarantined; the world
+    re-forms WITHOUT it (never below min_np) and completes."""
+    before = monitor.stat_get("gang.quarantined")
+    sup = _sup(tmp_path, """
+beat(0)
+if os.environ["PADDLE_TRAINERS_NUM"] == "1":
+    sys.exit(0)           # the re-formed single-rank world completes
+if slot == "1":
+    sys.exit(3)           # flaky on every attempt
+time.sleep(5)
+""", min_np=1, max_restarts=4, quarantine_after=2)
+    assert sup.run() == 0
+    assert sup.quarantined == {1}
+    assert sup.world_size() == 1
+    assert monitor.stat_get("gang.quarantined") == before + 1
+    assert "quarantined" in sup.stderr.getvalue()
+
+
+def test_supervisor_membership_verdict_triggers_reformation(tmp_path):
+    """A rank deregistering (preemption path) is a membership change:
+    the ElasticManager verdict re-forms the gang even though every
+    child process is still alive."""
+    sup = _sup(tmp_path, """
+if attempt > 1:
+    beat(0); sys.exit(0)
+for i in range(200):
+    beat(i)
+    if slot == "1" and i == 20:
+        os.remove(os.path.join(gang, "rank-1.beat"))
+        time.sleep(20)    # alive, but left the registry
+    time.sleep(0.05)
+""", max_restarts=2, hang_secs=0.0)
+    assert sup.run() == 0
+    assert sup.restarts == 1
+    assert "membership changed" in sup.stderr.getvalue()
+
+
+def test_supervisor_gang_restart_site_fires(tmp_path):
+    with faults.ChaosSchedule("gang.restart@1:delay:0.01") as ch:
+        sup = _sup(tmp_path, """
+beat(0)
+if slot == "0" and attempt == 1:
+    sys.exit(2)
+""")
+        assert sup.run() == 0
+        ch.verify()
+
+
+def test_supervisor_min_np_unformable_raises(tmp_path):
+    with pytest.raises(ValueError, match="min_np"):
+        GangSupervisor(["true"], 2, gang_dir=str(tmp_path / "g"),
+                       min_np=3)
+
+
+# ---------------------------------------------------------------------------
+# observability
+# ---------------------------------------------------------------------------
+
+
+def test_gang_metrics_in_snapshot_and_prometheus(tmp_path):
+    from paddle_tpu.observe import export
+
+    with faults.ChaosSchedule("dist.p2p_recv@1:delay:0.2"):
+        eps = ["127.0.0.1:%d" % p for p in _free_ports(1)]
+        box = _Mailbox(_fake_env(0, eps))
+        with pytest.raises((CollectiveTimeoutError, PeerGoneError)):
+            box.recv(0, timeout=0.05)
+        box._tcp.shutdown()
+        box._tcp.server_close()
+    snap = export.snapshot()
+    assert "gang" in snap
+    assert snap["gang"]["collective_timeouts"] >= 1
+    text = export.prometheus_text()
+    for fam in ("paddle_gang_restarts_total",
+                "paddle_gang_collective_timeouts_total",
+                "paddle_gang_peer_gone_total",
+                "paddle_gang_commits_total",
+                "paddle_gang_restart_lost_seconds_total"):
+        assert fam in text, fam
+
+
+def test_gang_restart_time_folds_into_goodput_as_restart(tmp_path):
+    from paddle_tpu.observe import export
+
+    sup = _sup(tmp_path, """
+beat(0)
+if slot == "1" and attempt == 1:
+    sys.exit(4)
+""")
+    assert sup.run() == 0
+    g = export.goodput()
+    assert g["categories_s"]["restart"] > 0.0  # restart time is lost time

@@ -1,0 +1,110 @@
+"""Fork-based gang chaos certification (ISSUE 14, slow tier): real
+processes under the real launcher, a real SIGKILL delivered while the
+peer is blocked inside a cross-rank collective, and bitwise resume from
+the newest globally committed checkpoint. Fast in-process equivalents
+of every scenario live in tests/test_gang.py (tier-1)."""
+
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PAYLOAD = os.path.join(REPO, "tests", "gang_payload.py")
+
+
+def _clean_env(extra):
+    env = dict(os.environ)
+    for k in list(env):
+        if k.startswith("PADDLE_"):
+            del env[k]
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.update(extra)
+    return env
+
+
+def _launch(tmp_path, name, steps, extra_env, *args):
+    out = str(tmp_path / name)
+    os.makedirs(out, exist_ok=True)
+    log_dir = os.path.join(out, "logs")
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "2", "--elastic_retries", "2",
+         "--gang_dir", os.path.join(out, "gang"),
+         "--log_dir", log_dir, "--poll_interval", "0.05",
+         *args, PAYLOAD],
+        cwd=REPO, capture_output=True, text=True, timeout=240,
+        env=_clean_env({"GANG_OUT": out, "GANG_STEPS": str(steps),
+                        **extra_env}))
+    logs = ""
+    for rank in (0, 1):
+        p = os.path.join(log_dir, f"workerlog.{rank}")
+        if os.path.exists(p):
+            logs += open(p).read()
+    return r, out, logs
+
+
+def _losses(out):
+    got = {}
+    with open(os.path.join(out, "losses.r0.log")) as f:
+        for line in f:
+            step, hexval = line.split()
+            got[int(step)] = hexval  # last execution of a step wins
+    return got
+
+
+def test_sigkill_mid_collective_gang_restarts_and_resumes_bitwise(
+        tmp_path):
+    """One rank is SIGKILLed while its peer is blocked inside the
+    gradient all-reduce. The survivor must unblock with a TYPED error
+    (not hang), the launcher must tear down and restart the whole gang,
+    and the rerun must complete every step with a loss trajectory
+    bitwise identical to an uninterrupted run."""
+    steps = 6
+    clean, cout, clogs = _launch(tmp_path, "clean", steps,
+                                 {"FLAGS_dist_timeout_s": "2.0"})
+    assert clean.returncode == 0, (clean.stderr, clogs)
+
+    t0 = time.time()
+    kill, kout, klogs = _launch(
+        tmp_path, "kill", steps,
+        {"FLAGS_dist_timeout_s": "2.0",
+         "GANG_KILL_RANK": "1", "GANG_KILL_STEP": "4"})
+    assert kill.returncode == 0, (kill.stderr, klogs)
+    assert time.time() - t0 < 200
+    # the whole pod was torn down and restarted exactly once
+    assert "terminating the pod" in kill.stderr
+    assert "elastic restart 1/2" in kill.stderr
+    # the survivor raised a typed retriable error, never hung
+    typed = open(os.path.join(kout, "typed.r0.log")).read()
+    assert "PeerGoneError" in typed or "CollectiveTimeoutError" in typed
+    # bitwise parity with the uninterrupted run, including the
+    # re-executed steps after restore
+    assert _losses(kout) == _losses(cout)
+    assert len(_losses(kout)) == steps
+
+
+def test_hung_rank_detected_by_watermark_and_gang_restarted(tmp_path):
+    """A rank that stays alive but stops heartbeating/advancing is
+    detected by the supervisor's stall watermark (no exit code to key
+    off) and the gang is restarted to completion."""
+    steps = 6
+    clean, cout, clogs = _launch(tmp_path, "clean", steps,
+                                 {"FLAGS_dist_timeout_s": "30.0"})
+    assert clean.returncode == 0, (clean.stderr, clogs)
+
+    hang, hout, hlogs = _launch(
+        tmp_path, "hang", steps,
+        {"FLAGS_dist_timeout_s": "30.0",
+         "GANG_HANG_RANK": "1", "GANG_HANG_STEP": "3"},
+        "--gang_hang_secs", "2.0")
+    assert hang.returncode == 0, (hang.stderr, hlogs)
+    assert "stalled" in hang.stderr
+    assert "elastic restart 1/2" in hang.stderr
+    assert _losses(hout) == _losses(cout)
+    assert len(_losses(hout)) == steps
