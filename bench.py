@@ -424,5 +424,147 @@ def main():
     }))
 
 
+def _overlap_leg(dp, mp, overlap, peak, on_tpu):
+    """One A/B leg: a dp x mp hybrid GPT engine (sequence-parallel
+    blocks — the configuration the ring schedule targets: both the
+    all-gather into the column matmul and the reduce-scatter out of the
+    row matmul decompose into ppermute ring steps) run with
+    FLAGS_mp_overlap on or off, measured for step time, overlap
+    pairing, and compiled peak memory."""
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.hybrid import make_gpt_hybrid_engine
+    from paddle_tpu.distributed.topology import set_hybrid_communicate_group
+    from paddle_tpu.nlp.transformers import (
+        GPTConfig, GPTForPretraining, GPTPretrainingCriterion,
+    )
+
+    if on_tpu:
+        batch = int(os.environ.get("BENCH_OVERLAP_BATCH", "16"))
+        seq, hidden, layers, heads, vocab = 512, 1024, 8, 16, 50304
+        steps, timed_steps = 3, 8
+    else:
+        # heads must divide every mp degree in the sweep (mp up to 8)
+        batch, seq, hidden, layers, heads, vocab = 8, 64, 64, 4, 8, 256
+        steps, timed_steps = 3, 4
+
+    paddle.set_flags({"FLAGS_mp_overlap": overlap})
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": dp, "mp_degree": mp,
+                               "pp_degree": 1, "sharding_degree": 1}
+    fleet.init(is_collective=True, strategy=strategy)
+    hcg = fleet.get_hybrid_communicate_group()
+    try:
+        paddle.seed(7)
+        cfg = GPTConfig(vocab_size=vocab, hidden_size=hidden,
+                        num_layers=layers, num_heads=heads,
+                        max_seq_len=seq, dropout=0.0, use_parallel=True,
+                        sequence_parallel=True)
+        model = GPTForPretraining(cfg)
+        crit = GPTPretrainingCriterion(cfg)
+        opt = paddle.optimizer.SGD(learning_rate=0.01,
+                                   parameters=model.parameters())
+        n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
+        toks = np.random.RandomState(0).randint(
+            0, vocab, (batch, seq + 1)).astype(np.int32)
+        x, y = toks[:, :-1], toks[:, 1:]
+        eng = make_gpt_hybrid_engine(model, crit, opt, hcg)
+        loss = eng.train_batch(x, y)       # compile
+        loss = eng.train_batch(x, y)       # warm
+        import jax as _jax
+        _jax.block_until_ready(eng.rest_params)
+
+        t0 = time.perf_counter()
+        for _ in range(timed_steps):
+            loss = eng.train_batch(x, y)
+        loss_v = float(np.asarray(loss._value))
+        step_s = (time.perf_counter() - t0) / timed_steps
+
+        ovl = eng.overlap_report(steps=steps)
+        try:
+            peak_gb = round(eng.memory_analysis()["peak"] / 2**30, 3)
+        except Exception:
+            peak_gb = None
+
+        flops_per_token = 6.0 * n_params + 12.0 * layers * hidden * seq
+        tokens_per_sec = batch * seq / step_s
+        mfu = flops_per_token * tokens_per_sec / (peak * dp * mp)
+        return {
+            "mesh": f"dp{dp}.mp{mp}",
+            "overlap": overlap,
+            "step_ms": round(step_s * 1e3, 2),
+            "tokens_per_sec": round(tokens_per_sec, 1),
+            "mfu_pct": round(mfu * 100.0, 2),
+            "exposed_collective_frac":
+                round(ovl["exposed_collective_frac"], 4),
+            "collective_share": round(ovl["collective_share"], 4),
+            "hidden_collective_us":
+                round(ovl["hidden_collective_us"], 1),
+            "peak_hbm_gb": peak_gb,
+            "loss": loss_v,
+        }
+    finally:
+        set_hybrid_communicate_group(None)
+        paddle.set_flags({"FLAGS_mp_overlap": False})
+
+
+def overlap_main():
+    """`bench.py --overlap`: collective-matmul A/B across MULTICHIP_r05
+    mesh factorizations of 8 devices.  Each factorization runs the SAME
+    sequence-parallel hybrid GPT step with FLAGS_mp_overlap off (GSPMD
+    collectives) and on (ring-decomposed collective-matmul), and the
+    line's headline is the exposed-collective-fraction on the 2x4 mesh
+    with `vs_baseline` = overlap/baseline (< 1 means the ring schedule
+    hid more collective time behind matmuls)."""
+    if os.environ.get("BENCH_PLATFORM", "") == "cpu" or not _tpu_usable():
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8")
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    import jax
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+    peak = _peak_for(dev)
+    ndev = len(jax.devices())
+
+    legs = []
+    for dp, mp in ((2, 4), (4, 2), (1, 8)):
+        if dp * mp > ndev:
+            continue
+        for overlap in (False, True):
+            legs.append(_overlap_leg(dp, mp, overlap, peak, on_tpu))
+
+    by_mesh = {}
+    for leg in legs:
+        by_mesh.setdefault(leg["mesh"], {})[leg["overlap"]] = leg
+    head = by_mesh.get("dp2.mp4", next(iter(by_mesh.values())))
+    base, over = head[False], head[True]
+
+    print(json.dumps({
+        "metric": "mp_overlap_exposed_collective_frac",
+        "value": over["exposed_collective_frac"],
+        "unit": "fraction_of_device_time",
+        "vs_baseline": round(
+            over["exposed_collective_frac"]
+            / base["exposed_collective_frac"], 3)
+            if base["exposed_collective_frac"] else None,
+        "mesh": base["mesh"],
+        "baseline_exposed_collective_frac":
+            base["exposed_collective_frac"],
+        "device": getattr(dev, "device_kind", dev.platform),
+        "num_devices": ndev,
+        "legs": legs,
+    }))
+    return 0
+
+
 if __name__ == "__main__":
+    if "--overlap" in sys.argv:
+        sys.exit(overlap_main())
     sys.exit(main())

@@ -4,6 +4,14 @@ Times variants with the same differenced scan-N method as bench.py to
 locate where step time goes: full step (default dispatch — the Pallas
 flash kernel at seq >= 128), dropout off, and forced pallas/jnp paths
 for kernel-vs-XLA comparisons.
+
+The `attrib` variant skips the timing sweep and instead captures an
+xplane trace of the running step, printing the device-time bucket split
+(observe.attribute) plus the collective-overlap pairing
+(observe.overlap_report).  A capture whose device plane holds no
+classifiable op rows is a broken capture, not a zero measurement — the
+variant exits nonzero with a message instead of printing a JSON line
+full of silent zeros.
 """
 
 import json
@@ -121,6 +129,8 @@ def main():
     variant = sys.argv[1] if len(sys.argv) > 1 else "full"
     if variant == "longctx":
         return longctx()
+    if variant == "attrib":
+        return attrib()
     if variant == "full":
         eng = build(dropout=0.1)
     elif variant == "nodrop":
@@ -141,6 +151,87 @@ def main():
         raise SystemExit(f"unknown variant {variant}")
     ms = timed_step(eng)
     print(json.dumps({"variant": variant, "step_ms": round(ms, 2)}))
+
+
+def attrib():
+    """Device-time attribution + overlap pairing of the live train step.
+
+    Exits 2 (with a stderr message) when the xplane capture comes back
+    with an empty device plane — zero classified rows means the
+    profiler produced nothing to attribute, and a silent all-zero JSON
+    line would read as "no collective time" rather than "no data".
+    """
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu import amp
+    from paddle_tpu.engine import Engine
+    from paddle_tpu.nlp.transformers import (
+        ErnieConfig, ErnieForPretraining, ErniePretrainingCriterion,
+    )
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if on_tpu:
+        batch, seq = int(os.environ.get("BENCH_BATCH", "32")), 512
+        cfg = ErnieConfig(vocab_size=18000, hidden_size=768, num_layers=12,
+                          num_heads=12, ffn_hidden_size=3072,
+                          max_seq_len=seq, dropout=0.1, attn_dropout=0.1,
+                          use_parallel=False)
+    else:
+        batch, seq = 4, 64
+        cfg = ErnieConfig(vocab_size=512, hidden_size=64, num_layers=2,
+                          num_heads=4, ffn_hidden_size=128,
+                          max_seq_len=seq, dropout=0.0,
+                          use_parallel=False)
+
+    paddle.seed(0)
+    model = ErnieForPretraining(cfg)
+    criterion = ErniePretrainingCriterion(cfg)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=model.parameters(),
+                                 weight_decay=0.01)
+
+    def loss_fn(outputs, mlm_labels):
+        logits, nsp = outputs
+        return criterion(logits, nsp, mlm_labels)
+
+    eng = Engine(model, opt, loss_fn)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32)
+    labels = ids.copy()
+    labels[rng.rand(batch, seq) > 0.15] = -100
+    with amp.auto_cast(enable=True, dtype="bfloat16"):
+        eng.train_batch(ids, labels)
+        eng.train_batch(ids, labels)  # warm: attribute a steady step
+
+    try:
+        report = eng.attribute_step(steps=3)
+        overlap = eng.overlap_report(steps=3)
+    except FileNotFoundError as e:
+        print(f"bench_attrib attrib: xplane capture missing ({e}); the "
+              "profiler wrote no device trace — nothing to attribute",
+              file=sys.stderr)
+        return 2
+    if report["total_us"] <= 0.0 or overlap["total_us"] <= 0.0:
+        print("bench_attrib attrib: xplane capture yielded an EMPTY "
+              "device plane (zero classified op rows); the profiler "
+              "backend produced no device events — refusing to print "
+              "an all-zero attribution", file=sys.stderr)
+        return 2
+    print(json.dumps({
+        "variant": "attrib",
+        "batch": batch, "seq": seq,
+        "buckets_us": {k: round(v, 1)
+                       for k, v in report["buckets"].items()},
+        "fractions": {k: round(v, 4)
+                      for k, v in report["fractions"].items()},
+        "exposed_collective_frac":
+            round(overlap["exposed_collective_frac"], 4),
+        "collective_share": round(overlap["collective_share"], 4),
+        "hidden_collective_us": round(overlap["hidden_collective_us"], 1),
+        "total_us": round(report["total_us"], 1),
+    }))
+    return 0
 
 
 def longctx():

@@ -122,6 +122,16 @@ class ColumnParallelLinear(Layer):
             self.bias = None
 
     def forward(self, x):
+        if not self.gather_output:
+            # latency-hiding path: the SP seq all-gather decomposes into
+            # ring hops hidden behind per-chunk partial matmuls
+            from ....ops import overlap as _overlap
+
+            out = _overlap.maybe_column_parallel(x, self.weight)
+            if out is not None:
+                if self.bias is not None:
+                    out = out + self.bias
+                return shard_hint(out, *([None] * (out.ndim - 1)), MP_AXIS)
         out = F.linear(x, self.weight, self.bias)
         if self.gather_output:
             return shard_hint(out, *([None] * out.ndim))
@@ -155,8 +165,16 @@ class RowParallelLinear(Layer):
     def forward(self, x):
         if self.input_is_parallel:
             x = shard_hint(x, *([None] * (x.ndim - 1)), MP_AXIS)
-        out = apply("matmul_v2", x, self.weight)
-        out = shard_hint(out, *([None] * out.ndim))  # forces the all-reduce
+        # latency-hiding path: the mp all-reduce (or SP reduce-scatter)
+        # decomposes into ring hops hidden behind partial matmuls; the
+        # shard_map output already carries its final sharding, so no
+        # forcing hint is needed
+        from ....ops import overlap as _overlap
+
+        out = _overlap.maybe_row_parallel(x, self.weight)
+        if out is None:
+            out = apply("matmul_v2", x, self.weight)
+            out = shard_hint(out, *([None] * out.ndim))  # forces all-reduce
         if self.bias is not None:
             out = out + self.bias
         return out

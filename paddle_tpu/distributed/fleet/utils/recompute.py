@@ -14,6 +14,35 @@ from __future__ import annotations
 import jax
 
 from ....core.tensor import Tensor
+from ....framework.flags import flag
+
+
+def remat_wrapper(default="full"):
+    """Resolve FLAGS_remat_policy to a jax.checkpoint-style wrapper.
+
+    Returns a callable `wrap(fn) -> fn'`:
+      - 'full'          -> jax.checkpoint(fn): save nothing, recompute all
+      - 'dots_saveable' -> jax.checkpoint(fn, policy=dots_saveable): the
+                          matmul outputs are saved, the cheap elementwise
+                          tail is recomputed
+      - 'none'          -> fn unchanged: all residuals saved, no recompute
+      - 'auto'          -> the site's own `default` (recompute() segments
+                          default to 'full'; the hybrid block scan passes
+                          'none' so auto keeps its save-residuals shape)
+    """
+    policy = flag("FLAGS_remat_policy")
+    if policy == "auto":
+        policy = default
+    if policy == "full":
+        return jax.checkpoint
+    if policy == "dots_saveable":
+        return lambda fn: jax.checkpoint(
+            fn, policy=jax.checkpoint_policies.dots_saveable)
+    if policy == "none":
+        return lambda fn: fn
+    raise ValueError(
+        f"FLAGS_remat_policy={policy!r}; expected "
+        "auto | full | dots_saveable | none")
 
 
 def recompute(function, *args, **kwargs):
@@ -43,7 +72,8 @@ def recompute(function, *args, **kwargs):
             lambda t: t._value if isinstance(t, Tensor) else t, out,
             is_leaf=lambda t: isinstance(t, Tensor))
 
-    out = jax.checkpoint(fn_arrays)(
+    wrap = remat_wrapper(default="full")
+    out = wrap(fn_arrays)(
         *[args[i]._value for i in tensor_idx])
     return jax.tree.map(Tensor, out)
 

@@ -114,6 +114,9 @@ class HybridParallelEngine:
         }
         self._step_fn = None
         self._offload_sh = None
+        self._step_protos = None
+        self._mem_analysis = None
+        self._last_batch = None
         self._shardings = self._build_shardings(specs)
 
     # -- sharding specs ------------------------------------------------------
@@ -219,23 +222,39 @@ class HybridParallelEngine:
                            if k not in _asp_covered}
 
         from ..core.config import no_tape
+        from ..ops import overlap as _overlap
+        from .fleet.utils.recompute import remat_wrapper
+
+        # FLAGS_remat_policy: 'auto' keeps the scan's save-residuals
+        # shape; full/dots_saveable rematerialize each block in backward
+        remat = remat_wrapper(default="none")
+
+        def run_block(h, kk, layer_params):
+            with _random.rng_scope(kk):
+                with no_tape(), _swap_state(template, layer_params):
+                    out = template(Tensor(h))
+            return out._value if isinstance(out, Tensor) else out
 
         def stage_fn(stage_params, x):
             # stage_params leaves: [Lps, ...]; scan the blocks
             def body(h, inp):
                 layer_params, idx = inp
-                with _random.rng_scope(
-                        jax.random.fold_in(_random.next_key(), idx)):
-                    with no_tape(), _swap_state(template, layer_params):
-                        out = template(Tensor(h))
-                return out._value if isinstance(out, Tensor) else out, None
+                # fold-in OUTSIDE the remat wrapper: the trace-level RNG
+                # stream is consumed exactly once per block regardless
+                # of policy (backward replays get the key as an arg)
+                kk = jax.random.fold_in(_random.next_key(), idx)
+                return remat(run_block)(h, kk, layer_params), None
 
             h, _ = jax.lax.scan(body, x,
                                 (stage_params, jnp.arange(Lps)))
             return h
 
+        # pp==1 needs no pipeline: the single stage runs on the merged
+        # micro axis (exact — one stage, no bubbles), which also keeps
+        # the step a plain GSPMD trace the overlap ring shard_map can
+        # nest in under the old-jax compat shim
         pipeline = pipeline_spmd(stage_fn, mesh, num_stages=S,
-                                 num_micro=M)
+                                 num_micro=M) if S > 1 else None
 
         # per-param decay/lr-mult constants (mirrors eager _preprocess);
         # block params take their meta from the template block's Parameter
@@ -244,15 +263,25 @@ class HybridParallelEngine:
         rest_metas = opt.param_metas_for(self.rest_params,
                                          self.model.state_dict())
 
+        # mp collective-matmul overlap: active only when FLAGS_mp_overlap
+        # (or the FORCE env) is on AND the mesh is pure dp x mp — the
+        # region is a trace-time no-op otherwise
+        seq_parallel = bool(getattr(template, "sequence_parallel", False))
+
         def loss_of(block_params, rest_params, buffers, batch, key):
             tokens, labels = batch
-            with _random.rng_scope(key):
+            with _random.rng_scope(key), _overlap.region(
+                    mesh, sequence_parallel=seq_parallel):
                 values = {**buffers, **rest_params}
                 x = embed_fn(self.model, values, tokens)  # [B, s, h]
                 b, s, h = x.shape
-                x = x.reshape((M, b // M, s, h))
-                x = pipeline(block_params, x)
-                x = x.reshape((b, s, h))
+                if pipeline is not None:
+                    x = x.reshape((M, b // M, s, h))
+                    x = pipeline(block_params, x)
+                    x = x.reshape((b, s, h))
+                else:
+                    x = stage_fn(jax.tree.map(lambda v: v[0],
+                                              block_params), x)
                 loss = head_fn(self.model, values, x, labels)
                 return loss.astype(jnp.float32)
 
@@ -283,6 +312,10 @@ class HybridParallelEngine:
 
         def _step_impl(block_params, rest_params, buffers, opt_state,
                        batch, lr, key):
+            from .. import observe as _observe
+
+            _observe.record_compile(
+                "hybrid_step", signature=_observe.signature_of(batch))
             loss, (gb, gr) = jax.value_and_grad(
                 loss_of, argnums=(0, 1))(block_params, rest_params,
                                          buffers, batch, key)
@@ -308,7 +341,9 @@ class HybridParallelEngine:
                 nr = apply_masks_tree(self.model, nr,
                                       engine_name="HybridParallelEngine",
                                       masks=_asp_rest_masks)
-            return loss, nb, nr, {"blocks": ob, "rest": orr}
+            # buffers pass through as an output so they can be donated:
+            # every engine-state leaf is arg<->output aliased
+            return loss, nb, nr, buffers, {"blocks": ob, "rest": orr}
 
         sh = self._shardings
         self._step_fn = jax.jit(
@@ -317,8 +352,11 @@ class HybridParallelEngine:
                           sh["opt"], (sh["data"], sh["data"]),
                           sh["repl"], sh["repl"]),
             out_shardings=(sh["repl"], sh["blocks"], sh["rest"],
-                           sh["opt"]),
-            donate_argnums=(0, 1, 3))
+                           sh["buffers"], sh["opt"]),
+            donate_argnums=(0, 1, 2, 3))
+        # raw (unjitted) step for bench harnesses that re-jit it inside
+        # a scan (bench_attrib._timed_scan_ms)
+        self._step_fn._raw_step_fn = step_fn
 
     def train_batch(self, tokens, labels):
         if self._step_fn is None:
@@ -335,19 +373,137 @@ class HybridParallelEngine:
             jnp.asarray(tokens)
         l = labels._value if isinstance(labels, Tensor) else \
             jnp.asarray(labels)
+        self._last_batch = (t, l)
         key = _random.default_generator.next_key()
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         opt_state = self.opt_state
         if self._offload_sh is not None:
             opt_state = jax.device_put(opt_state, self._offload_sh[0])
-        loss, self.block_params, self.rest_params, new_opt = \
-            self._step_fn(self.block_params, self.rest_params,
-                          self.rest_buffers, opt_state, (t, l), lr,
-                          key)
+        if self._step_protos is None:
+            self._step_protos = jax.tree.map(
+                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+                (self.block_params, self.rest_params, self.rest_buffers,
+                 opt_state, (t, l), lr, key))
+            self._mem_analysis = None
+        loss, self.block_params, self.rest_params, self.rest_buffers, \
+            new_opt = self._step_fn(self.block_params, self.rest_params,
+                                    self.rest_buffers, opt_state, (t, l),
+                                    lr, key)
         if self._offload_sh is not None:
             new_opt = jax.device_put(new_opt, self._offload_sh[1])
         self.opt_state = new_opt
         return Tensor(loss)
+
+    # -- step introspection --------------------------------------------------
+    def schedule(self):
+        """The ordered phase list of ONE compiled hybrid step — embed,
+        the N transformer blocks, head, gradient reduction, optimizer —
+        each with its per-phase sharding specs. Pure metadata built from
+        the engine's sharding rules (no tracing, no device work), stable
+        across rebuilds of the same configuration: the introspection
+        hook the sharded serving engine starts from (ROADMAP item 1)."""
+        sh = self._shardings
+        block_specs = OrderedDict(
+            (k, sh["blocks"][k].spec) for k in sorted(sh["blocks"]))
+        embed = OrderedDict()
+        head = OrderedDict()
+        for k in sorted(self.rest_params):
+            target = embed if "embedding" in k else head
+            target[k] = sh["rest"][k].spec
+        phases = [dict(name="embed", kind="embed", params=embed)]
+        for i in range(self.num_layers):
+            phases.append(dict(
+                name=f"block{i}", kind="block",
+                stage=i // self.layers_per_stage, params=block_specs))
+        phases.append(dict(name="head", kind="head", params=head))
+        reduce_axes = [DP_AXIS]
+        if self.zero_stage >= 2 and \
+                self.mesh.shape.get(SHARDING_AXIS, 1) > 1:
+            reduce_axes.append(SHARDING_AXIS)
+        phases.append(dict(name="grad-reduce", kind="collective",
+                           axes=tuple(reduce_axes), params=OrderedDict()))
+        opt_specs = OrderedDict()
+        for group in ("blocks", "rest"):
+            for k in sorted(sh["opt"][group]):
+                opt_specs[f"{group}.{k}"] = jax.tree.map(
+                    lambda s: s.spec, sh["opt"][group][k])
+        phases.append(dict(name="opt", kind="opt", params=opt_specs))
+        return phases
+
+    def memory_analysis(self) -> dict:
+        """MEASURED per-step device memory of the compiled hybrid step
+        (same keys as Engine.memory_analysis; `alias` is the donated
+        arg<->output reuse the donation audit asserts on)."""
+        if self._step_fn is None or self._step_protos is None:
+            raise RuntimeError("run train_batch() once first")
+        if self._mem_analysis is None:
+            from .. import observe as _observe
+
+            with _observe.retrace.suppress():
+                ma = self._step_fn.lower(*self._step_protos) \
+                    .compile().memory_analysis()
+            peak = getattr(ma, "peak_memory_in_bytes", 0) or (
+                ma.argument_size_in_bytes + ma.temp_size_in_bytes
+                + ma.output_size_in_bytes - ma.alias_size_in_bytes)
+            self._mem_analysis = {
+                "arguments": ma.argument_size_in_bytes,
+                "temps": ma.temp_size_in_bytes,
+                "outputs": ma.output_size_in_bytes,
+                "alias": ma.alias_size_in_bytes,
+                "generated_code": ma.generated_code_size_in_bytes,
+                "peak": peak,
+                "host_arguments": ma.host_argument_size_in_bytes,
+                "host_temps": ma.host_temp_size_in_bytes,
+                "host_outputs": ma.host_output_size_in_bytes,
+            }
+            _observe.annotate("hybrid_step", peak_bytes=peak)
+        return dict(self._mem_analysis)
+
+    def attribute_step(self, logdir=None, steps=1, top=10):
+        """Capture an xplane trace of `steps` replays of the LAST
+        train_batch shape and classify device time into the observe
+        buckets. State is donated, so these are REAL steps."""
+        if self._last_batch is None:
+            raise RuntimeError("run train_batch() once first")
+        import tempfile
+
+        from .. import observe as _observe, profiler as _profiler
+
+        if logdir is None:
+            logdir = tempfile.mkdtemp(prefix="paddle-attrib-")
+        tokens, labels = self._last_batch
+        _profiler.start_trace(logdir)
+        try:
+            for _ in range(steps):
+                self.train_batch(tokens, labels)
+            jax.block_until_ready(self.rest_params)
+        finally:
+            _profiler.stop_trace()
+        return _observe.attribute(logdir, top=top)
+
+    def overlap_report(self, logdir=None, steps=1):
+        """Capture a trace of `steps` real steps and pair the collective
+        bucket against concurrently-resident matmul/attention time:
+        returns observe.overlap_report's dict, whose headline
+        `exposed_collective_frac` is the share of device time spent in
+        collectives with NO compute in flight."""
+        if self._last_batch is None:
+            raise RuntimeError("run train_batch() once first")
+        import tempfile
+
+        from .. import observe as _observe, profiler as _profiler
+
+        if logdir is None:
+            logdir = tempfile.mkdtemp(prefix="paddle-overlap-")
+        tokens, labels = self._last_batch
+        _profiler.start_trace(logdir)
+        try:
+            for _ in range(steps):
+                self.train_batch(tokens, labels)
+            jax.block_until_ready(self.rest_params)
+        finally:
+            _profiler.stop_trace()
+        return _observe.overlap_report(logdir)
 
 
 # -- adapters for the nlp model family --------------------------------------

@@ -323,6 +323,10 @@ def make_train_step(layer, loss_fn, optimizer, *, grad_clip=None,
 
     asp_masks = _asp_masks_for(layer)
 
+    from .ops import overlap as _overlap
+
+    _seq_parallel = _overlap.model_sequence_parallel(layer)
+
     def loss_of(params, buffers, batch, key):
         if comm_dtype is not None:
             from .amp import auto_cast
@@ -330,7 +334,10 @@ def make_train_step(layer, loss_fn, optimizer, *, grad_clip=None,
             amp_ctx = auto_cast(enable=True, level="O2", dtype=comm_dtype)
         else:
             amp_ctx = contextlib.nullcontext()
-        with _random.rng_scope(key), amp_ctx:
+        # mp collective-matmul overlap (trace-time no-op unless
+        # FLAGS_mp_overlap is on and the mesh is pure dp x mp)
+        with _random.rng_scope(key), amp_ctx, _overlap.region(
+                mesh, sequence_parallel=_seq_parallel):
             inputs = batch["inputs"]
             if not isinstance(inputs, (list, tuple)):
                 inputs = (inputs,)
@@ -772,6 +779,32 @@ class Engine:
         finally:
             _profiler.stop_trace()
         return _observe.attribute(logdir, top=top)
+
+    def overlap_report(self, logdir=None, steps=1):
+        """Capture a trace of `steps` real steps (same mechanics as
+        attribute_step) and pair the collective bucket against
+        concurrently-resident matmul/attention time: returns
+        observe.overlap_report's dict, whose headline
+        `exposed_collective_frac` is the share of device time spent in
+        collectives with NO compute in flight — the number the
+        FLAGS_mp_overlap ring schedule exists to push down."""
+        if self._last_batch is None:
+            raise RuntimeError("run train_batch() once first")
+        import tempfile
+
+        from . import observe as _observe, profiler as _profiler
+
+        if logdir is None:
+            logdir = tempfile.mkdtemp(prefix="paddle-overlap-")
+        inputs, labels = self._last_batch
+        _profiler.start_trace(logdir)
+        try:
+            for _ in range(steps):
+                self.train_batch(inputs, labels)
+            jax.block_until_ready(self.state.params)
+        finally:
+            _profiler.stop_trace()
+        return _observe.overlap_report(logdir)
 
     def memory_analysis(self) -> dict:
         """MEASURED per-step device memory of the compiled train step

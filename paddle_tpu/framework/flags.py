@@ -167,6 +167,24 @@ _DEFS = {
         "hang detection; keep this above FLAGS_dist_timeout_s so "
         "collective-blocked victims unblock via their deadline and the "
         "stall is attributed to the rank that actually died)"),
+    "FLAGS_mp_overlap": (
+        False, bool,
+        "distributed: route mp-sharded matmuls through the ring-"
+        "decomposed collective-matmul kernels (ops/overlap.py) — the "
+        "column-parallel all-gather / row-parallel reduce-scatter / "
+        "all-reduce become lax.ppermute steps interleaved with "
+        "per-shard partial matmuls so collective time hides behind "
+        "compute. PADDLE_TPU_MP_OVERLAP_FORCE=on|off overrides; "
+        "unsupported meshes fall back to the GSPMD collectives"),
+    "FLAGS_remat_policy": (
+        "auto", str,
+        "rematerialisation policy for recompute() segments and the "
+        "hybrid engine's per-block remat: 'full' saves nothing inside "
+        "the segment (max recompute, min memory), 'dots_saveable' "
+        "saves matmul outputs (jax dots_saveable policy), 'none' "
+        "disables remat (max memory, no recompute). 'auto' keeps each "
+        "site's default: recompute() segments remat fully, the hybrid "
+        "block scan saves its residuals"),
     "FLAGS_flight_recorder_capacity": (
         256, int,
         "observe: ring-buffer size of the always-on flight recorder "

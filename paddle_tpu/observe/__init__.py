@@ -14,7 +14,8 @@ Four coupled pieces, one import:
 """
 
 from .timeline import (BUCKETS, StepTimeline, attribute, attribute_rows,  # noqa: F401
-                       classify_op, phase, timeline)
+                       classify_op, overlap_report, overlap_stats, phase,
+                       timeline)
 from .retrace import (RetraceError, annotate, compile_events, no_retrace,  # noqa: F401
                       record_compile, signature_of, suppress)
 from . import retrace  # noqa: F401
@@ -25,7 +26,7 @@ from .export import dump, goodput, prometheus_text, snapshot  # noqa: F401
 
 __all__ = [
     "BUCKETS", "StepTimeline", "attribute", "attribute_rows", "classify_op",
-    "phase", "timeline",
+    "overlap_report", "overlap_stats", "phase", "timeline",
     "RetraceError", "annotate", "compile_events", "no_retrace",
     "record_compile", "signature_of", "suppress", "retrace",
     "FlightRecorder", "flight", "flight_guard", "install_signal_handler",

@@ -33,7 +33,8 @@ import threading
 import time
 
 __all__ = ["StepTimeline", "timeline", "phase", "BUCKETS", "classify_op",
-           "attribute", "attribute_rows"]
+           "attribute", "attribute_rows", "overlap_stats",
+           "overlap_report"]
 
 
 class StepTimeline:
@@ -105,13 +106,20 @@ _FRAMEWORK_RE = re.compile(
     r"Outfeed|program|shard_args|DevicePut|device_put|BufferFrom|"
     r"TransferTo|CopyTo|H2D|D2H|step\.|serving\.|checkpoint\.|train\.)")
 
+# HLO control-flow wrappers: a `call.3` / `while.2` row's duration
+# encloses its children, which appear as their own rows — counting the
+# wrapper double-counts the body (seen with the remat'd block scan)
+_WRAPPER_RE = re.compile(r"^(call|while|conditional)(\.\d+)?$")
+
 # ordered: the first matching bucket wins (softmax -> attention even
 # though a fused name may also contain "multiply"; "convert" must not
-# hit the matmul "conv" pattern)
+# hit the matmul "conv" pattern). Collective names are separator-
+# tolerant: fusion rows spell them with underscores (`all_gather_fusion`
+# vs the plain op's `all-gather.3`)
 _BUCKET_RES = (
     ("collective", re.compile(
-        r"all-reduce|all-gather|all-to-all|reduce-scatter|collective|"
-        r"permute|psum|send|recv")),
+        r"all[-_]reduce|all[-_]gather|all[-_]to[-_]all|"
+        r"reduce[-_]scatter|collective|permute|psum|send|recv")),
     ("attention", re.compile(r"attention|flash|mha|softmax")),
     ("matmul", re.compile(r"dot|conv(?!ert)|gemm|einsum|matmul")),
     ("elementwise", re.compile(
@@ -127,6 +135,8 @@ def classify_op(name):
     if name.startswith("$") or _FRAMEWORK_RE.search(name):
         return None
     low = name.lower()
+    if _WRAPPER_RE.match(low):
+        return None
     for bucket, rx in _BUCKET_RES:
         if rx.search(low):
             return bucket
@@ -164,3 +174,76 @@ def attribute(logdir, top=10):
 
     _, rows = profiler.device_op_table(logdir)
     return attribute_rows(rows, top=top)
+
+
+def _merge_intervals(ivs):
+    """Union of (start, end) intervals, sorted and coalesced."""
+    merged = []
+    for s, e in sorted(ivs):
+        if merged and s <= merged[-1][1]:
+            merged[-1][1] = max(merged[-1][1], e)
+        else:
+            merged.append([s, e])
+    return merged
+
+
+def _covered(iv, merged):
+    """Length of interval `iv` covered by the merged union."""
+    s, e = iv
+    cov = 0.0
+    for ms, me in merged:
+        if me <= s:
+            continue
+        if ms >= e:
+            break
+        cov += min(e, me) - max(s, ms)
+    return cov
+
+
+def overlap_stats(events):
+    """Pair collective device time against CONCURRENTLY-RESIDENT compute.
+
+    events: `profiler.device_op_events` rows (per-occurrence intervals
+    on the capture's shared clock). Every collective interval is
+    intersected with the union of matmul+attention intervals across all
+    device lines: the covered part is collective time hidden behind
+    compute somewhere on the chip set; the rest is exposed — time the
+    interconnect serializes the step. `exposed_collective_frac` (exposed
+    collective time over total classified device time) is the headline
+    the FLAGS_mp_overlap ring schedule exists to push down."""
+    comp, coll = [], []
+    compute_us = collective_us = total_us = 0.0
+    for e in events:
+        b = classify_op(e["name"])
+        if b is None:
+            continue
+        iv = (e["start_us"], e["start_us"] + e["dur_us"])
+        total_us += e["dur_us"]
+        if b == "collective":
+            coll.append(iv)
+            collective_us += e["dur_us"]
+        elif b in ("matmul", "attention"):
+            comp.append(iv)
+            compute_us += e["dur_us"]
+    merged = _merge_intervals(comp)
+    hidden = sum(_covered(iv, merged) for iv in coll)
+    exposed = max(collective_us - hidden, 0.0)
+    return {
+        "collective_us": collective_us,
+        "compute_us": compute_us,
+        "hidden_collective_us": hidden,
+        "exposed_collective_us": exposed,
+        "exposed_collective_frac": (exposed / total_us
+                                    if total_us else 0.0),
+        "collective_share": (collective_us / total_us
+                             if total_us else 0.0),
+        "total_us": total_us,
+    }
+
+
+def overlap_report(logdir):
+    """Parse an xplane capture and report how much collective time hides
+    behind concurrently-resident compute (see overlap_stats)."""
+    from .. import profiler
+
+    return overlap_stats(profiler.device_op_events(logdir))

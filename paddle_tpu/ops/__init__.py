@@ -23,3 +23,4 @@ from . import extra_ops  # noqa: F401
 from . import long_tail_ops  # noqa: F401
 from . import compat_ops  # noqa: F401
 from . import quant_ops  # noqa: F401
+from . import overlap  # noqa: F401

@@ -31,7 +31,7 @@ __all__ = [
     "disable_op_profiling", "is_op_profiling_enabled", "reset", "events",
     "mem_events", "record_device_memory", "summary", "percentiles",
     "export_chrome_tracing", "profile", "start_trace", "stop_trace",
-    "device_op_table",
+    "device_op_table", "device_op_events",
 ]
 
 # rolling windows: the always-on step timeline (paddle_tpu.observe)
@@ -374,6 +374,86 @@ def device_op_table(logdir, top=None, sorted_by="total"):
             f"{r['name'][:51]:<52}{r['calls']:>8}{r['total']:>14.1f}"
             f"{r['avg']:>12.1f}{r['max']:>12.1f}")
     return "\n".join(lines), rows
+
+
+def device_op_events(logdir):
+    """Per-event DEVICE intervals from an XProf capture: a flat list of
+    ``{name, line, start_us, dur_us}`` rows with start times absolute
+    within the capture (XLine.timestamp_ns + XEvent.offset_ps — one
+    shared clock across the capture's lines). Where `device_op_table`
+    aggregates totals per op name, this keeps every occurrence so the
+    overlap report can intersect collective intervals with the
+    concurrently-resident compute intervals."""
+    import glob as _glob
+
+    from ..utils.protowire import fields
+
+    paths = sorted(_glob.glob(
+        os.path.join(logdir, "**", "*.xplane.pb"), recursive=True))
+    if not paths:
+        raise FileNotFoundError(f"no *.xplane.pb under {logdir}")
+    out = []
+
+    def plane_name(buf):
+        for f, w, v in fields(buf):
+            if f == 2 and w == 2:
+                return v.decode(errors="replace")
+        return ""
+
+    def walk_plane(buf):
+        meta = {}
+        for f, w, v in fields(buf):
+            if f == 4 and w == 2:          # event_metadata map entry
+                mid, name = None, None
+                for f2, w2, v2 in fields(v):
+                    if f2 == 1 and w2 == 0:
+                        mid = v2
+                    elif f2 == 2 and w2 == 2:  # XEventMetadata
+                        for f3, w3, v3 in fields(v2):
+                            if f3 == 1 and w3 == 0:
+                                mid = v3
+                            elif f3 == 2 and w3 == 2:
+                                name = v3.decode(errors="replace")
+                if mid is not None and name:
+                    meta[mid] = name
+        for f, w, v in fields(buf):
+            if f != 3 or w != 2:           # XLine
+                continue
+            line_name, ts_ns = "", 0
+            evs = []
+            for f2, w2, v2 in fields(v):
+                if f2 == 2 and w2 == 2:
+                    line_name = v2.decode(errors="replace")
+                elif f2 == 3 and w2 == 0:
+                    ts_ns = v2
+                elif f2 == 4 and w2 == 2:  # XEvent
+                    evs.append(v2)
+            for ev in evs:
+                mid, off_ps, dur_ps = None, 0, 0
+                for f3, w3, v3 in fields(ev):
+                    if f3 == 1 and w3 == 0:
+                        mid = v3
+                    elif f3 == 2 and w3 == 0:
+                        off_ps = v3            # picoseconds
+                    elif f3 == 3 and w3 == 0:
+                        dur_ps = v3            # picoseconds
+                name = meta.get(mid)
+                if name and not name.startswith("$"):
+                    out.append({
+                        "name": name, "line": line_name,
+                        "start_us": ts_ns / 1e3 + off_ps / 1e6,
+                        "dur_us": dur_ps / 1e6,
+                    })
+
+    for path in paths:
+        with open(path, "rb") as f:
+            space = f.read()
+        planes = [v for fno, w, v in fields(space) if fno == 1 and w == 2]
+        device = [p for p in planes if plane_name(p).startswith("/device:")]
+        for p in device or [p for p in planes
+                            if plane_name(p) == "/host:CPU"]:
+            walk_plane(p)
+    return out
 
 
 def start_trace(logdir):

@@ -175,6 +175,68 @@ def test_classify_op_rules():
     assert observe.classify_op("PjitFunction(f)") is None
     assert observe.classify_op("shard_args") is None
     assert observe.classify_op("$src.py:12 fn") is None
+    # collective rows are separator-tolerant: fusion names use
+    # underscores where the plain HLO ops use dashes — both must land
+    # in the collective bucket, NOT fall through to "fusion"/elementwise
+    assert observe.classify_op("all_gather_fusion") == "collective"
+    assert observe.classify_op("all-gather.3") == "collective"
+    assert observe.classify_op("reduce_scatter.1") == "collective"
+    assert observe.classify_op("reduce-scatter.271") == "collective"
+    assert observe.classify_op("collective-permute.2") == "collective"
+    assert observe.classify_op("collective_permute_start") == "collective"
+    assert observe.classify_op("all_to_all.4") == "collective"
+    # HLO control-flow wrappers enclose their children (which appear as
+    # their own rows): counting them would double the body
+    assert observe.classify_op("call.3") is None
+    assert observe.classify_op("while.2") is None
+    assert observe.classify_op("conditional") is None
+    assert observe.classify_op("call") is None
+    # ...but names merely CONTAINING those words are real ops
+    assert observe.classify_op("recall_fusion") == "elementwise"
+
+
+def test_collective_bucket_nonzero_on_mp_mesh(tmp_path):
+    """Satellite gate: an mp-sharded program's xplane capture must show
+    a NONZERO collective bucket on the 2-device CPU mesh — the
+    all-gather/reduce-scatter/collective-permute rows land in
+    `collective`, not in the fusion/elementwise catch-all."""
+    import jax
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from paddle_tpu import profiler
+
+    if jax.device_count() < 2:
+        pytest.skip("needs 2 devices")
+    mesh = Mesh(np.array(jax.devices()[:2]), ("mp",))
+
+    def local(a):
+        peer = jax.lax.ppermute(a, "mp", [(0, 1), (1, 0)])
+        return jax.lax.psum(a @ peer.T, "mp")
+
+    f = jax.jit(jax.shard_map(local, mesh=mesh, in_specs=P("mp", None),
+                              out_specs=P(), axis_names={"mp"},
+                              check_vma=False))
+    x = np.random.RandomState(0).randn(128, 64).astype(np.float32)
+    jax.block_until_ready(f(x))          # compile outside the capture
+    logdir = str(tmp_path / "mp2")
+    profiler.start_trace(logdir)
+    try:
+        for _ in range(3):
+            jax.block_until_ready(f(x))
+    finally:
+        profiler.stop_trace()
+    rep = observe.attribute(logdir)
+    assert rep["total_us"] > 0
+    assert rep["buckets"]["collective"] > 0, rep["buckets"]
+    assert rep["buckets"]["matmul"] > 0, rep["buckets"]
+    # the per-occurrence event view classifies the same rows
+    events = profiler.device_op_events(logdir)
+    assert any(observe.classify_op(e["name"]) == "collective"
+               for e in events)
+    stats = observe.overlap_stats(events)
+    assert stats["collective_us"] > 0
+    assert stats["collective_us"] == pytest.approx(
+        stats["hidden_collective_us"] + stats["exposed_collective_us"])
 
 
 # ---------------------------------------------------------------------------
