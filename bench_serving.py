@@ -38,6 +38,17 @@ workload simulator (serving/workload.py) instead of closed-loop
 clients, emitting ``BENCH_SERVING_TRACE`` — the same scenario language
 bench_fleet.py sweeps, so the LLM bench and the elasticity bench grade
 against identical offered load.
+
+Fast-decode legs: ``--spec [K]`` turns on speculative decoding (K draft
+tokens per round, self-draft by default — the ISSUE-16 acceptance
+config) and ``--int8`` freezes the weights to int8 through the dequant
+epilogue path; every row reports ``tokens_per_s_per_chip`` and
+``acceptance_rate``. ``--smoke`` runs the certification instead of the
+sweep: a plain-greedy baseline leg vs a speculative leg (vs an optional
+``--int8`` leg) over the same pinned prompts, asserting >= 2x decode
+tokens/s at acceptance >= 0.7, a bitwise-equal greedy output digest,
+compile counters frozen at one trace per kind for the server's life,
+and zero errors — then emits one ``BENCH_SERVING_SMOKE`` object.
 """
 
 from __future__ import annotations
@@ -83,12 +94,16 @@ def run_level(server, n_clients, steps, prompt_len, max_new, vocab,
     for t in threads:
         t.join()
     wall = time.monotonic() - t0
+    import jax
+
     eng = server.engine
     snap = server.snapshot()
     lat = snap["latency_s"].get("e2e", {})
     blk = snap.get("kv_blocks", {})
     pfx = snap.get("prefix_cache", {})
     cp = snap.get("chunked_prefill", {})
+    spec = snap.get("speculative", {})
+    ndev = max(jax.device_count(), 1)
     row = {
         "clients": n_clients,
         "requests": done[0],
@@ -96,6 +111,9 @@ def run_level(server, n_clients, steps, prompt_len, max_new, vocab,
         "wall_s": round(wall, 4),
         "qps": round(done[0] / wall, 3),
         "tokens_per_s": round(done[0] * max_new / wall, 2),
+        "tokens_per_s_per_chip": round(done[0] * max_new / wall / ndev,
+                                       2),
+        "acceptance_rate": round(spec.get("acceptance_rate", 0.0), 4),
         "occupancy_avg": round(snap["batch_occupancy"]["avg"], 4),
         "occupancy_max": round(snap["batch_occupancy"]["max"], 4),
         # peak simultaneous in-flight requests this level actually hit
@@ -315,6 +333,128 @@ def run_chaos(args, model, serving):
     return 0
 
 
+def run_smoke(args, serving):
+    """--smoke: the ISSUE-16 fast-decode certification. Same pinned
+    greedy prompts through a plain baseline leg and a speculative
+    (self-draft) leg — plus an ``--int8`` leg when asked — asserting
+    the >=2x tokens/s speedup at >=0.7 acceptance, bitwise output
+    parity (sha256 digest over all emitted ids), one compiled trace
+    per kind for each server's whole life, and zero errors."""
+    import hashlib
+
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu.nlp.transformers import GPTConfig, GPTForPretraining
+
+    k = args.spec or 3
+    max_new, n_req, prompt_len = 24, 6, 8
+    # hidden 256 x 6 layers over a 64-wide unified step: enough
+    # per-dispatch compute that the speedup reflects column work (the
+    # TPU regime), not host dispatch overhead, while a full leg stays
+    # ~1s on a tier-1 CPU run. The wide step is the point: base decode
+    # pays all 64 columns for 1 token/slot, speculation fills k+1 of
+    # them per round for the same step cost.
+    paddle.seed(7)
+    cfg = GPTConfig(vocab_size=512, hidden_size=256, num_layers=6,
+                    num_heads=4, max_seq_len=64, dropout=0.0,
+                    attn_dropout=0.0, use_parallel=False)
+    model = GPTForPretraining(cfg)
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(1, cfg.vocab_size,
+                           (prompt_len,)).astype(np.int32)
+               for _ in range(n_req)]
+    ndev = max(jax.device_count(), 1)
+
+    def leg(spec_len, quantize):
+        server = serving.Server(
+            model, max_slots=4, max_seq_len=64, block_size=16,
+            num_blocks=17, prefill_chunk=64, spec_len=spec_len,
+            quantize=quantize).start()
+        # compile outside the timed window (same trace serves the run)
+        server.generate(prompts[0], max_new_tokens=4, timeout=120.0)
+        # best-of-2: one repetition can eat a scheduler hiccup on a
+        # loaded CI box; greedy decode makes both reps bitwise equal
+        wall, outs = None, None
+        for _ in range(2):
+            t0 = time.monotonic()
+            futs = [server.submit(p, max_new_tokens=max_new,
+                                  timeout=120.0)
+                    for p in prompts]
+            outs = [np.asarray(f.result(120.0), np.int64)
+                    for f in futs]
+            rep = time.monotonic() - t0
+            wall = rep if wall is None else min(wall, rep)
+        snap = server.snapshot()
+        counts = {str(c): v
+                  for c, v in server.engine.compile_counts.items()}
+        server.shutdown(drain=True)
+        spec = snap.get("speculative", {})
+        return {
+            "tokens_per_s": round(n_req * max_new / wall, 2),
+            "tokens_per_s_per_chip": round(
+                n_req * max_new / wall / ndev, 2),
+            "wall_s": round(wall, 4),
+            "acceptance_rate": round(
+                spec.get("acceptance_rate", 0.0), 4),
+            "errors": snap["counters"].get("failed", 0),
+            "compiles": counts,
+            "digest": hashlib.sha256(
+                b"".join(np.ascontiguousarray(o, np.int64).tobytes()
+                         for o in outs)).hexdigest(),
+        }
+
+    base = leg(0, False)
+    print(json.dumps({"leg": "base", **base}))
+    spec = leg(k, False)
+    print(json.dumps({"leg": "spec", **spec}))
+    speedup = spec["tokens_per_s"] / max(base["tokens_per_s"], 1e-9)
+    failures = []
+    if base["errors"] or spec["errors"]:
+        failures.append(f"errors: base={base['errors']} "
+                        f"spec={spec['errors']}")
+    if spec["digest"] != base["digest"]:
+        failures.append("greedy parity digest mismatch")
+    if speedup < 2.0:
+        failures.append(f"speedup {speedup:.2f} < 2.0")
+    if spec["acceptance_rate"] < 0.7:
+        failures.append(
+            f"acceptance {spec['acceptance_rate']} < 0.7")
+    if base["compiles"] != {"decode": 1, "cow": 1}:
+        failures.append(f"base compiles {base['compiles']}")
+    if spec["compiles"] != {"decode": 1, "draft": 1, "cow": 1}:
+        failures.append(f"spec compiles {spec['compiles']}")
+    result = {
+        "bench": "BENCH_SERVING_SMOKE",
+        "spec_len": k,
+        "requests": n_req,
+        "max_new": max_new,
+        "model": {"vocab": cfg.vocab_size, "hidden": cfg.hidden_size,
+                  "layers": cfg.num_layers, "heads": cfg.num_heads},
+        "base": base,
+        "spec": spec,
+        "speedup": round(speedup, 3),
+        "greedy_parity": spec["digest"] == base["digest"],
+        "ok": not failures,
+    }
+    if args.int8:
+        q = leg(k, True)
+        print(json.dumps({"leg": "int8", **q}))
+        result["int8"] = q
+        if q["errors"]:
+            failures.append(f"int8 errors: {q['errors']}")
+        if q["compiles"] != {"decode": 1, "draft": 1, "cow": 1}:
+            failures.append(f"int8 compiles {q['compiles']}")
+        result["ok"] = not failures
+    if failures:
+        result["failures"] = failures
+    print(json.dumps(result))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(result, f, indent=1, sort_keys=True)
+    return 0 if result["ok"] else 1
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--clients", default="1,8,32",
@@ -360,11 +500,25 @@ def main(argv=None):
     ap.add_argument("--time-scale", type=float, default=1.0,
                     help="--trace: multiply every arrival time (0.5 = "
                     "replay twice as fast)")
+    ap.add_argument("--spec", type=int, nargs="?", const=3, default=0,
+                    help="speculative decoding with K draft tokens per "
+                    "round (bare --spec = 3); self-draft unless a real "
+                    "draft model is wired in code")
+    ap.add_argument("--int8", action="store_true",
+                    help="freeze weights to int8 (dequant epilogue "
+                    "decode path)")
+    ap.add_argument("--smoke", action="store_true",
+                    help="fast-decode certification: baseline vs "
+                    "speculative legs, >=2x + parity + compile-once "
+                    "assertions; emits BENCH_SERVING_SMOKE")
     args = ap.parse_args(argv)
 
     import paddle_tpu as paddle
     from paddle_tpu import serving
     from paddle_tpu.nlp.transformers import GPTConfig, GPTForPretraining
+
+    if args.smoke:
+        return run_smoke(args, serving)
 
     paddle.seed(7)
     cfg = GPTConfig(vocab_size=args.vocab, hidden_size=args.hidden,
@@ -392,7 +546,8 @@ def main(argv=None):
             model, max_slots=args.max_slots,
             max_seq_len=args.max_seq_len, block_size=args.block_size,
             num_blocks=num_blocks, prefill_chunk=args.prefill_chunk,
-            queue_cap=max(64, 2 * n_clients)).start()
+            queue_cap=max(64, 2 * n_clients),
+            spec_len=args.spec, quantize=args.int8).start()
         row = run_level(server, n_clients, args.steps, args.prompt_len,
                         args.max_new, args.vocab,
                         shared_prefix=args.shared_prefix)
@@ -414,6 +569,7 @@ def main(argv=None):
             "dense_equiv_slots": args.dense_equiv_slots,
             "prefill_chunk": args.prefill_chunk,
             "shared_prefix": args.shared_prefix,
+            "spec_len": args.spec, "int8": args.int8,
             "kv_pool_bytes": kv_bytes,
             "model": {"vocab": args.vocab, "hidden": args.hidden,
                       "layers": args.layers, "heads": args.heads},

@@ -167,6 +167,12 @@ SITES = {
     "serving.rollout_load": "each weight-registry checkpoint-dir load",
     "serving.canary": "before the canary replica's gate evaluation",
     "serving.rollback": "each rollout rollback attempt (tag = version)",
+    "serving.draft": "before each speculative draft phase (a fault "
+                     "degrades the round to plain decode)",
+    "serving.verify": "before each speculative verify dispatch on the "
+                      "unified decode trace",
+    "serving.dequant": "each decode step of an int8-frozen engine, "
+                       "before the dequant decode dispatch",
     "dist.allreduce": "each eager all-reduce before the transport "
                       "(delay eats the FLAGS_dist_timeout_s budget)",
     "dist.barrier": "each eager barrier / gang ckpt commit barrier",

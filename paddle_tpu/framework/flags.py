@@ -112,6 +112,20 @@ _DEFS = {
         "token-prefix hash so later requests sharing a prefix (system "
         "prompts) reuse physical blocks, with copy-on-write on "
         "divergence"),
+    "FLAGS_serving_spec_len": (
+        0, int,
+        "serving: speculative-decoding draft length k — each decode "
+        "round proposes up to k tokens from the draft model and "
+        "verifies them in one unified step (draft trace width k+1, "
+        "verify rides the decode trace). 0 disables speculation; the "
+        "engine then compiles no draft trace at all"),
+    "FLAGS_serving_quantize": (
+        False, bool,
+        "serving: freeze 2-D float weights to int8 with per-tensor "
+        "abs-max scales at engine build; the decode trace dequantizes "
+        "in-trace (weights ride the jit boundary as int8 — the TPU win "
+        "is HBM bytes) and the tied LM head runs the dequant-matmul "
+        "epilogue from ops/quant_ops.py"),
     "FLAGS_fleet_min_replicas": (
         1, int,
         "fleet: autoscaler floor — the Autoscaler never drains the "

@@ -186,7 +186,14 @@ class GPTAttention(nn.Layer):
         mask. Out-of-range rows (padding past max_seq) write into the
         reserved null block 0; table entries past a slot's allocation
         are 0 too, and both stay unattended because the mask only admits
-        keys <= each row's own position."""
+        keys <= each row's own position.
+
+        Speculative decoding rides the same scatter: a verify step
+        bulk-writes all k+1 staged columns (next token + proposals) in
+        this one dispatch, and a rejected suffix's pool rows are just
+        more garbage-above-the-frontier — masked out by ``key_idx <=
+        t_idx`` now, overwritten by the next round's staging before the
+        coverage frontier reaches them."""
         import jax
         import jax.numpy as jnp
 

@@ -431,6 +431,22 @@ def prometheus_text(serving=None, queue_depth=None, fleet=None):
             L.add("paddle_serving_prefill_tokens_per_step",
                   cp["tokens_per_step"],
                   help_="prompt tokens folded into each decode step")
+        # speculative decoding: the drafted/accepted/rejected counters
+        # already flow through the generic counter loop above as
+        # paddle_serving_spec_*_total — only the gauges are added here
+        spec = snap.get("speculative")
+        if spec:
+            L.add("paddle_serving_spec_acceptance_rate",
+                  spec["acceptance_rate"],
+                  help_="accepted/drafted proposal tokens since start")
+            for s, rate in sorted(spec["per_slot_acceptance"].items()):
+                L.add("paddle_serving_spec_slot_acceptance_rate", rate,
+                      labels={"slot": s},
+                      help_="per-slot speculative acceptance rate")
+            L.add("paddle_serving_spec_dequant_path",
+                  spec["dequant_path"],
+                  help_="1 while the engine serves int8-frozen weights "
+                        "through the dequant epilogue path")
     if queue_depth is not None:
         L.add("paddle_serving_queue_depth", queue_depth)
 

@@ -44,7 +44,67 @@ __all__ = [
     "QuantedLinear", "QuantedConv2D",
     "QuantizedLinearInt8", "QuantizedConv2DInt8",
     "quantize_weight_int8",
+    "SCALE_SUFFIX", "quantize_state_int8", "dequantize_state",
+    "is_quantized_state",
 ]
+
+# a frozen state dict stores each quantized leaf as int8 under its
+# original name plus an f32 scalar companion leaf `name + SCALE_SUFFIX`;
+# the engine's `_swap_state` skips unknown names, so the companions ride
+# any values dict (checkpoints, WeightRegistry manifests, jit args)
+# without model-side plumbing
+SCALE_SUFFIX = "@scale"
+
+
+def quantize_state_int8(values):
+    """Freeze a flat `{name: array}` state-values dict for serving:
+    every 2-D float leaf becomes int8 with a per-tensor abs-max scale
+    stored as the f32 scalar leaf `name + SCALE_SUFFIX`.
+
+    Per-tensor (not per-channel) because the serving decode path
+    dequantizes whole tensors in-trace and routes the tied LM head
+    through the `dequant_matmul` epilogue, which takes one scale per
+    output row at most — the embedding table's single abs-max serves
+    both uses.  1-D leaves (LayerNorm, biases) and non-float leaves pass
+    through unchanged.  Dequant of every frozen leaf follows
+    `ops.quant_ops.dequant_int8` exactly."""
+    out = {}
+    for name, v in values.items():
+        w = np.asarray(v)
+        if w.ndim < 2 or not np.issubdtype(w.dtype, np.floating):
+            out[name] = v
+            continue
+        w = w.astype(np.float32)
+        scale = np.float32(max(float(np.abs(w).max()), 1e-9))
+        q = np.clip(np.round(w / scale * 127.0), -127, 127).astype(np.int8)
+        out[name] = q
+        out[name + SCALE_SUFFIX] = np.asarray(scale, np.float32)
+    return out
+
+
+def is_quantized_state(values):
+    """True when `values` carries frozen-int8 companions (SCALE_SUFFIX
+    leaves) — how engines and the rollout registry recognise a quantized
+    artifact without a side channel."""
+    return any(k.endswith(SCALE_SUFFIX) for k in values)
+
+
+def dequantize_state(values):
+    """Inverse of `quantize_state_int8`, jit-traceable: returns a dict
+    of exactly the model's leaf names with every frozen leaf rebuilt as
+    `dequant_int8(q, scale)` f32.  Runs inside the compiled decode trace
+    (weights cross the jit boundary as int8; XLA fuses the dequant into
+    the consumers' operand reads) and eagerly in the rollout golden
+    chain — one formula, both places."""
+    from ..ops.quant_ops import dequant_int8
+
+    out = {}
+    for name, v in values.items():
+        if name.endswith(SCALE_SUFFIX):
+            continue
+        scale = values.get(name + SCALE_SUFFIX)
+        out[name] = v if scale is None else dequant_int8(v, scale)
+    return out
 
 
 def quantize_weight_int8(w, quant_axis):

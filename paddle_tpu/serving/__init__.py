@@ -13,7 +13,10 @@ iteration-level ("continuous") batching in the Orca lineage:
   cache (vLLM-style block tables + SGLang-style radix prefix sharing,
   paging.py) with chunked prefill folded into one compiled step,
   join-at-step admission by free blocks, and eviction on
-  EOS/max-len/deadline (engine.py);
+  EOS/max-len/deadline — plus fast decode: draft-model speculative
+  decoding with rejection sampling (FLAGS_serving_spec_len) and an
+  int8 frozen-weight path through a dequant-matmul epilogue
+  (FLAGS_serving_quantize) (engine.py);
 - `ServingMetrics` — QPS, queue depth, batch occupancy, latency
   percentiles; JSON-exportable, spans mirrored into the profiler's
   chrome trace (metrics.py);
@@ -51,6 +54,7 @@ from .fleet import (  # noqa: F401
 from .metrics import ServingMetrics, percentile  # noqa: F401
 from .paging import (  # noqa: F401
     NULL_BLOCK, BlockAllocator, PoolExhausted, PrefixCache,
+    positions_to_rows,
 )
 from .queueing import (  # noqa: F401
     AdmissionQueue, BrownoutShedError, CapacityExhaustedError,
@@ -78,5 +82,6 @@ __all__ = [
     "ServingError", "ServingMetrics", "SlotEngine",
     "VersionRetiredError", "WeightRegistry", "WeightVersion",
     "bucket_for", "bucket_ladder", "golden_digests", "http_front",
-    "pad_batch", "percentile", "replay", "retriable",
+    "pad_batch", "percentile", "positions_to_rows", "replay",
+    "retriable",
 ]

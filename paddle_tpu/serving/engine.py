@@ -34,13 +34,50 @@ releases its block references at the next step boundary. Stale KV from
 a previous occupant of a recycled block is harmless: the per-row causal
 mask only admits keys <= the request's own position, all of which its
 own prefill/decode overwrote first (same argument covers chunked-
-prefill padding rows and whole-block CoW copies).
+prefill padding rows, whole-block CoW copies, and the rejected-suffix
+rows of speculative verify steps — see below).
+
+Fast decode (ISSUE 16) rides the same one-trace contract:
+
+- Speculative decoding (``spec_len`` / FLAGS_serving_spec_len = k > 0):
+  each decode round proposes up to k tokens per slot from a draft model
+  (self-draft when none is given) and verifies them IN the unified step
+  — the slot stages ``[next, d_1..d_k]`` across the chunk columns it
+  already owns, and the step additionally projects the first k+1
+  columns to logits so the host can run Leviathan-style accept /
+  residual-resample per slot. Accepted tokens were already scattered
+  into the paged pool in bulk by that same step; a rejected suffix
+  leaves garbage KV above the committed position, which the next
+  round's staging always overwrites before any row can attend it (the
+  per-row causal mask covers the degraded-round gap). The draft model
+  runs its own compiled micro-step over separate pools sharing THIS
+  engine's block tables; its cache trails the committed sequence
+  (per-slot ``dfill``) and self-heals by catch-up, so a faulted draft
+  phase simply degrades the round to plain decode. Compile counters
+  certify ``{decode: 1, draft: 1, cow: 1}`` for life; spec-disabled
+  engines build no draft trace at all and keep ``{decode: 1, cow: 1}``.
+  Greedy speculative decode is bitwise token-identical to plain greedy:
+  rejection hands the verify logits to the normal `_pick` path instead
+  of eagerly committing, so every emitted token is an argmax of the
+  same-valued logits row the plain engine would have produced.
+- Int8 weight path (``quantize`` / FLAGS_serving_quantize): weights are
+  frozen per-tensor to int8 + `@scale` companions
+  (quantization.quantize_state_int8) and cross the jit boundary as
+  int8 — the HBM win. The trace dequantizes in-body via the one
+  canonical formula (ops.quant_ops.dequant_int8) and routes the tied
+  LM head through the `dequant_matmul` epilogue kernel. Engines handed
+  a pre-frozen values dict (rollout artifacts) adopt it as-is.
 
 Fault sites: ``serving.step`` fires once per decode step (a `raise`
 action fails every in-flight request deterministically while the engine
 stays up); ``serving.alloc_block`` on every physical block allocation
 (deterministic pool exhaustion); ``serving.cow_split`` before every
-copy-on-write block copy. Supervised (fleet-owned) engines additionally
+copy-on-write block copy; ``serving.draft`` before each speculative
+draft phase (raise = degrade that round to plain decode, slots survive
+with no lost or duplicated tokens); ``serving.verify`` before each
+speculative verify dispatch (raise = step error, fails in-flight
+requests like serving.step); ``serving.dequant`` once per step on an
+int8-frozen engine. Supervised (fleet-owned) engines additionally
 fire ``serving.replica_heartbeat`` every loop iteration and
 ``serving.replica_step`` before each decode step, both tagged with the
 replica name — the fleet chaos sites (see framework/faults.py).
@@ -65,7 +102,36 @@ from .queueing import (
     RequestCancelled,
 )
 
-__all__ = ["SlotEngine"]
+__all__ = ["SlotEngine", "speculative_accept"]
+
+
+def speculative_accept(p_list, q_list, proposals, rng):
+    """Leviathan-style rejection sampling over one drafted chain.
+
+    `p_list[j]` / `q_list[j]` are the (identically warped) target and
+    draft probability vectors at the position of `proposals[j]`. Accept
+    d_j while ``u_j < min(1, p_j(d_j) / q_j(d_j))``; on first rejection
+    resample from the residual ``normalize(max(p - q, 0))``. Returns
+    ``(accepted_count, resampled_token_or_None)`` — None means every
+    proposal survived (the caller then samples the bonus token from the
+    verify step's final logits row, completing the k+1-per-round
+    upside). The emitted-token distribution equals sampling from p
+    directly — certified by the histogram test in
+    tests/test_serving_spec.py. Pure host-side numpy so the invariant
+    is testable without an engine."""
+    for j, d in enumerate(proposals):
+        p, q = p_list[j], q_list[j]
+        if rng.random_sample() < min(1.0, float(p[d]) / max(float(q[d]),
+                                                            1e-20)):
+            continue
+        residual = np.maximum(p - q, 0.0)
+        tot = residual.sum()
+        if tot <= 0.0:
+            # p == q exactly and still rejected (u landed on the
+            # boundary): any residual draw is p-distributed; use p
+            residual, tot = p, p.sum()
+        return j, int(rng.choice(residual.size, p=residual / tot))
+    return len(proposals), None
 
 
 class _Slot:
@@ -85,6 +151,21 @@ class _Slot:
         self.rng = None
         if req.gen.get("do_sample"):
             self.rng = np.random.RandomState(req.gen.get("seed", 0))
+        # speculative-decoding state (unused when spec_len == 0):
+        # the draft cache trails the committed sequence — positions
+        # [0, dfill) hold draft KV for tokens[0:dfill]; `fed` logs every
+        # token fed to it this round (committed catch-up AND proposals)
+        # so dfill advances exactly as far as the commit agreed with
+        # what was fed, whatever the round's outcome (accept, reject,
+        # degrade, mid-phase fault)
+        self.dfill = 0
+        self.fed: list = []
+        self.drafted: list = []   # this round's proposals d_1..d_s
+        self.qdists: list = []    # warped draft dists per proposal
+        self.spec_staged: list = []  # proposals actually staged
+        # a residual-resampled token is appended at commit but its KV is
+        # not yet written; the next consume must feed it, not re-pick
+        self.unfed = False
 
 
 class SlotEngine:
@@ -106,9 +187,15 @@ class SlotEngine:
                  block_size=None, num_blocks=None, prefill_chunk=None,
                  prefix_cache=None, cache_dtype=None, metrics=None,
                  queue=None, strict_shapes=False, name=None,
-                 supervised=False, values=None, weight_version=0):
+                 supervised=False, values=None, weight_version=0,
+                 draft_model=None, spec_len=None, quantize=None):
         import jax
         import jax.numpy as jnp
+
+        from ..quantization import (
+            SCALE_SUFFIX, dequantize_state, is_quantized_state,
+            quantize_state_int8,
+        )
 
         model.eval()
         self.model = model
@@ -132,6 +219,17 @@ class SlotEngine:
         self.prefill_chunk = min(
             prefill_chunk or flag("FLAGS_serving_prefill_chunk"),
             self.max_seq_len)
+        self.spec_len = flag("FLAGS_serving_spec_len") \
+            if spec_len is None else int(spec_len)
+        if self.spec_len:
+            # verify needs k+1 chunk columns per slot; the draft trace
+            # is a separate, narrower program of the same width
+            self.prefill_chunk = max(self.prefill_chunk, self.spec_len + 1)
+            self.prefill_chunk = min(self.prefill_chunk, self.max_seq_len)
+            if self.spec_len + 1 > self.max_seq_len:
+                raise ValueError(
+                    f"spec_len {self.spec_len} needs {self.spec_len + 1} "
+                    f"chunk columns but max_seq_len is {self.max_seq_len}")
         self.metrics = metrics if metrics is not None else ServingMetrics()
         self.queue = queue if queue is not None else AdmissionQueue(
             flag("FLAGS_serving_queue_cap"), metrics=self.metrics)
@@ -142,6 +240,28 @@ class SlotEngine:
         self._values = dict(values) if values is not None \
             else dict(state_values(model))
         self.weight_version = int(weight_version)
+        if quantize is None:
+            quantize = flag("FLAGS_serving_quantize")
+        if is_quantized_state(self._values):
+            self.quantized = True   # pre-frozen artifact (e.g. rollout)
+        elif quantize:
+            self._values = quantize_state_int8(self._values)
+            self.quantized = True
+        else:
+            self.quantized = False
+        self._dequantize_state = dequantize_state
+        # tied-embedding LM head on the dequant-matmul epilogue: find
+        # the int8 table + scale once; fall back to the operand-dequant
+        # head when untied or the table didn't freeze
+        self._head_key = None
+        if self.quantized:
+            self.metrics.set_gauge("dequant_path", 1.0)
+            for k in self._values:
+                if k.endswith("word_embeddings.weight") and \
+                        (k + SCALE_SUFFIX) in self._values and \
+                        getattr(model.config, "tie_word_embeddings", False):
+                    self._head_key = (k, k + SCALE_SUFFIX)
+                    break
         cfg = model.config
         hd = cfg.hidden_size // cfg.num_heads
         dtype = cache_dtype or jnp.float32
@@ -169,6 +289,22 @@ class SlotEngine:
         def _count(key):
             self._compiles[key] = self._compiles.get(key, 0) + 1
 
+        def _head(m, values, hrows):
+            """Project hidden rows (.., H) to f32 logits (.., V): the
+            dequant-matmul epilogue against the int8 tied table when
+            frozen, the model's own head otherwise."""
+            if self._head_key is not None:
+                from ..ops.quant_ops import dequant_matmul
+
+                qk, sk = self._head_key
+                return dequant_matmul(hrows, values[qk], values[sk])
+            squeeze = hrows.ndim == 2
+            if squeeze:
+                hrows = hrows[:, None, :]
+            out = m.logits(Tensor(hrows))
+            out = out._value if isinstance(out, Tensor) else out
+            return (out[:, 0, :] if squeeze else out).astype(jnp.float32)
+
         def step_fn(values, tok, pos, nvalid, tables, ks, vs):
             # trace-time only: the compile counter + retrace registry
             _count("decode")
@@ -181,6 +317,11 @@ class SlotEngine:
             posmat = jnp.minimum(
                 pos[:, None] + jnp.arange(tok.shape[1]),
                 self.max_seq_len - 1)
+            # int8-frozen weights dequantize IN-trace (one canonical
+            # formula; XLA fuses it into operand reads) — except the
+            # head, which _head routes through the epilogue kernel
+            fvals = self._dequantize_state(values) if self.quantized \
+                else values
 
             def run(m):
                 h, new_caches = m.gpt(Tensor(tok), Tensor(posmat),
@@ -189,12 +330,20 @@ class SlotEngine:
                 # only each slot's last valid position feeds sampling:
                 # skip the full-vocab projection of the rest of the chunk
                 last = hv[jnp.arange(hv.shape[0]), nvalid - 1]
-                return m.logits(Tensor(last[:, None, :])), new_caches
+                lv = _head(m, values, last)
+                if self.spec_len:
+                    # speculative verify: the first k+1 chunk columns
+                    # ([next, d_1..d_k]) all feed accept/reject
+                    sv = _head(m, values, hv[:, :self.spec_len + 1])
+                    return (lv, sv), new_caches
+                return (lv, lv), new_caches
 
-            logits, new_caches = functional_apply(self.model, values, run)
-            lv = jnp.asarray(logits)[:, 0, :].astype(jnp.float32)
-            return (lv, [c[0] for c in new_caches],
-                    [c[1] for c in new_caches])
+            (lv, sv), new_caches = functional_apply(self.model, fvals, run)
+            out_ks = [c[0] for c in new_caches]
+            out_vs = [c[1] for c in new_caches]
+            if self.spec_len:
+                return lv, sv, out_ks, out_vs
+            return lv, out_ks, out_vs
 
         def cow_fn(ks, vs, src, dst):
             from jax import lax
@@ -212,14 +361,77 @@ class SlotEngine:
         self._decode = jax.jit(step_fn)
         self._cow = jax.jit(cow_fn)
 
+        # -- speculative draft trace (only when spec is on: a disabled
+        # engine keeps compile counters {decode: 1, cow: 1} exactly) --
+        if self.spec_len:
+            self.draft_model = draft_model if draft_model is not None \
+                else model
+            self.draft_model.eval()
+            dcfg = self.draft_model.config
+            if dcfg.vocab_size != cfg.vocab_size:
+                raise ValueError(
+                    f"draft vocab {dcfg.vocab_size} != target vocab "
+                    f"{cfg.vocab_size}")
+            if dcfg.max_seq_len < self.max_seq_len:
+                raise ValueError(
+                    f"draft max_seq_len {dcfg.max_seq_len} < engine "
+                    f"max_seq_len {self.max_seq_len}")
+            # draft weights stay float (the draft is the small model);
+            # separate per-layer pools share THIS engine's block tables
+            # and allocator, so one block id addresses both caches
+            self._dvalues = dict(state_values(self.draft_model)) \
+                if draft_model is not None else dict(self._values)
+            if is_quantized_state(self._dvalues):
+                self._dvalues = {
+                    k: v for k, v in self._dequantize_state(
+                        self._dvalues).items()}
+            dhd = dcfg.hidden_size // dcfg.num_heads
+            dshape = (self.num_blocks, dcfg.num_heads, self.block_size,
+                      dhd)
+            self._dks = [jnp.zeros(dshape, dtype)
+                         for _ in range(dcfg.num_layers)]
+            self._dvs = [jnp.zeros(dshape, dtype)
+                         for _ in range(dcfg.num_layers)]
+            self.kv_pool_bytes += int(
+                2 * dcfg.num_layers * np.prod(dshape)
+                * jnp.zeros((), dtype).nbytes)
+            self._draft_chunk = self.spec_len + 1
+
+            def draft_fn(dvalues, tok, pos, nvalid, tables, ks, vs):
+                _count("draft")
+                observe.record_compile(
+                    "serving.draft",
+                    signature=observe.signature_of(tok, pos, tables))
+                caches = [(k, v, (pos, tables)) for k, v in zip(ks, vs)]
+                posmat = jnp.minimum(
+                    pos[:, None] + jnp.arange(tok.shape[1]),
+                    self.max_seq_len - 1)
+
+                def run(m):
+                    h, new_caches = m.gpt(Tensor(tok), Tensor(posmat),
+                                          caches=caches)
+                    hv = h._value if isinstance(h, Tensor) else h
+                    last = hv[jnp.arange(hv.shape[0]), nvalid - 1]
+                    return m.logits(Tensor(last[:, None, :])), new_caches
+
+                logits, new_caches = functional_apply(
+                    self.draft_model, dvalues, run)
+                lv = jnp.asarray(logits)[:, 0, :].astype(jnp.float32)
+                return (lv, [c[0] for c in new_caches],
+                        [c[1] for c in new_caches])
+
+            self._draft = jax.jit(draft_fn)
+
     # -- introspection ------------------------------------------------------
 
     @property
     def compile_counts(self):
         """'decode' -> traces of the unified prefill+decode step,
-        'cow' -> traces of the copy-on-write block copy. The paged
-        engine's compile invariant is every value == 1 — there is no
-        prefill bucket ladder anymore."""
+        'cow' -> traces of the copy-on-write block copy, 'draft' ->
+        traces of the speculative draft micro-step (present only when
+        spec_len > 0). The paged engine's compile invariant is every
+        value == 1 — there is no prefill bucket ladder anymore, and
+        draft/verify batches reuse the same two programs for life."""
         return dict(self._compiles)
 
     @property
@@ -258,6 +470,11 @@ class SlotEngine:
                      jnp.asarray(self._bt), self._ks, self._vs)
         self._cow(self._ks, self._vs, jnp.int32(NULL_BLOCK),
                   jnp.int32(NULL_BLOCK))
+        if self.spec_len:
+            dtok = jnp.zeros((self.max_slots, self._draft_chunk),
+                             jnp.int32)
+            self._draft(self._dvalues, dtok, pos, nvalid,
+                        jnp.asarray(self._bt), self._dks, self._dvs)
         self._warmed = True
         return self.compile_counts
 
@@ -380,13 +597,12 @@ class SlotEngine:
             self.metrics.observe_latency(
                 "queue", time.monotonic() - req.arrival)
 
-    def _pick(self, slot: _Slot):
-        """Next token from the slot's pending logits (host-side so each
-        request carries its own sampling config)."""
-        logits = slot.next_logits
-        gen = slot.req.gen
-        if not gen.get("do_sample"):
-            return int(logits.argmax())
+    @staticmethod
+    def _warp_probs(logits, gen):
+        """Temperature + top-k warped softmax, exactly the transform
+        `_pick` samples from — speculative accept/reject must compare
+        target and draft through the SAME warp or the emitted
+        distribution shifts."""
         scaled = logits / max(gen.get("temperature", 1.0), 1e-6)
         top_k = gen.get("top_k", 0)
         if top_k:
@@ -395,7 +611,17 @@ class SlotEngine:
         z = scaled - scaled.max()
         p = np.exp(z)
         p /= p.sum()
-        return int(slot.rng.choice(scaled.size, p=p))
+        return p
+
+    def _pick(self, slot: _Slot):
+        """Next token from the slot's pending logits (host-side so each
+        request carries its own sampling config)."""
+        logits = slot.next_logits
+        gen = slot.req.gen
+        if not gen.get("do_sample"):
+            return int(logits.argmax())
+        p = self._warp_probs(logits, gen)
+        return int(slot.rng.choice(p.size, p=p))
 
     def _evict(self, idx, error=None):
         slot = self._slots[idx]
@@ -425,6 +651,14 @@ class SlotEngine:
                 self._evict(i, error)
 
     def _step(self):
+        if self.quantized:
+            # raise here propagates to _loop like any step error
+            faults.fault_point("serving.dequant")
+        if self.spec_len:
+            return self._step_spec()
+        return self._step_plain()
+
+    def _step_plain(self):
         """One continuous-batching iteration: consume each decoding
         slot's pending logits (finishing slots that hit
         EOS/max/deadline), stage the next chunk for prefilling slots,
@@ -513,6 +747,288 @@ class SlotEngine:
             slot.advance = 1
             live.append(i)
         return prefill_tokens
+
+    # -- speculative decoding (spec_len > 0) --------------------------------
+
+    def _step_spec(self):
+        """One speculative iteration: pick each decoding slot's
+        committed next token, draft up to spec_len proposals per slot
+        with the compiled draft micro-step (catch-up + propose over the
+        shared block tables), stage ``[next, d_1..d_s]`` across the
+        chunk columns, run ONE verify dispatch on the unified decode
+        trace, then accept/commit host-side. A fault in the draft phase
+        degrades the round to plain decode: proposals are dropped, the
+        draft cache keeps whatever catch-up landed, and every slot
+        still commits exactly its picked token — no losses, no dups."""
+        import jax.numpy as jnp
+
+        try:
+            faults.fault_point("serving.step")
+        except Exception as e:  # noqa: BLE001 — deterministic mid-decode
+            self._fail_all_active(e)
+            return
+        now = time.monotonic()
+        tok = np.zeros((self.max_slots, self.prefill_chunk), np.int32)
+        nvalid = np.ones((self.max_slots,), np.int32)
+        live: list = []
+        plan: list = []   # (slot_idx, slot, next_token, s_i)
+        with observe.phase("sample", cat="serving"):
+            prefill_tokens = self._consume_spec(now, tok, nvalid, live,
+                                                plan)
+        if not live:
+            return
+        # prefilling slots join the draft phase with s_i = 0 so the
+        # draft cache ingests their prompt alongside the target prefill
+        work = [(i, slot, s_i) for i, slot, _, s_i in plan]
+        work += [(i, self._slots[i], 0) for i in live
+                 if self._slots[i].state == "prefill"]
+        drafted_ok = True
+        try:
+            faults.fault_point("serving.draft")
+            with observe.phase("draft", cat="serving"):
+                self._run_draft(work)
+        except Exception:  # noqa: BLE001 — degrade to plain decode
+            drafted_ok = False
+            self.metrics.inc("spec_draft_faults")
+        for i, slot, nxt, s_i in plan:
+            props = slot.drafted[:s_i] if drafted_ok else []
+            slot.spec_staged = props
+            tok[i, 0] = nxt
+            if props:
+                tok[i, 1:1 + len(props)] = props
+            nvalid[i] = 1 + len(props)
+        faults.fault_point("serving.verify")
+        with profiler.RecordEvent("serving.step", cat="serving"):
+            with observe.phase("device-step", cat="serving"):
+                lv, sv, self._ks, self._vs = self._decode(
+                    self._values, jnp.asarray(tok),
+                    jnp.asarray(self._pos), jnp.asarray(nvalid),
+                    jnp.asarray(self._bt), self._ks, self._vs)
+        lv = np.asarray(lv)
+        sv = np.asarray(sv)
+        for i in live:
+            slot = self._slots[i]
+            if slot.state == "prefill":
+                self._pos[i] += slot.advance
+                slot.fill += slot.advance
+                self._advance_dfill(slot)
+                if slot.fill >= slot.prompt_len:
+                    slot.state = "decode"
+                    slot.next_logits = lv[i]
+                    self.metrics.inc("prefills")
+            else:
+                self._commit_spec(i, slot, lv[i], sv[i])
+        self.metrics.inc("steps")
+        if plan:
+            self.metrics.inc("spec_rounds")
+        if prefill_tokens:
+            self.metrics.inc("prefill_tokens", prefill_tokens)
+        self.metrics.observe_occupancy(len(live), self.max_slots)
+        self.metrics.observe_blocks(self._alloc.blocks_in_use,
+                                    self._alloc.usable)
+
+    def _consume_spec(self, now, tok, nvalid, live, plan):
+        """Speculative twin of `_consume_slots`: same cancel / deadline
+        / EOS handling and prefill staging, but decoding slots defer
+        their token-matrix staging until after the draft phase.  Caps
+        each slot's draft length at its remaining token budget so every
+        staged position stays inside its allocated blocks."""
+        prefill_tokens = 0
+        for i, slot in enumerate(self._slots):
+            if slot is None:
+                continue
+            req = slot.req
+            if req.cancelled:
+                self.metrics.inc("cancelled")
+                self._evict(i, RequestCancelled(
+                    f"request {req.id} cancelled mid-decode"))
+                continue
+            if req.expired(now):
+                self.metrics.inc("timeouts")
+                self._evict(i, DeadlineExceededError(
+                    f"request {req.id} deadline exceeded mid-decode "
+                    f"after {slot.produced} tokens"))
+                continue
+            if slot.state == "prefill":
+                n = min(self.prefill_chunk, slot.prompt_len - slot.fill)
+                tok[i, :n] = slot.prompt[slot.fill:slot.fill + n]
+                nvalid[i] = n
+                slot.advance = n
+                prefill_tokens += n
+                live.append(i)
+                continue
+            gen = req.gen
+            if slot.unfed:
+                # a residual-resampled token: already committed and
+                # EOS-checked last round, its KV write happens now
+                nxt = slot.tokens[-1]
+                slot.unfed = False
+            else:
+                nxt = self._pick(slot)
+                slot.tokens.append(nxt)
+                slot.produced += 1
+                self.metrics.inc("tokens_out")
+                eos = gen.get("eos_token_id")
+                if (eos is not None and nxt == eos) or \
+                        slot.produced >= gen.get("max_new_tokens", 16):
+                    self._evict(i)
+                    continue
+            s_i = min(self.spec_len,
+                      gen.get("max_new_tokens", 16) - slot.produced)
+            plan.append((i, slot, nxt, s_i))
+            live.append(i)
+        return prefill_tokens
+
+    def _run_draft(self, work):
+        """Drive the ONE compiled draft micro-step until every working
+        slot has caught its draft cache up to the committed sequence and
+        sampled its proposals. Each iteration batches one [max_slots,
+        spec_len+1] call: catch-up slots feed their next committed
+        segment, proposing slots feed their latest proposal; idle rows
+        route beyond the table so their writes land in the null block.
+        Successful feeds are logged to `slot.fed` AFTER the call
+        returns, so a mid-phase fault leaves bookkeeping consistent
+        with what actually landed in the draft pools."""
+        import jax.numpy as jnp
+
+        width = self._draft_chunk
+        idle_pos = self.blocks_per_slot * self.block_size
+        qlast: dict = {}
+        limit = -(-self.max_seq_len // width) + self.spec_len + 4
+        for _ in range(limit):
+            dtok = np.zeros((self.max_slots, width), np.int32)
+            dpos = np.full((self.max_slots,), idle_pos, np.int32)
+            dnval = np.ones((self.max_slots,), np.int32)
+            feeds: dict = {}
+            for i, slot, s_i in work:
+                base = slot.dfill + len(slot.fed)
+                target = slot.tokens
+                if base < len(target):
+                    n = min(width, len(target) - base)
+                    seg = target[base:base + n]
+                    dtok[i, :n] = seg
+                    dpos[i] = base
+                    dnval[i] = n
+                    feeds[i] = (slot, seg)
+                elif s_i and len(slot.drafted) < s_i:
+                    d = self._draft_pick(slot, qlast[i])
+                    slot.drafted.append(d)
+                    # the FINAL proposal is never fed back: no later
+                    # proposal conditions on it, verify recomputes p
+                    if len(slot.drafted) < s_i:
+                        dtok[i, 0] = d
+                        dpos[i] = base
+                        dnval[i] = 1
+                        feeds[i] = (slot, [d])
+            if not feeds:
+                return
+            with profiler.RecordEvent("serving.draft", cat="serving"):
+                lv, self._dks, self._dvs = self._draft(
+                    self._dvalues, jnp.asarray(dtok), jnp.asarray(dpos),
+                    jnp.asarray(dnval), jnp.asarray(self._bt),
+                    self._dks, self._dvs)
+            lv = np.asarray(lv)
+            for i, (slot, seg) in feeds.items():
+                slot.fed.extend(int(t) for t in seg)
+                qlast[i] = lv[i]
+        raise RuntimeError(
+            f"draft catch-up did not converge in {limit} micro-steps")
+
+    def _draft_pick(self, slot, qrow):
+        """Sample one proposal from the draft distribution, recording
+        the warped probs (sampling requests) for accept/reject."""
+        gen = slot.req.gen
+        if not gen.get("do_sample"):
+            slot.qdists.append(None)
+            return int(qrow.argmax())
+        p = self._warp_probs(qrow, gen)
+        slot.qdists.append(p)
+        return int(slot.rng.choice(p.size, p=p))
+
+    def _advance_dfill(self, slot):
+        """Advance the draft-cache coverage mark exactly as far as this
+        round's feeds agree with the (post-commit) token sequence:
+        committed catch-up and ACCEPTED proposals advance it, a
+        rejected suffix or degraded round stops it — the next round's
+        catch-up rewrites from there. Clears the round scratch."""
+        base, fed, seq = slot.dfill, slot.fed, slot.tokens
+        j = 0
+        while j < len(fed) and base + j < len(seq) \
+                and fed[j] == seq[base + j]:
+            j += 1
+        slot.dfill = base + j
+        slot.fed = []
+        slot.drafted = []
+        slot.qdists = []
+
+    def _commit_spec(self, i, slot, lv_i, sv_i):
+        """Host-side accept/commit for one slot after a verify step.
+        Greedy: accept the longest prefix of proposals that match the
+        verify argmaxes, then hand the first-mismatch logits row to the
+        NEXT round's `_pick` — every emitted token is an argmax of the
+        same logits the plain engine would compute, hence bitwise
+        parity. Sampling: Leviathan accept / residual-resample through
+        the identical `_warp_probs` transform (`speculative_accept`).
+        All staged positions were already scattered into the paged pool
+        in bulk by the verify step; `self._pos` advances only over the
+        committed prefix, and the garbage KV above it is overwritten by
+        the next round's staging before any row can attend it."""
+        props = slot.spec_staged
+        slot.spec_staged = []
+        gen = slot.req.gen
+        eos = gen.get("eos_token_id")
+        max_new = gen.get("max_new_tokens", 16)
+        s = len(props)
+        L = int(self._pos[i])   # position nxt was written at
+        if s == 0:
+            # plain-decode round (spec budget exhausted or degraded)
+            self._pos[i] = L + 1
+            slot.next_logits = lv_i
+            self._advance_dfill(slot)
+            return
+        if not gen.get("do_sample"):
+            a = 0
+            while a < s and int(sv_i[a].argmax()) == props[a]:
+                a += 1
+            resampled = None
+            # rejection: sv_i[a] is p(. | accepted prefix) — the next
+            # _pick's argmax IS the rejection token; all-accept: the
+            # bonus row
+            nl = sv_i[a] if a < s else sv_i[s]
+        else:
+            p_list = [self._warp_probs(sv_i[j], gen) for j in range(s)]
+            a, resampled = speculative_accept(p_list, slot.qdists[:s],
+                                              props, slot.rng)
+            nl = None if resampled is not None else sv_i[s]
+        self.metrics.observe_spec(i, s, a)
+        finished = False
+        m = 0
+        for t in props[:a]:
+            slot.tokens.append(int(t))
+            slot.produced += 1
+            self.metrics.inc("tokens_out")
+            m += 1
+            if (eos is not None and t == eos) or \
+                    slot.produced >= max_new:
+                finished = True
+                break
+        self._pos[i] = L + 1 + m
+        self._advance_dfill(slot)
+        if finished:
+            self._evict(i)
+            return
+        if resampled is not None:
+            slot.tokens.append(int(resampled))
+            slot.produced += 1
+            self.metrics.inc("tokens_out")
+            slot.next_logits = None
+            slot.unfed = True
+            if (eos is not None and resampled == eos) or \
+                    slot.produced >= max_new:
+                slot.unfed = False
+                self._evict(i)
+            return
+        slot.next_logits = nl
 
     # -- serve loop ---------------------------------------------------------
 

@@ -34,7 +34,12 @@ class ServingMetrics:
     (prompt positions written by chunked prefill), `prompt_tokens` /
     `prefix_lookups` / `prefix_hit_blocks` / `prefix_hit_tokens` /
     `cow_splits` (prefix-cache traffic), `rejected_capacity` (429 sheds
-    whose block demand exceeds the pool). The fleet (fleet.py) adds its
+    whose block demand exceeds the pool), and the fast-decode set:
+    `spec_drafted_tokens` / `spec_accepted_tokens` /
+    `spec_rejected_tokens` / `spec_rounds` / `spec_draft_faults`
+    (speculative decoding, fed via `observe_spec`, surfaced under
+    snapshot()["speculative"] with per-slot acceptance rates and the
+    `dequant_path` gauge). The fleet (fleet.py) adds its
     own family over the same registry: `fleet_submitted` /
     `fleet_completed` / `fleet_failed` (client-level, exactly-once),
     `routed`, `retries`, `replays`, `hedges`, `hedge_wins`,
@@ -60,7 +65,27 @@ class ServingMetrics:
         self._blk_sum = 0.0
         self._blk_n = 0
         self._blk_max = 0.0
+        self._gauges: dict = {}       # name -> float (last-write-wins)
+        self._spec_slots: dict = {}   # slot -> [drafted, accepted]
         self._started = time.monotonic()
+
+    def set_gauge(self, name, value):
+        """Last-write-wins scalar (e.g. `dequant_path` = 1.0 while an
+        int8-frozen engine serves)."""
+        with self._lock:
+            self._gauges[name] = float(value)
+
+    def observe_spec(self, slot, drafted, accepted):
+        """One speculative round's outcome for one slot: `drafted`
+        proposals went into the verify step, `accepted` survived.
+        Feeds the spec_* counters and the per-slot acceptance gauges."""
+        with self._lock:
+            cell = self._spec_slots.setdefault(int(slot), [0, 0])
+            cell[0] += int(drafted)
+            cell[1] += int(accepted)
+        self.inc("spec_drafted_tokens", int(drafted))
+        self.inc("spec_accepted_tokens", int(accepted))
+        self.inc("spec_rejected_tokens", int(drafted) - int(accepted))
 
     def inc(self, name, n=1):
         with self._lock:
@@ -151,6 +176,25 @@ class ServingMetrics:
                 "tokens": counters["prefill_tokens"],
                 "tokens_per_step":
                     counters["prefill_tokens"] / steps if steps else 0.0,
+            }
+        with self._lock:
+            gauges = dict(self._gauges)
+            spec_slots = {k: tuple(v) for k, v in self._spec_slots.items()}
+        if counters.get("spec_drafted_tokens") or spec_slots \
+                or gauges.get("dequant_path"):
+            drafted = counters.get("spec_drafted_tokens", 0)
+            accepted = counters.get("spec_accepted_tokens", 0)
+            snap["speculative"] = {
+                "drafted_tokens": drafted,
+                "accepted_tokens": accepted,
+                "rejected_tokens": counters.get("spec_rejected_tokens", 0),
+                "rounds": counters.get("spec_rounds", 0),
+                "draft_faults": counters.get("spec_draft_faults", 0),
+                "acceptance_rate": accepted / drafted if drafted else 0.0,
+                "per_slot_acceptance": {
+                    str(s): a / d if d else 0.0
+                    for s, (d, a) in sorted(spec_slots.items())},
+                "dequant_path": gauges.get("dequant_path", 0.0),
             }
         if queue_depth is not None:
             snap["queue_depth"] = queue_depth

@@ -38,12 +38,31 @@ import numpy as np
 
 from ..framework import faults
 
-__all__ = ["NULL_BLOCK", "PoolExhausted", "BlockAllocator", "PrefixCache"]
+__all__ = ["NULL_BLOCK", "PoolExhausted", "BlockAllocator", "PrefixCache",
+           "positions_to_rows"]
 
 #: physical block 0 — reserved scratch target for padding writes
 NULL_BLOCK = 0
 
 _ROOT = b"\x00root"
+
+
+def positions_to_rows(table, positions, block_size):
+    """Map logical sequence positions to physical pool rows through a
+    slot's block table: ``(table[t // bs], t % bs)``.
+
+    This is the same routing the compiled step's bulk KV scatter uses —
+    a speculative round scatters all ``k+1`` staged columns (next token
+    plus every draft proposal) through it in one dispatch, so the rows
+    of a rejected suffix land in the pool too. They are harmless:
+    per-row causal masking (``key_idx <= t``) hides them from every
+    attend, and the next round's staging overwrites them before the
+    coverage frontier reaches their positions. Tests use this helper to
+    read pool rows back and certify scatter parity.
+    """
+    positions = np.asarray(positions)
+    table = np.asarray(table)
+    return table[positions // block_size], positions % block_size
 
 
 class PoolExhausted(RuntimeError):

@@ -79,12 +79,49 @@ def golden_digests(model, values, prompts, *, max_new=6):
     convention the serving parity tests certify bitwise against the
     engine's paged decode).
 
+    Int8-frozen values (``@scale`` companion leaves present) chain with
+    the engine's exact arithmetic: the body runs on
+    `quantization.dequantize_state` (the one canonical dequant formula)
+    and the tied LM head goes through the `dequant_matmul` epilogue on
+    the raw int8 table — so an int8 canary still gates bitwise, not
+    "close enough".
+
     Caller must hold the fleet's `_build_lock`: `functional_apply`
     swaps the model's parameter handles and must not race a trace.
     """
     import jax.numpy as jnp
 
     from ..core.tensor import Tensor
+    from ..quantization import (SCALE_SUFFIX, dequantize_state,
+                                is_quantized_state)
+
+    quantized = is_quantized_state(values)
+    fvals = dequantize_state(values) if quantized else values
+    head_key = None   # (int8 table, scale) for the tied epilogue head
+    if quantized and getattr(model.config, "tie_word_embeddings", False):
+        for k in values:
+            if k.endswith("word_embeddings.weight") and \
+                    (k + SCALE_SUFFIX) in values:
+                head_key = (k, k + SCALE_SUFFIX)
+                break
+
+    def _logits_row(ids, last):
+        if head_key is None:
+            logits = functional_apply(
+                model, fvals,
+                lambda m: m(Tensor(jnp.asarray(ids, jnp.int32))))
+            return np.asarray(logits._value, np.float32)[0, last]
+
+        def run(m):
+            from ..ops.quant_ops import dequant_matmul
+
+            h = m.gpt(Tensor(jnp.asarray(ids, jnp.int32)))
+            hv = h._value if isinstance(h, Tensor) else h
+            return dequant_matmul(hv[:, last], values[head_key[0]],
+                                  values[head_key[1]])
+
+        return np.asarray(functional_apply(model, fvals, run),
+                          np.float32)[0]
 
     pad = model.config.max_seq_len
     out = {}
@@ -97,10 +134,7 @@ def golden_digests(model, values, prompts, *, max_new=6):
         for _ in range(max_new):
             ids = np.zeros((1, pad), np.int32)
             ids[0, :len(toks)] = toks
-            logits = functional_apply(
-                model, values,
-                lambda m: m(Tensor(jnp.asarray(ids, jnp.int32))))
-            row = np.asarray(logits._value, np.float32)[0, len(toks) - 1]
+            row = _logits_row(ids, len(toks) - 1)
             toks.append(int(row.argmax()))
         out[f"p{pi}"] = _digest_ids(toks)
     return out
@@ -110,11 +144,22 @@ class WeightVersion:
     """One immutable weight set: flat ``name -> array`` values, a
     monotonically increasing id, and a per-leaf sha256 manifest.
     `golden` holds the precomputed golden-prompt digests once
-    `RolloutController.ensure_golden` (or the caller) fills them."""
+    `RolloutController.ensure_golden` (or the caller) fills them.
+
+    Quantized artifacts are first-class versions: values frozen by
+    `quantization.quantize_state_int8` carry int8 tables plus
+    ``@scale`` companion leaves, all covered by the same per-leaf
+    sha256 manifest, and `quant` records the ``{leaf: {dtype, scale}}``
+    summary (auto-derived from the companions when not given). A
+    rollout to — or bitwise rollback from — an int8 version goes
+    through the exact same drain→rebuild path as a float one; the
+    engine adopts a pre-frozen values dict as-is, so no retrace beyond
+    the per-rebuild compile the float path already pays."""
 
     def __init__(self, version, values, *, manifest=None, source=None,
-                 golden=None):
+                 golden=None, quant=None):
         from ..distributed import checkpoint as ckpt
+        from ..quantization import SCALE_SUFFIX
 
         self.version = int(version)
         self.values = dict(values)
@@ -122,6 +167,18 @@ class WeightVersion:
             ckpt.leaf_digests(self.values)
         self.source = source
         self.golden = dict(golden) if golden else None
+        if quant is None:
+            scales = [k for k in self.values if k.endswith(SCALE_SUFFIX)]
+            if scales:
+                quant = {}
+                for sk in scales:
+                    leaf = sk[:-len(SCALE_SUFFIX)]
+                    quant[leaf] = {
+                        "dtype": str(np.asarray(
+                            self.values[leaf]).dtype),
+                        "scale": float(np.asarray(self.values[sk])),
+                    }
+        self.quant = dict(quant) if quant else None
 
     @classmethod
     def from_model(cls, model, version=0):
@@ -129,9 +186,21 @@ class WeightVersion:
 
         return cls(version, state_values(model), source="model")
 
+    @classmethod
+    def quantized_from(cls, wv, version):
+        """Freeze an existing float version's 2-D weights to int8 (+
+        ``@scale`` companions) as a NEW version with its own manifest:
+        the artifact the fleet serves is the artifact the registry
+        certifies, not its float parent."""
+        from ..quantization import quantize_state_int8
+
+        return cls(version, quantize_state_int8(wv.values),
+                   source=f"int8(v{wv.version})")
+
     def __repr__(self):
+        q = ", int8" if self.quant else ""
         return (f"WeightVersion(v{self.version}, {len(self.values)} leaves"
-                f", source={self.source!r})")
+                f"{q}, source={self.source!r})")
 
 
 class WeightRegistry:

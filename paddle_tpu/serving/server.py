@@ -45,6 +45,12 @@ class Server:
     mode="batch": `fn` is a batch function (or pass a jax-traceable
     callable as `model`); requests are single samples coalesced by the
     `DynamicBatcher`.
+
+    Fast-decode knobs (forwarded to every engine, single or fleet):
+    ``spec_len``/``draft_model`` enable speculative decoding (self-draft
+    when no draft model is given), ``quantize`` freezes weights to int8
+    for the dequant decode path. Defaults come from
+    FLAGS_serving_spec_len / FLAGS_serving_quantize.
     """
 
     def __init__(self, model=None, *, mode="generate", fn=None,
@@ -52,7 +58,8 @@ class Server:
                  num_blocks=None, prefill_chunk=None, prefix_cache=None,
                  queue_cap=None, max_batch=None, max_wait_s=0.002,
                  cache_dtype=None, jit=True, strict_shapes=False,
-                 warmup=True, replicas=1, fleet=None):
+                 warmup=True, replicas=1, fleet=None, spec_len=None,
+                 draft_model=None, quantize=None):
         self.mode = mode
         self.metrics = ServingMetrics()
         self._warmup = warmup
@@ -66,7 +73,9 @@ class Server:
                 max_slots=max_slots, max_seq_len=max_seq_len,
                 block_size=block_size, num_blocks=num_blocks,
                 prefill_chunk=prefill_chunk, prefix_cache=prefix_cache,
-                cache_dtype=cache_dtype, strict_shapes=strict_shapes)
+                cache_dtype=cache_dtype, strict_shapes=strict_shapes,
+                spec_len=spec_len, draft_model=draft_model,
+                quantize=quantize)
             self.router = Router(
                 model, max(replicas, 1), engine_kw=engine_kw,
                 metrics=self.metrics, queue_cap=queue_cap,
@@ -86,7 +95,9 @@ class Server:
                 block_size=block_size, num_blocks=num_blocks,
                 prefill_chunk=prefill_chunk, prefix_cache=prefix_cache,
                 cache_dtype=cache_dtype, metrics=self.metrics,
-                queue=queue, strict_shapes=strict_shapes)
+                queue=queue, strict_shapes=strict_shapes,
+                spec_len=spec_len, draft_model=draft_model,
+                quantize=quantize)
             self.batcher = None
         elif mode == "batch":
             target = fn if fn is not None else model

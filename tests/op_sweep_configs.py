@@ -361,6 +361,12 @@ case("matmul", [f32((4, 3), seed=19), _M2],
      {"transpose_X": True},
      ref=lambda x, y, transpose_X: x.T @ y, grad=(0, 1))
 case("mul", [_M1, _M2], ref=lambda x, y: x @ y, grad=(0, 1))
+case("dequant_matmul",
+     [f32((4, 8), seed=18), ints((5, 8), -127, 128, seed=19,
+                                 dtype=np.int8),
+      np.float32(0.9)],
+     ref=lambda x, q, s: x @ (q.astype(np.float32) * (s / 127.0)).T,
+     grad=None, bf16=False)
 case("bmm", [f32((2, 3, 4), seed=20), f32((2, 4, 5), seed=21)],
      ref=np.matmul, grad=(0, 1))
 case("addmm", [f32((3, 5), seed=22), _M1, _M2],

@@ -132,6 +132,19 @@ def test_rollout_sites_are_registered():
         assert hint in faults.SITES[site]
 
 
+def test_fast_decode_sites_are_registered():
+    """ISSUE 16: the fast-decode sites — speculative draft/verify and
+    the int8 dequant step — must stay registered, or the bench's chaos
+    legs degrade to clean runs. (Behavioral coverage:
+    test_serving_spec.py: a draft fault degrades the round to plain
+    decode; verify/dequant faults are step errors the engine survives.)"""
+    for site, hint in (("serving.draft", "draft"),
+                       ("serving.verify", "verify"),
+                       ("serving.dequant", "dequant")):
+        assert site in faults.SITES, site
+        assert hint in faults.SITES[site]
+
+
 # ---------------------------------------------------------------------------
 # direct coverage for the sites no other tier-1 test drives
 # ---------------------------------------------------------------------------
