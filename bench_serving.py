@@ -49,6 +49,27 @@ sweep: a plain-greedy baseline leg vs a speculative leg (vs an optional
 tokens/s at acceptance >= 0.7, a bitwise-equal greedy output digest,
 compile counters frozen at one trace per kind for the server's life,
 and zero errors — then emits one ``BENCH_SERVING_SMOKE`` object.
+
+Mesh-sharded legs (ISSUE 17): ``--mesh dp1.mp2`` shards every engine's
+weights and paged KV pool over a (dp, mp) device mesh (GSPMD,
+serving/sharding.py) in any mode. ``--disagg`` runs the disaggregation
+benchmark instead of the sweep: a colocated fleet (every replica serves
+prefill AND decode through one chunk-wide compiled step) vs a
+disaggregated fleet (one prefill-role replica, decode-role replicas
+compiled at a narrow chunk, finished KV blocks streamed over the
+deadline-guarded mailbox) at EQUAL chips, over the same pinned prompts
+plus the same closed-loop load. Each leg reports ``decode_p99_ms`` /
+``prefill_p50_ms`` (from the per-step phase-latency series) and KV
+migration throughput; the run asserts bitwise greedy parity and a live
+migration path, and with ``--smoke`` additionally gates on the
+disaggregated decode p99 beating colocated — the unified step's cost
+scales with its compiled prefill width, so colocated decode pays the
+wide-chunk program every step while a decode-role replica never does.
+Emits ``BENCH_SERVING_DISAGG``. CPU certification dry-run:
+
+    JAX_PLATFORMS=cpu python bench_serving.py --disagg --smoke \
+        --mesh dp1.mp2 --clients 4 --steps 2 --prefill-chunk 64 \
+        --block-size 8 --hidden 32 --layers 2
 """
 
 from __future__ import annotations
@@ -213,7 +234,8 @@ def run_trace(args, model, serving):
         model, replicas=args.replicas, max_slots=args.max_slots,
         max_seq_len=args.max_seq_len, block_size=args.block_size,
         num_blocks=num_blocks, prefill_chunk=args.prefill_chunk,
-        queue_cap=max(64, 4 * args.max_slots), fleet=fleet).start()
+        queue_cap=max(64, 4 * args.max_slots), mesh=args.mesh or None,
+        fleet=fleet).start()
 
     def submit(a):
         return server.submit(a.prompt, max_new_tokens=a.max_new,
@@ -274,7 +296,7 @@ def run_chaos(args, model, serving):
             model, replicas=args.replicas, max_slots=args.max_slots,
             max_seq_len=args.max_seq_len, block_size=args.block_size,
             num_blocks=num_blocks, prefill_chunk=args.prefill_chunk,
-            queue_cap=max(64, 2 * n_clients),
+            queue_cap=max(64, 2 * n_clients), mesh=args.mesh or None,
             fleet=dict(hedge=False, retry_budget=3,
                        liveness_timeout_s=30.0, backoff_base_s=0.05,
                        name=name)).start()
@@ -331,6 +353,117 @@ def run_chaos(args, model, serving):
         with open(args.json, "w") as f:
             json.dump(result, f, indent=1, sort_keys=True)
     return 0
+
+
+def run_disagg(args, model, serving):
+    """--disagg: colocated vs disaggregated prefill/decode at equal
+    chips (same replica count, same mesh, same block pool). The
+    colocated fleet compiles every replica at the wide --prefill-chunk;
+    the disaggregated fleet gives one replica the prefill role (wide
+    chunk) and compiles the decode-role replicas at a narrow chunk
+    (--block-size), with finished KV blocks migrating prefill->decode
+    through the deadline-guarded mailbox. Correctness gates (always):
+    bitwise greedy parity between the legs, zero failed requests, and a
+    live migration path; perf gate (--smoke): disaggregated decode p99
+    strictly under colocated."""
+    import hashlib
+
+    n_clients = [int(c) for c in args.clients.split(",") if c][0]
+    blocks_per_seq = -(-args.max_seq_len // args.block_size)
+    num_blocks = args.kv_blocks or \
+        args.dense_equiv_slots * blocks_per_seq + 1
+    wide = args.prefill_chunk
+    narrow = min(args.block_size, wide)
+    rng = np.random.RandomState(17)
+    pinned = [rng.randint(0, args.vocab,
+                          (args.prompt_len,)).astype(np.int32)
+              for _ in range(4)]
+
+    def leg(name, fleet_kw):
+        server = serving.Server(
+            model, replicas=args.replicas, max_slots=args.max_slots,
+            max_seq_len=args.max_seq_len, block_size=args.block_size,
+            num_blocks=num_blocks, prefill_chunk=wide,
+            prefix_cache=True, queue_cap=max(64, 2 * n_clients),
+            mesh=args.mesh or None,
+            fleet=dict(hedge=False, liveness_timeout_s=30.0,
+                       name=name, **fleet_kw)).start()
+        # pinned parity probe first (also warms every compiled trace so
+        # the timed load below measures steps, not compiles)
+        outs = [np.asarray(server.generate(p, max_new_tokens=args.max_new,
+                                           timeout=120.0), np.int64)
+                for p in pinned]
+        digest = hashlib.sha256(
+            b"".join(np.ascontiguousarray(o).tobytes()
+                     for o in outs)).hexdigest()
+        m = server.metrics
+        moved0 = m.get("kv_migrate_bytes")
+        row = run_fleet_level(server, n_clients, args.steps,
+                              args.prompt_len, args.max_new, args.vocab)
+        dec = m.latency_percentiles("decode", (99,))[99]
+        pre = m.latency_percentiles("prefill", (50,))[50]
+        moved = m.get("kv_migrate_bytes") - moved0
+        row.update({
+            "digest": digest,
+            "decode_p99_ms": round((dec or 0.0) * 1e3, 3),
+            "prefill_p50_ms": round((pre or 0.0) * 1e3, 3),
+            "kv_migrations": m.get("kv_migrations"),
+            "kv_migrate_blocks": m.get("kv_migrate_blocks"),
+            "kv_migrate_bytes": m.get("kv_migrate_bytes"),
+            "kv_migrate_faults": m.get("kv_migrate_faults"),
+            "kv_migrate_mb_per_s": round(
+                moved / max(row["wall_s"], 1e-9) / 2**20, 3),
+        })
+        server.shutdown(drain=True)
+        return row
+
+    colo = leg("dcolo", {})
+    print(json.dumps({"leg": "colocated", **colo}))
+    roles = ["prefill"] + ["decode"] * max(args.replicas - 1, 1)
+    dis = leg("ddis", dict(
+        roles=roles[:max(args.replicas, 2)],
+        role_kw={"decode": {"prefill_chunk": narrow}}, disagg=True))
+    print(json.dumps({"leg": "disagg", **dis}))
+
+    failures = []
+    if colo["requests_failed"] or dis["requests_failed"]:
+        failures.append(f"failed requests: colo="
+                        f"{colo['requests_failed']} "
+                        f"disagg={dis['requests_failed']}")
+    if dis["digest"] != colo["digest"]:
+        failures.append("greedy parity digest mismatch")
+    if not dis["kv_migrations"]:
+        failures.append("disagg leg migrated no KV blocks")
+    if args.smoke and dis["decode_p99_ms"] >= colo["decode_p99_ms"]:
+        failures.append(
+            f"disagg decode p99 {dis['decode_p99_ms']}ms >= "
+            f"colocated {colo['decode_p99_ms']}ms")
+    result = {
+        "bench": "BENCH_SERVING_DISAGG",
+        "config": {
+            "replicas": args.replicas, "mesh": args.mesh or None,
+            "clients": n_clients, "steps": args.steps,
+            "prompt_len": args.prompt_len, "max_new": args.max_new,
+            "prefill_chunk_wide": wide, "prefill_chunk_narrow": narrow,
+            "block_size": args.block_size, "kv_blocks": num_blocks,
+            "model": {"vocab": args.vocab, "hidden": args.hidden,
+                      "layers": args.layers, "heads": args.heads},
+        },
+        "colocated": colo,
+        "disagg": dis,
+        "decode_p99_speedup": round(
+            colo["decode_p99_ms"] / max(dis["decode_p99_ms"], 1e-9), 3),
+        "greedy_parity": dis["digest"] == colo["digest"],
+        "smoke": bool(args.smoke),
+        "ok": not failures,
+    }
+    if failures:
+        result["failures"] = failures
+    print(json.dumps(result))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(result, f, indent=1, sort_keys=True)
+    return 0 if result["ok"] else 1
 
 
 def run_smoke(args, serving):
@@ -510,14 +643,26 @@ def main(argv=None):
     ap.add_argument("--smoke", action="store_true",
                     help="fast-decode certification: baseline vs "
                     "speculative legs, >=2x + parity + compile-once "
-                    "assertions; emits BENCH_SERVING_SMOKE")
+                    "assertions; emits BENCH_SERVING_SMOKE (with "
+                    "--disagg: adds the decode-p99-win gate to the "
+                    "disaggregation benchmark)")
+    ap.add_argument("--mesh", default="",
+                    help="serving mesh spec 'dpD.mpM' (e.g. dp1.mp2): "
+                    "shard every engine's weights + paged KV pool over "
+                    "a (dp, mp) device mesh via GSPMD "
+                    "(serving/sharding.py; default FLAGS_serving_mesh)")
+    ap.add_argument("--disagg", action="store_true",
+                    help="disaggregation benchmark: colocated fleet vs "
+                    "prefill/decode-role fleet at equal chips, decode "
+                    "p99 / prefill p50 / KV-migration throughput per "
+                    "leg; emits BENCH_SERVING_DISAGG")
     args = ap.parse_args(argv)
 
     import paddle_tpu as paddle
     from paddle_tpu import serving
     from paddle_tpu.nlp.transformers import GPTConfig, GPTForPretraining
 
-    if args.smoke:
+    if args.smoke and not args.disagg:
         return run_smoke(args, serving)
 
     paddle.seed(7)
@@ -527,6 +672,8 @@ def main(argv=None):
                     attn_dropout=0.0, use_parallel=False)
     model = GPTForPretraining(cfg)
 
+    if args.disagg:
+        return run_disagg(args, model, serving)
     if args.chaos:
         return run_chaos(args, model, serving)
     if args.trace:
@@ -547,7 +694,8 @@ def main(argv=None):
             max_seq_len=args.max_seq_len, block_size=args.block_size,
             num_blocks=num_blocks, prefill_chunk=args.prefill_chunk,
             queue_cap=max(64, 2 * n_clients),
-            spec_len=args.spec, quantize=args.int8).start()
+            spec_len=args.spec, quantize=args.int8,
+            mesh=args.mesh or None).start()
         row = run_level(server, n_clients, args.steps, args.prompt_len,
                         args.max_new, args.vocab,
                         shared_prefix=args.shared_prefix)
@@ -570,6 +718,7 @@ def main(argv=None):
             "prefill_chunk": args.prefill_chunk,
             "shared_prefix": args.shared_prefix,
             "spec_len": args.spec, "int8": args.int8,
+            "mesh": args.mesh or None,
             "kv_pool_bytes": kv_bytes,
             "model": {"vocab": args.vocab, "hidden": args.hidden,
                       "layers": args.layers, "heads": args.heads},

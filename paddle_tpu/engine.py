@@ -105,16 +105,25 @@ def functional_call(layer, values, *args, capture_buffers=False, **kwargs):
     return _unwrap(out)
 
 
-def functional_apply(layer, values, fn):
+def functional_apply(layer, values, fn, mesh=None):
     """Run an arbitrary `fn(layer)` with parameters/buffers taken from
     `values` (dict name->array), tape off — the inference analogue of
     functional_call for callers that need more than one plain forward
     (e.g. the serving decode step: cached GPT forward + lm-head logits
     inside one jitted function). Returns fn's result with Tensors
-    unwrapped to arrays."""
-    from .core.config import no_tape
+    unwrapped to arrays.
 
-    with no_tape(), _swap_state(layer, values):
+    When `mesh` is given the call runs inside `ops.overlap.region(mesh)`
+    so RowParallelLinear matmuls route through the ring collective-matmul
+    kernels when `FLAGS_mp_overlap` is on and the mesh qualifies — the
+    same silent-guard contract as training (unsupported mesh or
+    non-divisible shapes fall back to plain GSPMD)."""
+    from .core.config import no_tape
+    from .ops import overlap
+
+    region = (overlap.region(mesh) if mesh is not None
+              else contextlib.nullcontext())
+    with region, no_tape(), _swap_state(layer, values):
         out = fn(layer)
     return _unwrap(out)
 

@@ -51,6 +51,17 @@ A fault point is a named site the runtime passes through:
     serving.replay            each failover replay of a dead replica's
                               request (raise = replay path failure →
                               typed error to the client)
+    serving.shard_step        each decode step of a mesh-sharded engine
+                              before the sharded dispatch, tagged with
+                              the engine name (raise = step error the
+                              engine survives and the Router replays)
+    serving.kv_migrate        each KV-block adoption while a prefill
+                              replica's finished blocks migrate to a
+                              decode replica, tagged with the adopting
+                              engine name (raise = migration abort —
+                              all-or-nothing, the pool stays leak-free
+                              and the request falls back to colocated
+                              dispatch)
     ps.push                   each PS mutation between WAL append and
                               table apply, tagged with the table name
                               (crash = kill mid-push: recovery replays
@@ -173,6 +184,12 @@ SITES = {
                       "unified decode trace",
     "serving.dequant": "each decode step of an int8-frozen engine, "
                        "before the dequant decode dispatch",
+    "serving.shard_step": "each decode step of a mesh-sharded engine, "
+                          "before the sharded dispatch (tag = engine "
+                          "name)",
+    "serving.kv_migrate": "each KV-block adoption during the "
+                          "prefill->decode block migration (tag = "
+                          "adopting decode engine name)",
     "dist.allreduce": "each eager all-reduce before the transport "
                       "(delay eats the FLAGS_dist_timeout_s budget)",
     "dist.barrier": "each eager barrier / gang ckpt commit barrier",

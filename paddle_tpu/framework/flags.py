@@ -126,6 +126,20 @@ _DEFS = {
         "in-trace (weights ride the jit boundary as int8 — the TPU win "
         "is HBM bytes) and the tied LM head runs the dequant-matmul "
         "epilogue from ops/quant_ops.py"),
+    "FLAGS_serving_mesh": (
+        "", str,
+        "serving: mesh spec 'dpD.mpM' the SlotEngine shards weights and "
+        "the paged KV pool over (partition rules from "
+        "serving/sharding.py; block tables stay host-side and "
+        "replica-global). Empty = single-device engine, exactly the "
+        "pre-mesh behavior"),
+    "FLAGS_serving_disagg": (
+        False, bool,
+        "serving: disaggregate prefill and decode — the Router sends "
+        "each request's prefill to a prefill-role replica, streams the "
+        "finished KV blocks to a decode-role replica over the "
+        "deadline-guarded migration mailbox, and pins the decode leg "
+        "to the prefill leg's weight version"),
     "FLAGS_fleet_min_replicas": (
         1, int,
         "fleet: autoscaler floor — the Autoscaler never drains the "

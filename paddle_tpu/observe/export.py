@@ -109,6 +109,13 @@ def snapshot(serving=None):
             restart_lost_seconds=(
                 monitor.stat_get("gang.restart_lost_ms") / 1e3),
             heartbeat_ages=_gang_heartbeat_ages()),
+        # mesh-sharded serving view mirrors paddle_serving_mesh_*: the
+        # KV-migration counters (every ServingMetrics.inc also lands in
+        # the monitor registry; per-engine mesh shape / per-shard
+        # occupancy detail lives in snapshot()["serving"]["mesh"] when
+        # a ServingMetrics registry is passed)
+        "mesh": {stat.split(".", 1)[1]: monitor.stat_get(stat)
+                 for stat in _MESH_STATS},
     }
     if serving is not None:
         out["serving"] = serving.snapshot()
@@ -240,6 +247,18 @@ _GANG_METRICS = {
 }
 #: gang stats consumed by _GANG_METRICS or converted inline
 _GANG_STATS = set(_GANG_METRICS) | {"gang.restart_lost_ms"}
+
+#: monitor stats mirrored in snapshot()["mesh"] (mesh-sharded serving's
+#: KV-migration traffic; the serving-registry counters of the same
+#: names feed the labelled paddle_serving_mesh_* family below)
+_MESH_STATS = (
+    "serving.kv_migrations", "serving.kv_migrate_blocks",
+    "serving.kv_migrate_bytes", "serving.kv_migrate_faults",
+    "serving.kv_migrate_timeouts",
+)
+
+#: disaggregation role encodings for the mesh-family role gauge
+MESH_ROLE_CODES = {"any": 0, "prefill": 1, "decode": 2}
 
 
 def _gang_heartbeat_ages():
@@ -447,6 +466,29 @@ def prometheus_text(serving=None, queue_depth=None, fleet=None):
                   spec["dequant_path"],
                   help_="1 while the engine serves int8-frozen weights "
                         "through the dequant epilogue path")
+        # mesh-sharded serving: shape-labelled gauges + KV-migration
+        # counters + the disaggregation role gauge
+        mesh = snap.get("mesh")
+        if mesh:
+            mlab = {"mesh": mesh["spec"] or "single"}
+            L.add("paddle_serving_mesh_devices", mesh["devices"],
+                  labels=mlab,
+                  help_="devices in this engine's serving mesh")
+            L.add("paddle_serving_mesh_role",
+                  MESH_ROLE_CODES.get(mesh["role"], -1),
+                  labels={**mlab, "role": mesh["role"]},
+                  help_="disaggregation role (0=any 1=prefill 2=decode)")
+            for shard in mesh["per_shard_occupancy"]:
+                L.add("paddle_serving_mesh_shard_occupancy",
+                      shard["occupancy"],
+                      labels={**mlab, "shard": str(shard["shard"])},
+                      help_="per-shard decode slot occupancy (GSPMD "
+                            "runs one program per shard)")
+            for k in ("kv_migrations", "kv_migrate_blocks",
+                      "kv_migrate_bytes", "kv_migrate_faults"):
+                L.add(f"paddle_serving_mesh_{k}_total", mesh[k],
+                      mtype="counter", labels=mlab,
+                      help_="prefill->decode KV block migration traffic")
     if queue_depth is not None:
         L.add("paddle_serving_queue_depth", queue_depth)
 
@@ -487,6 +529,13 @@ def prometheus_text(serving=None, queue_depth=None, fleet=None):
                 L.add("paddle_serving_replica_beat_age_seconds",
                       rep["beat_age_s"], labels=labels,
                       help_="age of the replica's last liveness beat")
+            role = rep.get("role", "any")
+            L.add("paddle_serving_replica_role",
+                  MESH_ROLE_CODES.get(role, -1),
+                  labels={**labels, "role": role,
+                          "mesh": rep.get("mesh", "") or "single"},
+                  help_="replica disaggregation role "
+                        "(0=any 1=prefill 2=decode)")
             br = rep.get("breaker", {})
             L.add("paddle_serving_replica_breaker_state",
                   breaker_codes.get(br.get("state"), -1),

@@ -34,6 +34,13 @@ iteration-level ("continuous") batching in the Orca lineage:
   gates, rolling canary upgrades through drain→rebuild, golden-prompt
   bitwise + SLO burn gates, and auto-rollback to the pinned previous
   version (rollout.py);
+- `ShardingPlan` / `match_partition_rules` — mesh-sharded serving:
+  partition-rule-driven TP/GSPMD weight + paged-KV sharding over a
+  (dp, mp) device mesh, reusing the training Column/RowParallel
+  layout conventions (sharding.py, FLAGS_serving_mesh);
+- `KVMailbox` / `migrate_prefix` — disaggregated prefill/decode:
+  deadline-guarded prefill→decode KV-block streaming behind the
+  Router (migrate.py, FLAGS_serving_disagg);
 - `Scenario` / `Arrival` / `replay` — the seeded open-loop traffic
   simulator every serving bench replays (workload.py);
 - `Server` / `http_front` — the user-facing shell (server.py);
@@ -52,6 +59,7 @@ from .fleet import (  # noqa: F401
     CircuitBreaker, Replica, ReplicaSet, Router, retriable,
 )
 from .metrics import ServingMetrics, percentile  # noqa: F401
+from .migrate import KVMailbox, migrate_prefix  # noqa: F401
 from .paging import (  # noqa: F401
     NULL_BLOCK, BlockAllocator, PoolExhausted, PrefixCache,
     positions_to_rows,
@@ -68,20 +76,27 @@ from .rollout import (  # noqa: F401
 )
 from .autoscale import SLOWindow  # noqa: F401
 from .server import Server, http_front  # noqa: F401
+from .sharding import (  # noqa: F401
+    GPT_PARTITION_RULES, ShardingPlan, build_mesh, match_partition_rules,
+    mesh_spec_of, parse_mesh_spec, resolve_mesh,
+)
 from .workload import Arrival, Scenario, replay  # noqa: F401
 
 __all__ = [
     "AdmissionQueue", "Arrival", "Autoscaler", "BlockAllocator",
     "BrownoutShedError",
     "CapacityExhaustedError", "CircuitBreaker", "DeadlineExceededError",
-    "DynamicBatcher", "NULL_BLOCK", "PoolExhausted", "PrefixCache",
+    "DynamicBatcher", "GPT_PARTITION_RULES", "KVMailbox", "NULL_BLOCK",
+    "PoolExhausted", "PrefixCache",
     "QueueFullError", "Replica", "ReplicaDiedError", "ReplicaSet",
     "Request", "RequestCancelled", "RetriesExhaustedError",
     "RolloutController", "RolloutError", "RolloutGateError", "Router",
     "SLOWindow", "Scenario", "Server", "ServerClosedError",
-    "ServingError", "ServingMetrics", "SlotEngine",
+    "ServingError", "ServingMetrics", "ShardingPlan", "SlotEngine",
     "VersionRetiredError", "WeightRegistry", "WeightVersion",
-    "bucket_for", "bucket_ladder", "golden_digests", "http_front",
-    "pad_batch", "percentile", "positions_to_rows", "replay",
-    "retriable",
+    "bucket_for", "bucket_ladder", "build_mesh", "golden_digests",
+    "http_front", "match_partition_rules", "mesh_spec_of",
+    "migrate_prefix",
+    "pad_batch", "parse_mesh_spec", "percentile", "positions_to_rows",
+    "replay", "resolve_mesh", "retriable",
 ]

@@ -188,7 +188,8 @@ class SlotEngine:
                  prefix_cache=None, cache_dtype=None, metrics=None,
                  queue=None, strict_shapes=False, name=None,
                  supervised=False, values=None, weight_version=0,
-                 draft_model=None, spec_len=None, quantize=None):
+                 draft_model=None, spec_len=None, quantize=None,
+                 mesh=None):
         import jax
         import jax.numpy as jnp
 
@@ -196,10 +197,21 @@ class SlotEngine:
             SCALE_SUFFIX, dequantize_state, is_quantized_state,
             quantize_state_int8,
         )
+        from .sharding import ShardingPlan, mesh_spec_of, resolve_mesh
 
         model.eval()
         self.model = model
         self.name = name or "engine"
+        # mesh-sharded serving (ISSUE 17): None consults
+        # FLAGS_serving_mesh; a 'dpD.mpM' string builds the 2-axis
+        # serving mesh. Weights/pools are placed by the partition rules
+        # in serving/sharding.py and the ONE compiled step carries
+        # explicit in/out shardings — still exactly one trace per mesh
+        # shape for engine life.
+        self.mesh = resolve_mesh(mesh)
+        self.mesh_spec = mesh_spec_of(self.mesh)
+        self._plan = ShardingPlan(self.mesh) \
+            if self.mesh is not None else None
         self.supervised = supervised
         self.last_beat = time.monotonic()
         self.heartbeats = 0
@@ -268,6 +280,16 @@ class SlotEngine:
         shape = (self.num_blocks, cfg.num_heads, self.block_size, hd)
         self._ks = [jnp.zeros(shape, dtype) for _ in range(cfg.num_layers)]
         self._vs = [jnp.zeros(shape, dtype) for _ in range(cfg.num_layers)]
+        if self._plan is not None:
+            # weights by partition rule, KV pools over the head axis
+            # (replicated when heads don't divide mp); block tables and
+            # the allocator stay host-side numpy — replica-global
+            self._values = self._plan.place_values(self._values)
+            pool_sh = self._plan.pool_sharding(cfg.num_heads)
+            self._ks = [jax.device_put(k, pool_sh) for k in self._ks]
+            self._vs = [jax.device_put(v, pool_sh) for v in self._vs]
+            self.metrics.set_gauge("mesh_devices", float(self.mesh.size))
+            self.metrics.note_mesh(self.mesh_spec, int(self.mesh.size))
         self.kv_pool_bytes = int(
             2 * cfg.num_layers * np.prod(shape) * jnp.zeros((), dtype).nbytes)
         self._alloc = BlockAllocator(self.num_blocks)
@@ -285,6 +307,11 @@ class SlotEngine:
         self._warmed = False
         self._abort = threading.Event()
         self._thread = None
+        # KV adoptions (prefill->decode migration) land at step
+        # boundaries: callers enqueue here and the serve loop applies,
+        # so pool rebinds never race the compiled step's own updates
+        self._migrate_q: list = []
+        self._migrate_lock = threading.Lock()
 
         def _count(key):
             self._compiles[key] = self._compiles.get(key, 0) + 1
@@ -338,7 +365,8 @@ class SlotEngine:
                     return (lv, sv), new_caches
                 return (lv, lv), new_caches
 
-            (lv, sv), new_caches = functional_apply(self.model, fvals, run)
+            (lv, sv), new_caches = functional_apply(self.model, fvals, run,
+                                                    mesh=self.mesh)
             out_ks = [c[0] for c in new_caches]
             out_vs = [c[1] for c in new_caches]
             if self.spec_len:
@@ -358,8 +386,28 @@ class SlotEngine:
 
             return [copy(k) for k in ks], [copy(v) for v in vs]
 
-        self._decode = jax.jit(step_fn)
-        self._cow = jax.jit(cow_fn)
+        if self._plan is not None:
+            # explicit in/out shardings: host-staged step inputs are
+            # replicated, weights follow the partition rules, pools keep
+            # their head sharding through the step (GSPMD then has no
+            # freedom to reshard the hot loop between steps)
+            rep = self._plan.replicated()
+            vsh = self._plan.values_shardings(self._values)
+            pools = [self._plan.pool_sharding(cfg.num_heads)] \
+                * cfg.num_layers
+            step_out = (rep, rep, pools, pools) if self.spec_len \
+                else (rep, pools, pools)
+            self._decode = jax.jit(
+                step_fn,
+                in_shardings=(vsh, rep, rep, rep, rep, pools, pools),
+                out_shardings=step_out)
+            self._cow = jax.jit(
+                cow_fn,
+                in_shardings=(pools, pools, rep, rep),
+                out_shardings=(pools, pools))
+        else:
+            self._decode = jax.jit(step_fn)
+            self._cow = jax.jit(cow_fn)
 
         # -- speculative draft trace (only when spec is on: a disabled
         # engine keeps compile counters {decode: 1, cow: 1} exactly) --
@@ -434,6 +482,22 @@ class SlotEngine:
         draft/verify batches reuse the same two programs for life."""
         return dict(self._compiles)
 
+    def mesh_info(self):
+        """Mesh introspection for fleet snapshots: canonical spec label,
+        device count, and whether the KV pool is actually head-sharded
+        (heads % mp == 0) or silently replicated."""
+        if self.mesh is None:
+            return {"spec": "", "devices": 1, "kv_sharded": False}
+        from ..distributed.topology import MP_AXIS
+
+        mp = dict(self.mesh.shape).get(MP_AXIS, 1)
+        return {
+            "spec": self.mesh_spec,
+            "devices": int(self.mesh.size),
+            "kv_sharded": bool(
+                mp > 1 and self.model.config.num_heads % mp == 0),
+        }
+
     @property
     def active(self):
         return sum(1 for s in self._slots if s is not None)
@@ -456,25 +520,47 @@ class SlotEngine:
 
     # -- warmup -------------------------------------------------------------
 
-    def warmup(self):
+    def warmup(self, mesh=None):
         """Trace the unified step and the CoW copy before traffic so the
         hot path never compiles. All tables point at the null block, so
         the dummy step's writes land in reserved scratch; outputs are
-        discarded. Returns `compile_counts`."""
+        discarded. Returns `compile_counts`.
+
+        `mesh` (optional) asserts the caller's mesh matches the one the
+        engine compiled for — a shard restart that rebuilt topology must
+        land on the same shape or it would silently retrace. A repeat
+        warmup (re-entering the serve path after a shard restart) runs
+        under `observe.no_retrace()`: same shapes + same mesh = zero new
+        compiles for engine life."""
+        import contextlib
+
         import jax.numpy as jnp
 
-        tok = jnp.zeros((self.max_slots, self.prefill_chunk), jnp.int32)
-        pos = jnp.zeros((self.max_slots,), jnp.int32)
-        nvalid = jnp.ones((self.max_slots,), jnp.int32)
-        self._decode(self._values, tok, pos, nvalid,
-                     jnp.asarray(self._bt), self._ks, self._vs)
-        self._cow(self._ks, self._vs, jnp.int32(NULL_BLOCK),
-                  jnp.int32(NULL_BLOCK))
-        if self.spec_len:
-            dtok = jnp.zeros((self.max_slots, self._draft_chunk),
-                             jnp.int32)
-            self._draft(self._dvalues, dtok, pos, nvalid,
-                        jnp.asarray(self._bt), self._dks, self._dvs)
+        if mesh is not None:
+            from .sharding import mesh_spec_of, resolve_mesh
+
+            want = mesh_spec_of(resolve_mesh(mesh))
+            if want != self.mesh_spec:
+                raise ValueError(
+                    f"warmup mesh {want!r} != engine mesh "
+                    f"{self.mesh_spec!r}: rebuild the engine for a new "
+                    "mesh shape instead of re-warming")
+        guard = observe.no_retrace() if self._warmed \
+            else contextlib.nullcontext()
+        with guard:
+            tok = jnp.zeros((self.max_slots, self.prefill_chunk),
+                            jnp.int32)
+            pos = jnp.zeros((self.max_slots,), jnp.int32)
+            nvalid = jnp.ones((self.max_slots,), jnp.int32)
+            self._decode(self._values, tok, pos, nvalid,
+                         jnp.asarray(self._bt), self._ks, self._vs)
+            self._cow(self._ks, self._vs, jnp.int32(NULL_BLOCK),
+                      jnp.int32(NULL_BLOCK))
+            if self.spec_len:
+                dtok = jnp.zeros((self.max_slots, self._draft_chunk),
+                                 jnp.int32)
+                self._draft(self._dvalues, dtok, pos, nvalid,
+                            jnp.asarray(self._bt), self._dks, self._dvs)
         self._warmed = True
         return self.compile_counts
 
@@ -597,6 +683,116 @@ class SlotEngine:
             self.metrics.observe_latency(
                 "queue", time.monotonic() - req.arrival)
 
+    # -- KV migration (prefill->decode disaggregation, ISSUE 17) ------------
+
+    def export_prefix_blocks(self, prompt_ids):
+        """Gather this engine's fully-written cached KV blocks covering
+        `prompt_ids` into host numpy for migration. Returns a payload
+        dict (tokens / per-layer (k_rows, v_rows) / geometry) or None
+        when nothing is cached. The matched blocks are pinned (incref)
+        for the duration of the gather so a concurrent reclaim cannot
+        recycle them mid-copy; block tables were host-side all along, so
+        only block payload bytes leave the engine."""
+        if self._cache is None:
+            return None
+        ids = np.asarray(prompt_ids, np.int32).reshape(-1)
+        if ids.size < 2:
+            return None
+        shared, n_shared, _cow = self._cache.match(ids, ids.size - 1)
+        if not shared:
+            return None
+        for bid in shared:
+            self._alloc.incref(bid)
+        try:
+            # snapshot the (immutable) pool arrays once: a concurrent
+            # step rebinding self._ks cannot tear the gather, and the
+            # pinned blocks' rows were fully written before the cache
+            # ever indexed them
+            ks, vs = list(self._ks), list(self._vs)
+            idx = np.asarray(shared, np.int64)
+            layers = [(np.asarray(k[idx]), np.asarray(v[idx]))
+                      for k, v in zip(ks, vs)]
+        finally:
+            for bid in shared:
+                self._alloc.decref(bid)
+        return {
+            "tokens": [int(t) for t in ids[:n_shared]],
+            "n_tokens": int(n_shared),
+            "block_size": self.block_size,
+            "layers": layers,
+        }
+
+    def adopt_prefix_blocks(self, payload, timeout=5.0):
+        """Adopt migrated KV blocks into this engine's pool + prefix
+        cache. Applied at a step boundary when the serve loop is
+        running (pool rebinds must not race the compiled step), inline
+        otherwise. Returns the number of prompt tokens now served from
+        cache (0 = incompatible payload). All-or-nothing: any fault
+        mid-adoption frees every block taken so far — the pool is
+        leak-free and the request simply prefills from scratch."""
+        if self._thread is not None and self._thread.is_alive():
+            done = threading.Event()
+            box: dict = {}
+            with self._migrate_lock:
+                self._migrate_q.append((payload, done, box))
+            if not done.wait(timeout):
+                raise TimeoutError(
+                    f"engine {self.name!r} did not reach a step boundary "
+                    f"within {timeout:.3f}s to adopt migrated KV")
+            if "error" in box:
+                raise box["error"]
+            return box["adopted"]
+        return self._apply_adoption(payload)
+
+    def _drain_adoptions(self):
+        while True:
+            with self._migrate_lock:
+                if not self._migrate_q:
+                    return
+                payload, done, box = self._migrate_q.pop(0)
+            try:
+                box["adopted"] = self._apply_adoption(payload)
+            except Exception as e:  # noqa: BLE001 — caller re-raises
+                box["error"] = e
+            finally:
+                done.set()
+
+    def _apply_adoption(self, payload):
+        if self._cache is None or payload is None:
+            return 0
+        if payload.get("block_size") != self.block_size:
+            return 0
+        layers = payload["layers"]
+        if len(layers) != len(self._ks):
+            return 0
+        nb = int(layers[0][0].shape[0]) if layers else 0
+        if nb == 0 or layers[0][0].shape[1:] != self._ks[0].shape[1:]:
+            return 0
+        if self._alloc.free_blocks < nb and self._cache is not None:
+            self._cache.reclaim(nb - self._alloc.free_blocks)
+        taken: list = []
+        try:
+            for _ in range(nb):
+                faults.fault_point("serving.kv_migrate", tag=self.name)
+                taken.append(self._alloc.alloc())
+            idx = np.asarray(taken, np.int64)
+            for li, (krows, vrows) in enumerate(layers):
+                self._ks[li] = self._ks[li].at[idx].set(krows)
+                self._vs[li] = self._vs[li].at[idx].set(vrows)
+            n_tokens = nb * self.block_size
+            self._cache.insert(payload["tokens"][:n_tokens], taken,
+                               n_tokens)
+        except Exception:
+            for bid in taken:
+                self._alloc.decref(bid)
+            raise
+        # the cache increfed every NEW entry; dropping our allocation
+        # refs hands ownership over (and frees duplicate-key blocks the
+        # cache already held under another id)
+        for bid in taken:
+            self._alloc.decref(bid)
+        return nb * self.block_size
+
     @staticmethod
     def _warp_probs(logits, gen):
         """Temperature + top-k warped softmax, exactly the transform
@@ -651,6 +847,10 @@ class SlotEngine:
                 self._evict(i, error)
 
     def _step(self):
+        if self.mesh is not None:
+            # raise here propagates to _loop like any step error: the
+            # engine survives and the Router replays the in-flight work
+            faults.fault_point("serving.shard_step", tag=self.name)
         if self.quantized:
             # raise here propagates to _loop like any step error
             faults.fault_point("serving.dequant")
@@ -678,6 +878,9 @@ class SlotEngine:
             prefill_tokens = self._consume_slots(now, tok, nvalid, live)
         if not live:
             return
+        n_pref = sum(1 for i in live
+                     if self._slots[i].state == "prefill")
+        t0 = time.monotonic()
         with profiler.RecordEvent("serving.step", cat="serving"):
             with observe.phase("device-step", cat="serving"):
                 logits, self._ks, self._vs = self._decode(
@@ -685,6 +888,8 @@ class SlotEngine:
                     jnp.asarray(self._pos), jnp.asarray(nvalid),
                     jnp.asarray(self._bt), self._ks, self._vs)
         logits = np.asarray(logits)
+        self._observe_step_latency(time.monotonic() - t0,
+                                   prefill_tokens, len(live) - n_pref)
         for i in live:
             slot = self._slots[i]
             self._pos[i] += slot.advance
@@ -702,6 +907,18 @@ class SlotEngine:
         self.metrics.observe_occupancy(len(live), self.max_slots)
         self.metrics.observe_blocks(self._alloc.blocks_in_use,
                                     self._alloc.usable)
+
+    def _observe_step_latency(self, dt, prefill_tokens, n_decoding):
+        """Attribute one device step to the phase-latency series: a step
+        staging prompt tokens is a 'prefill' sample, a step advancing at
+        least one decoding slot is a 'decode' sample (a mixed colocated
+        step is honestly both — decoding slots really did wait for the
+        chunk-wide prefill program). These feed the decode p99 /
+        prefill p50 columns the disaggregation bench compares."""
+        if prefill_tokens:
+            self.metrics.observe_latency("prefill", dt)
+        if n_decoding:
+            self.metrics.observe_latency("decode", dt)
 
     def _consume_slots(self, now, tok, nvalid, live):
         """Host-side half of a step: sample each decoding slot's pending
@@ -798,6 +1015,9 @@ class SlotEngine:
                 tok[i, 1:1 + len(props)] = props
             nvalid[i] = 1 + len(props)
         faults.fault_point("serving.verify")
+        n_pref = sum(1 for i in live
+                     if self._slots[i].state == "prefill")
+        t0 = time.monotonic()
         with profiler.RecordEvent("serving.step", cat="serving"):
             with observe.phase("device-step", cat="serving"):
                 lv, sv, self._ks, self._vs = self._decode(
@@ -806,6 +1026,8 @@ class SlotEngine:
                     jnp.asarray(self._bt), self._ks, self._vs)
         lv = np.asarray(lv)
         sv = np.asarray(sv)
+        self._observe_step_latency(time.monotonic() - t0,
+                                   prefill_tokens, len(live) - n_pref)
         for i in live:
             slot = self._slots[i]
             if slot.state == "prefill":
@@ -1060,6 +1282,7 @@ class SlotEngine:
         with guard:
             while True:
                 self._beat()
+                self._drain_adoptions()
                 if self._abort.is_set():
                     self._fail_all_active(
                         self._abort_error or RequestCancelled(

@@ -53,6 +53,22 @@ availability toolkit:
   restarted. `serving/autoscale.py` drives both ends from the SLO
   error budget.
 
+- **Disaggregated prefill/decode** (``FLAGS_serving_disagg`` or
+  ``Router(disagg=True)``). Replicas carry a `role` ("prefill" /
+  "decode" / "any", assigned via ``roles=`` and specialized via
+  ``role_kw=`` engine overrides — typically a wide ``prefill_chunk``
+  for prefill replicas, a narrow one for decode). Each new request's
+  first leg goes to a prefill-role replica with ``max_new_tokens=1``
+  (the produced token is discarded); on success a migration thread
+  streams the finished KV blocks to a decode replica over the
+  deadline-guarded mailbox (serving/migrate.py), then the decode leg
+  dispatches with one-shot affinity to the adopting replica and a pin
+  to the prefill leg's weight version — a wave can never mix weight
+  versions within one request. Any failure (no roles healthy,
+  migration fault/timeout, adoption abort) degrades the request to
+  ordinary colocated dispatch; failover replay and first-wins dedup
+  apply to both legs unchanged. Prefill legs are never hedged.
+
 Chaos sites (framework/faults.py): ``serving.replica_step`` and
 ``serving.replica_heartbeat`` fire inside supervised engine loops
 (tagged with the replica name, so ``serving.replica_step[fleet.r0]``
@@ -175,11 +191,18 @@ class CircuitBreaker:
 
 class Replica:
     """One supervised engine slot: the engine itself (rebuilt across
-    generations), liveness/restart bookkeeping, and its breaker."""
+    generations), liveness/restart bookkeeping, and its breaker.
 
-    def __init__(self, index, name, breaker):
+    `role` is the disaggregation assignment: "any" (default) serves
+    whole requests; "prefill" replicas only take the prefill leg of a
+    disaggregated flight and stream their finished KV blocks out;
+    "decode" replicas take everything except prefill legs. Roles are
+    routing hints on the Router side — the engine itself is identical."""
+
+    def __init__(self, index, name, breaker, role="any"):
         self.index = index
         self.name = name
+        self.role = role
         self.engine: SlotEngine | None = None
         self.generation = 0       # bumped per (re)build
         self.state = "starting"   # REPLICA_STATE_CODES keys
@@ -240,6 +263,8 @@ class Replica:
             "name": self.name, "state": self.state,
             "generation": self.generation, "deaths": self.deaths,
             "restarts": self.restarts, "load": self.load,
+            "role": self.role,
+            "mesh": "" if e is None else e.mesh_spec,
             "weight_version": self.weight_version,
             "heartbeats": 0 if e is None else e.heartbeats,
             "uptime_s": self.uptime(now),
@@ -278,13 +303,21 @@ class ReplicaSet:
                  liveness_timeout_s=2.0, backoff_base_s=0.05,
                  backoff_max_s=2.0, breaker_threshold=5,
                  breaker_cooloff_s=1.0, breaker_clock=time.monotonic,
-                 queue_cap=None, warmup=True, name="fleet", on_death=None):
+                 queue_cap=None, warmup=True, name="fleet", on_death=None,
+                 roles=None, role_kw=None):
         if n_replicas < 1:
             raise ValueError(f"n_replicas must be >= 1, got {n_replicas}")
         self.model = model
         self.name = name
         self.metrics = metrics if metrics is not None else ServingMetrics()
         self.engine_kw = dict(engine_kw or {})
+        # disaggregation: roles[i] assigns the i-th initial replica
+        # ("any"/"prefill"/"decode"; later additions default to "any");
+        # role_kw maps a role to engine_kw overrides so e.g. decode
+        # replicas run a narrow prefill_chunk while prefill replicas
+        # run a wide one — the specialization the bench measures
+        self.roles = list(roles or [])
+        self.role_kw = dict(role_kw or {})
         self.queue_cap = queue_cap or flag("FLAGS_serving_queue_cap")
         self.liveness_timeout_s = liveness_timeout_s
         self.backoff_base_s = backoff_base_s
@@ -312,8 +345,10 @@ class ReplicaSet:
         and metrics labels stay unambiguous)."""
         i = self._next_index = getattr(self, "_next_index", -1) + 1
         threshold, cooloff_s, clock = self._breaker_kw
+        role = self.roles[i] if i < len(self.roles) else "any"
         return Replica(i, f"{self.name}.r{i}",
-                       CircuitBreaker(threshold, cooloff_s, clock=clock))
+                       CircuitBreaker(threshold, cooloff_s, clock=clock),
+                       role=role)
 
     def start(self):
         if self._started:
@@ -333,11 +368,13 @@ class ReplicaSet:
                 replica.target_weights = wv
                 replica.rebuild_to = None
             q = AdmissionQueue(self.queue_cap, metrics=self.metrics)
+            kw = dict(self.engine_kw)
+            kw.update(self.role_kw.get(replica.role, {}))
             eng = SlotEngine(self.model, metrics=self.metrics, queue=q,
                              name=replica.name, supervised=True,
                              values=None if wv is None else wv.values,
                              weight_version=0 if wv is None else wv.version,
-                             **self.engine_kw)
+                             **kw)
             if self._warmup:
                 eng.warmup()
             eng.start()
@@ -698,7 +735,8 @@ class _Flight:
     __slots__ = ("client", "retries_left", "replays_left", "attempts",
                  "live", "stale", "hedge_ids", "hedged", "parked",
                  "first_dispatch", "last_dispatch", "retry_at",
-                 "retry_exclude", "versions", "pin")
+                 "retry_exclude", "versions", "pin", "prefill_ids",
+                 "kv_state", "prefer")
 
     def __init__(self, client, retries, replays):
         self.client = client
@@ -716,6 +754,10 @@ class _Flight:
         self.retry_exclude = None
         self.versions: dict = {}   # attempt id -> engine weight version
         self.pin = None            # replay weight-version pin
+        # disaggregated prefill/decode bookkeeping
+        self.prefill_ids: set = set()  # attempt ids that are prefill legs
+        self.kv_state = None       # None / "migrated" / "fallback"
+        self.prefer = None         # one-shot replica affinity (adopted KV)
 
     def active(self):
         return [aid for aid in self.live if aid not in self.stale]
@@ -741,7 +783,10 @@ class Router:
                  breaker_clock=time.monotonic,
                  backoff_base_s=0.05, backoff_max_s=2.0,
                  queue_cap=None, warmup=True, name="fleet",
-                 autoscale=None):
+                 autoscale=None, roles=None, role_kw=None, disagg=None,
+                 migrate_deadline_s=5.0):
+        from .migrate import KVMailbox
+
         self.metrics = metrics if metrics is not None else ServingMetrics()
         self.replica_set = ReplicaSet(
             model, replicas, engine_kw=engine_kw, metrics=self.metrics,
@@ -750,8 +795,19 @@ class Router:
             breaker_threshold=breaker_threshold,
             breaker_cooloff_s=breaker_cooloff_s,
             breaker_clock=breaker_clock, queue_cap=queue_cap,
-            warmup=warmup, name=name, on_death=self._on_replica_death)
+            warmup=warmup, name=name, on_death=self._on_replica_death,
+            roles=roles, role_kw=role_kw)
         self.name = name
+        # disaggregated prefill/decode (ISSUE 17): the Router sends each
+        # request's prefill to a prefill-role replica, migrates the
+        # finished KV blocks over the deadline-guarded mailbox, then
+        # dispatches the decode leg (pinned to the prefill leg's weight
+        # version) with affinity to the adopting replica. Degrades to
+        # colocated dispatch whenever roles or migration are unavailable.
+        self._disagg = bool(flag("FLAGS_serving_disagg")) \
+            if disagg is None else bool(disagg)
+        self._kv_mailbox = KVMailbox()
+        self._migrate_deadline_s = migrate_deadline_s
         self.retry_budget = retry_budget
         self.replay_budget = replay_budget if replay_budget is not None \
             else max(replicas, 2)
@@ -969,7 +1025,24 @@ class Router:
                 self._route_failed(flight, e)
                 return
             pin = version if version is not None else flight.pin
-            replica = self._pick(exclude, version=pin)
+            prefill_leg = False
+            replica = None
+            if flight.prefer is not None:
+                # one-shot affinity: the replica that adopted this
+                # flight's migrated KV blocks serves its decode leg
+                p, flight.prefer = flight.prefer, None
+                if (p.state == "healthy" and p not in exclude
+                        and p.breaker.state == "closed"
+                        and p.engine is not None
+                        and (pin is None
+                             or p.engine.weight_version == pin)):
+                    replica = p
+            if replica is None and not hedge and flight.kv_state is None \
+                    and self._disagg_on():
+                replica = self._pick(exclude, version=pin, role="prefill")
+                prefill_leg = replica is not None
+            if replica is None:
+                replica = self._pick(exclude, version=pin)
             if replica is None:
                 if hedge:
                     flight.hedged = False   # retry the hedge next tick
@@ -995,6 +1068,12 @@ class Router:
                     return
             flight.parked = False
             gen = dict(client.gen)
+            if prefill_leg:
+                # the prefill leg only has to fill the KV cache and
+                # donate its blocks; one produced token is the engine's
+                # minimum request (its value is discarded — the decode
+                # leg re-picks every output token itself)
+                gen["max_new_tokens"] = 1
             if self.brownout_active:
                 gen["max_new_tokens"] = min(
                     gen.get("max_new_tokens", 16), self._brownout_max_new)
@@ -1012,6 +1091,8 @@ class Router:
             flight.attempts[attempt.id] = (replica, attempt)
             flight.versions[attempt.id] = replica.engine.weight_version
             flight.live.add(attempt.id)
+            if prefill_leg:
+                flight.prefill_ids.add(attempt.id)
             if hedge:
                 flight.hedge_ids.add(attempt.id)
                 self.metrics.inc("hedges")
@@ -1023,15 +1104,42 @@ class Router:
             self.metrics.inc("routed")
             attempt.add_done_callback(self._attempt_done_cb)
 
-    def _pick(self, exclude, version=None):
+    def _role_ok(self, replica, role):
+        """May `replica` take an attempt of this kind? role="prefill"
+        wants a prefill-specialized replica; role=None is a whole or
+        decode attempt, which prefill-specialized replicas never take
+        while disaggregation is on (they'd pay the wide-chunk step for
+        every decode token — the exact cost disaggregation removes)."""
+        if role is not None:
+            return replica.role == role
+        return replica.role != "prefill" or not self._disagg
+
+    def _disagg_on(self):
+        """Disaggregate right now? Needs the flag AND both roles
+        healthy — a fleet that lost all its prefill (or decode)
+        replicas degrades to colocated dispatch instead of parking."""
+        if not self._disagg:
+            return False
+        have_prefill = have_decode = False
+        for r in self.replica_set.replicas:
+            if r.state == "healthy":
+                if r.role == "prefill":
+                    have_prefill = True
+                else:
+                    have_decode = True
+        return have_prefill and have_decode
+
+    def _pick(self, exclude, version=None, role=None):
         """Deterministic replica choice: a breaker awaiting its
         half-open probe goes first (lowest index — otherwise an open
         breaker could starve forever behind healthy siblings), else the
         least-loaded replica with a closed breaker (ties to the lowest
         index). `version` restricts to replicas serving that exact
-        weight version (pinned replays/hedges mid-rollout)."""
+        weight version (pinned replays/hedges mid-rollout); `role`
+        restricts by disaggregation role (see `_role_ok`)."""
         candidates = [r for r in self.replica_set.replicas
                       if r.state == "healthy" and r not in exclude
+                      and self._role_ok(r, role)
                       and (version is None or (
                           r.engine is not None
                           and r.engine.weight_version == version))]
@@ -1065,6 +1173,53 @@ class Router:
         else:
             self._dispatch(flight, exclude)
 
+    # -- disaggregated prefill/decode ---------------------------------------
+
+    def _start_migration(self, flight, prefill_replica, version):
+        """Kick the KV migration off the engine callback thread. The
+        adoption blocks until the decode engine's next step boundary,
+        and that engine's own done-callbacks need the Router lock —
+        migrating under the lock would deadlock the fleet."""
+        threading.Thread(
+            target=self._migrate_then_decode,
+            args=(flight, prefill_replica, version),
+            name=f"{self.name}-kv-migrate", daemon=True).start()
+
+    def _migrate_then_decode(self, flight, prefill_replica, version):
+        """Stream the prefill replica's finished KV blocks to a decode
+        replica, then dispatch the decode leg there (pinned to the
+        prefill weight version — adopted KV must never meet different
+        weights). Any migration failure degrades to ordinary colocated
+        dispatch; the request stays replayable throughout."""
+        from .migrate import migrate_prefix
+
+        with self._lock:
+            if flight.client.done():
+                return
+            target = self._pick(frozenset((prefill_replica,)),
+                                version=version)
+        adopted = 0
+        if target is not None and target.engine is not None \
+                and prefill_replica.engine is not None:
+            try:
+                adopted = migrate_prefix(
+                    prefill_replica.engine, target.engine,
+                    flight.client.payload, mailbox=self._kv_mailbox,
+                    deadline_s=self._migrate_deadline_s)
+            except Exception:  # noqa: BLE001 — degrade, don't fail
+                self.metrics.inc("kv_migrate_faults")
+        with self._lock:
+            if flight.client.done():
+                return
+            if adopted:
+                flight.kv_state = "migrated"
+                flight.prefer = target
+                if flight.pin is None and version is not None:
+                    flight.pin = version
+            else:
+                flight.kv_state = "fallback"
+            self._dispatch(flight, frozenset())
+
     def _attempt_done_cb(self, attempt):
         """Done-callback on every attempt future; runs on the engine
         (or cancelling) thread. First-wins on the client request makes
@@ -1088,6 +1243,15 @@ class Router:
             if err is None:
                 if replica is not None:
                     replica.breaker.record_success()
+                if attempt.id in flight.prefill_ids:
+                    # disaggregated prefill leg: its one produced token
+                    # is discarded — migrate the KV blocks and dispatch
+                    # the decode leg (off-thread: migration waits on the
+                    # decode engine's step boundary, which must not
+                    # happen under the Router lock)
+                    if not flight.client.done():
+                        self._start_migration(flight, replica, att_version)
+                    return
                 if self._finish_ok(flight, attempt._value):
                     if attempt.id in flight.hedge_ids:
                         self.metrics.inc("hedge_wins")
@@ -1252,6 +1416,10 @@ class Router:
                     continue
                 active = flight.active()
                 if len(active) != 1 or flight.last_dispatch is None:
+                    continue
+                if active[0] in flight.prefill_ids:
+                    # never hedge a prefill leg: its value is discarded
+                    # and a duplicate would double the KV migration
                     continue
                 if now - flight.last_dispatch < delay:
                     continue

@@ -48,7 +48,12 @@ class ServingMetrics:
     `brownout_sheds`, `retry_budget_exhausted`, `supervisor_errors`,
     and the elastic set: `replicas_added` / `replicas_removed` (scale
     events that landed), `drains_started`, `drain_errors`,
-    `scale_failures` (autoscaler actions that raised).
+    `scale_failures` (autoscaler actions that raised). Mesh-sharded
+    serving adds `kv_migrations` / `kv_migrate_blocks` /
+    `kv_migrate_bytes` / `kv_migrate_faults` (prefill->decode KV block
+    streaming) surfaced with the mesh shape, per-shard occupancy and
+    disaggregation role under snapshot()["mesh"] (see `note_mesh` /
+    `note_role`).
     Every inc() also bumps the global `framework.monitor` counter
     ``serving.<name>`` so serving shows up in the same stat registry as
     the rest of the runtime.
@@ -58,6 +63,8 @@ class ServingMetrics:
         self._lock = threading.Lock()
         self._counters: dict = {}
         self._latency: dict = {}      # kind -> [seconds]
+        self._mesh = None             # (spec, devices) when mesh-sharded
+        self._role = None             # disagg role ('prefill'/'decode')
         self._occ_sum = 0.0
         self._occ_n = 0
         self._occ_max = 0.0
@@ -74,6 +81,20 @@ class ServingMetrics:
         int8-frozen engine serves)."""
         with self._lock:
             self._gauges[name] = float(value)
+
+    def note_mesh(self, spec, devices):
+        """Record the serving mesh shape (e.g. 'dp1.mp2' over 2
+        devices): turns on the snapshot()['mesh'] section and the
+        paddle_serving_mesh_* Prometheus family."""
+        with self._lock:
+            self._mesh = (str(spec), int(devices))
+
+    def note_role(self, role):
+        """Disaggregation role of the replica this registry serves
+        ('any' / 'prefill' / 'decode') — surfaced as the mesh-family
+        role gauge."""
+        with self._lock:
+            self._role = str(role)
 
     def observe_spec(self, slot, drafted, accepted):
         """One speculative round's outcome for one slot: `drafted`
@@ -195,6 +216,27 @@ class ServingMetrics:
                     str(s): a / d if d else 0.0
                     for s, (d, a) in sorted(spec_slots.items())},
                 "dequant_path": gauges.get("dequant_path", 0.0),
+            }
+        with self._lock:
+            mesh, role = self._mesh, self._role
+        if mesh is not None or role is not None \
+                or counters.get("kv_migrations") \
+                or counters.get("kv_migrate_faults"):
+            spec, devices = mesh if mesh is not None else ("", 1)
+            snap["mesh"] = {
+                "spec": spec,
+                "devices": devices,
+                "role": role or "any",
+                # GSPMD runs the SAME program on every shard, so each
+                # shard's slot occupancy equals the replica's — emitted
+                # per shard anyway so a future uneven layout shows up
+                "per_shard_occupancy": [
+                    {"shard": i, "occupancy": occ_avg}
+                    for i in range(devices)],
+                "kv_migrations": counters.get("kv_migrations", 0),
+                "kv_migrate_blocks": counters.get("kv_migrate_blocks", 0),
+                "kv_migrate_bytes": counters.get("kv_migrate_bytes", 0),
+                "kv_migrate_faults": counters.get("kv_migrate_faults", 0),
             }
         if queue_depth is not None:
             snap["queue_depth"] = queue_depth

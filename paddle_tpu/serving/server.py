@@ -51,6 +51,12 @@ class Server:
     when no draft model is given), ``quantize`` freezes weights to int8
     for the dequant decode path. Defaults come from
     FLAGS_serving_spec_len / FLAGS_serving_quantize.
+
+    Mesh-sharded serving: ``mesh='dpD.mpM'`` (or a prebuilt Mesh;
+    default FLAGS_serving_mesh) shards every engine's weights and paged
+    KV pool over a (dp, mp) device mesh via serving/sharding.py. Fleet
+    mode composes with disaggregated prefill/decode — pass
+    ``fleet=dict(roles=[...], role_kw={...}, disagg=True)``.
     """
 
     def __init__(self, model=None, *, mode="generate", fn=None,
@@ -59,7 +65,7 @@ class Server:
                  queue_cap=None, max_batch=None, max_wait_s=0.002,
                  cache_dtype=None, jit=True, strict_shapes=False,
                  warmup=True, replicas=1, fleet=None, spec_len=None,
-                 draft_model=None, quantize=None):
+                 draft_model=None, quantize=None, mesh=None):
         self.mode = mode
         self.metrics = ServingMetrics()
         self._warmup = warmup
@@ -75,7 +81,7 @@ class Server:
                 prefill_chunk=prefill_chunk, prefix_cache=prefix_cache,
                 cache_dtype=cache_dtype, strict_shapes=strict_shapes,
                 spec_len=spec_len, draft_model=draft_model,
-                quantize=quantize)
+                quantize=quantize, mesh=mesh)
             self.router = Router(
                 model, max(replicas, 1), engine_kw=engine_kw,
                 metrics=self.metrics, queue_cap=queue_cap,
@@ -97,7 +103,7 @@ class Server:
                 cache_dtype=cache_dtype, metrics=self.metrics,
                 queue=queue, strict_shapes=strict_shapes,
                 spec_len=spec_len, draft_model=draft_model,
-                quantize=quantize)
+                quantize=quantize, mesh=mesh)
             self.batcher = None
         elif mode == "batch":
             target = fn if fn is not None else model

@@ -38,7 +38,7 @@ _DIST_MODULES = {
     "test_zero3_offload", "test_context_parallel",
     "test_parameter_server", "test_strategies_compiled",
     "test_heter_ps", "test_flash_gspmd", "test_pipeline_hetero",
-    "test_memory_stats", "test_overlap",
+    "test_memory_stats", "test_overlap", "test_serving_mesh",
 }
 
 

@@ -145,6 +145,20 @@ def test_fast_decode_sites_are_registered():
         assert hint in faults.SITES[site]
 
 
+def test_mesh_serving_sites_are_registered():
+    """ISSUE 17: the mesh-sharded serving sites — the sharded decode
+    step and the prefill->decode KV-block adoption — must stay
+    registered, or the disaggregation chaos legs degrade to clean runs.
+    (Behavioral coverage: test_serving_mesh.py: a shard_step fault is a
+    step error the engine survives and the Router replays; a kv_migrate
+    fault aborts the adoption leak-free and falls back to colocated
+    dispatch.)"""
+    for site, hints in (("serving.shard_step", ("shard", "step")),
+                        ("serving.kv_migrate", ("migration", "adoption"))):
+        assert site in faults.SITES, site
+        assert any(h in faults.SITES[site] for h in hints), site
+
+
 # ---------------------------------------------------------------------------
 # direct coverage for the sites no other tier-1 test drives
 # ---------------------------------------------------------------------------
