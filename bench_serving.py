@@ -70,6 +70,19 @@ Emits ``BENCH_SERVING_DISAGG``. CPU certification dry-run:
     JAX_PLATFORMS=cpu python bench_serving.py --disagg --smoke \
         --mesh dp1.mp2 --clients 4 --steps 2 --prefill-chunk 64 \
         --block-size 8 --hidden 32 --layers 2
+
+Durable sessions (ISSUE 18): ``--sessions`` certifies the global KV
+fabric instead of the sweep — multi-turn sessions whose radix caches
+are drained through the crc-framed SSD spill tier between turns, then
+resumed (same replica, and cross-replica after a kill) with bitwise
+greedy parity against an uninterrupted reference; a chaos leg raising
+once at each of serving.spill / serving.kv_restore / serving.affinity
+(goodput 1.0, fired == planned, compile counters frozen); and a
+multi-turn workload replay grading fleet-wide prefix hit rate with
+affinity routing on vs the best single replica with it off. Emits
+``BENCH_SESSIONS``. CPU certification dry-run:
+
+    JAX_PLATFORMS=cpu python bench_serving.py --sessions --smoke
 """
 
 from __future__ import annotations
@@ -588,6 +601,301 @@ def run_smoke(args, serving):
     return 0 if result["ok"] else 1
 
 
+def run_sessions(args, serving):
+    """--sessions: the ISSUE-18 durable multi-turn certification.
+
+    Four legs over one pinned tiny model, all greedy and graded
+    bitwise against an uninterrupted single-engine reference:
+
+    - resume: a 2-replica affinity fleet serves turn 1, every radix
+      cache is drained through the SSD spill tier (the between-turn
+      pressure model), and turn 2 resumes from restored blocks —
+      p50 turn-2 latency is compared against a cold fleet that must
+      re-prefill the whole transcript;
+    - cross: the replica that served turn 1 is killed between turns;
+      turn 2 fails over and restores the session from the shared
+      spill file on the surviving replica;
+    - chaos: the cross leg again, under a scripted fault schedule
+      raising once at each of serving.spill / serving.kv_restore /
+      serving.affinity — goodput must stay 1.0, the schedule must
+      certify fired == planned, and every replica (including the
+      supervised restart) must hold compile counters at one decode +
+      one cow trace;
+    - hitrate: a seeded multi-turn workload trace replayed through an
+      affinity-on fleet vs an affinity-off fleet — the affinity
+      fleet's fleet-wide prefix hit rate must strictly beat the best
+      single replica of the scattered fleet.
+
+    Emits one ``BENCH_SESSIONS`` object; ``--smoke`` additionally
+    gates the resumed-vs-cold latency win and the hit-rate ordering.
+    """
+    import os
+    import shutil
+    import tempfile
+
+    import paddle_tpu as paddle
+    from paddle_tpu.framework import faults
+    from paddle_tpu.nlp.transformers import GPTConfig, GPTForPretraining
+    from paddle_tpu.serving import kvstore, workload
+
+    max_new, p1_len, tail_len, bs = 4, 104, 16, 8
+    paddle.seed(7)
+    cfg = GPTConfig(vocab_size=97, hidden_size=64, num_layers=2,
+                    num_heads=4, max_seq_len=160, dropout=0.0,
+                    attn_dropout=0.0, use_parallel=False)
+    model = GPTForPretraining(cfg)
+    spill_root = tempfile.mkdtemp(prefix="bench_sessions_kv_")
+    failures = []
+
+    def fleet_server(name, spill, affinity=True, max_seq=160,
+                     num_blocks=161):
+        kvstore.reset_spill_stores()
+        # prefill_chunk == block_size so cold prefill is multi-step:
+        # the resumed leg's win is exactly the chunks it skips
+        return serving.Server(
+            model, replicas=2, max_slots=4, max_seq_len=max_seq,
+            block_size=bs, num_blocks=num_blocks, prefill_chunk=bs,
+            spill_dir=spill,
+            fleet=dict(hedge=False, liveness_timeout_s=30.0,
+                       backoff_base_s=0.05, name=name,
+                       prefix_affinity=affinity)).start()
+
+    ref = serving.Server(model, max_slots=4, max_seq_len=160,
+                         block_size=bs, num_blocks=161, prefill_chunk=bs,
+                         prefix_cache=False).start()
+
+    def ref_out(prompt):
+        return np.asarray(ref.generate(prompt, max_new_tokens=max_new,
+                                       timeout=120.0), np.int32)
+
+    def gen(srv, prompt):
+        t0 = time.monotonic()
+        out = np.asarray(srv.generate(prompt, max_new_tokens=max_new,
+                                      timeout=120.0), np.int32)
+        return out, time.monotonic() - t0
+
+    def drain_caches(srv):
+        """Between-turn pressure model: every replica's radix cache is
+        evicted through the spill tier, so turn 2 can only be cheap if
+        the SSD restore path works."""
+        for r in srv.router.replica_set.replicas:
+            if r.engine is not None:
+                r.engine.spill_cache()
+
+    def parity(tag, got, want):
+        if not np.array_equal(got, want):
+            failures.append(f"{tag}: bitwise parity mismatch")
+
+    rng = np.random.RandomState(5)
+    n_sessions = 4
+    prompts1 = [rng.randint(0, cfg.vocab_size, (p1_len,)).astype(np.int32)
+                for _ in range(n_sessions)]
+    tails = [rng.randint(0, cfg.vocab_size, (tail_len,)).astype(np.int32)
+             for _ in range(n_sessions)]
+
+    # -- leg 1: same-fleet resume (spill -> restore) vs cold re-prefill
+    srv = fleet_server("bsess", os.path.join(spill_root, "resume"))
+    outs1 = [gen(srv, p)[0] for p in prompts1]
+    drain_caches(srv)
+    prompts2 = [np.concatenate([o, t]) for o, t in zip(outs1, tails)]
+    resumed = [gen(srv, p) for p in prompts2]
+    restored_blocks = srv.metrics.get("kv_restored_blocks")
+    spilled_blocks = srv.metrics.get("kv_spilled_blocks")
+    aff_snap = srv.router.snapshot().get("affinity", {})
+    srv.shutdown(drain=True)
+    for i, (out2, _) in enumerate(resumed):
+        parity(f"resume s{i}", out2, ref_out(prompts2[i]))
+    if restored_blocks <= 0:
+        failures.append("resume leg restored no KV blocks from spill")
+    if spilled_blocks <= 0:
+        failures.append("resume leg spilled no KV blocks")
+
+    srv = fleet_server("bcold", None)
+    cold = [gen(srv, p) for p in prompts2]
+    srv.shutdown(drain=True)
+    for i, (out2, _) in enumerate(cold):
+        parity(f"cold s{i}", out2, ref_out(prompts2[i]))
+    resumed_p50 = serving.percentile([t for _, t in resumed], 50)
+    cold_p50 = serving.percentile([t for _, t in cold], 50)
+    leg_resume = {
+        "leg": "resume",
+        "resumed_p50_ttft_ms": round(resumed_p50 * 1e3, 3),
+        "cold_p50_ttft_ms": round(cold_p50 * 1e3, 3),
+        "spilled_blocks": spilled_blocks,
+        "restored_blocks": restored_blocks,
+        "affinity": {k: aff_snap.get(k) for k in
+                     ("lookups", "hits", "hit_rate")},
+    }
+    print(json.dumps(leg_resume))
+    if args.smoke and resumed_p50 >= cold_p50:
+        failures.append(
+            f"resumed p50 {resumed_p50 * 1e3:.1f}ms not below cold "
+            f"re-prefill {cold_p50 * 1e3:.1f}ms")
+
+    # -- leg 2: replica death between turns; resume on the survivor
+    def killed_session_turn(name, chaos=None):
+        srv = fleet_server(name, os.path.join(spill_root, name))
+        sched = faults.ChaosSchedule(*chaos) if chaos else None
+        if sched:
+            sched.__enter__()
+        ok = bad = 0
+        try:
+            outs = []
+            for p in prompts1[:3]:
+                try:
+                    outs.append(gen(srv, p)[0])
+                    ok += 1
+                except Exception:  # noqa: BLE001 — graded as goodput
+                    outs.append(None)
+                    bad += 1
+            drain_caches(srv)
+            reps = srv.router.replica_set.replicas
+            home = next((r for r in reps
+                         if r.engine is not None
+                         and r.engine.prefix_lookups > 0), reps[0])
+            srv.router.kill(home.name, "bench session kill")
+            outs2 = []
+            for o, t in zip(outs, tails):
+                if o is None:
+                    outs2.append(None)
+                    continue
+                try:
+                    outs2.append(gen(srv, np.concatenate([o, t]))[0])
+                    ok += 1
+                except Exception:  # noqa: BLE001 — graded as goodput
+                    outs2.append(None)
+                    bad += 1
+        finally:
+            if sched:
+                sched.__exit__(None, None, None)
+        # let the supervised restart land before the compile audit
+        m = srv.metrics
+        deadline = time.monotonic() + 30
+        while m.get("replica_restarts") < m.get("replica_deaths") \
+                and time.monotonic() < deadline:
+            time.sleep(0.05)
+        compiles = {name_: {str(k): v for k, v in counts.items()}
+                    for name_, counts in srv.router.compile_counts().items()}
+        restored = m.get("kv_restored_blocks")
+        fired = sched.fired() if sched else {}
+        planned = sched.planned() if sched else {}
+        if sched:
+            try:
+                sched.verify()
+            except AssertionError as e:
+                failures.append(str(e))
+        srv.shutdown(drain=True)
+        for i, o2 in enumerate(outs2):
+            if o2 is not None:
+                parity(f"{name} s{i}", o2,
+                       ref_out(np.concatenate([outs[i], tails[i]])))
+        return {
+            "leg": name, "ok": ok, "failed": bad,
+            "goodput": round(ok / max(ok + bad, 1), 4),
+            "killed": home.name, "restored_blocks": restored,
+            "compiles": compiles, "fired": fired, "planned": planned,
+        }
+
+    leg_cross = killed_session_turn("bkill")
+    print(json.dumps(leg_cross))
+    if leg_cross["goodput"] != 1.0:
+        failures.append(f"cross leg goodput {leg_cross['goodput']} < 1.0")
+    if leg_cross["restored_blocks"] <= 0:
+        failures.append("cross leg restored no KV blocks after the kill")
+
+    # -- leg 3: the same kill under faults at every new site
+    leg_chaos = killed_session_turn("bchaosess", chaos=(
+        "serving.spill@1:raise",
+        "serving.kv_restore@1:raise",
+        "serving.affinity@1:raise",
+    ))
+    print(json.dumps(leg_chaos))
+    if leg_chaos["goodput"] != 1.0:
+        failures.append(f"chaos leg goodput {leg_chaos['goodput']} < 1.0")
+    bad_compiles = {n: c for n, c in leg_chaos["compiles"].items()
+                    if c != {"decode": 1, "cow": 1}}
+    if bad_compiles:
+        failures.append(f"chaos leg compiles {bad_compiles}")
+
+    # -- leg 4: fleet-wide affinity hit rate vs best scattered replica
+    # session-private content dominates the shared one-block user
+    # prefix, so the hit-rate split measures AFFINITY, not luck: a
+    # turn landing off its home replica can only hit the user prefix
+    sc = workload.Scenario(
+        name="sessions", seed=3, vocab=cfg.vocab_size, n_users=32,
+        user_prefix_len=8, prompt_len=(16, 24), max_new=(2, 4),
+        multi_turn=True, session_turns=(3, 4), think_time=(0.0, 0.01),
+        phases=[{"duration_s": 1.5, "rate_rps": 8.0}])
+    if workload.Scenario.from_json(sc.to_json()).to_json() != sc.to_json():
+        failures.append("multi-turn scenario JSON roundtrip drifted")
+    trace = sc.trace()
+
+    def hit_leg(name, affinity):
+        srv = fleet_server(name, None, affinity=affinity)
+        by_turn = {}
+        for a in trace:
+            by_turn.setdefault(a.turn, []).append(a)
+        ok = bad = 0
+        # waves: all sessions' turn-k arrivals in flight together, so
+        # load-based routing actually scatters when affinity is off
+        for turn in sorted(by_turn):
+            futs = [srv.submit(a.prompt, max_new_tokens=a.max_new,
+                               timeout=120.0) for a in by_turn[turn]]
+            for f in futs:
+                try:
+                    f.result(120.0)
+                    ok += 1
+                except Exception:  # noqa: BLE001 — graded as goodput
+                    bad += 1
+        snap = srv.metrics.snapshot()
+        per = {r.name: round(r.engine.prefix_hit_rate(), 4)
+               for r in srv.router.replica_set.replicas
+               if r.engine is not None}
+        srv.shutdown(drain=True)
+        fleet_rate = snap.get("prefix_cache", {}).get("hit_rate", 0.0)
+        return {"leg": name, "affinity": affinity, "ok": ok,
+                "failed": bad, "fleet_hit_rate": round(fleet_rate, 4),
+                "per_replica_hit_rate": per}
+
+    hit_on = hit_leg("baffon", True)
+    print(json.dumps(hit_on))
+    hit_off = hit_leg("baffoff", False)
+    print(json.dumps(hit_off))
+    best_single = max(hit_off["per_replica_hit_rate"].values() or [0.0])
+    if hit_on["failed"] or hit_off["failed"]:
+        failures.append("hit-rate legs dropped requests")
+    if args.smoke and hit_on["fleet_hit_rate"] <= best_single:
+        failures.append(
+            f"fleet-wide hit rate {hit_on['fleet_hit_rate']} not above "
+            f"best scattered replica {best_single}")
+
+    ref.shutdown(drain=True)
+    kvstore.reset_spill_stores()
+    shutil.rmtree(spill_root, ignore_errors=True)
+
+    result = {
+        "bench": "BENCH_SESSIONS",
+        "sessions": n_sessions,
+        "turn_tokens": {"turn1": p1_len, "tail": tail_len,
+                        "max_new": max_new},
+        "model": {"vocab": cfg.vocab_size, "hidden": cfg.hidden_size,
+                  "layers": cfg.num_layers, "heads": cfg.num_heads},
+        "resume": leg_resume,
+        "cross": leg_cross,
+        "chaos": leg_chaos,
+        "hitrate": {"on": hit_on, "off": hit_off,
+                    "best_single_replica": best_single},
+        "ok": not failures,
+    }
+    if failures:
+        result["failures"] = failures
+    print(json.dumps(result))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(result, f, indent=1, sort_keys=True)
+    return 0 if result["ok"] else 1
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--clients", default="1,8,32",
@@ -656,12 +964,21 @@ def main(argv=None):
                     "prefill/decode-role fleet at equal chips, decode "
                     "p99 / prefill p50 / KV-migration throughput per "
                     "leg; emits BENCH_SERVING_DISAGG")
+    ap.add_argument("--sessions", action="store_true",
+                    help="durable multi-turn session benchmark: SSD KV "
+                    "spill/restore vs cold re-prefill, cross-replica "
+                    "resume after a kill, chaos at the kv-fabric fault "
+                    "sites, and affinity-on vs -off prefix hit rates; "
+                    "emits BENCH_SESSIONS (--smoke gates the latency "
+                    "and hit-rate wins)")
     args = ap.parse_args(argv)
 
     import paddle_tpu as paddle
     from paddle_tpu import serving
     from paddle_tpu.nlp.transformers import GPTConfig, GPTForPretraining
 
+    if args.sessions:
+        return run_sessions(args, serving)
     if args.smoke and not args.disagg:
         return run_smoke(args, serving)
 

@@ -62,6 +62,21 @@ A fault point is a named site the runtime passes through:
                               all-or-nothing, the pool stays leak-free
                               and the request falls back to colocated
                               dispatch)
+    serving.spill             each evicted-KV-block spill append to the
+                              SSD tier, before the record write (raise /
+                              ioerror = full or failing spill disk — the
+                              eviction proceeds without durability and
+                              the allocator stays balanced)
+    serving.kv_restore        each KV-block restore from a spilled
+                              record during session resume, tagged with
+                              the restoring engine name (raise = restore
+                              abort — all-or-nothing, blocks roll back
+                              and the session re-prefills from scratch)
+    serving.affinity          each prefix-affinity routing decision in
+                              the fleet Router, before the sticky
+                              replica is chosen (raise = affinity lookup
+                              failure — the Router falls back to
+                              least-loaded placement)
     ps.push                   each PS mutation between WAL append and
                               table apply, tagged with the table name
                               (crash = kill mid-push: recovery replays
@@ -190,6 +205,16 @@ SITES = {
     "serving.kv_migrate": "each KV-block adoption during the "
                           "prefill->decode block migration (tag = "
                           "adopting decode engine name)",
+    "serving.spill": "each evicted-KV-block spill append to the SSD "
+                     "tier, before the record write (a fault loses "
+                     "durability, never blocks)",
+    "serving.kv_restore": "each KV-block restore from a spilled record "
+                          "during session resume (tag = restoring "
+                          "engine name; all-or-nothing, falls back to "
+                          "re-prefill)",
+    "serving.affinity": "each prefix-affinity routing decision before "
+                        "the sticky replica is chosen (a fault falls "
+                        "back to least-loaded placement)",
     "dist.allreduce": "each eager all-reduce before the transport "
                       "(delay eats the FLAGS_dist_timeout_s budget)",
     "dist.barrier": "each eager barrier / gang ckpt commit barrier",

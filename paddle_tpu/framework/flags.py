@@ -133,6 +133,26 @@ _DEFS = {
         "serving/sharding.py; block tables stay host-side and "
         "replica-global). Empty = single-device engine, exactly the "
         "pre-mesh behavior"),
+    "FLAGS_serving_kv_spill_dir": (
+        "", str,
+        "serving: directory for the persistent SSD KV spill tier — "
+        "cold KV blocks evicted from the radix prefix cache append "
+        "their payloads here (crc32-framed, append-before-evict) and "
+        "restore on session resume through the all-or-nothing "
+        "admission path. Empty = spill tier disabled, exactly the "
+        "pre-fabric behavior"),
+    "FLAGS_serving_kv_spill_cap_mb": (
+        256, int,
+        "serving: soft cap in MiB on a replica's spill file; crossing "
+        "it triggers a tmp+rename compaction that drops invalidated "
+        "and superseded records (0 = never compact on size)"),
+    "FLAGS_serving_prefix_affinity": (
+        True, bool,
+        "serving: route each request to the fleet replica holding the "
+        "longest live prefix-cache match for its token prefix (sticky "
+        "session affinity with clean failover when the affine replica "
+        "is dead, draining, or breaker-open); False = pure "
+        "least-loaded placement"),
     "FLAGS_serving_disagg": (
         False, bool,
         "serving: disaggregate prefill and decode — the Router sends "

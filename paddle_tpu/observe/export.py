@@ -116,6 +116,9 @@ def snapshot(serving=None):
         # a ServingMetrics registry is passed)
         "mesh": {stat.split(".", 1)[1]: monitor.stat_get(stat)
                  for stat in _MESH_STATS},
+        # persistent-KV-tier view mirrors paddle_serving_kvstore_*
+        "kvstore": {stat.split(".", 1)[1]: monitor.stat_get(stat)
+                    for stat in _KVSTORE_METRICS},
     }
     if serving is not None:
         out["serving"] = serving.snapshot()
@@ -257,6 +260,39 @@ _MESH_STATS = (
     "serving.kv_migrate_timeouts",
 )
 
+#: monitor stat -> (prometheus name, type, help) for the persistent SSD
+#: KV tier (serving/kvstore.py); same contract as _PS_METRICS, emitted
+#: ahead of the generic dump and mirrored in snapshot()["kvstore"].
+#: The per-replica prefix-affinity hit rate rides with the fleet
+#: section (it is a labelled gauge over the Router snapshot)
+_KVSTORE_METRICS = {
+    "serving.kv_spilled_blocks": (
+        "paddle_serving_kvstore_spilled_blocks_total", "counter",
+        "evicted KV blocks durably appended to the SSD spill tier"),
+    "serving.kv_restored_blocks": (
+        "paddle_serving_kvstore_restored_blocks_total", "counter",
+        "KV blocks re-staged from spilled records on session resume"),
+    "serving.kv_invalidated_blocks": (
+        "paddle_serving_kvstore_invalidated_blocks_total", "counter",
+        "spilled records fenced by weight-rollout commits"),
+    "serving.kv_spill_bytes": (
+        "paddle_serving_kvstore_spill_bytes_total", "counter",
+        "bytes appended to the SSD KV spill tier"),
+    "serving.kv_restore_corrupt": (
+        "paddle_serving_kvstore_restore_corrupt_records_total",
+        "counter",
+        "spilled records that failed crc re-verification at restore "
+        "(degraded to re-prefill, never wrong tokens)"),
+    "serving.kv_restore_fenced": (
+        "paddle_serving_kvstore_restore_fenced_total", "counter",
+        "session resumes that hit a generation-fenced record and fell "
+        "back to re-prefill on the live weights"),
+    "serving.kv_spill_errors": (
+        "paddle_serving_kvstore_spill_errors_total", "counter",
+        "spill appends that failed (durability lost for that block; "
+        "the eviction itself proceeded)"),
+}
+
 #: disaggregation role encodings for the mesh-family role gauge
 MESH_ROLE_CODES = {"any": 0, "prefill": 1, "decode": 2}
 
@@ -378,11 +414,17 @@ def prometheus_text(serving=None, queue_depth=None, fleet=None):
               labels={"rank": slot},
               help_="age of this rank's last gang heartbeat")
 
+    # persistent-KV-tier family: spill/restore/fencing traffic of the
+    # SSD tier, stable names + helps (mirrored in snapshot()["kvstore"])
+    for stat, (pname, mtype, help_) in _KVSTORE_METRICS.items():
+        L.add(pname, monitor.stat_get(stat), mtype=mtype, help_=help_)
+
     for name, value in sorted(monitor.stats().items()):
         if not isinstance(value, (int, float)):
             continue
         if name in _PS_METRICS or name in _REC_METRICS \
-                or name in _FLEET_STATS or name in _GANG_STATS:
+                or name in _FLEET_STATS or name in _GANG_STATS \
+                or name in _KVSTORE_METRICS:
             continue
         L.add(f"paddle_{name}", value, mtype="counter",
               help_="framework.monitor stat")
@@ -547,5 +589,27 @@ def prometheus_text(serving=None, queue_depth=None, fleet=None):
         if "in_flight" in fleet:
             L.add("paddle_serving_fleet_in_flight", fleet["in_flight"],
                   help_="client requests the Router is tracking")
+        aff = fleet.get("affinity")
+        if aff:
+            L.add("paddle_serving_kvstore_affinity_lookups_total",
+                  aff["lookups"], mtype="counter",
+                  help_="prefix-affinity routing decisions attempted")
+            L.add("paddle_serving_kvstore_affinity_hits_total",
+                  aff["hits"], mtype="counter",
+                  help_="dispatches steered to the replica holding the "
+                        "longest live prefix match")
+            L.add("paddle_serving_kvstore_affinity_hit_rate",
+                  aff["hit_rate"],
+                  help_="fleet-wide sticky-affinity hit fraction")
+            for rname, per in sorted(aff.get("per_replica", {}).items()):
+                L.add("paddle_serving_kvstore_replica_affinity_hits",
+                      per["hits"], mtype="counter",
+                      labels={"replica": rname},
+                      help_="affinity-steered dispatches per replica")
+                L.add(
+                    "paddle_serving_kvstore_replica_prefix_hit_rate",
+                    per["prefix_hit_rate"], labels={"replica": rname},
+                    help_="this replica's own prompt-token prefix-cache "
+                          "hit rate")
 
     return L.text()

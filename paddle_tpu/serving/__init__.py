@@ -41,6 +41,14 @@ iteration-level ("continuous") batching in the Orca lineage:
 - `KVMailbox` / `migrate_prefix` — disaggregated prefill/decode:
   deadline-guarded prefill→decode KV-block streaming behind the
   Router (migrate.py, FLAGS_serving_disagg);
+- `KVSpillStore` / `open_spill_store` — the global KV fabric: cold
+  KV blocks spill to a crash-safe, crc-framed SSD tier on eviction
+  and restore on session resume through the all-or-nothing admission
+  path; weight-rollout commits generation-fence stale records
+  (`SpillFencedError`), and the Router's prefix-affinity routing
+  steers each request to the replica holding the longest live prefix
+  match (kvstore.py, FLAGS_serving_kv_spill_dir,
+  FLAGS_serving_prefix_affinity);
 - `Scenario` / `Arrival` / `replay` — the seeded open-loop traffic
   simulator every serving bench replays (workload.py);
 - `Server` / `http_front` — the user-facing shell (server.py);
@@ -57,6 +65,9 @@ from .batcher import (  # noqa: F401
 from .engine import SlotEngine  # noqa: F401
 from .fleet import (  # noqa: F401
     CircuitBreaker, Replica, ReplicaSet, Router, retriable,
+)
+from .kvstore import (  # noqa: F401
+    KVSpillStore, SpillFencedError, open_spill_store, reset_spill_stores,
 )
 from .metrics import ServingMetrics, percentile  # noqa: F401
 from .migrate import KVMailbox, migrate_prefix  # noqa: F401
@@ -86,17 +97,19 @@ __all__ = [
     "AdmissionQueue", "Arrival", "Autoscaler", "BlockAllocator",
     "BrownoutShedError",
     "CapacityExhaustedError", "CircuitBreaker", "DeadlineExceededError",
-    "DynamicBatcher", "GPT_PARTITION_RULES", "KVMailbox", "NULL_BLOCK",
+    "DynamicBatcher", "GPT_PARTITION_RULES", "KVMailbox", "KVSpillStore",
+    "NULL_BLOCK",
     "PoolExhausted", "PrefixCache",
     "QueueFullError", "Replica", "ReplicaDiedError", "ReplicaSet",
     "Request", "RequestCancelled", "RetriesExhaustedError",
     "RolloutController", "RolloutError", "RolloutGateError", "Router",
     "SLOWindow", "Scenario", "Server", "ServerClosedError",
     "ServingError", "ServingMetrics", "ShardingPlan", "SlotEngine",
-    "VersionRetiredError", "WeightRegistry", "WeightVersion",
+    "SpillFencedError", "VersionRetiredError", "WeightRegistry",
+    "WeightVersion",
     "bucket_for", "bucket_ladder", "build_mesh", "golden_digests",
     "http_front", "match_partition_rules", "mesh_spec_of",
-    "migrate_prefix",
+    "migrate_prefix", "open_spill_store",
     "pad_batch", "parse_mesh_spec", "percentile", "positions_to_rows",
-    "replay", "resolve_mesh", "retriable",
+    "replay", "reset_spill_stores", "resolve_mesh", "retriable",
 ]

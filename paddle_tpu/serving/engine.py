@@ -68,6 +68,18 @@ Fast decode (ISSUE 16) rides the same one-trace contract:
   LM head through the `dequant_matmul` epilogue kernel. Engines handed
   a pre-frozen values dict (rollout artifacts) adopt it as-is.
 
+Durable sessions (ISSUE 18): when ``FLAGS_serving_kv_spill_dir`` names
+a directory, the engine attaches the process-shared `KVSpillStore`
+(kvstore.py) as the radix cache's spill hook — a cold block evicted
+from the cache persists its KV rows to SSD *before* the allocator frees
+it, and a later request whose token prefix extends a spilled record
+restores the blocks through `_maybe_restore` (the same all-or-nothing
+alloc→scatter→insert staging as KV adoption). A torn, bit-rotted, or
+generation-fenced record degrades to re-prefill, never to wrong tokens;
+the session "handle" is the token prefix itself — content-addressed, so
+a session resumes on ANY replica sharing the spill directory, including
+after its original replica died between turns.
+
 Fault sites: ``serving.step`` fires once per decode step (a `raise`
 action fails every in-flight request deterministically while the engine
 stays up); ``serving.alloc_block`` on every physical block allocation
@@ -77,7 +89,9 @@ draft phase (raise = degrade that round to plain decode, slots survive
 with no lost or duplicated tokens); ``serving.verify`` before each
 speculative verify dispatch (raise = step error, fails in-flight
 requests like serving.step); ``serving.dequant`` once per step on an
-int8-frozen engine. Supervised (fleet-owned) engines additionally
+int8-frozen engine; ``serving.kv_restore`` before each spilled-block
+restore (raise = restore abort, leak-free, the request re-prefills).
+Supervised (fleet-owned) engines additionally
 fire ``serving.replica_heartbeat`` every loop iteration and
 ``serving.replica_step`` before each decode step, both tagged with the
 replica name — the fleet chaos sites (see framework/faults.py).
@@ -95,6 +109,7 @@ from ..core.tensor import Tensor
 from ..engine import functional_apply, state_values
 from ..framework import faults
 from ..framework.flags import flag
+from . import kvstore
 from .metrics import ServingMetrics
 from .paging import NULL_BLOCK, BlockAllocator, PoolExhausted, PrefixCache
 from .queueing import (
@@ -189,7 +204,7 @@ class SlotEngine:
                  queue=None, strict_shapes=False, name=None,
                  supervised=False, values=None, weight_version=0,
                  draft_model=None, spec_len=None, quantize=None,
-                 mesh=None):
+                 mesh=None, spill_dir=None):
         import jax
         import jax.numpy as jnp
 
@@ -297,6 +312,19 @@ class SlotEngine:
             prefix_cache = flag("FLAGS_serving_prefix_cache")
         self._cache = PrefixCache(self._alloc, self.block_size) \
             if prefix_cache else None
+        # persistent KV spill tier (ISSUE 18): one shared store per
+        # spill directory, so every replica of the process spills into
+        # — and can resume from — the same tier. None = disabled.
+        self.spill_store = kvstore.open_spill_store(
+            spill_dir, metrics=self.metrics) \
+            if self._cache is not None else None
+        if self.spill_store is not None:
+            self._cache.spill_hook = self._spill_block
+        # per-engine prefix stats (the shared ServingMetrics registry
+        # aggregates fleet-wide; per-replica hit rates need local ones)
+        self.prefix_lookups = 0
+        self.prefix_hit_tokens = 0
+        self.prefix_prompt_tokens = 0
         self._pos = np.zeros((self.max_slots,), np.int32)
         self._bt = np.full((self.max_slots, self.blocks_per_slot),
                            NULL_BLOCK, np.int32)
@@ -610,6 +638,11 @@ class SlotEngine:
 
         shared, n_shared, cow = [], 0, None
         if self._cache is not None:
+            if self.spill_store is not None:
+                # session resume: pull spilled records extending the
+                # live cached prefix back into the pool first, so the
+                # match below sees them as ordinary cache hits
+                self._maybe_restore(ids)
             # always leave >= 1 prompt token to compute: the last
             # token's logits seed decode
             shared, n_shared, cow = self._cache.match(ids, ids.size - 1)
@@ -620,18 +653,29 @@ class SlotEngine:
                 self.metrics.inc("prefix_hit_blocks", len(shared))
             if hit_tokens:
                 self.metrics.inc("prefix_hit_tokens", hit_tokens)
+            self.prefix_lookups += 1
+            self.prefix_prompt_tokens += int(ids.size)
+            self.prefix_hit_tokens += hit_tokens
         n_new = need_total - len(shared)
-        if self._alloc.free_blocks < n_new and self._cache is not None:
-            self._cache.reclaim(n_new - self._alloc.free_blocks)
-        if self._alloc.free_blocks < n_new:
-            raise PoolExhausted(
-                f"need {n_new} free KV blocks, have "
-                f"{self._alloc.free_blocks}")
-        taken, new = [], []
+        taken, new, pinned_src = [], [], None
         try:
+            # pin every matched block (and the CoW source) BEFORE any
+            # reclaim: eviction under pressure must never free a block
+            # `match` just handed us — an unpinned matched leaf could be
+            # reclaimed here and its id recycled by our own alloc loop,
+            # turning a prefix hit into silent KV corruption
             for bid in shared:
                 self._alloc.incref(bid)
                 taken.append(bid)
+            if cow is not None:
+                self._alloc.incref(cow[0])
+                pinned_src = cow[0]
+            if self._alloc.free_blocks < n_new and self._cache is not None:
+                self._cache.reclaim(n_new - self._alloc.free_blocks)
+            if self._alloc.free_blocks < n_new:
+                raise PoolExhausted(
+                    f"need {n_new} free KV blocks, have "
+                    f"{self._alloc.free_blocks}")
             for _ in range(n_new):
                 new.append(self._alloc.alloc())
             fill = n_shared
@@ -649,7 +693,11 @@ class SlotEngine:
                 self._alloc.decref(bid)
             for bid in new:
                 self._alloc.decref(bid)
+            if pinned_src is not None:
+                self._alloc.decref(pinned_src)
             raise
+        if pinned_src is not None:
+            self._alloc.decref(pinned_src)
         return taken + new, fill
 
     def _admit(self):
@@ -792,6 +840,124 @@ class SlotEngine:
         for bid in taken:
             self._alloc.decref(bid)
         return nb * self.block_size
+
+    # -- persistent KV spill tier (ISSUE 18) --------------------------------
+
+    def _spill_block(self, key, tokens, bid, n_rows):
+        """PrefixCache donation hook: persist one evicted block's KV
+        rows to the SSD tier BEFORE the freeing decref (append-before-
+        evict). Best-effort by contract — a spill fault (full/failing
+        disk, injected ``serving.spill``) loses durability for this
+        block, never the eviction or the allocator balance."""
+        if n_rows != self.block_size:
+            return
+        try:
+            # snapshot the (immutable) pool arrays once; the block is
+            # still cache-referenced, so its rows cannot be recycled
+            # before the hook returns
+            ks, vs = list(self._ks), list(self._vs)
+            layers = [(np.asarray(k[bid]), np.asarray(v[bid]))
+                      for k, v in zip(ks, vs)]
+            self.spill_store.append(key, self.weight_version, tokens,
+                                    layers)
+        except Exception:  # noqa: BLE001 — durability is best-effort
+            self.metrics.inc("kv_spill_errors")
+
+    def _maybe_restore(self, ids):
+        """Resume staging: walk the prompt's cumulative-prefix digest
+        chain past the live cached prefix and re-stage every matching
+        spilled record through the all-or-nothing admission path
+        (alloc → scatter → cache.insert, exactly like KV adoption).
+        Fault site ``serving.kv_restore`` fires per block, tagged with
+        the engine name; any failure — fault, fenced generation, torn
+        or bit-rotted record, geometry/token mismatch, pool pressure —
+        stops the walk leak-free and the request re-prefills the rest.
+        Returns the number of tokens restored."""
+        store, cache = self.spill_store, self._cache
+        ids = np.asarray(ids, np.int32).reshape(-1)
+        if store is None or cache is None or ids.size < 2:
+            return 0
+        bs = self.block_size
+        limit = ids.size - 1
+        chain, n, _cow = cache.match(ids, limit)
+        chain = list(chain)
+        # gather every restorable record past the live chain first, then
+        # stage them with ONE scatter per layer pool — per-block
+        # .at[].set dispatches cost more host time than the prefill
+        # chunks the restore is supposed to save
+        recs = []
+        while n + len(recs) * bs + bs <= limit:
+            m = n + len(recs) * bs
+            key = cache._digest(ids[:m + bs])
+            if key in cache._blocks:
+                if recs:
+                    break   # restored gap already ends at a live entry
+                chain.append(cache._blocks[key])
+                n += bs
+                continue
+            try:
+                rec = store.get(key)
+            except kvstore.SpillFencedError:
+                # rollout fenced this generation's records: the caller
+                # re-prefills on the live weights (bitwise-safe)
+                self.metrics.inc("kv_restore_fenced")
+                break
+            if rec is None:
+                break
+            if (rec["generation"] != self.weight_version
+                    or rec["block_size"] != bs
+                    or len(rec["layers"]) != len(self._ks)
+                    or rec["layers"][0][0].shape != self._ks[0].shape[1:]
+                    or not np.array_equal(rec["tokens"], ids[:m + bs])):
+                break
+            recs.append(rec)
+        if self._alloc.free_blocks < len(recs):
+            # no reclaim here: it could evict our own chain
+            recs = recs[:max(self._alloc.free_blocks, 0)]
+        if not recs:
+            return 0
+        bids, inserted = [], 0
+        try:
+            for _ in recs:
+                faults.fault_point("serving.kv_restore", tag=self.name)
+                bids.append(self._alloc.alloc())
+            idx = np.asarray(bids, np.int64)
+            for li in range(len(self._ks)):
+                krows = np.stack([r["layers"][li][0] for r in recs])
+                vrows = np.stack([r["layers"][li][1] for r in recs])
+                self._ks[li] = self._ks[li].at[idx].set(krows)
+                self._vs[li] = self._vs[li].at[idx].set(vrows)
+            for bid in bids:
+                chain.append(bid)
+                cache.insert(ids[:n + bs], chain, n + bs)
+                # the cache now owns its own ref; drop ours
+                self._alloc.decref(bid)
+                self.metrics.inc("kv_restored_blocks")
+                n += bs
+                inserted += 1
+        except Exception:  # noqa: BLE001 — leak-free abort
+            for bid in bids[inserted:]:
+                if chain and chain[-1] == bid:
+                    chain.pop()
+                self._alloc.decref(bid)
+        return inserted * bs
+
+    def spill_cache(self):
+        """Drain the radix cache through the spill tier (graceful-drain
+        / bench pressure lever): every evictable entry takes the normal
+        eviction path, so blocks whose last reference is the cache's
+        persist to SSD before they free. Returns #entries dropped."""
+        if self._cache is None:
+            return 0
+        n = len(self._cache)
+        self._cache.clear()
+        return n
+
+    def prefix_hit_rate(self):
+        """This engine's own prompt-token prefix hit rate (the shared
+        metrics registry aggregates fleet-wide; this is per-replica)."""
+        return self.prefix_hit_tokens / self.prefix_prompt_tokens \
+            if self.prefix_prompt_tokens else 0.0
 
     @staticmethod
     def _warp_probs(logits, gen):
@@ -1329,3 +1495,8 @@ class SlotEngine:
         if self._thread is not None:
             self._thread.join(timeout)
             self._thread = None
+        if drain and self.spill_store is not None:
+            # graceful drain persists the radix cache through the SSD
+            # tier, so sessions resume decode-only after a clean
+            # restart (a crash only keeps what eviction already wrote)
+            self.spill_cache()

@@ -69,6 +69,25 @@ availability toolkit:
   ordinary colocated dispatch; failover replay and first-wins dedup
   apply to both legs unchanged. Prefill legs are never hedged.
 
+- **Prefix-cache-aware routing** (``FLAGS_serving_prefix_affinity``,
+  on by default). Every submit computes the same cumulative sha1
+  block-boundary digests the radix `PrefixCache` indexes on and
+  consults a sticky digest -> replica table, steering the request to
+  the replica holding the longest live match for its token prefix —
+  multi-turn sessions keep landing where their KV already is, so turn
+  N pays decode-only latency instead of a full re-prefill. This
+  generalizes the one-shot adopted-KV ``prefer`` affinity into
+  sticky-with-failover: when the affine replica is dead, draining,
+  breaker-open, excluded, or version-mismatched, dispatch falls
+  through to the normal least-loaded pick, and the table re-sticks to
+  wherever the request actually lands (the new replica re-prefills —
+  or restores from the SSD spill tier, serving/kvstore.py — and
+  becomes the session's new home). The ``serving.affinity`` fault
+  site fires per affinity decision; an injected raise degrades that
+  one decision to least-loaded placement. Per-replica affinity hits
+  and engine-local prefix hit rates export via
+  ``snapshot()["affinity"]``.
+
 Chaos sites (framework/faults.py): ``serving.replica_step`` and
 ``serving.replica_heartbeat`` fire inside supervised engine loops
 (tagged with the replica name, so ``serving.replica_step[fleet.r0]``
@@ -97,6 +116,7 @@ from ..framework import faults, monitor
 from ..framework.flags import flag
 from .engine import SlotEngine
 from .metrics import ServingMetrics
+from .paging import PrefixCache
 from .queueing import (
     AdmissionQueue, BrownoutShedError, DeadlineExceededError, Request,
     RequestCancelled, ReplicaDiedError, RetriesExhaustedError, ServerClosedError,
@@ -736,7 +756,7 @@ class _Flight:
                  "live", "stale", "hedge_ids", "hedged", "parked",
                  "first_dispatch", "last_dispatch", "retry_at",
                  "retry_exclude", "versions", "pin", "prefill_ids",
-                 "kv_state", "prefer")
+                 "kv_state", "prefer", "prefix_digests")
 
     def __init__(self, client, retries, replays):
         self.client = client
@@ -758,6 +778,10 @@ class _Flight:
         self.prefill_ids: set = set()  # attempt ids that are prefill legs
         self.kv_state = None       # None / "migrated" / "fallback"
         self.prefer = None         # one-shot replica affinity (adopted KV)
+        # cumulative block-boundary prefix digests of the prompt,
+        # ascending length — the sticky-affinity lookup keys (longest
+        # match wins; empty = prompt shorter than one block)
+        self.prefix_digests = ()
 
     def active(self):
         return [aid for aid in self.live if aid not in self.stale]
@@ -784,7 +808,7 @@ class Router:
                  backoff_base_s=0.05, backoff_max_s=2.0,
                  queue_cap=None, warmup=True, name="fleet",
                  autoscale=None, roles=None, role_kw=None, disagg=None,
-                 migrate_deadline_s=5.0):
+                 migrate_deadline_s=5.0, prefix_affinity=None):
         from .migrate import KVMailbox
 
         self.metrics = metrics if metrics is not None else ServingMetrics()
@@ -808,6 +832,19 @@ class Router:
             if disagg is None else bool(disagg)
         self._kv_mailbox = KVMailbox()
         self._migrate_deadline_s = migrate_deadline_s
+        # prefix-cache-aware routing (ISSUE 18): sticky map from a
+        # cumulative prompt-prefix digest (PrefixCache._digest at block
+        # boundaries) to the name of the replica that last served a
+        # request with that prefix. Longest-match lookup in _dispatch;
+        # re-stuck on every placement, so failover moves the session's
+        # home instead of pinning it to a corpse. Size-capped FIFO.
+        self._affinity_on = None if prefix_affinity is None \
+            else bool(prefix_affinity)
+        self._affinity: dict = {}          # digest -> replica name
+        self._affinity_cap = 4096
+        self._affinity_lookups = 0
+        self._affinity_hits: dict = {}     # replica name -> hits
+        self._block_size = None
         self.retry_budget = retry_budget
         self.replay_budget = replay_budget if replay_budget is not None \
             else max(replicas, 2)
@@ -843,7 +880,11 @@ class Router:
         if self._sup is not None:
             return self
         self.replica_set.start()
-        self._max_seq_len = self.replica_set.replicas[0].engine.max_seq_len
+        eng0 = self.replica_set.replicas[0].engine
+        self._max_seq_len = eng0.max_seq_len
+        self._block_size = eng0.block_size
+        if self._affinity_on is None:
+            self._affinity_on = bool(flag("FLAGS_serving_prefix_affinity"))
         if self._autoscale_spec and self.autoscaler is None:
             from .autoscale import Autoscaler
             kw = (dict(self._autoscale_spec)
@@ -917,6 +958,14 @@ class Router:
                          temperature=temperature, top_k=top_k, seed=seed)
         self.metrics.inc("fleet_submitted")
         flight = _Flight(client, self.retry_budget, self.replay_budget)
+        if self._affinity_on and self._block_size:
+            # the same cumulative block-boundary digests the replicas'
+            # radix caches index on — ascending length, so a reversed
+            # walk finds the longest sticky match first
+            bs = self._block_size
+            flight.prefix_digests = tuple(
+                PrefixCache._digest(ids[:k * bs])
+                for k in range(1, ids.size // bs + 1))
         with self._lock:
             self._flights[client.id] = flight
             # single cleanup point: whatever resolves the client —
@@ -969,6 +1018,24 @@ class Router:
         snap["brownout"] = self.brownout_active
         with self._lock:
             snap["in_flight"] = len(self._flights)
+            if self._affinity_on:
+                hits = sum(self._affinity_hits.values())
+                per = {}
+                for r in self.replica_set.replicas:
+                    e = r.engine
+                    per[r.name] = {
+                        "hits": self._affinity_hits.get(r.name, 0),
+                        "prefix_hit_rate": (e.prefix_hit_rate()
+                                            if e is not None else 0.0),
+                    }
+                snap["affinity"] = {
+                    "lookups": self._affinity_lookups,
+                    "hits": hits,
+                    "hit_rate": (hits / self._affinity_lookups
+                                 if self._affinity_lookups else 0.0),
+                    "table_size": len(self._affinity),
+                    "per_replica": per,
+                }
         if self.autoscaler is not None:
             snap["autoscaler"] = self.autoscaler.snapshot()
         if self.rollout is not None:
@@ -1037,6 +1104,18 @@ class Router:
                         and (pin is None
                              or p.engine.weight_version == pin)):
                     replica = p
+            if replica is None and not hedge and not self._disagg_on():
+                # sticky prefix affinity: the replica that last served
+                # this token prefix holds its KV blocks live (or can
+                # restore them from the spill tier) — decode-only TTFT
+                # instead of a re-prefill. Any failure (injected
+                # serving.affinity fault, dead/draining/breaker-open
+                # replica, version mismatch) falls through to the
+                # normal pick and the session re-sticks there. Under
+                # live disaggregation the role split owns placement:
+                # fresh requests must take the prefill->migrate leg
+                # (the adopted-KV `prefer` handles decode affinity).
+                replica = self._affinity_pick(flight, exclude, pin)
             if replica is None and not hedge and flight.kv_state is None \
                     and self._disagg_on():
                 replica = self._pick(exclude, version=pin, role="prefill")
@@ -1093,6 +1172,11 @@ class Router:
             flight.live.add(attempt.id)
             if prefill_leg:
                 flight.prefill_ids.add(attempt.id)
+            else:
+                # (re)stick the session's prefix chain to wherever it
+                # actually landed — on failover this moves the home;
+                # prefill legs don't stick (the decode leg will)
+                self._affinity_stick(flight, replica)
             if hedge:
                 flight.hedge_ids.add(attempt.id)
                 self.metrics.inc("hedges")
@@ -1103,6 +1187,53 @@ class Router:
                 flight.first_dispatch = flight.last_dispatch
             self.metrics.inc("routed")
             attempt.add_done_callback(self._attempt_done_cb)
+
+    def _affinity_pick(self, flight, exclude, pin):
+        """The sticky-affinity replica for this flight's longest mapped
+        prompt prefix, or None when affinity is off / no digest maps /
+        the mapped replica cannot take the attempt (then the caller's
+        least-loaded pick handles placement and re-sticks). The
+        ``serving.affinity`` fault site fires once per decision; a
+        raised fault degrades this one decision to least-loaded."""
+        if not self._affinity_on or not flight.prefix_digests:
+            return None
+        self._affinity_lookups += 1
+        try:
+            faults.fault_point("serving.affinity")
+        except Exception:  # noqa: BLE001 — degrade to least-loaded
+            self.metrics.inc("affinity_faults")
+            return None
+        by_name = {r.name: r for r in self.replica_set.replicas}
+        for digest in reversed(flight.prefix_digests):   # longest first
+            name = self._affinity.get(digest)
+            if name is None:
+                continue
+            p = by_name.get(name)
+            if (p is not None and p.state == "healthy"
+                    and p not in exclude
+                    and p.breaker.state == "closed"
+                    and p.engine is not None
+                    and self._role_ok(p, None)
+                    and (pin is None
+                         or p.engine.weight_version == pin)):
+                self._affinity_hits[name] = \
+                    self._affinity_hits.get(name, 0) + 1
+                self.metrics.inc("affinity_hits")
+                return p
+            return None   # mapped but unroutable: fail over cleanly
+        return None
+
+    def _affinity_stick(self, flight, replica):
+        """Point every prefix digest of this flight at the replica it
+        landed on (insertion-ordered FIFO cap keeps the table bounded;
+        re-inserts refresh recency)."""
+        if not self._affinity_on or not flight.prefix_digests:
+            return
+        for digest in flight.prefix_digests:
+            self._affinity.pop(digest, None)
+            self._affinity[digest] = replica.name
+        while len(self._affinity) > self._affinity_cap:
+            self._affinity.pop(next(iter(self._affinity)))
 
     def _role_ok(self, replica, role):
         """May `replica` take an attempt of this kind? role="prefill"

@@ -53,7 +53,11 @@ class ServingMetrics:
     `kv_migrate_bytes` / `kv_migrate_faults` (prefill->decode KV block
     streaming) surfaced with the mesh shape, per-shard occupancy and
     disaggregation role under snapshot()["mesh"] (see `note_mesh` /
-    `note_role`).
+    `note_role`). The persistent KV tier (kvstore.py) adds
+    `kv_spilled_blocks` / `kv_restored_blocks` / `kv_invalidated_blocks`
+    / `kv_spill_bytes` / `kv_restore_corrupt` / `kv_restore_fenced` /
+    `kv_spill_errors`, surfaced under snapshot()["kvstore"], and the
+    prefix-affinity Router adds `affinity_hits` / `affinity_faults`.
     Every inc() also bumps the global `framework.monitor` counter
     ``serving.<name>`` so serving shows up in the same stat registry as
     the rest of the runtime.
@@ -190,6 +194,20 @@ class ServingMetrics:
                 "hit_tokens": hit,
                 "prompt_tokens": prompt,
                 "hit_rate": hit / prompt if prompt else 0.0,
+            }
+        if counters.get("kv_spilled_blocks") \
+                or counters.get("kv_restored_blocks") \
+                or counters.get("kv_invalidated_blocks") \
+                or counters.get("kv_restore_corrupt"):
+            snap["kvstore"] = {
+                "spilled_blocks": counters.get("kv_spilled_blocks", 0),
+                "restored_blocks": counters.get("kv_restored_blocks", 0),
+                "invalidated_blocks":
+                    counters.get("kv_invalidated_blocks", 0),
+                "spill_bytes": counters.get("kv_spill_bytes", 0),
+                "restore_corrupt": counters.get("kv_restore_corrupt", 0),
+                "restore_fenced": counters.get("kv_restore_fenced", 0),
+                "spill_errors": counters.get("kv_spill_errors", 0),
             }
         if counters.get("prefill_tokens"):
             steps = counters.get("steps", 0)

@@ -146,6 +146,13 @@ class PrefixCache:
         self._children: dict = {}   # key -> set of child keys
         self._lru: dict = {}        # key -> last-touch tick
         self._clock = 0
+        #: optional spill donation: ``hook(key, prefix_tokens, bid,
+        #: n_rows)`` called on eviction of an entry whose block is about
+        #: to be freed, BEFORE the freeing decref (append-before-evict —
+        #: the spill tier persists the rows while they still exist).
+        #: The hook must not raise: a failed spill loses durability for
+        #: that block, never the eviction itself.
+        self.spill_hook = None
 
     def __len__(self):
         return len(self._blocks)
@@ -220,7 +227,24 @@ class PrefixCache:
             parent = key
         return added
 
+    def prefix_tokens(self, key):
+        """The full cumulative token prefix an entry covers (root chunk
+        through this entry's own chunk, concatenated in order)."""
+        chunks = []
+        while key != _ROOT:
+            chunks.append(self._chunks[key])
+            key = self._parent[key]
+        return np.concatenate(chunks[::-1]) if chunks else \
+            np.zeros((0,), np.int32)
+
     def _evict(self, key):
+        bid = self._blocks[key]
+        if self.spill_hook is not None \
+                and self._alloc.refcount(bid) == 1:
+            # append-before-evict: persist the rows while the block
+            # still exists — the decref below frees it for reuse
+            self.spill_hook(key, self.prefix_tokens(key), bid,
+                            len(self._chunks[key]))
         self._children.get(self._parent[key], set()).discard(key)
         self._children.pop(key, None)
         bid = self._blocks.pop(key)
@@ -250,7 +274,10 @@ class PrefixCache:
         return freed
 
     def clear(self):
-        """Drop every entry (and its allocator reference)."""
-        for key in list(self._blocks):
-            if key in self._blocks:
+        """Drop every entry (and its allocator reference). Leaves go
+        before parents so the spill hook can still resolve each
+        entry's full token prefix through a live parent chain."""
+        while self._blocks:
+            for key in [k for k in self._blocks
+                        if not self._children.get(k)]:
                 self._evict(key)

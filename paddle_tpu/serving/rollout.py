@@ -478,6 +478,16 @@ class RolloutController:
         self._abort_reason = None
         self._lock = threading.Lock()   # one rollout at a time
         router.rollout = self
+        # generation-fence the SSD KV spill tier (serving/kvstore.py):
+        # every registry commit fences spilled records of the retired
+        # versions, so a session can never resume attention state
+        # computed under weights the rollout replaced
+        fenced = set()
+        for r in router.replica_set.replicas:
+            store = getattr(r.engine, "spill_store", None)
+            if store is not None and id(store) not in fenced:
+                fenced.add(id(store))
+                store.attach_registry(registry)
 
     # -- public API ----------------------------------------------------------
 

@@ -57,6 +57,14 @@ class Server:
     KV pool over a (dp, mp) device mesh via serving/sharding.py. Fleet
     mode composes with disaggregated prefill/decode — pass
     ``fleet=dict(roles=[...], role_kw={...}, disagg=True)``.
+
+    Durable sessions: ``spill_dir=`` (default
+    FLAGS_serving_kv_spill_dir) turns on the persistent SSD KV tier —
+    every engine of the server spills evicted prefix-cache blocks
+    there and restores them on session resume (serving/kvstore.py);
+    fleet mode pairs it with prefix-affinity routing
+    (FLAGS_serving_prefix_affinity or
+    ``fleet=dict(prefix_affinity=...)``).
     """
 
     def __init__(self, model=None, *, mode="generate", fn=None,
@@ -65,7 +73,8 @@ class Server:
                  queue_cap=None, max_batch=None, max_wait_s=0.002,
                  cache_dtype=None, jit=True, strict_shapes=False,
                  warmup=True, replicas=1, fleet=None, spec_len=None,
-                 draft_model=None, quantize=None, mesh=None):
+                 draft_model=None, quantize=None, mesh=None,
+                 spill_dir=None):
         self.mode = mode
         self.metrics = ServingMetrics()
         self._warmup = warmup
@@ -81,7 +90,7 @@ class Server:
                 prefill_chunk=prefill_chunk, prefix_cache=prefix_cache,
                 cache_dtype=cache_dtype, strict_shapes=strict_shapes,
                 spec_len=spec_len, draft_model=draft_model,
-                quantize=quantize, mesh=mesh)
+                quantize=quantize, mesh=mesh, spill_dir=spill_dir)
             self.router = Router(
                 model, max(replicas, 1), engine_kw=engine_kw,
                 metrics=self.metrics, queue_cap=queue_cap,
@@ -103,7 +112,7 @@ class Server:
                 cache_dtype=cache_dtype, metrics=self.metrics,
                 queue=queue, strict_shapes=strict_shapes,
                 spec_len=spec_len, draft_model=draft_model,
-                quantize=quantize, mesh=mesh)
+                quantize=quantize, mesh=mesh, spill_dir=spill_dir)
             self.batcher = None
         elif mode == "batch":
             target = fn if fn is not None else model

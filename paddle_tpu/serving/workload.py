@@ -13,7 +13,12 @@ every serving bench shares (bench_serving.py --trace, bench_fleet.py):
   ``heavy_tail`` Pareto gaps), a zipfian user population whose per-user
   token prefixes repeat across requests (so the radix PrefixCache sees
   realistic shared-prefix traffic), prompt/output length ranges, and
-  weighted priority classes (feeding fleet brownout shedding).
+  weighted priority classes (feeding fleet brownout shedding). With
+  ``multi_turn=True`` every base arrival opens a *session*: 2..N turns
+  separated by think-time gaps, each turn's prompt a pure extension of
+  the previous one — the resume-heavy shape that exercises prefix-
+  affinity routing and the SSD KV spill tier (bench_serving.py
+  --sessions).
 - **Scenario.trace()** — expands the spec into a concrete arrival list,
   bit-deterministic in the seed: the same JSON replays the exact same
   trace on any machine, which is what lets a chaos re-run be compared
@@ -41,21 +46,33 @@ ARRIVAL_PROCESSES = ("poisson", "burst", "heavy_tail")
 
 
 class Arrival:
-    """One scheduled request of a trace (times are seconds from t=0)."""
+    """One scheduled request of a trace (times are seconds from t=0).
 
-    __slots__ = ("t", "user", "prompt", "max_new", "priority")
+    ``session``/``turn`` identify multi-turn traffic: every turn of a
+    session shares the session id, and turn k's prompt is a pure
+    extension of turn k-1's — the shape that makes prefix-affinity
+    routing and the SSD KV spill tier earn their keep. Single-shot
+    arrivals carry ``session=None, turn=0``."""
 
-    def __init__(self, t, user, prompt, max_new, priority):
+    __slots__ = ("t", "user", "prompt", "max_new", "priority",
+                 "session", "turn")
+
+    def __init__(self, t, user, prompt, max_new, priority,
+                 session=None, turn=0):
         self.t = float(t)
         self.user = int(user)
         self.prompt = np.asarray(prompt, np.int32)
         self.max_new = int(max_new)
         self.priority = int(priority)
+        self.session = None if session is None else int(session)
+        self.turn = int(turn)
 
     def __repr__(self):
+        sess = "" if self.session is None \
+            else f", session={self.session}, turn={self.turn}"
         return (f"Arrival(t={self.t:.4f}, user={self.user}, "
                 f"len={self.prompt.size}, max_new={self.max_new}, "
-                f"priority={self.priority})")
+                f"priority={self.priority}{sess})")
 
 
 def _normalize_phase(p):
@@ -94,7 +111,8 @@ class Scenario:
     def __init__(self, name="scenario", seed=0, vocab=97, n_users=64,
                  zipf_s=1.2, user_prefix_len=8, prompt_len=(4, 12),
                  max_new=(4, 8), priorities=((0, 0.7), (1, 0.2), (2, 0.1)),
-                 phases=None):
+                 phases=None, multi_turn=False, session_turns=(2, 4),
+                 think_time=(0.05, 0.2)):
         self.name = str(name)
         self.seed = int(seed)
         self.vocab = int(vocab)
@@ -118,6 +136,22 @@ class Scenario:
             raise ValueError(f"bad prompt_len range {self.prompt_len}")
         if self.max_new[0] < 1 or self.max_new[1] < self.max_new[0]:
             raise ValueError(f"bad max_new range {self.max_new}")
+        # multi-turn sessions (ISSUE 18): each base arrival opens a
+        # session of `session_turns` turns separated by `think_time`
+        # gaps; every turn's prompt extends the previous turn's with a
+        # fresh tail, so the radix caches (and the spill tier) see
+        # genuine resume traffic at the same zipfian popularity
+        self.multi_turn = bool(multi_turn)
+        self.session_turns = (int(session_turns[0]),
+                              int(session_turns[1]))
+        self.think_time = (float(think_time[0]), float(think_time[1]))
+        if self.session_turns[0] < 1 or \
+                self.session_turns[1] < self.session_turns[0]:
+            raise ValueError(
+                f"bad session_turns range {self.session_turns}")
+        if self.think_time[0] < 0 or \
+                self.think_time[1] < self.think_time[0]:
+            raise ValueError(f"bad think_time range {self.think_time}")
 
     # -- spec (de)serialization ---------------------------------------------
 
@@ -130,6 +164,9 @@ class Scenario:
             "max_new": list(self.max_new),
             "priorities": [list(pw) for pw in self.priorities],
             "phases": [dict(p) for p in self.phases],
+            "multi_turn": self.multi_turn,
+            "session_turns": list(self.session_turns),
+            "think_time": list(self.think_time),
         }
 
     def to_json(self, path=None, **kw):
@@ -239,7 +276,7 @@ class Scenario:
         prio_w /= prio_w.sum()
         prefixes = {}
         arrivals = []
-        t0 = 0.0
+        t0, session_id = 0.0, 0
         for phase in self.phases:
             end = t0 + phase["duration_s"]
             gaps = self._gaps(rng, phase)
@@ -260,9 +297,38 @@ class Scenario:
                                                     p=prio_w)])
                 prompt = np.concatenate(
                     [prefixes[user], tail.astype(np.int32)])
-                arrivals.append(Arrival(t, user, prompt, max_new,
-                                        priority))
+                if not self.multi_turn:
+                    arrivals.append(Arrival(t, user, prompt, max_new,
+                                            priority))
+                    continue
+                # multi-turn: this arrival opens a session; turn k's
+                # prompt extends turn k-1's with a fresh tail after a
+                # think-time gap (all draws from the same stream, so
+                # the trace stays bit-deterministic in the seed)
+                sid, session_id = session_id, session_id + 1
+                lo, hi = self.session_turns
+                n_turns = int(rng.randint(lo, hi + 1))
+                tt = t
+                for turn in range(n_turns):
+                    if turn:
+                        tlo, thi = self.think_time
+                        tt += float(rng.uniform(tlo, thi))
+                        lo, hi = self.prompt_len
+                        ext = rng.randint(
+                            0, self.vocab,
+                            (int(rng.randint(lo, hi + 1)),))
+                        prompt = np.concatenate(
+                            [prompt, ext.astype(np.int32)])
+                        lo, hi = self.max_new
+                        max_new = int(rng.randint(lo, hi + 1))
+                    arrivals.append(Arrival(tt, user, prompt, max_new,
+                                            priority, session=sid,
+                                            turn=turn))
             t0 = end
+        if self.multi_turn:
+            # session turns overrun their phase slot; restore global
+            # time order (stable sort keeps the per-time-tie draw order)
+            arrivals.sort(key=lambda a: a.t)
         return arrivals
 
 

@@ -159,6 +159,21 @@ def test_mesh_serving_sites_are_registered():
         assert any(h in faults.SITES[site] for h in hints), site
 
 
+def test_kv_fabric_sites_are_registered():
+    """ISSUE 18: the global-KV-fabric sites — SSD spill append, spilled
+    record restore, and the prefix-affinity routing decision — must stay
+    registered, or bench_serving.py --sessions' chaos leg degrades to a
+    clean run. (Behavioral coverage: test_serving_kvstore.py: a spill
+    fault loses one record's durability but the eviction completes
+    leak-free; a restore fault falls back to re-prefill bitwise; an
+    affinity fault falls back to least-loaded routing.)"""
+    for site, hints in (("serving.spill", ("spill",)),
+                        ("serving.kv_restore", ("restore", "spilled")),
+                        ("serving.affinity", ("affinity", "routing"))):
+        assert site in faults.SITES, site
+        assert any(h in faults.SITES[site].lower() for h in hints), site
+
+
 # ---------------------------------------------------------------------------
 # direct coverage for the sites no other tier-1 test drives
 # ---------------------------------------------------------------------------

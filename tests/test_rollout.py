@@ -444,6 +444,85 @@ def test_rec_refresh_dense_at_version_boundary_no_recompile():
 
 
 # ---------------------------------------------------------------------------
+# spilled-KV generation fencing at the commit boundary (ISSUE 18)
+# ---------------------------------------------------------------------------
+
+
+def test_commit_fences_spilled_kv_and_resume_reprefills(tmp_path, gpt):
+    """A rollout commit must fence SSD-spilled KV of the retired weight
+    version — the spilled-state analogue of `VersionRetiredError` for
+    replays: a resume against a fenced record gets a typed retriable
+    503 inside the engine and falls back to re-prefill bitwise."""
+    from paddle_tpu.serving import SpillFencedError, reset_spill_stores
+
+    reset_spill_stores()
+    router = Router(gpt, replicas=2,
+                    engine_kw=dict(max_slots=2, block_size=8,
+                                   prefill_chunk=8,
+                                   spill_dir=str(tmp_path)),
+                    hedge=False, retry_budget=3, liveness_timeout_s=30.0,
+                    backoff_base_s=0.02, name="rofence").start()
+    try:
+        reg = WeightRegistry(gpt)
+        # the controller wires every engine's spill store to the
+        # registry's commit boundary
+        RolloutController(router, reg, canary_secs=0.05, wave_size=1,
+                          poll_s=0.005, replica_timeout_s=120.0,
+                          slo_p99_ms=60000.0)
+
+        p1 = _prompt(41, 16)
+        out1 = np.asarray(
+            router.submit(p1, max_new_tokens=3, timeout=120.0)
+            .result(120.0), np.int32)
+        store = None
+        for r in router.replica_set.replicas:
+            r.engine.spill_cache()
+            store = store or r.engine.spill_store
+        assert len(store) > 0
+        np.testing.assert_array_equal(          # pre-fence resume works
+            np.asarray(router.submit(np.concatenate([out1, _prompt(42, 4)]),
+                                     max_new_tokens=2, timeout=120.0)
+                       .result(120.0), np.int32)[-2:],
+            np.asarray(router.submit(np.concatenate([out1, _prompt(42, 4)]),
+                                     max_new_tokens=2, timeout=120.0)
+                       .result(120.0), np.int32)[-2:])
+        assert router.metrics.get("kv_restored_blocks") > 0
+
+        # committing v1 retires v0 -> every gen-0 record is fenced
+        reg.add(WeightVersion(1, _perturbed(gpt, 17)))
+        reg.begin(1)
+        reg.commit(1)
+        digest = next(iter(store._index))
+        with pytest.raises(SpillFencedError) as ei:
+            store.get(digest)
+        assert ei.value.status == 503 and ei.value.retriable
+
+        # the engines still serve v0: their resume attempt hits the
+        # fence, counts it, and re-prefills bitwise on the live weights
+        for r in router.replica_set.replicas:
+            r.engine.spill_cache()
+        fenced0 = router.metrics.get("kv_restore_fenced")
+        restored0 = router.metrics.get("kv_restored_blocks")
+        p2 = np.concatenate([out1, _prompt(43, 5)])
+        out2 = np.asarray(
+            router.submit(p2, max_new_tokens=3, timeout=120.0)
+            .result(120.0), np.int32)
+        assert router.metrics.get("kv_restore_fenced") > fenced0
+        assert router.metrics.get("kv_restored_blocks") == restored0
+        ref = Server(gpt, max_slots=2, block_size=8,
+                     prefix_cache=False).start()
+        try:
+            np.testing.assert_array_equal(
+                out2, np.asarray(ref.generate(p2, max_new_tokens=3,
+                                              timeout=120.0), np.int32))
+        finally:
+            ref.shutdown(drain=True)
+    finally:
+        router.shutdown(drain=True)
+        reset_spill_stores()
+
+
+# ---------------------------------------------------------------------------
 # bench subprocess smoke (slow tier)
 # ---------------------------------------------------------------------------
 
