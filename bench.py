@@ -564,7 +564,168 @@ def overlap_main():
     return 0
 
 
+def _lowp_ernie_leg(mode, steps):
+    """One ERNIE A/B leg: the plain Engine (nn.Linear routing + the
+    delayed-scaling ScaleState carry + the fused LM-head loss chunks)
+    trained `steps` steps under FLAGS_lowp_matmul=mode. Returns the
+    loss curve + the lowp telemetry columns."""
+    import paddle_tpu as paddle
+    from paddle_tpu.engine import Engine, LOWP_SCALE_KEY
+    from paddle_tpu.framework import monitor
+    from paddle_tpu.nlp.transformers import (
+        ErnieConfig, ErnieForPretraining, ErniePretrainingCriterion,
+    )
+
+    paddle.set_flags({"FLAGS_lowp_matmul": mode})
+    try:
+        paddle.seed(0)
+        cfg = ErnieConfig(vocab_size=1000, hidden_size=128, num_layers=2,
+                          num_heads=4, ffn_hidden_size=512,
+                          max_seq_len=128, dropout=0.0, attn_dropout=0.0,
+                          use_parallel=False)
+        model = ErnieForPretraining(cfg)
+        criterion = ErniePretrainingCriterion(cfg)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                     parameters=model.parameters(),
+                                     weight_decay=0.01)
+
+        def loss_fn(outputs, mlm_labels):
+            logits, nsp = outputs
+            return criterion(logits, nsp, mlm_labels)
+
+        eng = Engine(model, opt, loss_fn)
+        rng = np.random.RandomState(0)
+        x = rng.randint(0, cfg.vocab_size, (4, 128)).astype(np.int32)
+        y = rng.randint(0, cfg.vocab_size, (4, 128)).astype(np.int32)
+        c0 = {d: monitor.stat_get(f"lowp.matmuls_{d}")
+              for d in ("int8", "fp8")}
+        losses = [float(np.asarray(eng.train_batch(x, y)))
+                  for _ in range(steps)]
+        quantized = {d: monitor.stat_get(f"lowp.matmuls_{d}") - c0[d]
+                     for d in ("int8", "fp8")}
+        leg = {"model": "ernie", "mode": mode, "steps": steps,
+               "achieved_dtype": mode if mode != "off" else "f32",
+               "final_loss": losses[-1], "losses": losses,
+               "matmuls_quantized": quantized,
+               "clip_rate": None, "scale_updates": 0}
+        state = eng.state.buffers.get(LOWP_SCALE_KEY)
+        if state is not None:
+            from paddle_tpu.quantization.scaling import \
+                publish_scale_state
+
+            leg["clip_rate"] = round(publish_scale_state(state), 6)
+            leg["scale_updates"] = int(state.updates)
+        return leg
+    finally:
+        paddle.set_flags({"FLAGS_lowp_matmul": "off"})
+
+
+def _lowp_gpt_leg(mode, steps):
+    """One GPT A/B leg: the hybrid engine (per-block scan + the tied
+    lowp head, dynamic scales) on a 1-device dp1.mp1 group."""
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.hybrid import make_gpt_hybrid_engine
+    from paddle_tpu.distributed.topology import \
+        set_hybrid_communicate_group
+    from paddle_tpu.framework import monitor
+    from paddle_tpu.nlp.transformers import (
+        GPTConfig, GPTForPretraining, GPTPretrainingCriterion,
+    )
+
+    paddle.set_flags({"FLAGS_lowp_matmul": mode})
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 1,
+                               "pp_degree": 1, "sharding_degree": 1}
+    fleet.init(is_collective=True, strategy=strategy)
+    hcg = fleet.get_hybrid_communicate_group()
+    try:
+        paddle.seed(7)
+        cfg = GPTConfig(vocab_size=256, hidden_size=64, num_layers=4,
+                        num_heads=8, max_seq_len=64, dropout=0.0,
+                        attn_dropout=0.0, use_parallel=True,
+                        sequence_parallel=True)
+        model = GPTForPretraining(cfg)
+        crit = GPTPretrainingCriterion(cfg)
+        opt = paddle.optimizer.SGD(learning_rate=0.01,
+                                   parameters=model.parameters())
+        toks = np.random.RandomState(0).randint(
+            0, cfg.vocab_size, (8, 65)).astype(np.int32)
+        x, y = toks[:, :-1], toks[:, 1:]
+        eng = make_gpt_hybrid_engine(model, crit, opt, hcg)
+        c0 = {d: monitor.stat_get(f"lowp.matmuls_{d}")
+              for d in ("int8", "fp8")}
+        losses = [float(np.asarray(eng.train_batch(x, y)._value))
+                  for _ in range(steps)]
+        quantized = {d: monitor.stat_get(f"lowp.matmuls_{d}") - c0[d]
+                     for d in ("int8", "fp8")}
+        return {"model": "gpt", "mode": mode, "steps": steps,
+                "achieved_dtype": mode if mode != "off" else "f32",
+                "final_loss": losses[-1], "losses": losses,
+                "matmuls_quantized": quantized,
+                "clip_rate": None, "scale_updates": 0}
+    finally:
+        set_hybrid_communicate_group(None)
+        paddle.set_flags({"FLAGS_lowp_matmul": "off"})
+
+
+def lowp_main():
+    """`bench.py --lowp`: the ISSUE-19 loss-parity gate. bf16/f32 vs
+    int8 vs fp8-sim A/B on the ERNIE (plain Engine, delayed scaling)
+    and GPT (hybrid engine, dynamic scaling) configs: >=50 training
+    steps per leg, an elementwise loss-curve rtol gate for each
+    quantized mode, and a flag-off determinism check (two 'off' runs
+    must be bitwise-identical — the routing layer returns None before
+    touching anything). One JSON line, `vs_baseline`-style columns."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    dev = jax.devices()[0]
+    steps = int(os.environ.get("BENCH_LOWP_STEPS", "50"))
+    rtol = float(os.environ.get("BENCH_LOWP_RTOL", "0.2"))
+
+    legs = []
+    gates = []
+    for kind, leg_fn in (("ernie", _lowp_ernie_leg),
+                         ("gpt", _lowp_gpt_leg)):
+        base = leg_fn("off", steps)
+        base2 = leg_fn("off", steps)
+        off_bitwise = base["losses"] == base2["losses"]
+        legs.append(base)
+        for mode in ("int8", "fp8"):
+            leg = leg_fn(mode, steps)
+            dev_curve = [
+                abs(a - b) / max(abs(b), 1e-6)
+                for a, b in zip(leg["losses"], base["losses"])]
+            leg["max_rel_dev"] = round(max(dev_curve), 5)
+            leg["pass"] = bool(leg["max_rel_dev"] <= rtol
+                               and leg["matmuls_quantized"][mode] > 0)
+            legs.append(leg)
+            gates.append((kind, mode, leg["pass"]))
+        gates.append((kind, "off_bitwise", off_bitwise))
+
+    for leg in legs:
+        leg.pop("losses", None)   # keep the line one screen wide
+    ok = all(p for _, _, p in gates)
+    print(json.dumps({
+        "metric": "lowp_loss_parity",
+        "value": 1 if ok else 0,
+        "unit": "gate",
+        "vs_baseline": max((leg.get("max_rel_dev", 0.0)
+                            for leg in legs), default=0.0),
+        "rtol": rtol,
+        "steps": steps,
+        "gates": [{"model": m, "check": c, "pass": p}
+                  for m, c, p in gates],
+        "device": getattr(dev, "device_kind", dev.platform),
+        "legs": legs,
+    }))
+    return 0 if ok else 1
+
+
 if __name__ == "__main__":
     if "--overlap" in sys.argv:
         sys.exit(overlap_main())
+    if "--lowp" in sys.argv:
+        sys.exit(lowp_main())
     sys.exit(main())

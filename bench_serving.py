@@ -89,6 +89,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import threading
 import time
 
@@ -601,6 +602,117 @@ def run_smoke(args, serving):
     return 0 if result["ok"] else 1
 
 
+def run_w8a8(args, serving):
+    """--w8a8: the ISSUE-19 low-precision decode certification. Same
+    pinned greedy prompts through three servers of one model — f32
+    reference, weights-only int8 (the PR16 dequant epilogue), and w8a8
+    (int8 weights x int8 activations through the fused
+    ``w8a8_matmul`` epilogue with a frozen per-tensor activation
+    scale) — asserting:
+
+    - greedy-token agreement of the w8a8 leg vs the f32 reference at
+      >= the tolerance (autoregressive drift compounds after a first
+      divergence, so agreement is measured per emitted token);
+    - the compile contract is UNTOUCHED: ``{decode: 1, cow: 1}`` for
+      every leg's whole life (the activation scale is a runtime
+      argument of the one compiled trace, never a retrace);
+    - the activation scale actually froze (calibration ended inside
+      the run) and zero request errors;
+
+    and reporting tokens/s/chip per leg."""
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu.nlp.transformers import GPTConfig, GPTForPretraining
+
+    max_new, n_req, prompt_len = 24, 6, 8
+    tol = float(os.environ.get("BENCH_W8A8_TOL", "0.8"))
+    paddle.seed(7)
+    cfg = GPTConfig(vocab_size=512, hidden_size=256, num_layers=6,
+                    num_heads=4, max_seq_len=64, dropout=0.0,
+                    attn_dropout=0.0, use_parallel=False)
+    model = GPTForPretraining(cfg)
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(1, cfg.vocab_size,
+                           (prompt_len,)).astype(np.int32)
+               for _ in range(n_req)]
+    ndev = max(jax.device_count(), 1)
+
+    def leg(name, quantize, w8a8):
+        server = serving.Server(
+            model, max_slots=4, max_seq_len=64, block_size=16,
+            num_blocks=17, prefill_chunk=64, quantize=quantize,
+            w8a8=w8a8).start()
+        server.generate(prompts[0], max_new_tokens=4, timeout=120.0)
+        t0 = time.monotonic()
+        futs = [server.submit(p, max_new_tokens=max_new, timeout=120.0)
+                for p in prompts]
+        outs = [np.asarray(f.result(120.0), np.int64) for f in futs]
+        wall = time.monotonic() - t0
+        snap = server.snapshot()
+        eng = server.engine
+        counts = {str(c): v for c, v in eng.compile_counts.items()}
+        row = {
+            "leg": name,
+            "tokens_per_s": round(n_req * max_new / wall, 2),
+            "tokens_per_s_per_chip": round(
+                n_req * max_new / wall / ndev, 2),
+            "errors": snap["counters"].get("failed", 0),
+            "compiles": counts,
+        }
+        if w8a8:
+            row["act_scale"] = round(float(eng._act_scale), 5)
+            row["act_scale_frozen"] = bool(eng._act_frozen)
+        server.shutdown(drain=True)
+        return row, outs
+
+    f32, ref = leg("f32", False, False)
+    print(json.dumps(f32))
+    int8, _ = leg("int8", True, False)
+    print(json.dumps(int8))
+    w8a8, outs = leg("w8a8", True, True)
+
+    total = sum(len(o) for o in ref)
+    match = sum(int(np.sum(np.asarray(a[:min(len(a), len(b))]) ==
+                           np.asarray(b[:min(len(a), len(b))])))
+                for a, b in zip(outs, ref))
+    agree = match / max(total, 1)
+    w8a8["token_agreement"] = round(agree, 4)
+    print(json.dumps(w8a8))
+
+    failures = []
+    for row in (f32, int8, w8a8):
+        if row["errors"]:
+            failures.append(f"{row['leg']} errors: {row['errors']}")
+        if row["compiles"] != {"decode": 1, "cow": 1}:
+            failures.append(
+                f"{row['leg']} compiles {row['compiles']}")
+    if agree < tol:
+        failures.append(f"token agreement {agree:.3f} < {tol}")
+    if not w8a8.get("act_scale_frozen"):
+        failures.append("activation scale never froze")
+    result = {
+        "bench": "BENCH_SERVING_W8A8",
+        "requests": n_req,
+        "max_new": max_new,
+        "tolerance": tol,
+        "model": {"vocab": cfg.vocab_size, "hidden": cfg.hidden_size,
+                  "layers": cfg.num_layers, "heads": cfg.num_heads},
+        "f32": f32,
+        "int8": int8,
+        "w8a8": w8a8,
+        "token_agreement": round(agree, 4),
+        "ok": not failures,
+    }
+    if failures:
+        result["failures"] = failures
+    print(json.dumps(result))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(result, f, indent=1, sort_keys=True)
+    return 0 if result["ok"] else 1
+
+
 def run_sessions(args, serving):
     """--sessions: the ISSUE-18 durable multi-turn certification.
 
@@ -948,6 +1060,11 @@ def main(argv=None):
     ap.add_argument("--int8", action="store_true",
                     help="freeze weights to int8 (dequant epilogue "
                     "decode path)")
+    ap.add_argument("--w8a8", action="store_true",
+                    help="low-precision decode certification: f32 vs "
+                    "weights-only int8 vs w8a8 legs, greedy-token "
+                    "tolerance + compile-once assertions; emits "
+                    "BENCH_SERVING_W8A8")
     ap.add_argument("--smoke", action="store_true",
                     help="fast-decode certification: baseline vs "
                     "speculative legs, >=2x + parity + compile-once "
@@ -979,6 +1096,8 @@ def main(argv=None):
 
     if args.sessions:
         return run_sessions(args, serving)
+    if args.w8a8:
+        return run_w8a8(args, serving)
     if args.smoke and not args.disagg:
         return run_smoke(args, serving)
 

@@ -173,7 +173,9 @@ class RowParallelLinear(Layer):
 
         out = _overlap.maybe_row_parallel(x, self.weight)
         if out is None:
-            out = apply("matmul_v2", x, self.weight)
+            # F.linear (not raw matmul_v2) so the FLAGS_lowp_matmul
+            # route applies to the GSPMD row-parallel path too
+            out = F.linear(x, self.weight)
             out = shard_hint(out, *([None] * out.ndim))  # forces all-reduce
         if self.bias is not None:
             out = out + self.bias

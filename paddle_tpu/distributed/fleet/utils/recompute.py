@@ -64,10 +64,16 @@ def recompute(function, *args, **kwargs):
     tensor_idx = [i for i, a in enumerate(args) if isinstance(a, Tensor)]
 
     def fn_arrays(*arrs):
+        # the checkpointed segment is a sub-trace: the lowp delayed-
+        # scaling region must not record its tracers (its matmuls use
+        # dynamic scales instead)
+        from ....ops import lowp as _lowp
+
         full = list(args)
         for j, i in enumerate(tensor_idx):
             full[i] = Tensor(arrs[j])
-        out = function(*full, **kwargs)
+        with _lowp.suppress_region():
+            out = function(*full, **kwargs)
         return jax.tree.map(
             lambda t: t._value if isinstance(t, Tensor) else t, out,
             is_leaf=lambda t: isinstance(t, Tensor))

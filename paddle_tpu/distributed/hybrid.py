@@ -529,7 +529,18 @@ def make_gpt_hybrid_engine(model, criterion, optimizer, hcg, *,
         h = functional_call(m.gpt.final_norm, fn_values, Tensor(h))
         # tied embedding logits: weight lives in the rest params
         w = values["gpt.embeddings.word_embeddings.weight"]
-        logits = jnp.matmul(h, w.T)
+        from ..ops import lowp as _lowp
+
+        if _lowp.mode() != "off":
+            # dynamic scales: the hybrid per-block scan has no
+            # delayed-scaling region (the ScaleState carry rides the
+            # plain Engine only)
+            hv = h._value if isinstance(h, Tensor) else h
+            logits = _lowp.scaled_matmul(
+                hv, w.T, qdtype=_lowp.mode(),
+                out_dtype=jnp.result_type(hv, w))
+        else:
+            logits = jnp.matmul(h, w.T)
         loss = criterion(Tensor(logits), Tensor(labels))
         return loss._value if isinstance(loss, Tensor) else loss
 

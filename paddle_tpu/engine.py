@@ -281,8 +281,14 @@ ANOMALY_BAD_STEPS_KEY = "__anomaly_bad_steps__"
 # (pre-clip) computed inside the compiled step, read lazily by the
 # flight recorder — no extra device pass, no per-step host sync
 GRAD_NORM_KEY = "__grad_norm__"
+# reserved buffer slot for FLAGS_lowp_matmul delayed scaling: the
+# quantization.scaling.ScaleState pytree rides the buffer carry so the
+# per-tensor amax history/scales update in-graph — donated with the rest
+# of the step state, never a host sync or retrace
+LOWP_SCALE_KEY = "__lowp_scale__"
 _RESERVED_BUFFER_KEYS = (LOSS_SCALE_KEY, GOOD_STEPS_KEY, BAD_STEPS_KEY,
-                         ANOMALY_BAD_STEPS_KEY, GRAD_NORM_KEY)
+                         ANOMALY_BAD_STEPS_KEY, GRAD_NORM_KEY,
+                         LOWP_SCALE_KEY)
 
 # paddle GradScaler defaults (ref python/paddle/amp/grad_scaler.py)
 DEFAULT_SCALE_CONFIG = dict(
@@ -293,7 +299,7 @@ DEFAULT_SCALE_CONFIG = dict(
 def make_train_step(layer, loss_fn, optimizer, *, grad_clip=None,
                     donate=True, mesh=None, batch_spec=None, zero_stage=0,
                     sharding_axis=None, loss_scale=None, comm_dtype=None,
-                    anomaly_guard=False, record_grad_norm=None):
+                    anomaly_guard=False, record_grad_norm=None, lowp=None):
     """Build a jitted step:
     (params, buffers, opt_state, batch, lr, key) ->
         (loss, params, buffers, opt_state)
@@ -333,10 +339,13 @@ def make_train_step(layer, loss_fn, optimizer, *, grad_clip=None,
     asp_masks = _asp_masks_for(layer)
 
     from .ops import overlap as _overlap
+    from .ops import lowp as _lowp
 
     _seq_parallel = _overlap.model_sequence_parallel(layer)
+    if lowp is None:
+        lowp = _lowp.mode() != "off"
 
-    def loss_of(params, buffers, batch, key):
+    def loss_of(params, buffers, batch, key, lowp_state=None):
         if comm_dtype is not None:
             from .amp import auto_cast
 
@@ -345,8 +354,12 @@ def make_train_step(layer, loss_fn, optimizer, *, grad_clip=None,
             amp_ctx = contextlib.nullcontext()
         # mp collective-matmul overlap (trace-time no-op unless
         # FLAGS_mp_overlap is on and the mesh is pure dp x mp)
+        # lowp delayed scaling: bind the ScaleState carry to this
+        # trace's quantized matmuls (trace-order slots); the updated
+        # state leaves through the aux return, never a Python cell
         with _random.rng_scope(key), amp_ctx, _overlap.region(
-                mesh, sequence_parallel=_seq_parallel):
+                mesh, sequence_parallel=_seq_parallel), \
+                _lowp.scale_region(lowp_state) as lowp_rec:
             inputs = batch["inputs"]
             if not isinstance(inputs, (list, tuple)):
                 inputs = (inputs,)
@@ -359,6 +372,8 @@ def make_train_step(layer, loss_fn, optimizer, *, grad_clip=None,
                            *(Tensor(l) for l in labels))
             loss_v = loss._value if isinstance(loss, Tensor) else loss
             new_buffers = {k: post[k] for k in buffers}
+            if lowp_rec is not None:
+                new_buffers[LOWP_SCALE_KEY] = lowp_rec.updated()
             return loss_v.astype(jnp.float32), new_buffers
 
     # single build of the sharding rules, shared by the ZeRO-2 gradient
@@ -410,11 +425,13 @@ def make_train_step(layer, loss_fn, optimizer, *, grad_clip=None,
         elif static_scale is not None:
             scale = jnp.asarray(static_scale, jnp.float32)
         anomaly_prev = buffers.get(ANOMALY_BAD_STEPS_KEY)
+        lowp_prev = buffers.get(LOWP_SCALE_KEY)
         model_buffers = {k: v for k, v in buffers.items()
                          if k not in _RESERVED_BUFFER_KEYS}
 
         def scaled_loss(params, model_buffers, batch, key):
-            loss, nb = loss_of(params, model_buffers, batch, key)
+            loss, nb = loss_of(params, model_buffers, batch, key,
+                               lowp_state=lowp_prev)
             if loss_scale is not None:
                 return loss * scale, (loss, nb)
             return loss, (loss, nb)
@@ -476,7 +493,13 @@ def make_train_step(layer, loss_fn, optimizer, *, grad_clip=None,
                 lambda n, o: jnp.where(guard_ok, n, o), new, old)
             new_params = gpick(new_params, params)
             new_opt = gpick(new_opt, opt_state)
-            new_buffers = dict(gpick(new_buffers, model_buffers))
+            # the old-side tree must mirror new_buffers' keys — the lowp
+            # ScaleState rides along, and a bad step keeps the previous
+            # scales (its amaxes may be the very poison being skipped)
+            old_buffers = dict(model_buffers)
+            if lowp_prev is not None and LOWP_SCALE_KEY in new_buffers:
+                old_buffers[LOWP_SCALE_KEY] = lowp_prev
+            new_buffers = dict(gpick(new_buffers, old_buffers))
             new_buffers[ANOMALY_BAD_STEPS_KEY] = jnp.where(
                 guard_ok, 0, anomaly_prev + 1).astype(jnp.int32)
         if record_grad_norm:
@@ -527,6 +550,9 @@ def make_train_step(layer, loss_fn, optimizer, *, grad_clip=None,
             buf_sh[ANOMALY_BAD_STEPS_KEY] = NamedSharding(mesh, P())
         if record_grad_norm:
             buf_sh[GRAD_NORM_KEY] = NamedSharding(mesh, P())
+        if lowp:
+            # sharding prefix over the ScaleState pytree: replicated
+            buf_sh[LOWP_SCALE_KEY] = NamedSharding(mesh, P())
         opt0 = {k: optimizer._init_state(v) for k, v in params0.items()}
         o_sh = {k: jax.tree.map(lambda a, kk=k: opt_sh(kk, a), st)
                 for k, st in opt0.items()}
@@ -611,6 +637,15 @@ class Engine:
         if self._record_grad_norm:
             self.state.buffers[GRAD_NORM_KEY] = jnp.asarray(0.0,
                                                             jnp.float32)
+        # FLAGS_lowp_matmul latched the same way: the ScaleState buffer
+        # joins the donated carry at construction or never
+        from .ops import lowp as _lowp_mod
+
+        self._lowp = _lowp_mod.mode() != "off"
+        if self._lowp:
+            from .quantization.scaling import init_scale_state
+
+            self.state.buffers[LOWP_SCALE_KEY] = init_scale_state()
         self._step_fn = None
         self._offload_sh = None
         self._grad_clip = grad_clip
@@ -627,7 +662,7 @@ class Engine:
             batch_spec=self.batch_spec, zero_stage=self.zero_stage,
             sharding_axis=self.sharding_axis, loss_scale=self.loss_scale,
             comm_dtype=self.comm_dtype, anomaly_guard=self.anomaly_guard,
-            record_grad_norm=self._record_grad_norm)
+            record_grad_norm=self._record_grad_norm, lowp=self._lowp)
         self._offload_sh = None
         if self.offload and self._step_fn._state_shardings is not None:
             # optimizer-state offload (ref sharding/offload_helper.py):

@@ -77,6 +77,11 @@ A fault point is a named site the runtime passes through:
                               replica is chosen (raise = affinity lookup
                               failure — the Router falls back to
                               least-loaded placement)
+    serving.w8a8              each decode step of a w8a8 engine before
+                              the activation-quant dispatch (raise =
+                              activation-quant failure — the step
+                              degrades to the weights-only dequant path
+                              inside the same compiled trace, leak-free)
     ps.push                   each PS mutation between WAL append and
                               table apply, tagged with the table name
                               (crash = kill mid-push: recovery replays
@@ -215,6 +220,9 @@ SITES = {
     "serving.affinity": "each prefix-affinity routing decision before "
                         "the sticky replica is chosen (a fault falls "
                         "back to least-loaded placement)",
+    "serving.w8a8": "each decode step of a w8a8 engine before the "
+                    "activation-quant dispatch (a fault degrades that "
+                    "step to the weights-only dequant path, leak-free)",
     "dist.allreduce": "each eager all-reduce before the transport "
                       "(delay eats the FLAGS_dist_timeout_s budget)",
     "dist.barrier": "each eager barrier / gang ckpt commit barrier",

@@ -250,6 +250,47 @@ _DEFS = {
         True, bool,
         "observe: include device bytes_in_use in each flight-recorder "
         "step record (one host allocator-stats call per step)"),
+    "FLAGS_lowp_matmul": (
+        "off", str,
+        "low precision: route eligible matmuls (nn.Linear, the mp "
+        "Column/RowParallelLinear, the overlap-ring per-shard partials, "
+        "the fused LM-head loss chunks, the hybrid tied head) through "
+        "the ops/lowp.py scaled-matmul family. 'int8' quantizes "
+        "operands per-tensor to int8 with int32 accumulation; 'fp8' "
+        "uses bit-faithful e4m3 emulation with f32 accumulation; 'off' "
+        "keeps every path bitwise-unchanged. Backward always runs in "
+        "bf16 (lowp forward, high-precision backward). "
+        "PADDLE_TPU_LOWP_FORCE=pallas|lax pins the kernel path"),
+    "FLAGS_lowp_amax_history": (
+        16, int,
+        "low precision: length H of each tensor slot's abs-max history "
+        "ring in quantization.scaling.ScaleState — the delayed scale is "
+        "max over the ring, so a transient outlier keeps its headroom "
+        "for H steps (fp8-recipe amax_history_len)"),
+    "FLAGS_lowp_amax_margin": (
+        0, int,
+        "low precision: power-of-two headroom M added to the delayed "
+        "scale (scale = ring-max * 2**M); >0 trades resolution for "
+        "fewer clipped outliers between scale updates"),
+    "FLAGS_lowp_scale_interval": (
+        1, int,
+        "low precision: recompute the delayed scales from the amax "
+        "history every N steps (1 = every step); between updates the "
+        "stale scale keeps the step free of any host sync or retrace"),
+    "FLAGS_lowp_slots": (
+        128, int,
+        "low precision: per-tensor slot capacity of the ScaleState "
+        "carried through the train step; call sites beyond the "
+        "capacity fall back to dynamic (current-step abs-max) scaling "
+        "with a one-time warning"),
+    "FLAGS_serving_w8a8": (
+        False, bool,
+        "serving: extend the weights-only int8 decode "
+        "(FLAGS_serving_quantize) to w8a8 — the tied LM-head matmul "
+        "also quantizes its activation rows to int8 against a frozen "
+        "per-tensor scale calibrated during warmup, still one compiled "
+        "decode trace (compile counters {decode:1, cow:1} unchanged). "
+        "Requires the int8-frozen tied head; ignored otherwise"),
 }
 
 _values: dict = {}

@@ -16,6 +16,7 @@ import jax.numpy as jnp
 __all__ = [
     "calculate_density", "check_sparsity", "create_mask", "prune_model",
     "decorate", "set_excluded_layers", "reset_excluded_layers",
+    "dequant_masked_matmul",
 ]
 
 import weakref
@@ -178,6 +179,26 @@ def apply_masks_tree(layer, new_params, *, engine_name="engine",
             "sparsity is NOT enforced on this path")
     return {k: (v * masks[k].astype(v.dtype)) if k in masks else v
             for k, v in new_params.items()}
+
+
+def dequant_masked_matmul(x, qweight, scale, mask):
+    """Sparsity x quantization (ISSUE 19 satellite): contract f32
+    activations against a 2:4-masked int8 weight table through the
+    `dequant_matmul` epilogue kernel, never materialising the
+    dequantized weights.
+
+    x: (..., K) activations; qweight: (N, K) int8 frozen rows (the
+    quantize_state_int8 layout); scale: scalar or (N,) f32; mask:
+    (N, K) bool/0-1 n:m mask over the SAME layout. Masking the int8
+    code points IS masking the dequantized weights (dequant_int8 maps
+    0 -> 0.0 exactly), so the composition stays bit-faithful to the
+    dense dequant path with masked weights — the parity contract
+    tests/test_lowp.py pins."""
+    from ..ops.quant_ops import dequant_matmul
+
+    qweight = jnp.asarray(qweight)
+    mq = qweight * jnp.asarray(mask).astype(qweight.dtype)
+    return dequant_matmul(x, mq, scale)
 
 
 class ASPOptimizerWrapper:

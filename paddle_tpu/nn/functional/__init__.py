@@ -128,7 +128,14 @@ def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
 
 
 def linear(x, weight, bias=None, name=None):
-    out = apply("matmul_v2", x, weight)
+    # FLAGS_lowp_matmul: eligible matmuls route through the int8/fp8
+    # scaled-matmul family (ops/lowp.py); returns None when off or the
+    # operands aren't routable — 'off' is bitwise-unchanged
+    from ...ops import lowp as _lowp
+
+    out = _lowp.maybe_linear(x, weight)
+    if out is None:
+        out = apply("matmul_v2", x, weight)
     if bias is not None:
         out = out + bias
     return out

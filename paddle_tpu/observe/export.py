@@ -119,6 +119,9 @@ def snapshot(serving=None):
         # persistent-KV-tier view mirrors paddle_serving_kvstore_*
         "kvstore": {stat.split(".", 1)[1]: monitor.stat_get(stat)
                     for stat in _KVSTORE_METRICS},
+        # low-precision compute view mirrors paddle_lowp_*
+        "lowp": {stat.split(".", 1)[1]: monitor.stat_get(stat)
+                 for stat in _LOWP_METRICS},
     }
     if serving is not None:
         out["serving"] = serving.snapshot()
@@ -293,6 +296,46 @@ _KVSTORE_METRICS = {
         "the eviction itself proceeded)"),
 }
 
+#: monitor stat -> (prometheus name, type, help) for the low-precision
+#: compute family (ops/lowp.py + quantization/scaling.py); same
+#: contract as _PS_METRICS, mirrored in snapshot()["lowp"]. The
+#: matmuls counters carry a dtype label (one prometheus name), and the
+#: clip rate is stored as an integer ppm in the monitor registry
+#: (monitor stats coerce to int) and rescaled to a ratio at emission
+_LOWP_METRICS = {
+    "lowp.matmuls_int8": (
+        "paddle_lowp_matmuls_total", "counter",
+        "matmul instances quantized by the lowp scaled-matmul family, "
+        "by quantized dtype (trace-time: one per compiled program)"),
+    "lowp.matmuls_fp8": (
+        "paddle_lowp_matmuls_total", "counter",
+        "matmul instances quantized by the lowp scaled-matmul family, "
+        "by quantized dtype (trace-time: one per compiled program)"),
+    "lowp.scale_updates": (
+        "paddle_lowp_scale_updates_total", "counter",
+        "delayed-scaling recompute events absorbed by the ScaleState "
+        "carry"),
+    "lowp.clipped_elems": (
+        "paddle_lowp_clipped_elements_total", "counter",
+        "elements that saturated the quantization range under the "
+        "delayed scales"),
+    "lowp.quantized_elems": (
+        "paddle_lowp_quantized_elements_total", "counter",
+        "elements quantized under the delayed-scaling region"),
+    "lowp.clip_rate_ppm": (
+        "paddle_lowp_clip_rate_ppm", "gauge",
+        "per-tensor clip/saturation rate of the delayed-scaling "
+        "region, parts per million"),
+    "lowp.amax_history_depth": (
+        "paddle_lowp_amax_history_depth", "gauge",
+        "length of each tensor slot's abs-max history ring "
+        "(FLAGS_lowp_amax_history)"),
+    "lowp.slot_overflow": (
+        "paddle_lowp_slot_overflow_total", "counter",
+        "matmul operands beyond the ScaleState slot capacity that "
+        "fell back to dynamic scaling"),
+}
+
 #: disaggregation role encodings for the mesh-family role gauge
 MESH_ROLE_CODES = {"any": 0, "prefill": 1, "decode": 2}
 
@@ -419,12 +462,21 @@ def prometheus_text(serving=None, queue_depth=None, fleet=None):
     for stat, (pname, mtype, help_) in _KVSTORE_METRICS.items():
         L.add(pname, monitor.stat_get(stat), mtype=mtype, help_=help_)
 
+    # low-precision compute family: dtype-labelled quantized-matmul
+    # counters + delayed-scaling clip/update telemetry
+    for stat, (pname, mtype, help_) in _LOWP_METRICS.items():
+        labels = None
+        if stat.startswith("lowp.matmuls_"):
+            labels = {"dtype": stat.rsplit("_", 1)[1]}
+        L.add(pname, monitor.stat_get(stat), mtype=mtype, labels=labels,
+              help_=help_)
+
     for name, value in sorted(monitor.stats().items()):
         if not isinstance(value, (int, float)):
             continue
         if name in _PS_METRICS or name in _REC_METRICS \
                 or name in _FLEET_STATS or name in _GANG_STATS \
-                or name in _KVSTORE_METRICS:
+                or name in _KVSTORE_METRICS or name in _LOWP_METRICS:
             continue
         L.add(f"paddle_{name}", value, mtype="counter",
               help_="framework.monitor stat")

@@ -453,7 +453,77 @@ def _bwd_lax(x, w, labels, lse, g, cv):
 # ---------------------------------------------------------------------------
 
 
+def _fwd_lax_lowp(x, w, labels, cv, qdtype):
+    """_fwd_lax with the per-chunk score matmuls quantized (the lowp
+    route for the fused LM-head loss). Scales are dynamic per-tensor
+    abs-max — this runs inside the _lce custom_vjp forward rule, a
+    sub-trace where the train step's delayed-scaling region must not
+    record. x quantizes once; each weight chunk quantizes in-scan.
+    The backward recomputes scores at full precision against the lowp
+    lse (standard lowp-fwd/high-precision-bwd recipe; the mismatch is
+    covered by the bench.py --lowp rtol gate)."""
+    from . import lowp as _lowp
+
+    monitor_name = f"lowp.matmuls_{qdtype}"
+    from ..framework import monitor as _monitor
+
+    _monitor.stat_add(monitor_name)
+    n, _ = x.shape
+    v = w.shape[0]
+    wc, nv = _chunked_w(w, cv)
+    lbl = labels.astype(jnp.int32)
+    sx = _lowp.amax_of(x)
+    if qdtype == "int8":
+        qx = _lowp._quant_int8(x, sx)
+    else:
+        qx = _lowp._quant_f8(x, sx).astype(jnp.float32)
+
+    def scores(wk):
+        sw = _lowp.amax_of(wk)
+        if qdtype == "int8":
+            qw = _lowp._quant_int8(wk, sw)
+            acc = lax.dot_general(qx, qw, (((1,), (1,)), ((), ())),
+                                  preferred_element_type=jnp.int32)
+            return acc.astype(jnp.float32) * (sx * sw / (127.0 * 127.0))
+        qw = _lowp._quant_f8(wk, sw).astype(jnp.float32)
+        acc = lax.dot_general(qx, qw, (((1,), (1,)), ((), ())),
+                              preferred_element_type=jnp.float32)
+        return acc * (sx * sw / (448.0 * 448.0))
+
+    def step(carry, inp):
+        m_i, l_i, p_i = carry
+        off, wk = inp
+        s = scores(wk)  # (n, cv) f32
+        col = off + jnp.arange(cv, dtype=jnp.int32)
+        s = jnp.where(col[None, :] < v, s, _NEG_INF)
+        m_new = jnp.maximum(m_i, jnp.max(s, axis=-1))
+        l_new = l_i * jnp.exp(m_i - m_new) + \
+            jnp.sum(jnp.exp(s - m_new[:, None]), axis=-1)
+        hit = (col[None, :] < v) & (col[None, :] == lbl[:, None])
+        p_new = p_i + jnp.sum(jnp.where(hit, s, 0.0), axis=-1)
+        return (m_new, l_new, p_new), None
+
+    offs = jnp.arange(nv, dtype=jnp.int32) * cv
+    init = (jnp.full((n,), _NEG_INF, jnp.float32),
+            jnp.zeros((n,), jnp.float32), jnp.zeros((n,), jnp.float32))
+    (m, l, picked), _ = lax.scan(step, init, (offs, wc))
+    lse = m + jnp.log(l)
+    return lse - picked, lse
+
+
+def _lowp_mode():
+    from . import lowp as _lowp
+
+    return _lowp.mode()
+
+
 def _fwd_dispatch(x, w, labels, cv):
+    m = _lowp_mode()
+    if m != "off":
+        # lowp forces the lax scan (the pallas LM-loss kernels stay
+        # full-precision; the quantized scores use the same online-lse
+        # math)
+        return _fwd_lax_lowp(x, w, labels, cv, m)
     if _use_pallas_lm():
         return _fwd_pallas(x, w, labels, cv)
     return _fwd_lax(x, w, labels, cv)

@@ -198,6 +198,21 @@ def _smap(mesh, fn, in_specs, out_specs):
                          axis_names={DP_AXIS, MP_AXIS}, check_vma=False)
 
 
+def _pmm(a, b):
+    """One ring-hop partial matmul. Under FLAGS_lowp_matmul the
+    per-shard partials quantize through the scaled-matmul family
+    (dynamic per-hop abs-max scales — this runs inside a shard_map
+    body, where the train step's delayed-scaling region must not leak)
+    and accumulate across hops at the operands' precision."""
+    from . import lowp as _lowp
+
+    m = _lowp.mode()
+    if m == "off":
+        return a @ b
+    return _lowp.scaled_matmul(a, b, qdtype=m,
+                               out_dtype=jnp.result_type(a, b))
+
+
 def matmul_allreduce(x, w, mesh):
     """Row-parallel matmul with the all-reduce decomposed into a
     reduce-scatter ring + all-gather ring, both hidden behind per-chunk
@@ -224,10 +239,10 @@ def matmul_allreduce(x, w, mesh):
 
         # reduce-scatter phase: after n-1 hops device idx holds output
         # chunk idx fully summed over all mp shards of the contraction
-        acc = xl @ wchunk((idx - 1) % n)
+        acc = _pmm(xl, wchunk((idx - 1) % n))
         for t in range(1, n):
             acc = lax.ppermute(acc, MP_AXIS, fwd) \
-                + xl @ wchunk((idx - t - 1) % n)
+                + _pmm(xl, wchunk((idx - t - 1) % n))
         # all-gather phase: circulate the finished chunks
         parts = [acc]
         cur = acc
@@ -271,7 +286,7 @@ def allgather_matmul(x, w, mesh):
         cur = xl
         y = None
         for t in range(n):
-            part = cur @ wl                  # [..., s/n, M/n]
+            part = _pmm(cur, wl)             # [..., s/n, M/n]
             if y is None:
                 y = jnp.zeros(part.shape[:-2] + (n * sl, part.shape[-1]),
                               part.dtype)
@@ -309,7 +324,8 @@ def matmul_reducescatter(x, w, mesh):
         sl = xl.shape[-2] // n
 
         def pchunk(c):
-            return lax.dynamic_slice_in_dim(xl, c * sl, sl, axis=-2) @ wl
+            return _pmm(lax.dynamic_slice_in_dim(xl, c * sl, sl, axis=-2),
+                        wl)
 
         # after n-1 hops device idx holds seq chunk idx fully summed
         acc = pchunk((idx - 1) % n)

@@ -46,7 +46,12 @@ __all__ = [
     "quantize_weight_int8",
     "SCALE_SUFFIX", "quantize_state_int8", "dequantize_state",
     "is_quantized_state",
+    "ScaleState", "init_scale_state", "update_scale_state",
+    "publish_scale_state",
 ]
+
+from .scaling import (ScaleState, init_scale_state,  # noqa: E402
+                      publish_scale_state, update_scale_state)
 
 # a frozen state dict stores each quantized leaf as int8 under its
 # original name plus an f32 scalar companion leaf `name + SCALE_SUFFIX`;

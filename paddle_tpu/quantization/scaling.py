@@ -1,0 +1,124 @@
+"""Delayed scaling for the low-precision matmul path (ops/lowp.py).
+
+``ScaleState`` is the fp8-recipe amax bookkeeping as one flat pytree
+carried through the train step like any other buffer (donated, so it
+never forces a host sync or a retrace):
+
+  * ``history`` — per-tensor-slot ring of the last H abs-max values,
+    written in-graph each step (the QAT observers' abs-max statistic,
+    minus the EMA: delayed scaling keeps the raw window and takes its
+    max instead).
+  * ``scale``   — the active per-slot representable-abs-max, updated
+    every ``FLAGS_lowp_scale_interval`` steps as
+    ``max(history) * 2**FLAGS_lowp_amax_margin``.
+  * ``step`` / ``updates`` — schedule counters.
+  * ``clipped`` / ``total`` — running element counts feeding the
+    clip/saturation-rate gauge.
+
+Slots bind to matmul operands in trace order (ops/lowp._ScaleRegion);
+capacity is ``FLAGS_lowp_slots``. Unseen slots this step contribute
+0.0 to their ring column, so an idle slot's scale decays toward the
+floor as its window rolls off — the standard delayed-scaling behavior.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["ScaleState", "init_scale_state", "update_scale_state",
+           "publish_scale_state"]
+
+_EPS = 1e-9
+
+
+class ScaleState(NamedTuple):
+    """Flat pytree of jnp leaves — safe to donate, shard (replicated)
+    and thread through jit boundaries."""
+
+    history: jax.Array   # f32[capacity, H] amax ring
+    scale: jax.Array     # f32[capacity] active delayed scales
+    step: jax.Array      # i32[] steps absorbed into the history
+    updates: jax.Array   # i32[] scale-recompute events so far
+    clipped: jax.Array   # f32[] elements clipped, cumulative
+    total: jax.Array     # f32[] elements quantized, cumulative
+
+
+def init_scale_state(capacity=None, history=None):
+    """Fresh state: unit scales (never used — lowp's first step falls
+    back to dynamic abs-max until the history warms up), empty ring."""
+    from ..framework.flags import flag
+
+    cap = int(flag("FLAGS_lowp_slots") if capacity is None else capacity)
+    h = int(flag("FLAGS_lowp_amax_history") if history is None
+            else history)
+    cap, h = max(cap, 1), max(h, 1)
+    return ScaleState(
+        history=jnp.zeros((cap, h), jnp.float32),
+        scale=jnp.ones((cap,), jnp.float32),
+        step=jnp.zeros((), jnp.int32),
+        updates=jnp.zeros((), jnp.int32),
+        clipped=jnp.zeros((), jnp.float32),
+        total=jnp.zeros((), jnp.float32),
+    )
+
+
+def update_scale_state(state, amax, mask, clipped=None, total=None):
+    """One step of the delayed-scaling schedule, fully in-graph.
+
+    amax: f32[capacity] this step's per-slot abs-max (0 where unseen);
+    mask: bool[capacity] which slots were seen. Writes the ring column
+    ``step % H``, then every ``FLAGS_lowp_scale_interval`` steps
+    recomputes ``scale = max(ring) * 2**margin`` for slots whose ring
+    holds any signal (all-zero rings keep their previous scale so a
+    never-seen slot stays at the unit init instead of collapsing to
+    the epsilon floor).
+    """
+    from ..framework.flags import flag
+
+    margin = int(flag("FLAGS_lowp_amax_margin"))
+    interval = max(int(flag("FLAGS_lowp_scale_interval")), 1)
+
+    cap, h = state.history.shape
+    amax = jnp.asarray(amax, jnp.float32).reshape(cap)
+    mask = jnp.asarray(mask, jnp.bool_).reshape(cap)
+    col = jnp.mod(state.step, h)
+    ring = state.history.at[:, col].set(jnp.where(mask, amax, 0.0))
+
+    step = state.step + 1
+    do = jnp.equal(jnp.mod(step, interval), 0)
+    ringmax = jnp.max(ring, axis=1)
+    fresh = jnp.maximum(ringmax * (2.0 ** margin), _EPS)
+    scale = jnp.where(jnp.logical_and(do, ringmax > 0.0),
+                      fresh, state.scale)
+    return ScaleState(
+        history=ring,
+        scale=scale,
+        step=step,
+        updates=state.updates + do.astype(jnp.int32),
+        clipped=state.clipped + (jnp.zeros((), jnp.float32)
+                                 if clipped is None else clipped),
+        total=state.total + (jnp.zeros((), jnp.float32)
+                             if total is None else total),
+    )
+
+
+def publish_scale_state(state):
+    """Host-side: push the state's counters into the monitor stats
+    backing the ``paddle_lowp_*`` Prometheus family. Forces a device
+    sync — call it from bench/diagnostic code, never the hot loop."""
+    from ..framework import monitor
+
+    monitor.stat_set("lowp.scale_updates", int(state.updates))
+    monitor.stat_set("lowp.clipped_elems", int(state.clipped))
+    monitor.stat_set("lowp.quantized_elems", int(state.total))
+    monitor.stat_set("lowp.amax_history_depth",
+                     int(state.history.shape[1]))
+    tot = float(state.total)
+    rate = float(state.clipped) / tot if tot > 0 else 0.0
+    # monitor stats are integers; the rate gauge is stored in ppm and
+    # rescaled at the observe/export layer
+    monitor.stat_set("lowp.clip_rate_ppm", int(round(rate * 1e6)))
+    return rate

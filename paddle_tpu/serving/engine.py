@@ -204,7 +204,7 @@ class SlotEngine:
                  queue=None, strict_shapes=False, name=None,
                  supervised=False, values=None, weight_version=0,
                  draft_model=None, spec_len=None, quantize=None,
-                 mesh=None, spill_dir=None):
+                 w8a8=None, mesh=None, spill_dir=None):
         import jax
         import jax.numpy as jnp
 
@@ -289,6 +289,22 @@ class SlotEngine:
                         getattr(model.config, "tie_word_embeddings", False):
                     self._head_key = (k, k + SCALE_SUFFIX)
                     break
+        # w8a8 (ISSUE 19): extend the weights-only int8 tied head to
+        # activation quant — the decode matmul's input rows quantize
+        # in-trace against a per-tensor scale calibrated over warmup +
+        # the first few real steps, then frozen. The scale is a runtime
+        # argument of the SAME compiled step (a lax.cond picks the
+        # weights-only branch while it is 0), so compile counters stay
+        # {decode: 1, cow: 1} and a faulted step degrades leak-free.
+        if w8a8 is None:
+            w8a8 = flag("FLAGS_serving_w8a8")
+        self.w8a8 = bool(w8a8) and self._head_key is not None
+        self._act_scale = jnp.zeros((), jnp.float32)
+        self._act_calib = 0
+        self._act_frozen = False
+        self._w8a8_degraded = False
+        if self.w8a8:
+            self.metrics.set_gauge("w8a8_path", 1.0)
         cfg = model.config
         hd = cfg.hidden_size // cfg.num_heads
         dtype = cache_dtype or jnp.float32
@@ -344,15 +360,35 @@ class SlotEngine:
         def _count(key):
             self._compiles[key] = self._compiles.get(key, 0) + 1
 
-        def _head(m, values, hrows):
+        def _head(m, values, hrows, act_scale=None):
             """Project hidden rows (.., H) to f32 logits (.., V): the
             dequant-matmul epilogue against the int8 tied table when
-            frozen, the model's own head otherwise."""
+            frozen, the model's own head otherwise. With `act_scale`
+            (w8a8) the rows also quantize to int8 — a lax.cond inside
+            the one compiled step falls back to the weights-only
+            epilogue while the scale is 0 (calibration, fault
+            degrade)."""
             if self._head_key is not None:
                 from ..ops.quant_ops import dequant_matmul
 
                 qk, sk = self._head_key
-                return dequant_matmul(hrows, values[qk], values[sk])
+                if act_scale is None:
+                    return dequant_matmul(hrows, values[qk], values[sk])
+                from ..ops import lowp as _lowp
+
+                def quant_head(h):
+                    # int8 x int8 with int32 accumulation; the frozen
+                    # table is [V, H], contraction-ready as its
+                    # transpose (XLA fuses the relayout into the read)
+                    return _lowp.w8a8_matmul(
+                        h, values[qk].T, values[sk], act_scale)
+
+                def plain_head(h):
+                    return dequant_matmul(h, values[qk], values[sk])
+
+                from jax import lax
+                return lax.cond(act_scale > 0.0, quant_head, plain_head,
+                                hrows)
             squeeze = hrows.ndim == 2
             if squeeze:
                 hrows = hrows[:, None, :]
@@ -360,7 +396,8 @@ class SlotEngine:
             out = out._value if isinstance(out, Tensor) else out
             return (out[:, 0, :] if squeeze else out).astype(jnp.float32)
 
-        def step_fn(values, tok, pos, nvalid, tables, ks, vs):
+        def step_fn(values, tok, pos, nvalid, tables, ks, vs,
+                    act_scale=None):
             # trace-time only: the compile counter + retrace registry
             _count("decode")
             observe.record_compile(
@@ -385,20 +422,30 @@ class SlotEngine:
                 # only each slot's last valid position feeds sampling:
                 # skip the full-vocab projection of the rest of the chunk
                 last = hv[jnp.arange(hv.shape[0]), nvalid - 1]
-                lv = _head(m, values, last)
+                lv = _head(m, values, last, act_scale)
+                # w8a8 calibration: this step's head-input abs-max
+                # rides the outputs so the host can fold it into the
+                # frozen activation scale without an extra device pass
+                amax = jnp.max(jnp.abs(last.astype(jnp.float32))) \
+                    if act_scale is not None else None
                 if self.spec_len:
                     # speculative verify: the first k+1 chunk columns
                     # ([next, d_1..d_k]) all feed accept/reject
-                    sv = _head(m, values, hv[:, :self.spec_len + 1])
-                    return (lv, sv), new_caches
-                return (lv, lv), new_caches
+                    sv = _head(m, values, hv[:, :self.spec_len + 1],
+                               act_scale)
+                    return (lv, sv, amax), new_caches
+                return (lv, lv, amax), new_caches
 
-            (lv, sv), new_caches = functional_apply(self.model, fvals, run,
-                                                    mesh=self.mesh)
+            (lv, sv, amax), new_caches = functional_apply(
+                self.model, fvals, run, mesh=self.mesh)
             out_ks = [c[0] for c in new_caches]
             out_vs = [c[1] for c in new_caches]
             if self.spec_len:
+                if act_scale is not None:
+                    return lv, sv, amax, out_ks, out_vs
                 return lv, sv, out_ks, out_vs
+            if act_scale is not None:
+                return lv, amax, out_ks, out_vs
             return lv, out_ks, out_vs
 
         def cow_fn(ks, vs, src, dst):
@@ -423,11 +470,17 @@ class SlotEngine:
             vsh = self._plan.values_shardings(self._values)
             pools = [self._plan.pool_sharding(cfg.num_heads)] \
                 * cfg.num_layers
-            step_out = (rep, rep, pools, pools) if self.spec_len \
-                else (rep, pools, pools)
+            if self.w8a8:
+                step_out = (rep, rep, rep, pools, pools) if self.spec_len \
+                    else (rep, rep, pools, pools)
+                step_in = (vsh, rep, rep, rep, rep, pools, pools, rep)
+            else:
+                step_out = (rep, rep, pools, pools) if self.spec_len \
+                    else (rep, pools, pools)
+                step_in = (vsh, rep, rep, rep, rep, pools, pools)
             self._decode = jax.jit(
                 step_fn,
-                in_shardings=(vsh, rep, rep, rep, rep, pools, pools),
+                in_shardings=step_in,
                 out_shardings=step_out)
             self._cow = jax.jit(
                 cow_fn,
@@ -546,6 +599,36 @@ class SlotEngine:
     def _blocks_needed(self, n_positions):
         return -(-int(n_positions) // self.block_size)
 
+    # -- w8a8 activation scale (frozen after a short calibration) -----------
+
+    # warmup + this many real steps feed the running abs-max before the
+    # activation scale freezes; until the first absorb lands the scale
+    # is 0 and the in-trace lax.cond keeps the weights-only epilogue
+    _W8A8_CALIB_STEPS = 8
+
+    def _act_arg(self):
+        """This step's activation-scale argument: 0 degrades the step
+        to the weights-only dequant path inside the same trace."""
+        import jax.numpy as jnp
+
+        if self._w8a8_degraded:
+            return jnp.zeros((), jnp.float32)
+        return self._act_scale
+
+    def _absorb_act_amax(self, amax):
+        """Fold one step's head-input abs-max into the frozen scale.
+        Pure device ops (jnp.maximum on scalars) — no host sync, and
+        the scale is an argument of the one compiled step, so the
+        running update never retraces."""
+        if self._act_frozen or self._w8a8_degraded:
+            return
+        import jax.numpy as jnp
+
+        self._act_scale = jnp.maximum(self._act_scale, amax)
+        self._act_calib += 1
+        if self._act_calib > self._W8A8_CALIB_STEPS:
+            self._act_frozen = True
+
     # -- warmup -------------------------------------------------------------
 
     def warmup(self, mesh=None):
@@ -580,8 +663,14 @@ class SlotEngine:
                             jnp.int32)
             pos = jnp.zeros((self.max_slots,), jnp.int32)
             nvalid = jnp.ones((self.max_slots,), jnp.int32)
-            self._decode(self._values, tok, pos, nvalid,
-                         jnp.asarray(self._bt), self._ks, self._vs)
+            if self.w8a8:
+                out = self._decode(self._values, tok, pos, nvalid,
+                                   jnp.asarray(self._bt), self._ks,
+                                   self._vs, self._act_arg())
+                self._absorb_act_amax(out[2 if self.spec_len else 1])
+            else:
+                self._decode(self._values, tok, pos, nvalid,
+                             jnp.asarray(self._bt), self._ks, self._vs)
             self._cow(self._ks, self._vs, jnp.int32(NULL_BLOCK),
                       jnp.int32(NULL_BLOCK))
             if self.spec_len:
@@ -1020,6 +1109,17 @@ class SlotEngine:
         if self.quantized:
             # raise here propagates to _loop like any step error
             faults.fault_point("serving.dequant")
+        self._w8a8_degraded = False
+        if self.w8a8:
+            # a fault here degrades THIS step to the weights-only
+            # dequant path (act scale 0 -> the lax.cond's plain branch
+            # inside the same compiled step) — leak-free: no eviction,
+            # no retrace, the step still commits its tokens
+            try:
+                faults.fault_point("serving.w8a8")
+            except Exception:  # noqa: BLE001 — deterministic degrade
+                self._w8a8_degraded = True
+                self.metrics.inc("w8a8_degraded_steps")
         if self.spec_len:
             return self._step_spec()
         return self._step_plain()
@@ -1049,10 +1149,18 @@ class SlotEngine:
         t0 = time.monotonic()
         with profiler.RecordEvent("serving.step", cat="serving"):
             with observe.phase("device-step", cat="serving"):
-                logits, self._ks, self._vs = self._decode(
-                    self._values, jnp.asarray(tok),
-                    jnp.asarray(self._pos), jnp.asarray(nvalid),
-                    jnp.asarray(self._bt), self._ks, self._vs)
+                if self.w8a8:
+                    logits, amax, self._ks, self._vs = self._decode(
+                        self._values, jnp.asarray(tok),
+                        jnp.asarray(self._pos), jnp.asarray(nvalid),
+                        jnp.asarray(self._bt), self._ks, self._vs,
+                        self._act_arg())
+                    self._absorb_act_amax(amax)
+                else:
+                    logits, self._ks, self._vs = self._decode(
+                        self._values, jnp.asarray(tok),
+                        jnp.asarray(self._pos), jnp.asarray(nvalid),
+                        jnp.asarray(self._bt), self._ks, self._vs)
         logits = np.asarray(logits)
         self._observe_step_latency(time.monotonic() - t0,
                                    prefill_tokens, len(live) - n_pref)
@@ -1186,10 +1294,18 @@ class SlotEngine:
         t0 = time.monotonic()
         with profiler.RecordEvent("serving.step", cat="serving"):
             with observe.phase("device-step", cat="serving"):
-                lv, sv, self._ks, self._vs = self._decode(
-                    self._values, jnp.asarray(tok),
-                    jnp.asarray(self._pos), jnp.asarray(nvalid),
-                    jnp.asarray(self._bt), self._ks, self._vs)
+                if self.w8a8:
+                    lv, sv, amax, self._ks, self._vs = self._decode(
+                        self._values, jnp.asarray(tok),
+                        jnp.asarray(self._pos), jnp.asarray(nvalid),
+                        jnp.asarray(self._bt), self._ks, self._vs,
+                        self._act_arg())
+                    self._absorb_act_amax(amax)
+                else:
+                    lv, sv, self._ks, self._vs = self._decode(
+                        self._values, jnp.asarray(tok),
+                        jnp.asarray(self._pos), jnp.asarray(nvalid),
+                        jnp.asarray(self._bt), self._ks, self._vs)
         lv = np.asarray(lv)
         sv = np.asarray(sv)
         self._observe_step_latency(time.monotonic() - t0,

@@ -157,7 +157,7 @@ class WeightVersion:
     the per-rebuild compile the float path already pays."""
 
     def __init__(self, version, values, *, manifest=None, source=None,
-                 golden=None, quant=None):
+                 golden=None, quant=None, act_schema=None):
         from ..distributed import checkpoint as ckpt
         from ..quantization import SCALE_SUFFIX
 
@@ -179,6 +179,15 @@ class WeightVersion:
                         "scale": float(np.asarray(self.values[sk])),
                     }
         self.quant = dict(quant) if quant else None
+        # w8a8 artifacts additionally record the activation-quant
+        # schema (per-tensor dtype + frozen scales by site). Golden
+        # digests stay weights-only — activations quantize in-trace at
+        # serve time against these scales, so the quant summary (not
+        # the values manifest) is where the schema is certified.
+        self.act_schema = dict(act_schema) if act_schema else None
+        if self.act_schema is not None and self.quant is not None:
+            self.quant = dict(self.quant)
+            self.quant["__activations__"] = dict(self.act_schema)
 
     @classmethod
     def from_model(cls, model, version=0):
@@ -187,15 +196,32 @@ class WeightVersion:
         return cls(version, state_values(model), source="model")
 
     @classmethod
-    def quantized_from(cls, wv, version):
+    def quantized_from(cls, wv, version, act_scales=None):
         """Freeze an existing float version's 2-D weights to int8 (+
         ``@scale`` companions) as a NEW version with its own manifest:
         the artifact the fleet serves is the artifact the registry
-        certifies, not its float parent."""
+        certifies, not its float parent.
+
+        `act_scales` ({site: float} — e.g. the engine's frozen head
+        activation scale) marks the artifact w8a8: the activation-quant
+        schema is recorded in the quant summary (per-tensor int8,
+        scale = representable abs-max, q = clip(round(x/s*127))) so the
+        version rolls through the bitwise canary gate with its serving
+        contract attached, like PR 16's weights-only ones."""
         from ..quantization import quantize_state_int8
 
+        schema = None
+        src = f"int8(v{wv.version})"
+        if act_scales:
+            schema = {
+                "dtype": "int8",
+                "granularity": "per_tensor",
+                "scales": {str(k): float(v)
+                           for k, v in dict(act_scales).items()},
+            }
+            src = f"w8a8(v{wv.version})"
         return cls(version, quantize_state_int8(wv.values),
-                   source=f"int8(v{wv.version})")
+                   source=src, act_schema=schema)
 
     def __repr__(self):
         q = ", int8" if self.quant else ""

@@ -73,7 +73,7 @@ class Server:
                  queue_cap=None, max_batch=None, max_wait_s=0.002,
                  cache_dtype=None, jit=True, strict_shapes=False,
                  warmup=True, replicas=1, fleet=None, spec_len=None,
-                 draft_model=None, quantize=None, mesh=None,
+                 draft_model=None, quantize=None, w8a8=None, mesh=None,
                  spill_dir=None):
         self.mode = mode
         self.metrics = ServingMetrics()
@@ -90,7 +90,8 @@ class Server:
                 prefill_chunk=prefill_chunk, prefix_cache=prefix_cache,
                 cache_dtype=cache_dtype, strict_shapes=strict_shapes,
                 spec_len=spec_len, draft_model=draft_model,
-                quantize=quantize, mesh=mesh, spill_dir=spill_dir)
+                quantize=quantize, w8a8=w8a8, mesh=mesh,
+                spill_dir=spill_dir)
             self.router = Router(
                 model, max(replicas, 1), engine_kw=engine_kw,
                 metrics=self.metrics, queue_cap=queue_cap,
@@ -112,7 +113,8 @@ class Server:
                 cache_dtype=cache_dtype, metrics=self.metrics,
                 queue=queue, strict_shapes=strict_shapes,
                 spec_len=spec_len, draft_model=draft_model,
-                quantize=quantize, mesh=mesh, spill_dir=spill_dir)
+                quantize=quantize, w8a8=w8a8, mesh=mesh,
+                spill_dir=spill_dir)
             self.batcher = None
         elif mode == "batch":
             target = fn if fn is not None else model

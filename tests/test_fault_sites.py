@@ -174,6 +174,16 @@ def test_kv_fabric_sites_are_registered():
         assert any(h in faults.SITES[site].lower() for h in hints), site
 
 
+def test_w8a8_site_is_registered():
+    """ISSUE 19: the w8a8 decode site — each step's activation-quant
+    dispatch — must stay registered, or the low-precision degrade path
+    is never driven by chaos. (Behavioral coverage:
+    test_serving_w8a8.py: a fault degrades that step to the
+    weights-only dequant path and the step still emits tokens.)"""
+    assert "serving.w8a8" in faults.SITES
+    assert "dequant" in faults.SITES["serving.w8a8"].lower()
+
+
 # ---------------------------------------------------------------------------
 # direct coverage for the sites no other tier-1 test drives
 # ---------------------------------------------------------------------------

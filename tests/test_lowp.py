@@ -1,0 +1,337 @@
+"""Low-precision matmul path (ops/lowp.py + quantization/scaling.py)
+— ISSUE 19 tier-1 contracts:
+
+- kernel parity: the Pallas int8 kernel (interpret mode on CPU) and
+  the lax reference quantize identically and produce the SAME i32
+  accumulator (pinned against a numpy int64 oracle); the scalar f32
+  epilogue agrees to the last ulp, and the fp8-sim kernel to float
+  tolerance (lane padding reorders the f32 dot);
+- the custom_vjp backward is the bf16 matmul of the UNQUANTIZED
+  operands: grads flow to both operands and track the exact f32
+  product's grads to bf16 tolerance (gradcheck);
+- flag-off is a true no-op: ``maybe_linear`` returns None before
+  touching anything and two flag-off engine runs are bitwise
+  identical;
+- the ScaleState delayed-scaling schedule (injected amax sequences:
+  update interval, margin, unseen-slot decay, never-seen slots);
+- the state rides the train step as a donated buffer: a multi-step
+  int8 Engine run under ``observe.no_retrace()`` stays one compile
+  while step/updates/history advance;
+- the ``paddle_lowp_*`` observe family: ``snapshot()["lowp"]`` and
+  the Prometheus exposition of the same counters;
+- ASP x quantization: ``dequant_masked_matmul`` == the dense dequant
+  of the masked table, and the masked table still passes
+  ``check_sparsity``.
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import observe
+from paddle_tpu.engine import LOWP_SCALE_KEY, Engine
+from paddle_tpu.framework import flags, monitor
+from paddle_tpu.incubate import asp
+from paddle_tpu.ops import lowp
+from paddle_tpu.ops.quant_ops import dequant_matmul
+from paddle_tpu.quantization import (
+    ScaleState, init_scale_state, publish_scale_state,
+    update_scale_state,
+)
+
+_LOWP_FLAGS = ("FLAGS_lowp_matmul", "FLAGS_lowp_amax_history",
+               "FLAGS_lowp_amax_margin", "FLAGS_lowp_scale_interval",
+               "FLAGS_lowp_slots")
+
+
+@pytest.fixture(autouse=True)
+def _restore_lowp_flags():
+    saved = {f: flags.flag(f) for f in _LOWP_FLAGS}
+    yield
+    flags.set_flags(saved)
+
+
+def _ab(m=24, k=40, n=12, seed=0):
+    rs = np.random.RandomState(seed)
+    return (rs.randn(m, k).astype(np.float32) * 3.0,
+            rs.randn(k, n).astype(np.float32))
+
+
+# ---------------------------------------------------------------------------
+# kernel parity + gradients
+# ---------------------------------------------------------------------------
+
+
+def test_int8_pallas_interpret_matches_lax_exact_accumulator(
+        monkeypatch):
+    """Both int8 backends quantize identically and accumulate the int8
+    dot EXACTLY (recovering the i32 accumulator by dividing out the
+    scalar epilogue reproduces a numpy int64 oracle bit-for-bit); the
+    f32 epilogue multiply itself may differ by XLA fusion order across
+    the two programs, so it is compared to the last f32 ulp."""
+    a, b = _ab()
+    sa, sb = float(np.abs(a).max()), float(np.abs(b).max())
+    qa = np.clip(np.rint(a * 127.0 / sa), -127, 127).astype(np.int64)
+    qb = np.clip(np.rint(b * 127.0 / sb), -127, 127).astype(np.int64)
+    acc = qa @ qb
+    epi = sa * sb / (127.0 * 127.0)
+    for force in ("lax", "pallas"):
+        monkeypatch.setenv("PADDLE_TPU_LOWP_FORCE", force)
+        out = np.asarray(lowp.scaled_matmul(a, b, qdtype="int8"),
+                         np.float64)
+        assert np.array_equal(np.rint(out / epi).astype(np.int64),
+                              acc), force
+        np.testing.assert_allclose(out, acc * epi, rtol=1e-6,
+                                   err_msg=force)
+
+
+def test_fp8_pallas_interpret_matches_lax(monkeypatch):
+    a, b = _ab(seed=1)
+    monkeypatch.setenv("PADDLE_TPU_LOWP_FORCE", "lax")
+    ref = lowp.scaled_matmul(a, b, qdtype="fp8")
+    monkeypatch.setenv("PADDLE_TPU_LOWP_FORCE", "pallas")
+    out = lowp.scaled_matmul(a, b, qdtype="fp8")
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_w8a8_pallas_interpret_matches_lax_bitwise(monkeypatch):
+    rs = np.random.RandomState(3)
+    x = rs.randn(8, 32).astype(np.float32)
+    w = rs.randn(32, 16).astype(np.float32)
+    scale = float(np.abs(w).max())
+    qw = np.clip(np.rint(w * 127.0 / scale), -127, 127).astype(np.int8)
+    act = float(np.abs(x).max())
+    monkeypatch.setenv("PADDLE_TPU_LOWP_FORCE", "lax")
+    ref = lowp.w8a8_matmul(x, qw, scale, act)
+    monkeypatch.setenv("PADDLE_TPU_LOWP_FORCE", "pallas")
+    out = lowp.w8a8_matmul(x, qw, scale, act)
+    assert np.array_equal(np.asarray(ref), np.asarray(out))
+    # and the epilogue itself is right: int8 fake-quant of both
+    # operands contracted in f64 as the oracle
+    deq = (np.clip(np.rint(x * 127.0 / act), -127, 127) * act / 127.0)
+    want = deq.astype(np.float64) @ (qw.astype(np.float64) * scale
+                                     / 127.0)
+    np.testing.assert_allclose(np.asarray(ref), want, rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_scaled_matmul_gradcheck_bf16_backward():
+    """The custom_vjp backward ignores quantization (straight-through)
+    and computes bf16 matmuls of the full-precision operands: both
+    grads exist and track the exact f32 matmul's grads to bf16
+    rounding tolerance."""
+    a, b = _ab(m=6, k=16, n=5, seed=2)
+
+    def f_lowp(a, b):
+        return jnp.sum(lowp.scaled_matmul(a, b, qdtype="int8") ** 2)
+
+    def f_ref(a, b):
+        return jnp.sum((a @ b) ** 2)
+
+    ga, gb = jax.grad(f_lowp, argnums=(0, 1))(a, b)
+    ra, rb = jax.grad(f_ref, argnums=(0, 1))(a, b)
+    assert np.all(np.isfinite(ga)) and np.all(np.isfinite(gb))
+    # two error sources vs the f32 reference: the forward's int8
+    # quantization (enters through the cotangent of sum(y**2)) and the
+    # backward's own bf16 casts — both ~1e-2 relative
+    np.testing.assert_allclose(np.asarray(ga), np.asarray(ra),
+                               rtol=0.1, atol=0.1 * np.abs(ra).max())
+    np.testing.assert_allclose(np.asarray(gb), np.asarray(rb),
+                               rtol=0.1, atol=0.1 * np.abs(rb).max())
+
+
+def test_flag_off_is_a_true_noop():
+    flags.set_flags({"FLAGS_lowp_matmul": "off"})
+    assert lowp.mode() == "off"
+    x = paddle.to_tensor(np.ones((4, 8), np.float32))
+    paddle.seed(11)
+    lin = nn.Linear(8, 3)
+    assert lowp.maybe_linear(x, lin.weight) is None
+
+    def run():
+        paddle.seed(5)
+        m = nn.Linear(6, 3)
+        opt = paddle.optimizer.Adam(learning_rate=0.05,
+                                    parameters=m.parameters())
+        eng = Engine(m, opt, lambda o, y: ((o - y) ** 2).mean())
+        rs = np.random.RandomState(0)
+        x = rs.randn(8, 6).astype(np.float32)
+        y = rs.randn(8, 3).astype(np.float32)
+        losses = [float(eng.train_batch(x, y)) for _ in range(3)]
+        # flag off: no scale buffer is ever latched into the engine
+        assert LOWP_SCALE_KEY not in eng.state.buffers
+        return losses, {k: np.asarray(v)
+                        for k, v in eng.state.params.items()}
+
+    l1, p1 = run()
+    l2, p2 = run()
+    assert l1 == l2
+    for k in p1:
+        assert np.array_equal(p1[k], p2[k]), k
+
+
+# ---------------------------------------------------------------------------
+# ScaleState schedule
+# ---------------------------------------------------------------------------
+
+
+def test_scale_state_schedule_injected_amax():
+    flags.set_flags({"FLAGS_lowp_amax_margin": 0,
+                     "FLAGS_lowp_scale_interval": 1})
+    st = init_scale_state(capacity=3, history=4)
+    assert isinstance(st, ScaleState)
+    # step 1: slots 0,1 seen
+    st = update_scale_state(st, jnp.array([2.0, 4.0, 0.0]),
+                            jnp.array([True, True, False]),
+                            clipped=jnp.float32(3), total=jnp.float32(100))
+    np.testing.assert_allclose(np.asarray(st.scale), [2.0, 4.0, 1.0])
+    assert int(st.step) == 1 and int(st.updates) == 1
+    # step 2: slot 0 spikes; slot 1 idle writes 0 into its ring but the
+    # window still holds the old 4.0
+    st = update_scale_state(st, jnp.array([8.0, 0.0, 0.0]),
+                            jnp.array([True, False, False]))
+    np.testing.assert_allclose(np.asarray(st.scale), [8.0, 4.0, 1.0])
+    # roll slot 1's 4.0 out of its H=4 window: its ring goes all-zero
+    # and the scale HOLDS (never collapses to the eps floor)
+    for _ in range(4):
+        st = update_scale_state(st, jnp.zeros(3),
+                                jnp.array([False, False, False]))
+    np.testing.assert_allclose(np.asarray(st.scale), [8.0, 4.0, 1.0])
+    assert float(st.clipped) == 3.0 and float(st.total) == 100.0
+
+
+def test_scale_state_interval_and_margin():
+    flags.set_flags({"FLAGS_lowp_amax_margin": 1,
+                     "FLAGS_lowp_scale_interval": 2})
+    st = init_scale_state(capacity=1, history=8)
+    st = update_scale_state(st, jnp.array([3.0]), jnp.array([True]))
+    # step 1 of 2: no recompute yet
+    np.testing.assert_allclose(np.asarray(st.scale), [1.0])
+    assert int(st.updates) == 0
+    st = update_scale_state(st, jnp.array([5.0]), jnp.array([True]))
+    # step 2: scale = max(window) * 2**margin
+    np.testing.assert_allclose(np.asarray(st.scale), [10.0])
+    assert int(st.updates) == 1
+
+
+def test_publish_scale_state_feeds_monitor():
+    st = init_scale_state(capacity=2, history=4)
+    st = st._replace(updates=jnp.int32(7), clipped=jnp.float32(5),
+                     total=jnp.float32(1000))
+    rate = publish_scale_state(st)
+    assert rate == pytest.approx(0.005)
+    assert monitor.stat_get("lowp.scale_updates") == 7
+    assert monitor.stat_get("lowp.clip_rate_ppm") == 5000
+    assert monitor.stat_get("lowp.amax_history_depth") == 4
+
+
+# ---------------------------------------------------------------------------
+# the donated carry through the Engine
+# ---------------------------------------------------------------------------
+
+
+def _int8_engine(seed=5):
+    paddle.seed(seed)
+    m = nn.Sequential(nn.Linear(6, 16), nn.ReLU(), nn.Linear(16, 3))
+    opt = paddle.optimizer.Adam(learning_rate=0.05,
+                                parameters=m.parameters())
+    return Engine(m, opt, lambda o, y: ((o - y) ** 2).mean())
+
+
+def test_int8_training_carries_scale_state_one_compile():
+    flags.set_flags({"FLAGS_lowp_matmul": "int8"})
+    observe.reset()
+    eng = _int8_engine()
+    assert LOWP_SCALE_KEY in eng.state.buffers
+    rs = np.random.RandomState(0)
+    x = rs.randn(8, 6).astype(np.float32)
+    y = rs.randn(8, 3).astype(np.float32)
+    with observe.no_retrace(allow=("train_step",)):
+        losses = [float(eng.train_batch(x, y))]
+    # steady state: the ScaleState carry must not retrace — donation
+    # round-trips the same shapes/dtypes every step
+    with observe.no_retrace():
+        losses += [float(eng.train_batch(x, y)) for _ in range(4)]
+    assert all(np.isfinite(v) for v in losses)
+    st = eng.state.buffers[LOWP_SCALE_KEY]
+    assert int(st.step) == 5 and int(st.updates) == 5
+    # both Linears bound slots: their delayed scales left the unit init
+    assert float(np.max(np.asarray(st.scale))) > 1.0 or \
+        float(np.min(np.asarray(st.scale)[:2])) != 1.0
+    assert float(st.total) > 0
+    evs = observe.compile_events("train_step")
+    assert len(evs) == 1, [e["signature"] for e in evs]
+    observe.reset()
+
+
+def test_int8_fp8_curves_track_f32(tol=0.2):
+    # tol matches the bench.py --lowp gate; this 19-param toy model
+    # amplifies quantization drift far beyond the real configs
+    rs = np.random.RandomState(1)
+    x = rs.randn(16, 6).astype(np.float32)
+    y = rs.randn(16, 3).astype(np.float32)
+    curves = {}
+    for m in ("off", "int8", "fp8"):
+        flags.set_flags({"FLAGS_lowp_matmul": m})
+        eng = _int8_engine(seed=9)
+        curves[m] = [float(eng.train_batch(x, y)) for _ in range(10)]
+    for m in ("int8", "fp8"):
+        dev = max(abs(a - b) / max(abs(b), 1e-6)
+                  for a, b in zip(curves[m], curves["off"]))
+        assert dev < tol, (m, dev, curves)
+
+
+# ---------------------------------------------------------------------------
+# observe export family
+# ---------------------------------------------------------------------------
+
+
+def test_observe_lowp_family():
+    monitor.stat_set("lowp.matmuls_int8", 4)
+    monitor.stat_set("lowp.matmuls_fp8", 2)
+    monitor.stat_set("lowp.scale_updates", 9)
+    monitor.stat_set("lowp.clip_rate_ppm", 1234)
+    snap = observe.snapshot()
+    assert snap["lowp"]["matmuls_int8"] == 4
+    assert snap["lowp"]["scale_updates"] == 9
+    json.dumps(snap)  # the whole snapshot stays JSON-serializable
+    text = observe.prometheus_text()
+    assert 'paddle_lowp_matmuls_total{dtype="int8"} 4' in text
+    assert 'paddle_lowp_matmuls_total{dtype="fp8"} 2' in text
+    assert "paddle_lowp_scale_updates_total 9" in text
+    assert "paddle_lowp_clip_rate_ppm 1234" in text
+
+
+# ---------------------------------------------------------------------------
+# ASP x quantization
+# ---------------------------------------------------------------------------
+
+
+def test_asp_dequant_masked_matmul_parity():
+    rs = np.random.RandomState(7)
+    w = rs.randn(6, 16).astype(np.float32)          # (N, K) head rows
+    x = rs.randn(4, 16).astype(np.float32)
+    mask = asp.create_mask(w)                        # 2:4 along K
+    scale = float(np.abs(w).max())
+    qw = np.clip(np.rint(w * 127.0 / scale), -127, 127).astype(np.int8)
+
+    out = asp.dequant_masked_matmul(x, qw, scale, mask)
+    # oracle 1: the dense dequant path over the masked table
+    ref = dequant_matmul(x, qw * mask.astype(np.int8), scale)
+    assert np.array_equal(np.asarray(out), np.asarray(ref))
+    # oracle 2: materialized masked dequant weights, plain f64 matmul
+    dense = (qw * mask).astype(np.float64) * scale / 127.0
+    np.testing.assert_allclose(np.asarray(out, np.float64),
+                               x.astype(np.float64) @ dense.T,
+                               rtol=1e-5, atol=1e-5)
+    # masking int8 code points IS masking the weights: still 2:4
+    assert asp.check_sparsity(np.asarray(qw * mask.astype(np.int8)))

@@ -1,0 +1,140 @@
+"""w8a8 decode (ISSUE 19): int8 weights x int8 activations through the
+fused ``lowp.w8a8_matmul`` LM-head epilogue of the unified SlotEngine
+step.
+
+Contracts certified here:
+
+- greedy tokens from a w8a8 engine agree with the f32 reference at
+  high rate (per-tensor activation quantization of the final hidden
+  row perturbs near-tie argmaxes only) and the run costs the SAME
+  compile budget as every other engine: ``{decode: 1, cow: 1}`` for
+  the engine's whole life — the activation scale is a runtime argument
+  of the one trace (calibration AND the frozen steady state reuse it);
+- the per-tensor activation scale calibrates on-line from the first
+  decode steps' amax and then freezes;
+- the ``serving.w8a8`` fault site fires each decode step of a w8a8
+  engine; a raise degrades THAT step to the weights-only dequant path
+  (no step error, tokens still emitted, ``w8a8_degraded_steps``
+  counts it) and a float engine never passes the site;
+- ``WeightVersion.quantized_from(..., act_scales=...)`` stamps the
+  activation-quant schema into the artifact's quant summary.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import serving
+from paddle_tpu.framework import faults
+from paddle_tpu.nlp.transformers import GPTConfig, GPTForPretraining
+from paddle_tpu.serving.rollout import WeightVersion
+
+VOCAB = 97
+
+
+@pytest.fixture(scope="module")
+def gpt():
+    paddle.seed(11)
+    cfg = GPTConfig(vocab_size=VOCAB, hidden_size=64, num_layers=2,
+                    num_heads=4, max_seq_len=64, dropout=0.0,
+                    attn_dropout=0.0, use_parallel=False)
+    m = GPTForPretraining(cfg)
+    m.eval()
+    return m
+
+
+def _prompt(seed, n):
+    return np.random.RandomState(seed).randint(
+        1, VOCAB, (n,)).astype(np.int32)
+
+
+def _engine(gpt, **kw):
+    kw.setdefault("max_slots", 2)
+    kw.setdefault("block_size", 8)
+    kw.setdefault("prefill_chunk", 8)
+    e = serving.SlotEngine(gpt, **kw)
+    e.warmup()
+    return e
+
+
+def _drive(eng, prompt, max_new=6, **gen):
+    """test_serving_spec._drive: synchronous admit + step with the
+    fail-all-on-step-error loop contract."""
+    fut = eng.submit(np.asarray(prompt, np.int32),
+                     max_new_tokens=max_new, timeout=None, **gen)
+    eng._admit()
+    while eng.active:
+        try:
+            eng._step()
+        except Exception as e:  # noqa: BLE001 — _loop parity
+            eng.metrics.inc("step_errors")
+            eng._fail_all_active(e)
+    return fut.result(10)
+
+
+def test_w8a8_token_agreement_and_compile_budget(gpt):
+    ref = _engine(gpt)
+    w8a8 = _engine(gpt, quantize=True, w8a8=True)
+    assert w8a8.w8a8
+    total = match = 0
+    for seed, plen, n in ((3, 5, 12), (50, 20, 10), (9, 12, 12)):
+        p = _prompt(seed, plen)
+        want = np.asarray(_drive(ref, p, max_new=n))[plen:]
+        got = np.asarray(_drive(w8a8, p, max_new=n))[plen:]
+        total += want.size
+        match += int(np.sum(want == got))
+    assert match / total >= 0.75, (match, total)
+    # one decode trace + one CoW trace for the whole life: calibration
+    # steps and frozen steady-state steps share the compiled step_fn
+    assert w8a8.compile_counts == {"decode": 1, "cow": 1}
+    assert ref.compile_counts == {"decode": 1, "cow": 1}
+    assert w8a8.metrics.snapshot()["counters"].get("failed", 0) == 0
+
+
+def test_w8a8_act_scale_calibrates_then_freezes(gpt):
+    eng = _engine(gpt, quantize=True, w8a8=True)
+    assert not eng._act_frozen
+    _drive(eng, _prompt(21, 6), max_new=12)
+    # 12 decode steps > the 8-step calibration window
+    assert eng._act_frozen
+    frozen = float(eng._act_scale)
+    assert frozen > 0.0
+    _drive(eng, _prompt(22, 6), max_new=4)
+    assert float(eng._act_scale) == frozen     # frozen means frozen
+
+
+def test_w8a8_fault_degrades_step_to_weights_only(gpt):
+    eng = _engine(gpt, quantize=True, w8a8=True)
+    with faults.ChaosSchedule("serving.w8a8@2:raise") as ch:
+        out = _drive(eng, _prompt(31, 5), max_new=6)
+        ch.verify()
+    # the fault is NOT a step error: the step degraded to the
+    # weights-only dequant head and still emitted its token
+    assert np.asarray(out).shape == (11,)
+    assert eng.metrics.get("w8a8_degraded_steps") == 1
+    assert eng.metrics.get("step_errors") == 0
+    assert eng.metrics.snapshot()["counters"].get("failed", 0) == 0
+    # float engines never pass the site
+    plain = _engine(gpt)
+    with faults.ChaosSchedule("serving.w8a8@1-:raise") as ch:
+        _drive(plain, _prompt(32, 5), max_new=3)
+        assert ch.fired().get("serving.w8a8", 0) == 0
+
+
+def test_weight_version_act_scale_schema(gpt):
+    vals = {k: np.asarray(v._value)
+            for k, v in gpt.state_dict().items()}
+    v1 = WeightVersion(1, vals, source="test")
+    v2 = WeightVersion.quantized_from(v1, 2,
+                                      act_scales={"head": 3.25})
+    assert v2.source == "w8a8(v1)"
+    schema = v2.quant["__activations__"]
+    assert schema == {"dtype": "int8", "granularity": "per_tensor",
+                      "scales": {"head": 3.25}}
+    # weights-only freeze records NO activation schema
+    v3 = WeightVersion.quantized_from(v1, 3)
+    assert v3.source == "int8(v1)"
+    assert v3.quant is not None
+    assert "__activations__" not in v3.quant
