@@ -48,10 +48,24 @@ stall past the liveness timeout — replays its in-flight requests
 pinned to the weight version they were decoding on, and the rollout
 still commits).
 
+``--tenants`` swaps the legs for the multi-tenant isolation story
+(serving/tenancy.py) over the same seeded flash crowd against a fixed
+2-replica weighted-fair fleet with live batched LoRA adapter banks:
+**tenants-isolation** (a weight-1 bronze tenant floods while a
+weight-4 gold tenant trickles; DRR admission must keep the victim's
+p99 within ITS SLO while the flood queues in its own share, each
+tenant decoding its own adapter batched in the same step) and
+**tenants-chaos** (the same leg with ``serving.admit_tenant`` drops on
+the noisy tenant — per-tenant shed accounting must be EXACT: fired ==
+the noisy tenant's shed counter, the victim sheds zero — plus a
+mid-leg adapter rollout whose wave faults at ``serving.adapter_swap``
+and must roll back all-or-nothing with the old bank serving bitwise).
+
 CPU smoke (the tier-1 case):
 
     JAX_PLATFORMS=cpu python bench_fleet.py --smoke
     JAX_PLATFORMS=cpu python bench_fleet.py --rollout --smoke
+    JAX_PLATFORMS=cpu python bench_fleet.py --tenants --smoke
 """
 
 from __future__ import annotations
@@ -449,6 +463,279 @@ def rollout_legs(args, serving, faults, model, scenario):
     return 0
 
 
+# ---------------------------------------------------------------------------
+# --tenants: weighted-fair isolation + batched-adapter serving
+# ---------------------------------------------------------------------------
+
+
+def tenant_legs(args, serving, faults, model, scenario):
+    """Two legs over the same seeded flash crowd, redrawn 4:1 across a
+    noisy bronze tenant ("crowd") and a gold victim ("steady"), against
+    a fixed 2-replica weighted-fair fleet whose engines decode a live
+    batched LoRA bank (one adapter per tenant, gathered per slot inside
+    the single decode trace):
+
+    - **tenants-isolation** — the crowd floods its own DRR share while
+      steady (weight 4) keeps flowing: steady's p99 must stay within
+      ITS SLO, nothing sheds, and the adapter install retraced nothing.
+    - **tenants-chaos** — the same replay with ``serving.admit_tenant``
+      drops injected on the crowd (per-tenant shed accounting must be
+      EXACT: client-observed sheds == the tenant's shed counter == the
+      planned drops; steady sheds zero) while a mid-leg adapter rollout
+      faults at its wave swap (``serving.adapter_swap``) and must roll
+      back all-or-nothing with the OLD bank serving bitwise.
+    """
+    from paddle_tpu.serving import workload
+    from paddle_tpu.serving.tenancy import (
+        AdapterRollout, ArtifactCatalog, TenantDirectory, TenantSpec)
+
+    # same swing curve, arrivals now drawn 4:1 crowd:steady; the victim
+    # rides the top priority class, but the isolation teeth are in the
+    # DRR share — priority never reorders a tenant's own FIFO
+    sdict = scenario.to_dict()
+    sdict["name"] += "-tenants"
+    sdict["tenants"] = {"crowd": {"weight": 4.0},
+                        "steady": {"weight": 1.0, "priority": 2}}
+    scenario = workload.Scenario.from_dict(sdict)
+
+    n_adapters, rank = 3, 4
+    adapter_of = {"steady": 1, "crowd": 2}
+
+    def banks(seed, scale):
+        """Stacked [N, r, H] / [N, V, r] f32 banks; row 0 stays all-zero
+        (adapter id 0 = the base model, bitwise)."""
+        rng = np.random.RandomState(seed)
+        la = np.zeros((n_adapters, rank, args.hidden), np.float32)
+        lb = np.zeros((n_adapters, args.vocab, rank), np.float32)
+        for i in range(1, n_adapters):
+            la[i] = rng.normal(0.0, scale, (rank, args.hidden))
+            lb[i] = rng.normal(0.0, scale, (args.vocab, rank))
+        return la, lb
+
+    def fleet(name):
+        # fresh TenantDirectory per fleet: buckets/deficits are live
+        # state. brownout_tier=0 = never tier-shed — this bench
+        # certifies exactly-once over EVERY arrival (the tier-shed
+        # teeth are unit-tested in test_tenancy.py); no budgets either,
+        # so every shed in the chaos leg is one of OUR injected drops
+        tenancy = TenantDirectory([
+            TenantSpec("steady", weight=4.0, priority=2,
+                       slo_class="gold", slo_p99_ms=args.tenant_slo_ms),
+            TenantSpec("crowd", weight=1.0, slo_class="bronze"),
+        ], brownout_tier=0)
+        return serving.Router(
+            model, 2,
+            engine_kw=dict(max_slots=args.max_slots,
+                           max_seq_len=args.max_seq_len,
+                           block_size=args.block_size,
+                           max_adapters=n_adapters, lora_rank=rank),
+            tenancy=tenancy,
+            queue_cap=args.queue_cap, hedge=False, retry_budget=3,
+            liveness_timeout_s=30.0, backoff_base_s=0.05,
+            brownout_priority=0, name=name).start()
+
+    la1, lb1 = banks(seed=29, scale=0.5)
+    probe = np.random.RandomState(5).randint(
+        0, args.vocab, (6,)).astype(np.int32)
+
+    def run_tenant_leg(router, label, during=None):
+        trace = scenario.trace()
+        lock = threading.Lock()
+        reqs = {}
+        t0 = time.monotonic()
+
+        def submit(a):
+            fut = router.submit(a.prompt, max_new_tokens=a.max_new,
+                                priority=a.priority, tenant=a.tenant,
+                                adapter_id=adapter_of.get(a.tenant, 0),
+                                timeout=120.0)
+            info = {"tenant": a.tenant,
+                    "t_submit": time.monotonic() - t0, "done": 0,
+                    "lat_s": None, "ok": False, "err": None}
+            with lock:
+                reqs[fut.id] = info
+
+            def cb(f, info=info):
+                with lock:
+                    info["done"] += 1
+                    info["lat_s"] = time.monotonic() - t0 \
+                        - info["t_submit"]
+                    info["ok"] = f._error is None
+                    info["err"] = None if f._error is None \
+                        else type(f._error).__name__
+            fut.add_done_callback(cb)
+            return fut
+
+        during_out, dthread = {}, None
+        if during is not None:
+            def _during():
+                try:
+                    during_out["result"] = during()
+                except Exception as e:  # noqa: BLE001 — in the row
+                    during_out["error"] = f"{type(e).__name__}: {e}"
+            dthread = threading.Thread(target=_during, daemon=True)
+            dthread.start()
+        records = workload.replay(submit, trace,
+                                  time_scale=args.time_scale)
+        for r in records:
+            if r["future"] is not None:
+                try:
+                    r["future"].result(120.0)
+                except Exception:  # noqa: BLE001 — typed failures count
+                    pass
+        if dthread is not None:
+            dthread.join(240.0)
+            if dthread.is_alive():
+                during_out["error"] = "during-thunk still running"
+        wall = time.monotonic() - t0
+        compiles = router.compile_counts()
+
+        with lock:
+            rows = list(reqs.values())
+        # a synchronous shed never produced a future: replay recorded
+        # the raise; fold it in as a resolved (done once) outcome
+        for r in records:
+            if r["error"] is not None:
+                rows.append({"tenant": r["arrival"].tenant,
+                             "t_submit": r["t_submit"], "done": 1,
+                             "lat_s": None, "ok": False,
+                             "err": type(r["error"]).__name__})
+        per_tenant = {}
+        for t in sorted({r["tenant"] for r in rows}):
+            sub = [r for r in rows if r["tenant"] == t]
+            ok = [r for r in sub if r["ok"]]
+            shed = [r for r in sub if r["err"] == "TenantBudgetError"]
+            lats = [r["lat_s"] for r in ok if r["lat_s"] is not None]
+            per_tenant[t] = {
+                "submitted": len(sub),
+                "ok": len(ok),
+                "shed": len(shed),
+                "failed_other": len(sub) - len(ok) - len(shed),
+                "p50_ms": round(percentile(lats, 50) * 1e3, 3),
+                "p99_ms": round(percentile(lats, 99) * 1e3, 3),
+                "shed_counter": router.metrics.tenant_get(t, "shed"),
+            }
+        total = len(rows)
+        ok_n = sum(pt["ok"] for pt in per_tenant.values())
+        shed_n = sum(pt["shed"] for pt in per_tenant.values())
+        row = {
+            "leg": label,
+            "arrivals": len(trace),
+            "requests_ok": ok_n,
+            "shed": shed_n,
+            "lost": sum(1 for r in rows if r["done"] == 0),
+            "duplicated": sum(1 for r in rows if r["done"] > 1),
+            "goodput": round(ok_n / total, 4) if total else 0.0,
+            # the injected sheds are deterministic 429s, not losses:
+            # everything ADMITTED must land exactly once
+            "goodput_served": round(ok_n / (total - shed_n), 4)
+                if total > shed_n else 0.0,
+            "wall_s": round(wall, 4),
+            "tenants": per_tenant,
+            "compiles_once": all(c == {"decode": 1, "cow": 1}
+                                 for c in compiles.values()),
+            "adapter_versions": sorted({
+                r.engine.adapter_version
+                for r in router.replica_set.replicas
+                if r.state == "healthy"}),
+        }
+        if during is not None:
+            row["during"] = during_out
+        return row
+
+    # -- leg A: isolation — the crowd floods, the victim's p99 holds --------
+    router = fleet("ftenA")
+    catalog = ArtifactCatalog()
+    ro = AdapterRollout(router, catalog, name="tenant-adapters")
+    ro.roll_to(la1, lb1, probe=probe)      # live install, zero retraces
+    legA = run_tenant_leg(router, "tenants-isolation")
+    legA["adapter_state"] = ro.state
+    router.shutdown(drain=True)
+    print(json.dumps(legA))
+
+    # -- leg B: chaos — injected tenant sheds + a faulted adapter wave ------
+    router = fleet("ftenB")
+    catalog = ArtifactCatalog()
+    ro = AdapterRollout(router, catalog, name="tenant-adapters")
+    ro.roll_to(la1, lb1, probe=probe)      # v1 installs BEFORE the
+    pre = np.asarray(router.generate(      # schedule: swap occurrences
+        probe, max_new_tokens=6, tenant="steady", adapter_id=1,
+        timeout=60.0))                     # below count from zero
+    la2, lb2 = banks(seed=31, scale=0.25)
+    drops = (5, 9, 14)                     # 5th/9th/14th crowd admission
+    specs = ["serving.admit_tenant[crowd]@%d:drop" % k for k in drops]
+    specs.append("serving.adapter_swap@2:raise")   # the WAVE swap (the
+    ro2 = AdapterRollout(router, catalog,          # canary is occ 1) ->
+                         name="tenant-adapters")   # auto-rollback
+    with faults.ChaosSchedule(*specs) as sched:
+        legB = run_tenant_leg(
+            router, "tenants-chaos",
+            during=lambda: ro2.roll_to(la2, lb2, timeout=60.0))
+        fired = sched.verify()
+    post = np.asarray(router.generate(
+        probe, max_new_tokens=6, tenant="steady", adapter_id=1,
+        timeout=60.0))
+    legB["chaos_fired"] = fired
+    legB["adapter_state"] = ro2.state
+    legB["adapter_error"] = ro2.error
+    legB["bank_bitwise_after_rollback"] = bool(
+        pre.shape == post.shape and (pre == post).all())
+    legB["catalog_serving"] = catalog.serving_version(
+        "adapter", "tenant-adapters")
+    router.shutdown(drain=True)
+    print(json.dumps(legB))
+
+    result = {
+        "bench": "BENCH_FLEET_TENANTS",
+        "scenario": scenario.to_dict(),
+        "config": {"replicas": 2, "max_slots": args.max_slots,
+                   "queue_cap": args.queue_cap,
+                   "time_scale": args.time_scale,
+                   "tenant_slo_ms": args.tenant_slo_ms,
+                   "adapters": {"n": n_adapters, "rank": rank,
+                                "by_tenant": adapter_of},
+                   "model": {"vocab": args.vocab, "hidden": args.hidden,
+                             "layers": args.layers, "heads": args.heads},
+                   "chaos_specs": specs},
+        "isolation": legA, "chaos": legB,
+    }
+    print(json.dumps(result))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(result, f, indent=1, sort_keys=True)
+
+    if args.smoke:
+        for leg in (legA, legB):
+            assert leg["lost"] == 0, f"{leg['leg']}: lost futures"
+            assert leg["duplicated"] == 0, \
+                f"{leg['leg']}: duplicated outcomes"
+            assert leg["compiles_once"], \
+                f"{leg['leg']}: an adapter swap retraced"
+            assert leg["adapter_versions"] == [1], leg
+            assert leg["goodput_served"] == 1.0, leg
+            st = leg["tenants"]["steady"]
+            assert st["shed"] == 0 and st["shed_counter"] == 0, st
+            assert st["failed_other"] == 0, st
+            assert st["p99_ms"] <= args.tenant_slo_ms, \
+                (leg["leg"], st["p99_ms"], args.tenant_slo_ms)
+        assert legA["shed"] == 0 and legA["goodput"] == 1.0, legA
+        assert legA["adapter_state"] == "committed", legA
+        cr = legB["tenants"]["crowd"]
+        assert cr["shed"] == len(drops), cr          # client-observed
+        assert cr["shed_counter"] == len(drops), cr  # metrics-side
+        assert legB["chaos_fired"] == {
+            "serving.admit_tenant": len(drops),
+            "serving.adapter_swap": 1}, legB
+        assert legB["adapter_state"] == "rolled_back", legB
+        assert "FaultError" in (legB["adapter_error"] or ""), legB
+        assert "error" in legB["during"], legB["during"]
+        assert legB["bank_bitwise_after_rollback"], \
+            "post-rollback adapter decode is not bitwise pre-rollout"
+        assert legB["catalog_serving"] == 1, legB    # v2 retired
+        print("SMOKE OK")
+    return 0
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--trace", default=None,
@@ -492,6 +779,14 @@ def main(argv=None):
                     "of the autoscale legs: a rolling weight upgrade, "
                     "a bitwise auto-rollback, and a kill-mid-rollout "
                     "driven during the same surge")
+    ap.add_argument("--tenants", action="store_true",
+                    help="run the multi-tenant legs instead of the "
+                    "autoscale legs: a weighted-fair flash crowd with "
+                    "per-tenant adapters, then the same replay under "
+                    "injected tenant sheds + a faulted adapter wave")
+    ap.add_argument("--tenant-slo-ms", type=float, default=2000.0,
+                    help="the victim (gold) tenant's e2e p99 SLO for "
+                    "the --tenants legs")
     ap.add_argument("--smoke", action="store_true",
                     help="tiny model + short trace + assert the "
                     "acceptance bar (tier-1 CPU case)")
@@ -507,10 +802,12 @@ def main(argv=None):
         args.max_new = "12,16"
         args.max_slots, args.max_replicas = 1, 3
         args.slo_ms, args.cooldown_s = 150.0, 0.4
-        if args.rollout:
+        if args.rollout or args.tenants:
             # two slots per replica: the fleet dips to one serving
-            # replica while the other drains/rebuilds, and the surge
-            # must queue (never shed) through that window
+            # replica while the other drains/rebuilds (rollout), or
+            # absorbs the crowd's backlog in its own DRR share while
+            # the victim keeps flowing (tenants); the surge must queue
+            # (never shed) through that window
             args.max_slots = 2
 
     import paddle_tpu as paddle
@@ -540,6 +837,8 @@ def main(argv=None):
 
     if args.rollout:
         return rollout_legs(args, serving, faults, model, scenario)
+    if args.tenants:
+        return tenant_legs(args, serving, faults, model, scenario)
 
     # -- leg 1: static fleet provisioned for the peak -----------------------
     router = make_router(serving, model, args, "fstatic",

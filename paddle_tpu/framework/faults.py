@@ -82,6 +82,16 @@ A fault point is a named site the runtime passes through:
                               activation-quant failure — the step
                               degrades to the weights-only dequant path
                               inside the same compiled trace, leak-free)
+    serving.admit_tenant      each tenant admission decision in the
+                              weighted-fair queue, after the budget
+                              debit and before the enqueue, tagged with
+                              the tenant name (drop = shed with the
+                              tenant-budget 429; the Retry-After header
+                              tracks the bucket refill)
+    serving.adapter_swap      each adapter-bank hot-swap, before any
+                              mutation, tagged with the engine name
+                              (raise = all-or-nothing swap abort — the
+                              old adapter bank keeps serving bitwise)
     ps.push                   each PS mutation between WAL append and
                               table apply, tagged with the table name
                               (crash = kill mid-push: recovery replays
@@ -223,6 +233,15 @@ SITES = {
     "serving.w8a8": "each decode step of a w8a8 engine before the "
                     "activation-quant dispatch (a fault degrades that "
                     "step to the weights-only dequant path, leak-free)",
+    "serving.admit_tenant": "each tenant admission decision in the "
+                            "weighted-fair queue, after budget debit "
+                            "and before enqueue (tag = tenant name; "
+                            "drop = shed with the tenant-budget 429 "
+                            "whose Retry-After tracks the refill)",
+    "serving.adapter_swap": "each adapter-bank hot-swap, before any "
+                            "mutation (tag = engine name; a fault is "
+                            "all-or-nothing — the old adapter bank "
+                            "keeps serving bitwise)",
     "dist.allreduce": "each eager all-reduce before the transport "
                       "(delay eats the FLAGS_dist_timeout_s budget)",
     "dist.barrier": "each eager barrier / gang ckpt commit barrier",

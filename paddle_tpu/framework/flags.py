@@ -126,6 +126,28 @@ _DEFS = {
         "in-trace (weights ride the jit boundary as int8 — the TPU win "
         "is HBM bytes) and the tied LM head runs the dequant-matmul "
         "epilogue from ops/quant_ops.py"),
+    "FLAGS_serving_max_adapters": (
+        0, int,
+        "serving: capacity of the engine's stacked LoRA adapter bank "
+        "([n, r, H] / [n, V, r] jit arguments of the one compiled "
+        "decode step; each slot gathers its own adapter row by index). "
+        "Row 0 is the base model (all-zero). 0 disables adapters and "
+        "keeps every existing path byte-identical"),
+    "FLAGS_serving_lora_rank": (
+        8, int,
+        "serving: low-rank dimension r of the batched LoRA adapter "
+        "bank (used only when FLAGS_serving_max_adapters > 0)"),
+    "FLAGS_tenant_default_budget": (
+        0, int,
+        "serving: default per-tenant token budget in tokens/second "
+        "(token bucket, lazily refilled) for tenants the directory "
+        "auto-creates; over-budget admissions shed with a 429 whose "
+        "Retry-After derives from the bucket's refill. 0 = unlimited"),
+    "FLAGS_tenant_wfq_quantum": (
+        256, int,
+        "serving: deficit-round-robin quantum in tokens credited to a "
+        "tenant's queue per scheduler visit; a tenant's effective "
+        "share is quantum * weight (TenantFairQueue)"),
     "FLAGS_serving_mesh": (
         "", str,
         "serving: mesh spec 'dpD.mpM' the SlotEngine shards weights and "

@@ -459,6 +459,32 @@ class GPTForPretraining(nn.Layer):
         return self.lm_head(h)
 
 
+def lora_logits_delta(hrows, aid, lora_a, lora_b):
+    """Batched low-rank LM-head delta for multi-adapter serving
+    (ISSUE 20): each slot's hidden rows pick up ``B[aid] @ A[aid] @ h``
+    with its own adapter gathered by index — row 0 is the base model's
+    all-zero pair, so base slots add exactly ``0.0`` and stay bitwise.
+
+    ``hrows`` is ``[S, H]`` (one row per slot) or ``[S, C, H]`` (the
+    speculative verify columns); ``aid`` is ``[S]`` int32;
+    ``lora_a`` is ``[n_adapters, r, H]`` and ``lora_b`` is
+    ``[n_adapters, V, r]``. Returns f32 logits deltas shaped like the
+    head's output (``[S, V]`` / ``[S, C, V]``). Pure jnp — traced
+    inside the engine's ONE compiled step; the gather keeps shapes
+    static so adding adapters to a slot never retraces."""
+    import jax.numpy as jnp
+
+    h = jnp.asarray(hrows).astype(jnp.float32)
+    a = jnp.take(jnp.asarray(lora_a), jnp.asarray(aid), axis=0)
+    b = jnp.take(jnp.asarray(lora_b), jnp.asarray(aid), axis=0)
+    if h.ndim == 2:          # [S, H] x [S, r, H] -> [S, r] -> [S, V]
+        low = jnp.einsum("sh,srh->sr", h, a)
+        return jnp.einsum("sr,svr->sv", low, b)
+    # [S, C, H] x [S, r, H] -> [S, C, r] -> [S, C, V]
+    low = jnp.einsum("sch,srh->scr", h, a)
+    return jnp.einsum("scr,svr->scv", low, b)
+
+
 class GPTPretrainingCriterion(nn.Layer):
     def __init__(self, config: GPTConfig = None, ignore_index=-100):
         super().__init__()

@@ -583,6 +583,30 @@ def prometheus_text(serving=None, queue_depth=None, fleet=None):
                 L.add(f"paddle_serving_mesh_{k}_total", mesh[k],
                       mtype="counter", labels=mlab,
                       help_="prefill->decode KV block migration traffic")
+        # multi-tenant serving: one labelled family per tenant-scoped
+        # signal (qps, tokens, shed, latency quantiles, budget gauge)
+        for tname, tsnap in sorted(snap.get("tenants", {}).items()):
+            tlab = {"tenant": tname}
+            for k, v in sorted(tsnap.get("counters", {}).items()):
+                L.add(f"paddle_tenant_{k}_total", v, mtype="counter",
+                      labels=tlab, help_="per-tenant serving counter")
+            L.add("paddle_tenant_qps", tsnap["qps"], labels=tlab,
+                  help_="completions per second billed to this tenant")
+            L.add("paddle_tenant_tokens_per_second",
+                  tsnap["tokens_per_s"], labels=tlab,
+                  help_="generated tokens per second billed to this "
+                        "tenant")
+            lat = tsnap.get("latency_s")
+            if lat:
+                for q in ("p50", "p95", "p99", "max"):
+                    L.add("paddle_tenant_latency_seconds", lat[q],
+                          labels={**tlab, "quantile": q},
+                          help_="per-tenant end-to-end latency "
+                                "quantiles (seconds)")
+            for g, v in sorted(tsnap.get("gauges", {}).items()):
+                L.add(f"paddle_tenant_{g}", v, labels=tlab,
+                      help_="per-tenant gauge (e.g. budget_remaining "
+                            "tokens)")
     if queue_depth is not None:
         L.add("paddle_serving_queue_depth", queue_depth)
 

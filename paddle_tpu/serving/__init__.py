@@ -49,6 +49,15 @@ iteration-level ("continuous") batching in the Orca lineage:
   steers each request to the replica holding the longest live prefix
   match (kvstore.py, FLAGS_serving_kv_spill_dir,
   FLAGS_serving_prefix_affinity);
+- `TenantDirectory` / `TenantFairQueue` / `ArtifactCatalog` /
+  `AdapterRollout` — the multi-tenant platform: batched LoRA adapter
+  banks inside the one compiled decode step (``submit(...,
+  adapter_id=k)``, hot-swapped with zero retraces through the
+  rollout-commit path), a catalog of named (model, adapter, version)
+  artifacts with sha256 manifests, weighted-fair (deficit round
+  robin) per-tenant admission with token budgets, SLO classes, and
+  tier-based brownout shedding (tenancy.py, queueing.py,
+  FLAGS_serving_max_adapters, FLAGS_tenant_default_budget);
 - `Scenario` / `Arrival` / `replay` — the seeded open-loop traffic
   simulator every serving bench replays (workload.py);
 - `Server` / `http_front` — the user-facing shell (server.py);
@@ -79,7 +88,8 @@ from .queueing import (  # noqa: F401
     AdmissionQueue, BrownoutShedError, CapacityExhaustedError,
     DeadlineExceededError, QueueFullError, ReplicaDiedError, Request,
     RequestCancelled, RetriesExhaustedError, ServerClosedError,
-    ServingError, VersionRetiredError,
+    ServingError, TenantBudgetError, TenantFairQueue,
+    VersionRetiredError,
 )
 from .rollout import (  # noqa: F401
     RolloutController, RolloutError, RolloutGateError, WeightRegistry,
@@ -87,6 +97,10 @@ from .rollout import (  # noqa: F401
 )
 from .autoscale import SLOWindow  # noqa: F401
 from .server import Server, http_front  # noqa: F401
+from .tenancy import (  # noqa: F401
+    DEFAULT_TENANT, AdapterRollout, Artifact, ArtifactCatalog,
+    TenantDirectory, TenantSpec,
+)
 from .sharding import (  # noqa: F401
     GPT_PARTITION_RULES, ShardingPlan, build_mesh, match_partition_rules,
     mesh_spec_of, parse_mesh_spec, resolve_mesh,
@@ -94,9 +108,11 @@ from .sharding import (  # noqa: F401
 from .workload import Arrival, Scenario, replay  # noqa: F401
 
 __all__ = [
-    "AdmissionQueue", "Arrival", "Autoscaler", "BlockAllocator",
+    "AdapterRollout", "AdmissionQueue", "Arrival", "Artifact",
+    "ArtifactCatalog", "Autoscaler", "BlockAllocator",
     "BrownoutShedError",
-    "CapacityExhaustedError", "CircuitBreaker", "DeadlineExceededError",
+    "CapacityExhaustedError", "CircuitBreaker", "DEFAULT_TENANT",
+    "DeadlineExceededError",
     "DynamicBatcher", "GPT_PARTITION_RULES", "KVMailbox", "KVSpillStore",
     "NULL_BLOCK",
     "PoolExhausted", "PrefixCache",
@@ -105,8 +121,9 @@ __all__ = [
     "RolloutController", "RolloutError", "RolloutGateError", "Router",
     "SLOWindow", "Scenario", "Server", "ServerClosedError",
     "ServingError", "ServingMetrics", "ShardingPlan", "SlotEngine",
-    "SpillFencedError", "VersionRetiredError", "WeightRegistry",
-    "WeightVersion",
+    "SpillFencedError", "TenantBudgetError", "TenantDirectory",
+    "TenantFairQueue", "TenantSpec", "VersionRetiredError",
+    "WeightRegistry", "WeightVersion",
     "bucket_for", "bucket_ladder", "build_mesh", "golden_digests",
     "http_front", "match_partition_rules", "mesh_spec_of",
     "migrate_prefix", "open_spill_store",

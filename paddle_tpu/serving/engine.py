@@ -90,7 +90,10 @@ with no lost or duplicated tokens); ``serving.verify`` before each
 speculative verify dispatch (raise = step error, fails in-flight
 requests like serving.step); ``serving.dequant`` once per step on an
 int8-frozen engine; ``serving.kv_restore`` before each spilled-block
-restore (raise = restore abort, leak-free, the request re-prefills).
+restore (raise = restore abort, leak-free, the request re-prefills);
+``serving.adapter_swap`` before each adapter-bank hot-swap mutates
+anything (raise = all-or-nothing abort, the OLD adapter bank keeps
+serving bitwise).
 Supervised (fleet-owned) engines additionally
 fire ``serving.replica_heartbeat`` every loop iteration and
 ``serving.replica_step`` before each decode step, both tagged with the
@@ -204,7 +207,8 @@ class SlotEngine:
                  queue=None, strict_shapes=False, name=None,
                  supervised=False, values=None, weight_version=0,
                  draft_model=None, spec_len=None, quantize=None,
-                 w8a8=None, mesh=None, spill_dir=None):
+                 w8a8=None, mesh=None, spill_dir=None,
+                 max_adapters=None, lora_rank=None):
         import jax
         import jax.numpy as jnp
 
@@ -306,6 +310,34 @@ class SlotEngine:
         if self.w8a8:
             self.metrics.set_gauge("w8a8_path", 1.0)
         cfg = model.config
+        # batched LoRA adapters (ISSUE 20): stacked [n, r, H] / [n, V, r]
+        # A/B banks ride the compiled step as swappable jit ARGUMENTS;
+        # each slot carries an adapter_id (row 0 = base model, all-zero)
+        # and the head's logits pick up a gathered low-rank delta inside
+        # the ONE trace — compile counters stay {decode: 1, cow: 1} and
+        # banks hot-swap with zero retraces (fixed shapes)
+        if max_adapters is None:
+            max_adapters = flag("FLAGS_serving_max_adapters")
+        self.max_adapters = int(max_adapters or 0)
+        if lora_rank is None:
+            lora_rank = flag("FLAGS_serving_lora_rank")
+        self.lora_rank = int(lora_rank)
+        self.adapter_version = 0
+        if self.max_adapters:
+            if self.lora_rank < 1:
+                raise ValueError(
+                    f"lora_rank must be >= 1, got {self.lora_rank}")
+            self._lora_a = jnp.zeros(
+                (self.max_adapters, self.lora_rank, cfg.hidden_size),
+                jnp.float32)
+            self._lora_b = jnp.zeros(
+                (self.max_adapters, cfg.vocab_size, self.lora_rank),
+                jnp.float32)
+            self.metrics.set_gauge("max_adapters",
+                                   float(self.max_adapters))
+        else:
+            self._lora_a = None
+            self._lora_b = None
         hd = cfg.hidden_size // cfg.num_heads
         dtype = cache_dtype or jnp.float32
         shape = (self.num_blocks, cfg.num_heads, self.block_size, hd)
@@ -342,6 +374,9 @@ class SlotEngine:
         self.prefix_hit_tokens = 0
         self.prefix_prompt_tokens = 0
         self._pos = np.zeros((self.max_slots,), np.int32)
+        # per-slot adapter row (0 = base model); a jit argument of the
+        # one compiled step, so changing it never retraces
+        self._aid = np.zeros((self.max_slots,), np.int32)
         self._bt = np.full((self.max_slots, self.blocks_per_slot),
                            NULL_BLOCK, np.int32)
         self._slots: list = [None] * self.max_slots
@@ -356,6 +391,10 @@ class SlotEngine:
         # so pool rebinds never race the compiled step's own updates
         self._migrate_q: list = []
         self._migrate_lock = threading.Lock()
+        # adapter-bank hot-swaps land at step boundaries too (same
+        # enqueue/drain contract as KV adoption), so a swap never races
+        # the compiled step's reads
+        self._adapter_q: list = []
 
         def _count(key):
             self._compiles[key] = self._compiles.get(key, 0) + 1
@@ -397,7 +436,7 @@ class SlotEngine:
             return (out[:, 0, :] if squeeze else out).astype(jnp.float32)
 
         def step_fn(values, tok, pos, nvalid, tables, ks, vs,
-                    act_scale=None):
+                    act_scale=None, aid=None, la=None, lb=None):
             # trace-time only: the compile counter + retrace registry
             _count("decode")
             observe.record_compile(
@@ -426,13 +465,25 @@ class SlotEngine:
                 # w8a8 calibration: this step's head-input abs-max
                 # rides the outputs so the host can fold it into the
                 # frozen activation scale without an extra device pass
+                # (taken BEFORE any adapter delta — the scale calibrates
+                # the shared trunk, not one tenant's adapter)
                 amax = jnp.max(jnp.abs(last.astype(jnp.float32))) \
                     if act_scale is not None else None
+                if la is not None:
+                    # batched LoRA head delta: gather each slot's
+                    # adapter row by index inside the trace; row 0 is
+                    # all-zero so base-model slots add exactly 0.0
+                    from ..nlp.transformers.gpt import lora_logits_delta
+
+                    lv = lv + lora_logits_delta(last, aid, la, lb)
                 if self.spec_len:
                     # speculative verify: the first k+1 chunk columns
                     # ([next, d_1..d_k]) all feed accept/reject
                     sv = _head(m, values, hv[:, :self.spec_len + 1],
                                act_scale)
+                    if la is not None:
+                        sv = sv + lora_logits_delta(
+                            hv[:, :self.spec_len + 1], aid, la, lb)
                     return (lv, sv, amax), new_caches
                 return (lv, lv, amax), new_caches
 
@@ -478,6 +529,13 @@ class SlotEngine:
                 step_out = (rep, rep, pools, pools) if self.spec_len \
                     else (rep, pools, pools)
                 step_in = (vsh, rep, rep, rep, rep, pools, pools)
+                if self.max_adapters:
+                    # explicit act_scale=None slot (an empty pytree:
+                    # the leaf sharding applies to zero leaves)
+                    step_in = step_in + (rep,)
+            if self.max_adapters:
+                # per-slot adapter ids + replicated A/B banks
+                step_in = step_in + (rep, rep, rep)
             self._decode = jax.jit(
                 step_fn,
                 in_shardings=step_in,
@@ -629,6 +687,98 @@ class SlotEngine:
         if self._act_calib > self._W8A8_CALIB_STEPS:
             self._act_frozen = True
 
+    # -- batched adapter bank (ISSUE 20) ------------------------------------
+
+    def _dispatch_decode(self, tok, pos, nvalid):
+        """The ONE argument arity for the compiled decode step: every
+        call site (warmup, plain step, speculative verify) builds its
+        positional list here, so jax.jit sees exactly one signature per
+        engine configuration — the compile-once invariant survives any
+        mix of the w8a8 and adapter options."""
+        import jax.numpy as jnp
+
+        args = [self._values, jnp.asarray(tok), jnp.asarray(pos),
+                jnp.asarray(nvalid), jnp.asarray(self._bt), self._ks,
+                self._vs]
+        if self.w8a8:
+            args.append(self._act_arg())
+        elif self.max_adapters:
+            args.append(None)   # act_scale slot stays positional
+        if self.max_adapters:
+            args.extend((jnp.asarray(self._aid), self._lora_a,
+                         self._lora_b))
+        return self._decode(*args)
+
+    def swap_adapters(self, lora_a, lora_b, version=None, timeout=5.0):
+        """Hot-swap the stacked adapter bank (the rollout commit path).
+        Applied at a step boundary when the serve loop is running (the
+        bank rebind must not race the compiled step's reads), inline
+        otherwise. All-or-nothing: a fault (``serving.adapter_swap``) or
+        validation error leaves the OLD bank serving bitwise. Shapes
+        are fixed by construction, so a swap never retraces. Returns
+        the new adapter_version."""
+        if not self.max_adapters:
+            raise ValueError(
+                "engine built without adapters (max_adapters=0 / "
+                "FLAGS_serving_max_adapters)")
+        if self._thread is not None and self._thread.is_alive():
+            done = threading.Event()
+            box: dict = {}
+            with self._migrate_lock:
+                self._adapter_q.append((lora_a, lora_b, version, done,
+                                        box))
+            if not done.wait(timeout):
+                raise TimeoutError(
+                    f"engine {self.name!r} did not reach a step boundary "
+                    f"within {timeout:.3f}s to swap adapters")
+            if "error" in box:
+                raise box["error"]
+            return box["version"]
+        return self._apply_adapter_swap(lora_a, lora_b, version)
+
+    def _drain_adapter_swaps(self):
+        while True:
+            with self._migrate_lock:
+                if not self._adapter_q:
+                    return
+                la, lb, version, done, box = self._adapter_q.pop(0)
+            try:
+                box["version"] = self._apply_adapter_swap(la, lb,
+                                                          version)
+            except Exception as e:  # noqa: BLE001 — caller re-raises
+                box["error"] = e
+            finally:
+                done.set()
+
+    def _apply_adapter_swap(self, lora_a, lora_b, version):
+        import jax.numpy as jnp
+
+        # the fault fires BEFORE any mutation: a faulted swap leaves
+        # the old adapter bank serving bitwise
+        faults.fault_point("serving.adapter_swap", tag=self.name)
+        la = jnp.asarray(lora_a, jnp.float32)
+        lb = jnp.asarray(lora_b, jnp.float32)
+        if la.shape != self._lora_a.shape or \
+                lb.shape != self._lora_b.shape:
+            raise ValueError(
+                f"adapter bank shapes {la.shape}/{lb.shape} != engine "
+                f"{self._lora_a.shape}/{self._lora_b.shape}: rebuild "
+                "the engine to change adapter capacity or rank")
+        if np.asarray(la[0]).any() or np.asarray(lb[0]).any():
+            raise ValueError(
+                "adapter row 0 is the base model and must stay all-zero")
+        if self._plan is not None:
+            import jax
+
+            rep = self._plan.replicated()
+            la = jax.device_put(la, rep)
+            lb = jax.device_put(lb, rep)
+        self._lora_a, self._lora_b = la, lb
+        self.adapter_version = int(version) if version is not None \
+            else self.adapter_version + 1
+        self.metrics.inc("adapter_swaps")
+        return self.adapter_version
+
     # -- warmup -------------------------------------------------------------
 
     def warmup(self, mesh=None):
@@ -664,13 +814,10 @@ class SlotEngine:
             pos = jnp.zeros((self.max_slots,), jnp.int32)
             nvalid = jnp.ones((self.max_slots,), jnp.int32)
             if self.w8a8:
-                out = self._decode(self._values, tok, pos, nvalid,
-                                   jnp.asarray(self._bt), self._ks,
-                                   self._vs, self._act_arg())
+                out = self._dispatch_decode(tok, pos, nvalid)
                 self._absorb_act_amax(out[2 if self.spec_len else 1])
             else:
-                self._decode(self._values, tok, pos, nvalid,
-                             jnp.asarray(self._bt), self._ks, self._vs)
+                self._dispatch_decode(tok, pos, nvalid)
             self._cow(self._ks, self._vs, jnp.int32(NULL_BLOCK),
                       jnp.int32(NULL_BLOCK))
             if self.spec_len:
@@ -685,7 +832,7 @@ class SlotEngine:
 
     def submit(self, prompt_ids, *, max_new_tokens=16, eos_token_id=None,
                timeout=None, priority=0, do_sample=False, temperature=1.0,
-               top_k=0, seed=0):
+               top_k=0, seed=0, adapter_id=0, tenant=None):
         """Admit one request (or shed); returns its `Request` future.
 
         Length beyond the model's positional range is a hard
@@ -695,6 +842,11 @@ class SlotEngine:
         not slot count, is the admission limit."""
         if timeout is None:
             timeout = flag("FLAGS_serving_default_timeout_s") or None
+        adapter_id = int(adapter_id or 0)
+        if adapter_id < 0 or adapter_id >= max(self.max_adapters, 1):
+            raise ValueError(
+                f"adapter_id {adapter_id} outside the engine's bank "
+                f"(max_adapters={self.max_adapters}; 0 = base model)")
         ids = np.asarray(prompt_ids, np.int32).reshape(-1)
         if ids.size == 0:
             raise ValueError("empty prompt")
@@ -714,7 +866,7 @@ class SlotEngine:
             ids, timeout=timeout, priority=priority,
             max_new_tokens=max_new_tokens, eos_token_id=eos_token_id,
             do_sample=do_sample, temperature=temperature, top_k=top_k,
-            seed=seed))
+            seed=seed, adapter_id=adapter_id, tenant=tenant))
 
     def _stage_blocks(self, ids, need_total):
         """Reserve the physical blocks for one admission: reuse every
@@ -815,6 +967,7 @@ class SlotEngine:
             self._bt[slot, :] = NULL_BLOCK
             self._bt[slot, :len(blocks)] = blocks
             self._pos[slot] = fill
+            self._aid[slot] = int(req.gen.get("adapter_id", 0) or 0)
             self._slots[slot] = _Slot(req, ids, fill, blocks)
             self.metrics.inc("admitted")
             self.metrics.observe_latency(
@@ -1087,13 +1240,23 @@ class SlotEngine:
             self._alloc.decref(bid)
         self._bt[idx, :] = NULL_BLOCK
         self._pos[idx] = 0
+        self._aid[idx] = 0
+        tenant = slot.req.gen.get("tenant")
         if error is not None:
             self.metrics.inc("failed")
+            if tenant:
+                self.metrics.tenant_inc(tenant, "failed")
             slot.req._fail(error)
         else:
             self.metrics.inc("completed")
             self.metrics.observe_latency(
                 "e2e", time.monotonic() - slot.req.arrival)
+            if tenant:
+                self.metrics.tenant_inc(tenant, "completed")
+                self.metrics.tenant_inc(tenant, "tokens_out",
+                                        slot.produced)
+                self.metrics.tenant_observe_latency(
+                    tenant, time.monotonic() - slot.req.arrival)
             slot.req._complete(np.asarray(slot.tokens, np.int32))
 
     def _fail_all_active(self, error):
@@ -1150,17 +1313,12 @@ class SlotEngine:
         with profiler.RecordEvent("serving.step", cat="serving"):
             with observe.phase("device-step", cat="serving"):
                 if self.w8a8:
-                    logits, amax, self._ks, self._vs = self._decode(
-                        self._values, jnp.asarray(tok),
-                        jnp.asarray(self._pos), jnp.asarray(nvalid),
-                        jnp.asarray(self._bt), self._ks, self._vs,
-                        self._act_arg())
+                    logits, amax, self._ks, self._vs = \
+                        self._dispatch_decode(tok, self._pos, nvalid)
                     self._absorb_act_amax(amax)
                 else:
-                    logits, self._ks, self._vs = self._decode(
-                        self._values, jnp.asarray(tok),
-                        jnp.asarray(self._pos), jnp.asarray(nvalid),
-                        jnp.asarray(self._bt), self._ks, self._vs)
+                    logits, self._ks, self._vs = \
+                        self._dispatch_decode(tok, self._pos, nvalid)
         logits = np.asarray(logits)
         self._observe_step_latency(time.monotonic() - t0,
                                    prefill_tokens, len(live) - n_pref)
@@ -1295,17 +1453,12 @@ class SlotEngine:
         with profiler.RecordEvent("serving.step", cat="serving"):
             with observe.phase("device-step", cat="serving"):
                 if self.w8a8:
-                    lv, sv, amax, self._ks, self._vs = self._decode(
-                        self._values, jnp.asarray(tok),
-                        jnp.asarray(self._pos), jnp.asarray(nvalid),
-                        jnp.asarray(self._bt), self._ks, self._vs,
-                        self._act_arg())
+                    lv, sv, amax, self._ks, self._vs = \
+                        self._dispatch_decode(tok, self._pos, nvalid)
                     self._absorb_act_amax(amax)
                 else:
-                    lv, sv, self._ks, self._vs = self._decode(
-                        self._values, jnp.asarray(tok),
-                        jnp.asarray(self._pos), jnp.asarray(nvalid),
-                        jnp.asarray(self._bt), self._ks, self._vs)
+                    lv, sv, self._ks, self._vs = \
+                        self._dispatch_decode(tok, self._pos, nvalid)
         lv = np.asarray(lv)
         sv = np.asarray(sv)
         self._observe_step_latency(time.monotonic() - t0,
@@ -1565,6 +1718,7 @@ class SlotEngine:
             while True:
                 self._beat()
                 self._drain_adoptions()
+                self._drain_adapter_swaps()
                 if self._abort.is_set():
                     self._fail_all_active(
                         self._abort_error or RequestCancelled(

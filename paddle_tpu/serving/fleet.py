@@ -120,7 +120,7 @@ from .paging import PrefixCache
 from .queueing import (
     AdmissionQueue, BrownoutShedError, DeadlineExceededError, Request,
     RequestCancelled, ReplicaDiedError, RetriesExhaustedError, ServerClosedError,
-    ServingError, VersionRetiredError,
+    ServingError, TenantBudgetError, TenantFairQueue, VersionRetiredError,
 )
 
 __all__ = ["CircuitBreaker", "Replica", "ReplicaSet", "Router", "retriable",
@@ -140,6 +140,11 @@ def retriable(error):
     are; everything else consults the error's own `retriable` attr
     (see queueing.ServingError)."""
     if isinstance(error, (RequestCancelled, DeadlineExceededError)):
+        return False
+    if isinstance(error, TenantBudgetError):
+        # the token bucket is the TENANT's, shared by every replica —
+        # a retry elsewhere re-debits the same bucket and still fails;
+        # surface the 429 + Retry-After to the client instead
         return False
     if isinstance(error, faults.FaultError):
         return True
@@ -324,7 +329,7 @@ class ReplicaSet:
                  backoff_max_s=2.0, breaker_threshold=5,
                  breaker_cooloff_s=1.0, breaker_clock=time.monotonic,
                  queue_cap=None, warmup=True, name="fleet", on_death=None,
-                 roles=None, role_kw=None):
+                 roles=None, role_kw=None, tenancy=None):
         if n_replicas < 1:
             raise ValueError(f"n_replicas must be >= 1, got {n_replicas}")
         self.model = model
@@ -339,6 +344,10 @@ class ReplicaSet:
         self.roles = list(roles or [])
         self.role_kw = dict(role_kw or {})
         self.queue_cap = queue_cap or flag("FLAGS_serving_queue_cap")
+        # multi-tenant admission (ISSUE 20): with a TenantDirectory
+        # attached, every replica builds a TenantFairQueue (weighted
+        # fair queueing + per-tenant budgets) instead of the plain FIFO
+        self.tenancy = tenancy
         self.liveness_timeout_s = liveness_timeout_s
         self.backoff_base_s = backoff_base_s
         self.backoff_max_s = backoff_max_s
@@ -387,7 +396,12 @@ class ReplicaSet:
             if wv is not None:
                 replica.target_weights = wv
                 replica.rebuild_to = None
-            q = AdmissionQueue(self.queue_cap, metrics=self.metrics)
+            if self.tenancy is not None:
+                q = TenantFairQueue(self.queue_cap,
+                                    tenancy=self.tenancy,
+                                    metrics=self.metrics)
+            else:
+                q = AdmissionQueue(self.queue_cap, metrics=self.metrics)
             kw = dict(self.engine_kw)
             kw.update(self.role_kw.get(replica.role, {}))
             eng = SlotEngine(self.model, metrics=self.metrics, queue=q,
@@ -808,10 +822,15 @@ class Router:
                  backoff_base_s=0.05, backoff_max_s=2.0,
                  queue_cap=None, warmup=True, name="fleet",
                  autoscale=None, roles=None, role_kw=None, disagg=None,
-                 migrate_deadline_s=5.0, prefix_affinity=None):
+                 migrate_deadline_s=5.0, prefix_affinity=None,
+                 tenancy=None):
         from .migrate import KVMailbox
 
         self.metrics = metrics if metrics is not None else ServingMetrics()
+        # multi-tenant mode (ISSUE 20): a TenantDirectory turns the
+        # replica queues into weighted-fair TenantFairQueues and switches
+        # brownout from a global priority floor to tier-based shedding
+        self.tenancy = tenancy
         self.replica_set = ReplicaSet(
             model, replicas, engine_kw=engine_kw, metrics=self.metrics,
             liveness_timeout_s=liveness_timeout_s,
@@ -820,7 +839,7 @@ class Router:
             breaker_cooloff_s=breaker_cooloff_s,
             breaker_clock=breaker_clock, queue_cap=queue_cap,
             warmup=warmup, name=name, on_death=self._on_replica_death,
-            roles=roles, role_kw=role_kw)
+            roles=roles, role_kw=role_kw, tenancy=tenancy)
         self.name = name
         # disaggregated prefill/decode (ISSUE 17): the Router sends each
         # request's prefill to a prefill-role replica, migrates the
@@ -927,12 +946,13 @@ class Router:
 
     def submit(self, prompt_ids, *, max_new_tokens=16, eos_token_id=None,
                timeout=None, priority=0, do_sample=False, temperature=1.0,
-               top_k=0, seed=0):
+               top_k=0, seed=0, adapter_id=0, tenant=None):
         """Route one request; returns its first-wins `Request` future.
 
         Client errors (empty/over-long prompt) raise synchronously;
         brownout sheds below-floor priorities with `BrownoutShedError`
-        (429, retriable). Everything downstream — replica choice,
+        (429, retriable) — or, when a `TenantDirectory` is attached,
+        below-tier tenants. Everything downstream — replica choice,
         retries, failover, hedging — is the Router's problem."""
         import numpy as np
 
@@ -947,7 +967,20 @@ class Router:
             raise ValueError(
                 f"prompt ({ids.size}) + max_new_tokens ({max_new_tokens}) "
                 f"exceeds fleet max_seq_len {self._max_seq_len}")
-        if self.brownout_active and priority < self._brownout_priority:
+        if self.tenancy is not None:
+            spec = self.tenancy.resolve(tenant)
+            tenant = spec.name
+            if priority == 0:
+                priority = spec.priority
+            if self.brownout_active and spec.tier < self.tenancy.brownout_tier:
+                self.metrics.inc("brownout_sheds")
+                if hasattr(self.metrics, "tenant_inc"):
+                    self.metrics.tenant_inc(spec.name, "shed")
+                raise BrownoutShedError(
+                    f"request shed: fleet in brownout, tenant "
+                    f"{spec.name!r} tier {spec.tier} below floor "
+                    f"{self.tenancy.brownout_tier}")
+        elif self.brownout_active and priority < self._brownout_priority:
             self.metrics.inc("brownout_sheds")
             raise BrownoutShedError(
                 f"request shed: fleet in brownout, priority {priority} "
@@ -955,7 +988,8 @@ class Router:
         client = Request(ids, timeout=timeout, priority=priority,
                          max_new_tokens=max_new_tokens,
                          eos_token_id=eos_token_id, do_sample=do_sample,
-                         temperature=temperature, top_k=top_k, seed=seed)
+                         temperature=temperature, top_k=top_k, seed=seed,
+                         adapter_id=adapter_id, tenant=tenant)
         self.metrics.inc("fleet_submitted")
         flight = _Flight(client, self.retry_budget, self.replay_budget)
         if self._affinity_on and self._block_size:

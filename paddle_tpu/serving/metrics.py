@@ -58,6 +58,10 @@ class ServingMetrics:
     / `kv_spill_bytes` / `kv_restore_corrupt` / `kv_restore_fenced` /
     `kv_spill_errors`, surfaced under snapshot()["kvstore"], and the
     prefix-affinity Router adds `affinity_hits` / `affinity_faults`.
+    Multi-tenant serving bills per-tenant counters/latency/gauges via
+    `tenant_inc` / `tenant_observe_latency` / `tenant_set_gauge`,
+    surfaced under snapshot()["tenants"] and the paddle_tenant_*
+    Prometheus families (qps, tokens, shed, p50/p95/p99, budget).
     Every inc() also bumps the global `framework.monitor` counter
     ``serving.<name>`` so serving shows up in the same stat registry as
     the rest of the runtime.
@@ -78,6 +82,12 @@ class ServingMetrics:
         self._blk_max = 0.0
         self._gauges: dict = {}       # name -> float (last-write-wins)
         self._spec_slots: dict = {}   # slot -> [drafted, accepted]
+        # per-tenant accounting (ISSUE 20): tenant name ->
+        # {"counters": {...}, "latency": [s], "gauges": {...}} — fed by
+        # tenant_inc/tenant_observe_latency/tenant_set_gauge, surfaced
+        # under snapshot()["tenants"] and the paddle_tenant_* Prometheus
+        # families. Created lazily; absent in single-tenant serving.
+        self._tenants: dict = {}
         self._started = time.monotonic()
 
     def set_gauge(self, name, value):
@@ -116,6 +126,57 @@ class ServingMetrics:
         with self._lock:
             self._counters[name] = self._counters.get(name, 0) + n
         monitor.stat_add(f"serving.{name}", n)
+
+    # -- per-tenant accounting (ISSUE 20) -----------------------------------
+
+    def _tenant_cell(self, tenant):
+        # caller holds self._lock
+        cell = self._tenants.get(tenant)
+        if cell is None:
+            cell = {"counters": {}, "latency": [], "gauges": {}}
+            self._tenants[str(tenant)] = cell
+        return cell
+
+    def tenant_inc(self, tenant, name, n=1):
+        """Bump one tenant-scoped counter (`submitted`, `accepted`,
+        `shed`, `completed`, `failed`, `tokens_out`, ...)."""
+        if tenant is None:
+            return
+        with self._lock:
+            c = self._tenant_cell(tenant)["counters"]
+            c[name] = c.get(name, 0) + n
+        monitor.stat_add(f"serving.tenant.{tenant}.{name}", n)
+
+    def tenant_observe_latency(self, tenant, seconds):
+        """One end-to-end latency sample billed to `tenant`."""
+        if tenant is None:
+            return
+        with self._lock:
+            series = self._tenant_cell(tenant)["latency"]
+            series.append(float(seconds))
+            if len(series) > _MAX_SAMPLES:
+                del series[:len(series) - _MAX_SAMPLES]
+
+    def tenant_set_gauge(self, tenant, name, value):
+        """Last-write-wins tenant-scoped scalar (e.g. remaining token
+        budget)."""
+        if tenant is None:
+            return
+        with self._lock:
+            self._tenant_cell(tenant)["gauges"][name] = float(value)
+
+    def tenant_get(self, tenant, name):
+        with self._lock:
+            cell = self._tenants.get(tenant)
+            return cell["counters"].get(name, 0) if cell else 0
+
+    def tenant_latency_percentiles(self, tenant, ps=(50, 95, 99)):
+        with self._lock:
+            cell = self._tenants.get(tenant)
+            series = list(cell["latency"]) if cell else []
+        if not series:
+            return {p: None for p in ps}
+        return {p: percentile(series, p) for p in ps}
 
     def get(self, name):
         with self._lock:
@@ -256,6 +317,32 @@ class ServingMetrics:
                 "kv_migrate_bytes": counters.get("kv_migrate_bytes", 0),
                 "kv_migrate_faults": counters.get("kv_migrate_faults", 0),
             }
+        with self._lock:
+            tenants = {
+                t: {"counters": dict(c["counters"]),
+                    "latency": list(c["latency"]),
+                    "gauges": dict(c["gauges"])}
+                for t, c in self._tenants.items()}
+        if tenants:
+            snap["tenants"] = {}
+            for t in sorted(tenants):
+                cell = tenants[t]
+                c, series = cell["counters"], cell["latency"]
+                entry = {
+                    "counters": c,
+                    "qps": c.get("completed", 0) / elapsed,
+                    "tokens_per_s": c.get("tokens_out", 0) / elapsed,
+                    "gauges": cell["gauges"],
+                }
+                if series:
+                    entry["latency_s"] = {
+                        "count": len(series),
+                        "p50": percentile(series, 50),
+                        "p95": percentile(series, 95),
+                        "p99": percentile(series, 99),
+                        "max": max(series),
+                    }
+                snap["tenants"][t] = entry
         if queue_depth is not None:
             snap["queue_depth"] = queue_depth
         for kind, series in latency.items():

@@ -11,6 +11,18 @@ Fault sites (framework/faults.py grammar): ``serving.submit`` fires on
 every admission attempt (a `drop` action sheds the request exactly as a
 full queue would — deterministic overload), ``serving.dequeue`` on every
 pop by the batch assembler / decode engine.
+
+Multi-tenant admission (ISSUE 20): `TenantFairQueue` keeps the same
+submit/pop/requeue contract but runs deficit-round-robin weighted fair
+queueing over per-tenant FIFOs — each scheduler visit credits a tenant
+``quantum * weight`` tokens of deficit and serves its head while the
+deficit covers the head's cost (prompt + max_new tokens), so a flash
+crowd from one tenant cannot starve another's share. Per-tenant
+token-bucket budgets shed over-budget submissions with the retriable
+`TenantBudgetError` whose ``retry_after_s`` is derived from the
+bucket's refill; fault site ``serving.admit_tenant`` fires per
+admission decision (tagged with the tenant, ``drop`` = deterministic
+budget shed).
 """
 
 from __future__ import annotations
@@ -21,12 +33,13 @@ import time
 from collections import deque
 
 from ..framework import faults, monitor
+from ..framework.flags import flag
 
 __all__ = [
     "ServingError", "QueueFullError", "CapacityExhaustedError",
     "ServerClosedError", "DeadlineExceededError", "RequestCancelled",
     "ReplicaDiedError", "RetriesExhaustedError", "BrownoutShedError",
-    "Request", "AdmissionQueue",
+    "TenantBudgetError", "Request", "AdmissionQueue", "TenantFairQueue",
 ]
 
 
@@ -117,6 +130,18 @@ class RetriesExhaustedError(ServingError):
 class BrownoutShedError(QueueFullError):
     """Shed by fleet brownout: under sustained overload, requests below
     the priority floor are rejected first (429, retriable)."""
+
+
+class TenantBudgetError(QueueFullError):
+    """Shed by per-tenant admission: the tenant's token-bucket budget
+    is exhausted (429, retriable). ``retry_after_s`` is set per
+    instance from the bucket's refill rate, so the HTTP front's
+    ``Retry-After`` header tells the client exactly when the budget
+    next covers a request."""
+
+    def __init__(self, message, retry_after_s=1.0):
+        super().__init__(message)
+        self.retry_after_s = max(float(retry_after_s), 0.001)
 
 
 _ids = itertools.count(1)
@@ -380,6 +405,248 @@ class AdmissionQueue:
             if not drain:
                 while self._items:
                     dropped.append(self._items.popleft())
+            self._cond.notify_all()
+        for req in dropped:
+            self._count("rejected_closed")
+            req._fail(ServerClosedError(
+                f"request {req.id} dropped: non-drain shutdown"))
+
+
+class TenantFairQueue(AdmissionQueue):
+    """Weighted-fair admission over per-tenant FIFOs (ISSUE 20).
+
+    Same external contract as `AdmissionQueue` — submit admits or sheds
+    without blocking, pop fails expired/cancelled entries outside the
+    lock, requeue preserves head-of-line order, close/drained drive the
+    engine's exit — but the pop order is deficit-round-robin: each
+    arrival at a tenant's queue credits ``quantum * weight`` tokens of
+    deficit, and the queue keeps serving while the deficit covers its
+    head's cost (prompt + max_new tokens). A tenant that floods only
+    drains its own share; everyone else's heads keep flowing at their
+    weighted rate.
+
+    With a `TenantDirectory` attached (``tenancy=``), each submission
+    first debits the tenant's token-bucket budget — an over-budget
+    request sheds with `TenantBudgetError` carrying the exact refill
+    wait as ``retry_after_s``. Fault site ``serving.admit_tenant``
+    fires per admission decision (tag = tenant name; ``drop`` = shed
+    with the same typed 429)."""
+
+    def __init__(self, cap, *, tenancy=None, quantum=None, metrics=None):
+        super().__init__(cap, metrics=metrics)
+        self.tenancy = tenancy
+        self.quantum = int(quantum or flag("FLAGS_tenant_wfq_quantum"))
+        self._queues: dict = {}      # tenant -> deque of Requests
+        self._deficit: dict = {}     # tenant -> DRR token deficit
+        self._rr: deque = deque()    # tenant rotation order
+        self._head: deque = deque()  # requeued items: served first
+        self._front_credited = False
+        self._size = 0
+
+    @staticmethod
+    def _cost(request):
+        """DRR cost of one request in tokens: prompt + decode budget —
+        the same unit the tenant token-bucket debits."""
+        payload = request.payload
+        n = getattr(payload, "size", None)
+        if n is None:
+            n = len(payload) if hasattr(payload, "__len__") else 1
+        return float(int(n) + int(request.gen.get("max_new_tokens", 16)))
+
+    def _weight(self, tenant):
+        if self.tenancy is None:
+            return 1.0
+        return max(float(self.tenancy.resolve(tenant).weight), 1e-3)
+
+    def _tenant_inc(self, tenant, name, n=1):
+        if self._metrics is not None and \
+                hasattr(self._metrics, "tenant_inc"):
+            self._metrics.tenant_inc(tenant, name, n)
+
+    @property
+    def depth(self):
+        with self._cond:
+            return self._size
+
+    def tenant_depths(self):
+        """Per-tenant backlog snapshot {tenant: queued} (requeued
+        head-of-line items count against their own tenant)."""
+        with self._cond:
+            out = {t: len(q) for t, q in self._queues.items() if q}
+            for req in self._head:
+                t = req.gen.get("tenant") or "default"
+                out[t] = out.get(t, 0) + 1
+            return out
+
+    def drained(self):
+        with self._cond:
+            return self._closed and not self._size
+
+    def submit(self, request: Request):
+        """Admit or shed. Budget debit -> ``serving.admit_tenant`` ->
+        enqueue on the tenant's FIFO. Returns `request` for chaining."""
+        self._count("submitted")
+        tenant = request.gen.get("tenant") or "default"
+        if faults.fault_point("serving.submit", request) is faults.DROP:
+            self._count("rejected_queue_full")
+            raise QueueFullError(
+                f"request {request.id} shed (injected overload)")
+        wait_hint = 1.0
+        if self.tenancy is not None:
+            spec = self.tenancy.resolve(tenant)
+            ok, wait = spec.try_debit(self._cost(request))
+            wait_hint = wait or wait_hint
+            if not ok:
+                self._count("rejected_budget")
+                self._tenant_inc(tenant, "shed")
+                raise TenantBudgetError(
+                    f"request {request.id} shed: tenant {tenant!r} over "
+                    f"token budget (refill in {wait:.3f}s)",
+                    retry_after_s=wait)
+        if faults.fault_point("serving.admit_tenant", request,
+                              tag=tenant) is faults.DROP:
+            self._count("rejected_budget")
+            self._tenant_inc(tenant, "shed")
+            raise TenantBudgetError(
+                f"request {request.id} shed (injected tenant overload "
+                f"for {tenant!r})", retry_after_s=wait_hint)
+        with self._cond:
+            if self._closed:
+                self._count("rejected_closed")
+                raise ServerClosedError(
+                    f"request {request.id} rejected: server shutting down")
+            if self._size >= self.cap:
+                self._count("rejected_queue_full")
+                self._tenant_inc(tenant, "shed")
+                raise QueueFullError(
+                    f"request {request.id} rejected: queue at capacity "
+                    f"{self.cap}")
+            q = self._queues.get(tenant)
+            if q is None:
+                q = self._queues[tenant] = deque()
+                self._deficit[tenant] = 0.0
+                self._rr.append(tenant)
+            q.append(request)
+            self._size += 1
+            request._wake = self._notify
+            self._cond.notify_all()
+        self._count("accepted")
+        self._tenant_inc(tenant, "submitted")
+        return request
+
+    def _dead(self, req):
+        """to_fail entry for a cancelled/expired request, else None."""
+        if req.cancelled:
+            return ("cancelled", req, RequestCancelled(
+                f"request {req.id} cancelled while queued"))
+        if req.expired():
+            return ("timeouts", req, DeadlineExceededError(
+                f"request {req.id} deadline exceeded after "
+                f"{time.monotonic() - req.arrival:.3f}s in queue"))
+        return None
+
+    def _advance(self):
+        self._rr.rotate(-1)
+        self._front_credited = False
+
+    def _pop_locked(self, to_fail):
+        """One DRR scheduling decision under the lock. The rotation
+        front keeps serving while its deficit covers head costs;
+        crediting happens exactly once per arrival at a queue, so a
+        front tenant cannot out-earn its rotation share. Terminates:
+        every full rotation credits each live queue a positive amount,
+        so some deficit eventually covers its (finite) head cost, and a
+        sweep leaving nothing live exits with None."""
+        while self._head:
+            req = self._head.popleft()
+            self._size -= 1
+            dead = self._dead(req)
+            if dead is None:
+                return req
+            to_fail.append(dead)
+        while self._size:
+            progressed = False
+            for _ in range(len(self._rr)):
+                t = self._rr[0]
+                q = self._queues[t]
+                while q:
+                    dead = self._dead(q[0])
+                    if dead is None:
+                        break
+                    to_fail.append(dead)
+                    q.popleft()
+                    self._size -= 1
+                if not q:
+                    self._deficit[t] = 0.0
+                    self._advance()
+                    continue
+                progressed = True
+                if not self._front_credited:
+                    self._deficit[t] += self.quantum * self._weight(t)
+                    self._front_credited = True
+                if self._deficit[t] >= self._cost(q[0]):
+                    self._deficit[t] -= self._cost(q[0])
+                    self._size -= 1
+                    return q.popleft()
+                self._advance()
+            if not progressed:
+                return None
+        return None
+
+    def pop(self, timeout=0.0):
+        """Next live request in weighted-fair order, or None."""
+        deadline = time.monotonic() + timeout
+        while True:
+            got = None
+            finished = False
+            to_fail: list = []
+            with self._cond:
+                got = self._pop_locked(to_fail)
+                if got is None:
+                    if self._closed:
+                        finished = True
+                    else:
+                        remaining = deadline - time.monotonic()
+                        if remaining <= 0:
+                            finished = True
+                        else:
+                            self._cond.wait(remaining)
+            for name, req, err in to_fail:
+                self._count(name)
+                req._fail(err)
+            if got is not None:
+                faults.fault_point("serving.dequeue", got)
+                return got
+            if finished:
+                return None
+
+    def requeue(self, request: Request):
+        """Head-of-line push-back (paged-engine pool-wait contract):
+        requeued items are served before any DRR decision and carry no
+        extra deficit charge — their cost was already debited."""
+        with self._cond:
+            self._head.appendleft(request)
+            self._size += 1
+            self._cond.notify_all()
+
+    def wait_nonempty(self, timeout):
+        with self._cond:
+            if self._size or self._closed:
+                return
+            self._cond.wait(timeout)
+
+    def close(self, drain=True):
+        dropped: list = []
+        with self._cond:
+            self._closed = True
+            self._drain = drain
+            if not drain:
+                while self._head:
+                    dropped.append(self._head.popleft())
+                for q in self._queues.values():
+                    while q:
+                        dropped.append(q.popleft())
+                self._size = 0
             self._cond.notify_all()
         for req in dropped:
             self._count("rejected_closed")

@@ -55,7 +55,8 @@ from ..framework import faults, monitor
 from ..framework.flags import flag
 
 __all__ = ["WeightVersion", "WeightRegistry", "RolloutController",
-           "RolloutError", "RolloutGateError", "golden_digests"]
+           "RolloutError", "RolloutGateError", "golden_digests",
+           "artifact_digest"]
 
 
 class RolloutError(RuntimeError):
@@ -69,6 +70,18 @@ class RolloutGateError(RolloutError):
 def _digest_ids(ids):
     a = np.ascontiguousarray(np.asarray(ids, np.int32))
     return hashlib.sha256(a.tobytes()).hexdigest()
+
+
+def artifact_digest(manifest):
+    """One sha256 identifying a whole artifact: the hash of its sorted
+    per-leaf digest lines. Two artifacts (weight sets, adapter banks)
+    are bitwise-identical iff their artifact digests match — the
+    identity key the multi-tenant `ArtifactCatalog` (serving/tenancy.py)
+    and `WeightVersion.digest` share."""
+    h = hashlib.sha256()
+    for name in sorted(manifest):
+        h.update(f"{name}={manifest[name]}\n".encode())
+    return h.hexdigest()
 
 
 def golden_digests(model, values, prompts, *, max_new=6):
@@ -165,6 +178,7 @@ class WeightVersion:
         self.values = dict(values)
         self.manifest = dict(manifest) if manifest else \
             ckpt.leaf_digests(self.values)
+        self.digest = artifact_digest(self.manifest)
         self.source = source
         self.golden = dict(golden) if golden else None
         if quant is None:

@@ -65,6 +65,12 @@ class Server:
     fleet mode pairs it with prefix-affinity routing
     (FLAGS_serving_prefix_affinity or
     ``fleet=dict(prefix_affinity=...)``).
+
+    Multi-tenant serving: ``max_adapters=N`` gives every engine an
+    N-row batched LoRA adapter bank (``submit(..., adapter_id=k)``;
+    row 0 = base model) and ``tenancy=TenantDirectory(...)`` switches
+    admission to weighted-fair per-tenant queues with token budgets
+    and tier-based brownout (``submit(..., tenant=name)``).
     """
 
     def __init__(self, model=None, *, mode="generate", fn=None,
@@ -74,11 +80,13 @@ class Server:
                  cache_dtype=None, jit=True, strict_shapes=False,
                  warmup=True, replicas=1, fleet=None, spec_len=None,
                  draft_model=None, quantize=None, w8a8=None, mesh=None,
-                 spill_dir=None):
+                 spill_dir=None, max_adapters=None, lora_rank=None,
+                 tenancy=None):
         self.mode = mode
         self.metrics = ServingMetrics()
         self._warmup = warmup
         self.router = None
+        self.tenancy = tenancy
         if mode == "generate" and (replicas > 1 or fleet is not None):
             if model is None:
                 raise ValueError("generate mode needs a GPT model")
@@ -91,21 +99,28 @@ class Server:
                 cache_dtype=cache_dtype, strict_shapes=strict_shapes,
                 spec_len=spec_len, draft_model=draft_model,
                 quantize=quantize, w8a8=w8a8, mesh=mesh,
-                spill_dir=spill_dir)
+                spill_dir=spill_dir, max_adapters=max_adapters,
+                lora_rank=lora_rank)
+            fleet_kw = dict(fleet or {})
+            if tenancy is not None:
+                fleet_kw.setdefault("tenancy", tenancy)
             self.router = Router(
                 model, max(replicas, 1), engine_kw=engine_kw,
                 metrics=self.metrics, queue_cap=queue_cap,
-                warmup=warmup, **dict(fleet or {}))
+                warmup=warmup, **fleet_kw)
             self.engine = None
             self.batcher = None
         elif mode == "generate":
             if model is None:
                 raise ValueError("generate mode needs a GPT model")
-            from .queueing import AdmissionQueue
+            from .queueing import AdmissionQueue, TenantFairQueue
 
-            queue = AdmissionQueue(
-                queue_cap or flag("FLAGS_serving_queue_cap"),
-                metrics=self.metrics)
+            cap = queue_cap or flag("FLAGS_serving_queue_cap")
+            if tenancy is not None:
+                queue = TenantFairQueue(cap, tenancy=tenancy,
+                                        metrics=self.metrics)
+            else:
+                queue = AdmissionQueue(cap, metrics=self.metrics)
             self.engine = SlotEngine(
                 model, max_slots=max_slots, max_seq_len=max_seq_len,
                 block_size=block_size, num_blocks=num_blocks,
@@ -114,7 +129,8 @@ class Server:
                 queue=queue, strict_shapes=strict_shapes,
                 spec_len=spec_len, draft_model=draft_model,
                 quantize=quantize, w8a8=w8a8, mesh=mesh,
-                spill_dir=spill_dir)
+                spill_dir=spill_dir, max_adapters=max_adapters,
+                lora_rank=lora_rank)
             self.batcher = None
         elif mode == "batch":
             target = fn if fn is not None else model
@@ -279,7 +295,11 @@ def http_front(server: Server = None, host="127.0.0.1", port=0, *,
     GET /v1/version the model-version view (current/previous ids,
     rollout state, per-replica version map). Serving errors map to
     their HTTP status (429 shed, 504 deadline, 503 version retired,
-    ...), with a ``Retry-After`` backoff hint on 429/503.
+    ...), with a ``Retry-After`` backoff hint on 429/503. Requests may
+    carry a tenant identity as an ``X-Tenant`` header or a ``tenant``
+    body field (on both /v1/generate and /v1/rank); a tenant over its
+    token budget gets a per-tenant 429 whose ``Retry-After`` is that
+    tenant's own bucket refill time.
 
     Pass ``ranker=`` (a `rec.RankingService`) to also serve
     POST /v1/rank: ``{"dnn_ids": [...], "lr_ids": [...]}`` (wide&deep)
@@ -356,12 +376,20 @@ def http_front(server: Server = None, host="127.0.0.1", port=0, *,
             try:
                 n = int(self.headers.get("Content-Length", 0))
                 req = json.loads(self.rfile.read(n) or b"{}")
+                # tenant identity rides either as an `X-Tenant` header
+                # or a `tenant` body field (body wins on conflict); the
+                # tenant's admission budget answers 429s with its own
+                # Retry-After refill time below
+                xt = self.headers.get("X-Tenant")
+                if xt and not req.get("tenant"):
+                    req["tenant"] = xt
                 if self.path == "/v1/generate" and server is not None:
                     prompt = req.pop("prompt")
                     timeout = req.pop("timeout", None)
                     out = server.generate(prompt, timeout=timeout, **req)
                     self._reply(200, {"ids": np.asarray(out).tolist()})
                 elif self.path == "/v1/rank" and ranker is not None:
+                    req.pop("tenant", None)   # ranker bills nothing yet
                     self._reply(200, {"scores": rank_scores(req)})
                 else:
                     self._reply(404, {"error": "not found"})
@@ -372,8 +400,10 @@ def http_front(server: Server = None, host="127.0.0.1", port=0, *,
                 # responses carry a Retry-After hint
                 headers = {}
                 if e.status in (429, 503):
+                    # instance attribute first: a TenantBudgetError
+                    # carries the tenant's actual bucket refill time
                     headers["Retry-After"] = \
-                        f"{type(e).retry_after_s:g}"
+                        f"{e.retry_after_s:g}"
                 self._reply(e.status, {
                     "error": str(e),
                     "type": type(e).__name__,

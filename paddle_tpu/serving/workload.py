@@ -18,7 +18,10 @@ every serving bench shares (bench_serving.py --trace, bench_fleet.py):
   separated by think-time gaps, each turn's prompt a pure extension of
   the previous one — the resume-heavy shape that exercises prefix-
   affinity routing and the SSD KV spill tier (bench_serving.py
-  --sessions).
+  --sessions). With ``tenants={name: {"weight": ..., "priority": ...}}``
+  every arrival additionally bills to a tenant drawn from that weighted
+  mix (bench_fleet.py --tenants), feeding weighted-fair admission and
+  per-tenant SLO accounting.
 - **Scenario.trace()** — expands the spec into a concrete arrival list,
   bit-deterministic in the seed: the same JSON replays the exact same
   trace on any machine, which is what lets a chaos re-run be compared
@@ -52,13 +55,17 @@ class Arrival:
     session shares the session id, and turn k's prompt is a pure
     extension of turn k-1's — the shape that makes prefix-affinity
     routing and the SSD KV spill tier earn their keep. Single-shot
-    arrivals carry ``session=None, turn=0``."""
+    arrivals carry ``session=None, turn=0``.
+
+    ``tenant`` names the paying tenant the request bills to (None on
+    single-tenant scenarios); multi-tenant scenarios draw it zipfian
+    from the spec's ``tenants`` mix."""
 
     __slots__ = ("t", "user", "prompt", "max_new", "priority",
-                 "session", "turn")
+                 "session", "turn", "tenant")
 
     def __init__(self, t, user, prompt, max_new, priority,
-                 session=None, turn=0):
+                 session=None, turn=0, tenant=None):
         self.t = float(t)
         self.user = int(user)
         self.prompt = np.asarray(prompt, np.int32)
@@ -66,13 +73,15 @@ class Arrival:
         self.priority = int(priority)
         self.session = None if session is None else int(session)
         self.turn = int(turn)
+        self.tenant = None if tenant is None else str(tenant)
 
     def __repr__(self):
         sess = "" if self.session is None \
             else f", session={self.session}, turn={self.turn}"
+        ten = "" if self.tenant is None else f", tenant={self.tenant!r}"
         return (f"Arrival(t={self.t:.4f}, user={self.user}, "
                 f"len={self.prompt.size}, max_new={self.max_new}, "
-                f"priority={self.priority}{sess})")
+                f"priority={self.priority}{sess}{ten})")
 
 
 def _normalize_phase(p):
@@ -112,7 +121,7 @@ class Scenario:
                  zipf_s=1.2, user_prefix_len=8, prompt_len=(4, 12),
                  max_new=(4, 8), priorities=((0, 0.7), (1, 0.2), (2, 0.1)),
                  phases=None, multi_turn=False, session_turns=(2, 4),
-                 think_time=(0.05, 0.2)):
+                 think_time=(0.05, 0.2), tenants=None):
         self.name = str(name)
         self.seed = int(seed)
         self.vocab = int(vocab)
@@ -152,11 +161,28 @@ class Scenario:
         if self.think_time[0] < 0 or \
                 self.think_time[1] < self.think_time[0]:
             raise ValueError(f"bad think_time range {self.think_time}")
+        # multi-tenant mix (ISSUE 20): name -> {"weight": draw weight,
+        # "priority": optional override of the drawn priority class}.
+        # None keeps the trace single-tenant AND bit-identical to every
+        # pre-tenancy trace (the tenant draw consumes RNG only when a
+        # mix is configured).
+        self.tenants = None
+        if tenants:
+            self.tenants = {}
+            for tname in sorted(tenants):
+                spec = dict(tenants[tname])
+                spec["weight"] = float(spec.get("weight", 1.0))
+                if spec["weight"] <= 0:
+                    raise ValueError(
+                        f"tenant {tname!r} needs positive weight")
+                if "priority" in spec:
+                    spec["priority"] = int(spec["priority"])
+                self.tenants[str(tname)] = spec
 
     # -- spec (de)serialization ---------------------------------------------
 
     def to_dict(self):
-        return {
+        d = {
             "name": self.name, "seed": self.seed, "vocab": self.vocab,
             "n_users": self.n_users, "zipf_s": self.zipf_s,
             "user_prefix_len": self.user_prefix_len,
@@ -168,6 +194,9 @@ class Scenario:
             "session_turns": list(self.session_turns),
             "think_time": list(self.think_time),
         }
+        if self.tenants is not None:
+            d["tenants"] = {t: dict(s) for t, s in self.tenants.items()}
+        return d
 
     def to_json(self, path=None, **kw):
         text = json.dumps(self.to_dict(), sort_keys=True, **kw)
@@ -274,6 +303,12 @@ class Scenario:
         prio_vals = np.asarray([p for p, _ in self.priorities])
         prio_w = np.asarray([w for _, w in self.priorities], np.float64)
         prio_w /= prio_w.sum()
+        tnames, tw = None, None
+        if self.tenants is not None:
+            tnames = list(self.tenants)          # sorted at construction
+            tw = np.asarray([self.tenants[t]["weight"] for t in tnames],
+                            np.float64)
+            tw /= tw.sum()
         prefixes = {}
         arrivals = []
         t0, session_id = 0.0, 0
@@ -295,11 +330,19 @@ class Scenario:
                 max_new = int(rng.randint(lo, hi + 1))
                 priority = int(prio_vals[rng.choice(len(prio_vals),
                                                     p=prio_w)])
+                tenant = None
+                if tnames is not None:
+                    # the tenant draw consumes RNG only in multi-tenant
+                    # mode, so legacy seeded traces stay bit-identical
+                    tenant = tnames[int(rng.choice(len(tnames), p=tw))]
+                    tprio = self.tenants[tenant].get("priority")
+                    if tprio is not None:
+                        priority = int(tprio)
                 prompt = np.concatenate(
                     [prefixes[user], tail.astype(np.int32)])
                 if not self.multi_turn:
                     arrivals.append(Arrival(t, user, prompt, max_new,
-                                            priority))
+                                            priority, tenant=tenant))
                     continue
                 # multi-turn: this arrival opens a session; turn k's
                 # prompt extends turn k-1's with a fresh tail after a
@@ -323,7 +366,7 @@ class Scenario:
                         max_new = int(rng.randint(lo, hi + 1))
                     arrivals.append(Arrival(tt, user, prompt, max_new,
                                             priority, session=sid,
-                                            turn=turn))
+                                            turn=turn, tenant=tenant))
             t0 = end
         if self.multi_turn:
             # session turns overrun their phase slot; restore global

@@ -174,6 +174,19 @@ def test_kv_fabric_sites_are_registered():
         assert any(h in faults.SITES[site].lower() for h in hints), site
 
 
+def test_tenancy_sites_are_registered():
+    """ISSUE 20: the multi-tenant sites — per-tenant admission and the
+    adapter-bank hot-swap — must stay registered, or bench_fleet.py
+    --tenants' chaos legs degrade to clean runs. (Behavioral coverage:
+    test_tenancy.py: an admit_tenant drop is a per-tenant shed with a
+    Retry-After hint; a mid-swap fault aborts all-or-nothing and the
+    OLD adapter bank keeps serving bitwise.)"""
+    for site, hints in (("serving.admit_tenant", ("tenant", "budget")),
+                        ("serving.adapter_swap", ("adapter",))):
+        assert site in faults.SITES, site
+        assert any(h in faults.SITES[site].lower() for h in hints), site
+
+
 def test_w8a8_site_is_registered():
     """ISSUE 19: the w8a8 decode site — each step's activation-quant
     dispatch — must stay registered, or the low-precision degrade path
